@@ -1,0 +1,112 @@
+"""Wire-layout parity tests.
+
+Asserts the numpy dtypes reproduce the reference extern-struct layouts
+byte for byte (reference: src/tigerbeetle.zig:7-322).
+"""
+
+import numpy as np
+
+from tigerbeetle_tpu import constants, types
+
+
+def offsets(dtype):
+    return {name: dtype.fields[name][1] for name in dtype.names}
+
+
+def test_account_layout():
+    assert types.ACCOUNT_DTYPE.itemsize == 128
+    off = offsets(types.ACCOUNT_DTYPE)
+    assert off["id_lo"] == 0
+    assert off["debits_pending_lo"] == 16
+    assert off["debits_posted_lo"] == 32
+    assert off["credits_pending_lo"] == 48
+    assert off["credits_posted_lo"] == 64
+    assert off["user_data_128_lo"] == 80
+    assert off["user_data_64"] == 96
+    assert off["user_data_32"] == 104
+    assert off["reserved"] == 108
+    assert off["ledger"] == 112
+    assert off["code"] == 116
+    assert off["flags"] == 118
+    assert off["timestamp"] == 120
+
+
+def test_transfer_layout():
+    assert types.TRANSFER_DTYPE.itemsize == 128
+    off = offsets(types.TRANSFER_DTYPE)
+    assert off["id_lo"] == 0
+    assert off["debit_account_id_lo"] == 16
+    assert off["credit_account_id_lo"] == 32
+    assert off["amount_lo"] == 48
+    assert off["pending_id_lo"] == 64
+    assert off["user_data_128_lo"] == 80
+    assert off["user_data_64"] == 96
+    assert off["user_data_32"] == 104
+    assert off["timeout"] == 108
+    assert off["ledger"] == 112
+    assert off["code"] == 116
+    assert off["flags"] == 118
+    assert off["timestamp"] == 120
+
+
+def test_account_balance_layout():
+    assert types.ACCOUNT_BALANCE_DTYPE.itemsize == 128
+    off = offsets(types.ACCOUNT_BALANCE_DTYPE)
+    assert off["timestamp"] == 64
+    assert off["reserved"] == 72
+
+
+def test_account_filter_layout():
+    assert types.ACCOUNT_FILTER_DTYPE.itemsize == 64
+    off = offsets(types.ACCOUNT_FILTER_DTYPE)
+    assert off["timestamp_min"] == 16
+    assert off["timestamp_max"] == 24
+    assert off["limit"] == 32
+    assert off["flags"] == 36
+    assert off["reserved"] == 40
+
+
+def test_groove_value_layouts():
+    assert types.TRANSFER_PENDING_DTYPE.itemsize == 16
+    assert types.ACCOUNT_BALANCES_GROOVE_DTYPE.itemsize == 256
+    assert types.CREATE_RESULT_DTYPE.itemsize == 8
+
+
+def test_u128_roundtrip():
+    arr = np.zeros(1, dtype=types.ACCOUNT_DTYPE)
+    value = (123 << 64) | 456
+    types.u128_set(arr[0], "id", value)
+    assert types.u128_get(arr[0], "id") == value
+    # Little-endian layout: lo limb first.
+    raw = arr.tobytes()[0:16]
+    assert raw == value.to_bytes(16, "little")
+
+
+def test_u128_max_roundtrip():
+    arr = np.zeros(1, dtype=types.TRANSFER_DTYPE)
+    types.u128_set(arr[0], "amount", types.U128_MAX)
+    assert types.u128_get(arr[0], "amount") == types.U128_MAX
+    assert arr.tobytes()[48:64] == b"\xff" * 16
+
+
+def test_result_code_values():
+    # Spot-check precedence-critical orderings.
+    assert types.CreateTransferResult.exists == 46
+    assert types.CreateTransferResult.overflows_debits_pending == 47
+    assert types.CreateTransferResult.exceeds_credits == 54
+    assert types.CreateTransferResult.exceeds_debits == 55
+    assert types.CreateAccountResult.exists == 21
+    assert len(types.CreateTransferResult) == 56
+    assert len(types.CreateAccountResult) == 22
+
+
+def test_batch_max():
+    assert constants.PRODUCTION.batch_max_create_transfers == 8190
+    assert constants.TEST_MIN.batch_max_create_transfers == 30
+    assert constants.PRODUCTION.vsr_checkpoint_interval == 960
+
+
+def test_flags():
+    assert types.TransferFlags.pending == 2
+    assert types.TransferFlags.balancing_credit == 32
+    assert types.AccountFlags.history == 8
