@@ -1,0 +1,283 @@
+"""create_transfers semantics vs the reference precedence ladder.
+
+Covers the single-phase subset of the 56 CreateTransferResult codes
+(reference: src/tigerbeetle.zig:185-265, src/state_machine.zig:1462-1606).
+Two-phase codes live in test_two_phase.py.
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.testing.harness import SingleNodeHarness, account, transfer
+
+CTR = types.CreateTransferResult
+AF = types.AccountFlags
+TF = types.TransferFlags
+MAX = types.U128_MAX
+
+
+@pytest.fixture
+def h():
+    h = SingleNodeHarness(CpuStateMachine())
+    assert (
+        h.create_accounts(
+            [account(1), account(2), account(3, ledger=2), account(4)]
+        )
+        == []
+    )
+    return h
+
+
+def t(id, dr=1, cr=2, amount=10, **kw):
+    return transfer(id, debit_account_id=dr, credit_account_id=cr, amount=amount, **kw)
+
+
+def balances(h, id):
+    row = h.lookup_accounts([id])[0]
+    return tuple(
+        types.u128_get(row, f)
+        for f in ("debits_pending", "debits_posted", "credits_pending", "credits_posted")
+    )
+
+
+def test_ok_posted(h):
+    assert h.create_transfers([t(100)]) == []
+    assert balances(h, 1) == (0, 10, 0, 0)
+    assert balances(h, 2) == (0, 0, 0, 10)
+    row = h.lookup_transfers([100])[0]
+    assert types.u128_get(row, "amount") == 10
+    assert int(row["timestamp"]) > 0
+
+
+def test_validation_ladder(h):
+    cases = [
+        (t(1, flags=1 << 9), CTR.reserved_flag),
+        (t(0), CTR.id_must_not_be_zero),
+        (t(MAX), CTR.id_must_not_be_int_max),
+        (t(1, dr=0), CTR.debit_account_id_must_not_be_zero),
+        (t(1, dr=MAX), CTR.debit_account_id_must_not_be_int_max),
+        (t(1, cr=0), CTR.credit_account_id_must_not_be_zero),
+        (t(1, cr=MAX), CTR.credit_account_id_must_not_be_int_max),
+        (t(1, dr=1, cr=1), CTR.accounts_must_be_different),
+        (t(1, pending_id=5), CTR.pending_id_must_be_zero),
+        (t(1, timeout=5), CTR.timeout_reserved_for_pending_transfer),
+        (t(1, amount=0), CTR.amount_must_not_be_zero),
+        (t(1, ledger=0), CTR.ledger_must_not_be_zero),
+        (t(1, code=0), CTR.code_must_not_be_zero),
+        (t(1, dr=99), CTR.debit_account_not_found),
+        (t(1, cr=99), CTR.credit_account_not_found),
+        (t(1, cr=3), CTR.accounts_must_have_the_same_ledger),
+        (t(1, ledger=2), CTR.transfer_must_have_the_same_ledger_as_accounts),
+    ]
+    for row, expected in cases:
+        assert h.create_transfers([row]) == [(0, expected)], expected
+
+
+def test_timestamp_must_be_zero(h):
+    assert h.create_transfers([t(1, timestamp=1)]) == [(0, CTR.timestamp_must_be_zero)]
+
+
+def test_exists_ladder(h):
+    base = dict(
+        amount=10, user_data_128=1, user_data_64=2, user_data_32=3, code=5
+    )
+    assert h.create_transfers([t(100, **base)]) == []
+    cases = [
+        (t(100, flags=TF.pending, timeout=1, **base), CTR.exists_with_different_flags),
+        (
+            transfer(
+                100, debit_account_id=2, credit_account_id=1, amount=10,
+                user_data_128=1, user_data_64=2, user_data_32=3, code=5,
+            ),
+            CTR.exists_with_different_debit_account_id,
+        ),
+        (t(100, cr=4, **base), CTR.exists_with_different_credit_account_id),
+        (t(100, **{**base, "amount": 11}), CTR.exists_with_different_amount),
+        (
+            t(100, **{**base, "user_data_128": 9}),
+            CTR.exists_with_different_user_data_128,
+        ),
+        (
+            t(100, **{**base, "user_data_64": 9}),
+            CTR.exists_with_different_user_data_64,
+        ),
+        (
+            t(100, **{**base, "user_data_32": 9}),
+            CTR.exists_with_different_user_data_32,
+        ),
+        (t(100, **{**base, "code": 9}), CTR.exists_with_different_code),
+        (t(100, **base), CTR.exists),
+    ]
+    for row, expected in cases:
+        assert h.create_transfers([row]) == [(0, expected)], expected
+    # Balances unchanged by all the exists probes.
+    assert balances(h, 1) == (0, 10, 0, 0)
+
+
+def test_exists_with_different_timeout(h):
+    assert h.create_transfers([t(100, flags=TF.pending, timeout=5)]) == []
+    assert h.create_transfers([t(100, flags=TF.pending, timeout=6)]) == [
+        (0, CTR.exists_with_different_timeout)
+    ]
+
+
+def test_overflow_codes(h):
+    big = MAX - 5
+    assert h.create_transfers([t(100, amount=big)]) == []
+    assert balances(h, 1) == (0, big, 0, 0)
+    # debits_posted would overflow.
+    assert h.create_transfers([t(101, amount=10)]) == [
+        (0, CTR.overflows_debits_posted)
+    ]
+    # Pending-side overflow: use fresh accounts.
+    assert h.create_accounts([account(10), account(11), account(12)]) == []
+    assert h.create_transfers(
+        [t(102, dr=10, cr=11, amount=big, flags=TF.pending)]
+    ) == []
+    assert h.create_transfers(
+        [t(103, dr=10, cr=12, amount=10, flags=TF.pending)]
+    ) == [(0, CTR.overflows_debits_pending)]
+    assert h.create_transfers(
+        [t(104, dr=12, cr=11, amount=10, flags=TF.pending)]
+    ) == [(0, CTR.overflows_credits_pending)]
+    # overflows_debits: pending + posted + amount > u128 max.
+    assert h.create_accounts([account(13), account(14)]) == []
+    assert h.create_transfers([t(105, dr=13, cr=14, amount=big, flags=TF.pending)]) == []
+    assert h.create_transfers([t(106, dr=13, cr=14, amount=4)]) == []
+    assert h.create_transfers([t(107, dr=13, cr=14, amount=2)]) == [
+        (0, CTR.overflows_debits)
+    ]
+
+
+def test_overflows_timeout(h):
+    # timestamp + timeout_ns must fit u64 (reference:
+    # src/state_machine.zig:1545); needs a wall clock near u64 max.
+    late = types.U64_MAX - 1_500_000_000
+    assert h.create_transfers(
+        [t(100, flags=TF.pending, timeout=2)], realtime=late
+    ) == [(0, CTR.overflows_timeout)]
+    assert h.create_transfers([t(101, flags=TF.pending, timeout=1)]) == []
+
+
+def test_exceeds_credits_and_debits(h):
+    assert h.create_accounts(
+        [
+            account(20, flags=AF.debits_must_not_exceed_credits),
+            account(21, flags=AF.credits_must_not_exceed_debits),
+            account(22),
+        ]
+    ) == []
+    # Fund account 20 with 50 credits.
+    assert h.create_transfers([t(100, dr=22, cr=20, amount=50)]) == []
+    assert h.create_transfers([t(101, dr=20, cr=22, amount=51)]) == [
+        (0, CTR.exceeds_credits)
+    ]
+    assert h.create_transfers([t(102, dr=20, cr=22, amount=50)]) == []
+    # account 21: credits must not exceed debits (has 0 debits).
+    assert h.create_transfers([t(103, dr=22, cr=21, amount=1)]) == [
+        (0, CTR.exceeds_debits)
+    ]
+
+
+def test_balancing_debit(h):
+    assert h.create_accounts(
+        [account(30, flags=AF.debits_must_not_exceed_credits), account(31)]
+    ) == []
+    assert h.create_transfers([t(100, dr=31, cr=30, amount=40)]) == []
+    # balancing_debit clamps the amount to what's available (40).
+    assert h.create_transfers(
+        [t(101, dr=30, cr=31, amount=100, flags=TF.balancing_debit)]
+    ) == []
+    row = h.lookup_transfers([101])[0]
+    assert types.u128_get(row, "amount") == 40
+    assert balances(h, 30) == (0, 40, 0, 40)
+    # Nothing left -> exceeds_credits.
+    assert h.create_transfers(
+        [t(102, dr=30, cr=31, amount=1, flags=TF.balancing_debit)]
+    ) == [(0, CTR.exceeds_credits)]
+    # amount=0 with balancing = "transfer as much as possible".
+    assert h.create_transfers([t(103, dr=31, cr=30, amount=5)]) == []
+    assert h.create_transfers(
+        [t(104, dr=30, cr=31, amount=0, flags=TF.balancing_debit)]
+    ) == []
+    row = h.lookup_transfers([104])[0]
+    assert types.u128_get(row, "amount") == 5
+
+
+def test_balancing_credit(h):
+    assert h.create_accounts(
+        [account(40, flags=AF.credits_must_not_exceed_debits), account(41)]
+    ) == []
+    assert h.create_transfers([t(100, dr=40, cr=41, amount=30)]) == []
+    assert h.create_transfers(
+        [t(101, dr=41, cr=40, amount=100, flags=TF.balancing_credit)]
+    ) == []
+    row = h.lookup_transfers([101])[0]
+    assert types.u128_get(row, "amount") == 30
+    assert h.create_transfers(
+        [t(102, dr=41, cr=40, amount=1, flags=TF.balancing_credit)]
+    ) == [(0, CTR.exceeds_debits)]
+
+
+def test_linked_chain_rollback_restores_balances(h):
+    rows = [
+        t(100, amount=10, flags=TF.linked),
+        t(101, amount=20, flags=TF.linked),
+        t(0),  # id_must_not_be_zero breaks the chain
+    ]
+    assert h.create_transfers(rows) == [
+        (0, CTR.linked_event_failed),
+        (1, CTR.linked_event_failed),
+        (2, CTR.id_must_not_be_zero),
+    ]
+    assert balances(h, 1) == (0, 0, 0, 0)
+    assert balances(h, 2) == (0, 0, 0, 0)
+    assert len(h.lookup_transfers([100, 101])) == 0
+
+
+def test_chain_sees_prior_chain_events(h):
+    # Second event in the chain duplicates the first -> exists ladder.
+    rows = [
+        t(100, flags=TF.linked),
+        t(100),
+    ]
+    results = h.create_transfers(rows)
+    assert results == [
+        (0, CTR.linked_event_failed),
+        (1, CTR.exists_with_different_flags),
+    ]
+
+
+def test_batch_sees_earlier_events(h):
+    # Same-account transfers accumulate within one batch.
+    rows = [t(100, amount=10), t(101, amount=20)]
+    assert h.create_transfers(rows) == []
+    assert balances(h, 1) == (0, 30, 0, 0)
+
+
+def test_limit_interacts_within_batch(h):
+    assert h.create_accounts(
+        [account(50, flags=AF.debits_must_not_exceed_credits), account(51)]
+    ) == []
+    # Fund 50 with 25, then two debits of 20: the second must fail only
+    # because the first applied.
+    rows = [
+        t(100, dr=51, cr=50, amount=25),
+        t(101, dr=50, cr=51, amount=20),
+        t(102, dr=50, cr=51, amount=20),
+    ]
+    assert h.create_transfers(rows) == [(2, CTR.exceeds_credits)]
+    assert balances(h, 50) == (0, 20, 0, 25)
+
+
+def test_results_are_sparse_failures_only(h):
+    sm = h.sm
+    out = h.submit(
+        types.Operation.create_transfers,
+        np.stack([t(100), t(0), t(101)]).tobytes(),
+    )
+    arr = np.frombuffer(out, dtype=types.CREATE_RESULT_DTYPE)
+    assert len(arr) == 1
+    assert int(arr[0]["index"]) == 1

@@ -1,0 +1,175 @@
+"""get_account_transfers / get_account_balances / lookup queries.
+
+reference: src/state_machine.zig:786-1008 (filter validation + scans),
+:1346-1419 (execution), :1806-1841 (historical balances).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.testing.harness import SingleNodeHarness, account, transfer
+
+AF = types.AccountFlags
+FF = types.AccountFilterFlags
+TF = types.TransferFlags
+
+
+def account_filter(
+    account_id,
+    *,
+    timestamp_min=0,
+    timestamp_max=0,
+    limit=8190,
+    flags=FF.debits | FF.credits,
+    reserved=b"\x00" * 24,
+) -> bytes:
+    row = np.zeros(1, dtype=types.ACCOUNT_FILTER_DTYPE)[0]
+    types.u128_set(row, "account_id", account_id)
+    row["timestamp_min"] = timestamp_min
+    row["timestamp_max"] = timestamp_max
+    row["limit"] = limit
+    row["flags"] = flags
+    row["reserved"] = np.frombuffer(reserved, dtype=np.uint8)
+    return row.tobytes()
+
+
+@pytest.fixture
+def h():
+    h = SingleNodeHarness(CpuStateMachine())
+    assert (
+        h.create_accounts(
+            [account(1, flags=AF.history), account(2), account(3, flags=AF.history)]
+        )
+        == []
+    )
+    # 1 -> 2 (x2), 2 -> 1, 1 -> 3
+    assert (
+        h.create_transfers(
+            [
+                transfer(100, debit_account_id=1, credit_account_id=2, amount=10),
+                transfer(101, debit_account_id=1, credit_account_id=2, amount=20),
+                transfer(102, debit_account_id=2, credit_account_id=1, amount=5),
+                transfer(103, debit_account_id=1, credit_account_id=3, amount=1),
+            ]
+        )
+        == []
+    )
+    return h
+
+
+def get_transfers(h, filter_bytes):
+    out = h.submit(types.Operation.get_account_transfers, filter_bytes)
+    return np.frombuffer(out, dtype=types.TRANSFER_DTYPE)
+
+
+def get_balances(h, filter_bytes):
+    out = h.submit(types.Operation.get_account_balances, filter_bytes)
+    return np.frombuffer(out, dtype=types.ACCOUNT_BALANCE_DTYPE)
+
+
+def tids(rows):
+    return [types.u128_get(r, "id") for r in rows]
+
+
+def test_get_account_transfers_both_sides(h):
+    rows = get_transfers(h, account_filter(1))
+    assert tids(rows) == [100, 101, 102, 103]
+
+
+def test_get_account_transfers_debits_only(h):
+    rows = get_transfers(h, account_filter(1, flags=FF.debits))
+    assert tids(rows) == [100, 101, 103]
+
+
+def test_get_account_transfers_credits_only(h):
+    rows = get_transfers(h, account_filter(1, flags=FF.credits))
+    assert tids(rows) == [102]
+
+
+def test_get_account_transfers_reversed(h):
+    rows = get_transfers(h, account_filter(1, flags=FF.debits | FF.credits | FF.reversed))
+    assert tids(rows) == [103, 102, 101, 100]
+
+
+def test_get_account_transfers_limit(h):
+    rows = get_transfers(h, account_filter(1, limit=2))
+    assert tids(rows) == [100, 101]
+
+
+def test_get_account_transfers_timestamp_range(h):
+    all_rows = get_transfers(h, account_filter(1))
+    ts = [int(r["timestamp"]) for r in all_rows]
+    rows = get_transfers(h, account_filter(1, timestamp_min=ts[1], timestamp_max=ts[2]))
+    assert tids(rows) == [101, 102]
+
+
+def test_get_account_transfers_invalid_filters(h):
+    # reference: src/state_machine.zig:934-944
+    invalid = [
+        account_filter(0),
+        account_filter(types.U128_MAX),
+        account_filter(1, timestamp_min=types.U64_MAX),
+        account_filter(1, timestamp_max=types.U64_MAX),
+        account_filter(1, timestamp_min=5, timestamp_max=4),
+        account_filter(1, limit=0),
+        account_filter(1, flags=0),
+        account_filter(1, flags=1 << 30),
+        account_filter(1, reserved=b"\x01" + b"\x00" * 23),
+    ]
+    for f in invalid:
+        assert len(get_transfers(h, f)) == 0
+
+
+def test_get_account_balances_history(h):
+    rows = get_balances(h, account_filter(1))
+    assert len(rows) == 4
+    # Account 1 debits: 10, 30, 30 (credit of 5 on other side), 31.
+    posted = [types.u128_get(r, "debits_posted") for r in rows]
+    assert posted == [10, 30, 30, 31]
+    credits = [types.u128_get(r, "credits_posted") for r in rows]
+    assert credits == [0, 0, 5, 5]
+
+
+def test_get_account_balances_non_history_account(h):
+    # Account 2 has no history flag -> empty reply.
+    assert len(get_balances(h, account_filter(2))) == 0
+
+
+def test_get_account_balances_missing_account(h):
+    assert len(get_balances(h, account_filter(99))) == 0
+
+
+def test_get_account_balances_other_side_zeroed(h):
+    # Transfer 103 credited account 3 (history); its balance row must
+    # reflect account 3's side.
+    rows = get_balances(h, account_filter(3))
+    assert len(rows) == 1
+    assert types.u128_get(rows[0], "credits_posted") == 1
+    assert types.u128_get(rows[0], "debits_posted") == 0
+
+
+def test_lookup_missing_are_omitted(h):
+    found = h.lookup_accounts([1, 99, 2])
+    assert len(found) == 2
+    found_t = h.lookup_transfers([100, 999])
+    assert len(found_t) == 1
+
+
+def test_rollback_does_not_leak_history(h):
+    before = len(h.sm.account_balances)
+    assert h.create_transfers(
+        [
+            transfer(
+                200, debit_account_id=1, credit_account_id=2, amount=1,
+                flags=TF.linked,
+            ),
+            transfer(0),
+        ]
+    ) == [
+        (0, types.CreateTransferResult.linked_event_failed),
+        (1, types.CreateTransferResult.id_must_not_be_zero),
+    ]
+    assert len(h.sm.account_balances) == before
+    assert tids(get_transfers(h, account_filter(1))) == [100, 101, 102, 103]

@@ -1,0 +1,234 @@
+"""Two-phase (pending -> post/void) and expiry semantics.
+
+reference: src/state_machine.zig:1608-1804 (post/void),
+:1874-1929 + :2018-2172 (expiry pulse).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.testing.harness import SingleNodeHarness, account, transfer
+
+CTR = types.CreateTransferResult
+AF = types.AccountFlags
+TF = types.TransferFlags
+MAX = types.U128_MAX
+POST = TF.post_pending_transfer
+VOID = TF.void_pending_transfer
+
+
+@pytest.fixture
+def h():
+    h = SingleNodeHarness(CpuStateMachine())
+    assert h.create_accounts([account(1), account(2)]) == []
+    return h
+
+
+def t(id, dr=1, cr=2, amount=10, **kw):
+    return transfer(id, debit_account_id=dr, credit_account_id=cr, amount=amount, **kw)
+
+
+def pend(h, id=100, amount=10, timeout=0):
+    assert h.create_transfers([t(id, amount=amount, flags=TF.pending, timeout=timeout)]) == []
+
+
+def balances(h, id):
+    row = h.lookup_accounts([id])[0]
+    return tuple(
+        types.u128_get(row, f)
+        for f in ("debits_pending", "debits_posted", "credits_pending", "credits_posted")
+    )
+
+
+def test_pending_then_post_full(h):
+    pend(h)
+    assert balances(h, 1) == (10, 0, 0, 0)
+    assert balances(h, 2) == (0, 0, 10, 0)
+    assert h.create_transfers([t(101, dr=0, cr=0, amount=0, pending_id=100, flags=POST)]) == []
+    assert balances(h, 1) == (0, 10, 0, 0)
+    assert balances(h, 2) == (0, 0, 0, 10)
+    # The posting transfer inherits fields from the pending one.
+    row = h.lookup_transfers([101])[0]
+    assert types.u128_get(row, "amount") == 10
+    assert types.u128_get(row, "debit_account_id") == 1
+    assert types.u128_get(row, "pending_id") == 100
+
+
+def test_pending_then_post_partial(h):
+    pend(h)
+    assert h.create_transfers([t(101, dr=0, cr=0, amount=7, pending_id=100, flags=POST)]) == []
+    assert balances(h, 1) == (0, 7, 0, 0)
+    assert balances(h, 2) == (0, 0, 0, 7)
+
+
+def test_pending_then_void(h):
+    pend(h)
+    assert h.create_transfers([t(101, dr=0, cr=0, amount=0, pending_id=100, flags=VOID)]) == []
+    assert balances(h, 1) == (0, 0, 0, 0)
+    assert balances(h, 2) == (0, 0, 0, 0)
+
+
+def test_flag_exclusions(h):
+    pend(h)
+    cases = [
+        (t(101, pending_id=100, flags=POST | VOID), CTR.flags_are_mutually_exclusive),
+        (t(101, pending_id=100, flags=POST | TF.pending), CTR.flags_are_mutually_exclusive),
+        (t(101, pending_id=100, flags=POST | TF.balancing_debit), CTR.flags_are_mutually_exclusive),
+        (t(101, pending_id=100, flags=POST | TF.balancing_credit), CTR.flags_are_mutually_exclusive),
+        (t(101, pending_id=0, flags=POST), CTR.pending_id_must_not_be_zero),
+        (t(101, pending_id=MAX, flags=POST), CTR.pending_id_must_not_be_int_max),
+        (t(101, pending_id=101, flags=POST), CTR.pending_id_must_be_different),
+        (t(101, pending_id=100, timeout=5, flags=POST), CTR.timeout_reserved_for_pending_transfer),
+        (t(101, pending_id=999, flags=POST), CTR.pending_transfer_not_found),
+    ]
+    for row, expected in cases:
+        assert h.create_transfers([row]) == [(0, expected)], expected
+
+
+def test_pending_transfer_not_pending(h):
+    assert h.create_transfers([t(100)]) == []  # plain posted transfer
+    assert h.create_transfers([t(101, pending_id=100, flags=POST)]) == [
+        (0, CTR.pending_transfer_not_pending)
+    ]
+
+
+def test_mismatch_ladder(h):
+    assert h.create_accounts([account(3), account(4)]) == []
+    pend(h)
+    cases = [
+        (t(101, dr=3, cr=0, amount=0, pending_id=100, flags=POST),
+         CTR.pending_transfer_has_different_debit_account_id),
+        (t(101, dr=0, cr=4, amount=0, pending_id=100, flags=POST),
+         CTR.pending_transfer_has_different_credit_account_id),
+        (transfer(101, pending_id=100, ledger=9, code=0, flags=POST),
+         CTR.pending_transfer_has_different_ledger),
+        (transfer(101, pending_id=100, ledger=0, code=9, flags=POST),
+         CTR.pending_transfer_has_different_code),
+        (t(101, dr=0, cr=0, amount=11, pending_id=100, flags=POST),
+         CTR.exceeds_pending_transfer_amount),
+        (t(101, dr=0, cr=0, amount=9, pending_id=100, flags=VOID),
+         CTR.pending_transfer_has_different_amount),
+    ]
+    for row, expected in cases:
+        assert h.create_transfers([row]) == [(0, expected)], expected
+
+
+def test_already_posted_and_voided(h):
+    pend(h, id=100)
+    pend(h, id=200)
+    assert h.create_transfers([t(101, dr=0, cr=0, amount=0, pending_id=100, flags=POST)]) == []
+    assert h.create_transfers([t(102, dr=0, cr=0, amount=0, pending_id=100, flags=POST)]) == [
+        (0, CTR.pending_transfer_already_posted)
+    ]
+    assert h.create_transfers([t(201, dr=0, cr=0, amount=0, pending_id=200, flags=VOID)]) == []
+    assert h.create_transfers([t(202, dr=0, cr=0, amount=0, pending_id=200, flags=VOID)]) == [
+        (0, CTR.pending_transfer_already_voided)
+    ]
+
+
+def test_post_exists_ladder(h):
+    pend(h)
+    post_row = t(101, dr=0, cr=0, amount=7, pending_id=100, flags=POST,
+                 user_data_128=5)
+    assert h.create_transfers([post_row]) == []
+    cases = [
+        # amount=0 passes the void-amount precondition (inherits 10),
+        # reaching the exists ladder where the flags differ.
+        (t(101, dr=0, cr=0, amount=0, pending_id=100, flags=VOID),
+         CTR.exists_with_different_flags),
+        (t(101, dr=0, cr=0, amount=6, pending_id=100, flags=POST),
+         CTR.exists_with_different_amount),
+        # amount=0 means "p.amount" (10) which != e.amount (7).
+        (t(101, dr=0, cr=0, amount=0, pending_id=100, flags=POST),
+         CTR.exists_with_different_amount),
+        (t(101, dr=0, cr=0, amount=7, pending_id=100, flags=POST, user_data_128=9),
+         CTR.exists_with_different_user_data_128),
+        (t(101, dr=0, cr=0, amount=7, pending_id=100, flags=POST, user_data_128=5),
+         CTR.exists),
+    ]
+    for row, expected in cases:
+        assert h.create_transfers([row]) == [(0, expected)], expected
+
+
+def test_exists_with_different_pending_id(h):
+    pend(h, id=100)
+    pend(h, id=200)
+    assert h.create_transfers([t(101, dr=0, cr=0, amount=10, pending_id=100, flags=POST)]) == []
+    assert h.create_transfers([t(101, dr=0, cr=0, amount=10, pending_id=200, flags=POST)]) == [
+        (0, CTR.exists_with_different_pending_id)
+    ]
+
+
+def test_expiry_via_pulse(h):
+    pend(h, id=100, amount=10, timeout=1)
+    assert balances(h, 1) == (10, 0, 0, 0)
+    sm = h.sm
+    expires_at = sm.transfers[100].timestamp + 10**9
+    assert sm.pulse_next_timestamp == expires_at
+    # Advance the wall clock past expiry; the harness injects a pulse.
+    h.submit(types.Operation.lookup_accounts, b"", realtime=expires_at + 1)
+    assert balances(h, 1) == (0, 0, 0, 0)
+    assert balances(h, 2) == (0, 0, 0, 0)
+    assert sm.transfers_pending[sm.transfers[100].timestamp] == types.TransferPendingStatus.expired
+    # Posting after expiry fails.
+    assert h.create_transfers([t(101, dr=0, cr=0, amount=0, pending_id=100, flags=POST)]) == [
+        (0, CTR.pending_transfer_expired)
+    ]
+
+
+def test_post_overdue_pending_before_pulse(h):
+    """A post racing an overdue expiry returns pending_transfer_expired.
+
+    Reference quirk preserved: the posting transfer was already inserted
+    when the overdue check fires (src/state_machine.zig:1687-1696).
+    """
+    pend(h, id=100, amount=10, timeout=1)
+    sm = h.sm
+    expires_at = sm.transfers[100].timestamp + 10**9
+    # Submit the post with the clock past expiry, bypassing the pulse:
+    # call _run directly so tick_pulses doesn't fire first.
+    h.realtime = expires_at + 10
+    out = h._run(
+        types.Operation.create_transfers,
+        np.asarray(t(101, dr=0, cr=0, amount=0, pending_id=100, flags=POST)).tobytes(),
+    )
+    arr = np.frombuffer(out, dtype=types.CREATE_RESULT_DTYPE)
+    assert [(int(r["index"]), CTR(int(r["result"]))) for r in arr] == [
+        (0, CTR.pending_transfer_expired)
+    ]
+    # The quirk: transfer 101 leaked into the store. (Read state
+    # directly — a lookup via the harness would inject the due pulse.)
+    assert 101 in sm.transfers
+    a1 = sm.accounts[1]
+    assert (a1.debits_pending, a1.debits_posted) == (10, 0)
+
+
+def test_expiry_pulse_next_timestamp_bookkeeping(h):
+    sm = h.sm
+    assert sm.pulse_next_timestamp == types.TIMESTAMP_MIN
+    # First pulse (no pendings) parks the timestamp at max.
+    h.tick_pulses()
+    assert sm.pulse_next_timestamp == types.TIMESTAMP_MAX
+    pend(h, id=100, timeout=5)
+    pend(h, id=101, timeout=1)
+    e100 = sm.transfers[100].timestamp + 5 * 10**9
+    e101 = sm.transfers[101].timestamp + 10**9
+    assert sm.pulse_next_timestamp == min(e100, e101) == e101
+    # Void 101: pulse_next resets to min sentinel (it matched e101).
+    assert h.create_transfers([t(102, dr=0, cr=0, amount=0, pending_id=101, flags=VOID)]) == []
+    assert sm.pulse_next_timestamp == types.TIMESTAMP_MIN
+    # Next pulse rescans: finds e100 as next expiry.
+    h.tick_pulses()
+    assert sm.pulse_next_timestamp == e100
+
+
+def test_expired_pending_releases_only_pending_amounts(h):
+    pend(h, id=100, amount=10, timeout=1)
+    assert h.create_transfers([t(101, amount=3)]) == []
+    sm = h.sm
+    expires_at = sm.transfers[100].timestamp + 10**9
+    h.submit(types.Operation.lookup_accounts, b"", realtime=expires_at + 1)
+    assert balances(h, 1) == (0, 3, 0, 0)
+    assert balances(h, 2) == (0, 0, 0, 3)

@@ -1,0 +1,3 @@
+from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
+
+__all__ = ["CpuStateMachine"]
