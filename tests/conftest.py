@@ -1,15 +1,40 @@
 """Test configuration: force a virtual 8-device CPU mesh.
 
-Multi-chip hardware isn't available in CI; sharding correctness is
-validated on a virtual CPU mesh (the driver separately dry-runs the
-multi-chip path via __graft_entry__.dryrun_multichip).
+The ambient environment routes JAX at the axon TPU tunnel (a single
+shared chip) via sitecustomize, which both sets the jax_platforms
+config programmatically and registers a PJRT plugin whose discovery
+blocks when the tunnel is busy. Tests must never touch it — they run
+on a virtual 8-device CPU mesh instead — so we override the config and
+unregister the plugin factory before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+try:
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+except (ImportError, AttributeError):  # private API; config above suffices
+    pass
+
+import pytest
+
+
+@pytest.fixture(params=["cpu", "tpu"])
+def sm(request):
+    """Both state-machine implementations, for differential coverage."""
+    if request.param == "tpu":
+        from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+
+        return TpuStateMachine()
+    from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+    return CpuStateMachine()

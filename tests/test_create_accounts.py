@@ -16,8 +16,8 @@ AF = types.AccountFlags
 
 
 @pytest.fixture
-def h():
-    return SingleNodeHarness(CpuStateMachine())
+def h(sm):
+    return SingleNodeHarness(sm)
 
 
 def test_ok_and_timestamps(h):

@@ -19,8 +19,8 @@ MAX = types.U128_MAX
 
 
 @pytest.fixture
-def h():
-    h = SingleNodeHarness(CpuStateMachine())
+def h(sm):
+    h = SingleNodeHarness(sm)
     assert (
         h.create_accounts(
             [account(1), account(2), account(3, ledger=2), account(4)]

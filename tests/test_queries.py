@@ -36,8 +36,8 @@ def account_filter(
 
 
 @pytest.fixture
-def h():
-    h = SingleNodeHarness(CpuStateMachine())
+def h(sm):
+    h = SingleNodeHarness(sm)
     assert (
         h.create_accounts(
             [account(1, flags=AF.history), account(2), account(3, flags=AF.history)]
@@ -158,7 +158,7 @@ def test_lookup_missing_are_omitted(h):
 
 
 def test_rollback_does_not_leak_history(h):
-    before = len(h.sm.account_balances)
+    before = h.sm.history_count
     assert h.create_transfers(
         [
             transfer(
@@ -171,5 +171,5 @@ def test_rollback_does_not_leak_history(h):
         (0, types.CreateTransferResult.linked_event_failed),
         (1, types.CreateTransferResult.id_must_not_be_zero),
     ]
-    assert len(h.sm.account_balances) == before
+    assert h.sm.history_count == before
     assert tids(get_transfers(h, account_filter(1))) == [100, 101, 102, 103]

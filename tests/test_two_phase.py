@@ -20,8 +20,8 @@ VOID = TF.void_pending_transfer
 
 
 @pytest.fixture
-def h():
-    h = SingleNodeHarness(CpuStateMachine())
+def h(sm):
+    h = SingleNodeHarness(sm)
     assert h.create_accounts([account(1), account(2)]) == []
     return h
 
@@ -165,13 +165,13 @@ def test_expiry_via_pulse(h):
     pend(h, id=100, amount=10, timeout=1)
     assert balances(h, 1) == (10, 0, 0, 0)
     sm = h.sm
-    expires_at = sm.transfers[100].timestamp + 10**9
+    expires_at = sm.transfer_timestamp(100) + 10**9
     assert sm.pulse_next_timestamp == expires_at
     # Advance the wall clock past expiry; the harness injects a pulse.
     h.submit(types.Operation.lookup_accounts, b"", realtime=expires_at + 1)
     assert balances(h, 1) == (0, 0, 0, 0)
     assert balances(h, 2) == (0, 0, 0, 0)
-    assert sm.transfers_pending[sm.transfers[100].timestamp] == types.TransferPendingStatus.expired
+    assert sm.pending_status(100) == types.TransferPendingStatus.expired
     # Posting after expiry fails.
     assert h.create_transfers([t(101, dr=0, cr=0, amount=0, pending_id=100, flags=POST)]) == [
         (0, CTR.pending_transfer_expired)
@@ -186,7 +186,7 @@ def test_post_overdue_pending_before_pulse(h):
     """
     pend(h, id=100, amount=10, timeout=1)
     sm = h.sm
-    expires_at = sm.transfers[100].timestamp + 10**9
+    expires_at = sm.transfer_timestamp(100) + 10**9
     # Submit the post with the clock past expiry, bypassing the pulse:
     # call _run directly so tick_pulses doesn't fire first.
     h.realtime = expires_at + 10
@@ -200,9 +200,9 @@ def test_post_overdue_pending_before_pulse(h):
     ]
     # The quirk: transfer 101 leaked into the store. (Read state
     # directly — a lookup via the harness would inject the due pulse.)
-    assert 101 in sm.transfers
-    a1 = sm.accounts[1]
-    assert (a1.debits_pending, a1.debits_posted) == (10, 0)
+    assert sm.transfer_timestamp(101) is not None
+    dp, dpo, _, _ = sm.account_balances_raw(1)
+    assert (dp, dpo) == (10, 0)
 
 
 def test_expiry_pulse_next_timestamp_bookkeeping(h):
@@ -213,8 +213,8 @@ def test_expiry_pulse_next_timestamp_bookkeeping(h):
     assert sm.pulse_next_timestamp == types.TIMESTAMP_MAX
     pend(h, id=100, timeout=5)
     pend(h, id=101, timeout=1)
-    e100 = sm.transfers[100].timestamp + 5 * 10**9
-    e101 = sm.transfers[101].timestamp + 10**9
+    e100 = sm.transfer_timestamp(100) + 5 * 10**9
+    e101 = sm.transfer_timestamp(101) + 10**9
     assert sm.pulse_next_timestamp == min(e100, e101) == e101
     # Void 101: pulse_next resets to min sentinel (it matched e101).
     assert h.create_transfers([t(102, dr=0, cr=0, amount=0, pending_id=101, flags=VOID)]) == []
@@ -228,7 +228,7 @@ def test_expired_pending_releases_only_pending_amounts(h):
     pend(h, id=100, amount=10, timeout=1)
     assert h.create_transfers([t(101, amount=3)]) == []
     sm = h.sm
-    expires_at = sm.transfers[100].timestamp + 10**9
+    expires_at = sm.transfer_timestamp(100) + 10**9
     h.submit(types.Operation.lookup_accounts, b"", realtime=expires_at + 1)
     assert balances(h, 1) == (0, 3, 0, 0)
     assert balances(h, 2) == (0, 0, 0, 3)

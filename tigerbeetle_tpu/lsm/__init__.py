@@ -1,0 +1,3 @@
+from tigerbeetle_tpu.lsm.runs import SortedRuns, pack_u128
+
+__all__ = ["SortedRuns", "pack_u128"]

@@ -1,0 +1,3 @@
+from tigerbeetle_tpu.ops import u128
+
+__all__ = ["u128"]

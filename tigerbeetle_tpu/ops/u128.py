@@ -1,0 +1,83 @@
+"""u128 arithmetic on (lo, hi) uint64 limb pairs for JAX.
+
+TPUs have no native 128-bit integers; all balances/amounts in the wire
+format are u128 (reference: src/tigerbeetle.zig:8-12,83). We decompose
+into two little-endian uint64 limbs and implement the handful of ops
+the state machine needs: add/sub with overflow detection, comparison,
+min, and saturating subtraction. No multiplication is ever required.
+
+Requires jax_enable_x64 (enabled in tigerbeetle_tpu.state_machine.kernel).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# A u128 value is a tuple (lo, hi) of uint64 arrays.
+U128 = tuple
+
+
+def u128(lo, hi) -> U128:
+    return (jnp.asarray(lo, jnp.uint64), jnp.asarray(hi, jnp.uint64))
+
+
+def zeros_like(x: U128) -> U128:
+    return (jnp.zeros_like(x[0]), jnp.zeros_like(x[1]))
+
+
+def add(a: U128, b: U128) -> tuple[U128, jnp.ndarray]:
+    """(a + b) mod 2^128 and an overflow flag."""
+    lo = a[0] + b[0]
+    carry = (lo < a[0]).astype(jnp.uint64)
+    hi_partial = a[1] + b[1]
+    ov1 = hi_partial < a[1]
+    hi = hi_partial + carry
+    ov2 = hi < hi_partial
+    return (lo, hi), ov1 | ov2
+
+
+def sub(a: U128, b: U128) -> tuple[U128, jnp.ndarray]:
+    """(a - b) mod 2^128 and an underflow (borrow-out) flag."""
+    lo = a[0] - b[0]
+    borrow = (a[0] < b[0]).astype(jnp.uint64)
+    hi = a[1] - b[1] - borrow
+    under = (a[1] < b[1]) | ((a[1] == b[1]) & (borrow == 1))
+    return (lo, hi), under
+
+
+def sub_sat(a: U128, b: U128) -> U128:
+    """max(a - b, 0) — the reference's `-|` saturating subtraction
+    (reference: src/state_machine.zig:1519,1525)."""
+    (lo, hi), under = sub(a, b)
+    zero = jnp.zeros_like(lo)
+    return (jnp.where(under, zero, lo), jnp.where(under, zero, hi))
+
+
+def eq(a: U128, b: U128) -> jnp.ndarray:
+    return (a[0] == b[0]) & (a[1] == b[1])
+
+
+def ne(a: U128, b: U128) -> jnp.ndarray:
+    return ~eq(a, b)
+
+
+def gt(a: U128, b: U128) -> jnp.ndarray:
+    return (a[1] > b[1]) | ((a[1] == b[1]) & (a[0] > b[0]))
+
+
+def lt(a: U128, b: U128) -> jnp.ndarray:
+    return gt(b, a)
+
+
+def is_zero(a: U128) -> jnp.ndarray:
+    return (a[0] == 0) & (a[1] == 0)
+
+
+def minimum(a: U128, b: U128) -> U128:
+    a_gt = gt(a, b)
+    return (jnp.where(a_gt, b[0], a[0]), jnp.where(a_gt, b[1], a[1]))
+
+
+def select(pred, a: U128, b: U128) -> U128:
+    """where(pred, a, b) elementwise on limb pairs."""
+    return (jnp.where(pred, a[0], b[0]), jnp.where(pred, a[1], b[1]))

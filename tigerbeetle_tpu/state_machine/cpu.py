@@ -268,6 +268,30 @@ class CpuStateMachine:
         self._expiry_buffer: list[TransferRec] | None = None
 
     # ------------------------------------------------------------------
+    # Introspection helpers shared with TpuStateMachine (tests use these
+    # instead of reaching into either implementation's internals).
+
+    def transfer_timestamp(self, id_value: int) -> int | None:
+        t = self.transfers.get(id_value)
+        return None if t is None else t.timestamp
+
+    def pending_status(self, id_value: int) -> TransferPendingStatus | None:
+        t = self.transfers.get(id_value)
+        if t is None:
+            return None
+        return self.transfers_pending.get(t.timestamp)
+
+    @property
+    def history_count(self) -> int:
+        return len(self.account_balances)
+
+    def account_balances_raw(self, id_value: int) -> tuple | None:
+        a = self.accounts.get(id_value)
+        if a is None:
+            return None
+        return (a.debits_pending, a.debits_posted, a.credits_pending, a.credits_posted)
+
+    # ------------------------------------------------------------------
     # Groove mutations (undo-aware).
 
     def _account_insert(self, a: AccountRec) -> None:

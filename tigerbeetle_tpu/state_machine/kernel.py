@@ -1,0 +1,737 @@
+"""JAX create_transfers kernel: sequential-semantics batch apply.
+
+Re-expresses the reference's per-event commit loop (reference:
+src/state_machine.zig:1220-1306 execute, :1462-1741 create_transfer +
+post/void) as a `lax.scan` over the event batch against an HBM-resident
+account-balance table.
+
+Division of labor (see tpu.py for the host side):
+
+- The HOST resolves everything *static within a batch*: account-id ->
+  slot lookups (accounts are only created by separate create_accounts
+  operations, so existence/ledger/flags are immutable here), the
+  static validation ladder (codes 3-24), durable-transfer side tables
+  for `id`/`pending_id`, and compact *id groups*: every distinct
+  transfer-id value in the batch gets an index in [0, B) so the kernel
+  can track in-batch creations without u128 hashing.
+- The KERNEL owns everything *order-dependent*: balance math (u128 as
+  2xuint64 limbs), balancing clamps, overflow/limit ladders, in-batch
+  exists checks, two-phase status transitions, and linked-chain
+  rollback via an undo log — the reference's scoped-rollback semantics
+  (reference: src/state_machine.zig:1190-1218,1269-1300).
+
+The scan carry keeps the balance table in place (donated buffer);
+per-event state (results, created-transfer records, statuses, undo,
+group->creator directory) are (B,)-shaped arrays so chain rollback is
+a bounded reverse replay.
+"""
+
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+from jax import lax
+
+from tigerbeetle_tpu.ops import u128 as w  # "wide" math
+
+# TransferFlags bits (reference: src/tigerbeetle.zig:127-140).
+F_LINKED = 1 << 0
+F_PENDING = 1 << 1
+F_POST = 1 << 2
+F_VOID = 1 << 3
+F_BAL_DR = 1 << 4
+F_BAL_CR = 1 << 5
+
+# AccountFlags bits (reference: src/tigerbeetle.zig:42-63).
+AF_DR_LIMIT = 1 << 1
+AF_CR_LIMIT = 1 << 2
+
+# Pending statuses (reference: src/tigerbeetle.zig:113-125).
+S_NONE, S_PENDING, S_POSTED, S_VOIDED, S_EXPIRED = 0, 1, 2, 3, 4
+
+NS_PER_S = jnp.uint64(1_000_000_000)
+U64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+# Result codes used kernel-side (reference: src/tigerbeetle.zig:185-265).
+R_OK = 0
+R_LINKED_EVENT_FAILED = 1
+R_LINKED_EVENT_CHAIN_OPEN = 2
+R_TIMESTAMP_MUST_BE_ZERO = 3
+R_PENDING_NOT_FOUND = 25
+R_PENDING_NOT_PENDING = 26
+R_PENDING_DIFF_DR = 27
+R_PENDING_DIFF_CR = 28
+R_PENDING_DIFF_LEDGER = 29
+R_PENDING_DIFF_CODE = 30
+R_EXCEEDS_PENDING_AMOUNT = 31
+R_PENDING_DIFF_AMOUNT = 32
+R_ALREADY_POSTED = 33
+R_ALREADY_VOIDED = 34
+R_PENDING_EXPIRED = 35
+R_EXISTS_DIFF_FLAGS = 36
+R_EXISTS_DIFF_DR = 37
+R_EXISTS_DIFF_CR = 38
+R_EXISTS_DIFF_AMOUNT = 39
+R_EXISTS_DIFF_PENDING_ID = 40
+R_EXISTS_DIFF_UD128 = 41
+R_EXISTS_DIFF_UD64 = 42
+R_EXISTS_DIFF_UD32 = 43
+R_EXISTS_DIFF_TIMEOUT = 44
+R_EXISTS_DIFF_CODE = 45
+R_EXISTS = 46
+R_OVERFLOWS_DP = 47
+R_OVERFLOWS_CP = 48
+R_OVERFLOWS_DPO = 49
+R_OVERFLOWS_CPO = 50
+R_OVERFLOWS_DEBITS = 51
+R_OVERFLOWS_CREDITS = 52
+R_OVERFLOWS_TIMEOUT = 53
+R_EXCEEDS_CREDITS = 54
+R_EXCEEDS_DEBITS = 55
+
+# Sentinel for "run the exists ladder here" in precedence cascades;
+# code 1 (linked_event_failed) can never be produced by those ladders.
+_EXISTS_SENTINEL = 1
+
+# Balance-row column layout: 4 u128s as (lo, hi) limb pairs.
+DP_LO, DP_HI, DPO_LO, DPO_HI, CP_LO, CP_HI, CPO_LO, CPO_HI = range(8)
+
+# Fields of the in-batch "created transfers" buffer (all (B,) arrays).
+CREATED_FIELDS = (
+    "flags",       # uint32
+    "dr_slot",     # int32
+    "cr_slot",     # int32
+    "amount_lo", "amount_hi",
+    "pending_lo", "pending_hi",   # pending_id
+    "ud128_lo", "ud128_hi",
+    "ud64",
+    "ud32",        # uint32
+    "timeout",     # uint64 (widened)
+    "ledger",      # uint32
+    "code",        # uint32
+)
+
+_CREATED_DTYPES = {
+    "dr_slot": jnp.int32,
+    "cr_slot": jnp.int32,
+    "flags": jnp.uint32,
+    "ud32": jnp.uint32,
+    "ledger": jnp.uint32,
+    "code": jnp.uint32,
+}
+
+# Per-event input arrays the host must provide (all shape (B,)).
+EVENT_FIELDS = (
+    ("i", jnp.int32),
+    ("flags", jnp.uint32),
+    ("ts_nonzero", jnp.bool_),
+    ("static_result", jnp.uint32),
+    ("amount_lo", jnp.uint64), ("amount_hi", jnp.uint64),
+    ("pending_lo", jnp.uint64), ("pending_hi", jnp.uint64),
+    ("ud128_lo", jnp.uint64), ("ud128_hi", jnp.uint64),
+    ("ud64", jnp.uint64),
+    ("ud32", jnp.uint32),
+    ("timeout", jnp.uint64),
+    ("ledger", jnp.uint32),
+    ("code", jnp.uint32),
+    ("dr_slot", jnp.int32), ("cr_slot", jnp.int32),
+    ("dr_flags", jnp.uint32), ("cr_flags", jnp.uint32),
+    ("dr_id_zero", jnp.bool_), ("cr_id_zero", jnp.bool_),
+    # Compact id-value groups: id_group in [0, B); p_group = group of
+    # this event's pending_id value if that value is also some event's
+    # id, else -1.
+    ("id_group", jnp.int32),
+    ("p_group", jnp.int32),
+    # Durable transfer with the same id (exists-check), zeros if none:
+    ("e_found", jnp.bool_),
+    ("e_flags", jnp.uint32),
+    ("e_dr_slot", jnp.int32), ("e_cr_slot", jnp.int32),
+    ("e_amount_lo", jnp.uint64), ("e_amount_hi", jnp.uint64),
+    ("e_pending_lo", jnp.uint64), ("e_pending_hi", jnp.uint64),
+    ("e_ud128_lo", jnp.uint64), ("e_ud128_hi", jnp.uint64),
+    ("e_ud64", jnp.uint64),
+    ("e_ud32", jnp.uint32),
+    ("e_timeout", jnp.uint64),
+    ("e_code", jnp.uint32),
+    # Durable transfer matching pending_id (post/void), zeros if none:
+    ("p_found", jnp.bool_),
+    ("p_flags", jnp.uint32),
+    ("p_dr_slot", jnp.int32), ("p_cr_slot", jnp.int32),
+    ("p_amount_lo", jnp.uint64), ("p_amount_hi", jnp.uint64),
+    ("p_ud128_lo", jnp.uint64), ("p_ud128_hi", jnp.uint64),
+    ("p_ud64", jnp.uint64),
+    ("p_ud32", jnp.uint32),
+    ("p_timeout", jnp.uint64),
+    ("p_ledger", jnp.uint32),
+    ("p_code", jnp.uint32),
+    ("p_timestamp", jnp.uint64),
+    ("p_tgt", jnp.int32),  # index into the durable-status array
+)
+
+_E_FIELD_MAP = {
+    "flags": "e_flags", "dr_slot": "e_dr_slot", "cr_slot": "e_cr_slot",
+    "amount_lo": "e_amount_lo", "amount_hi": "e_amount_hi",
+    "pending_lo": "e_pending_lo", "pending_hi": "e_pending_hi",
+    "ud128_lo": "e_ud128_lo", "ud128_hi": "e_ud128_hi",
+    "ud64": "e_ud64", "ud32": "e_ud32", "timeout": "e_timeout",
+    "code": "e_code",
+}
+
+_P_FIELD_MAP = {
+    "flags": "p_flags", "dr_slot": "p_dr_slot", "cr_slot": "p_cr_slot",
+    "amount_lo": "p_amount_lo", "amount_hi": "p_amount_hi",
+    "ud128_lo": "p_ud128_lo", "ud128_hi": "p_ud128_hi",
+    "ud64": "p_ud64", "ud32": "p_ud32", "timeout": "p_timeout",
+    "ledger": "p_ledger", "code": "p_code",
+}
+
+
+def _first_nonzero(*pairs):
+    """Precedence cascade: the first true (cond, code) pair wins."""
+    result = jnp.uint32(0)
+    for cond, code in pairs:
+        result = jnp.where((result == 0) & cond, jnp.uint32(code), result)
+    return result
+
+
+def _gather_created(created, idx, B):
+    j = jnp.clip(idx, 0, B - 1)
+    return {f: created[f][j] for f in CREATED_FIELDS}
+
+
+def _merge(cond, inbatch_rec, ev, field_map):
+    out = {}
+    for field, ev_name in field_map.items():
+        out[field] = jnp.where(
+            cond, ev[ev_name].astype(inbatch_rec[field].dtype), inbatch_rec[field]
+        )
+    return out
+
+
+def _exists_ladder_normal(ev, e):
+    """reference: src/state_machine.zig:1587-1606 (raw t.amount)."""
+    return _first_nonzero(
+        (ev["flags"] != e["flags"], R_EXISTS_DIFF_FLAGS),
+        (ev["dr_slot"] != e["dr_slot"], R_EXISTS_DIFF_DR),
+        (ev["cr_slot"] != e["cr_slot"], R_EXISTS_DIFF_CR),
+        (
+            (ev["amount_lo"] != e["amount_lo"]) | (ev["amount_hi"] != e["amount_hi"]),
+            R_EXISTS_DIFF_AMOUNT,
+        ),
+        (
+            (ev["ud128_lo"] != e["ud128_lo"]) | (ev["ud128_hi"] != e["ud128_hi"]),
+            R_EXISTS_DIFF_UD128,
+        ),
+        (ev["ud64"] != e["ud64"], R_EXISTS_DIFF_UD64),
+        (ev["ud32"] != e["ud32"], R_EXISTS_DIFF_UD32),
+        (ev["timeout"] != e["timeout"], R_EXISTS_DIFF_TIMEOUT),
+        (ev["code"] != e["code"], R_EXISTS_DIFF_CODE),
+        (jnp.bool_(True), R_EXISTS),
+    )
+
+
+def _exists_ladder_post_void(ev, e, p):
+    """reference: src/state_machine.zig:1743-1804 (zero-means-inherit)."""
+    t_amount_zero = (ev["amount_lo"] == 0) & (ev["amount_hi"] == 0)
+    amount_diff = jnp.where(
+        t_amount_zero,
+        (e["amount_lo"] != p["amount_lo"]) | (e["amount_hi"] != p["amount_hi"]),
+        (ev["amount_lo"] != e["amount_lo"]) | (ev["amount_hi"] != e["amount_hi"]),
+    )
+    ud128_diff = jnp.where(
+        (ev["ud128_lo"] == 0) & (ev["ud128_hi"] == 0),
+        (e["ud128_lo"] != p["ud128_lo"]) | (e["ud128_hi"] != p["ud128_hi"]),
+        (ev["ud128_lo"] != e["ud128_lo"]) | (ev["ud128_hi"] != e["ud128_hi"]),
+    )
+    ud64_diff = jnp.where(
+        ev["ud64"] == 0, e["ud64"] != p["ud64"], ev["ud64"] != e["ud64"]
+    )
+    ud32_diff = jnp.where(
+        ev["ud32"] == 0, e["ud32"] != p["ud32"], ev["ud32"] != e["ud32"]
+    )
+    return _first_nonzero(
+        (ev["flags"] != e["flags"], R_EXISTS_DIFF_FLAGS),
+        (amount_diff, R_EXISTS_DIFF_AMOUNT),
+        (
+            (ev["pending_lo"] != e["pending_lo"])
+            | (ev["pending_hi"] != e["pending_hi"]),
+            R_EXISTS_DIFF_PENDING_ID,
+        ),
+        (ud128_diff, R_EXISTS_DIFF_UD128),
+        (ud64_diff, R_EXISTS_DIFF_UD64),
+        (ud32_diff, R_EXISTS_DIFF_UD32),
+        (jnp.bool_(True), R_EXISTS),
+    )
+
+
+@jax.jit
+def _noop(x):
+    return x
+
+
+def _run_impl(balances, events, dstat_init, n, ts_base):
+    B = events["flags"].shape[0]
+    A = balances.shape[0]
+    arange_b = jnp.arange(B, dtype=jnp.int32)
+    id_group_full = events["id_group"]
+
+    carry = {
+        "balances": balances,
+        "results": jnp.zeros(B, jnp.uint32),
+        "created_mask": jnp.zeros(B, jnp.bool_),
+        "created": {
+            f: jnp.zeros(B, _CREATED_DTYPES.get(f, jnp.uint64))
+            for f in CREATED_FIELDS
+        },
+        # group index -> event that currently holds a created transfer
+        # with that id value (-1 none). At most one at any time.
+        "group_creator": jnp.full(B, -1, jnp.int32),
+        "inb_status": jnp.zeros(B, jnp.uint32),
+        "dstat": dstat_init.astype(jnp.uint32),
+        # Undo log for chain rollback:
+        "u_dr_slot": jnp.full(B, -1, jnp.int32),
+        "u_cr_slot": jnp.full(B, -1, jnp.int32),
+        "u_dr_bal": jnp.zeros((B, 8), jnp.uint64),
+        "u_cr_bal": jnp.zeros((B, 8), jnp.uint64),
+        "u_status_kind": jnp.zeros(B, jnp.int32),  # 0 none, 1 durable, 2 in-batch
+        "u_status_idx": jnp.zeros(B, jnp.int32),
+        # Post-apply balance snapshots for historical balances:
+        "hist_dr": jnp.zeros((B, 8), jnp.uint64),
+        "hist_cr": jnp.zeros((B, 8), jnp.uint64),
+        "chain_start": jnp.int32(-1),
+        "chain_broken": jnp.bool_(False),
+        # Last event index that reached the apply point — including
+        # chain events later rolled back: the reference sets
+        # commit_timestamp before any rollback and never reverts it
+        # (reference: src/state_machine.zig:1583 + scope semantics).
+        "last_applied": jnp.int32(-1),
+        # pulse_next_timestamp bookkeeping signals, recorded at apply
+        # time and NEVER rolled back (the reference mutates
+        # expire_pending_transfers.pulse_next_timestamp outside any
+        # groove scope — src/state_machine.zig:1576-1580,1704-1708):
+        # pulse_create[i] = expires_at of a pending-with-timeout created
+        # at i; pulse_remove[i] = expires_at of the pending that event i
+        # posted/voided. Zero means no signal.
+        "pulse_create": jnp.zeros(B, jnp.uint64),
+        "pulse_remove": jnp.zeros(B, jnp.uint64),
+    }
+
+    def body(carry, ev):
+        i = ev["i"]
+        active = i < n
+        table = carry["balances"]
+        created = carry["created"]
+        group_creator = carry["group_creator"]
+        flags = ev["flags"]
+        linked = (flags & F_LINKED) != 0
+        is_pv = (flags & (F_POST | F_VOID)) != 0
+        ts_i = ts_base + i.astype(jnp.uint64)
+
+        # -- Chain bookkeeping (reference: src/state_machine.zig:1240-1248).
+        open_chain = active & linked & (carry["chain_start"] < 0)
+        chain_start = jnp.where(open_chain, i, carry["chain_start"])
+        chain_broken = carry["chain_broken"]
+
+        pre = _first_nonzero(
+            (linked & (i == n - 1), R_LINKED_EVENT_CHAIN_OPEN),
+            (chain_broken, R_LINKED_EVENT_FAILED),
+            (ev["ts_nonzero"], R_TIMESTAMP_MUST_BE_ZERO),
+        )
+        pre = jnp.where(pre == 0, ev["static_result"], pre)
+
+        # -- Exists resolution via the in-batch id directory.
+        e_creator = group_creator[jnp.clip(ev["id_group"], 0, B - 1)]
+        e_inb = e_creator >= 0
+        e_dur = ev["e_found"]
+        e_any = e_inb | e_dur
+        e = _merge(~e_inb, _gather_created(created, e_creator, B), ev, _E_FIELD_MAP)
+
+        # ==================== normal create_transfer ====================
+        # (reference: src/state_machine.zig:1506-1547)
+        dr_row = table[jnp.clip(ev["dr_slot"], 0, A - 1)]
+        cr_row = table[jnp.clip(ev["cr_slot"], 0, A - 1)]
+        dr_dp = (dr_row[DP_LO], dr_row[DP_HI])
+        dr_dpo = (dr_row[DPO_LO], dr_row[DPO_HI])
+        dr_cpo = (dr_row[CPO_LO], dr_row[CPO_HI])
+        cr_dp = (cr_row[DP_LO], cr_row[DP_HI])
+        cr_dpo = (cr_row[DPO_LO], cr_row[DPO_HI])
+        cr_cp = (cr_row[CP_LO], cr_row[CP_HI])
+        cr_cpo = (cr_row[CPO_LO], cr_row[CPO_HI])
+
+        exists_rn = _exists_ladder_normal(ev, e)
+
+        is_balancing = (flags & (F_BAL_DR | F_BAL_CR)) != 0
+        amount = (ev["amount_lo"], ev["amount_hi"])
+        # amount == 0 with balancing means maxInt(u64)
+        # (reference: src/state_machine.zig:1512).
+        amount = w.select(
+            is_balancing & w.is_zero(amount),
+            (jnp.full_like(amount[0], U64_MAX), jnp.zeros_like(amount[1])),
+            amount,
+        )
+        dr_balance, _ = w.add(dr_dpo, dr_dp)
+        bd_avail = w.sub_sat(dr_cpo, dr_balance)
+        amount = w.select((flags & F_BAL_DR) != 0, w.minimum(amount, bd_avail), amount)
+        bd_fail = ((flags & F_BAL_DR) != 0) & w.is_zero(amount)
+
+        cr_balance, _ = w.add(cr_cpo, cr_cp)
+        bc_avail = w.sub_sat(cr_dpo, cr_balance)
+        amount_bc = w.minimum(amount, bc_avail)
+        amount = w.select(
+            ((flags & F_BAL_CR) != 0) & ~bd_fail, amount_bc, amount
+        )
+        bc_fail = ((flags & F_BAL_CR) != 0) & w.is_zero(amount) & ~bd_fail
+
+        is_pending = (flags & F_PENDING) != 0
+        _, ov_dp = w.add(amount, dr_dp)
+        _, ov_cp = w.add(amount, cr_cp)
+        _, ov_dpo = w.add(amount, dr_dpo)
+        _, ov_cpo = w.add(amount, cr_cpo)
+        dr_total, _ = w.add(dr_dp, dr_dpo)
+        _, ov_debits = w.add(amount, dr_total)
+        cr_total, _ = w.add(cr_cp, cr_cpo)
+        _, ov_credits = w.add(amount, cr_total)
+
+        timeout_ns = ev["timeout"] * NS_PER_S
+        ts_plus = ts_i + timeout_ns
+        ov_timeout = ts_plus < ts_i
+
+        # Limit flags (reference: src/tigerbeetle.zig:31-39).
+        dr_lhs, _ = w.add(dr_total, amount)
+        exceeds_cr = ((ev["dr_flags"] & AF_DR_LIMIT) != 0) & w.gt(dr_lhs, dr_cpo)
+        cr_lhs, _ = w.add(cr_total, amount)
+        exceeds_dr = ((ev["cr_flags"] & AF_CR_LIMIT) != 0) & w.gt(cr_lhs, cr_dpo)
+
+        rn = _first_nonzero(
+            (e_any, _EXISTS_SENTINEL),
+            (bd_fail, R_EXCEEDS_CREDITS),
+            (bc_fail, R_EXCEEDS_DEBITS),
+            (is_pending & ov_dp, R_OVERFLOWS_DP),
+            (is_pending & ov_cp, R_OVERFLOWS_CP),
+            (ov_dpo, R_OVERFLOWS_DPO),
+            (ov_cpo, R_OVERFLOWS_CPO),
+            (ov_debits, R_OVERFLOWS_DEBITS),
+            (ov_credits, R_OVERFLOWS_CREDITS),
+            (ov_timeout, R_OVERFLOWS_TIMEOUT),
+            (exceeds_cr, R_EXCEEDS_CREDITS),
+            (exceeds_dr, R_EXCEEDS_DEBITS),
+        )
+        rn = jnp.where(rn == _EXISTS_SENTINEL, exists_rn, rn)
+
+        # ==================== post/void pending transfer ====================
+        # (reference: src/state_machine.zig:1608-1741)
+        p_creator = group_creator[jnp.clip(ev["p_group"], 0, B - 1)]
+        p_inb = (ev["p_group"] >= 0) & (p_creator >= 0)
+        p_dur = ev["p_found"]
+        p_any = p_dur | p_inb
+        p = _merge(p_dur, _gather_created(created, p_creator, B), ev, _P_FIELD_MAP)
+        p_timestamp = jnp.where(
+            p_dur,
+            ev["p_timestamp"],
+            ts_base + jnp.clip(p_creator, 0, B - 1).astype(jnp.uint64),
+        )
+        p_amount = (p["amount_lo"], p["amount_hi"])
+
+        pv_amount_raw = (ev["amount_lo"], ev["amount_hi"])
+        pv_amount = w.select(w.is_zero(pv_amount_raw), p_amount, pv_amount_raw)
+        is_void = (flags & F_VOID) != 0
+
+        exists_rp = _exists_ladder_post_void(ev, e, p)
+
+        # Pending status as visible to this event.
+        st = jnp.where(
+            p_dur,
+            carry["dstat"][jnp.clip(ev["p_tgt"], 0, B - 1)],
+            carry["inb_status"][jnp.clip(p_creator, 0, B - 1)],
+        )
+
+        rp_pre_insert = _first_nonzero(
+            (~p_any, R_PENDING_NOT_FOUND),
+            ((p["flags"] & F_PENDING) == 0, R_PENDING_NOT_PENDING),
+            (~ev["dr_id_zero"] & (ev["dr_slot"] != p["dr_slot"]), R_PENDING_DIFF_DR),
+            (~ev["cr_id_zero"] & (ev["cr_slot"] != p["cr_slot"]), R_PENDING_DIFF_CR),
+            ((ev["ledger"] > 0) & (ev["ledger"] != p["ledger"]), R_PENDING_DIFF_LEDGER),
+            ((ev["code"] > 0) & (ev["code"] != p["code"]), R_PENDING_DIFF_CODE),
+            (w.gt(pv_amount, p_amount), R_EXCEEDS_PENDING_AMOUNT),
+            (is_void & w.lt(pv_amount, p_amount), R_PENDING_DIFF_AMOUNT),
+            (e_any, _EXISTS_SENTINEL),
+            (st == S_POSTED, R_ALREADY_POSTED),
+            (st == S_VOIDED, R_ALREADY_VOIDED),
+            (st == S_EXPIRED, R_PENDING_EXPIRED),
+        )
+        rp_pre_insert = jnp.where(
+            rp_pre_insert == _EXISTS_SENTINEL, exists_rp, rp_pre_insert
+        )
+
+        # QUIRK (reference: src/state_machine.zig:1687-1696): the t2
+        # insert lands BEFORE the overdue-expiry check, so an overdue
+        # post/void leaks its transfer while returning an error.
+        p_expires = p_timestamp + p["timeout"] * NS_PER_S
+        overdue = (p["timeout"] > 0) & (p_expires <= ts_i)
+        rp = jnp.where(
+            (rp_pre_insert == 0) & overdue, R_PENDING_EXPIRED, rp_pre_insert
+        )
+
+        # ==================== merge & apply ====================
+        dyn_r = jnp.where(is_pv, rp, rn)
+        gate = active & (pre == 0)
+        r = jnp.where(gate, dyn_r, jnp.where(active, pre, 0))
+
+        pv_inserted = gate & is_pv & (rp_pre_insert == 0)
+        normal_applied = gate & ~is_pv & (rn == 0)
+        pv_applied = gate & is_pv & (rp == 0)
+        inserted = pv_inserted | normal_applied
+        applied = pv_applied | normal_applied
+
+        # Created-transfer record (reference t2 construction:
+        # src/state_machine.zig:1549-1551,1672-1687).
+        ud128_inherit = is_pv & (ev["ud128_lo"] == 0) & (ev["ud128_hi"] == 0)
+        rec = {
+            "flags": flags,
+            "dr_slot": jnp.where(is_pv, p["dr_slot"], ev["dr_slot"]),
+            "cr_slot": jnp.where(is_pv, p["cr_slot"], ev["cr_slot"]),
+            "amount_lo": jnp.where(is_pv, pv_amount[0], amount[0]),
+            "amount_hi": jnp.where(is_pv, pv_amount[1], amount[1]),
+            "pending_lo": ev["pending_lo"],
+            "pending_hi": ev["pending_hi"],
+            "ud128_lo": jnp.where(ud128_inherit, p["ud128_lo"], ev["ud128_lo"]),
+            "ud128_hi": jnp.where(ud128_inherit, p["ud128_hi"], ev["ud128_hi"]),
+            "ud64": jnp.where(is_pv & (ev["ud64"] == 0), p["ud64"], ev["ud64"]),
+            "ud32": jnp.where(is_pv & (ev["ud32"] == 0), p["ud32"], ev["ud32"]),
+            "timeout": jnp.where(is_pv, jnp.uint64(0), ev["timeout"]),
+            "ledger": jnp.where(is_pv, p["ledger"], ev["ledger"]),
+            "code": jnp.where(is_pv, p["code"], ev["code"]),
+        }
+
+        # Balance updates.
+        up_dr_slot = jnp.where(is_pv, p["dr_slot"], ev["dr_slot"])
+        up_cr_slot = jnp.where(is_pv, p["cr_slot"], ev["cr_slot"])
+        safe_dr = jnp.clip(up_dr_slot, 0, A - 1)
+        safe_cr = jnp.clip(up_cr_slot, 0, A - 1)
+        old_dr_row = table[safe_dr]
+        old_cr_row = table[safe_cr]
+
+        o_dr_dp = (old_dr_row[DP_LO], old_dr_row[DP_HI])
+        o_dr_dpo = (old_dr_row[DPO_LO], old_dr_row[DPO_HI])
+        o_cr_cp = (old_cr_row[CP_LO], old_cr_row[CP_HI])
+        o_cr_cpo = (old_cr_row[CPO_LO], old_cr_row[CPO_HI])
+
+        is_post = (flags & F_POST) != 0
+        # Normal: pending adds to *_pending, else *_posted.
+        # Post/void: release p.amount pending; post adds pv_amount posted.
+        n_dr_dp = w.select(
+            is_pv,
+            w.sub(o_dr_dp, p_amount)[0],
+            w.select(is_pending, w.add(o_dr_dp, amount)[0], o_dr_dp),
+        )
+        n_dr_dpo = w.select(
+            is_pv,
+            w.select(is_post, w.add(o_dr_dpo, pv_amount)[0], o_dr_dpo),
+            w.select(is_pending, o_dr_dpo, w.add(o_dr_dpo, amount)[0]),
+        )
+        n_cr_cp = w.select(
+            is_pv,
+            w.sub(o_cr_cp, p_amount)[0],
+            w.select(is_pending, w.add(o_cr_cp, amount)[0], o_cr_cp),
+        )
+        n_cr_cpo = w.select(
+            is_pv,
+            w.select(is_post, w.add(o_cr_cpo, pv_amount)[0], o_cr_cpo),
+            w.select(is_pending, o_cr_cpo, w.add(o_cr_cpo, amount)[0]),
+        )
+
+        new_dr_row = jnp.stack(
+            [
+                n_dr_dp[0], n_dr_dp[1],
+                n_dr_dpo[0], n_dr_dpo[1],
+                old_dr_row[CP_LO], old_dr_row[CP_HI],
+                old_dr_row[CPO_LO], old_dr_row[CPO_HI],
+            ]
+        )
+        new_cr_row = jnp.stack(
+            [
+                old_cr_row[DP_LO], old_cr_row[DP_HI],
+                old_cr_row[DPO_LO], old_cr_row[DPO_HI],
+                n_cr_cp[0], n_cr_cp[1],
+                n_cr_cpo[0], n_cr_cpo[1],
+            ]
+        )
+
+        table = table.at[safe_dr].set(jnp.where(applied, new_dr_row, table[safe_dr]))
+        table = table.at[safe_cr].set(jnp.where(applied, new_cr_row, table[safe_cr]))
+
+        # Record created transfer + id directory + statuses.
+        created = {
+            f: created[f]
+            .at[i]
+            .set(jnp.where(inserted, rec[f].astype(created[f].dtype), created[f][i]))
+            for f in CREATED_FIELDS
+        }
+        created_mask = carry["created_mask"].at[i].set(inserted)
+        gidx = jnp.clip(ev["id_group"], 0, B - 1)
+        group_creator = group_creator.at[gidx].set(
+            jnp.where(inserted, i, group_creator[gidx])
+        )
+
+        inb_status = carry["inb_status"].at[i].set(
+            jnp.where(normal_applied & is_pending, jnp.uint32(S_PENDING), 0)
+        )
+        new_status = jnp.where(is_post, jnp.uint32(S_POSTED), jnp.uint32(S_VOIDED))
+        dstat = carry["dstat"]
+        tgt = jnp.clip(ev["p_tgt"], 0, B - 1)
+        dstat = dstat.at[tgt].set(jnp.where(pv_applied & p_dur, new_status, dstat[tgt]))
+        pcr = jnp.clip(p_creator, 0, B - 1)
+        inb_status = inb_status.at[pcr].set(
+            jnp.where(pv_applied & ~p_dur, new_status, inb_status[pcr])
+        )
+
+        # Undo log entries (balance restore, creation, status change).
+        u_dr_slot = carry["u_dr_slot"].at[i].set(jnp.where(applied, up_dr_slot, -1))
+        u_cr_slot = carry["u_cr_slot"].at[i].set(jnp.where(applied, up_cr_slot, -1))
+        u_dr_bal = carry["u_dr_bal"].at[i].set(old_dr_row)
+        u_cr_bal = carry["u_cr_bal"].at[i].set(old_cr_row)
+        u_status_kind = carry["u_status_kind"].at[i].set(
+            jnp.where(pv_applied, jnp.where(p_dur, 1, 2), 0)
+        )
+        u_status_idx = carry["u_status_idx"].at[i].set(
+            jnp.where(p_dur, ev["p_tgt"], p_creator)
+        )
+
+        hist_dr = carry["hist_dr"].at[i].set(new_dr_row)
+        hist_cr = carry["hist_cr"].at[i].set(new_cr_row)
+
+        results = carry["results"].at[i].set(r)
+
+        # -- Chain failure: roll back [chain_start, i] in reverse
+        # (reference: src/state_machine.zig:1269-1290).
+        fail = active & (r != 0)
+        chain_fail = fail & (chain_start >= 0) & ~chain_broken
+
+        def do_rollback(state):
+            table, created_mask, group_creator, inb_status, dstat = state
+            count = i - chain_start + 1
+
+            def rb(k, st):
+                table, created_mask, group_creator, inb_status, dstat = st
+                idx = i - k
+                ds = u_dr_slot[idx]
+                has = ds >= 0
+                sds = jnp.clip(ds, 0, A - 1)
+                scs = jnp.clip(u_cr_slot[idx], 0, A - 1)
+                table = table.at[scs].set(jnp.where(has, u_cr_bal[idx], table[scs]))
+                table = table.at[sds].set(jnp.where(has, u_dr_bal[idx], table[sds]))
+                # Un-create (clears the id directory entry if we own it).
+                g = jnp.clip(id_group_full[idx], 0, B - 1)
+                group_creator = group_creator.at[g].set(
+                    jnp.where(group_creator[g] == idx, -1, group_creator[g])
+                )
+                created_mask = created_mask.at[idx].set(False)
+                inb_status = inb_status.at[idx].set(0)
+                kind = u_status_kind[idx]
+                sidx = jnp.clip(u_status_idx[idx], 0, B - 1)
+                dstat = dstat.at[sidx].set(
+                    jnp.where(kind == 1, jnp.uint32(S_PENDING), dstat[sidx])
+                )
+                inb_status = inb_status.at[sidx].set(
+                    jnp.where(kind == 2, jnp.uint32(S_PENDING), inb_status[sidx])
+                )
+                return (table, created_mask, group_creator, inb_status, dstat)
+
+            return lax.fori_loop(
+                0, count, rb, (table, created_mask, group_creator, inb_status, dstat)
+            )
+
+        table, created_mask, group_creator, inb_status, dstat = lax.cond(
+            chain_fail,
+            do_rollback,
+            lambda s: s,
+            (table, created_mask, group_creator, inb_status, dstat),
+        )
+
+        # Rewrite earlier chain results to linked_event_failed (FIFO
+        # order is preserved because results stay indexed by event).
+        rewrite = chain_fail & (arange_b >= chain_start) & (arange_b < i)
+        results = jnp.where(rewrite, jnp.uint32(R_LINKED_EVENT_FAILED), results)
+
+        chain_broken = chain_broken | chain_fail
+
+        # Chain close (reference: src/state_machine.zig:1292-1300).
+        tail = (chain_start >= 0) & (~linked | (r == R_LINKED_EVENT_CHAIN_OPEN))
+        chain_start = jnp.where(tail, jnp.int32(-1), chain_start)
+        chain_broken = jnp.where(tail, jnp.bool_(False), chain_broken)
+
+        new_carry = {
+            "balances": table,
+            "results": results,
+            "created_mask": created_mask,
+            "created": created,
+            "group_creator": group_creator,
+            "inb_status": inb_status,
+            "dstat": dstat,
+            "u_dr_slot": u_dr_slot,
+            "u_cr_slot": u_cr_slot,
+            "u_dr_bal": u_dr_bal,
+            "u_cr_bal": u_cr_bal,
+            "u_status_kind": u_status_kind,
+            "u_status_idx": u_status_idx,
+            "hist_dr": hist_dr,
+            "hist_cr": hist_cr,
+            "chain_start": chain_start,
+            "chain_broken": chain_broken,
+            "last_applied": jnp.where(applied, i, carry["last_applied"]),
+            "pulse_create": carry["pulse_create"]
+            .at[i]
+            .set(
+                jnp.where(
+                    normal_applied & is_pending & (ev["timeout"] > 0),
+                    ts_i + timeout_ns,
+                    jnp.uint64(0),
+                )
+            ),
+            "pulse_remove": carry["pulse_remove"]
+            .at[i]
+            .set(
+                jnp.where(
+                    pv_applied & (p["timeout"] > 0), p_expires, jnp.uint64(0)
+                )
+            ),
+        }
+        return new_carry, ()
+
+    final, _ = lax.scan(body, carry, events)
+    return {
+        "balances": final["balances"],
+        "results": final["results"],
+        "created_mask": final["created_mask"],
+        "created": final["created"],
+        "inb_status": final["inb_status"],
+        "dstat": final["dstat"],
+        "hist_dr": final["hist_dr"],
+        "hist_cr": final["hist_cr"],
+        "last_applied": final["last_applied"],
+        "pulse_create": final["pulse_create"],
+        "pulse_remove": final["pulse_remove"],
+    }
+
+
+_run = jax.jit(_run_impl, donate_argnums=(0,))
+
+
+def run_create_transfers(balances, events, dstat_init, n, ts_base):
+    """Run the scan kernel.
+
+    `events` is a dict of (B,) arrays per EVENT_FIELDS; `balances` is
+    the donated (A, 8) uint64 account-balance table. Returns the new
+    balances plus per-event outputs (results, created records,
+    statuses, post-apply balance snapshots).
+    """
+    return _run(
+        balances,
+        events,
+        jnp.asarray(dstat_init, jnp.uint32),
+        jnp.int32(n),
+        jnp.uint64(ts_base),
+    )

@@ -1,0 +1,978 @@
+"""TPU-backed state machine: host orchestration around the JAX kernel.
+
+Same external interface as ``CpuStateMachine`` (input_valid / prepare /
+pulse_needed / prefetch / commit over wire bytes), so the two are
+interchangeable under the test harness and diffable bit-for-bit.
+
+State split (see kernel.py header):
+- DEVICE: the account *balance* table, (A, 8) uint64 — four u128
+  balances as limb pairs. This is the only mutable per-account state
+  (reference: src/tigerbeetle.zig:7-29 — every other Account field is
+  immutable after create_accounts).
+- HOST: id directories (LSM-style sorted runs, vectorized lookup),
+  immutable account attributes, the columnar transfer store + pending
+  statuses + expires_at index + historical balances. All hot-path host
+  work is numpy-vectorized; per-event Python runs only for
+  create_accounts (not the benchmark's hot operation) and rare pulse
+  bookkeeping.
+
+The commit flow for create_transfers mirrors the reference pipeline
+(reference: src/vsr/replica.zig:3746-3847 prefetch->commit):
+host static ladder + joins ~ prefetch; kernel scan ~ execute; host
+post-processing ~ the groove inserts the reference does inline.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.lsm import SortedRuns, pack_u128
+from tigerbeetle_tpu.state_machine import kernel
+from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
+from tigerbeetle_tpu.types import (
+    ACCOUNT_BALANCE_DTYPE,
+    ACCOUNT_DTYPE,
+    ACCOUNT_FILTER_DTYPE,
+    CREATE_RESULT_DTYPE,
+    NS_PER_S,
+    TIMESTAMP_MAX,
+    TIMESTAMP_MIN,
+    TRANSFER_DTYPE,
+    U64_MAX,
+    U128_MAX,
+    AccountFilterFlags,
+    AccountFlags,
+    CreateAccountResult,
+    CreateTransferResult,
+    Operation,
+    TransferFlags,
+    TransferPendingStatus,
+)
+
+AF = AccountFlags
+TF = TransferFlags
+CAR = CreateAccountResult
+CTR = CreateTransferResult
+
+_BATCH_BUCKETS = (32, 256, 2048, 8192)
+
+# Columnar transfer-store fields.
+_STORE_FIELDS = {
+    "id_lo": np.uint64, "id_hi": np.uint64,
+    "dr_slot": np.int32, "cr_slot": np.int32,
+    "amount_lo": np.uint64, "amount_hi": np.uint64,
+    "pending_lo": np.uint64, "pending_hi": np.uint64,
+    "ud128_lo": np.uint64, "ud128_hi": np.uint64,
+    "ud64": np.uint64, "ud32": np.uint32,
+    "timeout": np.uint32, "ledger": np.uint32, "code": np.uint32,
+    "flags": np.uint32, "timestamp": np.uint64,
+    "status": np.uint8,  # TransferPendingStatus for pending transfers
+}
+
+_ATTR_FIELDS = {
+    "id_lo": np.uint64, "id_hi": np.uint64,
+    "ud128_lo": np.uint64, "ud128_hi": np.uint64,
+    "ud64": np.uint64, "ud32": np.uint32,
+    "ledger": np.uint32, "code": np.uint32, "flags": np.uint32,
+    "timestamp": np.uint64,
+}
+
+_HISTORY_FIELDS = {
+    "timestamp": np.uint64,
+    "dr_id_lo": np.uint64, "dr_id_hi": np.uint64,
+    "cr_id_lo": np.uint64, "cr_id_hi": np.uint64,
+    "dr_bal": (np.uint64, 8), "cr_bal": (np.uint64, 8),
+}
+
+
+class Columns:
+    """Growable columnar array store with vectorized batch append."""
+
+    def __init__(self, fields: dict, capacity: int = 1024) -> None:
+        self._fields = fields
+        self.count = 0
+        self._cap = capacity
+        self._cols = {}
+        for name, spec in fields.items():
+            if isinstance(spec, tuple):
+                dtype, width = spec
+                self._cols[name] = np.zeros((capacity, width), dtype)
+            else:
+                self._cols[name] = np.zeros(capacity, spec)
+
+    def _ensure(self, extra: int) -> None:
+        need = self.count + extra
+        if need <= self._cap:
+            return
+        while self._cap < need:
+            self._cap *= 2
+        for name, col in self._cols.items():
+            shape = (self._cap,) + col.shape[1:]
+            new = np.zeros(shape, col.dtype)
+            new[: self.count] = col[: self.count]
+            self._cols[name] = new
+
+    def append(self, **arrays) -> np.ndarray:
+        n = len(next(iter(arrays.values())))
+        self._ensure(n)
+        rows = np.arange(self.count, self.count + n)
+        for name, arr in arrays.items():
+            self._cols[name][rows] = arr
+        self.count += n
+        return rows
+
+    def truncate(self, count: int) -> None:
+        assert count <= self.count
+        self.count = count
+
+    def col(self, name: str) -> np.ndarray:
+        return self._cols[name][: self.count]
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._cols[name]
+
+
+def _first_code(shape) -> np.ndarray:
+    return np.zeros(shape, np.uint32)
+
+
+def _apply_code(result: np.ndarray, cond: np.ndarray, code: int) -> None:
+    np.copyto(result, np.uint32(code), where=(result == 0) & cond)
+
+
+class TpuStateMachine:
+    """Accounting state machine with a JAX/TPU create_transfers path."""
+
+    def __init__(
+        self, config: cfg.Config = cfg.PRODUCTION, account_capacity: int = 1 << 16
+    ) -> None:
+        self.config = config
+        self.prepare_timestamp = 0
+        self.commit_timestamp = 0
+        self.pulse_next_timestamp = TIMESTAMP_MIN
+
+        # Account state.
+        self._acct_dir = SortedRuns()
+        self._attrs = Columns(_ATTR_FIELDS)
+        self._balances = jnp.zeros((account_capacity, 8), jnp.uint64)
+
+        # Transfer state.
+        self._tdir = SortedRuns()
+        self._store = Columns(_STORE_FIELDS)
+        # expires_at index: (expires_at, row, active).
+        self._exp = Columns(
+            {"expires_at": np.uint64, "row": np.uint32, "active": np.bool_}
+        )
+        self._history = Columns(_HISTORY_FIELDS)
+
+        self._expiry_rows: np.ndarray | None = None
+        self._exp_dead = 0
+
+    # ------------------------------------------------------------------
+    # Introspection helpers shared with CpuStateMachine.
+
+    def _transfer_row(self, id_value: int) -> int | None:
+        key = pack_u128(
+            np.array([id_value & 0xFFFFFFFFFFFFFFFF], np.uint64),
+            np.array([id_value >> 64], np.uint64),
+        )
+        found, row = self._tdir.lookup(key)
+        return int(row[0]) if found[0] else None
+
+    def transfer_timestamp(self, id_value: int) -> int | None:
+        row = self._transfer_row(id_value)
+        return None if row is None else int(self._store["timestamp"][row])
+
+    def pending_status(self, id_value: int) -> TransferPendingStatus | None:
+        row = self._transfer_row(id_value)
+        if row is None:
+            return None
+        status = int(self._store["status"][row])
+        return None if status == 0 else TransferPendingStatus(status)
+
+    @property
+    def history_count(self) -> int:
+        return self._history.count
+
+    def account_balances_raw(self, id_value: int) -> tuple | None:
+        """(debits_pending, debits_posted, credits_pending,
+        credits_posted) without going through a commit."""
+        slot = self._account_slot(id_value)
+        if slot is None:
+            return None
+        row = np.asarray(self._balances[slot])
+        u = lambda i: int(row[i]) | (int(row[i + 1]) << 64)
+        return (u(0), u(2), u(4), u(6))
+
+    # ------------------------------------------------------------------
+    # Interface plumbing (mirrors CpuStateMachine).
+
+    def input_valid(self, operation: Operation, input_bytes: bytes) -> bool:
+        return CpuStateMachine.input_valid(self, operation, input_bytes)
+
+    def prepare(self, operation: Operation, input_bytes: bytes) -> None:
+        CpuStateMachine.prepare(self, operation, input_bytes)
+
+    def pulse_needed(self) -> bool:
+        return self.pulse_next_timestamp <= self.prepare_timestamp
+
+    def prefetch(
+        self, operation: Operation, input_bytes: bytes, prefetch_timestamp: int
+    ) -> None:
+        if operation == Operation.pulse:
+            self._expiry_rows = self._scan_expired(prefetch_timestamp)
+
+    def commit(
+        self,
+        client: int,
+        op: int,
+        timestamp: int,
+        operation: Operation,
+        input_bytes: bytes,
+    ) -> bytes:
+        assert op != 0
+        assert self.input_valid(operation, input_bytes)
+        assert timestamp > self.commit_timestamp
+        if operation == Operation.pulse:
+            return self._commit_expire(timestamp)
+        if operation == Operation.create_accounts:
+            return self._commit_create_accounts(timestamp, input_bytes)
+        if operation == Operation.create_transfers:
+            return self._commit_create_transfers(timestamp, input_bytes)
+        if operation == Operation.lookup_accounts:
+            return self._lookup_accounts(input_bytes)
+        if operation == Operation.lookup_transfers:
+            return self._lookup_transfers(input_bytes)
+        if operation == Operation.get_account_transfers:
+            return self._get_account_transfers(input_bytes)
+        if operation == Operation.get_account_balances:
+            return self._get_account_balances(input_bytes)
+        raise AssertionError(operation)
+
+    # ------------------------------------------------------------------
+    # Accounts (cold path: per-event, exact oracle semantics).
+
+    def _account_slot(self, id_value: int) -> int | None:
+        key = pack_u128(
+            np.array([id_value & 0xFFFFFFFFFFFFFFFF], np.uint64),
+            np.array([id_value >> 64], np.uint64),
+        )
+        found, slot = self._acct_dir.lookup(key)
+        return int(slot[0]) if found[0] else None
+
+    def _commit_create_accounts(self, timestamp: int, input_bytes: bytes) -> bytes:
+        events = np.frombuffer(input_bytes, dtype=ACCOUNT_DTYPE)
+        n = len(events)
+        results: list[tuple[int, int]] = []
+
+        chain: int | None = None
+        chain_broken = False
+        # Undo scope for linked chains: slots allocated in the open chain.
+        scope_slots: list[int] = []
+
+        committed: list[dict] = []  # attr rows staged this batch
+
+        def exists_ladder(ev: dict, slot: int) -> int:
+            a = self._attrs
+            if ev["flags"] != int(a["flags"][slot]):
+                return CAR.exists_with_different_flags
+            if ev["ud128_lo"] != int(a["ud128_lo"][slot]) or ev["ud128_hi"] != int(
+                a["ud128_hi"][slot]
+            ):
+                return CAR.exists_with_different_user_data_128
+            if ev["ud64"] != int(a["ud64"][slot]):
+                return CAR.exists_with_different_user_data_64
+            if ev["ud32"] != int(a["ud32"][slot]):
+                return CAR.exists_with_different_user_data_32
+            if ev["ledger"] != int(a["ledger"][slot]):
+                return CAR.exists_with_different_ledger
+            if ev["code"] != int(a["code"][slot]):
+                return CAR.exists_with_different_code
+            return CAR.exists
+
+        def rollback_scope() -> None:
+            if not scope_slots:
+                return
+            keys = pack_u128(
+                self._attrs["id_lo"][scope_slots],
+                self._attrs["id_hi"][scope_slots],
+            )
+            self._acct_dir.remove(keys)
+            self._attrs.truncate(min(scope_slots))
+            scope_slots.clear()
+
+        for index in range(n):
+            row = events[index]
+            ev = {
+                "id": types.u128_get(row, "id"),
+                "flags": int(row["flags"]),
+                "ud128_lo": int(row["user_data_128_lo"]),
+                "ud128_hi": int(row["user_data_128_hi"]),
+                "ud64": int(row["user_data_64"]),
+                "ud32": int(row["user_data_32"]),
+                "ledger": int(row["ledger"]),
+                "code": int(row["code"]),
+            }
+            linked = bool(ev["flags"] & AF.linked)
+
+            result: int | None = None
+            if linked:
+                if chain is None:
+                    chain = index
+                    assert not chain_broken
+                    scope_slots.clear()
+                if index == n - 1:
+                    result = CAR.linked_event_chain_open
+            if result is None and chain_broken:
+                result = CAR.linked_event_failed
+            if result is None and int(row["timestamp"]) != 0:
+                result = CAR.timestamp_must_be_zero
+
+            if result is None:
+                result = self._create_account_checked(row, ev, exists_ladder)
+                if result == CAR.ok:
+                    slot = self._attrs.count
+                    self._attrs.append(
+                        id_lo=np.array([row["id_lo"]]),
+                        id_hi=np.array([row["id_hi"]]),
+                        ud128_lo=np.array([row["user_data_128_lo"]]),
+                        ud128_hi=np.array([row["user_data_128_hi"]]),
+                        ud64=np.array([row["user_data_64"]]),
+                        ud32=np.array([row["user_data_32"]]),
+                        ledger=np.array([row["ledger"]]),
+                        code=np.array([row["code"]]),
+                        flags=np.array([row["flags"]]),
+                        timestamp=np.array([timestamp - n + index + 1], np.uint64),
+                    )
+                    self._acct_dir.insert(
+                        pack_u128(
+                            np.array([row["id_lo"]], np.uint64),
+                            np.array([row["id_hi"]], np.uint64),
+                        ),
+                        np.array([slot], np.uint64),
+                    )
+                    if chain is not None:
+                        scope_slots.append(slot)
+                    self.commit_timestamp = timestamp - n + index + 1
+
+            if result != CAR.ok:
+                if chain is not None:
+                    if not chain_broken:
+                        chain_broken = True
+                        rollback_scope()
+                        for chain_index in range(chain, index):
+                            results.append((chain_index, CAR.linked_event_failed))
+                results.append((index, int(result)))
+
+            if chain is not None and (
+                not linked or result == CAR.linked_event_chain_open
+            ):
+                scope_slots.clear()
+                chain = None
+                chain_broken = False
+
+        self._ensure_balance_capacity(self._attrs.count)
+
+        out = np.zeros(len(results), dtype=CREATE_RESULT_DTYPE)
+        for i, (index, result) in enumerate(results):
+            out[i]["index"] = index
+            out[i]["result"] = result
+        return out.tobytes()
+
+    def _create_account_checked(self, row, ev, exists_ladder) -> int:
+        # reference: src/state_machine.zig:1421-1448
+        if int(row["reserved"]) != 0:
+            return CAR.reserved_field
+        if ev["flags"] & ~0xF:
+            return CAR.reserved_flag
+        if ev["id"] == 0:
+            return CAR.id_must_not_be_zero
+        if ev["id"] == U128_MAX:
+            return CAR.id_must_not_be_int_max
+        if (ev["flags"] & AF.debits_must_not_exceed_credits) and (
+            ev["flags"] & AF.credits_must_not_exceed_debits
+        ):
+            return CAR.flags_are_mutually_exclusive
+        for field in ("debits_pending", "debits_posted", "credits_pending", "credits_posted"):
+            if types.u128_get(row, field) != 0:
+                return getattr(CAR, f"{field}_must_be_zero")
+        if ev["ledger"] == 0:
+            return CAR.ledger_must_not_be_zero
+        if ev["code"] == 0:
+            return CAR.code_must_not_be_zero
+        slot = self._account_slot(ev["id"])
+        if slot is not None:
+            return exists_ladder(ev, slot)
+        return CAR.ok
+
+    def _ensure_balance_capacity(self, slots: int) -> None:
+        cap = self._balances.shape[0]
+        if slots <= cap:
+            return
+        while cap < slots:
+            cap *= 2
+        extra = jnp.zeros((cap - self._balances.shape[0], 8), jnp.uint64)
+        self._balances = jnp.concatenate([self._balances, extra])
+
+    # ------------------------------------------------------------------
+    # create_transfers (the hot path).
+
+    def _commit_create_transfers(self, timestamp: int, input_bytes: bytes) -> bytes:
+        events = np.frombuffer(input_bytes, dtype=TRANSFER_DTYPE)
+        n = len(events)
+        if n == 0:
+            return b""
+        ts_base = timestamp - n + 1
+
+        B = next(b for b in _BATCH_BUCKETS if b >= n)
+
+        id_lo = events["id_lo"].astype(np.uint64)
+        id_hi = events["id_hi"].astype(np.uint64)
+        dr_lo = events["debit_account_id_lo"].astype(np.uint64)
+        dr_hi = events["debit_account_id_hi"].astype(np.uint64)
+        cr_lo = events["credit_account_id_lo"].astype(np.uint64)
+        cr_hi = events["credit_account_id_hi"].astype(np.uint64)
+        pend_lo = events["pending_id_lo"].astype(np.uint64)
+        pend_hi = events["pending_id_hi"].astype(np.uint64)
+        amount_lo = events["amount_lo"].astype(np.uint64)
+        amount_hi = events["amount_hi"].astype(np.uint64)
+        flags = events["flags"].astype(np.uint32)
+        timeout = events["timeout"].astype(np.uint64)
+        ledger = events["ledger"].astype(np.uint32)
+        code = events["code"].astype(np.uint32)
+
+        is_pv = (flags & (kernel.F_POST | kernel.F_VOID)) != 0
+
+        # Account resolution (immutable within this batch).
+        dr_key = pack_u128(dr_lo, dr_hi)
+        cr_key = pack_u128(cr_lo, cr_hi)
+        dr_found, dr_slot_u = self._acct_dir.lookup(dr_key)
+        cr_found, cr_slot_u = self._acct_dir.lookup(cr_key)
+        dr_slot = np.where(dr_found, dr_slot_u.astype(np.int64), -1).astype(np.int32)
+        cr_slot = np.where(cr_found, cr_slot_u.astype(np.int64), -1).astype(np.int32)
+        dr_flags = np.where(dr_found, self._attrs["flags"][np.clip(dr_slot, 0, None)], 0).astype(np.uint32)
+        cr_flags = np.where(cr_found, self._attrs["flags"][np.clip(cr_slot, 0, None)], 0).astype(np.uint32)
+        dr_ledger = np.where(dr_found, self._attrs["ledger"][np.clip(dr_slot, 0, None)], 0).astype(np.uint32)
+        cr_ledger = np.where(cr_found, self._attrs["ledger"][np.clip(cr_slot, 0, None)], 0).astype(np.uint32)
+
+        # Static precedence ladder (reference: src/state_machine.zig:
+        # 1465-1504 normal, :1614-1624 post/void prefix).
+        static = _first_code(n)
+        id_zero = (id_lo == 0) & (id_hi == 0)
+        id_max = (id_lo == np.uint64(U64_MAX)) & (id_hi == np.uint64(U64_MAX))
+        _apply_code(static, (flags & ~np.uint32(0x3F)) != 0, CTR.reserved_flag)
+        _apply_code(static, id_zero, CTR.id_must_not_be_zero)
+        _apply_code(static, id_max, CTR.id_must_not_be_int_max)
+
+        # Post/void static prefix.
+        post = (flags & kernel.F_POST) != 0
+        void = (flags & kernel.F_VOID) != 0
+        pv_excl = (
+            (post & void)
+            | (is_pv & ((flags & kernel.F_PENDING) != 0))
+            | (is_pv & ((flags & kernel.F_BAL_DR) != 0))
+            | (is_pv & ((flags & kernel.F_BAL_CR) != 0))
+        )
+        pend_zero = (pend_lo == 0) & (pend_hi == 0)
+        pend_max = (pend_lo == np.uint64(U64_MAX)) & (pend_hi == np.uint64(U64_MAX))
+        pend_self = (pend_lo == id_lo) & (pend_hi == id_hi)
+        _apply_code(static, is_pv & pv_excl, CTR.flags_are_mutually_exclusive)
+        _apply_code(static, is_pv & pend_zero, CTR.pending_id_must_not_be_zero)
+        _apply_code(static, is_pv & pend_max, CTR.pending_id_must_not_be_int_max)
+        _apply_code(static, is_pv & pend_self, CTR.pending_id_must_be_different)
+        _apply_code(static, is_pv & (timeout != 0), CTR.timeout_reserved_for_pending_transfer)
+
+        # Normal static ladder.
+        nm = ~is_pv
+        dr_zero = (dr_lo == 0) & (dr_hi == 0)
+        dr_max = (dr_lo == np.uint64(U64_MAX)) & (dr_hi == np.uint64(U64_MAX))
+        cr_zero = (cr_lo == 0) & (cr_hi == 0)
+        cr_max = (cr_lo == np.uint64(U64_MAX)) & (cr_hi == np.uint64(U64_MAX))
+        same_acct = (dr_lo == cr_lo) & (dr_hi == cr_hi)
+        _apply_code(static, nm & dr_zero, CTR.debit_account_id_must_not_be_zero)
+        _apply_code(static, nm & dr_max, CTR.debit_account_id_must_not_be_int_max)
+        _apply_code(static, nm & cr_zero, CTR.credit_account_id_must_not_be_zero)
+        _apply_code(static, nm & cr_max, CTR.credit_account_id_must_not_be_int_max)
+        _apply_code(static, nm & same_acct, CTR.accounts_must_be_different)
+        _apply_code(static, nm & ~pend_zero, CTR.pending_id_must_be_zero)
+        not_pending_flag = (flags & kernel.F_PENDING) == 0
+        _apply_code(
+            static, nm & not_pending_flag & (timeout != 0),
+            CTR.timeout_reserved_for_pending_transfer,
+        )
+        not_balancing = (flags & (kernel.F_BAL_DR | kernel.F_BAL_CR)) == 0
+        amount_zero = (amount_lo == 0) & (amount_hi == 0)
+        _apply_code(static, nm & not_balancing & amount_zero, CTR.amount_must_not_be_zero)
+        _apply_code(static, nm & (ledger == 0), CTR.ledger_must_not_be_zero)
+        _apply_code(static, nm & (code == 0), CTR.code_must_not_be_zero)
+        _apply_code(static, nm & ~dr_found, CTR.debit_account_not_found)
+        _apply_code(static, nm & ~cr_found, CTR.credit_account_not_found)
+        _apply_code(
+            static, nm & (dr_ledger != cr_ledger), CTR.accounts_must_have_the_same_ledger
+        )
+        _apply_code(
+            static, nm & (ledger != dr_ledger),
+            CTR.transfer_must_have_the_same_ledger_as_accounts,
+        )
+
+        # Id groups: one compact index per distinct id value.
+        id_key = pack_u128(id_lo, id_hi)
+        unique_ids, id_group = np.unique(id_key, return_inverse=True)
+        pend_key = pack_u128(pend_lo, pend_hi)
+        pos = np.searchsorted(unique_ids, pend_key)
+        pos_c = np.minimum(pos, len(unique_ids) - 1)
+        p_group = np.where(
+            is_pv & (unique_ids[pos_c] == pend_key), pos_c, -1
+        ).astype(np.int32)
+
+        # Durable joins.
+        e_found, e_row = self._tdir.lookup(id_key)
+        p_found, p_row = self._tdir.lookup(pend_key)
+        p_found = p_found & is_pv
+        er = np.clip(e_row, 0, None).astype(np.int64)
+        pr = np.clip(p_row, 0, None).astype(np.int64)
+
+        st = self._store
+
+        def gather(col, rows, valid):
+            return np.where(valid, st[col][rows], 0)
+
+        # Durable-pending target dedupe + initial statuses.
+        p_rows_valid = p_row[p_found].astype(np.int64)
+        uniq_rows, tgt_inverse = (
+            np.unique(p_rows_valid, return_inverse=True)
+            if len(p_rows_valid)
+            else (np.zeros(0, np.int64), np.zeros(0, np.int64))
+        )
+        p_tgt = np.full(n, -1, np.int32)
+        p_tgt[p_found] = tgt_inverse.astype(np.int32)
+        dstat_init = np.zeros(B, np.uint32)
+        dstat_init[: len(uniq_rows)] = st["status"][uniq_rows]
+
+        ev = {
+            "i": np.arange(B, dtype=np.int32),
+            "flags": _pad(flags, B),
+            "ts_nonzero": _pad(events["timestamp"] != 0, B),
+            "static_result": _pad(static, B),
+            "amount_lo": _pad(amount_lo, B), "amount_hi": _pad(amount_hi, B),
+            "pending_lo": _pad(pend_lo, B), "pending_hi": _pad(pend_hi, B),
+            "ud128_lo": _pad(events["user_data_128_lo"].astype(np.uint64), B),
+            "ud128_hi": _pad(events["user_data_128_hi"].astype(np.uint64), B),
+            "ud64": _pad(events["user_data_64"].astype(np.uint64), B),
+            "ud32": _pad(events["user_data_32"].astype(np.uint32), B),
+            "timeout": _pad(timeout, B),
+            "ledger": _pad(ledger, B), "code": _pad(code, B),
+            "dr_slot": _pad(dr_slot, B), "cr_slot": _pad(cr_slot, B),
+            "dr_flags": _pad(dr_flags, B), "cr_flags": _pad(cr_flags, B),
+            "dr_id_zero": _pad(dr_zero, B), "cr_id_zero": _pad(cr_zero, B),
+            "id_group": _pad(id_group.astype(np.int32), B),
+            "p_group": _pad(p_group, B),
+            "e_found": _pad(e_found, B),
+            "e_flags": _pad(gather("flags", er, e_found).astype(np.uint32), B),
+            "e_dr_slot": _pad(gather("dr_slot", er, e_found).astype(np.int32), B),
+            "e_cr_slot": _pad(gather("cr_slot", er, e_found).astype(np.int32), B),
+            "e_amount_lo": _pad(gather("amount_lo", er, e_found).astype(np.uint64), B),
+            "e_amount_hi": _pad(gather("amount_hi", er, e_found).astype(np.uint64), B),
+            "e_pending_lo": _pad(gather("pending_lo", er, e_found).astype(np.uint64), B),
+            "e_pending_hi": _pad(gather("pending_hi", er, e_found).astype(np.uint64), B),
+            "e_ud128_lo": _pad(gather("ud128_lo", er, e_found).astype(np.uint64), B),
+            "e_ud128_hi": _pad(gather("ud128_hi", er, e_found).astype(np.uint64), B),
+            "e_ud64": _pad(gather("ud64", er, e_found).astype(np.uint64), B),
+            "e_ud32": _pad(gather("ud32", er, e_found).astype(np.uint32), B),
+            "e_timeout": _pad(gather("timeout", er, e_found).astype(np.uint64), B),
+            "e_code": _pad(gather("code", er, e_found).astype(np.uint32), B),
+            "p_found": _pad(p_found, B),
+            "p_flags": _pad(gather("flags", pr, p_found).astype(np.uint32), B),
+            "p_dr_slot": _pad(gather("dr_slot", pr, p_found).astype(np.int32), B),
+            "p_cr_slot": _pad(gather("cr_slot", pr, p_found).astype(np.int32), B),
+            "p_amount_lo": _pad(gather("amount_lo", pr, p_found).astype(np.uint64), B),
+            "p_amount_hi": _pad(gather("amount_hi", pr, p_found).astype(np.uint64), B),
+            "p_ud128_lo": _pad(gather("ud128_lo", pr, p_found).astype(np.uint64), B),
+            "p_ud128_hi": _pad(gather("ud128_hi", pr, p_found).astype(np.uint64), B),
+            "p_ud64": _pad(gather("ud64", pr, p_found).astype(np.uint64), B),
+            "p_ud32": _pad(gather("ud32", pr, p_found).astype(np.uint32), B),
+            "p_timeout": _pad(gather("timeout", pr, p_found).astype(np.uint64), B),
+            "p_ledger": _pad(gather("ledger", pr, p_found).astype(np.uint32), B),
+            "p_code": _pad(gather("code", pr, p_found).astype(np.uint32), B),
+            "p_timestamp": _pad(gather("timestamp", pr, p_found).astype(np.uint64), B),
+            "p_tgt": _pad(p_tgt, B),
+        }
+
+        out = kernel.run_create_transfers(
+            self._balances, {k: jnp.asarray(v) for k, v in ev.items()},
+            dstat_init, n, ts_base,
+        )
+        self._balances = out["balances"]
+
+        results = np.asarray(out["results"])[:n]
+        created_mask = np.asarray(out["created_mask"])[:n]
+        created = {f: np.asarray(out["created"][f])[:n] for f in kernel.CREATED_FIELDS}
+        inb_status = np.asarray(out["inb_status"])[:n]
+        dstat = np.asarray(out["dstat"])
+
+        self._post_process_transfers(
+            n, ts_base, id_lo, id_hi, id_key, flags, timeout,
+            results, created_mask, created, inb_status,
+            dstat_init, dstat, uniq_rows, p_found, p_row, p_group, id_group,
+            np.asarray(out["hist_dr"])[:n], np.asarray(out["hist_cr"])[:n],
+            int(out["last_applied"]),
+            np.asarray(out["pulse_create"])[:n],
+            np.asarray(out["pulse_remove"])[:n],
+        )
+
+        # Reply: failures only, in event order.
+        fail_idx = np.flatnonzero(results != 0)
+        reply = np.zeros(len(fail_idx), dtype=CREATE_RESULT_DTYPE)
+        reply["index"] = fail_idx.astype(np.uint32)
+        reply["result"] = results[fail_idx]
+        return reply.tobytes()
+
+    def _post_process_transfers(
+        self, n, ts_base, id_lo, id_hi, id_key, flags, timeout,
+        results, created_mask, created, inb_status,
+        dstat_init, dstat, uniq_rows, p_found, p_row, p_group, id_group,
+        hist_dr, hist_cr, last_applied, pulse_create, pulse_remove,
+    ) -> None:
+        ok = results == 0
+        # 1. Insert created transfers into the columnar store.
+        cm = created_mask
+        if cm.any():
+            idx = np.flatnonzero(cm)
+            ts = np.uint64(ts_base) + idx.astype(np.uint64)
+            status = np.zeros(len(idx), np.uint8)
+            # Pending creators carry their final in-batch status.
+            status[:] = inb_status[idx].astype(np.uint8)
+            rows = self._store.append(
+                id_lo=id_lo[idx], id_hi=id_hi[idx],
+                dr_slot=created["dr_slot"][idx], cr_slot=created["cr_slot"][idx],
+                amount_lo=created["amount_lo"][idx], amount_hi=created["amount_hi"][idx],
+                pending_lo=created["pending_lo"][idx], pending_hi=created["pending_hi"][idx],
+                ud128_lo=created["ud128_lo"][idx], ud128_hi=created["ud128_hi"][idx],
+                ud64=created["ud64"][idx], ud32=created["ud32"][idx],
+                timeout=created["timeout"][idx].astype(np.uint32),
+                ledger=created["ledger"][idx], code=created["code"][idx],
+                flags=flags[idx], timestamp=ts,
+                status=status,
+            )
+            self._tdir.insert(id_key[idx], rows.astype(np.uint64))
+            row_of_event = np.full(n, -1, np.int64)
+            row_of_event[idx] = rows
+        else:
+            row_of_event = np.full(n, -1, np.int64)
+
+        # 2. Durable pending-status updates (+ expires index removal).
+        changed = np.flatnonzero(dstat[: len(uniq_rows)] != dstat_init[: len(uniq_rows)])
+        for t in changed:
+            row = int(uniq_rows[t])
+            self._store["status"][row] = int(dstat[t])
+            if int(self._store["timeout"][row]) > 0:
+                self._exp_deactivate(row)
+
+        # 3. New expires entries for still-pending in-batch creations.
+        pend_created = np.flatnonzero(
+            cm & (inb_status == kernel.S_PENDING) & (timeout > 0)
+        )
+        if len(pend_created):
+            exp_rows = row_of_event[pend_created]
+            expires = (
+                np.uint64(ts_base)
+                + pend_created.astype(np.uint64)
+                + timeout[pend_created] * np.uint64(NS_PER_S)
+            )
+            self._exp.append(
+                expires_at=expires,
+                row=exp_rows.astype(np.uint32),
+                active=np.ones(len(exp_rows), bool),
+            )
+        # In-batch created-then-finished pendings: status already stored;
+        # their expires entries were never added (create+remove nets out).
+
+        # 4. pulse_next_timestamp replay from the kernel's apply-time
+        # signals — these are recorded pre-rollback, matching the
+        # reference's unscoped pulse_next mutations
+        # (reference: src/state_machine.zig:1576-1580,1704-1708).
+        for k in np.flatnonzero((pulse_create != 0) | (pulse_remove != 0)):
+            create_at = int(pulse_create[k])
+            remove_at = int(pulse_remove[k])
+            if create_at:
+                if create_at < self.pulse_next_timestamp:
+                    self.pulse_next_timestamp = create_at
+            if remove_at:
+                if self.pulse_next_timestamp == remove_at:
+                    self.pulse_next_timestamp = TIMESTAMP_MIN
+
+        # 5. Historical balances.
+        applied = cm & ok
+        if applied.any():
+            idx = np.flatnonzero(applied)
+            drs = created["dr_slot"][idx]
+            crs = created["cr_slot"][idx]
+            dr_hist = (self._attrs["flags"][drs] & AF.history) != 0
+            cr_hist = (self._attrs["flags"][crs] & AF.history) != 0
+            want = dr_hist | cr_hist
+            if want.any():
+                sel = idx[want]
+                drs, crs = drs[want], crs[want]
+                dr_hist, cr_hist = dr_hist[want], cr_hist[want]
+                zero8 = np.zeros((len(sel), 8), np.uint64)
+                self._history.append(
+                    timestamp=np.uint64(ts_base) + sel.astype(np.uint64),
+                    dr_id_lo=np.where(dr_hist, self._attrs["id_lo"][drs], 0),
+                    dr_id_hi=np.where(dr_hist, self._attrs["id_hi"][drs], 0),
+                    cr_id_lo=np.where(cr_hist, self._attrs["id_lo"][crs], 0),
+                    cr_id_hi=np.where(cr_hist, self._attrs["id_hi"][crs], 0),
+                    dr_bal=np.where(dr_hist[:, None], hist_dr[sel], zero8),
+                    cr_bal=np.where(cr_hist[:, None], hist_cr[sel], zero8),
+                )
+
+        # 6. commit_timestamp advances to the last event that reached
+        # the apply point — including chain events later rolled back
+        # (reference: src/state_machine.zig:1583; rollback never
+        # reverts commit_timestamp).
+        if last_applied >= 0:
+            self.commit_timestamp = ts_base + last_applied
+
+    def _exp_deactivate(self, row: int) -> None:
+        exp_rows = self._exp.col("row")
+        active = self._exp.col("active")
+        matches = np.flatnonzero((exp_rows == row) & active)
+        self._exp["active"][matches] = False
+        self._exp_dead += len(matches)
+        # Compact once tombstones dominate, keeping scans O(live).
+        if self._exp_dead * 2 > self._exp.count and self._exp.count > 64:
+            live = np.flatnonzero(self._exp.col("active"))
+            cols = {
+                name: self._exp.col(name)[live].copy()
+                for name in ("expires_at", "row", "active")
+            }
+            self._exp.truncate(0)
+            self._exp.append(**cols)
+            self._exp_dead = 0
+
+    # ------------------------------------------------------------------
+    # Expiry pulse.
+
+    def _scan_expired(self, expires_at_max: int) -> np.ndarray:
+        limit = self.config.batch_max_create_transfers
+        active = self._exp.col("active")
+        exp_at = self._exp.col("expires_at")
+        rows = self._exp.col("row")
+        live = np.flatnonzero(active)
+        if len(live) == 0:
+            self.pulse_next_timestamp = TIMESTAMP_MAX
+            return np.zeros(0, np.int64)
+        ts = self._store["timestamp"][rows[live]]
+        order = np.lexsort((ts, exp_at[live]))
+        ordered = live[order]
+        ordered_exp = exp_at[live][order]
+
+        due = ordered_exp <= expires_at_max
+        due_idx = np.flatnonzero(due)
+        if len(due_idx) > limit:
+            taken = ordered[due_idx[:limit]]
+            # buffer_finished: next pulse rescans from the overflow point
+            # (reference: src/state_machine.zig:2136-2140).
+            self.pulse_next_timestamp = int(ordered_exp[due_idx[limit]])
+        elif len(due_idx) == len(ordered_exp):
+            taken = ordered[due_idx]
+            self.pulse_next_timestamp = TIMESTAMP_MAX
+        else:
+            taken = ordered[due_idx]
+            self.pulse_next_timestamp = int(ordered_exp[len(due_idx)])
+        return rows[taken].astype(np.int64)
+
+    def _commit_expire(self, timestamp: int) -> bytes:
+        assert self._expiry_rows is not None
+        rows, self._expiry_rows = self._expiry_rows, None
+        if len(rows) == 0:
+            return b""
+
+        st = self._store
+        # Release pending amounts on device (sums are order-independent).
+        slots = np.concatenate([st["dr_slot"][rows], st["cr_slot"][rows]])
+        kinds = np.concatenate([np.zeros(len(rows), np.int8), np.ones(len(rows), np.int8)])
+        amt_lo = np.concatenate([st["amount_lo"][rows]] * 2)
+        amt_hi = np.concatenate([st["amount_hi"][rows]] * 2)
+
+        balances = np.array(self._balances)  # writable host copy
+        for slot, kind, lo, hi in zip(slots, kinds, amt_lo, amt_hi):
+            row = balances[int(slot)]
+            amount = int(lo) | (int(hi) << 64)
+            if kind == 0:  # debit side: debits_pending -= amount
+                cur = int(row[0]) | (int(row[1]) << 64)
+                cur -= amount
+                assert cur >= 0
+                row[0] = cur & 0xFFFFFFFFFFFFFFFF
+                row[1] = cur >> 64
+            else:  # credit side: credits_pending -= amount
+                cur = int(row[4]) | (int(row[5]) << 64)
+                cur -= amount
+                assert cur >= 0
+                row[4] = cur & 0xFFFFFFFFFFFFFFFF
+                row[5] = cur >> 64
+        self._balances = jnp.asarray(balances)
+
+        for row in rows:
+            st["status"][int(row)] = TransferPendingStatus.expired
+            self._exp_deactivate(int(row))
+        return b""
+
+    # ------------------------------------------------------------------
+    # Lookups & queries (cold path).
+
+    def _lookup_accounts(self, input_bytes: bytes) -> bytes:
+        ids = np.frombuffer(input_bytes, dtype=types.U128_PAIR_DTYPE)
+        keys = pack_u128(ids["lo"].astype(np.uint64), ids["hi"].astype(np.uint64))
+        found, slots = self._acct_dir.lookup(keys)
+        hit = np.flatnonzero(found)
+        out = np.zeros(len(hit), dtype=ACCOUNT_DTYPE)
+        if len(hit) == 0:
+            return b""
+        slots = slots[hit].astype(np.int64)
+        balances = np.asarray(self._balances[jnp.asarray(slots)])
+        a = self._attrs
+        out["id_lo"], out["id_hi"] = a["id_lo"][slots], a["id_hi"][slots]
+        out["debits_pending_lo"], out["debits_pending_hi"] = balances[:, 0], balances[:, 1]
+        out["debits_posted_lo"], out["debits_posted_hi"] = balances[:, 2], balances[:, 3]
+        out["credits_pending_lo"], out["credits_pending_hi"] = balances[:, 4], balances[:, 5]
+        out["credits_posted_lo"], out["credits_posted_hi"] = balances[:, 6], balances[:, 7]
+        out["user_data_128_lo"], out["user_data_128_hi"] = a["ud128_lo"][slots], a["ud128_hi"][slots]
+        out["user_data_64"] = a["ud64"][slots]
+        out["user_data_32"] = a["ud32"][slots]
+        out["ledger"] = a["ledger"][slots]
+        out["code"] = a["code"][slots]
+        out["flags"] = a["flags"][slots]
+        out["timestamp"] = a["timestamp"][slots]
+        return out.tobytes()
+
+    def _transfer_rows_to_np(self, rows: np.ndarray) -> np.ndarray:
+        st = self._store
+        out = np.zeros(len(rows), dtype=TRANSFER_DTYPE)
+        out["id_lo"], out["id_hi"] = st["id_lo"][rows], st["id_hi"][rows]
+        dr = st["dr_slot"][rows]
+        cr = st["cr_slot"][rows]
+        out["debit_account_id_lo"] = self._attrs["id_lo"][dr]
+        out["debit_account_id_hi"] = self._attrs["id_hi"][dr]
+        out["credit_account_id_lo"] = self._attrs["id_lo"][cr]
+        out["credit_account_id_hi"] = self._attrs["id_hi"][cr]
+        out["amount_lo"], out["amount_hi"] = st["amount_lo"][rows], st["amount_hi"][rows]
+        out["pending_id_lo"], out["pending_id_hi"] = st["pending_lo"][rows], st["pending_hi"][rows]
+        out["user_data_128_lo"], out["user_data_128_hi"] = st["ud128_lo"][rows], st["ud128_hi"][rows]
+        out["user_data_64"] = st["ud64"][rows]
+        out["user_data_32"] = st["ud32"][rows]
+        out["timeout"] = st["timeout"][rows]
+        out["ledger"] = st["ledger"][rows]
+        out["code"] = st["code"][rows]
+        out["flags"] = st["flags"][rows]
+        out["timestamp"] = st["timestamp"][rows]
+        return out
+
+    def _lookup_transfers(self, input_bytes: bytes) -> bytes:
+        ids = np.frombuffer(input_bytes, dtype=types.U128_PAIR_DTYPE)
+        keys = pack_u128(ids["lo"].astype(np.uint64), ids["hi"].astype(np.uint64))
+        found, rows = self._tdir.lookup(keys)
+        hit = rows[found].astype(np.int64)
+        return self._transfer_rows_to_np(hit).tobytes()
+
+    def _parse_filter(self, input_bytes: bytes):
+        row = np.frombuffer(input_bytes, dtype=ACCOUNT_FILTER_DTYPE)[0]
+        return row
+
+    def _filter_rows(self, filter_row) -> np.ndarray | None:
+        """Validated filter -> matching store rows in timestamp order.
+
+        reference: src/state_machine.zig:931-996.
+        """
+        account_id = types.u128_get(filter_row, "account_id")
+        ts_min = int(filter_row["timestamp_min"])
+        ts_max = int(filter_row["timestamp_max"])
+        limit = int(filter_row["limit"])
+        fflags = int(filter_row["flags"])
+        valid = (
+            account_id != 0
+            and account_id != U128_MAX
+            and ts_min != U64_MAX
+            and ts_max != U64_MAX
+            and (ts_max == 0 or ts_min <= ts_max)
+            and limit != 0
+            and (fflags & (AccountFilterFlags.debits | AccountFilterFlags.credits))
+            and not (fflags & ~int(AccountFilterFlags._valid_mask))
+            and bytes(filter_row["reserved"]) == b"\x00" * 24
+        )
+        if not valid:
+            return None
+        slot = self._account_slot(account_id)
+        if slot is None:
+            return np.zeros(0, np.int64)
+        st = self._store
+        lo = TIMESTAMP_MIN if ts_min == 0 else ts_min
+        hi = TIMESTAMP_MAX if ts_max == 0 else ts_max
+        mask = np.zeros(st.count, bool)
+        if fflags & AccountFilterFlags.debits:
+            mask |= st.col("dr_slot") == slot
+        if fflags & AccountFilterFlags.credits:
+            mask |= st.col("cr_slot") == slot
+        ts = st.col("timestamp")
+        mask &= (ts >= lo) & (ts <= hi)
+        rows = np.flatnonzero(mask)  # store order == timestamp order
+        if fflags & AccountFilterFlags.reversed:
+            rows = rows[::-1]
+        return rows
+
+    def _get_account_transfers(self, input_bytes: bytes) -> bytes:
+        filter_row = self._parse_filter(input_bytes)
+        rows = self._filter_rows(filter_row)
+        if rows is None:
+            return b""
+        batch_max = self.config.batch_max(
+            ACCOUNT_FILTER_DTYPE.itemsize, TRANSFER_DTYPE.itemsize
+        )
+        rows = rows[: min(int(filter_row["limit"]), batch_max)]
+        return self._transfer_rows_to_np(rows).tobytes()
+
+    def _get_account_balances(self, input_bytes: bytes) -> bytes:
+        filter_row = self._parse_filter(input_bytes)
+        account_id = types.u128_get(filter_row, "account_id")
+        slot = self._account_slot(account_id)
+        if slot is None or not (int(self._attrs["flags"][slot]) & AF.history):
+            return b""
+        rows = self._filter_rows(filter_row)
+        if rows is None:
+            return b""
+        batch_max = self.config.batch_max(
+            ACCOUNT_FILTER_DTYPE.itemsize, ACCOUNT_BALANCE_DTYPE.itemsize
+        )
+        rows = rows[: min(int(filter_row["limit"]), batch_max)]
+        # Map transfer timestamps -> history rows (same timestamps;
+        # history rows are store-ordered too).
+        want_ts = self._store["timestamp"][rows]
+        h_ts = self._history.col("timestamp")
+        pos = np.searchsorted(h_ts, want_ts)
+        assert (h_ts[pos] == want_ts).all()
+
+        h = self._history
+        id_lo = np.uint64(account_id & 0xFFFFFFFFFFFFFFFF)
+        id_hi = np.uint64(account_id >> 64)
+        is_dr = (h["dr_id_lo"][pos] == id_lo) & (h["dr_id_hi"][pos] == id_hi)
+        bal = np.where(is_dr[:, None], h["dr_bal"][pos], h["cr_bal"][pos])
+        out = np.zeros(len(rows), dtype=ACCOUNT_BALANCE_DTYPE)
+        out["debits_pending_lo"], out["debits_pending_hi"] = bal[:, 0], bal[:, 1]
+        out["debits_posted_lo"], out["debits_posted_hi"] = bal[:, 2], bal[:, 3]
+        out["credits_pending_lo"], out["credits_pending_hi"] = bal[:, 4], bal[:, 5]
+        out["credits_posted_lo"], out["credits_posted_hi"] = bal[:, 6], bal[:, 7]
+        out["timestamp"] = want_ts
+        return out.tobytes()
+
+
+def _pad(arr: np.ndarray, size: int) -> np.ndarray:
+    n = len(arr)
+    if n == size:
+        return np.ascontiguousarray(arr)
+    out = np.zeros(size, arr.dtype)
+    out[:n] = arr
+    return out
