@@ -1,0 +1,103 @@
+"""Driver benchmark: create_transfers commit throughput, 1M-transfer replay.
+
+Replays the BASELINE.json "simple" config (sequential-id posted
+transfers over 1k accounts, single ledger, batch=8190 — reference:
+src/tigerbeetle/cli.zig:80-101 benchmark defaults) through the TPU
+state machine and prints ONE JSON line.
+
+vs_baseline is measured against the reference's published headline Zig
+single-core number: 800,000 transfers/s (reference:
+docs/about/README.md:78, AlphaBeetle io_uring rewrite).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+from tigerbeetle_tpu.testing.harness import SingleNodeHarness
+from tigerbeetle_tpu.types import ACCOUNT_DTYPE, TRANSFER_DTYPE, Operation
+
+BASELINE_TPS = 800_000.0
+N_ACCOUNTS = int(os.environ.get("BENCH_ACCOUNTS", 1_000))
+N_TRANSFERS = int(os.environ.get("BENCH_TRANSFERS", 1_000_000))
+BATCH = int(os.environ.get("BENCH_BATCH", 8_190))
+
+
+def make_accounts(n: int) -> bytes:
+    arr = np.zeros(n, dtype=ACCOUNT_DTYPE)
+    arr["id_lo"] = np.arange(1, n + 1, dtype=np.uint64)
+    arr["ledger"] = 1
+    arr["code"] = 1
+    return arr.tobytes()
+
+
+def make_transfers(start_id: int, n: int, rng: np.random.Generator) -> bytes:
+    arr = np.zeros(n, dtype=TRANSFER_DTYPE)
+    arr["id_lo"] = np.arange(start_id, start_id + n, dtype=np.uint64)
+    dr = rng.integers(1, N_ACCOUNTS + 1, size=n, dtype=np.uint64)
+    # credit account != debit account, both in [1, N_ACCOUNTS]
+    cr = dr % np.uint64(N_ACCOUNTS) + np.uint64(1)
+    arr["debit_account_id_lo"] = dr
+    arr["credit_account_id_lo"] = cr
+    arr["amount_lo"] = rng.integers(1, 100, size=n, dtype=np.uint64)
+    arr["ledger"] = 1
+    arr["code"] = 1
+    return arr.tobytes()
+
+
+def main() -> None:
+    import jax
+
+    sm = TpuStateMachine(account_capacity=1 << 12)
+    h = SingleNodeHarness(sm)
+    h.submit(Operation.create_accounts, make_accounts(N_ACCOUNTS))
+
+    rng = np.random.default_rng(42)
+
+    # Warmup batch (compile) — not timed, not counted.
+    warm = make_transfers(10_000_000, BATCH, rng)
+    reply = h.submit(Operation.create_transfers, warm)
+    assert reply == b"", "warmup transfers must all succeed"
+    sm.sync()  # also compiles the flush kernel's steady-state shape
+
+    # Pre-build all batches so generation isn't timed.
+    batches = []
+    next_id = 1
+    remaining = N_TRANSFERS
+    while remaining > 0:
+        n = min(BATCH, remaining)
+        batches.append(make_transfers(next_id, n, rng))
+        next_id += n
+        remaining -= n
+
+    t0 = time.perf_counter()
+    for body in batches:
+        reply = h.submit(Operation.create_transfers, body)
+        assert reply == b"", "replay transfers must all succeed"
+    sm.sync()
+    elapsed = time.perf_counter() - t0
+
+    tps = N_TRANSFERS / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "create_transfers_commits_per_sec",
+                "value": round(tps, 1),
+                "unit": "transfers/s",
+                "vs_baseline": round(tps / BASELINE_TPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

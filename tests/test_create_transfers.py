@@ -281,3 +281,19 @@ def test_results_are_sparse_failures_only(h):
     arr = np.frombuffer(out, dtype=types.CREATE_RESULT_DTYPE)
     assert len(arr) == 1
     assert int(arr[0]["index"]) == 1
+
+
+def test_overflow_beats_overflows_timeout(h):
+    # A balance overflow outranks overflows_timeout in the precedence
+    # ladder (reference: src/state_machine.zig:1531-1545) even when the
+    # event also has an overflowing timeout — regression test for the
+    # fast path mis-ranking it.
+    big = MAX - 2
+    assert h.create_accounts([account(20), account(21)]) == []
+    late = types.U64_MAX - 100_000_000_000
+    assert h.create_transfers(
+        [t(200, dr=20, cr=21, amount=big, flags=TF.pending)], realtime=late
+    ) == []
+    assert h.create_transfers(
+        [t(201, dr=20, cr=21, amount=5, timeout=400, flags=TF.pending)]
+    ) == [(0, CTR.overflows_debits_pending)]

@@ -1,3 +1,3 @@
-from tigerbeetle_tpu.lsm.runs import SortedRuns, pack_u128
+from tigerbeetle_tpu.lsm.runs import pack_u128
 
-__all__ = ["SortedRuns", "pack_u128"]
+__all__ = ["pack_u128"]

@@ -1,17 +1,11 @@
-"""Sorted-run key directory: the host-side analog of the LSM id tree.
+"""u128 key packing for order-preserving numpy sorts.
 
-The reference maps ids to objects through per-groove LSM trees
-(reference: src/lsm/groove.zig:136-176 — IdTree id->timestamp plus
-ObjectTree). On the host we need the same mapping (u128 id -> row/slot)
-with *vectorized* batch lookup so no per-event Python runs on the hot
-path. The structure is deliberately LSM-shaped: each inserted batch is
-one sorted run ("immutable memtable"), lookups binary-search every run
-newest-first, and runs are merge-compacted once there are too many
-(reference analog: src/lsm/compaction.zig level merging).
-
-u128 keys are packed into 16-byte big-endian void scalars so numpy's
-memcmp ordering equals numeric u128 ordering (sort/searchsorted work
-unchanged).
+The reference orders LSM keys numerically (src/lsm/composite_key.zig);
+on the host we pack u128 (lo, hi) limb pairs into 16-byte big-endian
+void scalars so numpy's memcmp ordering equals numeric u128 ordering
+(sort/searchsorted/unique work unchanged). The hot-path id directories
+live in utils/hashindex.py; this packing serves the exact-scan path's
+id grouping and future on-disk sorted runs.
 """
 
 from __future__ import annotations
@@ -28,67 +22,3 @@ def pack_u128(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
     s["hi"] = hi
     s["lo"] = lo
     return s.view(KEY_DTYPE).reshape(-1)
-
-
-class SortedRuns:
-    """Append-only key -> uint64 value map with vectorized lookup."""
-
-    def __init__(self, compact_at: int = 24) -> None:
-        self._runs: list[tuple[np.ndarray, np.ndarray]] = []
-        self._compact_at = compact_at
-        self.count = 0
-
-    def insert(self, keys: np.ndarray, values: np.ndarray) -> None:
-        """Insert one batch (keys must not already exist)."""
-        if len(keys) == 0:
-            return
-        order = np.argsort(keys, kind="stable")
-        self._runs.append((keys[order], np.asarray(values, np.uint64)[order]))
-        self.count += len(keys)
-        if len(self._runs) >= self._compact_at:
-            self._compact()
-
-    def _compact(self) -> None:
-        keys = np.concatenate([r[0] for r in self._runs])
-        values = np.concatenate([r[1] for r in self._runs])
-        order = np.argsort(keys, kind="stable")
-        self._runs = [(keys[order], values[order])]
-
-    def lookup(self, keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """Vectorized get: returns (found bool array, values uint64).
-
-        Newest run wins, though inserts of duplicate keys are illegal
-        anyway (the state machine's exists-checks prevent them).
-        """
-        n = len(keys)
-        found = np.zeros(n, dtype=bool)
-        values = np.zeros(n, dtype=np.uint64)
-        for run_keys, run_values in reversed(self._runs):
-            remaining = ~found
-            if not remaining.any():
-                break
-            probe = keys[remaining]
-            pos = np.searchsorted(run_keys, probe)
-            pos_clipped = np.minimum(pos, len(run_keys) - 1)
-            hit = run_keys[pos_clipped] == probe
-            idx = np.flatnonzero(remaining)[hit]
-            found[idx] = True
-            values[idx] = run_values[pos_clipped[hit]]
-        return found, values
-
-    def remove(self, keys: np.ndarray) -> None:
-        """Delete keys (used only by scoped rollback of create_accounts)."""
-        if len(keys) == 0:
-            return
-        keyset = set(keys.tobytes()[i * 16 : (i + 1) * 16] for i in range(len(keys)))
-        new_runs = []
-        for run_keys, run_values in self._runs:
-            mask = np.array(
-                [bytes(k) not in keyset for k in run_keys], dtype=bool
-            )
-            if mask.all():
-                new_runs.append((run_keys, run_values))
-            else:
-                new_runs.append((run_keys[mask], run_values[mask]))
-        self._runs = [r for r in new_runs if len(r[0])]
-        self.count -= len(keys)

@@ -1,0 +1,173 @@
+"""Write-behind device apply: fused modular scatter-add flushes.
+
+The TPU link has high per-dispatch latency (the state machine must
+never block the commit path on it), so balance updates from fast-path
+batches (see tpu.py `_commit_fast` for the admission conditions) are
+queued host-side as compact (slot, column, u128 delta) entries and
+flushed to the HBM table in large fused scatter-adds, asynchronously —
+no host<->device sync anywhere on the hot path.
+
+Overflow admission runs on the host BalanceMirror (mirror.py) before
+enqueueing, so the device apply is a pure mod-2^128 addition;
+subtractions (pending expiry) ride the same path as two's-complement
+deltas. Deltas are accumulated as 4x32-bit limbs in uint64 lanes so
+scatter-adds cannot wrap (limb sums < 2^32 * entries), then one carry
+pass recombines exact sums.
+
+The exact scan kernel (kernel.py) reads the table through a flush
+barrier, so order-dependent batches always see current state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tigerbeetle_tpu.ops import u128 as w
+
+_MASK32 = jnp.uint64(0xFFFFFFFF)
+
+# Flush shape buckets: only a few shapes ever compile.
+_FLUSH_BUCKETS = (4096, 32768, 131072, 524288)
+# Queue high-water mark: flush (async) once this many entries queue up.
+# Kept high: global compaction at flush time collapses the queue to at
+# most accounts*4 entries, and every read goes through a flush barrier,
+# so a bigger queue just means fewer (fused) device dispatches.
+FLUSH_THRESHOLD = 500_000
+
+
+def _limbs(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """(K,) u128 limb pair -> (K, 4) little-endian 32-bit limbs."""
+    return jnp.stack([lo & _MASK32, lo >> 32, hi & _MASK32, hi >> 32], axis=-1)
+
+
+def _normalize_mod(acc: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(..., 4) limb sums -> (lo, hi) mod 2^128 (carry-out dropped)."""
+    c0 = acc[..., 0]
+    c1 = acc[..., 1] + (c0 >> 32)
+    c2 = acc[..., 2] + (c1 >> 32)
+    c3 = acc[..., 3] + (c2 >> 32)
+    lo = (c0 & _MASK32) | ((c1 & _MASK32) << 32)
+    hi = (c2 & _MASK32) | ((c3 & _MASK32) << 32)
+    return lo, hi
+
+
+def _flush_impl(balances, slots, cols, add_lo, add_hi):
+    """balances[slot, col] += delta (mod 2^128), fused over K entries.
+
+    Padding entries use slot 0 / col 0 / amount 0 (a no-op add).
+    """
+    A = balances.shape[0]
+    limbs = _limbs(add_lo, add_hi)
+    acc = jnp.zeros((A, 4, 4), jnp.uint64)
+    acc = acc.at[jnp.clip(slots, 0, A - 1), cols].add(limbs)
+    d_lo, d_hi = _normalize_mod(acc)  # (A, 4)
+
+    old_lo = balances[:, 0::2]
+    old_hi = balances[:, 1::2]
+    (new_lo, new_hi), _ = w.add((old_lo, old_hi), (d_lo, d_hi))
+    return jnp.stack(
+        [
+            new_lo[:, 0], new_hi[:, 0],
+            new_lo[:, 1], new_hi[:, 1],
+            new_lo[:, 2], new_hi[:, 2],
+            new_lo[:, 3], new_hi[:, 3],
+        ],
+        axis=-1,
+    )
+
+
+_flush = jax.jit(_flush_impl, donate_argnums=(0,))
+
+
+class DeviceTable:
+    """The authoritative HBM balance table + its write-behind queue."""
+
+    def __init__(self, capacity: int) -> None:
+        self.balances = jnp.zeros((capacity, 8), jnp.uint64)
+        self._q: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = []
+        self._queued = 0
+
+    def grow(self, capacity: int) -> None:
+        have = self.balances.shape[0]
+        if capacity <= have:
+            return
+        extra = jnp.zeros((capacity - have, 8), jnp.uint64)
+        self.balances = jnp.concatenate([self.balances, extra])
+
+    def enqueue(self, slots, cols, add_lo, add_hi) -> None:
+        """Queue compact (slot, col, delta) modular adds."""
+        if len(slots) == 0:
+            return
+        self._q.append(
+            (
+                np.asarray(slots, np.int32),
+                np.asarray(cols, np.int32),
+                np.asarray(add_lo, np.uint64),
+                np.asarray(add_hi, np.uint64),
+            )
+        )
+        self._queued += len(slots)
+        if self._queued >= FLUSH_THRESHOLD:
+            self.flush()
+
+    def flush(self) -> None:
+        """Dispatch all queued deltas to the device (async, no sync).
+
+        The queue is first re-compacted globally — modular adds merge
+        across batches — so one flush covers many commits with at most
+        accounts*4 entries, usually landing in the smallest bucket.
+        """
+        if not self._queued:
+            return
+        from tigerbeetle_tpu.state_machine.mirror import compact_deltas
+
+        slots = np.concatenate([e[0] for e in self._q]).astype(np.int64)
+        cols = np.concatenate([e[1] for e in self._q]).astype(np.int64)
+        a_lo = np.concatenate([e[2] for e in self._q])
+        a_hi = np.concatenate([e[3] for e in self._q])
+        self._q.clear()
+        self._queued = 0
+        # Compact in bounded chunks (exactness limit of compact_deltas),
+        # then once more over the per-chunk sums.
+        chunk = (1 << 21) - 1
+        if len(slots) > chunk:
+            parts = [
+                compact_deltas(
+                    slots[i : i + chunk], cols[i : i + chunk],
+                    a_lo[i : i + chunk], a_hi[i : i + chunk],
+                )
+                for i in range(0, len(slots), chunk)
+            ]
+            slots = np.concatenate([p[0] for p in parts])
+            cols = np.concatenate([p[1] for p in parts])
+            a_lo = np.concatenate([p[2] for p in parts])
+            a_hi = np.concatenate([p[3] for p in parts])
+        u_slot, u_col, d_lo, d_hi, _ = compact_deltas(slots, cols, a_lo, a_hi)
+
+        at = 0
+        while at < len(u_slot):
+            take = min(len(u_slot) - at, _FLUSH_BUCKETS[-1])
+            bucket = next(b for b in _FLUSH_BUCKETS if b >= take)
+            pad = np.zeros(bucket, np.int64)
+            pslots, pcols = pad.copy(), pad.copy()
+            plo = np.zeros(bucket, np.uint64)
+            phi = np.zeros(bucket, np.uint64)
+            pslots[:take] = u_slot[at : at + take]
+            pcols[:take] = u_col[at : at + take]
+            plo[:take] = d_lo[at : at + take]
+            phi[:take] = d_hi[at : at + take]
+            self.balances = _flush(
+                self.balances,
+                jnp.asarray(pslots.astype(np.int32)),
+                jnp.asarray(pcols.astype(np.int32)),
+                jnp.asarray(plo), jnp.asarray(phi),
+            )
+            at += take
+
+    def read(self):
+        """Flush barrier + current device handle (still async)."""
+        self.flush()
+        return self.balances

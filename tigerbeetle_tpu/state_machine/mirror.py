@@ -1,0 +1,176 @@
+"""Host-side exact mirror of the account-balance table.
+
+The device (HBM) table is the authoritative balance store, but a
+round-trip to it costs ~wire latency, so the commit hot path must never
+wait on the device. The host keeps a bit-exact mirror of the four u128
+balance columns and uses it for:
+
+- fast-path admission: the monotone-overflow check (see
+  kernel_fast.py) runs against the mirror, so no device sync is needed
+  to decide fast vs exact-scan routing;
+- serving lookup/query balance reads without draining the device queue.
+
+The mirror is maintained by the same deltas the device applies, in the
+same commit order, so mirror == device table at every flush boundary
+(tests assert this via the device-reading debug API).
+
+Columns are (A, 4) uint64 limb pairs: dp, dpo, cp, cpo — matching the
+device layout in kernel.py (reference balance fields:
+src/tigerbeetle.zig:8-12).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_MASK32 = np.uint64(0xFFFFFFFF)
+
+
+def _add_u128(a_lo, a_hi, b_lo, b_hi):
+    """Vectorized (a + b) mod 2^128 plus overflow flag."""
+    lo = a_lo + b_lo
+    carry = (lo < a_lo).astype(np.uint64)
+    hi_partial = a_hi + b_hi
+    ov1 = hi_partial < a_hi
+    hi = hi_partial + carry
+    ov2 = hi < hi_partial
+    return lo, hi, ov1 | ov2
+
+
+def _sub_u128(a_lo, a_hi, b_lo, b_hi):
+    """Vectorized (a - b) mod 2^128 plus borrow flag."""
+    lo = a_lo - b_lo
+    borrow = (a_lo < b_lo).astype(np.uint64)
+    hi = a_hi - b_hi - borrow
+    under = (a_hi < b_hi) | ((a_hi == b_hi) & (borrow == 1))
+    return lo, hi, under
+
+
+def compact_deltas(slots, cols, amt_lo, amt_hi):
+    """Group (slot, col, amount) contributions into exact u128 sums.
+
+    Returns (uniq_slots, uniq_cols, sum_lo, sum_hi, limb_overflow).
+    Amounts are accumulated as 4x32-bit limbs in uint64 lanes: each
+    limb sum stays < 2^32 * count, so scatter-adds cannot wrap for any
+    realistic batch, and one carry pass recombines exact sums.
+    """
+    assert len(slots) < 1 << 21, "limb sums must stay exact in float64"
+    key = slots.astype(np.int64) * 4 + cols.astype(np.int64)
+    uniq, inv = np.unique(key, return_inverse=True)
+    # Exact limb sums via float64 bincount: each 32-bit limb summed
+    # over <= 2^21 entries stays < 2^53, so float64 is exact.
+    k = len(uniq)
+    c0 = np.bincount(inv, (amt_lo & _MASK32).astype(np.float64), k).astype(np.uint64)
+    c1_ = np.bincount(inv, (amt_lo >> np.uint64(32)).astype(np.float64), k).astype(
+        np.uint64
+    )
+    c2_ = np.bincount(inv, (amt_hi & _MASK32).astype(np.float64), k).astype(np.uint64)
+    c3_ = np.bincount(inv, (amt_hi >> np.uint64(32)).astype(np.float64), k).astype(
+        np.uint64
+    )
+    c1 = c1_ + (c0 >> np.uint64(32))
+    c2 = c2_ + (c1 >> np.uint64(32))
+    c3 = c3_ + (c2 >> np.uint64(32))
+    lo = (c0 & _MASK32) | ((c1 & _MASK32) << np.uint64(32))
+    hi = (c2 & _MASK32) | ((c3 & _MASK32) << np.uint64(32))
+    overflow = (c3 >> np.uint64(32)) != 0
+    return (uniq // 4).astype(np.int64), (uniq % 4).astype(np.int64), lo, hi, overflow
+
+
+class BalanceMirror:
+    """Exact host copy of the (A, 4)-column u128 balance table."""
+
+    def __init__(self, capacity: int) -> None:
+        self.lo = np.zeros((capacity, 4), np.uint64)
+        self.hi = np.zeros((capacity, 4), np.uint64)
+
+    def grow(self, capacity: int) -> None:
+        if capacity <= len(self.lo):
+            return
+        lo = np.zeros((capacity, 4), np.uint64)
+        hi = np.zeros((capacity, 4), np.uint64)
+        lo[: len(self.lo)] = self.lo
+        hi[: len(self.hi)] = self.hi
+        self.lo, self.hi = lo, hi
+
+    def rows8(self, slots: np.ndarray) -> np.ndarray:
+        """(k, 8) interleaved rows matching the device layout."""
+        out = np.empty((len(slots), 8), np.uint64)
+        out[:, 0::2] = self.lo[slots]
+        out[:, 1::2] = self.hi[slots]
+        return out
+
+    def set_rows8(self, slots: np.ndarray, rows: np.ndarray) -> None:
+        """Overwrite rows from (k, 8) device-layout snapshots.
+
+        Duplicate slots resolve to the LAST occurrence (commit order).
+        """
+        rev = slots[::-1]
+        uniq, first = np.unique(rev, return_index=True)
+        pick = len(slots) - 1 - first
+        self.lo[uniq] = rows[pick][:, 0::2]
+        self.hi[uniq] = rows[pick][:, 1::2]
+
+    def try_apply_adds(self, dr_slot, cr_slot, amt_lo, amt_hi, is_pending, mask):
+        """Fast-path admission + commit.
+
+        Applies non-negative balance additions (pending -> dp/cp,
+        posted -> dpo/cpo) iff no touched account's final column sum or
+        combined debit/credit total overflows u128. Returns the compact
+        (slot, col, delta_lo, delta_hi) arrays to enqueue to the device
+        when committed, or None — meaning the caller must take the
+        exact scan path (reference overflow codes:
+        src/state_machine.zig:1531-1545).
+        """
+        m = mask
+        dr_col = np.where(is_pending[m], 0, 1)
+        cr_col = np.where(is_pending[m], 2, 3)
+        slots = np.concatenate([dr_slot[m], cr_slot[m]])
+        cols = np.concatenate([dr_col, cr_col])
+        a_lo = np.concatenate([amt_lo[m]] * 2)
+        a_hi = np.concatenate([amt_hi[m]] * 2)
+        if len(slots) == 0:
+            return (slots, cols, a_lo, a_hi)
+
+        u_slot, u_col, d_lo, d_hi, limb_ov = compact_deltas(slots, cols, a_lo, a_hi)
+        if limb_ov.any():
+            return None
+        old_lo = self.lo[u_slot, u_col]
+        old_hi = self.hi[u_slot, u_col]
+        new_lo, new_hi, add_ov = _add_u128(old_lo, old_hi, d_lo, d_hi)
+        if add_ov.any():
+            return None
+
+        # Combined totals dp+dpo / cp+cpo are monotone too; check the
+        # final sums of every touched account.
+        touched = np.unique(u_slot)
+        cand_lo = self.lo[touched].copy()
+        cand_hi = self.hi[touched].copy()
+        pos = np.searchsorted(touched, u_slot)
+        cand_lo[pos, u_col] = new_lo
+        cand_hi[pos, u_col] = new_hi
+        _, _, dr_tot_ov = _add_u128(
+            cand_lo[:, 0], cand_hi[:, 0], cand_lo[:, 1], cand_hi[:, 1]
+        )
+        _, _, cr_tot_ov = _add_u128(
+            cand_lo[:, 2], cand_hi[:, 2], cand_lo[:, 3], cand_hi[:, 3]
+        )
+        if dr_tot_ov.any() or cr_tot_ov.any():
+            return None
+
+        self.lo[u_slot, u_col] = new_lo
+        self.hi[u_slot, u_col] = new_hi
+        return (u_slot, u_col, d_lo, d_hi)
+
+    def apply_subs(self, slots, cols, amt_lo, amt_hi) -> None:
+        """Release amounts (pending expiry): column -= amount, exact."""
+        u_slot, u_col, d_lo, d_hi, limb_ov = compact_deltas(
+            slots, cols, amt_lo, amt_hi
+        )
+        assert not limb_ov.any()
+        new_lo, new_hi, under = _sub_u128(
+            self.lo[u_slot, u_col], self.hi[u_slot, u_col], d_lo, d_hi
+        )
+        assert not under.any(), "pending release underflow"
+        self.lo[u_slot, u_col] = new_lo
+        self.hi[u_slot, u_col] = new_hi

@@ -31,8 +31,10 @@ import jax.numpy as jnp
 
 from tigerbeetle_tpu import constants as cfg
 from tigerbeetle_tpu import types
-from tigerbeetle_tpu.lsm import SortedRuns, pack_u128
-from tigerbeetle_tpu.state_machine import kernel
+from tigerbeetle_tpu.lsm import pack_u128
+from tigerbeetle_tpu.utils import HashIndex
+from tigerbeetle_tpu.state_machine import kernel, kernel_fast
+from tigerbeetle_tpu.state_machine.mirror import BalanceMirror, _sub_u128
 from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
 from tigerbeetle_tpu.types import (
     ACCOUNT_BALANCE_DTYPE,
@@ -156,13 +158,16 @@ class TpuStateMachine:
         self.commit_timestamp = 0
         self.pulse_next_timestamp = TIMESTAMP_MIN
 
-        # Account state.
-        self._acct_dir = SortedRuns()
+        # Account state. The device table is authoritative; the host
+        # mirror serves routing decisions and balance reads without
+        # blocking on the device link (see mirror.py / kernel_fast.py).
+        self._acct_dir = HashIndex()
         self._attrs = Columns(_ATTR_FIELDS)
-        self._balances = jnp.zeros((account_capacity, 8), jnp.uint64)
+        self._dev = kernel_fast.DeviceTable(account_capacity)
+        self._mirror = BalanceMirror(account_capacity)
 
         # Transfer state.
-        self._tdir = SortedRuns()
+        self._tdir = HashIndex()
         self._store = Columns(_STORE_FIELDS)
         # expires_at index: (expires_at, row, active).
         self._exp = Columns(
@@ -173,15 +178,27 @@ class TpuStateMachine:
         self._expiry_rows: np.ndarray | None = None
         self._exp_dead = 0
 
+    @property
+    def _balances(self):
+        """Current device table handle behind a flush barrier."""
+        return self._dev.read()
+
+    @_balances.setter
+    def _balances(self, value) -> None:
+        self._dev.balances = value
+
+    def sync(self) -> None:
+        """Drain the write-behind queue and wait for the device."""
+        jax.block_until_ready(self._dev.read())
+
     # ------------------------------------------------------------------
     # Introspection helpers shared with CpuStateMachine.
 
     def _transfer_row(self, id_value: int) -> int | None:
-        key = pack_u128(
+        found, row = self._tdir.lookup(
             np.array([id_value & 0xFFFFFFFFFFFFFFFF], np.uint64),
             np.array([id_value >> 64], np.uint64),
         )
-        found, row = self._tdir.lookup(key)
         return int(row[0]) if found[0] else None
 
     def transfer_timestamp(self, id_value: int) -> int | None:
@@ -258,11 +275,10 @@ class TpuStateMachine:
     # Accounts (cold path: per-event, exact oracle semantics).
 
     def _account_slot(self, id_value: int) -> int | None:
-        key = pack_u128(
+        found, slot = self._acct_dir.lookup(
             np.array([id_value & 0xFFFFFFFFFFFFFFFF], np.uint64),
             np.array([id_value >> 64], np.uint64),
         )
-        found, slot = self._acct_dir.lookup(key)
         return int(slot[0]) if found[0] else None
 
     def _commit_create_accounts(self, timestamp: int, input_bytes: bytes) -> bytes:
@@ -298,11 +314,10 @@ class TpuStateMachine:
         def rollback_scope() -> None:
             if not scope_slots:
                 return
-            keys = pack_u128(
+            self._acct_dir.remove(
                 self._attrs["id_lo"][scope_slots],
                 self._attrs["id_hi"][scope_slots],
             )
-            self._acct_dir.remove(keys)
             self._attrs.truncate(min(scope_slots))
             scope_slots.clear()
 
@@ -350,10 +365,8 @@ class TpuStateMachine:
                         timestamp=np.array([timestamp - n + index + 1], np.uint64),
                     )
                     self._acct_dir.insert(
-                        pack_u128(
-                            np.array([row["id_lo"]], np.uint64),
-                            np.array([row["id_hi"]], np.uint64),
-                        ),
+                        np.array([row["id_lo"]], np.uint64),
+                        np.array([row["id_hi"]], np.uint64),
                         np.array([slot], np.uint64),
                     )
                     if chain is not None:
@@ -411,13 +424,13 @@ class TpuStateMachine:
         return CAR.ok
 
     def _ensure_balance_capacity(self, slots: int) -> None:
-        cap = self._balances.shape[0]
+        cap = self._dev.balances.shape[0]
         if slots <= cap:
             return
         while cap < slots:
             cap *= 2
-        extra = jnp.zeros((cap - self._balances.shape[0], 8), jnp.uint64)
-        self._balances = jnp.concatenate([self._balances, extra])
+        self._dev.grow(cap)
+        self._mirror.grow(cap)
 
     # ------------------------------------------------------------------
     # create_transfers (the hot path).
@@ -449,10 +462,8 @@ class TpuStateMachine:
         is_pv = (flags & (kernel.F_POST | kernel.F_VOID)) != 0
 
         # Account resolution (immutable within this batch).
-        dr_key = pack_u128(dr_lo, dr_hi)
-        cr_key = pack_u128(cr_lo, cr_hi)
-        dr_found, dr_slot_u = self._acct_dir.lookup(dr_key)
-        cr_found, cr_slot_u = self._acct_dir.lookup(cr_key)
+        dr_found, dr_slot_u = self._acct_dir.lookup(dr_lo, dr_hi)
+        cr_found, cr_slot_u = self._acct_dir.lookup(cr_lo, cr_hi)
         dr_slot = np.where(dr_found, dr_slot_u.astype(np.int64), -1).astype(np.int32)
         cr_slot = np.where(cr_found, cr_slot_u.astype(np.int64), -1).astype(np.int32)
         dr_flags = np.where(dr_found, self._attrs["flags"][np.clip(dr_slot, 0, None)], 0).astype(np.uint32)
@@ -520,7 +531,47 @@ class TpuStateMachine:
             CTR.transfer_must_have_the_same_ledger_as_accounts,
         )
 
-        # Id groups: one compact index per distinct id value.
+        # Durable joins (vectorized hash-index probes).
+        e_found, e_row = self._tdir.lookup(id_lo, id_hi)
+
+        # Fast-path routing (see kernel_fast.py preconditions): no
+        # order-dependent flags, no in-batch or durable id collisions,
+        # no limit/history accounts anywhere in the batch.
+        order_free = not (
+            flags
+            & np.uint32(
+                TF.linked
+                | TF.post_pending_transfer
+                | TF.void_pending_transfer
+                | TF.balancing_debit
+                | TF.balancing_credit
+            )
+        ).any()
+        # In-batch duplicate-id check via a 64-bit key mix: a hash
+        # collision only costs a detour through the exact scan path,
+        # which resolves true id groups.
+        id_mix = id_lo * np.uint64(0x9E3779B97F4A7C15) + id_hi * np.uint64(
+            0xC2B2AE3D27D4EB4F
+        )
+        if order_free and len(np.unique(id_mix)) == n and not e_found.any():
+            acct_flags = dr_flags | cr_flags
+            if not (
+                acct_flags
+                & np.uint32(
+                    AF.debits_must_not_exceed_credits
+                    | AF.credits_must_not_exceed_debits
+                    | AF.history
+                )
+            ).any():
+                reply = self._commit_fast(
+                    n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi,
+                    flags, timeout, dr_slot, cr_slot, amount_lo, amount_hi,
+                    ledger, code, static,
+                )
+                if reply is not None:
+                    return reply
+
+        # Exact-path id groups: one compact index per distinct id value.
         id_key = pack_u128(id_lo, id_hi)
         unique_ids, id_group = np.unique(id_key, return_inverse=True)
         pend_key = pack_u128(pend_lo, pend_hi)
@@ -530,10 +581,12 @@ class TpuStateMachine:
             is_pv & (unique_ids[pos_c] == pend_key), pos_c, -1
         ).astype(np.int32)
 
-        # Durable joins.
-        e_found, e_row = self._tdir.lookup(id_key)
-        p_found, p_row = self._tdir.lookup(pend_key)
-        p_found = p_found & is_pv
+        if is_pv.any():
+            p_found, p_row = self._tdir.lookup(pend_lo, pend_hi)
+            p_found = p_found & is_pv
+        else:
+            p_found = np.zeros(n, bool)
+            p_row = np.zeros(n, np.uint64)
         er = np.clip(e_row, 0, None).astype(np.int64)
         pr = np.clip(p_row, 0, None).astype(np.int64)
 
@@ -614,12 +667,29 @@ class TpuStateMachine:
         created = {f: np.asarray(out["created"][f])[:n] for f in kernel.CREATED_FIELDS}
         inb_status = np.asarray(out["inb_status"])[:n]
         dstat = np.asarray(out["dstat"])
+        hist_dr = np.asarray(out["hist_dr"])[:n]
+        hist_cr = np.asarray(out["hist_cr"])[:n]
+
+        # Mirror reconstruction: events whose effects persisted
+        # (results == 0; rollback rewrote failed-chain members) carry
+        # post-apply snapshots of both touched rows. Interleaved in
+        # event order, last write wins -> final balances of every
+        # touched slot (rolled-back-only slots net to no change).
+        ok_idx = np.flatnonzero(results == 0)
+        if len(ok_idx):
+            slots2 = np.empty(2 * len(ok_idx), np.int64)
+            slots2[0::2] = created["dr_slot"][ok_idx]
+            slots2[1::2] = created["cr_slot"][ok_idx]
+            rows2 = np.empty((2 * len(ok_idx), 8), np.uint64)
+            rows2[0::2] = hist_dr[ok_idx]
+            rows2[1::2] = hist_cr[ok_idx]
+            self._mirror.set_rows8(slots2, rows2)
 
         self._post_process_transfers(
-            n, ts_base, id_lo, id_hi, id_key, flags, timeout,
+            n, ts_base, id_lo, id_hi, flags, timeout,
             results, created_mask, created, inb_status,
             dstat_init, dstat, uniq_rows, p_found, p_row, p_group, id_group,
-            np.asarray(out["hist_dr"])[:n], np.asarray(out["hist_cr"])[:n],
+            hist_dr, hist_cr,
             int(out["last_applied"]),
             np.asarray(out["pulse_create"])[:n],
             np.asarray(out["pulse_remove"])[:n],
@@ -632,8 +702,90 @@ class TpuStateMachine:
         reply["result"] = results[fail_idx]
         return reply.tobytes()
 
+    def _commit_fast(
+        self, n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi,
+        flags, timeout, dr_slot, cr_slot, amount_lo, amount_hi, ledger, code,
+        static,
+    ) -> bytes | None:
+        """Parallel scatter-add apply for order-independent batches.
+
+        Returns None when a balance-overflow is possible, in which case
+        the caller re-runs the exact scan kernel (a later event may
+        legitimately apply after an earlier one fails with an overflow
+        code — reference: src/state_machine.zig:1531-1545).
+        """
+        # Remaining per-event codes are all order-independent here:
+        # timestamp_must_be_zero precedes the static ladder (reference:
+        # src/state_machine.zig:1251-1256), overflows_timeout depends
+        # only on the event's own timestamp.
+        results = np.where(
+            events["timestamp"] != 0,
+            np.uint32(CTR.timestamp_must_be_zero),
+            static,
+        )
+        ts_i = np.uint64(ts_base) + np.arange(n, dtype=np.uint64)
+        expires = ts_i + timeout * np.uint64(NS_PER_S)
+        ov_timeout = expires < ts_i
+        if ov_timeout.any():
+            # overflows_timeout ranks BELOW the balance-overflow codes
+            # (reference ladder: src/state_machine.zig:1531-1545), and
+            # such an event's amount wouldn't reach the mirror's
+            # monotone check — only the exact path ranks them right.
+            return None
+        apply_mask = results == 0
+        is_pending = (flags & np.uint32(TF.pending)) != 0
+
+        # Host-mirror admission (monotone-overflow check) + async
+        # device enqueue — the hot path never waits on the device.
+        deltas = self._mirror.try_apply_adds(
+            dr_slot.astype(np.int64), cr_slot.astype(np.int64),
+            amount_lo, amount_hi, is_pending, apply_mask,
+        )
+        if deltas is None:
+            return None
+        self._dev.enqueue(*deltas)
+
+        created = {
+            "flags": flags,
+            "dr_slot": dr_slot.astype(np.int32),
+            "cr_slot": cr_slot.astype(np.int32),
+            "amount_lo": amount_lo, "amount_hi": amount_hi,
+            "pending_lo": pend_lo, "pending_hi": pend_hi,
+            "ud128_lo": events["user_data_128_lo"].astype(np.uint64),
+            "ud128_hi": events["user_data_128_hi"].astype(np.uint64),
+            "ud64": events["user_data_64"].astype(np.uint64),
+            "ud32": events["user_data_32"].astype(np.uint32),
+            "timeout": timeout,
+            "ledger": ledger, "code": code,
+        }
+        inb_status = np.where(
+            apply_mask & is_pending, np.uint32(kernel.S_PENDING), np.uint32(0)
+        )
+        applied_idx = np.flatnonzero(apply_mask)
+        last_applied = int(applied_idx[-1]) if len(applied_idx) else -1
+        pulse_create = np.where(
+            apply_mask & is_pending & (timeout > 0), expires, np.uint64(0)
+        )
+
+        self._post_process_transfers(
+            n, ts_base, id_lo, id_hi, flags, timeout,
+            results, apply_mask, created, inb_status,
+            np.zeros(0, np.uint32), np.zeros(0, np.uint32),
+            np.zeros(0, np.int64),
+            np.zeros(n, bool), np.zeros(n, np.uint64), np.full(n, -1, np.int32),
+            np.zeros(n, np.int32),
+            np.zeros((n, 8), np.uint64), np.zeros((n, 8), np.uint64),
+            last_applied, pulse_create, np.zeros(n, np.uint64),
+        )
+
+        fail_idx = np.flatnonzero(results != 0)
+        reply = np.zeros(len(fail_idx), dtype=CREATE_RESULT_DTYPE)
+        reply["index"] = fail_idx.astype(np.uint32)
+        reply["result"] = results[fail_idx]
+        return reply.tobytes()
+
     def _post_process_transfers(
-        self, n, ts_base, id_lo, id_hi, id_key, flags, timeout,
+        self, n, ts_base, id_lo, id_hi, flags, timeout,
         results, created_mask, created, inb_status,
         dstat_init, dstat, uniq_rows, p_found, p_row, p_group, id_group,
         hist_dr, hist_cr, last_applied, pulse_create, pulse_remove,
@@ -659,7 +811,7 @@ class TpuStateMachine:
                 flags=flags[idx], timestamp=ts,
                 status=status,
             )
-            self._tdir.insert(id_key[idx], rows.astype(np.uint64))
+            self._tdir.insert(id_lo[idx], id_hi[idx], rows.astype(np.uint64))
             row_of_event = np.full(n, -1, np.int64)
             row_of_event[idx] = rows
         else:
@@ -793,29 +945,23 @@ class TpuStateMachine:
             return b""
 
         st = self._store
-        # Release pending amounts on device (sums are order-independent).
-        slots = np.concatenate([st["dr_slot"][rows], st["cr_slot"][rows]])
-        kinds = np.concatenate([np.zeros(len(rows), np.int8), np.ones(len(rows), np.int8)])
+        # Release pending amounts: dp -= amount on the debit side,
+        # cp -= amount on the credit side (sums are order-independent;
+        # reference: src/state_machine.zig:1874-1929). Mirror applies
+        # exactly; the device gets the same deltas as two's-complement
+        # modular adds through the write-behind queue.
+        slots = np.concatenate([st["dr_slot"][rows], st["cr_slot"][rows]]).astype(
+            np.int64
+        )
+        cols = np.concatenate(
+            [np.zeros(len(rows), np.int64), np.full(len(rows), 2, np.int64)]
+        )
         amt_lo = np.concatenate([st["amount_lo"][rows]] * 2)
         amt_hi = np.concatenate([st["amount_hi"][rows]] * 2)
-
-        balances = np.array(self._balances)  # writable host copy
-        for slot, kind, lo, hi in zip(slots, kinds, amt_lo, amt_hi):
-            row = balances[int(slot)]
-            amount = int(lo) | (int(hi) << 64)
-            if kind == 0:  # debit side: debits_pending -= amount
-                cur = int(row[0]) | (int(row[1]) << 64)
-                cur -= amount
-                assert cur >= 0
-                row[0] = cur & 0xFFFFFFFFFFFFFFFF
-                row[1] = cur >> 64
-            else:  # credit side: credits_pending -= amount
-                cur = int(row[4]) | (int(row[5]) << 64)
-                cur -= amount
-                assert cur >= 0
-                row[4] = cur & 0xFFFFFFFFFFFFFFFF
-                row[5] = cur >> 64
-        self._balances = jnp.asarray(balances)
+        self._mirror.apply_subs(slots, cols, amt_lo, amt_hi)
+        zero = np.zeros(len(slots), np.uint64)
+        neg_lo, neg_hi, _ = _sub_u128(zero, zero, amt_lo, amt_hi)
+        self._dev.enqueue(slots, cols, neg_lo, neg_hi)
 
         for row in rows:
             st["status"][int(row)] = TransferPendingStatus.expired
@@ -827,14 +973,15 @@ class TpuStateMachine:
 
     def _lookup_accounts(self, input_bytes: bytes) -> bytes:
         ids = np.frombuffer(input_bytes, dtype=types.U128_PAIR_DTYPE)
-        keys = pack_u128(ids["lo"].astype(np.uint64), ids["hi"].astype(np.uint64))
-        found, slots = self._acct_dir.lookup(keys)
+        found, slots = self._acct_dir.lookup(
+            ids["lo"].astype(np.uint64), ids["hi"].astype(np.uint64)
+        )
         hit = np.flatnonzero(found)
         out = np.zeros(len(hit), dtype=ACCOUNT_DTYPE)
         if len(hit) == 0:
             return b""
         slots = slots[hit].astype(np.int64)
-        balances = np.asarray(self._balances[jnp.asarray(slots)])
+        balances = self._mirror.rows8(slots)
         a = self._attrs
         out["id_lo"], out["id_hi"] = a["id_lo"][slots], a["id_hi"][slots]
         out["debits_pending_lo"], out["debits_pending_hi"] = balances[:, 0], balances[:, 1]
@@ -874,8 +1021,9 @@ class TpuStateMachine:
 
     def _lookup_transfers(self, input_bytes: bytes) -> bytes:
         ids = np.frombuffer(input_bytes, dtype=types.U128_PAIR_DTYPE)
-        keys = pack_u128(ids["lo"].astype(np.uint64), ids["hi"].astype(np.uint64))
-        found, rows = self._tdir.lookup(keys)
+        found, rows = self._tdir.lookup(
+            ids["lo"].astype(np.uint64), ids["hi"].astype(np.uint64)
+        )
         hit = rows[found].astype(np.int64)
         return self._transfer_rows_to_np(hit).tobytes()
 

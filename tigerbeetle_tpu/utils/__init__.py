@@ -1,0 +1,3 @@
+from tigerbeetle_tpu.utils.hashindex import HashIndex
+
+__all__ = ["HashIndex"]

@@ -1,0 +1,153 @@
+"""Vectorized open-addressing hash index: u128 key -> u64 value.
+
+The host-side id directories (account id -> slot, transfer id -> row;
+the reference's IdTree role, src/lsm/groove.zig:136-176) sit on the
+commit hot path with 3 batch lookups + 1 batch insert per commit.
+Sorted-run searches over 16-byte void keys are memcmp-bound; this
+table keeps keys as native uint64 limb pairs and does linear probing
+with whole-batch numpy steps — each probe round is a handful of SIMD
+ops over the still-unresolved lanes, and rounds shrink geometrically
+(load factor is capped at ~0.5).
+
+Deletions (create_accounts chain rollback only) leave tombstones:
+lookups probe through them, inserts do not reuse them (rare enough
+that reclaiming happens on the next growth rehash).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_M1 = np.uint64(0x9E3779B97F4A7C15)
+_M2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_M3 = np.uint64(0xFF51AFD7ED558CCD)
+
+
+class HashIndex:
+    def __init__(self, capacity: int = 1 << 16) -> None:
+        assert capacity & (capacity - 1) == 0
+        self._cap = capacity
+        self._mask = np.uint64(capacity - 1)
+        self.k_lo = np.zeros(capacity, np.uint64)
+        self.k_hi = np.zeros(capacity, np.uint64)
+        self.val = np.zeros(capacity, np.uint64)
+        self.used = np.zeros(capacity, bool)
+        self.dead = np.zeros(capacity, bool)
+        self.count = 0
+        self._tombstones = 0
+
+    def _hash(self, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+        h = lo * _M1 + hi * _M2
+        h ^= h >> np.uint64(33)
+        h *= _M3
+        h ^= h >> np.uint64(29)
+        return h & self._mask
+
+    def _grow(self, need: int) -> None:
+        while (self.count + self._tombstones + need) * 2 >= self._cap:
+            self._cap *= 2
+        live = np.flatnonzero(self.used & ~self.dead)
+        k_lo, k_hi, val = self.k_lo[live], self.k_hi[live], self.val[live]
+        self._mask = np.uint64(self._cap - 1)
+        self.k_lo = np.zeros(self._cap, np.uint64)
+        self.k_hi = np.zeros(self._cap, np.uint64)
+        self.val = np.zeros(self._cap, np.uint64)
+        self.used = np.zeros(self._cap, bool)
+        self.dead = np.zeros(self._cap, bool)
+        self.count = 0
+        self._tombstones = 0
+        self.insert(k_lo, k_hi, val)
+
+    def insert(self, lo: np.ndarray, hi: np.ndarray, values: np.ndarray) -> None:
+        """Batch insert; keys must be unique and not already present."""
+        n = len(lo)
+        if n == 0:
+            return
+        if (self.count + self._tombstones + n) * 2 >= self._cap:
+            self._grow(n)
+        lo = np.asarray(lo, np.uint64)
+        hi = np.asarray(hi, np.uint64)
+        values = np.asarray(values, np.uint64)
+        pos = self._hash(lo, hi)
+        pending = np.arange(n)
+        one = np.uint64(1)
+        while len(pending):
+            p = pos[pending]
+            occ = self.used[p]
+            free = pending[~occ]
+            if len(free):
+                fp = pos[free]
+                uniq, first = np.unique(fp, return_index=True)
+                winners = free[first]
+                wp = fp[first]
+                self.used[wp] = True
+                self.k_lo[wp] = lo[winners]
+                self.k_hi[wp] = hi[winners]
+                self.val[wp] = values[winners]
+                placed = np.zeros(len(free), bool)
+                placed[first] = True
+                losers = free[~placed]
+            else:
+                losers = free
+            stepped = np.concatenate([pending[occ], losers])
+            pos[stepped] = (pos[stepped] + one) & self._mask
+            pending = stepped
+        self.count += n
+
+    def lookup(self, lo: np.ndarray, hi: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Batch get -> (found bool array, values uint64)."""
+        n = len(lo)
+        found = np.zeros(n, bool)
+        values = np.zeros(n, np.uint64)
+        if n == 0 or self.count == 0:
+            return found, values
+        lo = np.asarray(lo, np.uint64)
+        hi = np.asarray(hi, np.uint64)
+        pos = self._hash(lo, hi)
+        active = np.arange(n)
+        one = np.uint64(1)
+        while len(active):
+            p = pos[active]
+            occ = self.used[p]
+            match = (
+                occ
+                & ~self.dead[p]
+                & (self.k_lo[p] == lo[active])
+                & (self.k_hi[p] == hi[active])
+            )
+            hit = active[match]
+            found[hit] = True
+            values[hit] = self.val[p[match]]
+            cont = occ & ~match
+            active = active[cont]
+            pos[active] = (pos[active] + one) & self._mask
+        return found, values
+
+    def remove(self, lo: np.ndarray, hi: np.ndarray) -> None:
+        """Tombstone existing keys (chain-rollback un-create)."""
+        n = len(lo)
+        if n == 0:
+            return
+        lo = np.asarray(lo, np.uint64)
+        hi = np.asarray(hi, np.uint64)
+        pos = self._hash(lo, hi)
+        active = np.arange(n)
+        one = np.uint64(1)
+        removed = 0
+        while len(active):
+            p = pos[active]
+            occ = self.used[p]
+            match = (
+                occ
+                & ~self.dead[p]
+                & (self.k_lo[p] == lo[active])
+                & (self.k_hi[p] == hi[active])
+            )
+            mp = p[match]
+            self.dead[mp] = True
+            removed += len(mp)
+            cont = occ & ~match
+            active = active[cont]
+            pos[active] = (pos[active] + one) & self._mask
+        self.count -= removed
+        self._tombstones += removed
