@@ -81,3 +81,27 @@ def minimum(a: U128, b: U128) -> U128:
 def select(pred, a: U128, b: U128) -> U128:
     """where(pred, a, b) elementwise on limb pairs."""
     return (jnp.where(pred, a[0], b[0]), jnp.where(pred, a[1], b[1]))
+
+
+# ----------------------------------------------------------------------
+# 32-bit limb lanes for wrap-free scatter accumulation: a u128 delta is
+# spread over four uint64 lanes each holding a 32-bit limb, so summing
+# up to 2^32 deltas cannot wrap a lane; one carry pass recombines.
+
+_MASK32 = jnp.uint64(0xFFFFFFFF)
+
+
+def limbs32(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """(K,) u128 limb pair -> (K, 4) little-endian 32-bit limbs."""
+    return jnp.stack([lo & _MASK32, lo >> 32, hi & _MASK32, hi >> 32], axis=-1)
+
+
+def from_limbs32(acc: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(..., 4) limb sums -> (lo, hi, carry_out); sum taken mod 2^128."""
+    c0 = acc[..., 0]
+    c1 = acc[..., 1] + (c0 >> 32)
+    c2 = acc[..., 2] + (c1 >> 32)
+    c3 = acc[..., 3] + (c2 >> 32)
+    lo = (c0 & _MASK32) | ((c1 & _MASK32) << 32)
+    hi = (c2 & _MASK32) | ((c3 & _MASK32) << 32)
+    return lo, hi, c3 >> 32

@@ -1,0 +1,4 @@
+from tigerbeetle_tpu.parallel.sharded import (  # noqa: F401
+    build_apply_step,
+    make_mesh,
+)

@@ -1,0 +1,199 @@
+"""Multi-chip sharded balance apply (SPMD over a jax.sharding.Mesh).
+
+The reference's replication exists for fault tolerance, not throughput
+— commit execution is single-core by design (reference:
+docs/about/performance.md:66-78).  The TPU build keeps those commit
+semantics but adds a genuinely parallel device path for the subset
+that dominates real workloads: order-free `create_transfers` batches
+(the same admission conditions as the single-chip fast path, see
+tpu.py `_commit_fast`).
+
+Sharding design (scaling-book style — pick a mesh, annotate, let XLA
+insert collectives):
+
+- Mesh axes ``("dp", "shard")``.
+- The account-balance table — the only mutable device state
+  (reference: src/tigerbeetle.zig:7-29) — is sharded **row-wise over
+  "shard"** and replicated over "dp".  This is the tensor-parallel
+  analog: state partitioning.
+- Event batches are sharded **over "dp"**: each dp group ingests a
+  slice of the batch.  This is the data-parallel analog.
+
+One step, inside `shard_map`:
+
+1. every (dp, shard) device accumulates candidate u128 deltas from its
+   local event slice onto the rows it owns (32-bit limb lanes so sums
+   cannot wrap — same trick as kernel_fast.py);
+2. ``psum`` over **dp** combines the whole batch's deltas per row;
+3. per-row overflow predicates are computed locally, folded back to
+   per-event reject masks, and ``psum``-ed over **shard** so every
+   device agrees on admission (conservative row-granularity check:
+   a row that would overflow rejects all events touching it, which the
+   host then routes through the exact single-chip scan kernel —
+   mirroring the mirror-admission fallback in tpu.py);
+4. admitted deltas are re-accumulated and applied to the local rows.
+   Removing events only shrinks row sums, so admitted sums cannot
+   overflow.
+
+Collectives (all_gather-and-sum over "dp", all_gather-any over
+"shard") ride ICI; no host round-trips inside the step.  u64
+all-reduce doesn't lower on TPU, so exact sums are done locally after
+gathering.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tigerbeetle_tpu.ops import u128 as w
+
+try:  # jax>=0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+# Delta-column layout: 4 u128 columns per account row.
+# debits_pending, debits_posted, credits_pending, credits_posted.
+COL_DP, COL_DPO, COL_CP, COL_CPO = range(4)
+
+
+def make_mesh(devices=None, dp: int | None = None) -> Mesh:
+    """Mesh over `devices` shaped (dp, shard).
+
+    Defaults: dp=2 when the device count allows (so both axes are
+    exercised), else a pure "shard" mesh.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None:
+        dp = 2 if n % 2 == 0 and n >= 4 else 1
+    assert n % dp == 0, (n, dp)
+    grid = np.asarray(devices).reshape(dp, n // dp)
+    return Mesh(grid, ("dp", "shard"))
+
+
+def _accumulate(local_rows, row0, dr_slot, cr_slot, amount_lo, amount_hi,
+                is_pending, mask):
+    """Masked local-row limb accumulation of one event slice.
+
+    Returns (local_rows, 4, 4) uint64 limb sums.  Non-local or masked
+    events contribute zero (amounts zeroed, row clipped).
+    """
+    acc = jnp.zeros((local_rows, 4, 4), jnp.uint64)
+    for slot, col_p, col_posted in (
+        (dr_slot, COL_DP, COL_DPO),
+        (cr_slot, COL_CP, COL_CPO),
+    ):
+        local = mask & (slot >= row0) & (slot < row0 + local_rows)
+        row = jnp.clip(slot - row0, 0, local_rows - 1)
+        col = jnp.where(is_pending, col_p, col_posted)
+        lo = jnp.where(local, amount_lo, 0)
+        hi = jnp.where(local, amount_hi, 0)
+        acc = acc.at[row, col].add(w.limbs32(lo, hi))
+    return acc
+
+
+def build_apply_step(mesh: Mesh, table_rows: int):
+    """Jitted sharded apply: (balances, events...) -> (balances, admitted).
+
+    `balances` is (table_rows, 8) uint64 sharded P("shard", None);
+    event arrays are (E,) sharded P("dp").  Returns the updated table
+    (same sharding) and the per-event admitted mask (dp-sharded).
+    """
+    n_shard = mesh.shape["shard"]
+    assert table_rows % n_shard == 0, (table_rows, n_shard)
+    local_rows = table_rows // n_shard
+
+    def local_step(balances, dr_slot, cr_slot, amount_lo, amount_hi, is_pending):
+        shard_id = lax.axis_index("shard")
+        row0 = (shard_id * local_rows).astype(dr_slot.dtype)
+        ones = jnp.ones_like(dr_slot, bool)
+
+        # 1-2. Candidate deltas for local rows, combined across dp.
+        # u64 all-reduce doesn't lower on TPU, so combine as
+        # all_gather (pure data movement over ICI) + exact local sum.
+        def combine_dp(acc):
+            return lax.all_gather(acc, "dp").sum(axis=0)
+
+        acc = _accumulate(
+            local_rows, row0, dr_slot, cr_slot, amount_lo, amount_hi,
+            is_pending, ones,
+        )
+        acc = combine_dp(acc)
+        d_lo, d_hi, d_carry = w.from_limbs32(acc)  # (local_rows, 4)
+
+        # 3. Per-row overflow -> per-event reject, agreed across shards.
+        old_lo = balances[:, 0::2]
+        old_hi = balances[:, 1::2]
+        (_, _), carry = w.add((old_lo, old_hi), (d_lo, d_hi))
+        row_over = (carry | (d_carry != 0)).any(axis=1)  # (local_rows,)
+
+        reject = jnp.zeros_like(dr_slot, bool)
+        for slot in (dr_slot, cr_slot):
+            local = (slot >= row0) & (slot < row0 + local_rows)
+            row = jnp.clip(slot - row0, 0, local_rows - 1)
+            reject |= local & row_over[row]
+        reject = lax.all_gather(reject, "shard").any(axis=0)
+        # Out-of-range slots belong to no shard: their deltas were
+        # dropped above, so they must never read as admitted.
+        for slot in (dr_slot, cr_slot):
+            reject |= (slot < 0) | (slot >= n_shard * local_rows)
+        admitted = ~reject
+
+        # 4. Apply admitted deltas (monotone: subset sums cannot overflow).
+        acc = _accumulate(
+            local_rows, row0, dr_slot, cr_slot, amount_lo, amount_hi,
+            is_pending, admitted,
+        )
+        acc = combine_dp(acc)
+        a_lo, a_hi, _ = w.from_limbs32(acc)
+        (new_lo, new_hi), _ = w.add((old_lo, old_hi), (a_lo, a_hi))
+        new_balances = jnp.stack(
+            [new_lo[:, 0], new_hi[:, 0], new_lo[:, 1], new_hi[:, 1],
+             new_lo[:, 2], new_hi[:, 2], new_lo[:, 3], new_hi[:, 3]],
+            axis=-1,
+        )
+        return new_balances, admitted
+
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    check_kw = (
+        {"check_vma": False} if "check_vma" in params
+        else {"check_rep": False} if "check_rep" in params
+        else {}
+    )
+    step = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P("shard", None), P("dp"), P("dp"), P("dp"), P("dp"), P("dp")),
+        out_specs=(P("shard", None), P("dp")),
+        **check_kw,
+    )
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def shard_balances(mesh: Mesh, balances: np.ndarray):
+    """Place a host balance table onto the mesh with the step's sharding."""
+    return jax.device_put(
+        jnp.asarray(balances), NamedSharding(mesh, P("shard", None))
+    )
+
+
+def shard_events(mesh: Mesh, *arrays):
+    dp = mesh.shape["dp"]
+    for a in arrays:
+        assert len(a) % dp == 0, (len(a), dp)
+    sharding = NamedSharding(mesh, P("dp"))
+    return tuple(jax.device_put(jnp.asarray(a), sharding) for a in arrays)

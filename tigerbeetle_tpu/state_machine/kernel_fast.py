@@ -27,8 +27,6 @@ import jax.numpy as jnp
 
 from tigerbeetle_tpu.ops import u128 as w
 
-_MASK32 = jnp.uint64(0xFFFFFFFF)
-
 # Flush shape buckets: only a few shapes ever compile.
 _FLUSH_BUCKETS = (4096, 32768, 131072, 524288)
 # Queue high-water mark: flush (async) once this many entries queue up.
@@ -38,32 +36,16 @@ _FLUSH_BUCKETS = (4096, 32768, 131072, 524288)
 FLUSH_THRESHOLD = 500_000
 
 
-def _limbs(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
-    """(K,) u128 limb pair -> (K, 4) little-endian 32-bit limbs."""
-    return jnp.stack([lo & _MASK32, lo >> 32, hi & _MASK32, hi >> 32], axis=-1)
-
-
-def _normalize_mod(acc: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """(..., 4) limb sums -> (lo, hi) mod 2^128 (carry-out dropped)."""
-    c0 = acc[..., 0]
-    c1 = acc[..., 1] + (c0 >> 32)
-    c2 = acc[..., 2] + (c1 >> 32)
-    c3 = acc[..., 3] + (c2 >> 32)
-    lo = (c0 & _MASK32) | ((c1 & _MASK32) << 32)
-    hi = (c2 & _MASK32) | ((c3 & _MASK32) << 32)
-    return lo, hi
-
-
 def _flush_impl(balances, slots, cols, add_lo, add_hi):
     """balances[slot, col] += delta (mod 2^128), fused over K entries.
 
     Padding entries use slot 0 / col 0 / amount 0 (a no-op add).
     """
     A = balances.shape[0]
-    limbs = _limbs(add_lo, add_hi)
+    limbs = w.limbs32(add_lo, add_hi)
     acc = jnp.zeros((A, 4, 4), jnp.uint64)
     acc = acc.at[jnp.clip(slots, 0, A - 1), cols].add(limbs)
-    d_lo, d_hi = _normalize_mod(acc)  # (A, 4)
+    d_lo, d_hi, _ = w.from_limbs32(acc)  # (A, 4); mod 2^128 by design
 
     old_lo = balances[:, 0::2]
     old_hi = balances[:, 1::2]
