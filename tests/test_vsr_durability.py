@@ -1,0 +1,307 @@
+"""Durability: wire headers, journal recovery, superblock quorum,
+single-replica crash/restart round-trips."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.testing.harness import account, ids_bytes, pack, transfer
+from tigerbeetle_tpu.vsr import replica as vsr_replica
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.journal import Journal
+from tigerbeetle_tpu.vsr.storage import FileStorage, MemoryStorage, ZoneLayout
+from tigerbeetle_tpu.vsr.superblock import SuperBlock
+
+CLUSTER = 7
+
+
+def layout():
+    return ZoneLayout(config=cfg.TEST_MIN, grid_size=1 << 20)
+
+
+def fresh_replica(storage=None, sm=None):
+    storage = storage or MemoryStorage(layout())
+    vsr_replica.format(storage, CLUSTER)
+    r = vsr_replica.Replica(storage, CLUSTER, sm or CpuStateMachine(cfg.TEST_MIN))
+    r.open()
+    return storage, r
+
+
+def reopen(storage):
+    r = vsr_replica.Replica(storage, CLUSTER, CpuStateMachine(cfg.TEST_MIN))
+    r.open()
+    return r
+
+
+# ----------------------------------------------------------------------
+# Wire.
+
+
+def test_header_roundtrip_and_checksum():
+    h = wire.make_header(
+        command=wire.Command.prepare, operation=types.Operation.create_transfers,
+        cluster=CLUSTER, op=3, timestamp=99, parent=(1 << 100) + 5,
+    )
+    body = b"x" * 128
+    wire.finalize_header(h, body)
+    assert wire.verify_header(h, body)
+    h2 = wire.header_from_bytes(h.tobytes())
+    assert wire.verify_header(h2, body)
+    assert wire.u128(h2, "parent") == (1 << 100) + 5
+    # Any flipped byte must fail verification.
+    raw = bytearray(h.tobytes())
+    raw[40] ^= 0xFF
+    assert not wire.verify_header(wire.header_from_bytes(bytes(raw)), body)
+    assert not wire.verify_header(h, body + b"y")
+
+
+def test_root_prepare_deterministic():
+    a = vsr_replica.wire.root_prepare(5)
+    b = vsr_replica.wire.root_prepare(5)
+    assert a.tobytes() == b.tobytes()
+    assert a["op"] == 0 and wire.verify_header(a, b"")
+
+
+# ----------------------------------------------------------------------
+# Journal.
+
+
+def make_prepare(op, parent, body=b"", timestamp=None):
+    h = wire.make_header(
+        command=wire.Command.prepare, operation=types.Operation.create_accounts,
+        cluster=CLUSTER, op=op, timestamp=timestamp or op * 10, parent=parent,
+    )
+    return wire.finalize_header(h, body)
+
+
+def test_journal_write_read_recover():
+    storage = MemoryStorage(layout())
+    j = Journal(storage, CLUSTER)
+    root = wire.root_prepare(CLUSTER)
+    j.write_prepare(root, b"")
+    parent = wire.u128(root, "checksum")
+    for op in range(1, 6):
+        h = make_prepare(op, parent, body=bytes([op]) * 100)
+        j.write_prepare(h, bytes([op]) * 100)
+        parent = wire.u128(h, "checksum")
+
+    j2 = Journal(storage, CLUSTER)
+    rec = j2.recover(commit_min=0)
+    assert rec.op_head == 5
+    assert not rec.faulty_ops and not rec.truncated_ops
+    h, body = j2.read_prepare(3)
+    assert body == b"\x03" * 100
+
+
+def test_journal_torn_head_truncated():
+    storage = MemoryStorage(layout())
+    j = Journal(storage, CLUSTER)
+    root = wire.root_prepare(CLUSTER)
+    j.write_prepare(root, b"")
+    parent = wire.u128(root, "checksum")
+    for op in range(1, 4):
+        h = make_prepare(op, parent)
+        j.write_prepare(h, b"", sync=(op < 3))
+        parent = wire.u128(h, "checksum")
+    storage.crash()  # op 3 unsynced: prepare+header sectors revert
+
+    rec = Journal(storage, CLUSTER).recover(commit_min=0)
+    assert rec.op_head == 2
+    assert rec.faulty_ops == []
+
+
+def test_journal_corrupt_prepare_below_head_is_faulty():
+    storage = MemoryStorage(layout())
+    j = Journal(storage, CLUSTER)
+    root = wire.root_prepare(CLUSTER)
+    j.write_prepare(root, b"")
+    parent = wire.u128(root, "checksum")
+    for op in range(1, 5):
+        h = make_prepare(op, parent)
+        j.write_prepare(h, b"")
+        parent = wire.u128(h, "checksum")
+    storage.corrupt_sector(storage.layout.prepare_slot_offset(2))
+
+    rec = Journal(storage, CLUSTER).recover(commit_min=0)
+    assert rec.faulty_ops == [2]
+    assert rec.op_head == 4
+
+
+def test_journal_ring_wrap():
+    slots = cfg.TEST_MIN.journal_slot_count
+    storage = MemoryStorage(layout())
+    j = Journal(storage, CLUSTER)
+    root = wire.root_prepare(CLUSTER)
+    j.write_prepare(root, b"")
+    parent = wire.u128(root, "checksum")
+    last = slots + 10
+    for op in range(1, last + 1):
+        h = make_prepare(op, parent)
+        j.write_prepare(h, b"")
+        parent = wire.u128(h, "checksum")
+
+    rec = Journal(storage, CLUSTER).recover(commit_min=last - 5)
+    assert rec.op_head == last
+
+
+# ----------------------------------------------------------------------
+# SuperBlock.
+
+
+def test_superblock_quorum_and_sequence():
+    storage = MemoryStorage(layout())
+    sb = SuperBlock(storage, CLUSTER)
+    sb.format(replica=0, replica_count=1)
+    sb.checkpoint(
+        commit_min=24, commit_min_checksum=123, commit_max=24,
+        checkpoint_offset=storage.layout.grid_offset, checkpoint_size=100,
+        checkpoint_checksum=9,
+    )
+
+    sb2 = SuperBlock(storage, CLUSTER)
+    h = sb2.open()
+    assert int(h["sequence"]) == 2
+    assert int(h["commit_min"]) == 24
+
+    # Corrupt two of four copies: quorum (2) still holds.
+    storage.corrupt_sector(0)
+    storage.corrupt_sector(4096)
+    assert int(SuperBlock(storage, CLUSTER).open()["sequence"]) == 2
+
+    # Three corrupt: no quorum.
+    storage.corrupt_sector(2 * 4096)
+    with pytest.raises(RuntimeError, match="no quorum"):
+        SuperBlock(storage, CLUSTER).open()
+
+
+# ----------------------------------------------------------------------
+# Replica end-to-end.
+
+
+def test_replica_basic_and_restart_replay():
+    storage, r = fresh_replica()
+    reply = r.on_request(types.Operation.create_accounts,
+                         pack([account(1), account(2)]))
+    assert reply == b""
+    reply = r.on_request(
+        types.Operation.create_transfers,
+        pack([transfer(10, debit_account_id=1, credit_account_id=2, amount=100)]),
+    )
+    assert reply == b""
+
+    # Restart from a fresh state machine: WAL replay must rebuild state.
+    r2 = reopen(storage)
+    assert r2.op == r.op
+    out = r2.on_request(types.Operation.lookup_accounts, ids_bytes([1, 2]))
+    rows = np.frombuffer(out, types.ACCOUNT_DTYPE)
+    assert types.u128_get(rows[0], "debits_posted") == 100
+    assert types.u128_get(rows[1], "credits_posted") == 100
+
+
+def test_replica_crash_loses_unsynced_tail_only():
+    storage, r = fresh_replica()
+    r.on_request(types.Operation.create_accounts, pack([account(1), account(2)]))
+    r.on_request(
+        types.Operation.create_transfers,
+        pack([transfer(10, debit_account_id=1, credit_account_id=2, amount=7)]),
+    )
+    storage.crash()  # everything synced: no loss
+
+    r2 = reopen(storage)
+    out = r2.on_request(types.Operation.lookup_accounts, ids_bytes([1]))
+    assert types.u128_get(np.frombuffer(out, types.ACCOUNT_DTYPE)[0],
+                          "debits_posted") == 7
+
+
+def test_replica_checkpoint_and_wal_wrap():
+    storage, r = fresh_replica()
+    r.on_request(types.Operation.create_accounts, pack([account(1), account(2)]))
+    # Push ops past several checkpoint intervals + full ring wraps.
+    n_ops = cfg.TEST_MIN.journal_slot_count * 3
+    for i in range(n_ops):
+        r.on_request(
+            types.Operation.create_transfers,
+            pack([transfer(100 + i, debit_account_id=1, credit_account_id=2,
+                           amount=1)]),
+        )
+    assert r.checkpoint_op > 0
+
+    r2 = reopen(storage)
+    assert r2.commit_min == r.commit_min
+    out = r2.on_request(types.Operation.lookup_accounts, ids_bytes([1]))
+    assert types.u128_get(np.frombuffer(out, types.ACCOUNT_DTYPE)[0],
+                          "debits_posted") == n_ops
+
+
+def test_replica_dedupe_replays_reply():
+    storage, r = fresh_replica()
+    r.register_client(42)
+    b1 = r.on_request(types.Operation.create_accounts, pack([account(1)]),
+                      client=42, request=1)
+    assert b1 == b""
+    # Same request again: no re-execution (account already exists would
+    # return `exists`, so identical empty reply proves dedupe).
+    b2 = r.on_request(types.Operation.create_accounts, pack([account(1)]),
+                      client=42, request=1)
+    assert b2 == b""
+    # New request number does execute (and reports exists).
+    b3 = r.on_request(types.Operation.create_accounts, pack([account(1)]),
+                      client=42, request=2)
+    arr = np.frombuffer(b3, types.CREATE_RESULT_DTYPE)
+    assert types.CreateAccountResult(int(arr[0]["result"])).name == "exists"
+
+
+def test_replica_two_phase_expiry_survives_restart(tmp_path):
+    path = str(tmp_path / "data.tb")
+    storage = FileStorage(path, layout(), create=True)
+    vsr_replica.format(storage, CLUSTER)
+    r = vsr_replica.Replica(storage, CLUSTER, CpuStateMachine(cfg.TEST_MIN))
+    r.open()
+    r.on_request(types.Operation.create_accounts, pack([account(1), account(2)]))
+    r.on_request(
+        types.Operation.create_transfers,
+        pack([transfer(10, debit_account_id=1, credit_account_id=2, amount=50,
+                       timeout=1, flags=types.TransferFlags.pending)]),
+    )
+    storage.close()
+
+    storage = FileStorage(path, layout())
+    r2 = vsr_replica.Replica(storage, CLUSTER, CpuStateMachine(cfg.TEST_MIN))
+    r2.open()
+    # Advance realtime past expiry: pulse fires, pending releases.
+    out = r2.on_request(types.Operation.lookup_accounts, ids_bytes([1]),
+                        realtime=10 * types.NS_PER_S)
+    row = np.frombuffer(out, types.ACCOUNT_DTYPE)[0]
+    assert types.u128_get(row, "debits_pending") == 0
+    ts = r2.sm.transfer_timestamp(10)
+    assert r2.sm.pending_status(10) == types.TransferPendingStatus.expired
+    assert ts is not None
+    storage.close()
+
+
+def test_replica_tpu_state_machine_checkpoint_restart():
+    from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+
+    storage = MemoryStorage(layout())
+    vsr_replica.format(storage, CLUSTER)
+    r = vsr_replica.Replica(storage, CLUSTER, TpuStateMachine(cfg.TEST_MIN))
+    r.open()
+    r.on_request(types.Operation.create_accounts, pack([account(1), account(2)]))
+    n_ops = cfg.TEST_MIN.vsr_checkpoint_interval + 5  # cross one checkpoint
+    for i in range(n_ops):
+        r.on_request(
+            types.Operation.create_transfers,
+            pack([transfer(100 + i, debit_account_id=1, credit_account_id=2,
+                           amount=2)]),
+        )
+    assert r.checkpoint_op > 0
+
+    r2 = vsr_replica.Replica(storage, CLUSTER, TpuStateMachine(cfg.TEST_MIN))
+    r2.open()
+    assert r2.commit_min == r.commit_min
+    out = r2.on_request(types.Operation.lookup_accounts, ids_bytes([1, 2]))
+    rows = np.frombuffer(out, types.ACCOUNT_DTYPE)
+    assert types.u128_get(rows[0], "debits_posted") == 2 * n_ops
+    assert types.u128_get(rows[1], "credits_posted") == 2 * n_ops

@@ -1124,3 +1124,80 @@ def _pad(arr: np.ndarray, size: int) -> np.ndarray:
     out = np.zeros(size, arr.dtype)
     out[:n] = arr
     return out
+
+
+# ----------------------------------------------------------------------
+# Checkpoint snapshot (consumed by vsr.checkpointing).
+
+def _tpu_snapshot(self) -> bytes:
+    """Serialize durable state: columnar stores + the balance mirror
+    (which exactly equals the device table after a queue drain —
+    kernel_fast.py write-behind contract)."""
+    import pickle
+
+    self._dev.flush()  # queue drained; mirror == device content
+    count = self._attrs.count
+    state = {
+        "scalars": (
+            self.prepare_timestamp, self.commit_timestamp,
+            self.pulse_next_timestamp, self._exp_dead,
+        ),
+        "attrs": {k: self._attrs.col(k).copy() for k in _ATTR_FIELDS},
+        "store": {k: self._store.col(k).copy() for k in _STORE_FIELDS},
+        "exp": {
+            k: self._exp.col(k).copy() for k in ("expires_at", "row", "active")
+        },
+        "history": {k: self._history.col(k).copy() for k in _HISTORY_FIELDS},
+        "mirror_lo": self._mirror.lo[:count].copy(),
+        "mirror_hi": self._mirror.hi[:count].copy(),
+    }
+    return pickle.dumps(state, protocol=5)
+
+
+def _tpu_restore(self, data: bytes) -> None:
+    import jax.numpy as jnp
+    import pickle
+
+    state = pickle.loads(data)
+    (
+        self.prepare_timestamp, self.commit_timestamp,
+        self.pulse_next_timestamp, self._exp_dead,
+    ) = state["scalars"]
+
+    self._attrs = Columns(_ATTR_FIELDS)
+    self._attrs.append(**state["attrs"])
+    self._store = Columns(_STORE_FIELDS)
+    self._store.append(**state["store"])
+    self._exp = Columns(
+        {"expires_at": np.uint64, "row": np.uint32, "active": np.bool_}
+    )
+    self._exp.append(**state["exp"])
+    self._history = Columns(_HISTORY_FIELDS)
+    self._history.append(**state["history"])
+
+    # Rebuild directories (derived state, never serialized).
+    self._acct_dir = HashIndex()
+    n_acct = self._attrs.count
+    self._acct_dir.insert(
+        self._attrs.col("id_lo"), self._attrs.col("id_hi"),
+        np.arange(n_acct, dtype=np.uint64),
+    )
+    self._tdir = HashIndex()
+    self._tdir.insert(
+        self._store.col("id_lo"), self._store.col("id_hi"),
+        np.arange(self._store.count, dtype=np.uint64),
+    )
+
+    cap = max(1 << 12, 1 << (n_acct - 1).bit_length() if n_acct else 1)
+    self._mirror = BalanceMirror(cap)
+    self._mirror.lo[:n_acct] = state["mirror_lo"]
+    self._mirror.hi[:n_acct] = state["mirror_hi"]
+    self._dev = kernel_fast.DeviceTable(cap)
+    self._dev.balances = jnp.asarray(
+        self._mirror.rows8(np.arange(cap, dtype=np.int64))
+    )
+    self._expiry_rows = None
+
+
+TpuStateMachine.snapshot = _tpu_snapshot
+TpuStateMachine.restore = _tpu_restore

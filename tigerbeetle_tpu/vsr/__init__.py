@@ -1,0 +1,11 @@
+from tigerbeetle_tpu.vsr.wire import (  # noqa: F401
+    HEADER_DTYPE,
+    Command,
+    VsrOperation,
+    checksum,
+    finalize_header,
+    header_from_bytes,
+    make_header,
+    root_prepare,
+    verify_header,
+)

@@ -1,0 +1,164 @@
+"""SuperBlock: 4-copy quorum-written root of persistent state.
+
+Keeps the reference's protocol (reference: src/vsr/superblock.zig:1-56,
+superblock_quorums.zig): the superblock is written as 4 identical
+copies; opening requires a quorum (2 of 4) of valid copies agreeing on
+the highest sequence, so a crash mid-update can never lose both the
+old and the new state.
+
+State tracked (ours — the checkpoint reference is a grid-zone snapshot
+blob instead of an LSM manifest):
+- VSR state: view / log_view / commit_min / commit_max,
+- checkpoint: op (`commit_min`), checksum of the prepare at that op,
+  and the (offset, size, checksum) of the state snapshot in the grid
+  zone (double-buffered A/B regions so a torn snapshot write leaves
+  the previous checkpoint intact).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.storage import (
+    SUPERBLOCK_COPIES,
+    SUPERBLOCK_COPY_SIZE,
+    Storage,
+)
+
+SUPERBLOCK_DTYPE = np.dtype(
+    [
+        ("checksum_lo", "<u8"), ("checksum_hi", "<u8"),
+        ("cluster_lo", "<u8"), ("cluster_hi", "<u8"),
+        ("sequence", "<u8"),
+        ("replica", "<u2"), ("replica_count", "<u2"),
+        ("view", "<u4"), ("log_view", "<u4"),
+        ("version", "<u4"),
+        ("commit_min", "<u8"),
+        ("commit_max", "<u8"),
+        ("commit_min_checksum_lo", "<u8"), ("commit_min_checksum_hi", "<u8"),
+        ("checkpoint_offset", "<u8"),
+        ("checkpoint_size", "<u8"),
+        ("checkpoint_checksum_lo", "<u8"), ("checkpoint_checksum_hi", "<u8"),
+        ("reserved", f"V{SUPERBLOCK_COPY_SIZE - 120}"),
+    ]
+)
+assert SUPERBLOCK_DTYPE.itemsize == SUPERBLOCK_COPY_SIZE
+
+QUORUM_OPEN = 2  # of SUPERBLOCK_COPIES
+
+
+class SuperBlock:
+    def __init__(self, storage: Storage, cluster: int) -> None:
+        self.storage = storage
+        self.cluster = cluster
+        self.working = np.zeros(1, SUPERBLOCK_DTYPE)[0]
+
+    # ------------------------------------------------------------------
+
+    def format(self, replica: int, replica_count: int) -> None:
+        h = np.zeros(1, SUPERBLOCK_DTYPE)[0]
+        h["cluster_lo"] = self.cluster & 0xFFFFFFFFFFFFFFFF
+        h["cluster_hi"] = self.cluster >> 64
+        h["sequence"] = 1
+        h["replica"] = replica
+        h["replica_count"] = replica_count
+        h["version"] = wire.VERSION
+        h["commit_min"] = 0
+        h["commit_max"] = 0
+        root = wire.root_prepare(self.cluster)
+        h["commit_min_checksum_lo"] = root["checksum_lo"]
+        h["commit_min_checksum_hi"] = root["checksum_hi"]
+        self._write(h)
+
+    def checkpoint(
+        self,
+        commit_min: int,
+        commit_min_checksum: int,
+        commit_max: int,
+        checkpoint_offset: int,
+        checkpoint_size: int,
+        checkpoint_checksum: int,
+        view: int | None = None,
+        log_view: int | None = None,
+    ) -> None:
+        """Durably advance to a new checkpoint (snapshot must already
+        be synced in the grid zone — write ordering is the caller's
+        contract)."""
+        h = self.working.copy()
+        h["sequence"] = int(h["sequence"]) + 1
+        h["commit_min"] = commit_min
+        h["commit_max"] = commit_max
+        h["commit_min_checksum_lo"] = commit_min_checksum & 0xFFFFFFFFFFFFFFFF
+        h["commit_min_checksum_hi"] = commit_min_checksum >> 64
+        h["checkpoint_offset"] = checkpoint_offset
+        h["checkpoint_size"] = checkpoint_size
+        h["checkpoint_checksum_lo"] = checkpoint_checksum & 0xFFFFFFFFFFFFFFFF
+        h["checkpoint_checksum_hi"] = checkpoint_checksum >> 64
+        if view is not None:
+            h["view"] = view
+        if log_view is not None:
+            h["log_view"] = log_view
+        self._write(h)
+
+    def view_change(self, view: int, log_view: int, commit_max: int) -> None:
+        """Durably record a view change (required before participating
+        in the new view — reference: superblock view_change trigger)."""
+        h = self.working.copy()
+        h["sequence"] = int(h["sequence"]) + 1
+        h["view"] = view
+        h["log_view"] = log_view
+        h["commit_max"] = max(int(h["commit_max"]), commit_max)
+        self._write(h)
+
+    def _write(self, h: np.ndarray) -> None:
+        payload = h.tobytes()[16:]
+        c = wire.checksum(payload)
+        h["checksum_lo"] = c & 0xFFFFFFFFFFFFFFFF
+        h["checksum_hi"] = c >> 64
+        raw = h.tobytes()
+        for copy in range(SUPERBLOCK_COPIES):
+            self.storage.write(
+                self.storage.layout.superblock_offset + copy * SUPERBLOCK_COPY_SIZE,
+                raw,
+            )
+        self.storage.sync()
+        self.working = h
+
+    # ------------------------------------------------------------------
+
+    def open(self) -> np.ndarray:
+        """Quorum read: highest sequence with >= QUORUM_OPEN agreeing
+        valid copies wins."""
+        copies = []
+        for copy in range(SUPERBLOCK_COPIES):
+            raw = self.storage.read(
+                self.storage.layout.superblock_offset + copy * SUPERBLOCK_COPY_SIZE,
+                SUPERBLOCK_COPY_SIZE,
+            )
+            h = np.frombuffer(raw, SUPERBLOCK_DTYPE)[0]
+            if self._valid(h):
+                copies.append(h)
+        by_checksum: dict[int, list[np.ndarray]] = {}
+        for h in copies:
+            key = int(h["checksum_lo"]) | (int(h["checksum_hi"]) << 64)
+            by_checksum.setdefault(key, []).append(h)
+        quorums = [
+            group[0]
+            for group in by_checksum.values()
+            if len(group) >= QUORUM_OPEN
+        ]
+        if not quorums:
+            raise RuntimeError("superblock: no quorum of valid copies")
+        self.working = max(quorums, key=lambda h: int(h["sequence"])).copy()
+        return self.working
+
+    def _valid(self, h: np.ndarray) -> bool:
+        payload = h.tobytes()[16:]
+        c = wire.checksum(payload)
+        if int(h["checksum_lo"]) != c & 0xFFFFFFFFFFFFFFFF:
+            return False
+        if int(h["checksum_hi"]) != c >> 64:
+            return False
+        cluster = int(h["cluster_lo"]) | (int(h["cluster_hi"]) << 64)
+        return cluster == self.cluster and int(h["version"]) == wire.VERSION
