@@ -1,0 +1,164 @@
+"""Deterministic multi-replica cluster scenarios (reference:
+src/vsr/replica_test.zig patterns on our simulated network)."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.testing.cluster import Cluster, PacketOptions
+from tigerbeetle_tpu.testing.harness import account, ids_bytes, pack, transfer
+
+
+def make_cluster(**kw):
+    c = Cluster(replica_count=3, **kw)
+    client = c.client(1000)
+    client.register()
+    c.run_until(lambda: client.registered)
+    return c, client
+
+
+def test_normal_operation_replicates_and_converges():
+    c, client = make_cluster()
+    reply = c.run_request(
+        types.Operation.create_accounts, pack([account(1), account(2)])
+    ) if False else c.run_request(client, types.Operation.create_accounts,
+                                  pack([account(1), account(2)]))
+    assert reply == b""
+    reply = c.run_request(
+        client, types.Operation.create_transfers,
+        pack([transfer(10, debit_account_id=1, credit_account_id=2, amount=100)]),
+    )
+    assert reply == b""
+    c.settle()
+    c.check_linearized()
+    c.check_convergence()
+    # State is actually applied on backups too.
+    for r in c.replicas:
+        assert r.sm.transfer_timestamp(10) is not None
+
+
+def test_lookup_through_cluster():
+    c, client = make_cluster()
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    c.run_request(
+        client, types.Operation.create_transfers,
+        pack([transfer(10, debit_account_id=1, credit_account_id=2, amount=55)]),
+    )
+    out = c.run_request(client, types.Operation.lookup_accounts, ids_bytes([1, 2]))
+    rows = np.frombuffer(out, types.ACCOUNT_DTYPE)
+    assert types.u128_get(rows[0], "debits_posted") == 55
+    assert types.u128_get(rows[1], "credits_posted") == 55
+
+
+def test_view_change_on_primary_partition():
+    c, client = make_cluster()
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    old_primary = c.replicas[0].primary_index()
+    c.network.partition(old_primary)
+
+    # The remaining replicas elect a new primary and keep serving.
+    reply = c.run_request(
+        client, types.Operation.create_transfers,
+        pack([transfer(10, debit_account_id=1, credit_account_id=2, amount=9)]),
+        max_steps=4000,
+    )
+    assert reply == b""
+    live = [r for i, r in enumerate(c.replicas) if i != old_primary]
+    assert all(r.view > 0 for r in live)
+    assert any(r.is_primary for r in live)
+
+    # Heal: the old primary catches up (repair) and converges.
+    c.network.heal()
+    c.settle(max_steps=6000)
+    c.check_linearized()
+    c.check_convergence()
+    assert c.replicas[old_primary].sm.transfer_timestamp(10) is not None
+
+
+def test_backup_lag_repairs_after_heal():
+    c, client = make_cluster()
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    lagging = 2  # backup in view 0
+    c.network.partition(lagging)
+    for i in range(5):
+        c.run_request(
+            client, types.Operation.create_transfers,
+            pack([transfer(100 + i, debit_account_id=1, credit_account_id=2,
+                           amount=1)]),
+        )
+    c.network.heal()
+    c.settle(max_steps=6000)
+    c.check_linearized()
+    c.check_convergence()
+    assert c.replicas[lagging].sm.transfer_timestamp(104) is not None
+
+
+def test_lossy_network_still_converges():
+    c, client = make_cluster(
+        seed=1234,
+        options=PacketOptions(packet_loss_probability=0.05,
+                              packet_replay_probability=0.02),
+    )
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]), max_steps=6000)
+    for i in range(10):
+        reply = c.run_request(
+            client, types.Operation.create_transfers,
+            pack([transfer(200 + i, debit_account_id=1, credit_account_id=2,
+                           amount=2)]),
+            max_steps=6000,
+        )
+        assert reply == b""
+    c.settle(max_steps=8000)
+    c.check_linearized()
+    c.check_convergence()
+    for r in c.replicas:
+        bal = r.sm.account_balances_raw(1)
+        assert bal[1] == 20  # debits_posted
+
+
+def test_same_seed_same_run():
+    def run(seed):
+        c, client = make_cluster(
+            seed=seed, options=PacketOptions(packet_loss_probability=0.05)
+        )
+        c.run_request(client, types.Operation.create_accounts,
+                      pack([account(1), account(2)]), max_steps=6000)
+        c.run_request(
+            client, types.Operation.create_transfers,
+            pack([transfer(7, debit_account_id=1, credit_account_id=2,
+                           amount=3)]),
+            max_steps=6000,
+        )
+        c.settle(max_steps=8000)
+        return (
+            c.network.now,
+            tuple(r.commit_min for r in c.replicas),
+            tuple(r.view for r in c.replicas),
+        )
+
+    assert run(42) == run(42)
+
+
+def test_pending_expiry_replicated():
+    c, client = make_cluster()
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    c.run_request(
+        client, types.Operation.create_transfers,
+        pack([transfer(10, debit_account_id=1, credit_account_id=2, amount=5,
+                       timeout=1, flags=types.TransferFlags.pending)]),
+    )
+    # ~10ms/tick: 200 ticks > 1s timeout -> primary pulses the expiry.
+    c.run_until(
+        lambda: all(
+            r.sm.pending_status(10) == types.TransferPendingStatus.expired
+            for r in c.replicas
+        ),
+        max_steps=4000,
+    )
+    c.settle()
+    c.check_convergence()

@@ -1090,8 +1090,11 @@ class CpuStateMachine:
     # ------------------------------------------------------------------
     # Checkpoint snapshot (consumed by vsr.checkpointing).
 
+    # prepare_timestamp is primary-only in-memory state (re-derived from
+    # commit_timestamp on the next prepare), so it is NOT part of the
+    # snapshot — backups never advance it and must still converge.
     _SNAPSHOT_FIELDS = (
-        "prepare_timestamp", "commit_timestamp", "pulse_next_timestamp",
+        "commit_timestamp", "pulse_next_timestamp",
         "accounts", "accounts_by_timestamp",
         "transfers", "transfers_by_timestamp",
         "transfers_by_dr", "transfers_by_cr",
@@ -1116,5 +1119,6 @@ class CpuStateMachine:
         assert set(state) == set(self._SNAPSHOT_FIELDS)
         for k, v in state.items():
             setattr(self, k, v)
+        self.prepare_timestamp = self.commit_timestamp
         self._undo = UndoLog()
         self._expiry_buffer = None

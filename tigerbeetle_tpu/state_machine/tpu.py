@@ -1137,9 +1137,11 @@ def _tpu_snapshot(self) -> bytes:
 
     self._dev.flush()  # queue drained; mirror == device content
     count = self._attrs.count
+    # prepare_timestamp is primary-only in-memory state, re-derived from
+    # commit_timestamp after restore — see cpu.py snapshot note.
     state = {
         "scalars": (
-            self.prepare_timestamp, self.commit_timestamp,
+            self.commit_timestamp,
             self.pulse_next_timestamp, self._exp_dead,
         ),
         "attrs": {k: self._attrs.col(k).copy() for k in _ATTR_FIELDS},
@@ -1160,9 +1162,10 @@ def _tpu_restore(self, data: bytes) -> None:
 
     state = pickle.loads(data)
     (
-        self.prepare_timestamp, self.commit_timestamp,
+        self.commit_timestamp,
         self.pulse_next_timestamp, self._exp_dead,
     ) = state["scalars"]
+    self.prepare_timestamp = self.commit_timestamp
 
     self._attrs = Columns(_ATTR_FIELDS)
     self._attrs.append(**state["attrs"])

@@ -1,0 +1,300 @@
+"""Deterministic in-process cluster: N replicas + clients, one thread.
+
+The reference tests multi-node behavior without a real cluster by
+instantiating every replica and client in one process over a simulated
+network/storage/time (reference: src/testing/cluster.zig:56-70,
+packet_simulator.zig:10-40).  Same pattern here: a seeded
+`PacketSimulator` delivers bus messages with delay/loss/partitions,
+`Cluster.step()` advances one tick, and identical seeds give identical
+runs — which is also how TPU-vs-CPU state parity is checked
+reproducibly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+
+import numpy as np
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.vsr import replica as vsr_format
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.multi import VsrReplica
+from tigerbeetle_tpu.vsr.storage import MemoryStorage, ZoneLayout
+from tigerbeetle_tpu.vsr.wire import Command, VsrOperation
+
+
+@dataclasses.dataclass
+class PacketOptions:
+    """reference: src/testing/packet_simulator.zig:10-40."""
+
+    one_way_delay_min: int = 1
+    one_way_delay_max: int = 3
+    packet_loss_probability: float = 0.0
+    packet_replay_probability: float = 0.0
+
+
+class PacketSimulator:
+    """Seeded delay/loss/replay/partition between endpoints.
+
+    Endpoints: replicas are ints 0..n-1; clients are u128 client ids.
+    """
+
+    def __init__(self, options: PacketOptions, seed: int = 0) -> None:
+        self.options = options
+        self.rng = np.random.default_rng(seed)
+        self.now = 0
+        self._queue: list[tuple[int, int, object]] = []  # (tick, seq, packet)
+        self._seq = 0
+        self.partitioned: set = set()  # endpoints cut off from everyone
+
+    def partition(self, *endpoints) -> None:
+        self.partitioned.update(endpoints)
+
+    def heal(self, *endpoints) -> None:
+        if endpoints:
+            self.partitioned.difference_update(endpoints)
+        else:
+            self.partitioned.clear()
+
+    def submit(self, src, dst, header: np.ndarray, body: bytes) -> None:
+        if src in self.partitioned or dst in self.partitioned:
+            return
+        if self.rng.random() < self.options.packet_loss_probability:
+            return
+        copies = 1
+        if self.rng.random() < self.options.packet_replay_probability:
+            copies = 2
+        for _ in range(copies):
+            delay = int(
+                self.rng.integers(
+                    self.options.one_way_delay_min,
+                    self.options.one_way_delay_max + 1,
+                )
+            )
+            heapq.heappush(
+                self._queue,
+                (self.now + delay, self._seq, (src, dst, header.copy(), body)),
+            )
+            self._seq += 1
+
+    def advance(self, deliver) -> None:
+        """One tick: pop every packet due now and hand to `deliver`."""
+        self.now += 1
+        while self._queue and self._queue[0][0] <= self.now:
+            _, _, (src, dst, header, body) = heapq.heappop(self._queue)
+            if src in self.partitioned or dst in self.partitioned:
+                continue
+            deliver(dst, header, body)
+
+
+class _Bus:
+    """Per-replica bus endpoint feeding the packet simulator."""
+
+    def __init__(self, cluster: "Cluster", src) -> None:
+        self.cluster = cluster
+        self.src = src
+
+    def send(self, dst: int, header: np.ndarray, body: bytes) -> None:
+        self.cluster.network.submit(self.src, dst, header, body)
+
+    def send_client(self, client: int, header: np.ndarray, body: bytes) -> None:
+        self.cluster.network.submit(self.src, client, header, body)
+
+
+class SimClient:
+    """Driver-side client session: register, pipelined-one request,
+    retransmit on timeout (reference: src/vsr/client.zig:18-120)."""
+
+    RETRY_TICKS = 8
+
+    def __init__(self, cluster: "Cluster", client_id: int) -> None:
+        self.cluster = cluster
+        self.id = client_id
+        self.request_number = 0
+        self.view_guess = 0
+        self.reply: bytes | None = None
+        self.registered = False
+        self._inflight: tuple[np.ndarray, bytes] | None = None
+        self._last_sent = -(10**9)
+        self.replies: list[bytes] = []
+
+    # -- wire --
+
+    def on_message(self, header: np.ndarray, body: bytes) -> None:
+        if not wire.verify_header(header, body):
+            return
+        cmd = Command(int(header["command"]))
+        if cmd == Command.eviction:
+            raise RuntimeError(f"client {self.id} evicted")
+        if cmd != Command.reply:
+            return
+        if self._inflight is None:
+            return
+        want_request = int(self._inflight[0]["request"])
+        if int(header["request"]) != want_request:
+            return
+        self.view_guess = max(self.view_guess, int(header["view"]))
+        if int(self._inflight[0]["operation"]) == int(VsrOperation.register):
+            self.registered = True
+        self._inflight = None
+        self.reply = body
+        self.replies.append(body)
+
+    def tick(self) -> None:
+        if self._inflight is None:
+            return
+        if self.cluster.network.now - self._last_sent >= self.RETRY_TICKS:
+            self._send(broadcast=True)
+
+    # -- api --
+
+    def busy(self) -> bool:
+        return self._inflight is not None
+
+    def register(self) -> None:
+        assert not self.busy()
+        h = wire.make_header(
+            command=Command.request, operation=VsrOperation.register,
+            cluster=self.cluster.cluster_id, client=self.id, request=0,
+        )
+        wire.finalize_header(h, b"")
+        self._inflight = (h, b"")
+        self._send()
+
+    def request(self, operation: types.Operation, body: bytes) -> None:
+        assert self.registered and not self.busy()
+        self.request_number += 1
+        h = wire.make_header(
+            command=Command.request, operation=operation,
+            cluster=self.cluster.cluster_id, client=self.id,
+            request=self.request_number,
+        )
+        wire.finalize_header(h, body)
+        self.reply = None
+        self._inflight = (h, body)
+        self._send()
+
+    def _send(self, broadcast: bool = False) -> None:
+        assert self._inflight is not None
+        self._last_sent = self.cluster.network.now
+        header, body = self._inflight
+        targets = (
+            range(self.cluster.replica_count)
+            if broadcast
+            else [self.view_guess % self.cluster.replica_count]
+        )
+        for r in targets:
+            self.cluster.network.submit(self.id, r, header, body)
+
+
+class Cluster:
+    def __init__(self, replica_count: int = 3, *, seed: int = 0,
+                 config: cfg.Config = cfg.TEST_MIN,
+                 options: PacketOptions | None = None,
+                 state_machine_factory=None) -> None:
+        self.cluster_id = 0xC1
+        self.replica_count = replica_count
+        self.config = config
+        self.network = PacketSimulator(options or PacketOptions(), seed)
+        factory = state_machine_factory or (lambda: CpuStateMachine(config))
+
+        self.replicas: list[VsrReplica] = []
+        self.storages: list[MemoryStorage] = []
+        for i in range(replica_count):
+            storage = MemoryStorage(
+                ZoneLayout(config=config, grid_size=1 << 20), seed=seed + i
+            )
+            vsr_format.format(storage, self.cluster_id, i, replica_count)
+            r = VsrReplica(
+                storage, self.cluster_id, factory(), _Bus(self, i),
+                replica=i, replica_count=replica_count,
+            )
+            r.open()
+            self.storages.append(storage)
+            self.replicas.append(r)
+        self.clients: dict[int, SimClient] = {}
+        self.realtime = 0
+
+    def client(self, client_id: int) -> SimClient:
+        c = SimClient(self, client_id)
+        self.clients[client_id] = c
+        return c
+
+    # ------------------------------------------------------------------
+
+    def step(self) -> None:
+        """One tick: advance time, tick everyone, deliver due packets."""
+        self.realtime += types.NS_PER_S // 100  # 10ms per tick
+        for r in self.replicas:
+            r.realtime = self.realtime
+            r.tick()
+        for c in self.clients.values():
+            c.tick()
+        self.network.advance(self._deliver)
+
+    def _deliver(self, dst, header: np.ndarray, body: bytes) -> None:
+        if isinstance(dst, int) and dst < self.replica_count:
+            self.replicas[dst].on_message(header, body)
+        else:
+            client = self.clients.get(dst)
+            if client is not None:
+                client.on_message(header, body)
+
+    def run_until(self, cond, max_steps: int = 2000) -> None:
+        for _ in range(max_steps):
+            if cond():
+                return
+            self.step()
+        raise AssertionError(f"condition not reached in {max_steps} steps")
+
+    def run_request(self, client: SimClient, operation: types.Operation,
+                    body: bytes, max_steps: int = 2000) -> bytes:
+        client.request(operation, body)
+        self.run_until(lambda: not client.busy(), max_steps)
+        assert client.reply is not None or client.reply == b""
+        return client.reply
+
+    # ------------------------------------------------------------------
+    # Checkers (reference: src/testing/cluster/state_checker.zig:27-45).
+
+    def check_linearized(self) -> None:
+        """Every pair of replicas agrees on the prepare at every op
+        both have committed."""
+        for a in range(self.replica_count):
+            for b in range(a + 1, self.replica_count):
+                ra, rb = self.replicas[a], self.replicas[b]
+                lo = max(
+                    1,
+                    max(ra.checkpoint_op, rb.checkpoint_op),
+                    min(ra.commit_min, rb.commit_min)
+                    - self.config.journal_slot_count + 1,
+                )
+                for op in range(lo, min(ra.commit_min, rb.commit_min) + 1):
+                    pa = ra.journal.read_prepare(op)
+                    pb = rb.journal.read_prepare(op)
+                    assert pa is not None and pb is not None, (a, b, op)
+                    assert pa[0].tobytes() == pb[0].tobytes(), (a, b, op)
+
+    def check_convergence(self) -> None:
+        """All replicas at the same commit must hold identical state."""
+        commits = {r.commit_min for r in self.replicas}
+        assert len(commits) == 1, commits
+        snaps = {r.sm.snapshot() for r in self.replicas}
+        assert len(snaps) == 1, "state machines diverged"
+
+    def settle(self, max_steps: int = 3000) -> None:
+        """Run until all replicas have converged on the same commit."""
+        def converged():
+            if any(c.busy() for c in self.clients.values()):
+                return False
+            commits = {r.commit_min for r in self.replicas}
+            ops = {r.op for r in self.replicas}
+            return len(commits) == 1 and len(ops) == 1 and all(
+                r.status == "normal" for r in self.replicas
+            )
+
+        self.run_until(converged, max_steps)

@@ -1,0 +1,712 @@
+"""Multi-replica VSR consensus (Viewstamped Replication Revisited).
+
+Message-driven port of the reference's replica protocol (reference:
+src/vsr/replica.zig — on_request :1494, on_prepare :1557, on_prepare_ok
+:1670, commit piggybacking :1792, DVC quorum :9779) on top of the
+single-replica commit pipeline in replica.py.  Protocol facts kept:
+
+- Ring replication: the primary sends each prepare to its successor
+  only; every backup forwards to the next while journaling in parallel
+  (reference: src/vsr/replica.zig:1532-1556).
+- Replication quorum: majority of replicas, capped by
+  `quorum_replication_max` (reference: src/config.zig:151,
+  docs/about/performance.md:48-53).
+- Pipeline: up to `pipeline_prepare_queue_max` prepares in flight
+  (reference: src/config.zig:149).
+- Backups learn commits from the `commit` number piggybacked on later
+  prepares plus a periodic commit heartbeat.
+- View change: start_view_change broadcast -> do_view_change quorum at
+  the new primary (which adopts the longest log of the highest
+  log_view) -> start_view installs the canonical tail everywhere.
+  View/log_view are persisted to the superblock before participating
+  in the new view.
+- Repair: `request_prepare(op, checksum)` fetches missing/corrupt
+  prepares from peers (reference: src/vsr/replica.zig:2259-2497).
+
+Everything is deterministic: no threads, no wall clock — `tick()`
+advances timeouts and the bus delivers messages, so the in-process
+cluster (testing/cluster.py) reproduces any seed exactly, the same way
+the reference's VOPR does (reference: src/testing/cluster.zig:56-70).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tigerbeetle_tpu import constants, types
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.replica import Replica, Session
+from tigerbeetle_tpu.vsr.wire import Command, VsrOperation
+
+# Timeout cadences, in ticks (reference tunes these in src/constants.zig;
+# ratios preserved: heartbeat << view-change timeout).
+PING_TICKS = 2
+VIEW_CHANGE_TICKS = 10
+VIEW_CHANGE_RESEND_TICKS = 4
+REPAIR_RETRY_TICKS = 3
+
+
+@dataclasses.dataclass
+class PipelineEntry:
+    header: np.ndarray
+    body: bytes
+    ok_replicas: set[int]
+
+
+class VsrReplica(Replica):
+    """A replica wired to a message bus.
+
+    `bus.send(dst_replica, header, body)` / `bus.send_client(client_id,
+    header, body)` deliver messages; the harness calls `on_message` and
+    `tick`.
+    """
+
+    def __init__(self, storage, cluster, state_machine, bus, *,
+                 replica: int, replica_count: int) -> None:
+        super().__init__(storage, cluster, state_machine,
+                         replica=replica, replica_count=replica_count)
+        self.bus = bus
+        self.status = "recovering"
+        self.log_view = 0
+
+        majority = replica_count // 2 + 1
+        self.quorum_replication = min(
+            majority, self.config.quorum_replication_max
+        )
+        self.quorum_view_change = majority
+
+        self.pipeline: dict[int, PipelineEntry] = {}
+        self.request_queue: list[tuple[np.ndarray, bytes]] = []
+
+        # Timers.
+        self._ticks = 0
+        self._last_primary_seen = 0
+        self._last_ping_sent = 0
+        self._vc_last_sent = 0
+        self._repair_last_sent = 0
+        self._last_retransmit = 0
+
+        # View-change state.
+        self._svc_votes: dict[int, set[int]] = {}   # view -> replicas
+        self._dvc: dict[int, dict] = {}             # replica -> dvc payload
+        # Repair state: op -> checksum we want.
+        self._repair_wanted: dict[int, int] = {}
+        # Stashed out-of-order prepares: op -> (header, body).
+        self._stash: dict[int, tuple[np.ndarray, bytes]] = {}
+
+    # ------------------------------------------------------------------
+
+    def primary_index(self, view: int | None = None) -> int:
+        return (self.view if view is None else view) % self.replica_count
+
+    @property
+    def is_primary(self) -> bool:
+        return self.status == "normal" and self.primary_index() == self.replica
+
+    def open(self) -> None:
+        super().open()
+        self.log_view = int(self.superblock.working["log_view"])
+        self.status = "normal"
+        self.commit_max = self.commit_min
+
+    # ------------------------------------------------------------------
+    # Tick: timeouts.
+
+    def tick(self) -> None:
+        self._ticks += 1
+        if self.status == "normal":
+            if self.is_primary:
+                if self._ticks - self._last_ping_sent >= PING_TICKS:
+                    self._send_heartbeat()
+                self._maybe_pulse()
+                if self.pipeline and (
+                    self._ticks - self._last_retransmit >= REPAIR_RETRY_TICKS
+                ):
+                    self._retransmit_pipeline()
+            else:
+                if self._ticks - self._last_primary_seen >= VIEW_CHANGE_TICKS:
+                    self._start_view_change(self.view + 1)
+        elif self.status == "view_change":
+            if self._ticks - self._vc_last_sent >= VIEW_CHANGE_RESEND_TICKS:
+                self._broadcast_svc()
+        if self._repair_wanted and (
+            self._ticks - self._repair_last_sent >= REPAIR_RETRY_TICKS
+        ):
+            self._send_repair_requests()
+
+    def _retransmit_pipeline(self) -> None:
+        """Re-send the lowest non-quorate prepare directly to every
+        backup: recovers lost prepares and routes around a broken ring
+        (reference repairs these via request_prepare timeouts)."""
+        self._last_retransmit = self._ticks
+        op = min(self.pipeline)
+        entry = self.pipeline[op]
+        for r in range(self.replica_count):
+            if r != self.replica and r not in entry.ok_replicas:
+                self.bus.send(r, entry.header, entry.body)
+
+    def _maybe_pulse(self) -> None:
+        """Self-clocked expiry (reference: src/vsr/replica.zig:3126-3143):
+        the primary turns due timeouts into a replicated pulse op."""
+        if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
+            return
+        self._advance_prepare_timestamp()
+        if not self.sm.pulse_needed():
+            return
+        req = wire.make_header(
+            command=Command.request, operation=types.Operation.pulse,
+            cluster=self.cluster, view=self.view,
+        )
+        wire.finalize_header(req, b"")
+        self._primary_prepare(req, b"")
+
+    def _send_heartbeat(self) -> None:
+        self._last_ping_sent = self._ticks
+        h = wire.make_header(
+            command=Command.commit, cluster=self.cluster, view=self.view,
+            replica=self.replica, commit=self.commit_min,
+        )
+        wire.finalize_header(h, b"")
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.bus.send(r, h, b"")
+
+    # ------------------------------------------------------------------
+    # Message dispatch.
+
+    def on_message(self, header: np.ndarray, body: bytes) -> None:
+        if not wire.verify_header(header, body):
+            return
+        if wire.u128(header, "cluster") != self.cluster:
+            return
+        cmd = Command(int(header["command"]))
+        handler = {
+            Command.request: self._on_request_msg,
+            Command.prepare: self._on_prepare,
+            Command.prepare_ok: self._on_prepare_ok,
+            Command.commit: self._on_commit,
+            Command.start_view_change: self._on_start_view_change,
+            Command.do_view_change: self._on_do_view_change,
+            Command.start_view: self._on_start_view,
+            Command.request_prepare: self._on_request_prepare,
+            Command.ping: self._on_ping,
+        }.get(cmd)
+        if handler is not None:
+            handler(header, body)
+
+    # ------------------------------------------------------------------
+    # Normal operation: primary.
+
+    def _on_request_msg(self, header: np.ndarray, body: bytes) -> None:
+        if self.status != "normal":
+            return
+        if not self.is_primary:
+            # Forward to the primary (clients may have a stale view).
+            self.bus.send(self.primary_index(), header, body)
+            return
+        client = wire.u128(header, "client")
+        request = int(header["request"])
+        operation = int(header["operation"])
+
+        if operation != int(VsrOperation.register) and client:
+            entry = self.sessions.get(client)
+            if entry is None:
+                self._send_eviction(client)
+                return
+            if request == entry.request and request > 0:
+                self._send_stored_reply(client, entry)
+                return
+            if request < entry.request:
+                return  # stale duplicate
+        if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
+            self.request_queue.append((header, body))
+            return
+        self._primary_prepare(header, body)
+
+    def _primary_prepare(self, request: np.ndarray, body: bytes) -> None:
+        operation = int(request["operation"])
+        self._advance_prepare_timestamp()
+        if operation >= constants.VSR_OPERATIONS_RESERVED:
+            self.sm.prepare(types.Operation(operation), body)
+        timestamp = self.sm.prepare_timestamp
+
+        op = self.op + 1
+        prepare = wire.make_header(
+            command=Command.prepare, operation=operation,
+            cluster=self.cluster, client=wire.u128(request, "client"),
+            request=int(request["request"]), view=self.view,
+            op=op, commit=self.commit_min, timestamp=timestamp,
+            parent=self.parent_checksum, replica=self.replica,
+        )
+        wire.finalize_header(prepare, body)
+
+        self.journal.write_prepare(prepare, body)
+        self.op = op
+        self.parent_checksum = wire.u128(prepare, "checksum")
+        self.pipeline[op] = PipelineEntry(prepare, body, {self.replica})
+        self._replicate(prepare, body)
+        self._maybe_commit_pipeline()
+
+    def _replicate(self, prepare: np.ndarray, body: bytes) -> None:
+        """Ring forwarding: send to successor only (reference:
+        src/vsr/replica.zig:1532-1556)."""
+        if self.replica_count == 1:
+            return
+        succ = (self.replica + 1) % self.replica_count
+        if succ != self.primary_index(int(prepare["view"])):
+            self.bus.send(succ, prepare, body)
+
+    def _on_prepare_ok(self, header: np.ndarray, body: bytes) -> None:
+        if not self.is_primary or int(header["view"]) != self.view:
+            return
+        op = int(header["op"])
+        entry = self.pipeline.get(op)
+        if entry is None:
+            return
+        if wire.u128(header, "context") != wire.u128(entry.header, "checksum"):
+            return
+        entry.ok_replicas.add(int(header["replica"]))
+        self._maybe_commit_pipeline()
+
+    def _primary_requeue_uncommitted(self) -> None:
+        """After a view change, the adopted-but-uncommitted tail must be
+        re-committed under the new view: enqueue every tail op we hold
+        and re-replicate it so backups ack into this view."""
+        for op in range(self.commit_min + 1, self.op + 1):
+            if op in self.pipeline:
+                continue
+            read = self.journal.read_prepare(op)
+            if read is None:
+                continue  # still repairing; retried on fill
+            header, body = read
+            self.pipeline[op] = PipelineEntry(header, body, {self.replica})
+            self._replicate(header, body)
+        self._maybe_commit_pipeline()
+
+    def _maybe_commit_pipeline(self) -> None:
+        while self.pipeline:
+            op = min(self.pipeline)
+            if op <= self.commit_min:  # committed via _advance_commit
+                del self.pipeline[op]
+                continue
+            entry = self.pipeline[op]
+            if len(entry.ok_replicas) < self.quorum_replication:
+                return
+            if op != self.commit_min + 1:
+                return  # waiting on repair of earlier ops
+            reply_body = self._commit_prepare(entry.header, entry.body)
+            self.commit_max = max(self.commit_max, op)
+            client = wire.u128(entry.header, "client")
+            if client:
+                self._send_reply(entry.header, reply_body)
+            del self.pipeline[op]
+            if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
+                self.checkpoint()
+            while self.request_queue and (
+                len(self.pipeline) < self.config.pipeline_prepare_queue_max
+            ):
+                h, b = self.request_queue.pop(0)
+                self._primary_prepare(h, b)
+
+    def _send_reply(self, prepare: np.ndarray, reply_body: bytes) -> None:
+        client = wire.u128(prepare, "client")
+        operation = int(prepare["operation"])
+        if operation == int(VsrOperation.register):
+            entry = self.sessions[client]
+            reply = wire.make_header(
+                command=Command.reply, operation=operation,
+                cluster=self.cluster, client=client,
+                request=int(prepare["request"]), view=self.view,
+                op=int(prepare["op"]), commit=int(prepare["op"]),
+                timestamp=int(prepare["timestamp"]),
+            )
+            wire.finalize_header(reply, b"")
+            self.bus.send_client(client, reply, b"")
+            return
+        entry = self.sessions.get(client)
+        if entry is not None and entry.reply_header:
+            header = wire.header_from_bytes(entry.reply_header)
+            self.bus.send_client(client, header, reply_body)
+
+    def _send_stored_reply(self, client: int, entry: Session) -> None:
+        body = self._read_reply(entry)
+        self.bus.send_client(
+            client, wire.header_from_bytes(entry.reply_header), body
+        )
+
+    def _send_eviction(self, client: int) -> None:
+        h = wire.make_header(
+            command=Command.eviction, cluster=self.cluster, view=self.view,
+            client=client, replica=self.replica,
+        )
+        wire.finalize_header(h, b"")
+        self.bus.send_client(client, h, b"")
+
+    # ------------------------------------------------------------------
+    # Normal operation: backup.
+
+    def _on_prepare(self, header: np.ndarray, body: bytes) -> None:
+        view = int(header["view"])
+        op = int(header["op"])
+        if view < self.view:
+            # Stale-view prepares arrive as repair responses and as the
+            # new primary's re-replication of an adopted tail; the fill
+            # path accepts them only when requested/matching.
+            self._repair_fill(header, body)
+            return
+        if view > self.view:
+            # We missed a view change: catch up passively (the new
+            # primary's start_view was lost; prepares prove the view).
+            self._enter_view(view)
+        self._last_primary_seen = self._ticks
+        if self.status != "normal":
+            return
+        if self.is_primary:
+            return  # ring wrapped all the way around
+
+        if op <= self.op:
+            self._repair_fill(header, body)
+            return
+        if op > self.op + 1:
+            # Gap: stash and request the missing range.
+            self._stash[op] = (header, body)
+            for missing in range(self.op + 1, op):
+                self._repair_wanted.setdefault(missing, 0)
+            self._send_repair_requests()
+            return
+
+        if wire.u128(header, "parent") != self.parent_checksum:
+            # Chain mismatch: our tail is wrong (uncommitted garbage
+            # from an old view) — repair will overwrite it.
+            self._repair_wanted[op] = wire.u128(header, "checksum")
+            self._send_repair_requests()
+            return
+
+        self._accept_prepare(header, body)
+        # Drain any stashed successors.
+        while self.op + 1 in self._stash:
+            h, b = self._stash.pop(self.op + 1)
+            if wire.u128(h, "parent") != self.parent_checksum:
+                break
+            self._accept_prepare(h, b)
+        self._advance_commit(int(header["commit"]))
+
+    def _accept_prepare(self, header: np.ndarray, body: bytes) -> None:
+        op = int(header["op"])
+        self.journal.write_prepare(header, body)
+        self.op = op
+        self.parent_checksum = wire.u128(header, "checksum")
+        self._repair_wanted.pop(op, None)
+        self._replicate(header, body)
+        self._send_prepare_ok(header)
+
+    def _send_prepare_ok(self, prepare: np.ndarray) -> None:
+        if self.status != "normal" or self.is_primary:
+            return
+        ok = wire.make_header(
+            command=Command.prepare_ok, cluster=self.cluster, view=self.view,
+            op=int(prepare["op"]), replica=self.replica,
+            context=wire.u128(prepare, "checksum"),
+            client=wire.u128(prepare, "client"),
+        )
+        wire.finalize_header(ok, b"")
+        self.bus.send(self.primary_index(), ok, b"")
+
+    def _on_commit(self, header: np.ndarray, body: bytes) -> None:
+        if int(header["view"]) < self.view or self.status != "normal":
+            return
+        if int(header["view"]) > self.view:
+            self._enter_view(int(header["view"]))
+        self._last_primary_seen = self._ticks
+        self._advance_commit(int(header["commit"]))
+
+    def _advance_commit(self, commit_max: int) -> None:
+        self.commit_max = max(self.commit_max, commit_max)
+        while self.commit_min < min(self.commit_max, self.op):
+            op = self.commit_min + 1
+            read = self.journal.read_prepare(op)
+            if read is None:
+                self._repair_wanted.setdefault(op, 0)
+                self._send_repair_requests()
+                return
+            header, body = read
+            self._commit_prepare(header, body)
+            if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
+                self.checkpoint()
+
+    def _on_ping(self, header: np.ndarray, body: bytes) -> None:
+        pong = wire.make_header(
+            command=Command.pong, cluster=self.cluster, view=self.view,
+            replica=self.replica,
+        )
+        wire.finalize_header(pong, b"")
+        self.bus.send(int(header["replica"]), pong, b"")
+
+    # ------------------------------------------------------------------
+    # Repair.
+
+    def _repair_fill(self, header: np.ndarray, body: bytes) -> None:
+        """A prepare at or below our op: overwrite if we wanted it or
+        our copy is missing/diverged; ack matching content into the
+        current view so a new primary can re-commit an adopted tail."""
+        op = int(header["op"])
+        if op > self.op:
+            return
+        want = self._repair_wanted.get(op)
+        have = self.journal.read_prepare(op)
+        checksum = wire.u128(header, "checksum")
+        if have is not None and wire.u128(have[0], "checksum") == checksum:
+            self._send_prepare_ok(header)  # already hold it: just ack
+            return
+        if want is not None and (want == 0 or want == checksum):
+            pass  # requested repair
+        elif have is None:
+            pass  # hole in our journal
+        else:
+            return
+        self.journal.write_prepare(header, body)
+        self._repair_wanted.pop(op, None)
+        if op == self.op:
+            self.parent_checksum = checksum
+        self._send_prepare_ok(header)
+        if self.is_primary:
+            self._primary_requeue_uncommitted()
+        # Try draining stash / committing past the filled hole.
+        while self.op + 1 in self._stash:
+            h, b = self._stash.pop(self.op + 1)
+            prev = self.journal.read_prepare(self.op)
+            if prev is not None and wire.u128(h, "parent") != wire.u128(
+                prev[0], "checksum"
+            ):
+                break
+            self._accept_prepare(h, b)
+        self._advance_commit(self.commit_max)
+
+    def _send_repair_requests(self) -> None:
+        self._repair_last_sent = self._ticks
+        for op, checksum in list(self._repair_wanted.items())[:8]:
+            h = wire.make_header(
+                command=Command.request_prepare, cluster=self.cluster,
+                view=self.view, op=op, replica=self.replica, context=checksum,
+            )
+            wire.finalize_header(h, b"")
+            # Ask the primary first; any replica can answer.
+            target = self.primary_index()
+            if target == self.replica:
+                target = (self.replica + 1) % self.replica_count
+            self.bus.send(target, h, b"")
+
+    def _on_request_prepare(self, header: np.ndarray, body: bytes) -> None:
+        op = int(header["op"])
+        want = wire.u128(header, "context")
+        read = self.journal.read_prepare(op)
+        if read is None:
+            return
+        prepare, pbody = read
+        if want and wire.u128(prepare, "checksum") != want:
+            return
+        self.bus.send(int(header["replica"]), prepare, pbody)
+
+    # ------------------------------------------------------------------
+    # View change.
+
+    def _enter_view(self, view: int) -> None:
+        """Adopt a higher view as a backup in normal status."""
+        assert view > self.view
+        self.view = view
+        self.status = "normal"
+        self.log_view = view
+        self.superblock.view_change(self.view, self.log_view, self.commit_max)
+        self.pipeline.clear()
+        self.request_queue.clear()
+        self._svc_votes.clear()
+        self._dvc.clear()
+        self._last_primary_seen = self._ticks
+
+    def _start_view_change(self, view: int) -> None:
+        self.status = "view_change"
+        self.view = view
+        self._svc_votes.setdefault(view, set()).add(self.replica)
+        self._broadcast_svc()
+
+    def _broadcast_svc(self) -> None:
+        self._vc_last_sent = self._ticks
+        h = wire.make_header(
+            command=Command.start_view_change, cluster=self.cluster,
+            view=self.view, replica=self.replica,
+        )
+        wire.finalize_header(h, b"")
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.bus.send(r, h, b"")
+
+    def _on_start_view_change(self, header: np.ndarray, body: bytes) -> None:
+        view = int(header["view"])
+        if view < self.view:
+            return
+        if view > self.view or self.status == "normal":
+            if view == self.view and self.status == "normal":
+                return  # old noise for our current view
+            self._start_view_change(max(view, self.view))
+        self._svc_votes.setdefault(self.view, set()).add(int(header["replica"]))
+        votes = self._svc_votes.get(self.view, set())
+        if len(votes) >= self.quorum_view_change:
+            self._send_do_view_change()
+
+    def _send_do_view_change(self) -> None:
+        # Persist before participating (reference: superblock view_change).
+        self.superblock.view_change(self.view, self.log_view, self.commit_max)
+        payload = {
+            "log_view": self.log_view,
+            "op": self.op,
+            "commit_min": self.commit_min,
+            "headers": self._tail_headers(),
+        }
+        body = _encode_dvc(payload)
+        h = wire.make_header(
+            command=Command.do_view_change, cluster=self.cluster,
+            view=self.view, replica=self.replica, op=self.op,
+            commit=self.commit_min,
+        )
+        wire.finalize_header(h, body)
+        target = self.primary_index()
+        if target == self.replica:
+            self._on_do_view_change(h, body)
+        else:
+            self.bus.send(target, h, body)
+
+    def _tail_headers(self) -> list[bytes]:
+        """Headers of the last pipeline-window ops (the uncommitted
+        suffix a new primary might need to adopt)."""
+        out = []
+        lo = max(self.commit_min, self.op - self.config.pipeline_prepare_queue_max)
+        for op in range(lo, self.op + 1):
+            read = self.journal.read_prepare(op)
+            if read is not None:
+                out.append(read[0].tobytes())
+        return out
+
+    def _on_do_view_change(self, header: np.ndarray, body: bytes) -> None:
+        view = int(header["view"])
+        if view < self.view:
+            return
+        if view > self.view:
+            self._start_view_change(view)
+        if self.primary_index(view) != self.replica:
+            return
+        self._dvc[int(header["replica"])] = _decode_dvc(body)
+        if self.replica not in self._dvc:
+            self.superblock.view_change(self.view, self.log_view, self.commit_max)
+            self._dvc[self.replica] = {
+                "log_view": self.log_view, "op": self.op,
+                "commit_min": self.commit_min, "headers": self._tail_headers(),
+            }
+        if len(self._dvc) < self.quorum_view_change:
+            return
+        if self.status != "view_change":
+            return
+
+        # Adopt the longest log of the highest log_view (VRR rule).
+        best = max(
+            self._dvc.values(), key=lambda d: (d["log_view"], d["op"])
+        )
+        canonical = [wire.header_from_bytes(raw) for raw in best["headers"]]
+        commit_floor = max(d["commit_min"] for d in self._dvc.values())
+        self._install_log(canonical, best["op"], commit_floor)
+
+        self.status = "normal"
+        self.log_view = self.view
+        self.superblock.view_change(self.view, self.log_view, self.commit_max)
+        self._svc_votes.clear()
+        self._dvc.clear()
+        self._send_start_view()
+        self._advance_commit(self.commit_max)
+        self._primary_requeue_uncommitted()
+
+    def _install_log(self, canonical: list[np.ndarray], op_head: int,
+                     commit_floor: int) -> None:
+        """Make our journal match the canonical tail, requesting any
+        prepares we don't hold."""
+        self.op = max(self.op, 0)
+        for h in canonical:
+            op = int(h["op"])
+            checksum = wire.u128(h, "checksum")
+            have = self.journal.read_prepare(op)
+            if have is not None and wire.u128(have[0], "checksum") == checksum:
+                continue
+            self._repair_wanted[op] = checksum
+        self.op = op_head
+        self.commit_max = max(self.commit_max, commit_floor)
+        if canonical:
+            head = canonical[-1]
+            assert int(head["op"]) == op_head
+            self.parent_checksum = wire.u128(head, "checksum")
+        if self._repair_wanted:
+            self._send_repair_requests()
+
+    def _send_start_view(self) -> None:
+        body = _encode_dvc({
+            "log_view": self.log_view, "op": self.op,
+            "commit_min": self.commit_min, "headers": self._tail_headers(),
+        })
+        h = wire.make_header(
+            command=Command.start_view, cluster=self.cluster, view=self.view,
+            replica=self.replica, op=self.op, commit=self.commit_min,
+        )
+        wire.finalize_header(h, body)
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.bus.send(r, h, body)
+
+    def _on_start_view(self, header: np.ndarray, body: bytes) -> None:
+        view = int(header["view"])
+        if view < self.view:
+            return
+        payload = _decode_dvc(body)
+        self.view = view
+        self.status = "normal"
+        self.log_view = view
+        canonical = [wire.header_from_bytes(raw) for raw in payload["headers"]]
+        self._install_log(canonical, payload["op"], int(header["commit"]))
+        self.superblock.view_change(self.view, self.log_view, self.commit_max)
+        self._svc_votes.clear()
+        self._dvc.clear()
+        self._last_primary_seen = self._ticks
+        self._advance_commit(self.commit_max)
+
+
+# ----------------------------------------------------------------------
+# DVC/SV body codec: length-prefixed header list + scalars.
+
+
+def _encode_dvc(payload: dict) -> bytes:
+    import struct
+
+    parts = [
+        struct.pack(
+            "<QQQI",
+            payload["log_view"], payload["op"], payload["commit_min"],
+            len(payload["headers"]),
+        )
+    ]
+    parts.extend(payload["headers"])
+    return b"".join(parts)
+
+
+def _decode_dvc(body: bytes) -> dict:
+    import struct
+
+    log_view, op, commit_min, n = struct.unpack_from("<QQQI", body, 0)
+    off = 28
+    headers = []
+    from tigerbeetle_tpu.constants import HEADER_SIZE
+
+    for _ in range(n):
+        headers.append(body[off : off + HEADER_SIZE])
+        off += HEADER_SIZE
+    return {
+        "log_view": log_view, "op": op, "commit_min": commit_min,
+        "headers": headers,
+    }
