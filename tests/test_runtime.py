@@ -1,0 +1,256 @@
+"""Native runtime integration: C++ bus/client <-> Python server over
+real TCP, CLI, REPL, benchmark smoke."""
+
+import io
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.runtime.native import native_available, native_checksum128
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.vsr import wire
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native runtime not built"
+)
+
+CLUSTER = 3
+
+
+def test_native_checksum_matches_python():
+    for data in (b"", b"x", b"hello world" * 100, os.urandom(4096)):
+        assert native_checksum128(data) == wire.checksum(data)
+
+
+class ServerFixture:
+    def __init__(self, tmp_path, use_test_min=True):
+        from tigerbeetle_tpu.runtime.server import (
+            ReplicaServer,
+            format_data_file,
+        )
+
+        config = cfg.TEST_MIN if use_test_min else cfg.PRODUCTION
+        path = str(tmp_path / "data.tigerbeetle")
+        format_data_file(path, cluster=CLUSTER, config=config)
+        self.server = ReplicaServer(
+            path, cluster=CLUSTER, addresses=["127.0.0.1:0"], replica_index=0,
+            state_machine_factory=lambda: CpuStateMachine(config),
+            config=config,
+        )
+        self.address = f"127.0.0.1:{self.server.port}"
+        self._stop = False
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            self.server.poll_once(timeout_ms=1)
+
+    def close(self):
+        self._stop = True
+        self.thread.join(timeout=5)
+        self.server.close()
+
+
+@pytest.fixture
+def server(tmp_path):
+    f = ServerFixture(tmp_path)
+    yield f
+    f.close()
+
+
+def test_client_end_to_end(server):
+    from tigerbeetle_tpu.client import Client
+
+    c = Client(server.address, CLUSTER, client_id=77)
+    assert c.create_accounts(
+        [{"id": 1, "ledger": 1, "code": 1}, {"id": 2, "ledger": 1, "code": 1}]
+    ) == []
+    assert c.create_transfers(
+        [{"id": 10, "debit_account_id": 1, "credit_account_id": 2,
+          "amount": 250, "ledger": 1, "code": 1}]
+    ) == []
+    rows = c.lookup_accounts([1, 2])
+    assert types.u128_get(rows[0], "debits_posted") == 250
+    assert types.u128_get(rows[1], "credits_posted") == 250
+
+    transfers = c.get_account_transfers(1)
+    assert len(transfers) == 1
+    assert types.u128_get(transfers[0], "amount") == 250
+
+    # Error results round-trip.
+    results = c.create_accounts([{"id": 1, "ledger": 1, "code": 2}])
+    assert results == [(0, types.CreateAccountResult.exists_with_different_code)]
+    c.close()
+
+
+def test_two_clients_isolated_sessions(server):
+    from tigerbeetle_tpu.client import Client
+
+    a = Client(server.address, CLUSTER, client_id=101)
+    b = Client(server.address, CLUSTER, client_id=102)
+    assert a.create_accounts([{"id": 5, "ledger": 1, "code": 1}]) == []
+    assert b.create_accounts([{"id": 6, "ledger": 1, "code": 1}]) == []
+    assert len(a.lookup_accounts([5, 6])) == 2
+    a.close()
+    b.close()
+
+
+def test_repl_statements(server):
+    from tigerbeetle_tpu import repl
+    from tigerbeetle_tpu.client import Client
+
+    c = Client(server.address, CLUSTER, client_id=55)
+    out = repl.execute(
+        c, "create_accounts id=1 ledger=700 code=10, id=2 ledger=700 code=10;"
+    )
+    assert out == []
+    out = repl.execute(
+        c,
+        "create_transfers id=9 debit_account_id=1 credit_account_id=2 "
+        "amount=55 ledger=700 code=10;",
+    )
+    assert out == []
+    out = repl.execute(c, "lookup_accounts id=1;")
+    assert out[0]["id"] == 1 and out[0]["debits_posted"] == 55
+    out = repl.execute(c, "get_account_transfers account_id=1 limit=10;")
+    assert len(out) == 1 and out[0]["amount"] == 55
+
+    # flags parsing
+    out = repl.execute(
+        c,
+        "create_transfers id=11 debit_account_id=1 credit_account_id=2 "
+        "amount=5 ledger=700 code=10 flags=pending;",
+    )
+    assert out == []
+    c.close()
+
+
+def test_repl_run_stream(server):
+    from tigerbeetle_tpu import repl
+    from tigerbeetle_tpu.client import Client
+
+    c = Client(server.address, CLUSTER, client_id=56)
+    stdout = io.StringIO()
+    repl.run(
+        c,
+        command="create_accounts id=31 ledger=1 code=1; lookup_accounts id=31",
+        stdout=stdout,
+    )
+    lines = stdout.getvalue().strip().splitlines()
+    assert lines[0] == "ok"
+    assert json.loads(lines[1])["id"] == 31
+    c.close()
+
+
+def test_tcp_restart_recovers(tmp_path):
+    from tigerbeetle_tpu.client import Client
+
+    f = ServerFixture(tmp_path)
+    c = Client(f.address, CLUSTER, client_id=60)
+    c.create_accounts([{"id": 1, "ledger": 1, "code": 1},
+                       {"id": 2, "ledger": 1, "code": 1}])
+    c.create_transfers([{"id": 4, "debit_account_id": 1,
+                         "credit_account_id": 2, "amount": 9,
+                         "ledger": 1, "code": 1}])
+    c.close()
+    f.close()
+
+    f2 = ServerFixture(tmp_path)
+    c2 = Client(f2.address, CLUSTER, client_id=61)
+    rows = c2.lookup_accounts([1])
+    assert types.u128_get(rows[0], "debits_posted") == 9
+    c2.close()
+    f2.close()
+
+
+def test_benchmark_smoke():
+    from tigerbeetle_tpu.benchmark import run_benchmark
+
+    result = run_benchmark(
+        addresses=None, cluster=0, n_transfers=5000, n_accounts=100,
+        batch=1000, use_cpu=True,
+    )
+    assert result["transfers"] == 5000
+    assert result["transfers_per_second"] > 0
+    assert result["batch_latency_p100_ms"] >= result["batch_latency_p50_ms"]
+
+
+def test_cli_version_and_format(tmp_path, capsys):
+    from tigerbeetle_tpu import cli
+
+    cli.main(["version"])
+    assert "0.1" in capsys.readouterr().out
+
+    path = str(tmp_path / "f.tigerbeetle")
+    cli.main([f"format", "--cluster=9", path])
+    assert "formatted" in capsys.readouterr().out
+    assert os.path.getsize(path) > 0
+
+
+def test_three_replica_tcp_cluster(tmp_path):
+    """Real TCP mesh: three in-process servers, client at the primary."""
+    from tigerbeetle_tpu.client import Client
+    from tigerbeetle_tpu.runtime.server import ReplicaServer, format_data_file
+
+    # Bind three listeners first (port 0), then rewrite the address list.
+    servers = []
+    paths = [str(tmp_path / f"r{i}.tigerbeetle") for i in range(3)]
+    addresses = ["127.0.0.1:0"] * 3
+    for i in range(3):
+        format_data_file(paths[i], cluster=CLUSTER, replica_index=i,
+                         replica_count=3, config=cfg.TEST_MIN)
+        s = ReplicaServer(
+            paths[i], cluster=CLUSTER, addresses=list(addresses),
+            replica_index=i,
+            state_machine_factory=lambda: CpuStateMachine(cfg.TEST_MIN),
+            config=cfg.TEST_MIN,
+        )
+        addresses[i] = f"127.0.0.1:{s.port}"
+        servers.append(s)
+    for s in servers:
+        s.bus.addresses = list(addresses)
+
+    stop = [False]
+
+    def loop():
+        while not stop[0]:
+            for s in servers:
+                s.poll_once(timeout_ms=1)
+
+    thread = threading.Thread(target=loop, daemon=True)
+    thread.start()
+    try:
+        c = Client(addresses[0], CLUSTER, client_id=200, timeout_ms=30_000)
+        assert c.create_accounts(
+            [{"id": 1, "ledger": 1, "code": 1}, {"id": 2, "ledger": 1, "code": 1}]
+        ) == []
+        assert c.create_transfers(
+            [{"id": 3, "debit_account_id": 1, "credit_account_id": 2,
+              "amount": 12, "ledger": 1, "code": 1}]
+        ) == []
+        rows = c.lookup_accounts([1])
+        assert types.u128_get(rows[0], "debits_posted") == 12
+        c.close()
+
+        # Replication actually happened on the backups.
+        import time as _t
+
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            if all(s.replica.sm.transfer_timestamp(3) is not None
+                   for s in servers):
+                break
+            _t.sleep(0.05)
+        for s in servers:
+            assert s.replica.sm.transfer_timestamp(3) is not None
+    finally:
+        stop[0] = True
+        thread.join(timeout=5)
+        for s in servers:
+            s.close()

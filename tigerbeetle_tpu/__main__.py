@@ -1,0 +1,3 @@
+from tigerbeetle_tpu.cli import main
+
+main()

@@ -1,0 +1,131 @@
+"""CLI: format | start | version | repl | benchmark.
+
+reference: src/tigerbeetle/cli.zig:106-128 (same subcommands),
+src/tigerbeetle/benchmark_driver.zig + benchmark_load.zig (benchmark
+formats a temp single-replica cluster when no --addresses is given,
+then streams transfer batches and reports throughput + latency
+percentiles).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+from tigerbeetle_tpu import flags
+from tigerbeetle_tpu import constants as cfg
+
+VERSION = "0.1.0"
+
+USAGE = """usage: tigerbeetle-tpu <command> [flags]
+
+commands:
+  format     --cluster=<int> --replica=<i> --replica-count=<n> <path>
+  start      --addresses=<host:port,...> --replica=<i> [--cpu] <path>...
+  version
+  repl       --addresses=<host:port> [--cluster=<int>] [--command=<stmts>]
+  benchmark  [--transfers=N] [--accounts=N] [--batch=N] [--addresses=...]
+"""
+
+
+def _sm_factory(use_cpu: bool):
+    if use_cpu:
+        from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+        return lambda: CpuStateMachine(cfg.PRODUCTION)
+    from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+
+    return lambda: TpuStateMachine(cfg.PRODUCTION)
+
+
+def cmd_format(args: list[str]) -> None:
+    opts, paths = flags.parse(
+        args, {"cluster": None, "replica": 0, "replica_count": 1}
+    )
+    if len(paths) != 1:
+        flags.fatal("format requires exactly one data-file path")
+    from tigerbeetle_tpu.runtime.server import format_data_file
+
+    format_data_file(
+        paths[0], cluster=int(opts["cluster"], 0)
+        if isinstance(opts["cluster"], str) else opts["cluster"],
+        replica_index=opts["replica"], replica_count=opts["replica_count"],
+    )
+    print(f"formatted {paths[0]}")
+
+
+def cmd_start(args: list[str]) -> None:
+    opts, paths = flags.parse(
+        args, {"addresses": None, "replica": 0, "cluster": 0, "cpu": False}
+    )
+    if len(paths) != 1:
+        flags.fatal("start requires exactly one data-file path")
+    from tigerbeetle_tpu.runtime.server import ReplicaServer
+
+    server = ReplicaServer(
+        paths[0], cluster=opts["cluster"],
+        addresses=opts["addresses"].split(","), replica_index=opts["replica"],
+        state_machine_factory=_sm_factory(opts["cpu"]),
+    )
+    print(f"listening on port {server.port}", flush=True)
+    server.serve_forever()
+
+
+def cmd_repl(args: list[str]) -> None:
+    opts, _ = flags.parse(
+        args, {"addresses": None, "cluster": 0, "command": ""}
+    )
+    from tigerbeetle_tpu.client import Client
+    from tigerbeetle_tpu import repl
+
+    client = Client(opts["addresses"].split(",")[0], opts["cluster"])
+    try:
+        repl.run(client, command=opts["command"] or None)
+    finally:
+        client.close()
+
+
+def cmd_benchmark(args: list[str]) -> None:
+    opts, _ = flags.parse(
+        args,
+        {
+            "addresses": "", "cluster": 0, "transfers": 100_000,
+            "accounts": 10_000, "batch": 8190, "cpu": False,
+        },
+    )
+    from tigerbeetle_tpu.benchmark import run_benchmark
+
+    result = run_benchmark(
+        addresses=opts["addresses"] or None, cluster=opts["cluster"],
+        n_transfers=opts["transfers"], n_accounts=opts["accounts"],
+        batch=opts["batch"], use_cpu=opts["cpu"],
+    )
+    print(json.dumps(result))
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(USAGE)
+        raise SystemExit(1)
+    command, *rest = argv
+    if command == "version":
+        print(VERSION)
+    elif command == "format":
+        cmd_format(rest)
+    elif command == "start":
+        cmd_start(rest)
+    elif command == "repl":
+        cmd_repl(rest)
+    elif command == "benchmark":
+        cmd_benchmark(rest)
+    else:
+        print(USAGE)
+        flags.fatal(f"unknown command {command!r}")
+
+
+if __name__ == "__main__":
+    main()

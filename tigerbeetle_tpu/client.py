@@ -1,0 +1,136 @@
+"""Python client: typed API over the native C-ABI session.
+
+The role of the reference's language clients (reference:
+src/clients/python would be the analog; all funnel through the
+tb_client C ABI — src/clients/c/tb_client.zig:1-142).  Batches are
+encoded straight into the 128-byte wire layouts (numpy structured
+arrays), so the bytes this client sends are exactly what the state
+machine kernel consumes — the zero-copy "batch encoder feeds the
+device" path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.runtime.native import NativeClient
+from tigerbeetle_tpu.types import (
+    ACCOUNT_BALANCE_DTYPE,
+    ACCOUNT_DTYPE,
+    ACCOUNT_FILTER_DTYPE,
+    CREATE_RESULT_DTYPE,
+    TRANSFER_DTYPE,
+    CreateAccountResult,
+    CreateTransferResult,
+    Operation,
+)
+
+
+class Client:
+    """Synchronous client for one cluster address.
+
+    >>> c = Client("127.0.0.1:3001", cluster_id=0)
+    >>> c.create_accounts([{"id": 1, "ledger": 1, "code": 1}])
+    []
+    """
+
+    def __init__(self, address: str, cluster_id: int = 0, *,
+                 client_id: int | None = None, timeout_ms: int = 10_000) -> None:
+        host, _, port = address.rpartition(":")
+        if client_id is None:
+            client_id = int.from_bytes(__import__("os").urandom(8), "little") | 1
+        self._native = NativeClient(
+            host or "127.0.0.1", int(port), cluster_id, client_id
+        )
+        self.timeout_ms = timeout_ms
+
+    def close(self) -> None:
+        self._native.close()
+
+    # ------------------------------------------------------------------
+
+    def _rows(self, dtype: np.dtype, events, u128_fields) -> bytes:
+        arr = np.zeros(len(events), dtype=dtype)
+        for i, ev in enumerate(events):
+            if isinstance(ev, np.void):
+                arr[i] = ev
+                continue
+            for key, value in ev.items():
+                if key in u128_fields:
+                    types.u128_set(arr[i], key, value)
+                else:
+                    arr[i][key] = value
+        return arr.tobytes()
+
+    def create_accounts(self, accounts) -> list[tuple[int, CreateAccountResult]]:
+        body = self._rows(
+            ACCOUNT_DTYPE, accounts,
+            {"id", "debits_pending", "debits_posted", "credits_pending",
+             "credits_posted", "user_data_128"},
+        )
+        reply = self._native.request(
+            Operation.create_accounts, body, self.timeout_ms
+        )
+        out = np.frombuffer(reply, CREATE_RESULT_DTYPE)
+        return [
+            (int(r["index"]), CreateAccountResult(int(r["result"]))) for r in out
+        ]
+
+    def create_transfers(self, transfers) -> list[tuple[int, CreateTransferResult]]:
+        body = self._rows(
+            TRANSFER_DTYPE, transfers,
+            {"id", "debit_account_id", "credit_account_id", "amount",
+             "pending_id", "user_data_128"},
+        )
+        reply = self._native.request(
+            Operation.create_transfers, body, self.timeout_ms
+        )
+        out = np.frombuffer(reply, CREATE_RESULT_DTYPE)
+        return [
+            (int(r["index"]), CreateTransferResult(int(r["result"]))) for r in out
+        ]
+
+    def _ids(self, ids) -> bytes:
+        arr = np.zeros(len(ids), types.U128_PAIR_DTYPE)
+        for i, v in enumerate(ids):
+            arr[i]["lo"] = v & types.U64_MAX
+            arr[i]["hi"] = v >> 64
+        return arr.tobytes()
+
+    def lookup_accounts(self, ids) -> np.ndarray:
+        reply = self._native.request(
+            Operation.lookup_accounts, self._ids(ids), self.timeout_ms
+        )
+        return np.frombuffer(reply, ACCOUNT_DTYPE)
+
+    def lookup_transfers(self, ids) -> np.ndarray:
+        reply = self._native.request(
+            Operation.lookup_transfers, self._ids(ids), self.timeout_ms
+        )
+        return np.frombuffer(reply, TRANSFER_DTYPE)
+
+    def _filter(self, account_id: int, *, timestamp_min=0, timestamp_max=0,
+                limit=8190, flags=types.AccountFilterFlags.debits
+                | types.AccountFilterFlags.credits) -> bytes:
+        row = np.zeros(1, ACCOUNT_FILTER_DTYPE)[0]
+        types.u128_set(row, "account_id", account_id)
+        row["timestamp_min"] = timestamp_min
+        row["timestamp_max"] = timestamp_max
+        row["limit"] = limit
+        row["flags"] = flags
+        return row.tobytes()
+
+    def get_account_transfers(self, account_id: int, **kw) -> np.ndarray:
+        reply = self._native.request(
+            Operation.get_account_transfers, self._filter(account_id, **kw),
+            self.timeout_ms,
+        )
+        return np.frombuffer(reply, TRANSFER_DTYPE)
+
+    def get_account_balances(self, account_id: int, **kw) -> np.ndarray:
+        reply = self._native.request(
+            Operation.get_account_balances, self._filter(account_id, **kw),
+            self.timeout_ms,
+        )
+        return np.frombuffer(reply, ACCOUNT_BALANCE_DTYPE)

@@ -1,0 +1,5 @@
+from tigerbeetle_tpu.runtime.native import (  # noqa: F401
+    NativeBus,
+    NativeClient,
+    native_available,
+)

@@ -1,0 +1,196 @@
+"""ctypes bindings for the native host runtime (native/tb_runtime.cpp).
+
+The C++ library provides the epoll event loop, the header-framed TCP
+message bus, and the C-ABI client session (the reference's io /
+message_bus / tb_client components, reference: src/io/linux.zig,
+src/message_bus.zig, src/clients/c/tb_client.zig).  Python loads it
+via ctypes; if it hasn't been built yet and a compiler exists, it is
+built on first use (make -C native).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.environ.get(
+    "TB_RUNTIME_LIB", os.path.join(_NATIVE_DIR, "libtb_runtime.so")
+)
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class _Event(ctypes.Structure):
+    _fields_ = [
+        ("type", ctypes.c_int32),
+        ("conn", ctypes.c_int32),
+        ("data", ctypes.POINTER(ctypes.c_uint8)),
+        ("len", ctypes.c_uint32),
+    ]
+
+
+EV_ACCEPTED, EV_CONNECTED, EV_MESSAGE, EV_CLOSED = 1, 2, 3, 4
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR], check=True,
+                    capture_output=True, timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+
+        lib.tb_bus_create.restype = ctypes.c_void_p
+        lib.tb_bus_create.argtypes = [ctypes.c_uint32]
+        lib.tb_bus_destroy.argtypes = [ctypes.c_void_p]
+        lib.tb_bus_listen.restype = ctypes.c_int
+        lib.tb_bus_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16]
+        lib.tb_bus_listen_port.restype = ctypes.c_int
+        lib.tb_bus_listen_port.argtypes = [ctypes.c_void_p]
+        lib.tb_bus_connect.restype = ctypes.c_int
+        lib.tb_bus_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16]
+        lib.tb_bus_send.restype = ctypes.c_int
+        lib.tb_bus_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.tb_bus_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tb_bus_poll.restype = ctypes.c_int
+        lib.tb_bus_poll.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tb_bus_next_event.restype = ctypes.c_int
+        lib.tb_bus_next_event.argtypes = [ctypes.c_void_p, ctypes.POINTER(_Event)]
+        lib.tb_client_init.restype = ctypes.c_void_p
+        lib.tb_client_init.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.tb_client_deinit.argtypes = [ctypes.c_void_p]
+        lib.tb_client_request.restype = ctypes.c_int64
+        lib.tb_client_request.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint8, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int,
+        ]
+        lib.tb_checksum128.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64 * 2,
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_checksum128(data: bytes) -> int:
+    lib = _load()
+    out = (ctypes.c_uint64 * 2)()
+    lib.tb_checksum128(data, len(data), out)
+    return int(out[0]) | (int(out[1]) << 64)
+
+
+class NativeBus:
+    """Event-loop TCP bus: listen/connect/send/poll."""
+
+    def __init__(self, message_size_max: int = 1 << 20) -> None:
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._bus = self._lib.tb_bus_create(message_size_max)
+        if not self._bus:
+            raise RuntimeError("tb_bus_create failed")
+
+    def listen(self, host: str, port: int) -> int:
+        rc = self._lib.tb_bus_listen(self._bus, host.encode(), port)
+        if rc != 0:
+            raise OSError(f"listen {host}:{port} failed")
+        return self._lib.tb_bus_listen_port(self._bus)
+
+    def connect(self, host: str, port: int) -> int:
+        conn = self._lib.tb_bus_connect(self._bus, host.encode(), port)
+        if conn < 0:
+            raise OSError(f"connect {host}:{port} failed")
+        return conn
+
+    def send(self, conn: int, data: bytes) -> None:
+        self._lib.tb_bus_send(self._bus, conn, data, len(data))
+
+    def close_conn(self, conn: int) -> None:
+        self._lib.tb_bus_close(self._bus, conn)
+
+    def poll(self, timeout_ms: int = 0) -> list[tuple[int, int, bytes]]:
+        """-> [(event_type, conn, payload)]; payload copied out."""
+        self._lib.tb_bus_poll(self._bus, timeout_ms)
+        events = []
+        ev = _Event()
+        while self._lib.tb_bus_next_event(self._bus, ctypes.byref(ev)):
+            payload = b""
+            if ev.type == EV_MESSAGE and ev.len:
+                payload = ctypes.string_at(ev.data, ev.len)
+            events.append((int(ev.type), int(ev.conn), payload))
+        return events
+
+    def close(self) -> None:
+        if self._bus:
+            self._lib.tb_bus_destroy(self._bus)
+            self._bus = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeClient:
+    """Synchronous C-ABI client session (the tb_client analog)."""
+
+    def __init__(self, host: str, port: int, cluster: int, client_id: int,
+                 reply_cap: int = 1 << 20) -> None:
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._client = self._lib.tb_client_init(
+            host.encode(), port, cluster,
+            client_id & 0xFFFFFFFFFFFFFFFF, client_id >> 64,
+        )
+        if not self._client:
+            raise OSError(f"tb_client_init {host}:{port} failed")
+        self._reply_buf = ctypes.create_string_buffer(reply_cap)
+
+    def request(self, operation: int, body: bytes = b"",
+                timeout_ms: int = 10_000) -> bytes:
+        rc = self._lib.tb_client_request(
+            self._client, operation, body, len(body),
+            self._reply_buf, len(self._reply_buf), timeout_ms,
+        )
+        if rc < 0:
+            raise OSError(
+                {-2: "evicted", -3: "timeout", -4: "io error", -5: "reply too large"}
+                .get(rc, f"error {rc}")
+            )
+        return self._reply_buf.raw[:rc]
+
+    def close(self) -> None:
+        if self._client:
+            self._lib.tb_client_deinit(self._client)
+            self._client = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:
+            pass

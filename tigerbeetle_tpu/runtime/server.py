@@ -1,0 +1,197 @@
+"""TCP replica server: the `tigerbeetle start` process loop.
+
+Bridges the native message bus (runtime/native.py) to a VsrReplica:
+peers handshake with a `ping` carrying their replica index, clients
+are identified by the `client` field of their requests, and the loop
+alternates bus polling with replica ticks (reference:
+src/tigerbeetle/main.zig:382-384 `replica.tick(); io.run_for_ns(...)`).
+
+Peer connection rule: replica i initiates connections to every j < i
+(one TCP connection per replica pair); reconnects are retried each
+tick (reference: src/message_bus.zig reconnect w/ backoff).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu.constants import HEADER_SIZE
+from tigerbeetle_tpu.vsr import replica as vsr_format
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.multi import VsrReplica
+from tigerbeetle_tpu.vsr.storage import FileStorage, ZoneLayout
+from tigerbeetle_tpu.vsr.wire import Command
+from tigerbeetle_tpu.runtime.native import (
+    EV_CLOSED,
+    EV_MESSAGE,
+    NativeBus,
+)
+
+TICK_NS = 10_000_000  # 10ms, matching the sim cluster's tick
+
+
+def parse_address(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class TcpBus:
+    """VsrReplica-facing bus adapter over the native TCP bus."""
+
+    def __init__(self, addresses: list[str], replica_index: int,
+                 message_size_max: int) -> None:
+        self.addresses = addresses
+        self.index = replica_index
+        self.native = NativeBus(message_size_max)
+        host, port = parse_address(addresses[replica_index])
+        self.port = self.native.listen(host, port)
+        self.replica_conns: dict[int, int] = {}
+        self.client_conns: dict[int, int] = {}
+        self._conn_peer: dict[int, tuple[str, object]] = {}
+        self._pending_connects: dict[int, int] = {}  # conn -> replica
+
+    # -- VsrReplica interface --
+
+    def send(self, dst_replica: int, header: np.ndarray, body: bytes) -> None:
+        conn = self.replica_conns.get(dst_replica)
+        if conn is None:
+            return  # not connected yet; protocol retransmits
+        self.native.send(conn, header.tobytes() + body)
+
+    def send_client(self, client: int, header: np.ndarray, body: bytes) -> None:
+        conn = self.client_conns.get(client)
+        if conn is None:
+            return
+        self.native.send(conn, header.tobytes() + body)
+
+    # -- connection management --
+
+    def connect_peers(self, cluster: int, view: int) -> None:
+        """(Re)connect to every lower-indexed peer we're missing."""
+        for j in range(self.index):
+            if j in self.replica_conns:
+                continue
+            if j in self._pending_connects.values():
+                continue
+            host, port = parse_address(self.addresses[j])
+            try:
+                conn = self.native.connect(host, port)
+            except OSError:
+                continue
+            self._pending_connects[conn] = j
+            self._announce(conn, cluster, view)
+
+    def _announce(self, conn: int, cluster: int, view: int) -> None:
+        h = wire.make_header(
+            command=Command.ping, cluster=cluster, view=view,
+            replica=self.index,
+        )
+        wire.finalize_header(h, b"")
+        self.native.send(conn, h.tobytes())
+
+    def register_peer(self, conn: int, replica_index: int) -> None:
+        self._pending_connects.pop(conn, None)
+        self.replica_conns[replica_index] = conn
+        self._conn_peer[conn] = ("replica", replica_index)
+
+    def register_client(self, conn: int, client: int) -> None:
+        self.client_conns[client] = conn
+        self._conn_peer[conn] = ("client", client)
+
+    def drop_conn(self, conn: int) -> None:
+        self._pending_connects.pop(conn, None)
+        kind_id = self._conn_peer.pop(conn, None)
+        if kind_id is None:
+            return
+        kind, peer = kind_id
+        if kind == "replica":
+            self.replica_conns.pop(peer, None)
+        else:
+            self.client_conns.pop(peer, None)
+
+
+class ReplicaServer:
+    def __init__(self, data_path: str, *, cluster: int,
+                 addresses: list[str], replica_index: int,
+                 state_machine_factory, config: cfg.Config = cfg.PRODUCTION,
+                 grid_size: int = 1 << 20) -> None:
+        layout = ZoneLayout(config=config, grid_size=grid_size)
+        self.storage = FileStorage(data_path, layout)
+        self.bus = TcpBus(addresses, replica_index, config.message_size_max)
+        self.replica = VsrReplica(
+            self.storage, cluster, state_machine_factory(), self.bus,
+            replica=replica_index, replica_count=len(addresses),
+        )
+        self.replica.open()
+        self._last_tick = 0
+
+    @property
+    def port(self) -> int:
+        return self.bus.port
+
+    def poll_once(self, timeout_ms: int = 10) -> None:
+        """One loop iteration: deliver bus events + tick on cadence."""
+        for ev_type, conn, payload in self.bus.native.poll(timeout_ms):
+            if ev_type == EV_CLOSED:
+                self.bus.drop_conn(conn)
+            elif ev_type == EV_MESSAGE:
+                self._on_raw_message(conn, payload)
+        now = time.monotonic_ns()
+        if now - self._last_tick >= TICK_NS:
+            self._last_tick = now
+            self.replica.realtime = time.time_ns()
+            self.replica.tick()
+            self.bus.connect_peers(self.replica.cluster, self.replica.view)
+
+    def _on_raw_message(self, conn: int, payload: bytes) -> None:
+        if len(payload) < HEADER_SIZE:
+            return
+        header = wire.header_from_bytes(payload[:HEADER_SIZE])
+        body = payload[HEADER_SIZE:]
+        if not wire.verify_header(header, body):
+            return
+        cmd = int(header["command"])
+        if cmd == Command.ping:
+            self.bus.register_peer(conn, int(header["replica"]))
+            # Answer so the peer can map us too.
+            pong = wire.make_header(
+                command=Command.pong, cluster=self.replica.cluster,
+                view=self.replica.view, replica=self.replica.replica,
+            )
+            wire.finalize_header(pong, b"")
+            self.bus.native.send(conn, pong.tobytes())
+            return
+        if cmd == Command.pong:
+            self.bus.register_peer(conn, int(header["replica"]))
+            return
+        if cmd == Command.request:
+            self.bus.register_client(conn, wire.u128(header, "client"))
+        elif int(header["replica"]) != self.replica.replica:
+            # Learn peer identity from any replica-sourced message.
+            kind = self.bus._conn_peer.get(conn)
+            if kind is None and cmd not in (
+                int(Command.reply), int(Command.eviction),
+            ):
+                self.bus.register_peer(conn, int(header["replica"]))
+        self.replica.on_message(header, body)
+
+    def serve_forever(self) -> None:
+        while True:
+            self.poll_once()
+
+    def close(self) -> None:
+        self.bus.native.close()
+        self.storage.close()
+
+
+def format_data_file(path: str, *, cluster: int, replica_index: int = 0,
+                     replica_count: int = 1,
+                     config: cfg.Config = cfg.PRODUCTION,
+                     grid_size: int = 1 << 20) -> None:
+    layout = ZoneLayout(config=config, grid_size=grid_size)
+    storage = FileStorage(path, layout, create=True)
+    vsr_format.format(storage, cluster, replica_index, replica_count)
+    storage.close()
