@@ -1,0 +1,35 @@
+"""VOPR runs: seeded whole-cluster fuzzing with nemesis events."""
+
+import pytest
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu.testing.vopr import Vopr, Workload
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42, 1234])
+def test_vopr_seed(seed):
+    Vopr(seed, requests=80).run()
+
+
+def test_vopr_no_faults_longer():
+    Vopr(99, requests=200, packet_loss=0.0, crash_probability=0.0).run()
+
+
+def test_vopr_heavy_faults():
+    Vopr(31337, requests=50, packet_loss=0.05, crash_probability=0.02).run()
+
+
+def test_vopr_tpu_state_machine():
+    from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+
+    Vopr(
+        17, requests=40, packet_loss=0.0, crash_probability=0.0,
+        state_machine_factory=lambda: TpuStateMachine(cfg.TEST_MIN),
+    ).run()
+
+
+def test_workload_deterministic():
+    a = Workload(5)
+    b = Workload(5)
+    for _ in range(50):
+        assert a.next_request() == b.next_request()

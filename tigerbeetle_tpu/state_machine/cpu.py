@@ -1108,15 +1108,19 @@ class CpuStateMachine:
         checksummed blob)."""
         import pickle
 
-        return pickle.dumps(
-            {k: getattr(self, k) for k in self._SNAPSHOT_FIELDS}, protocol=5
-        )
+        state = {k: getattr(self, k) for k in self._SNAPSHOT_FIELDS}
+        # Sets pickle in history-dependent iteration order; canonicalize
+        # so equal states give byte-equal snapshots (the convergence
+        # checkers compare snapshot bytes).
+        state["expires_at_index"] = sorted(state["expires_at_index"])
+        return pickle.dumps(state, protocol=5)
 
     def restore(self, data: bytes) -> None:
         import pickle
 
         state = pickle.loads(data)
         assert set(state) == set(self._SNAPSHOT_FIELDS)
+        state["expires_at_index"] = set(state["expires_at_index"])
         for k, v in state.items():
             setattr(self, k, v)
         self.prepare_timestamp = self.commit_timestamp

@@ -201,6 +201,7 @@ class Cluster:
         self.config = config
         self.network = PacketSimulator(options or PacketOptions(), seed)
         factory = state_machine_factory or (lambda: CpuStateMachine(config))
+        self._factory = factory
 
         self.replicas: list[VsrReplica] = []
         self.storages: list[MemoryStorage] = []
@@ -225,11 +226,34 @@ class Cluster:
         return c
 
     # ------------------------------------------------------------------
+    # Nemesis (reference: src/simulator.zig:194-204 crash/restart).
+
+    def crash_replica(self, index: int) -> None:
+        """Power-loss crash: unsynced sectors are lost (seeded), the
+        process is gone until restart_replica."""
+        self.storages[index].crash()
+        self.network.partition(index)
+        self.replicas[index].status = "crashed"
+
+    def restart_replica(self, index: int, state_machine=None) -> None:
+        storage = self.storages[index]
+        self.network.heal(index)
+        r = VsrReplica(
+            storage, self.cluster_id,
+            state_machine or self._factory(), _Bus(self, index),
+            replica=index, replica_count=self.replica_count,
+        )
+        r.open()
+        self.replicas[index] = r
+
+    # ------------------------------------------------------------------
 
     def step(self) -> None:
         """One tick: advance time, tick everyone, deliver due packets."""
         self.realtime += types.NS_PER_S // 100  # 10ms per tick
         for r in self.replicas:
+            if r.status == "crashed":
+                continue
             r.realtime = self.realtime
             r.tick()
         for c in self.clients.values():

