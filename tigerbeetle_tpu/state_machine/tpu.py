@@ -122,11 +122,11 @@ class Columns:
     def append(self, **arrays) -> np.ndarray:
         n = len(next(iter(arrays.values())))
         self._ensure(n)
-        rows = np.arange(self.count, self.count + n)
+        lo, hi = self.count, self.count + n
         for name, arr in arrays.items():
-            self._cols[name][rows] = arr
-        self.count += n
-        return rows
+            self._cols[name][lo:hi] = arr
+        self.count = hi
+        return np.arange(lo, hi)
 
     def truncate(self, count: int) -> None:
         assert count <= self.count
@@ -284,6 +284,10 @@ class TpuStateMachine:
     def _commit_create_accounts(self, timestamp: int, input_bytes: bytes) -> bytes:
         events = np.frombuffer(input_bytes, dtype=ACCOUNT_DTYPE)
         n = len(events)
+
+        reply = self._commit_create_accounts_fast(timestamp, events, n)
+        if reply is not None:
+            return reply
         results: list[tuple[int, int]] = []
 
         chain: int | None = None
@@ -397,6 +401,64 @@ class TpuStateMachine:
             out[i]["result"] = result
         return out.tobytes()
 
+    def _commit_create_accounts_fast(
+        self, timestamp: int, events: np.ndarray, n: int
+    ) -> bytes | None:
+        """Vectorized all-valid batch: no chains, no failures, no
+        existing ids — else None routes to the exact per-event loop."""
+        if n == 0:
+            return b""
+        flags = events["flags"].astype(np.uint32)
+        if (flags & np.uint32(AF.linked)).any():
+            return None
+        id_lo = events["id_lo"].astype(np.uint64)
+        id_hi = events["id_hi"].astype(np.uint64)
+        invalid = (
+            (events["timestamp"] != 0)
+            | (events["reserved"] != 0)
+            | ((flags & ~np.uint32(0xF)) != 0)
+            | ((id_lo == 0) & (id_hi == 0))
+            | ((id_lo == np.uint64(U64_MAX)) & (id_hi == np.uint64(U64_MAX)))
+            | (
+                ((flags & np.uint32(AF.debits_must_not_exceed_credits)) != 0)
+                & ((flags & np.uint32(AF.credits_must_not_exceed_debits)) != 0)
+            )
+            | (events["ledger"] == 0)
+            | (events["code"] == 0)
+        )
+        for field in ("debits_pending", "debits_posted", "credits_pending",
+                      "credits_posted"):
+            invalid |= (events[f"{field}_lo"] != 0) | (events[f"{field}_hi"] != 0)
+        if invalid.any():
+            return None
+        if n > 1 and not (
+            (id_hi[1:] == id_hi[:-1]).all() and (id_lo[1:] > id_lo[:-1]).all()
+        ):
+            mix = id_lo * np.uint64(0x9E3779B97F4A7C15) + id_hi * np.uint64(
+                0xC2B2AE3D27D4EB4F
+            )
+            if len(np.unique(mix)) != n:
+                return None
+        found, _ = self._acct_dir.lookup(id_lo, id_hi)
+        if found.any():
+            return None
+
+        base = self._attrs.count
+        ts0 = np.uint64(timestamp - n + 1)
+        rows = self._attrs.append(
+            id_lo=id_lo, id_hi=id_hi,
+            ud128_lo=events["user_data_128_lo"],
+            ud128_hi=events["user_data_128_hi"],
+            ud64=events["user_data_64"], ud32=events["user_data_32"],
+            ledger=events["ledger"], code=events["code"], flags=flags,
+            timestamp=ts0 + np.arange(n, dtype=np.uint64),
+        )
+        assert rows[0] == base
+        self._acct_dir.insert(id_lo, id_hi, rows.astype(np.uint64))
+        self.commit_timestamp = timestamp
+        self._ensure_balance_capacity(self._attrs.count)
+        return b""
+
     def _create_account_checked(self, row, ev, exists_ladder) -> int:
         # reference: src/state_machine.zig:1421-1448
         if int(row["reserved"]) != 0:
@@ -442,8 +504,6 @@ class TpuStateMachine:
             return b""
         ts_base = timestamp - n + 1
 
-        B = next(b for b in _BATCH_BUCKETS if b >= n)
-
         id_lo = events["id_lo"].astype(np.uint64)
         id_hi = events["id_hi"].astype(np.uint64)
         dr_lo = events["debit_account_id_lo"].astype(np.uint64)
@@ -471,12 +531,46 @@ class TpuStateMachine:
         dr_ledger = np.where(dr_found, self._attrs["ledger"][np.clip(dr_slot, 0, None)], 0).astype(np.uint32)
         cr_ledger = np.where(cr_found, self._attrs["ledger"][np.clip(cr_slot, 0, None)], 0).astype(np.uint32)
 
+        # Elementary predicates, shared by the all-valid short circuit
+        # and the precedence ladder.
+        id_zero = (id_lo == 0) & (id_hi == 0)
+        id_max = (id_lo == np.uint64(U64_MAX)) & (id_hi == np.uint64(U64_MAX))
+        reserved = (flags & ~np.uint32(0x3F)) != 0
+        dr_zero = (dr_lo == 0) & (dr_hi == 0)
+        dr_max = (dr_lo == np.uint64(U64_MAX)) & (dr_hi == np.uint64(U64_MAX))
+        cr_zero = (cr_lo == 0) & (cr_hi == 0)
+        cr_max = (cr_lo == np.uint64(U64_MAX)) & (cr_hi == np.uint64(U64_MAX))
+        same_acct = (dr_lo == cr_lo) & (dr_hi == cr_hi)
+        pend_zero = (pend_lo == 0) & (pend_hi == 0)
+        not_pending_flag = (flags & kernel.F_PENDING) == 0
+        not_balancing = (flags & (kernel.F_BAL_DR | kernel.F_BAL_CR)) == 0
+        amount_zero = (amount_lo == 0) & (amount_hi == 0)
+
+        # Short circuit: the hot path (well-formed plain transfers) hits
+        # ZERO ladder codes — one OR-reduction detects that and skips
+        # the ~25 masked-copyto cascade entirely.
+        if not is_pv.any():
+            any_invalid = (
+                reserved | id_zero | id_max | dr_zero | dr_max | cr_zero
+                | cr_max | same_acct | ~pend_zero | ~dr_found | ~cr_found
+                | (not_pending_flag & (timeout != 0))
+                | (not_balancing & amount_zero)
+                | (ledger == 0) | (code == 0)
+                | (dr_ledger != cr_ledger) | (ledger != dr_ledger)
+            ).any()
+            if not any_invalid:
+                static = _first_code(n)
+                return self._commit_transfers_resolved(
+                    n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi,
+                    flags, timeout, dr_slot, cr_slot, amount_lo, amount_hi,
+                    ledger, code, static, is_pv, dr_flags, cr_flags,
+                    dr_zero, cr_zero,
+                )
+
         # Static precedence ladder (reference: src/state_machine.zig:
         # 1465-1504 normal, :1614-1624 post/void prefix).
         static = _first_code(n)
-        id_zero = (id_lo == 0) & (id_hi == 0)
-        id_max = (id_lo == np.uint64(U64_MAX)) & (id_hi == np.uint64(U64_MAX))
-        _apply_code(static, (flags & ~np.uint32(0x3F)) != 0, CTR.reserved_flag)
+        _apply_code(static, reserved, CTR.reserved_flag)
         _apply_code(static, id_zero, CTR.id_must_not_be_zero)
         _apply_code(static, id_max, CTR.id_must_not_be_int_max)
 
@@ -489,7 +583,6 @@ class TpuStateMachine:
             | (is_pv & ((flags & kernel.F_BAL_DR) != 0))
             | (is_pv & ((flags & kernel.F_BAL_CR) != 0))
         )
-        pend_zero = (pend_lo == 0) & (pend_hi == 0)
         pend_max = (pend_lo == np.uint64(U64_MAX)) & (pend_hi == np.uint64(U64_MAX))
         pend_self = (pend_lo == id_lo) & (pend_hi == id_hi)
         _apply_code(static, is_pv & pv_excl, CTR.flags_are_mutually_exclusive)
@@ -500,24 +593,16 @@ class TpuStateMachine:
 
         # Normal static ladder.
         nm = ~is_pv
-        dr_zero = (dr_lo == 0) & (dr_hi == 0)
-        dr_max = (dr_lo == np.uint64(U64_MAX)) & (dr_hi == np.uint64(U64_MAX))
-        cr_zero = (cr_lo == 0) & (cr_hi == 0)
-        cr_max = (cr_lo == np.uint64(U64_MAX)) & (cr_hi == np.uint64(U64_MAX))
-        same_acct = (dr_lo == cr_lo) & (dr_hi == cr_hi)
         _apply_code(static, nm & dr_zero, CTR.debit_account_id_must_not_be_zero)
         _apply_code(static, nm & dr_max, CTR.debit_account_id_must_not_be_int_max)
         _apply_code(static, nm & cr_zero, CTR.credit_account_id_must_not_be_zero)
         _apply_code(static, nm & cr_max, CTR.credit_account_id_must_not_be_int_max)
         _apply_code(static, nm & same_acct, CTR.accounts_must_be_different)
         _apply_code(static, nm & ~pend_zero, CTR.pending_id_must_be_zero)
-        not_pending_flag = (flags & kernel.F_PENDING) == 0
         _apply_code(
             static, nm & not_pending_flag & (timeout != 0),
             CTR.timeout_reserved_for_pending_transfer,
         )
-        not_balancing = (flags & (kernel.F_BAL_DR | kernel.F_BAL_CR)) == 0
-        amount_zero = (amount_lo == 0) & (amount_hi == 0)
         _apply_code(static, nm & not_balancing & amount_zero, CTR.amount_must_not_be_zero)
         _apply_code(static, nm & (ledger == 0), CTR.ledger_must_not_be_zero)
         _apply_code(static, nm & (code == 0), CTR.code_must_not_be_zero)
@@ -530,6 +615,22 @@ class TpuStateMachine:
             static, nm & (ledger != dr_ledger),
             CTR.transfer_must_have_the_same_ledger_as_accounts,
         )
+
+        return self._commit_transfers_resolved(
+            n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi,
+            flags, timeout, dr_slot, cr_slot, amount_lo, amount_hi,
+            ledger, code, static, is_pv, dr_flags, cr_flags,
+            dr_zero, cr_zero,
+        )
+
+    def _commit_transfers_resolved(
+        self, n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi,
+        flags, timeout, dr_slot, cr_slot, amount_lo, amount_hi,
+        ledger, code, static, is_pv, dr_flags, cr_flags, dr_zero, cr_zero,
+    ) -> bytes:
+        """Fast-path routing + exact kernel dispatch, after account
+        resolution and the static ladder."""
+        B = next(b for b in _BATCH_BUCKETS if b >= n)
 
         # Durable joins (vectorized hash-index probes).
         e_found, e_row = self._tdir.lookup(id_lo, id_hi)
@@ -547,13 +648,27 @@ class TpuStateMachine:
                 | TF.balancing_credit
             )
         ).any()
-        # In-batch duplicate-id check via a 64-bit key mix: a hash
-        # collision only costs a detour through the exact scan path,
-        # which resolves true id groups.
-        id_mix = id_lo * np.uint64(0x9E3779B97F4A7C15) + id_hi * np.uint64(
-            0xC2B2AE3D27D4EB4F
-        )
-        if order_free and len(np.unique(id_mix)) == n and not e_found.any():
+        # In-batch duplicate-id check: strictly-increasing ids (the
+        # common encoder output) prove uniqueness without a sort; else
+        # a 64-bit key mix + unique — a hash collision only costs a
+        # detour through the exact scan path, which resolves true id
+        # groups.
+        if order_free:
+            ids_unique = bool(
+                n == 1
+                or (
+                    (id_hi[1:] == id_hi[:-1]).all()
+                    and (id_lo[1:] > id_lo[:-1]).all()
+                )
+            )
+            if not ids_unique:
+                id_mix = id_lo * np.uint64(0x9E3779B97F4A7C15) + id_hi * np.uint64(
+                    0xC2B2AE3D27D4EB4F
+                )
+                ids_unique = len(np.unique(id_mix)) == n
+        else:
+            ids_unique = False
+        if order_free and ids_unique and not e_found.any():
             acct_flags = dr_flags | cr_flags
             if not (
                 acct_flags
@@ -776,6 +891,7 @@ class TpuStateMachine:
             np.zeros(n, np.int32),
             np.zeros((n, 8), np.uint64), np.zeros((n, 8), np.uint64),
             last_applied, pulse_create, np.zeros(n, np.uint64),
+            no_history=True,
         )
 
         fail_idx = np.flatnonzero(results != 0)
@@ -789,29 +905,36 @@ class TpuStateMachine:
         results, created_mask, created, inb_status,
         dstat_init, dstat, uniq_rows, p_found, p_row, p_group, id_group,
         hist_dr, hist_cr, last_applied, pulse_create, pulse_remove,
+        no_history: bool = False,
     ) -> None:
         ok = results == 0
-        # 1. Insert created transfers into the columnar store.
+        # 1. Insert created transfers into the columnar store.  When
+        # the whole batch applied (the hot path), index with slices —
+        # no per-column fancy-gather copies.
         cm = created_mask
-        if cm.any():
+        if cm.all():
+            idx = np.arange(n)
+            sel = lambda a: a  # noqa: E731
+        elif cm.any():
             idx = np.flatnonzero(cm)
+            sel = lambda a: a[idx]  # noqa: E731
+        else:
+            idx = None
+        if idx is not None:
             ts = np.uint64(ts_base) + idx.astype(np.uint64)
-            status = np.zeros(len(idx), np.uint8)
-            # Pending creators carry their final in-batch status.
-            status[:] = inb_status[idx].astype(np.uint8)
             rows = self._store.append(
-                id_lo=id_lo[idx], id_hi=id_hi[idx],
-                dr_slot=created["dr_slot"][idx], cr_slot=created["cr_slot"][idx],
-                amount_lo=created["amount_lo"][idx], amount_hi=created["amount_hi"][idx],
-                pending_lo=created["pending_lo"][idx], pending_hi=created["pending_hi"][idx],
-                ud128_lo=created["ud128_lo"][idx], ud128_hi=created["ud128_hi"][idx],
-                ud64=created["ud64"][idx], ud32=created["ud32"][idx],
-                timeout=created["timeout"][idx].astype(np.uint32),
-                ledger=created["ledger"][idx], code=created["code"][idx],
-                flags=flags[idx], timestamp=ts,
-                status=status,
+                id_lo=sel(id_lo), id_hi=sel(id_hi),
+                dr_slot=sel(created["dr_slot"]), cr_slot=sel(created["cr_slot"]),
+                amount_lo=sel(created["amount_lo"]), amount_hi=sel(created["amount_hi"]),
+                pending_lo=sel(created["pending_lo"]), pending_hi=sel(created["pending_hi"]),
+                ud128_lo=sel(created["ud128_lo"]), ud128_hi=sel(created["ud128_hi"]),
+                ud64=sel(created["ud64"]), ud32=sel(created["ud32"]),
+                timeout=sel(created["timeout"]).astype(np.uint32, copy=False),
+                ledger=sel(created["ledger"]), code=sel(created["code"]),
+                flags=sel(flags), timestamp=ts,
+                status=sel(inb_status).astype(np.uint8),
             )
-            self._tdir.insert(id_lo[idx], id_hi[idx], rows.astype(np.uint64))
+            self._tdir.insert(sel(id_lo), sel(id_hi), rows.astype(np.uint64))
             row_of_event = np.full(n, -1, np.int64)
             row_of_event[idx] = rows
         else:
@@ -858,9 +981,10 @@ class TpuStateMachine:
                 if self.pulse_next_timestamp == remove_at:
                     self.pulse_next_timestamp = TIMESTAMP_MIN
 
-        # 5. Historical balances.
+        # 5. Historical balances (skipped when the fast-path admission
+        # already proved no account in the batch has flags.history).
         applied = cm & ok
-        if applied.any():
+        if not no_history and applied.any():
             idx = np.flatnonzero(applied)
             drs = created["dr_slot"][idx]
             crs = created["cr_slot"][idx]

@@ -76,16 +76,15 @@ class HashIndex:
             occ = self.used[p]
             free = pending[~occ]
             if len(free):
+                # Scatter all candidates; colliding writes resolve
+                # last-writer-wins, and a read-back identifies the one
+                # winner per bucket (keys are unique) — no sort needed.
                 fp = pos[free]
-                uniq, first = np.unique(fp, return_index=True)
-                winners = free[first]
-                wp = fp[first]
-                self.used[wp] = True
-                self.k_lo[wp] = lo[winners]
-                self.k_hi[wp] = hi[winners]
-                self.val[wp] = values[winners]
-                placed = np.zeros(len(free), bool)
-                placed[first] = True
+                self.used[fp] = True
+                self.k_lo[fp] = lo[free]
+                self.k_hi[fp] = hi[free]
+                self.val[fp] = values[free]
+                placed = (self.k_lo[fp] == lo[free]) & (self.k_hi[fp] == hi[free])
                 losers = free[~placed]
             else:
                 losers = free
