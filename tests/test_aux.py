@@ -1,0 +1,100 @@
+"""Aux subsystems: tracer, statsd, AOF, grid scrubber."""
+
+import json
+import socket
+
+import numpy as np
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.testing.harness import account, pack, transfer
+from tigerbeetle_tpu.utils.statsd import StatsD
+from tigerbeetle_tpu.utils.tracer import Tracer
+from tigerbeetle_tpu.vsr import aof as aof_mod
+from tigerbeetle_tpu.vsr import replica as vsr_replica
+from tigerbeetle_tpu.vsr.grid import Grid
+from tigerbeetle_tpu.vsr.scrubber import GridScrubber
+from tigerbeetle_tpu.vsr.storage import MemoryStorage, ZoneLayout
+
+
+def test_tracer_spans():
+    t = Tracer(backend="json")
+    with t.span("commit"):
+        with t.span("state_machine_commit"):
+            pass
+    doc = json.loads(t.dump())
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert names == ["state_machine_commit", "commit"]
+    assert all(e["dur"] >= 0 for e in doc["traceEvents"])
+
+    none = Tracer(backend="none")
+    with none.span("commit"):
+        pass
+    assert json.loads(none.dump())["traceEvents"] == []
+
+
+def test_statsd_lines():
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2)
+    port = recv.getsockname()[1]
+    s = StatsD(port=port, prefix="tb")
+    s.gauge("tx_per_s", 100.5)
+    s.count("batches")
+    s.timing("batch_ms", 12.5)
+    got = sorted(recv.recv(1024).decode() for _ in range(3))
+    assert got == [
+        "tb.batch_ms:12.5|ms", "tb.batches:1|c", "tb.tx_per_s:100.5|g",
+    ]
+    s.close()
+    recv.close()
+
+
+def test_aof_records_and_replays(tmp_path):
+    path = str(tmp_path / "log.aof")
+    storage = MemoryStorage(ZoneLayout(config=cfg.TEST_MIN, grid_size=1 << 20))
+    vsr_replica.format(storage, 5)
+    r = vsr_replica.Replica(
+        storage, 5, CpuStateMachine(cfg.TEST_MIN), aof=aof_mod.AOF(path)
+    )
+    r.open()
+    r.on_request(types.Operation.create_accounts, pack([account(1), account(2)]))
+    r.on_request(
+        types.Operation.create_transfers,
+        pack([transfer(9, debit_account_id=1, credit_account_id=2, amount=11)]),
+    )
+    r.aof.sync()
+
+    entries = list(aof_mod.iterate(path))
+    assert len(entries) >= 2
+
+    fresh = CpuStateMachine(cfg.TEST_MIN)
+    applied = aof_mod.replay(path, fresh, cluster=5)
+    assert applied >= 2
+    assert fresh.snapshot() == r.sm.snapshot()
+
+    # A torn tail entry truncates iteration, not crashes.
+    with open(path, "ab") as f:
+        f.write(b"\x01" * 100)
+    assert len(list(aof_mod.iterate(path))) == len(entries)
+
+
+def test_grid_scrubber_finds_corruption():
+    storage = MemoryStorage(ZoneLayout(config=cfg.TEST_MIN, grid_size=1 << 22))
+    grid = Grid(storage, block_size=4096, block_count=64)
+    fs = grid.free_set
+    res = fs.reserve(8)
+    addrs = [fs.acquire(res) for _ in range(8)]
+    fs.forfeit(res)
+    for a in addrs:
+        grid.write_block(a, bytes([a]) * 100)
+
+    bad = addrs[3]
+    storage.corrupt_sector(grid._offset(bad))
+
+    scrubber = GridScrubber(grid, blocks_per_tick=4)
+    found = []
+    while scrubber.cycles == 0:
+        found += scrubber.tick()
+    assert set(found) == {bad}

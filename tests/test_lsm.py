@@ -1,0 +1,226 @@
+"""LSM engine: EWAH codec, FreeSet, Grid, Tree, Groove, Forest."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu.lsm import ewah
+from tigerbeetle_tpu.lsm.runs import KEY_DTYPE, pack_u128
+from tigerbeetle_tpu.lsm.tree import Tree, k_way_merge_flags
+from tigerbeetle_tpu.lsm.forest import Forest
+from tigerbeetle_tpu.vsr.free_set import FreeSet
+from tigerbeetle_tpu.vsr.grid import Grid
+from tigerbeetle_tpu.vsr.storage import MemoryStorage, ZoneLayout
+
+
+def storage():
+    return MemoryStorage(ZoneLayout(config=cfg.TEST_MIN, grid_size=1 << 22))
+
+
+def grid(block_size=4096, block_count=1 << 10):
+    return Grid(storage(), block_size=block_size, block_count=block_count)
+
+
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_ewah_roundtrip(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 300))
+    words = np.zeros(n, np.uint64)
+    # Mix of runs of zeros, ones, and literals.
+    for _ in range(10):
+        at = int(rng.integers(n))
+        ln = int(rng.integers(1, 30))
+        kind = rng.integers(3)
+        if kind == 0:
+            words[at : at + ln] = 0
+        elif kind == 1:
+            words[at : at + ln] = np.uint64(0xFFFFFFFFFFFFFFFF)
+        else:
+            words[at : at + ln] = rng.integers(
+                1, 1 << 63, min(ln, n - at), dtype=np.uint64
+            )
+    encoded = ewah.encode(words)
+    np.testing.assert_array_equal(ewah.decode(encoded, n), words)
+    # Compressible input compresses.
+    uniform = np.zeros(1000, np.uint64)
+    assert len(ewah.encode(uniform)) == 8
+
+
+def test_free_set_reserve_acquire_forfeit():
+    fs = FreeSet(64)
+    r1 = fs.reserve(4)
+    r2 = fs.reserve(4)
+    a = [fs.acquire(r1), fs.acquire(r2), fs.acquire(r1)]
+    assert len(set(a)) == 3
+    fs.forfeit(r1)
+    fs.forfeit(r2)
+    assert fs.count_free() == 61
+    # Release is staged until checkpoint.
+    fs.release(a[0])
+    assert not fs.is_free(a[0])
+    fs.checkpoint()
+    assert fs.is_free(a[0])
+    # Round-trips through EWAH.
+    fs2 = FreeSet.decode(fs.encode(), 64)
+    np.testing.assert_array_equal(fs2.free, fs.free)
+
+
+def test_grid_blocks_checksummed():
+    g = grid()
+    fs = g.free_set
+    r = fs.reserve(2)
+    a1, a2 = fs.acquire(r), fs.acquire(r)
+    fs.forfeit(r)
+    g.write_block(a1, b"hello world")
+    g.write_block(a2, b"x" * 1000)
+    assert g.read_block(a1) == b"hello world"
+    assert g.verify_block(a2)
+    # Corrupt the sector behind a2: verify fails, read raises.
+    g.storage.corrupt_sector(g._offset(a2))
+    assert not g.verify_block(a2)
+    with pytest.raises(RuntimeError):
+        g.read_block(a2)
+
+
+# ----------------------------------------------------------------------
+
+
+def keys_of(ids):
+    ids = np.asarray(ids, np.uint64)
+    return pack_u128(ids, np.zeros(len(ids), np.uint64))
+
+
+def test_tree_put_lookup_across_seals():
+    t = Tree(grid(), "t", value_size=8, memtable_max=64)
+    rng = np.random.default_rng(0)
+    all_ids = rng.permutation(np.arange(1, 2001, dtype=np.uint64))
+    for at in range(0, 2000, 50):
+        chunk = all_ids[at : at + 50]
+        t.put_batch(keys_of(chunk), chunk.astype("<u8").view("V8"))
+        t.maybe_seal()
+    assert any(t.levels[i] for i in range(7))  # actually spilled
+
+    probe = rng.permutation(np.arange(1, 3001, dtype=np.uint64))
+    found, values = t.lookup_batch(keys_of(probe))
+    expect = probe <= 2000
+    np.testing.assert_array_equal(found, expect)
+    got = values.view("<u8").reshape(-1)[expect]
+    np.testing.assert_array_equal(got, probe[expect])
+
+
+def test_tree_overwrite_newest_wins():
+    t = Tree(grid(), "t", value_size=8, memtable_max=16)
+    ids = np.arange(1, 101, dtype=np.uint64)
+    t.put_batch(keys_of(ids), ids.astype("<u8").view("V8"))
+    t.seal_memtable()
+    t.put_batch(keys_of(ids), (ids * 7).astype("<u8").view("V8"))
+    t.seal_memtable()
+    found, values = t.lookup_batch(keys_of(ids))
+    assert found.all()
+    np.testing.assert_array_equal(values.view("<u8").reshape(-1), ids * 7)
+
+
+def test_tree_tombstones():
+    t = Tree(grid(), "t", value_size=8, memtable_max=16)
+    ids = np.arange(1, 101, dtype=np.uint64)
+    t.put_batch(keys_of(ids), ids.astype("<u8").view("V8"))
+    t.seal_memtable()
+    t.remove_batch(keys_of(ids[:50]))
+    t.seal_memtable()
+    found, _ = t.lookup_batch(keys_of(ids))
+    np.testing.assert_array_equal(found, ids > 50)
+    # Compactions drop tombstones at the last populated level.
+    for _ in range(20):
+        t.put_batch(keys_of(ids[50:]), ids[50:].astype("<u8").view("V8"))
+        t.seal_memtable()
+    found, _ = t.lookup_batch(keys_of(ids))
+    np.testing.assert_array_equal(found, ids > 50)
+
+
+def test_tree_scan_range():
+    t = Tree(grid(), "t", value_size=8, memtable_max=32)
+    ids = np.arange(1, 301, dtype=np.uint64)
+    t.put_batch(keys_of(ids), ids.astype("<u8").view("V8"))
+    t.seal_memtable()
+    t.put_batch(keys_of(np.array([500], np.uint64)),
+                np.array([500], "<u8").view("V8"))
+    lo = keys_of([100]).tobytes()
+    hi = keys_of([200]).tobytes()
+    keys, values = t.scan_range(lo, hi)
+    assert len(keys) == 101
+    np.testing.assert_array_equal(
+        values.view("<u8").reshape(-1), np.arange(100, 201)
+    )
+
+
+def test_k_way_merge_newest_first():
+    k1 = keys_of([1, 2, 3])
+    k2 = keys_of([2, 3, 4])
+    v = lambda a: np.asarray(a, "<u8").view(np.uint8).reshape(-1, 8)
+    newest = (k1, np.zeros(3, np.uint8), v([10, 20, 30]))
+    oldest = (k2, np.zeros(3, np.uint8), v([99, 99, 40]))
+    keys, flags, vals = k_way_merge_flags([newest, oldest], 8)
+    np.testing.assert_array_equal(
+        vals.view("<u8").reshape(-1), [10, 20, 30, 40]
+    )
+
+
+# ----------------------------------------------------------------------
+
+
+def test_groove_end_to_end_with_forest_checkpoint():
+    st = storage()
+    f = Forest(st, block_size=4096, block_count=1 << 10, memtable_max=64)
+    g = f.groove("transfers", object_size=128, index_fields=["ledger", "code"])
+
+    n = 500
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    ts = ids * 10
+    objects = np.zeros((n, 128), np.uint8)
+    objects[:, 0] = (ids & 0xFF).astype(np.uint8)
+    ledgers = np.where(ids % 2 == 0, 7, 8).astype(np.uint64)
+    codes = np.full(n, 3, np.uint64)
+    g.insert_batch(ids, np.zeros(n, np.uint64), ts, objects,
+                   {"ledger": ledgers, "code": codes})
+
+    found, got_ts = g.lookup_ids(ids[:10], np.zeros(10, np.uint64))
+    assert found.all()
+    np.testing.assert_array_equal(got_ts, ts[:10])
+
+    found, objs = g.get_objects(ts[:10])
+    assert found.all()
+    np.testing.assert_array_equal(objs[:, 0], ids[:10] & 0xFF)
+
+    scan = g.index_scan("ledger", 7)
+    np.testing.assert_array_equal(scan, ts[ids % 2 == 0])
+    both = g.index_intersect([g.index_scan("ledger", 7), g.index_scan("code", 3)])
+    np.testing.assert_array_equal(both, ts[ids % 2 == 0])
+
+    # Checkpoint -> new forest over same storage -> identical reads.
+    blob = f.checkpoint()
+    f2 = Forest(st, block_size=4096, block_count=1 << 10, memtable_max=64)
+    f2.groove("transfers", object_size=128, index_fields=["ledger", "code"])
+    f2.open(blob)
+    g2 = f2.grooves["transfers"]
+    found, got_ts = g2.lookup_ids(ids, np.zeros(n, np.uint64))
+    assert found.all()
+    np.testing.assert_array_equal(got_ts, ts)
+    np.testing.assert_array_equal(g2.index_scan("ledger", 8), ts[ids % 2 == 1])
+
+
+def test_tree_scales_past_memtable():
+    """State far exceeding the memtable spills and stays queryable."""
+    t = Tree(grid(block_count=1 << 12), "big", value_size=8, memtable_max=256)
+    rng = np.random.default_rng(3)
+    ids = rng.permutation(np.arange(1, 20_001, dtype=np.uint64))
+    for at in range(0, len(ids), 256):
+        chunk = ids[at : at + 256]
+        t.put_batch(keys_of(chunk), chunk.astype("<u8").view("V8"))
+        t.maybe_seal()
+    probe = rng.choice(ids, 1000, replace=False)
+    found, values = t.lookup_batch(keys_of(probe))
+    assert found.all()
+    np.testing.assert_array_equal(values.view("<u8").reshape(-1), probe)

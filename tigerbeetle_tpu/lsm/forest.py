@@ -1,0 +1,71 @@
+"""Forest: owns every groove's trees; open/compact/checkpoint.
+
+reference: src/lsm/forest.zig:31,324,375,547 — the forest opens from
+the manifest, paces compaction, and checkpoints all trees plus the
+free set.  In this build the manifest + free set serialize into the
+replica's checkpoint blob (recovery between checkpoints is WAL replay,
+so an append-only manifest log is not needed for crash consistency —
+the blob is the durable boundary, reference-equivalent at checkpoint
+granularity).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from tigerbeetle_tpu.lsm.groove import Groove
+from tigerbeetle_tpu.vsr.free_set import FreeSet
+from tigerbeetle_tpu.vsr.grid import Grid
+from tigerbeetle_tpu.vsr.storage import Storage
+
+
+class Forest:
+    def __init__(self, storage: Storage, *, block_size: int = 1 << 16,
+                 block_count: int = 1 << 12, base_offset: int | None = None,
+                 memtable_max: int = 8192) -> None:
+        self.grid = Grid(
+            storage, block_size=block_size, block_count=block_count,
+            base_offset=base_offset,
+        )
+        self.memtable_max = memtable_max
+        self.grooves: dict[str, Groove] = {}
+
+    def groove(self, name: str, *, object_size: int,
+               index_fields: list[str]) -> Groove:
+        assert name not in self.grooves
+        g = Groove(
+            self.grid, name, object_size=object_size,
+            index_fields=index_fields, memtable_max=self.memtable_max,
+        )
+        self.grooves[name] = g
+        return g
+
+    def compact(self) -> None:
+        for g in self.grooves.values():
+            g.maybe_seal()
+
+    def checkpoint(self) -> bytes:
+        """Seal all memtables, release staged blocks, and return the
+        manifest+free-set blob for the superblock-referenced snapshot."""
+        for g in self.grooves.values():
+            g.id_tree.seal_memtable()
+            g.object_tree.seal_memtable()
+            for t in g.indexes.values():
+                t.seal_memtable()
+        self.grid.free_set.checkpoint()
+        return pickle.dumps(
+            {
+                "grooves": {n: g.manifest() for n, g in self.grooves.items()},
+                "free_set": self.grid.free_set.encode(),
+                "block_count": self.grid.block_count,
+            },
+            protocol=5,
+        )
+
+    def open(self, blob: bytes) -> None:
+        state = pickle.loads(blob)
+        self.grid.free_set = FreeSet.decode(
+            state["free_set"], state["block_count"]
+        )
+        for name, manifest in state["grooves"].items():
+            self.grooves[name].restore(manifest)

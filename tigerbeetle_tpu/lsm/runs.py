@@ -22,3 +22,17 @@ def pack_u128(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
     s["hi"] = hi
     s["lo"] = lo
     return s.view(KEY_DTYPE).reshape(-1)
+
+
+def key_words(keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """V16 keys -> (word0, word1) native uint64, lexicographic order."""
+    w = keys.view(">u8").astype(np.uint64).reshape(-1, 2)
+    return w[:, 0], w[:, 1]
+
+
+def keys_le(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Elementwise a <= b for V16 keys (void dtypes lack ordering
+    ufuncs; sort/searchsorted still use memcmp order)."""
+    a0, a1 = key_words(a)
+    b0, b1 = key_words(b)
+    return (a0 < b0) | ((a0 == b0) & (a1 <= b1))

@@ -1,0 +1,74 @@
+"""Tracer: span tree with slot-based start/end discipline.
+
+reference: src/tracer.zig:1-70 — events are started/ended on fixed
+slots (so nesting bugs assert immediately), and emitted to a backend
+selected at init: `none` (no-op, zero overhead) or `json` (Chrome
+trace-event format, loadable in chrome://tracing / Perfetto — the
+tracy backend analog for this build).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+# Event vocabulary (reference: src/tracer.zig:48-70).
+EVENTS = (
+    "commit", "checkpoint",
+    "state_machine_prefetch", "state_machine_commit", "state_machine_compact",
+    "tree_compaction", "grid_read", "grid_write", "io_read", "io_write",
+    "replica_on_message", "journal_write",
+)
+
+
+class Tracer:
+    def __init__(self, backend: str = "none", process_id: int = 0,
+                 clock=time.perf_counter_ns) -> None:
+        assert backend in ("none", "json")
+        self.backend = backend
+        self.process_id = process_id
+        self.clock = clock
+        self._open: dict[str, int] = {}   # slot -> start ns
+        self._spans: list[dict] = []
+
+    def start(self, event: str, **args) -> None:
+        if self.backend == "none":
+            return
+        assert event not in self._open, f"span {event} already open"
+        self._open[event] = self.clock()
+        if args:
+            self._open_args = {event: args}
+
+    def stop(self, event: str) -> None:
+        if self.backend == "none":
+            return
+        begin = self._open.pop(event)
+        now = self.clock()
+        self._spans.append(
+            {
+                "name": event, "ph": "X", "pid": self.process_id, "tid": 0,
+                "ts": begin / 1e3, "dur": (now - begin) / 1e3,
+            }
+        )
+
+    def span(self, event: str):
+        tracer = self
+
+        class _Span:
+            def __enter__(self):
+                tracer.start(event)
+
+            def __exit__(self, *exc):
+                tracer.stop(event)
+                return False
+
+        return _Span()
+
+    def dump(self) -> str:
+        assert not self._open, f"open spans at dump: {list(self._open)}"
+        return json.dumps({"traceEvents": self._spans})
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.dump())

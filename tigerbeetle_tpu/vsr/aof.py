@@ -1,0 +1,77 @@
+"""AOF: append-only file of committed prepares.
+
+reference: src/aof.zig — an optional sequential log of every committed
+prepare (header + body), written at commit time before the state
+machine executes (reference: src/vsr/replica.zig:4136-4141).  Used for
+external audit/replay pipelines; entries are self-framing (the header
+carries the size) and checksum-verified on read.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import numpy as np
+
+from tigerbeetle_tpu.constants import HEADER_SIZE
+from tigerbeetle_tpu.vsr import wire
+
+
+class AOF:
+    def __init__(self, path: str) -> None:
+        self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+
+    def write(self, header: np.ndarray, body: bytes) -> None:
+        os.write(self._fd, header.tobytes() + body)
+
+    def sync(self) -> None:
+        os.fdatasync(self._fd)
+
+    def close(self) -> None:
+        os.close(self._fd)
+
+
+def iterate(path: str) -> Iterator[tuple[np.ndarray, bytes]]:
+    """Yield verified (header, body) entries; stops at the first torn
+    or corrupt entry (a crash mid-append truncates the log there)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    at = 0
+    while at + HEADER_SIZE <= len(data):
+        header = wire.header_from_bytes(data[at : at + HEADER_SIZE])
+        size = int(header["size"])
+        if size < HEADER_SIZE or at + size > len(data):
+            return
+        body = data[at + HEADER_SIZE : at + size]
+        if not wire.verify_header(header, body):
+            return
+        yield header, body
+        at += size
+
+
+def replay(path: str, state_machine, *, cluster: int | None = None) -> int:
+    """Re-execute an AOF through a fresh state machine; returns the
+    number of ops applied (deterministic replay — same guarantee as
+    WAL recovery)."""
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.vsr.wire import Command
+
+    applied = 0
+    for header, body in iterate(path):
+        if int(header["command"]) != Command.prepare:
+            continue
+        if cluster is not None and wire.u128(header, "cluster") != cluster:
+            continue
+        operation = int(header["operation"])
+        if operation < types.Operation.pulse:
+            continue  # VSR-internal ops (register, ...)
+        timestamp = int(header["timestamp"])
+        state_machine.prepare_timestamp = timestamp
+        sm_op = types.Operation(operation)
+        state_machine.prefetch(sm_op, body, prefetch_timestamp=timestamp)
+        state_machine.commit(
+            0, int(header["op"]), timestamp, sm_op, body
+        )
+        applied += 1
+    return applied

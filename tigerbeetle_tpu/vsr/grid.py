@@ -1,0 +1,104 @@
+"""Grid: checksummed block store over the data file's grid zone.
+
+reference: src/vsr/grid.zig:34-60 — fixed-size blocks addressed
+1..block_count, allocated by the FreeSet, verified on every read, with
+a set-associative-style block cache (ours: bounded LRU dict — the
+cache policy is host-side and not consensus-critical).
+
+Block layout: [64B header][payload], header =
+checksum u128 | address u64 | length u32 | block_type u8 | pad.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.free_set import FreeSet
+from tigerbeetle_tpu.vsr.storage import Storage
+
+BLOCK_HEADER_SIZE = 64
+
+BLOCK_DTYPE = np.dtype(
+    [
+        ("checksum_lo", "<u8"), ("checksum_hi", "<u8"),
+        ("address", "<u8"),
+        ("length", "<u4"),
+        ("block_type", "u1"),
+        ("reserved", "V35"),
+    ]
+)
+assert BLOCK_DTYPE.itemsize == BLOCK_HEADER_SIZE
+
+
+class Grid:
+    def __init__(self, storage: Storage, *, block_size: int = 1 << 16,
+                 block_count: int = 1 << 12, base_offset: int | None = None,
+                 cache_blocks: int = 256) -> None:
+        self.storage = storage
+        self.block_size = block_size
+        assert block_size % 4096 == 0
+        self.block_count = block_count
+        self.base = (
+            storage.layout.grid_offset if base_offset is None else base_offset
+        )
+        self.free_set = FreeSet(block_count)
+        self._cache: collections.OrderedDict[int, bytes] = collections.OrderedDict()
+        self._cache_max = cache_blocks
+
+    @property
+    def payload_size(self) -> int:
+        return self.block_size - BLOCK_HEADER_SIZE
+
+    def _offset(self, address: int) -> int:
+        assert 1 <= address <= self.block_count
+        return self.base + (address - 1) * self.block_size
+
+    def write_block(self, address: int, payload: bytes,
+                    block_type: int = 1) -> None:
+        assert len(payload) <= self.payload_size
+        h = np.zeros(1, BLOCK_DTYPE)[0]
+        h["address"] = address
+        h["length"] = len(payload)
+        h["block_type"] = block_type
+        c = wire.checksum(payload)
+        h["checksum_lo"] = c & 0xFFFFFFFFFFFFFFFF
+        h["checksum_hi"] = c >> 64
+        block = (h.tobytes() + payload).ljust(self.block_size, b"\x00")
+        self.storage.write(self._offset(address), block)
+        self._cache_put(address, payload)
+
+    def read_block(self, address: int) -> bytes:
+        cached = self._cache.get(address)
+        if cached is not None:
+            self._cache.move_to_end(address)
+            return cached
+        raw = self.storage.read(self._offset(address), self.block_size)
+        h = np.frombuffer(raw[:BLOCK_HEADER_SIZE], BLOCK_DTYPE)[0]
+        length = int(h["length"])
+        if int(h["address"]) != address or length > self.payload_size:
+            raise RuntimeError(f"grid block {address} corrupt header")
+        payload = raw[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + length]
+        want = int(h["checksum_lo"]) | (int(h["checksum_hi"]) << 64)
+        if wire.checksum(payload) != want:
+            raise RuntimeError(f"grid block {address} corrupt payload")
+        self._cache_put(address, payload)
+        return payload
+
+    def verify_block(self, address: int) -> bool:
+        """Scrubber probe: is the on-disk block intact? (bypasses cache,
+        reference: src/vsr/grid_scrubber.zig)."""
+        try:
+            self._cache.pop(address, None)
+            self.read_block(address)
+            return True
+        except RuntimeError:
+            return False
+
+    def _cache_put(self, address: int, payload: bytes) -> None:
+        self._cache[address] = payload
+        self._cache.move_to_end(address)
+        while len(self._cache) > self._cache_max:
+            self._cache.popitem(last=False)

@@ -53,10 +53,11 @@ class Session:
 
 class Replica:
     def __init__(self, storage: Storage, cluster: int, state_machine,
-                 replica: int = 0, replica_count: int = 1) -> None:
+                 replica: int = 0, replica_count: int = 1, aof=None) -> None:
         self.storage = storage
         self.cluster = cluster
         self.sm = state_machine
+        self.aof = aof  # optional vsr.aof.AOF (reference: src/aof.zig)
         self.config = storage.layout.config
         self.replica = replica
         self.replica_count = replica_count
@@ -214,6 +215,9 @@ class Replica:
             # (prepare() only assigns timestamps, so setting the stored
             # value reproduces the live prepare exactly).
             self.sm.prepare_timestamp = timestamp
+        elif self.aof is not None:
+            # reference: src/vsr/replica.zig:4136-4141 — AOF before apply.
+            self.aof.write(header, body)
 
         if operation == int(VsrOperation.register):
             reply = b""
