@@ -57,7 +57,12 @@ def make_transfers(start_id: int, n: int, rng: np.random.Generator) -> bytes:
 def main() -> None:
     import jax
 
-    sm = TpuStateMachine(account_capacity=1 << 12)
+    # Static allocation, TigerBeetle-style: size the stores for the
+    # configured workload up front so the commit path never reallocates.
+    sm = TpuStateMachine(
+        account_capacity=1 << 12,
+        transfer_capacity=N_TRANSFERS + 2 * BATCH + 1024,
+    )
     h = SingleNodeHarness(sm)
     h.submit(Operation.create_accounts, make_accounts(N_ACCOUNTS))
 

@@ -98,3 +98,71 @@ def test_grid_scrubber_finds_corruption():
     while scrubber.cycles == 0:
         found += scrubber.tick()
     assert set(found) == {bad}
+
+
+# ---------------------------------------------------------------------------
+# RunIndex: run-compressed id directory (utils/hashindex.py).
+
+
+def _u64(*vals):
+    return np.array(vals, np.uint64)
+
+
+def test_runindex_sequential_batches_merge_and_lookup():
+    from tigerbeetle_tpu.utils import RunIndex
+
+    ix = RunIndex()
+    ix.insert(np.arange(1, 8191, dtype=np.uint64), np.zeros(8190, np.uint64),
+              np.arange(0, 8190, dtype=np.uint64))
+    ix.insert(np.arange(8191, 16381, dtype=np.uint64), np.zeros(8190, np.uint64),
+              np.arange(8190, 16380, dtype=np.uint64))
+    assert ix.count == 16380
+    found, vals = ix.lookup(_u64(1, 16380, 16381), _u64(0, 0, 0))
+    assert found.tolist() == [True, True, False]
+    assert vals[0] == 0 and vals[1] == 16379
+
+
+def test_runindex_hash_fallback_and_mixed_lookup():
+    from tigerbeetle_tpu.utils import RunIndex
+
+    ix = RunIndex()
+    ix.insert(np.arange(10, 20, dtype=np.uint64), np.zeros(10, np.uint64),
+              np.arange(10, dtype=np.uint64))
+    ix.insert(_u64(500, 7, 99), _u64(0, 0, 0), _u64(100, 101, 102))  # not a run
+    found, vals = ix.lookup(_u64(12, 7, 8), _u64(0, 0, 0))
+    assert found.tolist() == [True, True, False]
+    assert vals[0] == 2 and vals[1] == 101
+
+
+def test_runindex_remove_splits_and_empties_runs():
+    from tigerbeetle_tpu.utils import RunIndex
+
+    ix = RunIndex()
+    ix.insert(np.arange(10, 15, dtype=np.uint64), np.zeros(5, np.uint64),
+              np.arange(5, dtype=np.uint64))
+    ix.remove(_u64(12), _u64(0))  # split middle
+    found, vals = ix.lookup(np.arange(10, 15, dtype=np.uint64), np.zeros(5, np.uint64))
+    assert found.tolist() == [True, True, False, True, True]
+    assert vals[[0, 1, 3, 4]].tolist() == [0, 1, 3, 4]
+    ix.remove(_u64(10), _u64(0))  # shrink head
+    ix.remove(_u64(14), _u64(0))  # shrink tail
+    ix.remove(_u64(11), _u64(0))  # empty first run
+    ix.remove(_u64(13), _u64(0))  # empty last run -> group removed
+    assert ix.count == 0
+    found, _ = ix.lookup(_u64(13), _u64(0))  # must not crash on empty group
+    assert not found.any()
+    # Reinsert after emptying works.
+    ix.insert(np.arange(10, 12, dtype=np.uint64), np.zeros(2, np.uint64),
+              _u64(7, 8))
+    found, vals = ix.lookup(_u64(11), _u64(0))
+    assert found[0] and vals[0] == 8
+
+
+def test_runindex_rejects_wraparound_run():
+    from tigerbeetle_tpu.utils import RunIndex
+
+    ix = RunIndex()
+    lo = _u64(2**64 - 1, 0)
+    ix.insert(lo, _u64(7, 7), _u64(0, 1))
+    found, vals = ix.lookup(lo, _u64(7, 7))
+    assert found.all() and vals.tolist() == [0, 1]

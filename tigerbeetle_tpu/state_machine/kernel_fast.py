@@ -30,10 +30,13 @@ from tigerbeetle_tpu.ops import u128 as w
 # Flush shape buckets: only a few shapes ever compile.
 _FLUSH_BUCKETS = (4096, 32768, 131072, 524288)
 # Queue high-water mark: flush (async) once this many entries queue up.
-# Kept high: global compaction at flush time collapses the queue to at
-# most accounts*4 entries, and every read goes through a flush barrier,
-# so a bigger queue just means fewer (fused) device dispatches.
-FLUSH_THRESHOLD = 500_000
+# Low enough that device work overlaps the host commit loop (dispatch is
+# async); global compaction at flush time collapses each flush to at
+# most accounts*4 entries, so extra flushes cost one small dispatch, not
+# duplicated work — and the final drain barrier then waits on almost
+# nothing (the device link is high-latency, so a tail-end burst of
+# flushes is the worst case).
+FLUSH_THRESHOLD = 65_536
 
 
 def _flush_impl(balances, slots, cols, add_lo, add_hi):

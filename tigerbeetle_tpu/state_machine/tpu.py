@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from tigerbeetle_tpu import constants as cfg
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.lsm import pack_u128
-from tigerbeetle_tpu.utils import HashIndex
+from tigerbeetle_tpu.utils import HashIndex, RunIndex
 from tigerbeetle_tpu.state_machine import kernel, kernel_fast
 from tigerbeetle_tpu.state_machine.mirror import BalanceMirror, _sub_u128
 from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
@@ -92,6 +92,15 @@ _HISTORY_FIELDS = {
 }
 
 
+def _zeros_touched(shape, dtype) -> np.ndarray:
+    """Zeroed array with pages faulted in up front: appends write into
+    fresh pages, and eager sequential touching is ~4x cheaper than
+    faulting page-by-page from scattered slice writes."""
+    a = np.empty(shape, dtype)
+    a.fill(0)
+    return a
+
+
 class Columns:
     """Growable columnar array store with vectorized batch append."""
 
@@ -103,20 +112,21 @@ class Columns:
         for name, spec in fields.items():
             if isinstance(spec, tuple):
                 dtype, width = spec
-                self._cols[name] = np.zeros((capacity, width), dtype)
+                self._cols[name] = _zeros_touched((capacity, width), dtype)
             else:
-                self._cols[name] = np.zeros(capacity, spec)
+                self._cols[name] = _zeros_touched(capacity, spec)
 
     def _ensure(self, extra: int) -> None:
         need = self.count + extra
         if need <= self._cap:
             return
         while self._cap < need:
-            self._cap *= 2
+            self._cap *= 4
         for name, col in self._cols.items():
             shape = (self._cap,) + col.shape[1:]
-            new = np.zeros(shape, col.dtype)
+            new = np.empty(shape, col.dtype)
             new[: self.count] = col[: self.count]
+            new[self.count :].fill(0)
             self._cols[name] = new
 
     def append(self, **arrays) -> np.ndarray:
@@ -139,6 +149,13 @@ class Columns:
         return self._cols[name]
 
 
+def _dir_capacity(entries: int) -> int:
+    """Pow2 hash capacity holding `entries` at <=50% load (the hash is
+    the RunIndex fallback for non-sequential ids; presizing it keeps
+    random-id workloads from rehashing on the commit hot path)."""
+    return max(1 << 16, 1 << (2 * max(entries, 1)).bit_length())
+
+
 def _first_code(shape) -> np.ndarray:
     return np.zeros(shape, np.uint32)
 
@@ -151,8 +168,16 @@ class TpuStateMachine:
     """Accounting state machine with a JAX/TPU create_transfers path."""
 
     def __init__(
-        self, config: cfg.Config = cfg.PRODUCTION, account_capacity: int = 1 << 16
+        self,
+        config: cfg.Config = cfg.PRODUCTION,
+        account_capacity: int = 1 << 16,
+        transfer_capacity: int = 1 << 16,
     ) -> None:
+        """Capacities follow the reference's static-allocation design:
+        all large buffers are sized up front from operator-configured
+        limits (reference: docs/DESIGN.md static allocation;
+        src/config.zig storage limits), so the steady-state commit path
+        never grows or faults fresh pages."""
         self.config = config
         self.prepare_timestamp = 0
         self.commit_timestamp = 0
@@ -161,14 +186,14 @@ class TpuStateMachine:
         # Account state. The device table is authoritative; the host
         # mirror serves routing decisions and balance reads without
         # blocking on the device link (see mirror.py / kernel_fast.py).
-        self._acct_dir = HashIndex()
-        self._attrs = Columns(_ATTR_FIELDS)
+        self._acct_dir = RunIndex(_dir_capacity(account_capacity))
+        self._attrs = Columns(_ATTR_FIELDS, capacity=max(1024, account_capacity))
         self._dev = kernel_fast.DeviceTable(account_capacity)
         self._mirror = BalanceMirror(account_capacity)
 
         # Transfer state.
-        self._tdir = HashIndex()
-        self._store = Columns(_STORE_FIELDS)
+        self._tdir = RunIndex(_dir_capacity(transfer_capacity))
+        self._store = Columns(_STORE_FIELDS, capacity=max(1024, transfer_capacity))
         # expires_at index: (expires_at, row, active).
         self._exp = Columns(
             {"expires_at": np.uint64, "row": np.uint32, "active": np.bool_}
@@ -1303,13 +1328,13 @@ def _tpu_restore(self, data: bytes) -> None:
     self._history.append(**state["history"])
 
     # Rebuild directories (derived state, never serialized).
-    self._acct_dir = HashIndex()
     n_acct = self._attrs.count
+    self._acct_dir = RunIndex(_dir_capacity(n_acct))
     self._acct_dir.insert(
         self._attrs.col("id_lo"), self._attrs.col("id_hi"),
         np.arange(n_acct, dtype=np.uint64),
     )
-    self._tdir = HashIndex()
+    self._tdir = RunIndex(_dir_capacity(self._store.count))
     self._tdir.insert(
         self._store.col("id_lo"), self._store.col("id_hi"),
         np.arange(self._store.count, dtype=np.uint64),

@@ -1,3 +1,3 @@
-from tigerbeetle_tpu.utils.hashindex import HashIndex
+from tigerbeetle_tpu.utils.hashindex import HashIndex, RunIndex
 
-__all__ = ["HashIndex"]
+__all__ = ["HashIndex", "RunIndex"]
