@@ -1,0 +1,160 @@
+"""Cluster clock synchronization (vsr/clock.py).
+
+Covers Marzullo interval intersection (reference: src/vsr/marzullo.zig
+semantics), Clock sample admission/expiry, and the end-to-end property
+the reference's clock exists for: a primary with a skewed wall clock
+assigns prepare timestamps clamped toward the cluster majority's time
+(reference: src/vsr/clock.zig, src/vsr/replica.zig:5762-5772).
+"""
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.harness import account, pack, transfer
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.clock import (
+    EPOCH_MAX_NS,
+    OFFSET_TOLERANCE_NS,
+    Clock,
+    marzullo_smallest_interval,
+)
+
+MS = 1_000_000
+
+
+# ---------------------------------------------------------------------------
+# Marzullo.
+
+
+def test_marzullo_all_agree():
+    lo, hi, n = marzullo_smallest_interval([(0, 2), (1, 2), (-1, 2)])
+    assert n == 3
+    assert lo == -1 and hi == 1
+
+
+def test_marzullo_outlier_excluded():
+    # Two sources agree around 0; one claims +100 with a tight bound.
+    lo, hi, n = marzullo_smallest_interval([(0, 5), (2, 5), (100, 1)])
+    assert n == 2
+    assert -3 <= lo <= hi <= 5
+
+
+def test_marzullo_touching_endpoints_overlap():
+    # [0,10] and [10,20] touch at exactly 10 -> both count.
+    lo, hi, n = marzullo_smallest_interval([(5, 5), (15, 5)])
+    assert n == 2
+    assert lo == 10 and hi == 10
+
+
+def test_marzullo_empty_and_single():
+    assert marzullo_smallest_interval([]) == (0, 0, 0)
+    lo, hi, n = marzullo_smallest_interval([(7, 3)])
+    assert (lo, hi, n) == (4, 10, 1)
+
+
+def test_marzullo_majority_of_disjoint():
+    # Three disjoint camps of sizes 1/3/2 -> the size-3 camp wins.
+    tuples = [(0, 1)] + [(100, 2)] * 3 + [(200, 1)] * 2
+    lo, hi, n = marzullo_smallest_interval(tuples)
+    assert n == 3
+    assert 98 <= lo <= hi <= 102
+
+
+# ---------------------------------------------------------------------------
+# Clock.
+
+
+def test_clock_single_replica_always_synchronized():
+    c = Clock(0, 1)
+    assert c.synchronized
+    assert c.realtime_synchronized(12345) == 12345
+
+
+def test_clock_learns_and_clamps_skewed_local_clock():
+    c = Clock(0, 3)
+    assert not c.synchronized
+    # Local wall clock runs 500ms ahead of both peers (t1 = local-500ms
+    # at the sample midpoint), zero-RTT samples.
+    local = 10 * types.NS_PER_S
+    for peer, m in ((1, 100), (2, 200)):
+        c.learn(peer, m0=m, t1=local - 500 * MS, m2=m, realtime_now=local)
+    assert c.synchronized
+    # Majority window sits ~-500ms from us; our reading is clamped down.
+    rt = c.realtime_synchronized(local)
+    assert rt is not None and rt < local
+    assert abs((local - rt) - 500 * MS) <= 2 * OFFSET_TOLERANCE_NS
+
+
+def test_clock_rejects_unsane_samples():
+    c = Clock(0, 3)
+    c.learn(1, m0=100, t1=50, m2=90, realtime_now=100)  # monotonic regressed
+    c.learn(1, m0=0, t1=50, m2=10**12, realtime_now=100)  # rtt too large
+    assert not c._samples
+
+
+def test_clock_sample_expiry_desynchronizes():
+    c = Clock(0, 3)
+    c.learn(1, m0=0, t1=0, m2=0, realtime_now=0)
+    c.learn(2, m0=0, t1=0, m2=0, realtime_now=0)
+    assert c.synchronized
+    c.expire(EPOCH_MAX_NS + 1)
+    assert not c.synchronized
+    assert c.realtime_synchronized(123) is None
+
+
+# ---------------------------------------------------------------------------
+# End to end: skewed primary in a live cluster.
+
+
+def test_cluster_clock_skewed_primary_clamped():
+    cluster = Cluster(replica_count=3, seed=7)
+    skew = 2 * types.NS_PER_S
+    cluster.clock_skew[0] = skew  # replica 0 is the initial primary
+
+    for _ in range(20):  # let ping/pong rounds accumulate
+        cluster.step()
+    primary = cluster.replicas[0]
+    assert primary.is_primary
+    assert primary.clock.synchronized
+
+    client = cluster.client(1000)
+    client.register()
+    cluster.run_until(lambda: client.registered)
+    cluster.run_request(
+        client, Operation.create_accounts, pack([account(1), account(2)])
+    )
+    cluster.run_request(
+        client,
+        Operation.create_transfers,
+        pack([transfer(9, debit_account_id=1, credit_account_id=2, amount=5)]),
+    )
+    for _ in range(10):
+        cluster.step()
+
+    # The committed transfer's timestamp must track true cluster time,
+    # not the primary's wall clock 2s in the future.
+    ts = primary.sm.transfer_timestamp(9)
+    assert ts is not None
+    assert ts < cluster.realtime + skew // 2, (ts, cluster.realtime)
+    # And all replicas converge on the same state.
+    for r in cluster.replicas[1:]:
+        assert r.sm.transfer_timestamp(9) in (None, ts)
+
+
+def test_cluster_divergent_clocks_refuse_writes():
+    """When no majority of clocks agrees within tolerance, there is no
+    Marzullo window and the primary must NOT assign timestamps — the
+    documented safety property (reference: docs/about/safety.md clock
+    requirements; src/vsr/replica.zig realtime_synchronized gate)."""
+    cluster = Cluster(replica_count=3, seed=3)
+    cluster.clock_skew = [0, 60 * types.NS_PER_S, -60 * types.NS_PER_S]
+    for _ in range(50):
+        cluster.step()
+    primary = cluster.replicas[0]
+    assert not primary.clock.synchronized
+    client = cluster.client(1000)
+    client.register()
+    for _ in range(100):
+        cluster.step()
+    assert not client.registered  # queued, never prepared

@@ -16,6 +16,12 @@ HEADER_SIZE = 256  # reference: src/vsr/message_header.zig:17 (@sizeOf(Header))
 # reference: src/constants.zig:47
 VSR_OPERATIONS_RESERVED = 128
 
+# Event-loop tick length (ns): the simulator's wall-clock step, the
+# replica's virtual monotonic increment, and the server's tick cadence
+# all share this so clock-sync RTT arithmetic is consistent
+# (reference: src/constants.zig tick_ms).
+TICK_NS = 10_000_000
+
 
 @dataclasses.dataclass(frozen=True)
 class Config:

@@ -30,7 +30,7 @@ from tigerbeetle_tpu.runtime.native import (
     NativeBus,
 )
 
-TICK_NS = 10_000_000  # 10ms, matching the sim cluster's tick
+TICK_NS = cfg.TICK_NS
 
 
 def parse_address(addr: str) -> tuple[str, int]:
@@ -143,6 +143,10 @@ class ReplicaServer:
         if now - self._last_tick >= TICK_NS:
             self._last_tick = now
             self.replica.realtime = time.time_ns()
+            # Real elapsed time, not tick counts, so clock-sync RTT
+            # error bounds reflect event-loop stalls.
+            self.replica.monotonic_external = True
+            self.replica.monotonic = now
             self.replica.tick()
             self.bus.connect_peers(self.replica.cluster, self.replica.view)
 
@@ -154,18 +158,13 @@ class ReplicaServer:
         if not wire.verify_header(header, body):
             return
         cmd = int(header["command"])
-        if cmd == Command.ping:
+        if cmd in (Command.ping, Command.pong):
+            # Transport handshake: any ping/pong identifies the peer
+            # connection.  Then forward into the replica — pings carry
+            # clock-sync samples (vsr/clock.py) and the replica's pong
+            # reply rides the now-registered connection.
             self.bus.register_peer(conn, int(header["replica"]))
-            # Answer so the peer can map us too.
-            pong = wire.make_header(
-                command=Command.pong, cluster=self.replica.cluster,
-                view=self.replica.view, replica=self.replica.replica,
-            )
-            wire.finalize_header(pong, b"")
-            self.bus.native.send(conn, pong.tobytes())
-            return
-        if cmd == Command.pong:
-            self.bus.register_peer(conn, int(header["replica"]))
+            self.replica.on_message(header, body)
             return
         if cmd == Command.request:
             self.bus.register_client(conn, wire.u128(header, "client"))

@@ -529,19 +529,23 @@ class TpuStateMachine:
             return b""
         ts_base = timestamp - n + 1
 
-        id_lo = events["id_lo"].astype(np.uint64)
-        id_hi = events["id_hi"].astype(np.uint64)
-        dr_lo = events["debit_account_id_lo"].astype(np.uint64)
-        dr_hi = events["debit_account_id_hi"].astype(np.uint64)
-        cr_lo = events["credit_account_id_lo"].astype(np.uint64)
-        cr_hi = events["credit_account_id_hi"].astype(np.uint64)
-        pend_lo = events["pending_id_lo"].astype(np.uint64)
-        pend_hi = events["pending_id_hi"].astype(np.uint64)
-        amount_lo = events["amount_lo"].astype(np.uint64)
-        amount_hi = events["amount_hi"].astype(np.uint64)
+        # Same-width fields stay strided views into the 1 MiB wire
+        # buffer (it lives in L2 after the first pass, so elementwise
+        # ops on views beat paying a contiguous copy per column);
+        # narrower wire fields still widen via astype.
+        id_lo = np.asarray(events["id_lo"])
+        id_hi = np.asarray(events["id_hi"])
+        dr_lo = np.asarray(events["debit_account_id_lo"])
+        dr_hi = np.asarray(events["debit_account_id_hi"])
+        cr_lo = np.asarray(events["credit_account_id_lo"])
+        cr_hi = np.asarray(events["credit_account_id_hi"])
+        pend_lo = np.asarray(events["pending_id_lo"])
+        pend_hi = np.asarray(events["pending_id_hi"])
+        amount_lo = np.asarray(events["amount_lo"])
+        amount_hi = np.asarray(events["amount_hi"])
         flags = events["flags"].astype(np.uint32)
         timeout = events["timeout"].astype(np.uint64)
-        ledger = events["ledger"].astype(np.uint32)
+        ledger = np.asarray(events["ledger"])
         code = events["code"].astype(np.uint32)
 
         is_pv = (flags & (kernel.F_POST | kernel.F_VOID)) != 0
@@ -891,10 +895,10 @@ class TpuStateMachine:
             "cr_slot": cr_slot.astype(np.int32),
             "amount_lo": amount_lo, "amount_hi": amount_hi,
             "pending_lo": pend_lo, "pending_hi": pend_hi,
-            "ud128_lo": events["user_data_128_lo"].astype(np.uint64),
-            "ud128_hi": events["user_data_128_hi"].astype(np.uint64),
-            "ud64": events["user_data_64"].astype(np.uint64),
-            "ud32": events["user_data_32"].astype(np.uint32),
+            "ud128_lo": np.asarray(events["user_data_128_lo"]),
+            "ud128_hi": np.asarray(events["user_data_128_hi"]),
+            "ud64": np.asarray(events["user_data_64"]),
+            "ud32": np.asarray(events["user_data_32"]),
             "timeout": timeout,
             "ledger": ledger, "code": code,
         }

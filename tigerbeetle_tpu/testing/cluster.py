@@ -219,8 +219,16 @@ class Cluster:
             self.replicas.append(r)
         self.clients: dict[int, SimClient] = {}
         self.realtime = 0
+        # Per-replica wall-clock skew in ns (nemesis knob): replica i
+        # observes realtime + clock_skew[i].  The synchronized clock
+        # (vsr/clock.py) must keep primary timestamps near true time
+        # despite this.
+        self.clock_skew = [0] * replica_count
 
     def client(self, client_id: int) -> SimClient:
+        # Replica addresses occupy [0, replica_count) in the packet
+        # simulator's flat namespace.
+        assert client_id >= self.replica_count, "client id collides with replica"
         c = SimClient(self, client_id)
         self.clients[client_id] = c
         return c
@@ -250,11 +258,11 @@ class Cluster:
 
     def step(self) -> None:
         """One tick: advance time, tick everyone, deliver due packets."""
-        self.realtime += types.NS_PER_S // 100  # 10ms per tick
-        for r in self.replicas:
+        self.realtime += cfg.TICK_NS
+        for i, r in enumerate(self.replicas):
             if r.status == "crashed":
                 continue
-            r.realtime = self.realtime
+            r.realtime = self.realtime + self.clock_skew[i]
             r.tick()
         for c in self.clients.values():
             c.tick()

@@ -37,6 +37,7 @@ import numpy as np
 
 from tigerbeetle_tpu import constants, types
 from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.clock import Clock
 from tigerbeetle_tpu.vsr.replica import Replica, Session
 from tigerbeetle_tpu.vsr.wire import Command, VsrOperation
 
@@ -46,6 +47,11 @@ PING_TICKS = 2
 VIEW_CHANGE_TICKS = 10
 VIEW_CHANGE_RESEND_TICKS = 4
 REPAIR_RETRY_TICKS = 3
+
+# Virtual tick length for the per-replica monotonic clock; shared with
+# the simulator's wall-clock step and the server's tick cadence so
+# clock-sync RTT math stays consistent.
+TICK_NS = constants.TICK_NS
 
 
 @dataclasses.dataclass
@@ -80,10 +86,19 @@ class VsrReplica(Replica):
         self.pipeline: dict[int, PipelineEntry] = {}
         self.request_queue: list[tuple[np.ndarray, bytes]] = []
 
+        # Cluster clock synchronization (reference: src/vsr/clock.zig).
+        self.clock = Clock(replica, replica_count)
+        # Local monotonic ns: tick-advanced in the simulator; a real
+        # runtime sets monotonic_external and feeds time.monotonic_ns()
+        # so RTT error bounds reflect real elapsed time.
+        self.monotonic = 0
+        self.monotonic_external = False
+
         # Timers.
         self._ticks = 0
         self._last_primary_seen = 0
         self._last_ping_sent = 0
+        self._last_clock_ping = 0
         self._vc_last_sent = 0
         self._repair_last_sent = 0
         self._last_retransmit = 0
@@ -120,10 +135,17 @@ class VsrReplica(Replica):
 
     def tick(self) -> None:
         self._ticks += 1
+        if not self.monotonic_external:
+            self.monotonic += TICK_NS
+        if self.replica_count > 1:
+            if self._ticks - self._last_clock_ping >= PING_TICKS:
+                self._send_clock_pings()
+            self.clock.expire(self.monotonic)
         if self.status == "normal":
             if self.is_primary:
                 if self._ticks - self._last_ping_sent >= PING_TICKS:
                     self._send_heartbeat()
+                self._drain_request_queue()
                 self._maybe_pulse()
                 if self.pipeline and (
                     self._ticks - self._last_retransmit >= REPAIR_RETRY_TICKS
@@ -156,6 +178,8 @@ class VsrReplica(Replica):
         the primary turns due timeouts into a replicated pulse op."""
         if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
             return
+        if self.replica_count > 1 and not self.clock.synchronized:
+            return  # same clock gate as client requests
         self._advance_prepare_timestamp()
         if not self.sm.pulse_needed():
             return
@@ -200,6 +224,7 @@ class VsrReplica(Replica):
             Command.request_sync_checkpoint: self._on_request_sync,
             Command.sync_checkpoint: self._on_sync_checkpoint,
             Command.ping: self._on_ping,
+            Command.pong: self._on_pong,
         }.get(cmd)
         if handler is not None:
             handler(header, body)
@@ -226,6 +251,13 @@ class VsrReplica(Replica):
             if request == entry.request and request > 0:
                 self._send_stored_reply(client, entry)
                 return
+            if request == 0 and entry.request == 0:
+                # Re-sent register whose reply was lost: replay it
+                # instead of re-committing (a fresh commit would leak a
+                # reply slot — reference: duplicate register replays the
+                # stored reply, src/vsr/replica.zig:5035-5100).
+                self._send_register_reply(client, entry)
+                return
             if request < entry.request:
                 return  # stale duplicate
         if client:
@@ -244,10 +276,30 @@ class VsrReplica(Replica):
                     and int(qh["request"]) == request
                 ):
                     return
-        if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
+        if (
+            len(self.pipeline) >= self.config.pipeline_prepare_queue_max
+            or (self.replica_count > 1 and not self.clock.synchronized)
+        ):
+            # Pipeline full, or no timestamps yet because the cluster
+            # clock window doesn't exist (reference: src/vsr/replica.zig
+            # on_request gates on realtime_synchronized): queue and
+            # drain from tick()/commit.
             self.request_queue.append((header, body))
             return
         self._primary_prepare(header, body)
+
+    def _advance_prepare_timestamp(self) -> None:
+        """Primary timestamping through the synchronized cluster clock:
+        the local wall clock is clamped into the Marzullo window before
+        it feeds the strictly-monotonic prepare timestamp (reference:
+        src/vsr/replica.zig:5762-5772).  Falls back to the raw wall
+        clock while unsynchronized (e.g. before the first ping round)."""
+        rt = self.clock.realtime_synchronized(self.realtime)
+        if rt is None:
+            rt = self.realtime
+        self.sm.prepare_timestamp = max(
+            max(self.sm.prepare_timestamp, self.sm.commit_timestamp) + 1, rt
+        )
 
     def _primary_prepare(self, request: np.ndarray, body: bytes) -> None:
         operation = int(request["operation"])
@@ -328,26 +380,35 @@ class VsrReplica(Replica):
             del self.pipeline[op]
             if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
                 self.checkpoint()
-            while self.request_queue and (
-                len(self.pipeline) < self.config.pipeline_prepare_queue_max
-            ):
-                h, b = self.request_queue.pop(0)
-                self._primary_prepare(h, b)
+            self._drain_request_queue()
+
+    def _drain_request_queue(self) -> None:
+        """Prepare queued requests while pipeline slots are free — only
+        under a synchronized clock (every prepare path shares this
+        gate; see _on_request_msg)."""
+        if self.replica_count > 1 and not self.clock.synchronized:
+            return
+        while self.request_queue and (
+            len(self.pipeline) < self.config.pipeline_prepare_queue_max
+        ):
+            h, b = self.request_queue.pop(0)
+            self._primary_prepare(h, b)
+
+    def _send_register_reply(self, client: int, entry: Session) -> None:
+        reply = wire.make_header(
+            command=Command.reply, operation=VsrOperation.register,
+            cluster=self.cluster, client=client,
+            request=0, view=self.view,
+            op=entry.session, commit=entry.session,
+        )
+        wire.finalize_header(reply, b"")
+        self.bus.send_client(client, reply, b"")
 
     def _send_reply(self, prepare: np.ndarray, reply_body: bytes) -> None:
         client = wire.u128(prepare, "client")
         operation = int(prepare["operation"])
         if operation == int(VsrOperation.register):
-            entry = self.sessions[client]
-            reply = wire.make_header(
-                command=Command.reply, operation=operation,
-                cluster=self.cluster, client=client,
-                request=int(prepare["request"]), view=self.view,
-                op=int(prepare["op"]), commit=int(prepare["op"]),
-                timestamp=int(prepare["timestamp"]),
-            )
-            wire.finalize_header(reply, b"")
-            self.bus.send_client(client, reply, b"")
+            self._send_register_reply(client, self.sessions[client])
             return
         entry = self.sessions.get(client)
         if entry is not None and entry.reply_header:
@@ -477,13 +538,40 @@ class VsrReplica(Replica):
                 self._repair_wanted.setdefault(op, 0)
             self._send_repair_requests()
 
+    def _send_clock_pings(self) -> None:
+        """Sample every peer's wall clock: ping carries our monotonic
+        send time m0; the pong echoes it alongside the peer's wall
+        clock t1 (reference: src/vsr/replica.zig on_ping/on_pong)."""
+        self._last_clock_ping = self._ticks
+        ping = wire.make_header(
+            command=Command.ping, cluster=self.cluster, view=self.view,
+            replica=self.replica, timestamp=self.monotonic,
+        )
+        wire.finalize_header(ping, b"")
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.bus.send(r, ping, b"")
+
     def _on_ping(self, header: np.ndarray, body: bytes) -> None:
+        # Echo m0 in `timestamp`; our wall clock rides in `op` (clamped
+        # at 0 — the wire field is u64 and a skewed simulated clock can
+        # sit before the epoch at startup).
         pong = wire.make_header(
             command=Command.pong, cluster=self.cluster, view=self.view,
-            replica=self.replica,
+            replica=self.replica, timestamp=int(header["timestamp"]),
+            op=max(0, self.realtime),
         )
         wire.finalize_header(pong, b"")
         self.bus.send(int(header["replica"]), pong, b"")
+
+    def _on_pong(self, header: np.ndarray, body: bytes) -> None:
+        self.clock.learn(
+            int(header["replica"]),
+            m0=int(header["timestamp"]),
+            t1=int(header["op"]),
+            m2=self.monotonic,
+            realtime_now=self.realtime,
+        )
 
     # ------------------------------------------------------------------
     # Repair.
