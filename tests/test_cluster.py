@@ -162,3 +162,27 @@ def test_pending_expiry_replicated():
     )
     c.settle()
     c.check_convergence()
+
+
+def test_session_eviction_when_clients_max_exceeded():
+    """clients_max+1 registrations evict the oldest session
+    deterministically on every replica; the evicted client's next
+    request draws Command.eviction (reference:
+    src/vsr/client_sessions.zig evict, src/vsr.zig:301)."""
+    c = Cluster(replica_count=3, seed=4)
+    cmax = c.replicas[0].config.clients_max
+    clients = []
+    for i in range(cmax + 1):
+        cl = c.client(1000 + i)
+        cl.register()
+        c.run_until(lambda: cl.registered)
+        clients.append(cl)
+    for _ in range(20):
+        c.step()
+    # The over-capacity registration evicts the oldest session on every
+    # replica, and the primary notified the victim.
+    assert clients[0].evicted
+    assert not any(cl.evicted for cl in clients[1:])
+    for r in c.replicas:
+        assert clients[0].id not in r.sessions
+        assert len(r.sessions) == cmax

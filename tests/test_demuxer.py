@@ -1,0 +1,133 @@
+"""Logical request batching + reply demultiplexing.
+
+reference: src/state_machine.zig:122-176 (DemuxerType,
+batch_logical_allowed) — several client requests of one batchable
+operation share a prepare; each client receives only its slice of the
+batched reply, indexes rebased.
+"""
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import demuxer
+from tigerbeetle_tpu.state_machine.demuxer import Demuxer
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.harness import account, pack, transfer
+from tigerbeetle_tpu.types import (
+    CREATE_RESULT_DTYPE,
+    CreateTransferResult,
+    Operation,
+)
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.wire import Command
+
+
+def _results(*pairs):
+    arr = np.zeros(len(pairs), CREATE_RESULT_DTYPE)
+    for i, (index, result) in enumerate(pairs):
+        arr[i]["index"] = index
+        arr[i]["result"] = result
+    return arr.tobytes()
+
+
+def test_batch_logical_allowed():
+    assert demuxer.batch_logical_allowed(Operation.create_transfers)
+    assert demuxer.batch_logical_allowed(Operation.create_accounts)
+    assert not demuxer.batch_logical_allowed(Operation.lookup_accounts)
+    assert not demuxer.batch_logical_allowed(Operation.get_account_transfers)
+
+
+def test_demuxer_slices_and_rebases():
+    # 3 sub-batches of 4/3/5 events; failures at global 1, 4, 6, 11.
+    reply = _results((1, 10), (4, 20), (6, 30), (11, 40))
+    dm = Demuxer(Operation.create_transfers, reply)
+    a = np.frombuffer(dm.decode(0, 4), CREATE_RESULT_DTYPE)
+    b = np.frombuffer(dm.decode(4, 3), CREATE_RESULT_DTYPE)
+    c = np.frombuffer(dm.decode(7, 5), CREATE_RESULT_DTYPE)
+    assert [(int(r["index"]), int(r["result"])) for r in a] == [(1, 10)]
+    assert [(int(r["index"]), int(r["result"])) for r in b] == [(0, 20), (2, 30)]
+    assert [(int(r["index"]), int(r["result"])) for r in c] == [(4, 40)]
+
+
+def test_demuxer_empty_slices():
+    dm = Demuxer(Operation.create_accounts, b"")
+    assert dm.decode(0, 10) == b""
+    assert dm.decode(10, 5) == b""
+
+
+def test_trailer_roundtrip():
+    subs = [(1000, 3, 4), ((7 << 64) | 9, 1, 2)]
+    body = b"\x00" * (6 * demuxer.EVENT_SIZE) + demuxer.encode_trailer(subs)
+    events, got = demuxer.decode_trailer(body, 2)
+    assert got == subs
+    assert len(events) == 6 * demuxer.EVENT_SIZE
+    assert demuxer.strip_trailer(body, subs) == events
+
+
+def test_cluster_batched_prepare_demuxes_per_client():
+    """Two clients' transfer batches multiplexed into ONE prepare;
+    each gets its own failure slice with rebased indexes, and the
+    cluster converges."""
+    cluster = Cluster(replica_count=3, seed=5)
+    a = cluster.client(1000)
+    b = cluster.client(2000)
+    for c in (a, b):
+        c.register()
+        cluster.run_until(lambda: c.registered)
+    cluster.run_request(
+        a, Operation.create_accounts, pack([account(1), account(2)])
+    )
+
+    primary = cluster.replicas[0]
+    ops_before = primary.op
+
+    # Queue both requests while the primary cannot prepare (clock
+    # gate), then re-enable: the drain must batch them into one op.
+    def req(client, transfers):
+        client.request_number += 1
+        h = wire.make_header(
+            command=Command.request, operation=Operation.create_transfers,
+            cluster=cluster.cluster_id, client=client.id,
+            request=client.request_number,
+        )
+        body = pack(transfers)
+        wire.finalize_header(h, body)
+        client.reply = None
+        client._inflight = (h, body)
+        client._send()
+
+    # Hold the clock gate closed while both requests arrive (ping
+    # rounds would otherwise re-synchronize mid-delivery).
+    primary.clock.synchronized = False
+    primary.clock._synchronize = lambda monotonic_now: None
+    # a: ok, ok ; b: ok, FAIL(same accounts), ok
+    req(a, [
+        transfer(10, debit_account_id=1, credit_account_id=2, amount=1),
+        transfer(11, debit_account_id=1, credit_account_id=2, amount=2),
+    ])
+    req(b, [
+        transfer(12, debit_account_id=2, credit_account_id=1, amount=3),
+        transfer(13, debit_account_id=1, credit_account_id=1, amount=4),
+        transfer(14, debit_account_id=2, credit_account_id=1, amount=5),
+    ])
+    for _ in range(6):  # deliver requests into the gated queue
+        cluster.step()
+    assert len(primary.request_queue) == 2, len(primary.request_queue)
+    del primary.clock._synchronize
+    primary.clock.synchronized = True
+    cluster.run_until(lambda: a.reply is not None and b.reply is not None)
+
+    # Exactly one op for both requests.
+    assert primary.op == ops_before + 1
+    assert np.frombuffer(a.reply, CREATE_RESULT_DTYPE).size == 0
+    rb = np.frombuffer(b.reply, CREATE_RESULT_DTYPE)
+    assert [(int(r["index"]), int(r["result"])) for r in rb] == [
+        (1, int(CreateTransferResult.accounts_must_be_different))
+    ]
+    # All transfers except 13 exist everywhere once replicas catch up.
+    for _ in range(20):
+        cluster.step()
+    for r in cluster.replicas:
+        for tid in (10, 11, 12, 14):
+            assert r.sm.transfer_timestamp(tid) is not None, (r.replica, tid)
+        assert r.sm.transfer_timestamp(13) is None

@@ -118,6 +118,7 @@ class SimClient:
         self.view_guess = 0
         self.reply: bytes | None = None
         self.registered = False
+        self.evicted = False
         self._inflight: tuple[np.ndarray, bytes] | None = None
         self._last_sent = -(10**9)
         self.replies: list[bytes] = []
@@ -129,7 +130,12 @@ class SimClient:
             return
         cmd = Command(int(header["command"]))
         if cmd == Command.eviction:
-            raise RuntimeError(f"client {self.id} evicted")
+            # Fatal for the session (reference clients surface this as
+            # a terminal error); recorded, not raised, so a multi-client
+            # harness keeps stepping.
+            self.evicted = True
+            self._inflight = None
+            return
         if cmd != Command.reply:
             return
         if self._inflight is None:

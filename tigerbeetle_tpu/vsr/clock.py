@@ -119,7 +119,10 @@ class Clock:
         error = rtt // 2 + OFFSET_TOLERANCE_NS
         offset = t1 + rtt // 2 - realtime_now
         best = self._samples.get(peer)
-        if best is None or error < best.error:
+        # `<=` so a steady-RTT stream keeps refreshing learned_at —
+        # otherwise every sample would age out together at EPOCH_MAX
+        # and the cluster clock would flap unsynchronized periodically.
+        if best is None or error <= best.error:
             self._samples[peer] = _Sample(offset, error, m2)
         self._synchronize(m2)
 

@@ -36,6 +36,7 @@ import dataclasses
 import numpy as np
 
 from tigerbeetle_tpu import constants, types
+from tigerbeetle_tpu.state_machine import demuxer
 from tigerbeetle_tpu.vsr import wire
 from tigerbeetle_tpu.vsr.clock import Clock
 from tigerbeetle_tpu.vsr.replica import Replica, Session
@@ -59,6 +60,10 @@ class PipelineEntry:
     header: np.ndarray
     body: bytes
     ok_replicas: set[int]
+    # Logical batch sub-requests [(client, request, event_count)] when
+    # this prepare multiplexes several client requests (see
+    # state_machine/demuxer.py); None for plain prepares.
+    subs: list[tuple[int, int, int]] | None = None
 
 
 class VsrReplica(Replica):
@@ -243,20 +248,23 @@ class VsrReplica(Replica):
         request = int(header["request"])
         operation = int(header["operation"])
 
-        if operation != int(VsrOperation.register) and client:
+        if operation == int(VsrOperation.register) and client:
+            entry = self.sessions.get(client)
+            if entry is not None:
+                # Re-sent register whose reply was lost: replay it
+                # instead of re-committing (a fresh commit would leak a
+                # reply slot and evict an innocent session — reference:
+                # duplicate register replays the stored reply,
+                # src/vsr/replica.zig:5035-5100).
+                self._send_register_reply(client, entry)
+                return
+        elif client:
             entry = self.sessions.get(client)
             if entry is None:
                 self._send_eviction(client)
                 return
             if request == entry.request and request > 0:
                 self._send_stored_reply(client, entry)
-                return
-            if request == 0 and entry.request == 0:
-                # Re-sent register whose reply was lost: replay it
-                # instead of re-committing (a fresh commit would leak a
-                # reply slot — reference: duplicate register replays the
-                # stored reply, src/vsr/replica.zig:5035-5100).
-                self._send_register_reply(client, entry)
                 return
             if request < entry.request:
                 return  # stale duplicate
@@ -268,6 +276,10 @@ class VsrReplica(Replica):
                 if (
                     wire.u128(pe.header, "client") == client
                     and int(pe.header["request"]) == request
+                ):
+                    return
+                if pe.subs and any(
+                    c == client and r == request for c, r, _ in pe.subs
                 ):
                     return
             for qh, _ in self.request_queue:
@@ -301,11 +313,15 @@ class VsrReplica(Replica):
             max(self.sm.prepare_timestamp, self.sm.commit_timestamp) + 1, rt
         )
 
-    def _primary_prepare(self, request: np.ndarray, body: bytes) -> None:
+    def _primary_prepare(
+        self, request: np.ndarray, body: bytes,
+        subs: list[tuple[int, int, int]] | None = None,
+    ) -> None:
         operation = int(request["operation"])
         self._advance_prepare_timestamp()
         if operation >= constants.VSR_OPERATIONS_RESERVED:
-            self.sm.prepare(types.Operation(operation), body)
+            events = demuxer.strip_trailer(body, subs) if subs else body
+            self.sm.prepare(types.Operation(operation), events)
         timestamp = self.sm.prepare_timestamp
 
         op = self.op + 1
@@ -315,13 +331,14 @@ class VsrReplica(Replica):
             request=int(request["request"]), view=self.view,
             op=op, commit=self.commit_min, timestamp=timestamp,
             parent=self.parent_checksum, replica=self.replica,
+            context=len(subs) if subs else 0,
         )
         wire.finalize_header(prepare, body)
 
         self.journal.write_prepare(prepare, body)
         self.op = op
         self.parent_checksum = wire.u128(prepare, "checksum")
-        self.pipeline[op] = PipelineEntry(prepare, body, {self.replica})
+        self.pipeline[op] = PipelineEntry(prepare, body, {self.replica}, subs)
         self._replicate(prepare, body)
         self._maybe_commit_pipeline()
 
@@ -375,7 +392,14 @@ class VsrReplica(Replica):
             reply_body = self._commit_prepare(entry.header, entry.body)
             self.commit_max = max(self.commit_max, op)
             client = wire.u128(entry.header, "client")
-            if client:
+            if entry.subs:
+                # Batched prepare: each sub-request's demuxed reply was
+                # stored at commit; forward them to their clients.
+                for sub_client, _, _ in entry.subs:
+                    session = self.sessions.get(sub_client)
+                    if sub_client and session is not None:
+                        self._send_stored_reply(sub_client, session)
+            elif client:
                 self._send_reply(entry.header, reply_body)
             del self.pipeline[op]
             if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
@@ -385,14 +409,61 @@ class VsrReplica(Replica):
     def _drain_request_queue(self) -> None:
         """Prepare queued requests while pipeline slots are free — only
         under a synchronized clock (every prepare path shares this
-        gate; see _on_request_msg)."""
+        gate; see _on_request_msg).  Consecutive queued requests for
+        the same batchable operation are multiplexed into one prepare
+        (logical batching — reference: src/state_machine.zig:122-131),
+        cutting per-request consensus overhead under load."""
         if self.replica_count > 1 and not self.clock.synchronized:
             return
         while self.request_queue and (
             len(self.pipeline) < self.config.pipeline_prepare_queue_max
         ):
             h, b = self.request_queue.pop(0)
-            self._primary_prepare(h, b)
+            operation = int(h["operation"])
+            batch = []
+            if (
+                operation >= constants.VSR_OPERATIONS_RESERVED
+                and demuxer.batch_logical_allowed(types.Operation(operation))
+            ):
+                # Budget in BODY BYTES: events plus the per-sub demux
+                # trailer must fit the message body (and therefore the
+                # fixed-size WAL slot).
+                sub_size = demuxer.TRAILER_DTYPE.itemsize
+                total = len(b) + sub_size
+                limit = self.config.message_body_size_max
+                while self.request_queue:
+                    h2, b2 = self.request_queue[0]
+                    if int(h2["operation"]) != operation:
+                        break
+                    if total + len(b2) + sub_size > limit:
+                        break
+                    batch.append(self.request_queue.pop(0))
+                    total += len(b2) + sub_size
+            if batch:
+                self._primary_prepare_batch([(h, b)] + batch)
+            else:
+                self._primary_prepare(h, b)
+
+    def _primary_prepare_batch(
+        self, requests: list[tuple[np.ndarray, bytes]]
+    ) -> None:
+        """One prepare multiplexing several client requests: the body
+        is events || trailer, the header's `context` carries the
+        sub-request count so every replica demuxes identically."""
+        subs = [
+            (wire.u128(h, "client"), int(h["request"]),
+             len(b) // demuxer.EVENT_SIZE)
+            for h, b in requests
+        ]
+        body = b"".join(b for _, b in requests) + demuxer.encode_trailer(subs)
+        head = wire.make_header(
+            command=Command.request,
+            operation=int(requests[0][0]["operation"]),
+            cluster=self.cluster, view=self.view,
+            client=0, request=0, context=len(subs),
+        )
+        wire.finalize_header(head, body)
+        self._primary_prepare(head, body, subs=subs)
 
     def _send_register_reply(self, client: int, entry: Session) -> None:
         reply = wire.make_header(
@@ -420,6 +491,10 @@ class VsrReplica(Replica):
         self.bus.send_client(
             client, wire.header_from_bytes(entry.reply_header), body
         )
+
+    def _notify_eviction(self, client: int) -> None:
+        if self.is_primary:
+            self._send_eviction(client)
 
     def _send_eviction(self, client: int) -> None:
         h = wire.make_header(

@@ -24,6 +24,7 @@ import numpy as np
 
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.constants import HEADER_SIZE
+from tigerbeetle_tpu.state_machine import demuxer
 from tigerbeetle_tpu.vsr import wire
 from tigerbeetle_tpu.vsr.journal import Journal
 from tigerbeetle_tpu.vsr.storage import Storage, _sectors
@@ -225,8 +226,30 @@ class Replica:
                 session=op, request=0, reply_header=b"",
                 slot=self._alloc_reply_slot(),
             )
+            assert len(self.sessions) <= self.config.clients_max
         else:
             sm_op = types.Operation(operation)
+            n_subs = wire.u128(header, "context")
+            if n_subs:
+                # Logically-batched prepare: commit the combined event
+                # batch once, then demux + store each sub-request's
+                # reply slice (state_machine/demuxer.py).
+                events, subs = demuxer.decode_trailer(body, n_subs)
+                self.sm.prefetch(sm_op, events, prefetch_timestamp=timestamp)
+                reply = self.sm.commit(client, op, timestamp, sm_op, events)
+                dm = demuxer.Demuxer(sm_op, reply)
+                offset = 0
+                for sub_client, sub_request, count in subs:
+                    piece = dm.decode(offset, count)
+                    offset += count
+                    if sub_client:
+                        sub_h = header.copy()
+                        sub_h["client_lo"] = sub_client & 0xFFFFFFFFFFFFFFFF
+                        sub_h["client_hi"] = sub_client >> 64
+                        sub_h["request"] = sub_request
+                        self._store_reply(sub_h, piece)
+                self.commit_min = op
+                return reply
             self.sm.prefetch(sm_op, body, prefetch_timestamp=timestamp)
             reply = self.sm.commit(client, op, timestamp, sm_op, body)
 
@@ -239,10 +262,22 @@ class Replica:
     # Client replies (reference: src/vsr/client_replies.zig).
 
     def _alloc_reply_slot(self) -> int:
-        slot = self._next_reply_slot
-        self._next_reply_slot += 1
-        assert self._next_reply_slot <= self.config.clients_max, "too many clients"
+        """A free reply slot — evicting the oldest session when the
+        table is full (reference: src/vsr/client_sessions.zig evict +
+        Command.eviction, src/vsr.zig:301).  The eviction choice (the
+        lowest register op) is deterministic, so every replica evicts
+        the same client at the same commit."""
+        if self._next_reply_slot < self.config.clients_max:
+            slot = self._next_reply_slot
+            self._next_reply_slot += 1
+            return slot
+        victim = min(self.sessions, key=lambda c: self.sessions[c].session)
+        slot = self.sessions.pop(victim).slot
+        self._notify_eviction(victim)
         return slot
+
+    def _notify_eviction(self, client: int) -> None:
+        """Hook: networked replicas send Command.eviction (multi.py)."""
 
     def _store_reply(self, prepare: np.ndarray, reply_body: bytes) -> None:
         client = wire.u128(prepare, "client")
