@@ -166,3 +166,61 @@ def test_runindex_rejects_wraparound_run():
     ix.insert(lo, _u64(7, 7), _u64(0, 1))
     found, vals = ix.lookup(lo, _u64(7, 7))
     assert found.all() and vals.tolist() == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# Binding generation (bindings.py — reference: src/*_bindings.zig).
+
+
+def test_bindings_c_header_compiles_with_size_asserts(tmp_path):
+    import subprocess
+
+    from tigerbeetle_tpu import bindings
+
+    paths = bindings.generate(str(tmp_path))
+    header = next(p for p in paths if p.endswith("tb_types.h"))
+    # The _Static_asserts make the compiler verify every wire layout.
+    src = tmp_path / "check.c"
+    src.write_text(f'#include "{header}"\nint main(void) {{ return 0; }}\n')
+    subprocess.run(
+        ["g++", "-x", "c", "-std=c11", "-Wall", "-Werror", "-fsyntax-only",
+         str(src)],
+        check=True, capture_output=True,
+    )
+    # ABI consistency: compiling the header TOGETHER with the actual
+    # native runtime makes any signature drift a compile error.
+    import os
+
+    runtime = os.path.join(os.path.dirname(__file__), "..", "native",
+                           "tb_runtime.cpp")
+    both = tmp_path / "abi_check.cpp"
+    both.write_text(
+        f'#include "{header}"\n#include "{os.path.abspath(runtime)}"\n'
+    )
+    subprocess.run(
+        ["g++", "-std=c++17", "-fsyntax-only", str(both)],
+        check=True, capture_output=True,
+    )
+
+
+def test_bindings_cover_all_enums_and_fields(tmp_path):
+    from tigerbeetle_tpu import bindings
+
+    bindings.generate(str(tmp_path))
+    ts = (tmp_path / "types.ts").read_text()
+    go = (tmp_path / "types.go").read_text()
+    c = (tmp_path / "tb_types.h").read_text()
+    # Every CreateTransferResult code appears in every language.
+    for member in types.CreateTransferResult:
+        assert f"  {member.name} = {member.value}," in ts
+        camel = "".join(p.capitalize() for p in member.name.split("_"))
+        assert f"CreateTransferResult{camel} CreateTransferResult = {member.value}" in go
+        assert (
+            f"TB_CREATE_TRANSFER_RESULT_{member.name.upper()} = {member.value},"
+            in c
+        )
+    # u128 fields collapse to one logical field in TS/Go.
+    assert "id: bigint;" in ts and "Id [2]uint64" in go
+    # The C structs keep raw limb layout for ABI fidelity.
+    assert "uint64_t id_lo;" in c and "uint64_t id_hi;" in c
+    assert "tb_client_request" in c

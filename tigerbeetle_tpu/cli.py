@@ -28,6 +28,7 @@ commands:
   version
   repl       --addresses=<host:port> [--cluster=<int>] [--command=<stmts>]
   benchmark  [--transfers=N] [--accounts=N] [--batch=N] [--addresses=...]
+  bindings   [--out=<dir>]   (generate C / TypeScript / Go type bindings)
 """
 
 
@@ -106,6 +107,14 @@ def cmd_benchmark(args: list[str]) -> None:
     print(json.dumps(result))
 
 
+def cmd_bindings(args: list[str]) -> None:
+    opts, _ = flags.parse(args, {"out": "bindings"})
+    from tigerbeetle_tpu import bindings
+
+    for path in bindings.generate(opts["out"]):
+        print(f"wrote {path}")
+
+
 def main(argv: list[str] | None = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
@@ -122,6 +131,8 @@ def main(argv: list[str] | None = None) -> None:
         cmd_repl(rest)
     elif command == "benchmark":
         cmd_benchmark(rest)
+    elif command == "bindings":
+        cmd_bindings(rest)
     else:
         print(USAGE)
         flags.fatal(f"unknown command {command!r}")
