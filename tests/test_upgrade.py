@@ -1,0 +1,116 @@
+"""Multiversion upgrade protocol (reference: Operation.upgrade,
+`release` in every header, replica_release_execute
+src/vsr/replica.zig:4298, src/tigerbeetle/main.zig:421).
+
+Operators install new binary bundles replica-by-replica; the cluster
+keeps running the old release until EVERY replica advertises the new
+one, then the primary replicates one upgrade op and each process
+re-executes into the new release.
+"""
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.harness import account, pack, transfer
+from tigerbeetle_tpu.types import Operation
+
+
+def test_rolling_upgrade_switches_release_cluster_wide():
+    c = Cluster(replica_count=3, seed=2)
+    client = c.client(1000)
+    client.register()
+    c.run_until(lambda: client.registered)
+    c.run_request(client, Operation.create_accounts,
+                  pack([account(1), account(2)]))
+
+    # Rolling binary install: one replica at a time gets (1, 2); the
+    # cluster must keep serving release 1 and NOT propose an upgrade
+    # while any replica lacks release 2.
+    for i in range(3):
+        c.restart_replica(i, releases_available=(1, 2))
+        c.settle()
+        assert all(r.upgrade_target is None for r in c.replicas) or i == 2
+        c.run_request(client, Operation.create_transfers,
+                      pack([transfer(100 + i, debit_account_id=1,
+                                     credit_account_id=2, amount=1)]))
+        if i < 2:
+            assert all(r.release == 1 for r in c.replicas)
+
+    # All replicas advertise 2 -> the primary replicates the upgrade op.
+    c.run_until(
+        lambda: all(r.upgrade_target == 2 for r in c.replicas
+                    if r.status == "normal"),
+        max_steps=4000,
+    )
+    # Operator restarts each process into the committed target.
+    for i in range(3):
+        c.restart_replica(i, release=2)
+    c.settle()
+    assert all(r.release == 2 for r in c.replicas)
+
+    # The cluster keeps serving, and new prepares are stamped release 2.
+    c.run_request(client, Operation.create_transfers,
+                  pack([transfer(200, debit_account_id=1,
+                                 credit_account_id=2, amount=5)]))
+    primary = c.replicas[c.replicas[0].primary_index()]
+    head = primary.journal.read_prepare(primary.op)
+    assert head is not None and int(head[0]["release"]) == 2
+    for _ in range(30):
+        c.step()
+    for r in c.replicas:
+        assert r.sm.transfer_timestamp(200) is not None or r.status != "normal"
+
+
+def test_old_release_replica_defers_new_release_prepares():
+    """A replica still running release 1 must not commit a prepare
+    stamped release 2 (it cannot execute that logic) until upgraded."""
+    c = Cluster(replica_count=3, seed=6)
+    client = c.client(1000)
+    client.register()
+    c.run_until(lambda: client.registered)
+
+    # Upgrade replicas 0 and 1 to release 2 by hand; leave 2 at 1.
+    for i in (0, 1):
+        c.restart_replica(i, release=2, releases_available=(1, 2))
+    c.settle()
+    c.run_request(client, Operation.create_accounts, pack([account(1), account(2)]))
+    c.run_request(client, Operation.create_transfers,
+                  pack([transfer(300, debit_account_id=1,
+                                 credit_account_id=2, amount=2)]))
+    for _ in range(30):
+        c.step()
+    # Quorum (0, 1) committed; the stale replica held back.
+    assert c.replicas[0].sm.transfer_timestamp(300) is not None
+    assert c.replicas[2].sm.transfer_timestamp(300) is None
+    assert c.replicas[2].commit_min < c.replicas[0].commit_min
+
+    # Once upgraded, it catches up.
+    c.restart_replica(2, release=2, releases_available=(1, 2))
+    c.settle()
+    for _ in range(30):
+        c.step()
+    assert c.replicas[2].sm.transfer_timestamp(300) is not None
+
+
+def test_second_upgrade_not_blocked_by_replayed_target():
+    """After upgrading 1->2, replaying the old upgrade op must not
+    latch a stale target that blocks proposing 2->3."""
+    c = Cluster(replica_count=3, seed=8)
+    client = c.client(1000)
+    client.register()
+    c.run_until(lambda: client.registered)
+
+    for target in (2, 3):
+        for i in range(3):
+            c.restart_replica(i, releases_available=tuple(range(1, target + 1)))
+        c.settle()
+        c.run_until(
+            lambda: all(r.upgrade_target == target for r in c.replicas
+                        if r.status == "normal"),
+            max_steps=4000,
+        )
+        for i in range(3):
+            c.restart_replica(i, release=target)
+        c.settle()
+        assert all(r.release == target for r in c.replicas)
+    c.run_request(client, Operation.create_accounts, pack([account(1)]))
+    assert c.replicas[0].release == 3

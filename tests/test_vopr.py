@@ -33,3 +33,18 @@ def test_workload_deterministic():
     b = Workload(5)
     for _ in range(50):
         assert a.next_request() == b.next_request()
+
+
+def test_hash_log_divergence_pinpointing():
+    from tigerbeetle_tpu.testing.hash_log import HashLog
+
+    a, b = HashLog(), HashLog()
+    for op in range(1, 20):
+        a.record(op, b"header%d" % op, b"reply")
+        b.record(op, b"header%d" % op, b"reply" if op != 13 else b"DIVERGED")
+    assert a.first_divergence(b) == 13
+    assert a.first_divergence(a) is None
+    # Replay idempotence: re-recording an op yields the identical digest.
+    d = a.digest(7)
+    a.record(7, b"header7", b"reply")
+    assert a.digest(7) == d

@@ -22,7 +22,7 @@ from tigerbeetle_tpu import constants as cfg
 
 def run_benchmark(*, addresses: str | None, cluster: int, n_transfers: int,
                   n_accounts: int, batch: int, use_cpu: bool,
-                  seed: int = 42) -> dict:
+                  seed: int = 42, statsd_port: int | None = None) -> dict:
     from tigerbeetle_tpu.client import Client
 
     server = None
@@ -97,7 +97,7 @@ def run_benchmark(*, addresses: str | None, cluster: int, n_transfers: int,
 
         lat = np.sort(np.array(latencies))
         pct = lambda p: float(lat[min(len(lat) - 1, int(p / 100 * len(lat)))])
-        return {
+        result = {
             "transfers": n_transfers,
             "transfers_per_second": round(n_transfers / elapsed, 1),
             "batch": batch,
@@ -105,6 +105,17 @@ def run_benchmark(*, addresses: str | None, cluster: int, n_transfers: int,
             "batch_latency_p99_ms": round(pct(99) * 1e3, 3),
             "batch_latency_p100_ms": round(float(lat[-1]) * 1e3, 3),
         }
+        if statsd_port is not None:
+            # reference: src/tigerbeetle/benchmark_load.zig:360-380
+            # optional StatsD emit of the same metrics.
+            from tigerbeetle_tpu.utils.statsd import StatsD
+
+            s = StatsD(port=statsd_port, prefix="benchmark")
+            s.gauge("load_accepted_tx_per_s", result["transfers_per_second"])
+            s.timing("batch_p100_ms", result["batch_latency_p100_ms"])
+            s.timing("batch_p99_ms", result["batch_latency_p99_ms"])
+            s.close()
+        return result
     finally:
         if server is not None:
             server._stop = True

@@ -28,6 +28,7 @@ commands:
   version
   repl       --addresses=<host:port> [--cluster=<int>] [--command=<stmts>]
   benchmark  [--transfers=N] [--accounts=N] [--batch=N] [--addresses=...]
+             [--statsd-port=N]
   bindings   [--out=<dir>]   (generate C / TypeScript / Go type bindings)
 """
 
@@ -95,6 +96,7 @@ def cmd_benchmark(args: list[str]) -> None:
         {
             "addresses": "", "cluster": 0, "transfers": 100_000,
             "accounts": 10_000, "batch": 8190, "cpu": False,
+            "statsd_port": 0,
         },
     )
     from tigerbeetle_tpu.benchmark import run_benchmark
@@ -103,6 +105,7 @@ def cmd_benchmark(args: list[str]) -> None:
         addresses=opts["addresses"] or None, cluster=opts["cluster"],
         n_transfers=opts["transfers"], n_accounts=opts["accounts"],
         batch=opts["batch"], use_cpu=opts["cpu"],
+        statsd_port=opts["statsd_port"] or None,
     )
     print(json.dumps(result))
 

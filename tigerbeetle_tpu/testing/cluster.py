@@ -20,6 +20,7 @@ import numpy as np
 from tigerbeetle_tpu import constants as cfg
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.testing.hash_log import HashLog
 from tigerbeetle_tpu.vsr import replica as vsr_format
 from tigerbeetle_tpu.vsr import wire
 from tigerbeetle_tpu.vsr.multi import VsrReplica
@@ -220,9 +221,12 @@ class Cluster:
                 storage, self.cluster_id, factory(), _Bus(self, i),
                 replica=i, replica_count=replica_count,
             )
+            r.hash_log = HashLog()
             r.open()
             self.storages.append(storage)
             self.replicas.append(r)
+        # Cluster-owned so logs survive replica restarts.
+        self.hash_logs = [r.hash_log for r in self.replicas]
         self.clients: dict[int, SimClient] = {}
         self.realtime = 0
         # Per-replica wall-clock skew in ns (nemesis knob): replica i
@@ -249,15 +253,30 @@ class Cluster:
         self.network.partition(index)
         self.replicas[index].status = "crashed"
 
-    def restart_replica(self, index: int, state_machine=None) -> None:
+    def restart_replica(self, index: int, state_machine=None, *,
+                        release: int | None = None,
+                        releases_available: tuple[int, ...] | None = None,
+                        ) -> None:
+        """Restart; optionally with a different installed binary bundle
+        (releases_available) and/or running release — the harness-side
+        half of the multiversion upgrade (reference:
+        src/vsr/replica.zig:4298 replica_release_execute)."""
         storage = self.storages[index]
         self.network.heal(index)
+        old = self.replicas[index]
+        avail = releases_available or old.releases_available
         r = VsrReplica(
             storage, self.cluster_id,
             state_machine or self._factory(), _Bus(self, index),
             replica=index, replica_count=self.replica_count,
+            release=release if release is not None else old.release,
+            releases_available=avail,
         )
+        r.hash_log = self.hash_logs[index]
         r.open()
+        # Pre-crash commits beyond the durable checkpoint floor may
+        # have been lost with the process and superseded — drop them.
+        r.hash_log.prune_above(int(r.superblock.working["commit_min"]))
         self.replicas[index] = r
 
     # ------------------------------------------------------------------
@@ -318,11 +337,25 @@ class Cluster:
                     assert pa[0].tobytes() == pb[0].tobytes(), (a, b, op)
 
     def check_convergence(self) -> None:
-        """All replicas at the same commit must hold identical state."""
+        """All replicas at the same commit must hold identical state.
+        On divergence the hash logs name the exact first divergent op
+        (reference: src/testing/hash_log.zig)."""
         commits = {r.commit_min for r in self.replicas}
         assert len(commits) == 1, commits
         snaps = {r.sm.snapshot() for r in self.replicas}
-        assert len(snaps) == 1, "state machines diverged"
+        # The commit streams must agree op-for-op (even when the end
+        # states happen to match).
+        for i, a in enumerate(self.hash_logs):
+            for j, b in enumerate(self.hash_logs[i + 1 :], i + 1):
+                op = a.first_divergence(b)
+                suffix = "" if len(snaps) == 1 else " (states diverged)"
+                assert op is None, (
+                    f"replicas {i}/{j} diverged first at op {op}{suffix}"
+                )
+        assert len(snaps) == 1, (
+            "state machines diverged after identical commit hashes "
+            "(non-deterministic state outside the commit path)"
+        )
 
     def settle(self, max_steps: int = 3000) -> None:
         """Run until all replicas have converged on the same commit."""

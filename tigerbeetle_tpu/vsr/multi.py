@@ -75,12 +75,32 @@ class VsrReplica(Replica):
     """
 
     def __init__(self, storage, cluster, state_machine, bus, *,
-                 replica: int, replica_count: int) -> None:
+                 replica: int, replica_count: int,
+                 release: int = 1,
+                 releases_available: tuple[int, ...] = (1,)) -> None:
         super().__init__(storage, cluster, state_machine,
                          replica=replica, replica_count=replica_count)
         self.bus = bus
         self.status = "recovering"
         self.log_view = 0
+
+        # Multiversion upgrades (reference: src/vsr/replica.zig:4298
+        # replica_release_execute, Operation.upgrade, `release` in every
+        # header).  `release` is what we RUN; `releases_available` is
+        # what the installed binary bundle COULD run.  Peers advertise
+        # their max available release on pings; when every replica can
+        # run something newer, the primary replicates an upgrade op,
+        # and committing it sets upgrade_target — the process then
+        # re-executes into the new release (the harness/operator
+        # restarts it with release=target).
+        assert release in releases_available
+        self.release = release
+        self.releases_available = tuple(sorted(releases_available))
+        self.peer_release: dict[int, int] = {
+            replica: max(self.releases_available)
+        }
+        self.upgrade_target: int | None = None
+        self._upgrade_proposed = False
 
         majority = replica_count // 2 + 1
         self.quorum_replication = min(
@@ -152,6 +172,7 @@ class VsrReplica(Replica):
                     self._send_heartbeat()
                 self._drain_request_queue()
                 self._maybe_pulse()
+                self._maybe_propose_upgrade()
                 if self.pipeline and (
                     self._ticks - self._last_retransmit >= REPAIR_RETRY_TICKS
                 ):
@@ -177,6 +198,31 @@ class VsrReplica(Replica):
         for r in range(self.replica_count):
             if r != self.replica and r not in entry.ok_replicas:
                 self.bus.send(r, entry.header, entry.body)
+
+    def _maybe_propose_upgrade(self) -> None:
+        """Replicate Operation.upgrade once EVERY replica advertises a
+        release newer than the one we run (reference: the primary
+        coordinates the upgrade so the cluster switches atomically at
+        one op)."""
+        if self._upgrade_proposed or self.upgrade_target is not None:
+            return
+        if self.replica_count > 1 and not self.clock.synchronized:
+            return  # same clock gate as every other prepare path
+        if len(self.peer_release) < self.replica_count:
+            return
+        target = min(self.peer_release.values())
+        if target <= self.release:
+            return
+        if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
+            return
+        self._upgrade_proposed = True
+        req = wire.make_header(
+            command=Command.request, operation=VsrOperation.upgrade,
+            cluster=self.cluster, view=self.view,
+        )
+        body = int(target).to_bytes(8, "little")
+        wire.finalize_header(req, body)
+        self._primary_prepare(req, body)
 
     def _maybe_pulse(self) -> None:
         """Self-clocked expiry (reference: src/vsr/replica.zig:3126-3143):
@@ -332,6 +378,7 @@ class VsrReplica(Replica):
             op=op, commit=self.commit_min, timestamp=timestamp,
             parent=self.parent_checksum, replica=self.replica,
             context=len(subs) if subs else 0,
+            release=self.release,
         )
         wire.finalize_header(prepare, body)
 
@@ -389,6 +436,8 @@ class VsrReplica(Replica):
                 return
             if op != self.commit_min + 1:
                 return  # waiting on repair of earlier ops
+            if int(entry.header["release"]) > self.release:
+                return  # prepared by a newer release; upgrade first
             reply_body = self._commit_prepare(entry.header, entry.body)
             self.commit_max = max(self.commit_max, op)
             client = wire.u128(entry.header, "client")
@@ -599,6 +648,8 @@ class VsrReplica(Replica):
                 self._send_repair_requests()
                 return
             header, body = read
+            if int(header["release"]) > self.release:
+                return  # prepared by a newer release; upgrade first
             self._commit_prepare(header, body)
             if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
                 self.checkpoint()
@@ -621,6 +672,7 @@ class VsrReplica(Replica):
         ping = wire.make_header(
             command=Command.ping, cluster=self.cluster, view=self.view,
             replica=self.replica, timestamp=self.monotonic,
+            release=max(self.releases_available),
         )
         wire.finalize_header(ping, b"")
         for r in range(self.replica_count):
@@ -631,15 +683,24 @@ class VsrReplica(Replica):
         # Echo m0 in `timestamp`; our wall clock rides in `op` (clamped
         # at 0 — the wire field is u64 and a skewed simulated clock can
         # sit before the epoch at startup).
+        self._learn_peer_release(header)
         pong = wire.make_header(
             command=Command.pong, cluster=self.cluster, view=self.view,
             replica=self.replica, timestamp=int(header["timestamp"]),
             op=max(0, self.realtime),
+            release=max(self.releases_available),
         )
         wire.finalize_header(pong, b"")
         self.bus.send(int(header["replica"]), pong, b"")
 
+    def _learn_peer_release(self, header: np.ndarray) -> None:
+        rel = int(header["release"])
+        if rel:
+            peer = int(header["replica"])
+            self.peer_release[peer] = max(self.peer_release.get(peer, 0), rel)
+
     def _on_pong(self, header: np.ndarray, body: bytes) -> None:
+        self._learn_peer_release(header)
         self.clock.learn(
             int(header["replica"]),
             m0=int(header["timestamp"]),

@@ -59,6 +59,10 @@ class Replica:
         self.cluster = cluster
         self.sm = state_machine
         self.aof = aof  # optional vsr.aof.AOF (reference: src/aof.zig)
+        # Optional testing.hash_log.HashLog: per-commit chained digests
+        # for determinism-divergence pinpointing (reference:
+        # src/testing/hash_log.zig).
+        self.hash_log = None
         self.config = storage.layout.config
         self.replica = replica
         self.replica_count = replica_count
@@ -74,6 +78,10 @@ class Replica:
         self.sessions: dict[int, Session] = {}
         self._next_reply_slot = 0
         self.realtime = 0
+        # Multiversion upgrades (multi.py drives these; the base
+        # pipeline honors Operation.upgrade commits).
+        self.release = 1
+        self.upgrade_target: int | None = None
 
     # ------------------------------------------------------------------
     # Open / recovery.
@@ -227,6 +235,17 @@ class Replica:
                 slot=self._alloc_reply_slot(),
             )
             assert len(self.sessions) <= self.config.clients_max
+        elif operation == int(VsrOperation.upgrade):
+            # Cluster-coordinated release switch (reference:
+            # src/vsr/replica.zig:4298 replica_release_execute): the
+            # committed target release takes effect when the process
+            # re-executes into the new binary (harness restart).
+            reply = b""
+            target = int.from_bytes(body[:8], "little")
+            # Replay of an old upgrade op (already running >= target)
+            # must not latch a stale target and block future upgrades.
+            if target > self.release:
+                self.upgrade_target = target
         else:
             sm_op = types.Operation(operation)
             n_subs = wire.u128(header, "context")
@@ -249,11 +268,18 @@ class Replica:
                         sub_h["request"] = sub_request
                         self._store_reply(sub_h, piece)
                 self.commit_min = op
+                if self.hash_log is not None and not replay:
+                    self.hash_log.record(op, header.tobytes(), reply)
                 return reply
             self.sm.prefetch(sm_op, body, prefetch_timestamp=timestamp)
             reply = self.sm.commit(client, op, timestamp, sm_op, body)
 
         self.commit_min = op
+        # Replayed commits are not recorded: a recovered WAL tail may
+        # include speculative ops that never reached quorum and are
+        # later superseded (two-step repair corrects the state).
+        if self.hash_log is not None and not replay:
+            self.hash_log.record(op, header.tobytes(), reply)
         if client and operation != int(VsrOperation.register):
             self._store_reply(header, reply)
         return reply
