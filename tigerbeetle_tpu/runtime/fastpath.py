@@ -1,0 +1,188 @@
+"""ctypes bindings for the native commit fast path (tb_fastpath.cpp).
+
+The host side of the TPU commit pipeline — wire decode, static ladder,
+account resolution, duplicate detection, u128 overflow admission — runs
+in C++ at memcpy-like speed; Python keeps orchestration, the columnar
+stores, and the device queue.  The balance mirror memory is OWNED by
+the native library and wrapped zero-copy as numpy arrays, so exact-path
+(JAX kernel) commits and expiry mutations are immediately visible to
+the native admission checks and vice versa.
+
+Falls back to None (pure-Python path) when no compiler/library exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.environ.get(
+    "TB_FASTPATH_LIB", os.path.join(_NATIVE_DIR, "libtb_fastpath.so")
+)
+
+_lib = None
+_lib_lock = threading.Lock()
+
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+_U32P = ctypes.POINTER(ctypes.c_uint32)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+FALLBACK = 1
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if os.environ.get("TB_FASTPATH_DISABLE"):
+            return None
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR], check=True,
+                    capture_output=True, timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+
+        lib.tb_fp_create.restype = ctypes.c_void_p
+        lib.tb_fp_create.argtypes = [ctypes.c_uint64]
+        lib.tb_fp_destroy.argtypes = [ctypes.c_void_p]
+        lib.tb_fp_balances_lo.restype = _U64P
+        lib.tb_fp_balances_lo.argtypes = [ctypes.c_void_p]
+        lib.tb_fp_balances_hi.restype = _U64P
+        lib.tb_fp_balances_hi.argtypes = [ctypes.c_void_p]
+        lib.tb_fp_add_accounts.argtypes = [
+            ctypes.c_void_p, _U64P, _U64P, _U32P, _U32P,
+            ctypes.c_uint32, ctypes.c_uint64,
+        ]
+        lib.tb_fp_remove_accounts.argtypes = [
+            ctypes.c_void_p, _U64P, _U64P, ctypes.c_uint32,
+        ]
+        lib.tb_fp_add_transfer_ids.argtypes = [
+            ctypes.c_void_p, _U64P, _U64P, ctypes.c_uint64, ctypes.c_uint32,
+        ]
+        lib.tb_fp_remove_transfer_ids.argtypes = [
+            ctypes.c_void_p, _U64P, _U64P, ctypes.c_uint32,
+        ]
+        lib.tb_fp_commit_transfers.restype = ctypes.c_int
+        lib.tb_fp_commit_transfers.argtypes = [
+            ctypes.c_void_p, _U8P, ctypes.c_uint32, ctypes.c_uint64,
+            _U32P, _I32P, _I32P, _I64P, _I64P, _U64P, _U64P, _U32P,
+        ]
+        _lib = lib
+        return _lib
+
+
+def _p(arr: np.ndarray, ptype):
+    return arr.ctypes.data_as(ptype)
+
+
+class NativeFastpath:
+    """One native fast-path instance per TpuStateMachine."""
+
+    def __init__(self, account_capacity: int) -> None:
+        lib = _load()
+        assert lib is not None
+        self._lib = lib
+        self._fp = lib.tb_fp_create(account_capacity)
+        self.capacity = account_capacity
+        # Zero-copy numpy views over the native balance mirror.
+        self.lo = np.ctypeslib.as_array(
+            lib.tb_fp_balances_lo(self._fp), shape=(account_capacity, 4)
+        )
+        self.hi = np.ctypeslib.as_array(
+            lib.tb_fp_balances_hi(self._fp), shape=(account_capacity, 4)
+        )
+        # Reusable output buffers (sized for the largest batch).
+        n_max = 8192
+        self._results = np.empty(n_max, np.uint32)
+        self._dr_slot = np.empty(n_max, np.int32)
+        self._cr_slot = np.empty(n_max, np.int32)
+        # Deltas are bounded both by touched columns (4/account) and by
+        # 2 per event.
+        d_max = min(4 * account_capacity, 2 * n_max) + 8
+        self._dslot = np.empty(d_max, np.int64)
+        self._dcol = np.empty(d_max, np.int64)
+        self._dlo = np.empty(d_max, np.uint64)
+        self._dhi = np.empty(d_max, np.uint64)
+        self._ndeltas = ctypes.c_uint32(0)
+
+    def __del__(self):
+        if getattr(self, "_fp", None):
+            self._lib.tb_fp_destroy(self._fp)
+            self._fp = None
+
+    def add_accounts(self, id_lo, id_hi, flags, ledger, base_slot: int) -> None:
+        id_lo = np.ascontiguousarray(id_lo, np.uint64)
+        id_hi = np.ascontiguousarray(id_hi, np.uint64)
+        flags = np.ascontiguousarray(flags, np.uint32)
+        ledger = np.ascontiguousarray(ledger, np.uint32)
+        self._lib.tb_fp_add_accounts(
+            self._fp, _p(id_lo, _U64P), _p(id_hi, _U64P),
+            _p(flags, _U32P), _p(ledger, _U32P), len(id_lo), base_slot,
+        )
+
+    def remove_accounts(self, id_lo, id_hi) -> None:
+        id_lo = np.ascontiguousarray(id_lo, np.uint64)
+        id_hi = np.ascontiguousarray(id_hi, np.uint64)
+        self._lib.tb_fp_remove_accounts(
+            self._fp, _p(id_lo, _U64P), _p(id_hi, _U64P), len(id_lo)
+        )
+
+    def add_transfer_ids(self, id_lo, id_hi, base_row: int) -> None:
+        id_lo = np.ascontiguousarray(id_lo, np.uint64)
+        id_hi = np.ascontiguousarray(id_hi, np.uint64)
+        self._lib.tb_fp_add_transfer_ids(
+            self._fp, _p(id_lo, _U64P), _p(id_hi, _U64P), base_row, len(id_lo)
+        )
+
+    def remove_transfer_ids(self, id_lo, id_hi) -> None:
+        id_lo = np.ascontiguousarray(id_lo, np.uint64)
+        id_hi = np.ascontiguousarray(id_hi, np.uint64)
+        self._lib.tb_fp_remove_transfer_ids(
+            self._fp, _p(id_lo, _U64P), _p(id_hi, _U64P), len(id_lo)
+        )
+
+    def commit_transfers(self, body: bytes, n: int, ts_base: int):
+        """-> None (fallback) or (results, dr_slot, cr_slot,
+        (dslot, dcol, dlo, dhi)) — views into reusable buffers, valid
+        until the next call."""
+        if n > len(self._results):
+            return None  # oversized batch: take the exact path
+        # Zero-copy pointer into the immutable bytes object (the C side
+        # only reads).
+        buf = ctypes.cast(ctypes.c_char_p(body), _U8P)
+        rc = self._lib.tb_fp_commit_transfers(
+            self._fp, buf, n, ts_base,
+            _p(self._results, _U32P), _p(self._dr_slot, _I32P),
+            _p(self._cr_slot, _I32P), _p(self._dslot, _I64P),
+            _p(self._dcol, _I64P), _p(self._dlo, _U64P),
+            _p(self._dhi, _U64P), ctypes.byref(self._ndeltas),
+        )
+        if rc != 0:
+            return None
+        k = self._ndeltas.value
+        return (
+            self._results[:n], self._dr_slot[:n], self._cr_slot[:n],
+            (self._dslot[:k], self._dcol[:k], self._dlo[:k], self._dhi[:k]),
+        )
+
+
+def available() -> bool:
+    return _load() is not None

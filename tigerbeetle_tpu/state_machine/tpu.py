@@ -190,6 +190,21 @@ class TpuStateMachine:
         self._attrs = Columns(_ATTR_FIELDS, capacity=max(1024, account_capacity))
         self._dev = kernel_fast.DeviceTable(account_capacity)
         self._mirror = BalanceMirror(account_capacity)
+        # Native C++ fast path (native/tb_fastpath.cpp): wire decode,
+        # static ladder, account resolution, duplicate detection and
+        # u128 overflow admission run natively; the balance mirror is
+        # re-pointed at the native library's memory so both sides share
+        # one copy.  Absent a compiler, everything runs in Python.
+        self._native = None
+        try:
+            from tigerbeetle_tpu.runtime import fastpath
+
+            if fastpath.available():
+                self._native = fastpath.NativeFastpath(account_capacity)
+                self._mirror.lo = self._native.lo
+                self._mirror.hi = self._native.hi
+        except Exception:
+            self._native = None
 
         # Transfer state.
         self._tdir = RunIndex(_dir_capacity(transfer_capacity))
@@ -347,6 +362,11 @@ class TpuStateMachine:
                 self._attrs["id_lo"][scope_slots],
                 self._attrs["id_hi"][scope_slots],
             )
+            if self._native is not None:
+                self._native.remove_accounts(
+                    self._attrs["id_lo"][scope_slots],
+                    self._attrs["id_hi"][scope_slots],
+                )
             self._attrs.truncate(min(scope_slots))
             scope_slots.clear()
 
@@ -398,6 +418,20 @@ class TpuStateMachine:
                         np.array([row["id_hi"]], np.uint64),
                         np.array([slot], np.uint64),
                     )
+                    if self._native is not None:
+                        # A capacity rebuild re-registers everything in
+                        # _attrs (including this row) — only register
+                        # explicitly when no rebuild happened.
+                        native = self._native
+                        self._ensure_balance_capacity(self._attrs.count)
+                        if self._native is native:
+                            native.add_accounts(
+                                np.array([row["id_lo"]], np.uint64),
+                                np.array([row["id_hi"]], np.uint64),
+                                np.array([row["flags"]], np.uint32),
+                                np.array([row["ledger"]], np.uint32),
+                                base_slot=slot,
+                            )
                     if chain is not None:
                         scope_slots.append(slot)
                     self.commit_timestamp = timestamp - n + index + 1
@@ -481,7 +515,13 @@ class TpuStateMachine:
         assert rows[0] == base
         self._acct_dir.insert(id_lo, id_hi, rows.astype(np.uint64))
         self.commit_timestamp = timestamp
+        native = self._native
         self._ensure_balance_capacity(self._attrs.count)
+        # A capacity rebuild already re-registered every account.
+        if native is not None and self._native is native:
+            native.add_accounts(
+                id_lo, id_hi, flags, events["ledger"], base_slot=base
+            )
         return b""
 
     def _create_account_checked(self, row, ev, exists_ladder) -> int:
@@ -517,7 +557,35 @@ class TpuStateMachine:
         while cap < slots:
             cap *= 2
         self._dev.grow(cap)
-        self._mirror.grow(cap)
+        if self._native is not None:
+            self._rebuild_native(cap)
+        else:
+            self._mirror.grow(cap)
+
+    def _rebuild_native(self, capacity: int) -> None:
+        """Recreate the native fast path at a new capacity (growth or
+        restore): copy balances, re-point the shared mirror, and
+        re-register the id directories."""
+        from tigerbeetle_tpu.runtime import fastpath
+
+        old_lo, old_hi = self._mirror.lo, self._mirror.hi
+        native = fastpath.NativeFastpath(capacity)
+        native.lo[: len(old_lo)] = old_lo
+        native.hi[: len(old_hi)] = old_hi
+        n_acct = self._attrs.count
+        if n_acct:
+            native.add_accounts(
+                self._attrs.col("id_lo"), self._attrs.col("id_hi"),
+                self._attrs.col("flags"), self._attrs.col("ledger"),
+                base_slot=0,
+            )
+        if self._store.count:
+            native.add_transfer_ids(
+                self._store.col("id_lo"), self._store.col("id_hi"), 0
+            )
+        self._native = native
+        self._mirror.lo = native.lo
+        self._mirror.hi = native.hi
 
     # ------------------------------------------------------------------
     # create_transfers (the hot path).
@@ -528,6 +596,17 @@ class TpuStateMachine:
         if n == 0:
             return b""
         ts_base = timestamp - n + 1
+
+        # Native C++ fast path: one call covers decode, static ladder,
+        # account resolution, duplicate checks, and overflow admission
+        # (native/tb_fastpath.cpp); Python only does the bookkeeping.
+        # A None return means fallback — nothing was mutated.
+        if self._native is not None:
+            native_out = self._native.commit_transfers(input_bytes, n, ts_base)
+            if native_out is not None:
+                return self._finish_native_fast(
+                    events, n, ts_base, *native_out
+                )
 
         # Same-width fields stay strided views into the 1 MiB wire
         # buffer (it lives in L2 after the first pass, so elementwise
@@ -846,6 +925,42 @@ class TpuStateMachine:
         reply["result"] = results[fail_idx]
         return reply.tobytes()
 
+    def _finish_native_fast(
+        self, events, n, ts_base, results, dr_slot, cr_slot, deltas
+    ) -> bytes:
+        """Bookkeeping after a native fast-path apply: device enqueue,
+        store append, expiry/pulse updates, reply (mirrors
+        _commit_fast's tail; results/slots are views into reusable
+        native buffers, consumed before the next native call)."""
+        dslot, dcol, dlo, dhi = deltas
+        # Copies: the device queue holds these past this call, and the
+        # native output buffers are reused per batch.
+        self._dev.enqueue(
+            dslot.copy(), dcol.copy(), dlo.copy(), dhi.copy()
+        )
+
+        flags = events["flags"].astype(np.uint32)
+        timeout = np.asarray(events["timeout"]).astype(np.uint64)
+        created = {
+            "flags": flags,
+            "dr_slot": dr_slot, "cr_slot": cr_slot,
+            "amount_lo": np.asarray(events["amount_lo"]),
+            "amount_hi": np.asarray(events["amount_hi"]),
+            "pending_lo": np.asarray(events["pending_id_lo"]),
+            "pending_hi": np.asarray(events["pending_id_hi"]),
+            "ud128_lo": np.asarray(events["user_data_128_lo"]),
+            "ud128_hi": np.asarray(events["user_data_128_hi"]),
+            "ud64": np.asarray(events["user_data_64"]),
+            "ud32": np.asarray(events["user_data_32"]),
+            "timeout": timeout,
+            "ledger": np.asarray(events["ledger"]),
+            "code": events["code"].astype(np.uint32),
+        }
+        return self._finish_fast(
+            n, ts_base, np.asarray(events["id_lo"]),
+            np.asarray(events["id_hi"]), flags, timeout, results, created,
+        )
+
     def _commit_fast(
         self, n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi,
         flags, timeout, dr_slot, cr_slot, amount_lo, amount_hi, ledger, code,
@@ -902,6 +1017,21 @@ class TpuStateMachine:
             "timeout": timeout,
             "ledger": ledger, "code": code,
         }
+        return self._finish_fast(
+            n, ts_base, id_lo, id_hi, flags, timeout, results, created
+        )
+
+    def _finish_fast(
+        self, n, ts_base, id_lo, id_hi, flags, timeout, results, created
+    ) -> bytes:
+        """Shared fast-path tail (native and Python admission paths):
+        expiry/pulse signals, store bookkeeping, failure reply.  Must
+        stay one implementation — both paths\' durable state depends on
+        it being identical."""
+        apply_mask = results == 0
+        is_pending = (flags & np.uint32(TF.pending)) != 0
+        ts_i = np.uint64(ts_base) + np.arange(n, dtype=np.uint64)
+        expires = ts_i + timeout * np.uint64(NS_PER_S)
         inb_status = np.where(
             apply_mask & is_pending, np.uint32(kernel.S_PENDING), np.uint32(0)
         )
@@ -964,6 +1094,12 @@ class TpuStateMachine:
                 status=sel(inb_status).astype(np.uint8),
             )
             self._tdir.insert(sel(id_lo), sel(id_hi), rows.astype(np.uint64))
+            if self._native is not None:
+                # Keep the native duplicate-id set in lockstep (rows
+                # are contiguous, so base_row + i == row).
+                self._native.add_transfer_ids(
+                    sel(id_lo), sel(id_hi), int(rows[0])
+                )
             row_of_event = np.full(n, -1, np.int64)
             row_of_event[idx] = rows
         else:
@@ -1348,6 +1484,8 @@ def _tpu_restore(self, data: bytes) -> None:
     self._mirror = BalanceMirror(cap)
     self._mirror.lo[:n_acct] = state["mirror_lo"]
     self._mirror.hi[:n_acct] = state["mirror_hi"]
+    if self._native is not None:
+        self._rebuild_native(cap)
     self._dev = kernel_fast.DeviceTable(cap)
     self._dev.balances = jnp.asarray(
         self._mirror.rows8(np.arange(cap, dtype=np.int64))
