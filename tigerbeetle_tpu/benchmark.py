@@ -93,6 +93,16 @@ def run_benchmark(*, addresses: str | None, cluster: int, n_transfers: int,
             assert not results, results[:3]
             latencies.append(time.perf_counter() - b0)
         elapsed = time.perf_counter() - t0
+
+        # Query latency (devhub tracks query p100 alongside load tx/s —
+        # reference: src/scripts/devhub.zig:36-41).
+        query_lat = []
+        q_ids = [int(i) for i in rng.integers(1, n_accounts + 1, 100)]
+        for _ in range(20):
+            q0 = time.perf_counter()
+            rows = client.lookup_accounts(q_ids)
+            assert len(rows) == len(q_ids)  # one row per requested id
+            query_lat.append(time.perf_counter() - q0)
         client.close()
 
         lat = np.sort(np.array(latencies))
@@ -104,6 +114,7 @@ def run_benchmark(*, addresses: str | None, cluster: int, n_transfers: int,
             "batch_latency_p50_ms": round(pct(50) * 1e3, 3),
             "batch_latency_p99_ms": round(pct(99) * 1e3, 3),
             "batch_latency_p100_ms": round(float(lat[-1]) * 1e3, 3),
+            "query_latency_p100_ms": round(max(query_lat) * 1e3, 3),
         }
         if statsd_port is not None:
             # reference: src/tigerbeetle/benchmark_load.zig:360-380

@@ -324,9 +324,12 @@ class Cluster:
         for a in range(self.replica_count):
             for b in range(a + 1, self.replica_count):
                 ra, rb = self.replicas[a], self.replicas[b]
+                # The checkpoint op itself may never have been
+                # journaled (state sync installs state, not prepares):
+                # compare strictly above it.
                 lo = max(
                     1,
-                    max(ra.checkpoint_op, rb.checkpoint_op),
+                    max(ra.checkpoint_op, rb.checkpoint_op) + 1,
                     min(ra.commit_min, rb.commit_min)
                     - self.config.journal_slot_count + 1,
                 )

@@ -128,6 +128,12 @@ class VsrReplica(Replica):
         self._repair_last_sent = 0
         self._last_retransmit = 0
 
+        # Pending canonical-log install after passively entering a view
+        # (commits gated until start_view arrives).
+        self._canon_pending = False
+        # True while the journal chain between commit_min and the head
+        # is not fully verified (stale siblings possible): commits wait.
+        self._chain_suspect = False
         # View-change state.
         self._svc_votes: dict[int, set[int]] = {}   # view -> replicas
         self._dvc: dict[int, dict] = {}             # replica -> dvc payload
@@ -149,17 +155,38 @@ class VsrReplica(Replica):
     def is_primary(self) -> bool:
         return self.status == "normal" and self.primary_index() == self.replica
 
-    def open(self) -> None:
-        super().open()
+    def open(self, *, replay_tail: bool | None = None) -> None:
+        super().open(replay_tail=replay_tail)
         self.log_view = int(self.superblock.working["log_view"])
         self.status = "normal"
         self.commit_max = self.commit_min
+        # An unexecuted journal tail above the checkpoint must be
+        # confirmed by the cluster before this replica may commit or
+        # serve: rejoin through a view change, whose DVC quorum
+        # establishes the canonical log (VSR recovery — the reference's
+        # .recovering_head rejoins the same way).
+        self._recovering_tail = (
+            self.replica_count > 1 and self.op > self.commit_min
+        )
 
     # ------------------------------------------------------------------
     # Tick: timeouts.
 
     def tick(self) -> None:
         self._ticks += 1
+        if self._recovering_tail:
+            self._recovering_tail = False
+            if self.primary_index() == self.replica:
+                # We'd be the primary: only a DVC round can establish
+                # the canonical log.
+                self._start_view_change(self.view + 1)
+            else:
+                # Non-disruptive rejoin: gate commits and ask the live
+                # primary for the canonical view state; the normal
+                # heartbeat timeout escalates to a view change if the
+                # primary is gone.
+                self._canon_pending = True
+                self._request_start_view()
         if not self.monotonic_external:
             self.monotonic += TICK_NS
         if self.replica_count > 1:
@@ -187,6 +214,12 @@ class VsrReplica(Replica):
             self._ticks - self._repair_last_sent >= REPAIR_RETRY_TICKS
         ):
             self._send_repair_requests(force=True)
+        if (
+            self._canon_pending
+            and self.status == "normal"
+            and self._ticks % VIEW_CHANGE_RESEND_TICKS == 0
+        ):
+            self._request_start_view()
 
     def _retransmit_pipeline(self) -> None:
         """Re-send the lowest non-quorate prepare directly to every
@@ -272,6 +305,7 @@ class VsrReplica(Replica):
             Command.request_prepare: self._on_request_prepare,
             Command.request_headers: self._on_request_headers,
             Command.headers: self._on_headers,
+            Command.request_start_view: self._on_request_start_view,
             Command.request_sync_checkpoint: self._on_request_sync,
             Command.sync_checkpoint: self._on_sync_checkpoint,
             Command.ping: self._on_ping,
@@ -307,6 +341,10 @@ class VsrReplica(Replica):
         elif client:
             entry = self.sessions.get(client)
             if entry is None:
+                if self.commit_min < self.commit_max:
+                    # Still re-committing: the session may live in the
+                    # unapplied suffix — drop; the client retries.
+                    return
                 self._send_eviction(client)
                 return
             if request == entry.request and request > 0:
@@ -439,6 +477,7 @@ class VsrReplica(Replica):
             if int(entry.header["release"]) > self.release:
                 return  # prepared by a newer release; upgrade first
             reply_body = self._commit_prepare(entry.header, entry.body)
+            self.commit_parent = wire.u128(entry.header, "checksum")
             self.commit_max = max(self.commit_max, op)
             client = wire.u128(entry.header, "client")
             if entry.subs:
@@ -594,11 +633,17 @@ class VsrReplica(Replica):
             return
 
         if wire.u128(header, "parent") != self.parent_checksum:
-            # Chain mismatch: our tail is wrong (uncommitted garbage
-            # from an old view) — repair will overwrite it.
-            self._repair_wanted[op] = wire.u128(header, "checksum")
-            self._send_repair_requests()
-            return
+            # Chain mismatch: OUR head is a stale sibling (uncommitted
+            # garbage from an old view).  Accept anyway ONLY if this
+            # prepare is the exact one we pinned (checksum vouched
+            # canonical) — _flag_stale_predecessor then pins the stale
+            # head for repair and the commit gate keeps it from
+            # executing.  Otherwise pin it and wait.
+            checksum = wire.u128(header, "checksum")
+            if self._repair_wanted.get(op) != checksum:
+                self._repair_wanted[op] = checksum
+                self._send_repair_requests()
+                return
 
         self._accept_prepare(header, body)
         # Drain any stashed successors.
@@ -615,8 +660,27 @@ class VsrReplica(Replica):
         self.op = op
         self.parent_checksum = wire.u128(header, "checksum")
         self._repair_wanted.pop(op, None)
+        self._flag_stale_predecessor(header)
         self._replicate(header, body)
         self._send_prepare_ok(header)
+
+    def _flag_stale_predecessor(self, header: np.ndarray) -> None:
+        """Chain continuity at journal-write time: the accepted prepare
+        vouches (via `parent`) for exactly one predecessor checksum.  A
+        mismatched local predecessor is a superseded SIBLING from an
+        older view (same parent, different content — the parent check
+        alone cannot catch it); pin it for exact-checksum repair so the
+        commit path never executes it.  (_verify_chain_down subsumes
+        this during suspect phases.)"""
+        op = int(header["op"])
+        if op - 1 <= self.commit_min:
+            return
+        prev = self.journal.read_prepare(op - 1)
+        want = wire.u128(header, "parent")
+        if prev is None or wire.u128(prev[0], "checksum") != want:
+            self._repair_wanted[op - 1] = want
+            self._chain_suspect = True
+            self._send_repair_requests()
 
     def _send_prepare_ok(self, prepare: np.ndarray) -> None:
         if self.status != "normal" or self.is_primary:
@@ -640,9 +704,30 @@ class VsrReplica(Replica):
 
     def _advance_commit(self, commit_max: int) -> None:
         self.commit_max = max(self.commit_max, commit_max)
+        if self._canon_pending:
+            return  # tail not yet confirmed canonical (start_view pending)
+        if self._chain_suspect:
+            self._verify_chain_down()
+            if self._chain_suspect:
+                return  # stale siblings may lurk; repairs in flight
         while self.commit_min < min(self.commit_max, self.op):
             op = self.commit_min + 1
             read = self.journal.read_prepare(op)
+            if op in self._repair_wanted:
+                want = self._repair_wanted[op]
+                if (
+                    want
+                    and read is not None
+                    and wire.u128(read[0], "checksum") == want
+                ):
+                    # The pin is already satisfied locally.
+                    del self._repair_wanted[op]
+                else:
+                    # Flagged as superseded/missing: wait for the
+                    # canonical prepare instead of executing the local
+                    # candidate.
+                    self._send_repair_requests()
+                    return
             if read is None:
                 self._repair_wanted.setdefault(op, 0)
                 self._send_repair_requests()
@@ -650,7 +735,19 @@ class VsrReplica(Replica):
             header, body = read
             if int(header["release"]) > self.release:
                 return  # prepared by a newer release; upgrade first
+            if (
+                self.commit_parent is not None
+                and wire.u128(header, "parent") != self.commit_parent
+            ):
+                # Local candidate diverges from the committed chain
+                # (e.g. a speculative pre-crash prepare superseded by a
+                # view change): fetch the canonical prepare instead of
+                # executing the stale one.
+                self._repair_wanted.setdefault(op, 0)
+                self._send_repair_requests()
+                return
             self._commit_prepare(header, body)
+            self.commit_parent = wire.u128(header, "checksum")
             if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
                 self.checkpoint()
         if self.op < self.commit_max and not self.is_primary:
@@ -740,6 +837,12 @@ class VsrReplica(Replica):
         have = self.journal.read_prepare(op)
         checksum = wire.u128(header, "checksum")
         if have is not None and wire.u128(have[0], "checksum") == checksum:
+            if want == checksum:
+                # The local copy already IS the pinned canonical one:
+                # unpin, keep cascading the chain check, unblock commit.
+                del self._repair_wanted[op]
+                self._flag_stale_predecessor(have[0])
+                self._advance_commit(self.commit_max)
             self._send_prepare_ok(header)  # already hold it: just ack
             return
         # Accept ONLY checksum-pinned repairs: a stale prepare from a
@@ -751,6 +854,12 @@ class VsrReplica(Replica):
         self._repair_wanted.pop(op, None)
         if op == self.op:
             self.parent_checksum = checksum
+        # Re-verify: the canonical fill vouches for its predecessor,
+        # exposing the next stale sibling (if any).
+        if self._chain_suspect:
+            self._verify_chain_down()
+        else:
+            self._flag_stale_predecessor(header)
         self._send_prepare_ok(header)
         if self.is_primary:
             self._primary_requeue_uncommitted()
@@ -774,6 +883,12 @@ class VsrReplica(Replica):
         ):
             return
         self._repair_last_sent = self._ticks
+        # Drop pins the commit frontier has passed (already executed
+        # canonically; their journal slots may even be recycled).
+        for op in [o for o in self._repair_wanted if o <= self.commit_min]:
+            del self._repair_wanted[op]
+        if not self._repair_wanted:
+            return
         # Ask the primary (authoritative for the committed prefix);
         # ourselves-as-primary asks the successor.
         target = self.primary_index()
@@ -955,6 +1070,8 @@ class VsrReplica(Replica):
         self.commit_max = max(self.commit_max, remote_commit)
         self.op = checkpoint_op
         self.parent_checksum = commit_min_checksum
+        self.commit_parent = commit_min_checksum
+        self._canon_pending = False
         self._repair_wanted.clear()
         self._stash.clear()
         self._sync_chunks.clear()
@@ -964,7 +1081,13 @@ class VsrReplica(Replica):
     # View change.
 
     def _enter_view(self, view: int) -> None:
-        """Adopt a higher view as a backup in normal status."""
+        """Adopt a higher view as a backup in normal status.
+
+        Entering PASSIVELY (we missed the view change) means our
+        uncommitted journal tail may hold superseded siblings of the
+        canonical ops (same parent, different content) — commits are
+        gated until the new primary's start_view installs the canonical
+        tail (reference: Command.request_start_view)."""
         assert view > self.view
         self.view = view
         self.status = "normal"
@@ -975,8 +1098,28 @@ class VsrReplica(Replica):
         self._svc_votes.clear()
         self._dvc.clear()
         self._last_primary_seen = self._ticks
+        if self.op > self.commit_min and not self.is_primary:
+            self._canon_pending = True
+            self._request_start_view()
+
+    def _request_start_view(self) -> None:
+        h = wire.make_header(
+            command=Command.request_start_view, cluster=self.cluster,
+            view=self.view, replica=self.replica,
+        )
+        wire.finalize_header(h, b"")
+        self.bus.send(self.primary_index(), h, b"")
+
+    def _on_request_start_view(self, header: np.ndarray, body: bytes) -> None:
+        if (
+            int(header["view"]) == self.view
+            and self.status == "normal"
+            and self.is_primary
+        ):
+            self._send_start_view(dst=int(header["replica"]))
 
     def _start_view_change(self, view: int) -> None:
+        self._canon_pending = False  # the DVC/start_view round re-canonizes
         self.status = "view_change"
         self.view = view
         self._svc_votes.setdefault(view, set()).add(self.replica)
@@ -999,7 +1142,13 @@ class VsrReplica(Replica):
             return
         if view > self.view or self.status == "normal":
             if view == self.view and self.status == "normal":
-                return  # old noise for our current view
+                # A replica re-running view change for OUR live view
+                # (e.g. rejoining after a crash with an unconfirmed
+                # tail): the primary hands it the canonical view state
+                # (reference: request_start_view).
+                if self.is_primary:
+                    self._send_start_view(dst=int(header["replica"]))
+                return
             self._start_view_change(max(view, self.view))
         self._svc_votes.setdefault(self.view, set()).add(int(header["replica"]))
         votes = self._svc_votes.get(self.view, set())
@@ -1086,8 +1235,13 @@ class VsrReplica(Replica):
         we have headers for are adopted — anything above is uncommitted
         (committed ops always reach a quorum's journals) and truncates.
         """
+        self._canon_pending = False  # the canonical tail is now known
         have_ops = [int(h["op"]) for h in canonical]
-        op_head = max(max(have_ops) if have_ops else 0, commit_floor)
+        # Never regress below our own commit frontier: committed ops
+        # are immutable.
+        op_head = max(
+            max(have_ops) if have_ops else 0, commit_floor, self.commit_min
+        )
         for h in canonical:
             op = int(h["op"])
             if op > op_head:
@@ -1104,10 +1258,30 @@ class VsrReplica(Replica):
         )
         if head is not None:
             self.parent_checksum = wire.u128(head, "checksum")
+        self._verify_chain_down()
         if self._repair_wanted:
             self._send_repair_requests(force=True)
 
-    def _send_start_view(self) -> None:
+    def _verify_chain_down(self) -> None:
+        """Walk the journal from the canonical head toward commit_min,
+        verifying each prepare's checksum against its successor's
+        `parent`.  The first missing/mismatched op (a superseded
+        sibling from an older view) is pinned for exact-checksum
+        repair.  While the walk cannot reach commit_min, the whole
+        uncommitted range is SUSPECT (deeper siblings may hide below
+        the unverified op) and commits are gated (_advance_commit)."""
+        expect = self.parent_checksum
+        for op in range(self.op, self.commit_min, -1):
+            read = self.journal.read_prepare(op)
+            if read is None or wire.u128(read[0], "checksum") != expect:
+                self._repair_wanted[op] = expect
+                self._chain_suspect = True
+                self._send_repair_requests()
+                return
+            expect = wire.u128(read[0], "parent")
+        self._chain_suspect = False
+
+    def _send_start_view(self, dst: int | None = None) -> None:
         body = _encode_dvc({
             "log_view": self.log_view, "op": self.op,
             "commit_min": self.commit_min, "headers": self._tail_headers(),
@@ -1117,13 +1291,21 @@ class VsrReplica(Replica):
             replica=self.replica, op=self.op, commit=self.commit_min,
         )
         wire.finalize_header(h, body)
-        for r in range(self.replica_count):
-            if r != self.replica:
-                self.bus.send(r, h, body)
+        targets = (
+            [dst] if dst is not None
+            else [r for r in range(self.replica_count) if r != self.replica]
+        )
+        for r in targets:
+            self.bus.send(r, h, body)
 
     def _on_start_view(self, header: np.ndarray, body: bytes) -> None:
         view = int(header["view"])
         if view < self.view:
+            return
+        if view == self.view and int(header["op"]) < self.commit_min:
+            # Stale/delayed start_view for the current view (e.g. a
+            # rejoin-help reply that raced past newer commits): adopting
+            # it would regress op below our commit frontier.
             return
         payload = _decode_dvc(body)
         self.view = view

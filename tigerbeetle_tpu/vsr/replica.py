@@ -72,6 +72,7 @@ class Replica:
 
         self.op = 0                  # highest prepared op
         self.commit_min = 0          # highest committed op
+        self.commit_parent = None    # checksum of last committed prepare
         self.view = 0
         self.parent_checksum = 0     # checksum of prepare at self.op
         self.checkpoint_op = 0
@@ -86,7 +87,22 @@ class Replica:
     # ------------------------------------------------------------------
     # Open / recovery.
 
-    def open(self) -> None:
+    def open(self, *, replay_tail: bool | None = None) -> None:
+        """Recover: superblock quorum -> checkpoint snapshot -> WAL.
+
+        `replay_tail` controls whether the WAL above the checkpoint is
+        EXECUTED during recovery.  Single-replica: yes — every recorded
+        prepare was committed.  Multi-replica: no — the tail may hold
+        speculative prepares that never reached quorum and were
+        superseded after a view change; executing them would diverge
+        this replica's state from the cluster permanently.  The tail
+        stays in the journal as candidates, and the consensus layer
+        re-commits it through the parent-checksum-verified chain as
+        commit_max is learned from the cluster (the reference keeps
+        recovering replicas from committing ahead of the cluster the
+        same way — src/vsr/replica.zig:44-49 .recovering_head)."""
+        if replay_tail is None:
+            replay_tail = self.replica_count == 1
         sb = self.superblock.open()
         self.view = int(sb["view"])
         self.checkpoint_op = int(sb["commit_min"])
@@ -107,11 +123,9 @@ class Replica:
         if recovery.faulty_ops and self.replica_count == 1:
             raise RuntimeError(f"WAL data loss at ops {recovery.faulty_ops}")
 
-        # Replay the contiguous prefix above the checkpoint.  A gap
-        # (faulty slot) truncates replay there; with replicas > 1 the
-        # VSR repair protocol refetches the rest from peers (the
-        # reference enters .recovering_head similarly —
-        # src/vsr/replica.zig:44-49).
+        # The contiguous prefix above the checkpoint.  A gap (faulty
+        # slot) truncates the head there; with replicas > 1 the VSR
+        # repair protocol refetches the rest from peers.
         op_head = recovery.op_head
         for op in range(self.checkpoint_op + 1, recovery.op_head + 1):
             read = self.journal.read_prepare(op)
@@ -119,10 +133,22 @@ class Replica:
                 assert self.replica_count > 1
                 op_head = op - 1
                 break
-            header, body = read
-            self._commit_prepare(header, body, replay=True)
+            if replay_tail:
+                header, body = read
+                self._commit_prepare(header, body, replay=True)
         self.op = op_head
-        self.commit_min = op_head
+        self.commit_min = op_head if replay_tail else self.checkpoint_op
+        # Commit-chain anchor: checksum of the last committed prepare
+        # (consensus verifies each next commit links to it).
+        anchor = recovery.headers.get(self.commit_min)
+        if anchor is not None:
+            self.commit_parent = wire.u128(anchor, "checksum")
+        elif self.commit_min == 0:
+            self.commit_parent = wire.u128(
+                wire.root_prepare(self.cluster), "checksum"
+            )
+        else:
+            self.commit_parent = None  # unknown; verified from repair
         head = recovery.headers.get(op_head)
         self.parent_checksum = (
             wire.u128(head, "checksum") if head is not None
