@@ -102,10 +102,12 @@ def test_graft_entry_single_chip():
     import __graft_entry__ as ge
 
     fn, args = ge.entry()
-    out = jax.jit(fn)(*args)
-    jax.block_until_ready(out)
-    results = np.asarray(out["results"])[:8]
-    assert (results == 0).all()
+    balances, packed = jax.jit(fn)(*args)
+    jax.block_until_ready((balances, packed))
+    from tigerbeetle_tpu.state_machine import kernel
+
+    out = kernel.unpack_outputs(np.asarray(packed))
+    assert (out["results"][:8] == 0).all()
 
 
 def test_graft_entry_multichip():

@@ -125,6 +125,7 @@ def run_benchmark(*, addresses: str | None, cluster: int, n_transfers: int,
             s.gauge("load_accepted_tx_per_s", result["transfers_per_second"])
             s.timing("batch_p100_ms", result["batch_latency_p100_ms"])
             s.timing("batch_p99_ms", result["batch_latency_p99_ms"])
+            s.timing("query_p100_ms", result["query_latency_p100_ms"])
             s.close()
         return result
     finally:

@@ -702,7 +702,7 @@ def _run_impl(balances, events, dstat_init, n, ts_base):
         return new_carry, ()
 
     final, _ = lax.scan(body, carry, events)
-    return {
+    out = {
         "balances": final["balances"],
         "results": final["results"],
         "created_mask": final["created_mask"],
@@ -715,6 +715,63 @@ def _run_impl(balances, events, dstat_init, n, ts_base):
         "pulse_create": final["pulse_create"],
         "pulse_remove": final["pulse_remove"],
     }
+    return out["balances"], _pack_outputs(out)
+
+
+# Packed-output column layout: the device link is high-latency, so all
+# per-event outputs ride ONE (B, N_COLS) uint64 matrix fetched in a
+# single device->host transfer (unpacked by unpack_outputs below).
+_SCALAR_COLS = (
+    ["results", "created_mask"]
+    + list(CREATED_FIELDS)
+    + ["inb_status", "dstat", "pulse_create", "pulse_remove", "last_applied"]
+)
+N_COLS = len(_SCALAR_COLS) + 16  # + hist_dr (8) + hist_cr (8)
+
+
+def _pack_outputs(out):
+    cols = []
+    for name in _SCALAR_COLS:
+        if name == "last_applied":
+            # Scalar; may be -1 -> stored (+1) in element 0.
+            v = jnp.zeros_like(out["results"], shape=out["results"].shape)
+            v = v.astype(jnp.uint64).at[0].set(
+                (out["last_applied"] + 1).astype(jnp.uint64)
+            )
+        elif name in CREATED_FIELDS:
+            v = out["created"][name].astype(jnp.uint64)
+        else:
+            v = out[name].astype(jnp.uint64)
+        cols.append(v)
+    mat = jnp.stack(cols, axis=1)
+    return jnp.concatenate([mat, out["hist_dr"], out["hist_cr"]], axis=1)
+
+
+def unpack_outputs(packed: "np.ndarray") -> dict:
+    """Host-side inverse of _pack_outputs (packed: (B, N_COLS) u64)."""
+    import numpy as np
+
+    assert packed.shape[1] == N_COLS, packed.shape
+    out = {"created": {}}
+    for k, name in enumerate(_SCALAR_COLS):
+        col = packed[:, k]
+        if name == "last_applied":
+            out[name] = int(col[0]) - 1
+        elif name in ("dr_slot", "cr_slot"):
+            out["created"][name] = col.view(np.int64).astype(np.int32)
+        elif name in CREATED_FIELDS:
+            dtype = _CREATED_DTYPES.get(name, np.uint64)
+            out["created"][name] = col.astype(dtype)
+        elif name == "created_mask":
+            out[name] = col.astype(bool)
+        elif name in ("results", "inb_status", "dstat"):
+            out[name] = col.astype(np.uint32)
+        else:
+            out[name] = col.copy()
+    base = len(_SCALAR_COLS)
+    out["hist_dr"] = packed[:, base : base + 8]
+    out["hist_cr"] = packed[:, base + 8 : base + 16]
+    return out
 
 
 _run = jax.jit(_run_impl, donate_argnums=(0,))

@@ -132,6 +132,7 @@ class Vopr:
         self.requests = requests
         self.crash_probability = crash_probability
         self.crashed: set[int] = set()
+        self.restart_check_skipped = False
 
     def run(self) -> None:
         c = self.cluster
@@ -240,7 +241,9 @@ class Vopr:
         if live.op != live.commit_min:
             # A prepared-but-uncommitted suffix remains (quorum raced
             # the end of the run); tail replay would execute it, so the
-            # bit-exact comparison only holds without one.
+            # bit-exact comparison only holds without one.  Recorded so
+            # a seed corpus that never exercises this check is visible.
+            self.restart_check_skipped = True
             return
         import copy
 

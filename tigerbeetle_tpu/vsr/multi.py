@@ -644,6 +644,15 @@ class VsrReplica(Replica):
                 self._repair_wanted[op] = checksum
                 self._send_repair_requests()
                 return
+            self._accept_prepare(header, body)
+            self._flag_stale_predecessor(header)
+            while self.op + 1 in self._stash:
+                h, b = self._stash.pop(self.op + 1)
+                if wire.u128(h, "parent") != self.parent_checksum:
+                    break
+                self._accept_prepare(h, b)
+            self._advance_commit(int(header["commit"]))
+            return
 
         self._accept_prepare(header, body)
         # Drain any stashed successors.
@@ -660,7 +669,6 @@ class VsrReplica(Replica):
         self.op = op
         self.parent_checksum = wire.u128(header, "checksum")
         self._repair_wanted.pop(op, None)
-        self._flag_stale_predecessor(header)
         self._replicate(header, body)
         self._send_prepare_ok(header)
 
@@ -826,6 +834,7 @@ class VsrReplica(Replica):
                 and self.status == "normal"
             ):
                 self._accept_prepare(header, body)
+                self._flag_stale_predecessor(header)
                 while self.op + 1 in self._stash:
                     h, b = self._stash.pop(self.op + 1)
                     if wire.u128(h, "parent") != self.parent_checksum:
@@ -1226,7 +1235,8 @@ class VsrReplica(Replica):
         self._primary_requeue_uncommitted()
 
     def _install_log(self, canonical: list[np.ndarray], op_claimed: int,
-                     commit_floor: int) -> None:
+                     commit_floor: int,
+                     head_checksum: int | None = None) -> None:
         """Make our journal match the canonical tail, requesting any
         prepares we don't hold.
 
@@ -1258,6 +1268,18 @@ class VsrReplica(Replica):
         )
         if head is not None:
             self.parent_checksum = wire.u128(head, "checksum")
+        elif head_checksum is not None and op_head == op_claimed:
+            # No header covers op_head (e.g. the sender state-synced and
+            # its checkpoint op is not journaled): anchor on the
+            # sender's explicit head checksum instead of a stale local
+            # one — a wrong anchor would poison the chain-repair pins.
+            self.parent_checksum = head_checksum
+        else:
+            # Unknown anchor: do not run the chain walk against a
+            # possibly-stale parent_checksum.
+            if self._repair_wanted:
+                self._send_repair_requests(force=True)
+            return
         self._verify_chain_down()
         if self._repair_wanted:
             self._send_repair_requests(force=True)
@@ -1285,6 +1307,7 @@ class VsrReplica(Replica):
         body = _encode_dvc({
             "log_view": self.log_view, "op": self.op,
             "commit_min": self.commit_min, "headers": self._tail_headers(),
+            "head_checksum": self.parent_checksum,
         })
         h = wire.make_header(
             command=Command.start_view, cluster=self.cluster, view=self.view,
@@ -1312,7 +1335,10 @@ class VsrReplica(Replica):
         self.status = "normal"
         self.log_view = view
         canonical = [wire.header_from_bytes(raw) for raw in payload["headers"]]
-        self._install_log(canonical, payload["op"], int(header["commit"]))
+        self._install_log(
+            canonical, payload["op"], int(header["commit"]),
+            head_checksum=payload.get("head_checksum"),
+        )
         self.superblock.view_change(self.view, self.log_view, self.commit_max)
         self._svc_votes.clear()
         self._dvc.clear()
