@@ -22,6 +22,8 @@
 //   conditions of the Python fast path.  Otherwise it returns FALLBACK
 //   having mutated nothing, and Python runs the exact JAX scan path.
 
+#include <algorithm>
+#include <array>
 #include <cstdint>
 #include <cstring>
 #include <unordered_map>
@@ -436,3 +438,5 @@ int tb_fp_commit_transfers(
 }
 
 }  // extern "C"
+
+#include "tb_exact.inc"

@@ -139,3 +139,44 @@ def test_differential_random_stream(seed):
         assert cpu.submit(types.Operation.get_account_balances, fb) == tpu.submit(
             types.Operation.get_account_balances, fb
         )
+
+
+def test_kernel_path_parity_without_native():
+    """The JAX scan kernel stays the exact-path reference implementation
+    (the C++ engine shadows it when available): force the kernel path
+    and diff it against the CPU oracle on order-dependent workloads."""
+    from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
+    from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+    from tigerbeetle_tpu.testing.harness import SingleNodeHarness, account, transfer
+    from tigerbeetle_tpu.types import TransferFlags as TF
+
+    hc = SingleNodeHarness(CpuStateMachine())
+    ht = SingleNodeHarness(TpuStateMachine())
+    ht.sm._native = None  # force the JAX kernel exact path
+    for h in (hc, ht):
+        assert h.create_accounts([account(i) for i in range(1, 9)]) == []
+
+    cases = []
+    # linked chains with a failure mid-chain
+    cases.append([
+        transfer(100, debit_account_id=1, credit_account_id=2, amount=5, flags=TF.linked),
+        transfer(101, debit_account_id=2, credit_account_id=3, amount=5, flags=TF.linked),
+        transfer(102, debit_account_id=3, credit_account_id=3, amount=5),  # fails
+        transfer(103, debit_account_id=1, credit_account_id=2, amount=7),
+    ])
+    # two-phase: pending then post (inherit) then double-post
+    cases.append([transfer(200, debit_account_id=1, credit_account_id=2, amount=9,
+                           flags=TF.pending, timeout=100)])
+    cases.append([
+        transfer(201, pending_id=200, flags=TF.post_pending_transfer),
+        transfer(202, pending_id=200, flags=TF.post_pending_transfer),  # already posted
+    ])
+    # balancing debit
+    cases.append([transfer(300, debit_account_id=2, credit_account_id=4, amount=3,
+                           flags=TF.balancing_debit)])
+    for i, c in enumerate(cases):
+        rc = hc.create_transfers(c)
+        rt = ht.create_transfers(c)
+        assert rc == rt, (i, rc, rt)
+    for i in range(1, 9):
+        assert hc.sm.account_balances_raw(i) == ht.sm.account_balances_raw(i), i

@@ -8,7 +8,18 @@ from tigerbeetle_tpu.testing.vopr import Vopr, Workload
 
 @pytest.mark.parametrize("seed", [1, 7, 42, 1234])
 def test_vopr_seed(seed):
-    Vopr(seed, requests=80).run()
+    v = Vopr(seed, requests=80)
+    v.run()
+    # Corpus visibility: the restart-equivalence checker must actually
+    # run for this corpus (not be skipped by uncommitted suffixes).
+    _RESTART_CHECKS.append(not v.restart_check_skipped)
+
+
+_RESTART_CHECKS: list[bool] = []
+
+
+def test_vopr_restart_check_exercised():
+    assert any(_RESTART_CHECKS), "restart-equivalence never exercised"
 
 
 def test_vopr_no_faults_longer():

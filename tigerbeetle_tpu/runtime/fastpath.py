@@ -85,12 +85,30 @@ def _load():
             ctypes.c_void_p, _U8P, ctypes.c_uint32, ctypes.c_uint64,
             _U32P, _I32P, _I32P, _I64P, _I64P, _U64P, _U64P, _U32P,
         ]
+        lib.tb_fp_commit_exact.restype = ctypes.c_int
+        lib.tb_fp_commit_exact.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_uint32, _U32P, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.c_uint32,
+            _U64P, _I64P, _I64P, _U64P, _U64P, _U32P,
+        ]
         _lib = lib
         return _lib
 
 
 def _p(arr: np.ndarray, ptype):
     return arr.ctypes.data_as(ptype)
+
+
+class _OwnedView(np.ndarray):
+    """ndarray view that keeps its native owner alive (lifetime tie),
+    propagated to any derived view via __array_finalize__."""
+
+    _owner = None
+
+    def __array_finalize__(self, obj):
+        if obj is not None:
+            self._owner = getattr(obj, "_owner", None)
 
 
 class NativeFastpath:
@@ -102,21 +120,26 @@ class NativeFastpath:
         self._lib = lib
         self._fp = lib.tb_fp_create(account_capacity)
         self.capacity = account_capacity
-        # Zero-copy numpy views over the native balance mirror.
+        # Zero-copy numpy views over the native balance mirror.  The
+        # views hold a reference back to this object so the native
+        # buffers cannot be freed while any view (e.g. the Python
+        # BalanceMirror) is still alive.
         self.lo = np.ctypeslib.as_array(
             lib.tb_fp_balances_lo(self._fp), shape=(account_capacity, 4)
-        )
+        ).view(_OwnedView)
+        self.lo._owner = self
         self.hi = np.ctypeslib.as_array(
             lib.tb_fp_balances_hi(self._fp), shape=(account_capacity, 4)
-        )
+        ).view(_OwnedView)
+        self.hi._owner = self
         # Reusable output buffers (sized for the largest batch).
         n_max = 8192
         self._results = np.empty(n_max, np.uint32)
         self._dr_slot = np.empty(n_max, np.int32)
         self._cr_slot = np.empty(n_max, np.int32)
         # Deltas are bounded both by touched columns (4/account) and by
-        # 2 per event.
-        d_max = min(4 * account_capacity, 2 * n_max) + 8
+        # 4 per event (a post/void touches dp+dpo and cp+cpo).
+        d_max = min(4 * account_capacity, 4 * n_max) + 8
         self._dslot = np.empty(d_max, np.int64)
         self._dcol = np.empty(d_max, np.int64)
         self._dlo = np.empty(d_max, np.uint64)
@@ -157,6 +180,35 @@ class NativeFastpath:
         id_hi = np.ascontiguousarray(id_hi, np.uint64)
         self._lib.tb_fp_remove_transfer_ids(
             self._fp, _p(id_lo, _U64P), _p(id_hi, _U64P), len(id_lo)
+        )
+
+    def commit_exact(self, ev: dict, field_order, dstat_init, B: int,
+                     n: int, ts_base: int):
+        """Serial exact engine (native/tb_exact.inc): same inputs and
+        packed-output layout as the JAX scan kernel, so the caller
+        unpacks with kernel.unpack_outputs.  Mutates the shared mirror;
+        returns (packed (B, N_COLS) u64, deltas views)."""
+        arrays = []
+        ptrs = (ctypes.c_void_p * len(field_order))()
+        for k, (name, dt) in enumerate(field_order):
+            a = np.ascontiguousarray(ev[name], np.dtype(dt))
+            arrays.append(a)  # keep alive for the call
+            ptrs[k] = a.ctypes.data
+        from tigerbeetle_tpu.state_machine import kernel
+
+        dstat = np.ascontiguousarray(dstat_init, np.uint32)
+        packed = np.zeros((B, kernel.N_COLS), np.uint64)
+        rc = self._lib.tb_fp_commit_exact(
+            self._fp, ptrs, len(field_order), _p(dstat, _U32P), B, n, ts_base,
+            kernel.N_COLS,
+            _p(packed, _U64P), _p(self._dslot, _I64P), _p(self._dcol, _I64P),
+            _p(self._dlo, _U64P), _p(self._dhi, _U64P),
+            ctypes.byref(self._ndeltas),
+        )
+        assert rc == 0, f"exact engine field-order skew ({rc})"
+        k = self._ndeltas.value
+        return packed, (
+            self._dslot[:k], self._dcol[:k], self._dlo[:k], self._dhi[:k]
         )
 
     def commit_transfers(self, body: bytes, n: int, ts_base: int):

@@ -879,17 +879,32 @@ class TpuStateMachine:
             "p_tgt": _pad(p_tgt, B),
         }
 
-        new_balances, packed = kernel.run_create_transfers(
-            self._balances, {k: jnp.asarray(v) for k, v in ev.items()},
-            dstat_init, n, ts_base,
-        )
-        self._balances = new_balances
+        if self._native is not None:
+            # Serial exact engine in C++ (native/tb_exact.inc): same
+            # inputs and packed-output contract as the scan kernel.
+            # Sequential semantics are inherently serial (the reference
+            # loop is single-core), so the host runs them at memory
+            # speed; the shared mirror is mutated in place and the
+            # deltas ride the async device queue.
+            packed_np, deltas = self._native.commit_exact(
+                ev, kernel.EVENT_FIELDS, dstat_init, B, n, ts_base
+            )
+            self._dev.enqueue(*[d.copy() for d in deltas])
+            out = kernel.unpack_outputs(packed_np)
+            mirror_from_hist = False  # C++ already updated the mirror
+        else:
+            new_balances, packed = kernel.run_create_transfers(
+                self._balances, {k: jnp.asarray(v) for k, v in ev.items()},
+                dstat_init, n, ts_base,
+            )
+            self._balances = new_balances
 
-        # ONE device->host transfer for every output: the kernel packs
-        # them into a single u64 matrix because the device link is
-        # high-latency and per-leaf fetches each pay a full round trip
-        # (20x slower on a tunneled TPU).
-        out = kernel.unpack_outputs(np.asarray(packed))
+            # ONE device->host transfer for every output: the kernel
+            # packs them into a single u64 matrix because the device
+            # link is high-latency and per-leaf fetches each pay a full
+            # round trip (20x slower on a tunneled TPU).
+            out = kernel.unpack_outputs(np.asarray(packed))
+            mirror_from_hist = True
 
         results = out["results"][:n]
         created_mask = out["created_mask"][:n]
@@ -905,7 +920,7 @@ class TpuStateMachine:
         # event order, last write wins -> final balances of every
         # touched slot (rolled-back-only slots net to no change).
         ok_idx = np.flatnonzero(results == 0)
-        if len(ok_idx):
+        if mirror_from_hist and len(ok_idx):
             slots2 = np.empty(2 * len(ok_idx), np.int64)
             slots2[0::2] = created["dr_slot"][ok_idx]
             slots2[1::2] = created["cr_slot"][ok_idx]

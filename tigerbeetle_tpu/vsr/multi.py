@@ -1353,10 +1353,12 @@ class VsrReplica(Replica):
 def _encode_dvc(payload: dict) -> bytes:
     import struct
 
+    head = payload.get("head_checksum") or 0
     parts = [
         struct.pack(
-            "<QQQI",
+            "<QQQQQI",
             payload["log_view"], payload["op"], payload["commit_min"],
+            head & 0xFFFFFFFFFFFFFFFF, head >> 64,
             len(payload["headers"]),
         )
     ]
@@ -1367,8 +1369,10 @@ def _encode_dvc(payload: dict) -> bytes:
 def _decode_dvc(body: bytes) -> dict:
     import struct
 
-    log_view, op, commit_min, n = struct.unpack_from("<QQQI", body, 0)
-    off = 28
+    log_view, op, commit_min, head_lo, head_hi, n = struct.unpack_from(
+        "<QQQQQI", body, 0
+    )
+    off = 44
     headers = []
     from tigerbeetle_tpu.constants import HEADER_SIZE
 
@@ -1378,4 +1382,5 @@ def _decode_dvc(body: bytes) -> dict:
     return {
         "log_view": log_view, "op": op, "commit_min": commit_min,
         "headers": headers,
+        "head_checksum": (head_lo | (head_hi << 64)) or None,
     }
