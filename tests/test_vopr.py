@@ -59,3 +59,15 @@ def test_hash_log_divergence_pinpointing():
     d = a.digest(7)
     a.record(7, b"header7", b"reply")
     assert a.digest(7) == d
+
+
+def test_vopr_tpu_state_machine_with_faults():
+    """Whole-cluster fuzz with the TPU state machine (native C++ fast
+    and exact engines + device write-behind) replicated under VSR,
+    WITH the crash/partition/clock-skew nemesis enabled."""
+    from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+
+    Vopr(
+        21, requests=40,
+        state_machine_factory=lambda: TpuStateMachine(cfg.TEST_MIN),
+    ).run()
