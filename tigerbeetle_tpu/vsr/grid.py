@@ -2,8 +2,9 @@
 
 reference: src/vsr/grid.zig:34-60 — fixed-size blocks addressed
 1..block_count, allocated by the FreeSet, verified on every read, with
-a set-associative-style block cache (ours: bounded LRU dict — the
-cache policy is host-side and not consensus-critical).
+a set-associative block cache (utils/cache.py; reference:
+src/lsm/set_associative_cache.zig — the policy is host-side and not
+consensus-critical).
 
 Block layout: [64B header][payload], header =
 checksum u128 | address u64 | length u32 | block_type u8 | pad.
@@ -11,10 +12,9 @@ checksum u128 | address u64 | length u32 | block_type u8 | pad.
 
 from __future__ import annotations
 
-import collections
-
 import numpy as np
 
+from tigerbeetle_tpu.utils.cache import SetAssociativeCache
 from tigerbeetle_tpu.vsr import wire
 from tigerbeetle_tpu.vsr.free_set import FreeSet
 from tigerbeetle_tpu.vsr.storage import Storage
@@ -45,8 +45,12 @@ class Grid:
             storage.layout.grid_offset if base_offset is None else base_offset
         )
         self.free_set = FreeSet(block_count)
-        self._cache: collections.OrderedDict[int, bytes] = collections.OrderedDict()
-        self._cache_max = cache_blocks
+        # Round the operator-facing block budget up to a whole number
+        # of 4-way sets (0 still means "smallest cache", not cache-off:
+        # reads are checksum-verified either way).
+        ways = 4
+        capacity = max(ways, (cache_blocks + ways - 1) // ways * ways)
+        self._cache = SetAssociativeCache(capacity=capacity, ways=ways)
 
     @property
     def payload_size(self) -> int:
@@ -68,12 +72,11 @@ class Grid:
         h["checksum_hi"] = c >> 64
         block = (h.tobytes() + payload).ljust(self.block_size, b"\x00")
         self.storage.write(self._offset(address), block)
-        self._cache_put(address, payload)
+        self._cache.put(address, payload)
 
     def read_block(self, address: int) -> bytes:
         cached = self._cache.get(address)
         if cached is not None:
-            self._cache.move_to_end(address)
             return cached
         raw = self.storage.read(self._offset(address), self.block_size)
         h = np.frombuffer(raw[:BLOCK_HEADER_SIZE], BLOCK_DTYPE)[0]
@@ -84,21 +87,16 @@ class Grid:
         want = int(h["checksum_lo"]) | (int(h["checksum_hi"]) << 64)
         if wire.checksum(payload) != want:
             raise RuntimeError(f"grid block {address} corrupt payload")
-        self._cache_put(address, payload)
+        self._cache.put(address, payload)
         return payload
 
     def verify_block(self, address: int) -> bool:
         """Scrubber probe: is the on-disk block intact? (bypasses cache,
         reference: src/vsr/grid_scrubber.zig)."""
         try:
-            self._cache.pop(address, None)
+            self._cache.remove(address)
             self.read_block(address)
             return True
         except RuntimeError:
             return False
 
-    def _cache_put(self, address: int, payload: bytes) -> None:
-        self._cache[address] = payload
-        self._cache.move_to_end(address)
-        while len(self._cache) > self._cache_max:
-            self._cache.popitem(last=False)
