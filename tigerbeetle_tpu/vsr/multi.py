@@ -126,6 +126,7 @@ class VsrReplica(Replica):
         self._last_clock_ping = 0
         self._vc_last_sent = 0
         self._repair_last_sent = 0
+        self._sync_last_requested = -10**9
         self._last_retransmit = 0
 
         # Pending canonical-log install after passively entering a view
@@ -618,18 +619,13 @@ class VsrReplica(Replica):
             self._repair_fill(header, body)
             return
         if op > self.op + 1:
-            # Gap: stash and request the missing range — unless we're so
-            # far behind that WAL repair can't cover it, in which case
-            # ask for a state sync instead.
+            # Gap: stash and repair the missing range; for a big gap
+            # additionally request a state-sync jump (see
+            # _repair_gap_forward).
             window = 4 * self.config.pipeline_prepare_queue_max
-            if op - self.op > window:
-                self._request_sync()
-                return
             if len(self._stash) < 2 * window:
                 self._stash[op] = (header, body)
-            for missing in range(self.op + 1, op):
-                self._repair_wanted.setdefault(missing, 0)
-            self._send_repair_requests()
+            self._repair_gap_forward(op - 1)
             return
 
         if wire.u128(header, "parent") != self.parent_checksum:
@@ -760,14 +756,21 @@ class VsrReplica(Replica):
                 self.checkpoint()
         if self.op < self.commit_max and not self.is_primary:
             # Our log ends below the commit frontier (e.g. we rejoined
-            # after the pipeline drained): repair forward.
-            window = 4 * self.config.pipeline_prepare_queue_max
-            if self.commit_max - self.op > window:
-                self._request_sync()
-                return
-            for op in range(self.op + 1, self.commit_max + 1):
-                self._repair_wanted.setdefault(op, 0)
-            self._send_repair_requests()
+            # after the pipeline drained).
+            self._repair_gap_forward(self.commit_max)
+
+    def _repair_gap_forward(self, target_op: int) -> None:
+        """Catch the log up toward `target_op`: windowed WAL repair
+        always; for a big gap also request a state-sync jump.  Both
+        stay in flight on separate throttles — the remote checkpoint
+        may be OLDER than our commit frontier (sync would install
+        nothing), so whichever lands first advances us."""
+        window = 4 * self.config.pipeline_prepare_queue_max
+        if target_op - self.op > window:
+            self._request_sync()
+        for op in range(self.op + 1, min(self.op + window, target_op) + 1):
+            self._repair_wanted.setdefault(op, 0)
+        self._send_repair_requests()
 
     def _send_clock_pings(self) -> None:
         """Sample every peer's wall clock: ping carries our monotonic
@@ -962,9 +965,11 @@ class VsrReplica(Replica):
             self._send_repair_requests(force=True)
 
     def _request_sync(self) -> None:
-        if self._ticks - self._repair_last_sent < REPAIR_RETRY_TICKS:
+        # Own throttle: repair requests share the network but must not
+        # starve sync retries (and vice versa).
+        if self._ticks - self._sync_last_requested < REPAIR_RETRY_TICKS:
             return
-        self._repair_last_sent = self._ticks
+        self._sync_last_requested = self._ticks
         h = wire.make_header(
             command=Command.request_sync_checkpoint, cluster=self.cluster,
             view=self.view, replica=self.replica,
