@@ -217,15 +217,37 @@ struct Fastpath {
     IdDir accounts;
     IdDir transfers;  // values unused (duplicate-id set)
 
-    // Per-batch scratch (avoids reallocation).
-    std::unordered_map<uint64_t, u128> delta;  // slot*4+col -> sum
+    // Per-batch scratch (avoids reallocation).  Deltas use epoch-tagged
+    // flat arrays over slot*4+col — O(1) accumulate with no hashing and
+    // no per-batch clearing.
     std::unordered_set<u128, U128Hash> batch_ids;
+    std::vector<u128> delta_sum;       // capacity*4
+    std::vector<uint32_t> delta_epoch; // capacity*4
+    std::vector<uint64_t> delta_keys;  // touched keys, insertion order
+    uint32_t epoch = 0;
 
     explicit Fastpath(uint64_t cap) : capacity(cap) {
         bal_lo.assign(cap * 4, 0);
         bal_hi.assign(cap * 4, 0);
         acct_flags.assign(cap, 0);
         acct_ledger.assign(cap, 0);
+        delta_sum.assign(cap * 4, 0);
+        delta_epoch.assign(cap * 4, 0);
+        delta_keys.reserve(1 << 14);
+    }
+
+    // Accumulate `amount` into the per-batch delta for key; returns
+    // false on u128 wrap.
+    bool delta_add(uint64_t key, u128 amount) {
+        if (delta_epoch[key] != epoch) {
+            delta_epoch[key] = epoch;
+            delta_sum[key] = 0;
+            delta_keys.push_back(key);
+        }
+        u128& d = delta_sum[key];
+        if (d + amount < d) return false;
+        d += amount;
+        return true;
     }
 
     u128 bal(uint64_t slot, int col) const {
@@ -310,7 +332,11 @@ int tb_fp_commit_transfers(
     }
 
     // Pass 1: ladder + admission accumulation (no mutation yet).
-    fp->delta.clear();
+    if (++fp->epoch == 0) {  // epoch wrap: invalidate all tags
+        std::fill(fp->delta_epoch.begin(), fp->delta_epoch.end(), 0);
+        fp->epoch = 1;
+    }
+    fp->delta_keys.clear();
     for (uint32_t i = 0; i < n; i++) {
         const uint8_t* e = body + (size_t)i * 128;
         uint64_t id_lo = rd64(e + OFF_ID_LO), id_hi = rd64(e + OFF_ID_LO + 8);
@@ -383,54 +409,43 @@ int tb_fp_commit_transfers(
         int cr_col = (flags & F_PENDING) ? 2 : 3;  // cp : cpo
         // Accumulate with wrap detection: a wrapped u128 sum would
         // corrupt the admission check below.
-        u128& d1 = fp->delta[dr_slot_u * 4 + (uint64_t)dr_col];
-        if (d1 + amount < d1) return 1;
-        d1 += amount;
-        u128& d2 = fp->delta[cr_slot_u * 4 + (uint64_t)cr_col];
-        if (d2 + amount < d2) return 1;
-        d2 += amount;
+        if (!fp->delta_add(dr_slot_u * 4 + (uint64_t)dr_col, amount)) return 1;
+        if (!fp->delta_add(cr_slot_u * 4 + (uint64_t)cr_col, amount)) return 1;
     }
 
     // Pass 2: admission — every touched column and combined total must
     // stay within u128 (reference: src/state_machine.zig:1531-1547).
-    for (auto& kv : fp->delta) {
-        uint64_t slot = kv.first / 4;
-        int col = (int)(kv.first % 4);
-        u128 old_v = fp->bal(slot, col);
-        u128 add = kv.second;
-        u128 nv = old_v + add;
-        if (nv < old_v) return 1;  // column overflow
-        (void)col;
+    for (uint64_t key : fp->delta_keys) {
+        u128 old_v = fp->bal(key / 4, (int)(key % 4));
+        if (old_v + fp->delta_sum[key] < old_v) return 1;  // column overflow
     }
-    // Combined totals per touched slot (dp+dpo, cp+cpo).
-    {
-        std::unordered_set<uint64_t> touched;
-        for (auto& kv : fp->delta) touched.insert(kv.first / 4);
-        for (uint64_t slot : touched) {
-            u128 cols[4];
-            for (int c2 = 0; c2 < 4; c2++) {
-                cols[c2] = fp->bal(slot, c2);
-                auto it = fp->delta.find(slot * 4 + (uint64_t)c2);
-                if (it != fp->delta.end()) cols[c2] += it->second;
-            }
-            u128 dr_tot = cols[0] + cols[1];
-            if (dr_tot < cols[0]) return 1;
-            u128 cr_tot = cols[2] + cols[3];
-            if (cr_tot < cols[2]) return 1;
+    // Combined totals per touched slot (dp+dpo, cp+cpo): a slot may
+    // appear under several keys; checking it per key is idempotent.
+    for (uint64_t key : fp->delta_keys) {
+        uint64_t slot = key / 4;
+        u128 cols[4];
+        for (int c2 = 0; c2 < 4; c2++) {
+            cols[c2] = fp->bal(slot, c2);
+            uint64_t k2 = slot * 4 + (uint64_t)c2;
+            if (fp->delta_epoch[k2] == fp->epoch) cols[c2] += fp->delta_sum[k2];
         }
+        u128 dr_tot = cols[0] + cols[1];
+        if (dr_tot < cols[0]) return 1;
+        u128 cr_tot = cols[2] + cols[3];
+        if (cr_tot < cols[2]) return 1;
     }
 
     // Pass 3: apply + emit compacted deltas for the device queue.
     uint32_t k = 0;
-    for (auto& kv : fp->delta) {
-        uint64_t slot = kv.first / 4;
-        int col = (int)(kv.first % 4);
-        u128 nv = fp->bal(slot, col) + kv.second;
-        fp->set_bal(slot, col, nv);
+    for (uint64_t key : fp->delta_keys) {
+        uint64_t slot = key / 4;
+        int col = (int)(key % 4);
+        u128 d = fp->delta_sum[key];
+        fp->set_bal(slot, col, fp->bal(slot, col) + d);
         out_dslot[k] = (int64_t)slot;
         out_dcol[k] = col;
-        out_dlo[k] = (uint64_t)kv.second;
-        out_dhi[k] = (uint64_t)(kv.second >> 64);
+        out_dlo[k] = (uint64_t)d;
+        out_dhi[k] = (uint64_t)(d >> 64);
         k++;
     }
     *out_ndeltas = k;
