@@ -1,0 +1,50 @@
+"""Source hygiene lints (reference: src/tidy.zig — banned patterns and
+line-length limits enforced as a test)."""
+
+import os
+import re
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "tigerbeetle_tpu")
+
+BANNED = [
+    # (pattern, why)
+    (re.compile(r"\btime\.time\(\)"), "wall clock in core code breaks "
+     "determinism; use injected realtime/monotonic"),
+    (re.compile(r"\brandom\.random\(\)"), "unseeded randomness breaks "
+     "deterministic simulation; use seeded numpy Generators"),
+    (re.compile(r"\bprint\("), "core modules must not print; use logging "
+     "or tracer"),
+]
+# Modules where process I/O or wall time is the point.
+EXEMPT = {"cli.py", "repl.py", "benchmark.py", "server.py", "native.py",
+          "fastpath.py", "flags.py"}
+
+
+def _py_files():
+    for dirpath, _dirs, files in os.walk(ROOT):
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def test_no_banned_patterns():
+    offenders = []
+    for path in _py_files():
+        if os.path.basename(path) in EXEMPT:
+            continue
+        for lineno, line in enumerate(open(path), 1):
+            stripped = line.split("#", 1)[0]
+            for pat, why in BANNED:
+                if pat.search(stripped):
+                    offenders.append(f"{path}:{lineno}: {pat.pattern} ({why})")
+    assert not offenders, "\n".join(offenders)
+
+
+def test_line_length_limit():
+    # reference tidy.zig enforces 100 columns; we allow 100 too.
+    offenders = []
+    for path in _py_files():
+        for lineno, line in enumerate(open(path), 1):
+            if len(line.rstrip("\n")) > 100:
+                offenders.append(f"{path}:{lineno}: {len(line)} cols")
+    assert not offenders, "\n".join(offenders[:20])

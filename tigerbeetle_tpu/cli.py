@@ -24,7 +24,8 @@ USAGE = """usage: tigerbeetle-tpu <command> [flags]
 
 commands:
   format     --cluster=<int> --replica=<i> --replica-count=<n> <path>
-  start      --addresses=<host:port,...> --replica=<i> [--cpu] <path>...
+  start      --addresses=<host:port,...> --replica=<i> [--cpu]
+             [--aof=<path>] <path>...
   version
   repl       --addresses=<host:port> [--cluster=<int>] [--command=<stmts>]
   benchmark  [--transfers=N] [--accounts=N] [--batch=N] [--addresses=...]
@@ -61,7 +62,9 @@ def cmd_format(args: list[str]) -> None:
 
 def cmd_start(args: list[str]) -> None:
     opts, paths = flags.parse(
-        args, {"addresses": None, "replica": 0, "cluster": 0, "cpu": False}
+        args,
+        {"addresses": None, "replica": 0, "cluster": 0, "cpu": False,
+         "aof": ""},
     )
     if len(paths) != 1:
         flags.fatal("start requires exactly one data-file path")
@@ -71,6 +74,7 @@ def cmd_start(args: list[str]) -> None:
         paths[0], cluster=opts["cluster"],
         addresses=opts["addresses"].split(","), replica_index=opts["replica"],
         state_machine_factory=_sm_factory(opts["cpu"]),
+        aof_path=opts["aof"] or None,
     )
     print(f"listening on port {server.port}", flush=True)
     server.serve_forever()

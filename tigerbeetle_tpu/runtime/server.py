@@ -117,13 +117,21 @@ class ReplicaServer:
     def __init__(self, data_path: str, *, cluster: int,
                  addresses: list[str], replica_index: int,
                  state_machine_factory, config: cfg.Config = cfg.PRODUCTION,
-                 grid_size: int = 1 << 20) -> None:
+                 grid_size: int = 1 << 20, aof_path: str | None = None) -> None:
         layout = ZoneLayout(config=config, grid_size=grid_size)
         self.storage = FileStorage(data_path, layout)
         self.bus = TcpBus(addresses, replica_index, config.message_size_max)
+        aof = None
+        if aof_path:
+            # Append-only file of every committed prepare (reference:
+            # src/aof.zig, --aof flag): an independent audit/recovery
+            # stream replayable via vsr.aof.replay.
+            from tigerbeetle_tpu.vsr.aof import AOF
+
+            aof = AOF(aof_path)
         self.replica = VsrReplica(
             self.storage, cluster, state_machine_factory(), self.bus,
-            replica=replica_index, replica_count=len(addresses),
+            replica=replica_index, replica_count=len(addresses), aof=aof,
         )
         self.replica.open()
         self._last_tick = 0
@@ -182,6 +190,8 @@ class ReplicaServer:
             self.poll_once()
 
     def close(self) -> None:
+        if self.replica.aof is not None:
+            self.replica.aof.close()
         self.bus.native.close()
         self.storage.close()
 

@@ -634,10 +634,17 @@ class TpuStateMachine:
         cr_found, cr_slot_u = self._acct_dir.lookup(cr_lo, cr_hi)
         dr_slot = np.where(dr_found, dr_slot_u.astype(np.int64), -1).astype(np.int32)
         cr_slot = np.where(cr_found, cr_slot_u.astype(np.int64), -1).astype(np.int32)
-        dr_flags = np.where(dr_found, self._attrs["flags"][np.clip(dr_slot, 0, None)], 0).astype(np.uint32)
-        cr_flags = np.where(cr_found, self._attrs["flags"][np.clip(cr_slot, 0, None)], 0).astype(np.uint32)
-        dr_ledger = np.where(dr_found, self._attrs["ledger"][np.clip(dr_slot, 0, None)], 0).astype(np.uint32)
-        cr_ledger = np.where(cr_found, self._attrs["ledger"][np.clip(cr_slot, 0, None)], 0).astype(np.uint32)
+        dr_c = np.clip(dr_slot, 0, None)
+        cr_c = np.clip(cr_slot, 0, None)
+        attrs = self._attrs
+        dr_flags = np.where(dr_found, attrs["flags"][dr_c], 0).astype(np.uint32)
+        cr_flags = np.where(cr_found, attrs["flags"][cr_c], 0).astype(np.uint32)
+        dr_ledger = np.where(
+            dr_found, attrs["ledger"][dr_c], 0
+        ).astype(np.uint32)
+        cr_ledger = np.where(
+            cr_found, attrs["ledger"][cr_c], 0
+        ).astype(np.uint32)
 
         # Elementary predicates, shared by the all-valid short circuit
         # and the precedence ladder.
@@ -1298,7 +1305,8 @@ class TpuStateMachine:
         out["debits_posted_lo"], out["debits_posted_hi"] = balances[:, 2], balances[:, 3]
         out["credits_pending_lo"], out["credits_pending_hi"] = balances[:, 4], balances[:, 5]
         out["credits_posted_lo"], out["credits_posted_hi"] = balances[:, 6], balances[:, 7]
-        out["user_data_128_lo"], out["user_data_128_hi"] = a["ud128_lo"][slots], a["ud128_hi"][slots]
+        out["user_data_128_lo"] = a["ud128_lo"][slots]
+        out["user_data_128_hi"] = a["ud128_hi"][slots]
         out["user_data_64"] = a["ud64"][slots]
         out["user_data_32"] = a["ud32"][slots]
         out["ledger"] = a["ledger"][slots]
@@ -1319,7 +1327,8 @@ class TpuStateMachine:
         out["credit_account_id_hi"] = self._attrs["id_hi"][cr]
         out["amount_lo"], out["amount_hi"] = st["amount_lo"][rows], st["amount_hi"][rows]
         out["pending_id_lo"], out["pending_id_hi"] = st["pending_lo"][rows], st["pending_hi"][rows]
-        out["user_data_128_lo"], out["user_data_128_hi"] = st["ud128_lo"][rows], st["ud128_hi"][rows]
+        out["user_data_128_lo"] = st["ud128_lo"][rows]
+        out["user_data_128_hi"] = st["ud128_hi"][rows]
         out["user_data_64"] = st["ud64"][rows]
         out["user_data_32"] = st["ud32"][rows]
         out["timeout"] = st["timeout"][rows]

@@ -77,9 +77,11 @@ class VsrReplica(Replica):
     def __init__(self, storage, cluster, state_machine, bus, *,
                  replica: int, replica_count: int,
                  release: int = 1,
-                 releases_available: tuple[int, ...] = (1,)) -> None:
+                 releases_available: tuple[int, ...] = (1,),
+                 aof=None) -> None:
         super().__init__(storage, cluster, state_machine,
-                         replica=replica, replica_count=replica_count)
+                         replica=replica, replica_count=replica_count,
+                         aof=aof)
         self.bus = bus
         self.status = "recovering"
         self.log_view = 0
