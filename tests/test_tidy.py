@@ -27,13 +27,28 @@ def _py_files():
                 yield os.path.join(dirpath, f)
 
 
+def _strip_comment(line: str) -> str:
+    """Drop a trailing comment, respecting string literals (a '#'
+    inside quotes is not a comment start)."""
+    quote = None
+    for i, ch in enumerate(line):
+        if quote:
+            if ch == quote and line[i - 1] != "\\":
+                quote = None
+        elif ch in "'\"":
+            quote = ch
+        elif ch == "#":
+            return line[:i]
+    return line
+
+
 def test_no_banned_patterns():
     offenders = []
     for path in _py_files():
         if os.path.basename(path) in EXEMPT:
             continue
         for lineno, line in enumerate(open(path), 1):
-            stripped = line.split("#", 1)[0]
+            stripped = _strip_comment(line)
             for pat, why in BANNED:
                 if pat.search(stripped):
                     offenders.append(f"{path}:{lineno}: {pat.pattern} ({why})")
@@ -45,6 +60,7 @@ def test_line_length_limit():
     offenders = []
     for path in _py_files():
         for lineno, line in enumerate(open(path), 1):
-            if len(line.rstrip("\n")) > 100:
-                offenders.append(f"{path}:{lineno}: {len(line)} cols")
+            cols = len(line.rstrip("\n"))
+            if cols > 100:
+                offenders.append(f"{path}:{lineno}: {cols} cols")
     assert not offenders, "\n".join(offenders[:20])

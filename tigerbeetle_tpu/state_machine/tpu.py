@@ -745,7 +745,12 @@ class TpuStateMachine:
     ) -> bytes:
         """Fast-path routing + exact kernel dispatch, after account
         resolution and the static ladder."""
-        B = next(b for b in _BATCH_BUCKETS if b >= n)
+        # The JAX kernel needs shape buckets (compile cache); the native
+        # exact engine takes any length — skip the ~50-array padding.
+        if self._native is not None:
+            B = n
+        else:
+            B = next(b for b in _BATCH_BUCKETS if b >= n)
 
         # Durable joins (vectorized hash-index probes).
         e_found, e_row = self._tdir.lookup(id_lo, id_hi)

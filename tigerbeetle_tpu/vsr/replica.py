@@ -382,6 +382,11 @@ class Replica:
         assert head is not None
         head_header, _ = head
 
+        if self.aof is not None:
+            # The AOF is a recovery stream: make it durable at least as
+            # often as checkpoints (reference: src/aof.zig fsyncs).
+            self.aof.sync()
+
         blob = self._take_snapshot()
         region = int(self.superblock.working["sequence"]) % 2
         offset = self._grid_region_offset(region, len(blob))
