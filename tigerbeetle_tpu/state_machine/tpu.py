@@ -827,8 +827,21 @@ class TpuStateMachine:
 
         st = self._store
 
-        def gather(col, rows, valid):
-            return np.where(valid, st[col][rows], 0)
+        # Durable joins: skip the fancy-index gathers entirely when the
+        # batch references no durable duplicate/pending rows (the
+        # common case for fresh-id batches).
+        def _make_gather(found, rows):
+            has = bool(found.any())
+
+            def gather(col):
+                if not has:
+                    return np.zeros(n, st[col].dtype)
+                return np.where(found, st[col][rows], 0)
+
+            return gather
+
+        gather_e = _make_gather(e_found, er)
+        gather_p = _make_gather(p_found, pr)
 
         # Durable-pending target dedupe + initial statuses.
         p_rows_valid = p_row[p_found].astype(np.int64)
@@ -861,33 +874,33 @@ class TpuStateMachine:
             "id_group": _pad(id_group.astype(np.int32), B),
             "p_group": _pad(p_group, B),
             "e_found": _pad(e_found, B),
-            "e_flags": _pad(gather("flags", er, e_found).astype(np.uint32), B),
-            "e_dr_slot": _pad(gather("dr_slot", er, e_found).astype(np.int32), B),
-            "e_cr_slot": _pad(gather("cr_slot", er, e_found).astype(np.int32), B),
-            "e_amount_lo": _pad(gather("amount_lo", er, e_found).astype(np.uint64), B),
-            "e_amount_hi": _pad(gather("amount_hi", er, e_found).astype(np.uint64), B),
-            "e_pending_lo": _pad(gather("pending_lo", er, e_found).astype(np.uint64), B),
-            "e_pending_hi": _pad(gather("pending_hi", er, e_found).astype(np.uint64), B),
-            "e_ud128_lo": _pad(gather("ud128_lo", er, e_found).astype(np.uint64), B),
-            "e_ud128_hi": _pad(gather("ud128_hi", er, e_found).astype(np.uint64), B),
-            "e_ud64": _pad(gather("ud64", er, e_found).astype(np.uint64), B),
-            "e_ud32": _pad(gather("ud32", er, e_found).astype(np.uint32), B),
-            "e_timeout": _pad(gather("timeout", er, e_found).astype(np.uint64), B),
-            "e_code": _pad(gather("code", er, e_found).astype(np.uint32), B),
+            "e_flags": _pad(gather_e("flags").astype(np.uint32), B),
+            "e_dr_slot": _pad(gather_e("dr_slot").astype(np.int32), B),
+            "e_cr_slot": _pad(gather_e("cr_slot").astype(np.int32), B),
+            "e_amount_lo": _pad(gather_e("amount_lo").astype(np.uint64), B),
+            "e_amount_hi": _pad(gather_e("amount_hi").astype(np.uint64), B),
+            "e_pending_lo": _pad(gather_e("pending_lo").astype(np.uint64), B),
+            "e_pending_hi": _pad(gather_e("pending_hi").astype(np.uint64), B),
+            "e_ud128_lo": _pad(gather_e("ud128_lo").astype(np.uint64), B),
+            "e_ud128_hi": _pad(gather_e("ud128_hi").astype(np.uint64), B),
+            "e_ud64": _pad(gather_e("ud64").astype(np.uint64), B),
+            "e_ud32": _pad(gather_e("ud32").astype(np.uint32), B),
+            "e_timeout": _pad(gather_e("timeout").astype(np.uint64), B),
+            "e_code": _pad(gather_e("code").astype(np.uint32), B),
             "p_found": _pad(p_found, B),
-            "p_flags": _pad(gather("flags", pr, p_found).astype(np.uint32), B),
-            "p_dr_slot": _pad(gather("dr_slot", pr, p_found).astype(np.int32), B),
-            "p_cr_slot": _pad(gather("cr_slot", pr, p_found).astype(np.int32), B),
-            "p_amount_lo": _pad(gather("amount_lo", pr, p_found).astype(np.uint64), B),
-            "p_amount_hi": _pad(gather("amount_hi", pr, p_found).astype(np.uint64), B),
-            "p_ud128_lo": _pad(gather("ud128_lo", pr, p_found).astype(np.uint64), B),
-            "p_ud128_hi": _pad(gather("ud128_hi", pr, p_found).astype(np.uint64), B),
-            "p_ud64": _pad(gather("ud64", pr, p_found).astype(np.uint64), B),
-            "p_ud32": _pad(gather("ud32", pr, p_found).astype(np.uint32), B),
-            "p_timeout": _pad(gather("timeout", pr, p_found).astype(np.uint64), B),
-            "p_ledger": _pad(gather("ledger", pr, p_found).astype(np.uint32), B),
-            "p_code": _pad(gather("code", pr, p_found).astype(np.uint32), B),
-            "p_timestamp": _pad(gather("timestamp", pr, p_found).astype(np.uint64), B),
+            "p_flags": _pad(gather_p("flags").astype(np.uint32), B),
+            "p_dr_slot": _pad(gather_p("dr_slot").astype(np.int32), B),
+            "p_cr_slot": _pad(gather_p("cr_slot").astype(np.int32), B),
+            "p_amount_lo": _pad(gather_p("amount_lo").astype(np.uint64), B),
+            "p_amount_hi": _pad(gather_p("amount_hi").astype(np.uint64), B),
+            "p_ud128_lo": _pad(gather_p("ud128_lo").astype(np.uint64), B),
+            "p_ud128_hi": _pad(gather_p("ud128_hi").astype(np.uint64), B),
+            "p_ud64": _pad(gather_p("ud64").astype(np.uint64), B),
+            "p_ud32": _pad(gather_p("ud32").astype(np.uint32), B),
+            "p_timeout": _pad(gather_p("timeout").astype(np.uint64), B),
+            "p_ledger": _pad(gather_p("ledger").astype(np.uint32), B),
+            "p_code": _pad(gather_p("code").astype(np.uint32), B),
+            "p_timestamp": _pad(gather_p("timestamp").astype(np.uint64), B),
             "p_tgt": _pad(p_tgt, B),
         }
 
