@@ -145,6 +145,8 @@ class NativeFastpath:
         self._dlo = np.empty(d_max, np.uint64)
         self._dhi = np.empty(d_max, np.uint64)
         self._ndeltas = ctypes.c_uint32(0)
+        self._packed = None
+        self._field_dtypes = None
 
     def __del__(self):
         if getattr(self, "_fp", None):
@@ -187,17 +189,30 @@ class NativeFastpath:
         """Serial exact engine (native/tb_exact.inc): same inputs and
         packed-output layout as the JAX scan kernel, so the caller
         unpacks with kernel.unpack_outputs.  Mutates the shared mirror;
-        returns (packed (B, N_COLS) u64, deltas views)."""
-        arrays = []
-        ptrs = (ctypes.c_void_p * len(field_order))()
-        for k, (name, dt) in enumerate(field_order):
-            a = np.ascontiguousarray(ev[name], np.dtype(dt))
-            arrays.append(a)  # keep alive for the call
-            ptrs[k] = a.ctypes.data
+        returns (packed (B, N_COLS) u64, deltas views) — the packed
+        buffer is reused per call (the engine fully overwrites rows
+        [0, B))."""
         from tigerbeetle_tpu.state_machine import kernel
 
+        dtypes = self._field_dtypes
+        if dtypes is None:
+            dtypes = self._field_dtypes = [
+                np.dtype(dt) for _name, dt in field_order
+            ]
+        arrays = []
+        ptrs = (ctypes.c_void_p * len(field_order))()
+        for k, (name, _dt) in enumerate(field_order):
+            a = np.ascontiguousarray(ev[name], dtypes[k])
+            arrays.append(a)  # keep alive for the call
+            ptrs[k] = a.ctypes.data
+
         dstat = np.ascontiguousarray(dstat_init, np.uint32)
-        packed = np.zeros((B, kernel.N_COLS), np.uint64)
+        packed = self._packed
+        if packed is None or packed.shape[0] < B:
+            packed = self._packed = np.empty(
+                (max(B, 8192), kernel.N_COLS), np.uint64
+            )
+        packed = packed[:B]
         rc = self._lib.tb_fp_commit_exact(
             self._fp, ptrs, len(field_order), _p(dstat, _U32P), B, n, ts_base,
             kernel.N_COLS,

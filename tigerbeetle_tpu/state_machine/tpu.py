@@ -772,15 +772,16 @@ class TpuStateMachine:
         # common encoder output) prove uniqueness without a sort; else
         # a 64-bit key mix + unique — a hash collision only costs a
         # detour through the exact scan path, which resolves true id
-        # groups.
+        # groups.  The lexicographic (hi, lo) ascending test is shared
+        # with the exact-path grouping shortcut below.
+        ascending = n == 1 or bool(
+            (
+                (id_hi[1:] > id_hi[:-1])
+                | ((id_hi[1:] == id_hi[:-1]) & (id_lo[1:] > id_lo[:-1]))
+            ).all()
+        )
         if order_free:
-            ids_unique = bool(
-                n == 1
-                or (
-                    (id_hi[1:] == id_hi[:-1]).all()
-                    and (id_lo[1:] > id_lo[:-1]).all()
-                )
-            )
+            ids_unique = ascending
             if not ids_unique:
                 id_mix = id_lo * np.uint64(0x9E3779B97F4A7C15) + id_hi * np.uint64(
                     0xC2B2AE3D27D4EB4F
@@ -808,7 +809,13 @@ class TpuStateMachine:
 
         # Exact-path id groups: one compact index per distinct id value.
         id_key = pack_u128(id_lo, id_hi)
-        unique_ids, id_group = np.unique(id_key, return_inverse=True)
+        if ascending:
+            # Strictly ascending (the common sequential-id encoding):
+            # identity grouping without the unique() sort.
+            unique_ids = id_key
+            id_group = np.arange(n)
+        else:
+            unique_ids, id_group = np.unique(id_key, return_inverse=True)
         pend_key = pack_u128(pend_lo, pend_hi)
         pos = np.searchsorted(unique_ids, pend_key)
         pos_c = np.minimum(pos, len(unique_ids) - 1)
