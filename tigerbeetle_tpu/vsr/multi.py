@@ -49,6 +49,9 @@ VIEW_CHANGE_TICKS = 10
 VIEW_CHANGE_RESEND_TICKS = 4
 REPAIR_RETRY_TICKS = 3
 
+# Sentinel: the in-flight request set cannot be determined yet.
+UNDECIDABLE = object()
+
 # Virtual tick length for the per-replica monotonic clock; shared with
 # the simulator's wall-clock step and the server's tick cadence so
 # clock-sync RTT math stays consistent.
@@ -327,54 +330,11 @@ class VsrReplica(Replica):
             # Forward to the primary (clients may have a stale view).
             self.bus.send(self.primary_index(), header, body)
             return
-        client = wire.u128(header, "client")
-        request = int(header["request"])
-        operation = int(header["operation"])
-
-        if operation == int(VsrOperation.register) and client:
-            entry = self.sessions.get(client)
-            if entry is not None:
-                # Re-sent register whose reply was lost: replay it
-                # instead of re-committing (a fresh commit would leak a
-                # reply slot and evict an innocent session — reference:
-                # duplicate register replays the stored reply,
-                # src/vsr/replica.zig:5035-5100).
-                self._send_register_reply(client, entry)
-                return
-        elif client:
-            entry = self.sessions.get(client)
-            if entry is None:
-                if self.commit_min < self.commit_max:
-                    # Still re-committing: the session may live in the
-                    # unapplied suffix — drop; the client retries.
-                    return
-                self._send_eviction(client)
-                return
-            if request == entry.request and request > 0:
-                self._send_stored_reply(client, entry)
-                return
-            if request < entry.request:
-                return  # stale duplicate
-        if client:
-            # In-flight dedupe: a retransmission must not be prepared a
-            # second time while the original is still in the pipeline
-            # (reference: primary pipeline message_by_client lookup).
-            for pe in self.pipeline.values():
-                if (
-                    wire.u128(pe.header, "client") == client
-                    and int(pe.header["request"]) == request
-                ):
-                    return
-                if pe.subs and any(
-                    c == client and r == request for c, r, _ in pe.subs
-                ):
-                    return
-            for qh, _ in self.request_queue:
-                if (
-                    wire.u128(qh, "client") == client
-                    and int(qh["request"]) == request
-                ):
-                    return
+        verdict = self._request_dedupe(header)
+        if verdict is not None:
+            if verdict == "queue":
+                self._enqueue_request(header, body)
+            return
         if (
             len(self.pipeline) >= self.config.pipeline_prepare_queue_max
             or (self.replica_count > 1 and not self.clock.synchronized)
@@ -383,9 +343,121 @@ class VsrReplica(Replica):
             # clock window doesn't exist (reference: src/vsr/replica.zig
             # on_request gates on realtime_synchronized): queue and
             # drain from tick()/commit.
-            self.request_queue.append((header, body))
+            self._enqueue_request(header, body)
             return
         self._primary_prepare(header, body)
+
+    def _enqueue_request(self, header: np.ndarray, body: bytes) -> None:
+        """Queue a request exactly once: broadcast retransmissions of
+        the same (client, request) must not pile up (a batched drain
+        would execute every copy)."""
+        client = wire.u128(header, "client")
+        request = int(header["request"])
+        for qh, _ in self.request_queue:
+            if (
+                wire.u128(qh, "client") == client
+                and int(qh["request"]) == request
+            ):
+                return
+        self.request_queue.append((header, body))
+
+    def _request_dedupe(
+        self, header: np.ndarray, in_queue: bool = False,
+        peek: bool = False,
+    ) -> str | None:
+        """At-most-once gate, shared by request arrival and queue drain.
+
+        -> None ("fresh: prepare it"), "drop" (duplicate/stale/handled),
+        or "queue" (cannot decide yet: catching up or tail not yet
+        materialized — retry once current).  `peek` suppresses the
+        reply/eviction side effects (batch lookahead must not send
+        twice)."""
+        client = wire.u128(header, "client")
+        request = int(header["request"])
+        operation = int(header["operation"])
+
+        if not client:
+            return None
+        is_register = operation == int(VsrOperation.register)
+        entry = self.sessions.get(client)
+
+        if is_register:
+            if entry is not None:
+                # Re-sent register whose reply was lost: replay it
+                # instead of re-committing (a fresh commit would leak a
+                # reply slot and evict an innocent session — reference:
+                # src/vsr/replica.zig:5035-5100).
+                if not peek:
+                    self._send_register_reply(client, entry)
+                return "drop"
+            # No session yet: fall through to the in-flight scans — a
+            # retransmitted register whose original is still in flight
+            # must not be prepared twice.
+        elif entry is None:
+            if self.commit_min < self.commit_max:
+                # Still re-committing: the session may live in the
+                # unapplied suffix.
+                return "queue"
+            if not peek:
+                self._send_eviction(client)
+            return "drop"
+        else:
+            if request == entry.request and request > 0:
+                if not peek:
+                    self._send_stored_reply(client, entry)
+                return "drop"
+            if request < entry.request:
+                return "drop"  # stale duplicate
+            if self.commit_min < self.commit_max:
+                # Catching up: the re-committing suffix may already
+                # contain this request (our session entry is from an
+                # older checkpoint) — preparing it now would execute it
+                # twice.
+                return "queue"
+
+        # In-flight dedupe: pipeline, queued requests, and the
+        # uncommitted journal tail (a prepare adopted via repair never
+        # enters OUR pipeline) — a retransmission must not be prepared
+        # a second time anywhere (reference: primary pipeline
+        # message_by_client lookup).
+        inflight = self._inflight_requests(include_queue=not in_queue)
+        if inflight is UNDECIDABLE:
+            return "queue"
+        return "drop" if (client, request) in inflight else None
+
+    def _inflight_requests(self, include_queue: bool = True):
+        """(client, request) pairs currently in the pipeline, queue,
+        and uncommitted journal tail — or UNDECIDABLE while the tail is
+        not fully materialized (repair in flight)."""
+        pairs: set[tuple[int, int]] = set()
+        for pe in self.pipeline.values():
+            c = wire.u128(pe.header, "client")
+            if c:
+                pairs.add((c, int(pe.header["request"])))
+            if pe.subs:
+                pairs.update((sc, sr) for sc, sr, _ in pe.subs if sc)
+        if include_queue:
+            for qh, _ in self.request_queue:
+                c = wire.u128(qh, "client")
+                if c:
+                    pairs.add((c, int(qh["request"])))
+        for tail_op in range(self.commit_min + 1, self.op + 1):
+            if tail_op in self.pipeline:
+                continue  # scanned above
+            read = self.journal.read_prepare(tail_op)
+            if read is None:
+                return UNDECIDABLE
+            th, tb = read
+            c = wire.u128(th, "client")
+            if c:
+                pairs.add((c, int(th["request"])))
+            t_subs = wire.u128(th, "context")
+            if t_subs and (
+                int(th["operation"]) >= constants.VSR_OPERATIONS_RESERVED
+            ):
+                _ev, subs2 = demuxer.decode_trailer(tb, t_subs)
+                pairs.update((sc, sr) for sc, sr, _ in subs2 if sc)
+        return pairs
 
     def _advance_prepare_timestamp(self) -> None:
         """Primary timestamping through the synchronized cluster clock:
@@ -462,7 +534,19 @@ class VsrReplica(Replica):
             if read is None:
                 continue  # still repairing; retried on fill
             header, body = read
-            self.pipeline[op] = PipelineEntry(header, body, {self.replica})
+            # Reconstruct logical-batch sub-requests from the body
+            # trailer: the retransmission dedupe scans them, and a
+            # requeued batch without its subs would let a client's
+            # retransmit be prepared (and executed) a second time.
+            subs = None
+            n_subs = wire.u128(header, "context")
+            if n_subs and (
+                int(header["operation"]) >= constants.VSR_OPERATIONS_RESERVED
+            ):
+                _events, subs = demuxer.decode_trailer(body, n_subs)
+            self.pipeline[op] = PipelineEntry(
+                header, body, {self.replica}, subs
+            )
             self._replicate(header, body)
         self._maybe_commit_pipeline()
 
@@ -506,10 +590,20 @@ class VsrReplica(Replica):
         cutting per-request consensus overhead under load."""
         if self.replica_count > 1 and not self.clock.synchronized:
             return
+        requeue: list[tuple[np.ndarray, bytes]] = []
         while self.request_queue and (
             len(self.pipeline) < self.config.pipeline_prepare_queue_max
         ):
             h, b = self.request_queue.pop(0)
+            # Queued requests re-run the at-most-once gate: their
+            # duplicate may have committed (or become decidable) while
+            # they waited.
+            verdict = self._request_dedupe(h, in_queue=True)
+            if verdict == "drop":
+                continue
+            if verdict == "queue":
+                requeue.append((h, b))
+                continue
             operation = int(h["operation"])
             batch = []
             if (
@@ -528,12 +622,18 @@ class VsrReplica(Replica):
                         break
                     if total + len(b2) + sub_size > limit:
                         break
+                    if (
+                        self._request_dedupe(h2, in_queue=True, peek=True)
+                        is not None
+                    ):
+                        break  # handled/undecidable: not batchable now
                     batch.append(self.request_queue.pop(0))
                     total += len(b2) + sub_size
             if batch:
                 self._primary_prepare_batch([(h, b)] + batch)
             else:
                 self._primary_prepare(h, b)
+        self.request_queue.extend(requeue)
 
     def _primary_prepare_batch(
         self, requests: list[tuple[np.ndarray, bytes]]
@@ -1042,7 +1142,10 @@ class VsrReplica(Replica):
     def _on_sync_checkpoint(self, header: np.ndarray, body: bytes) -> None:
         checkpoint_op = int(header["op"])
         if checkpoint_op <= self.commit_min:
-            return  # already past it
+            # Already past it; drop any partial chunk assembly for this
+            # obsolete checkpoint.
+            self._sync_chunks.pop(wire.u128(header, "context"), None)
+            return
         blob_checksum = wire.u128(header, "context")
         total = int(header["timestamp"])
         chunk_size = self.config.message_body_size_max
@@ -1065,6 +1168,7 @@ class VsrReplica(Replica):
     def _install_sync_checkpoint(self, blob: bytes, checkpoint_op: int,
                                  commit_min_checksum: int, blob_checksum: int,
                                  remote_commit: int) -> None:
+        assert checkpoint_op > self.commit_min  # guarded at receive
         self._restore_snapshot(blob)
         self.sm.prepare_timestamp = self.sm.commit_timestamp
 
