@@ -186,3 +186,95 @@ def test_session_eviction_when_clients_max_exceeded():
     for r in c.replicas:
         assert clients[0].id not in r.sessions
         assert len(r.sessions) == cmax
+
+
+def test_view_change_mid_pipeline_preserves_committed_prefix():
+    """Primary dies with prepares in flight; the committed prefix must
+    survive and the uncommitted tail resolves one way only (reference:
+    replica_test.zig 'view-change after partition' scenarios)."""
+    c = Cluster(replica_count=3, seed=31)
+    client = c.client(1000)
+    client.register()
+    c.run_until(lambda: client.registered)
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    for k in range(5):
+        c.run_request(client, types.Operation.create_transfers,
+                      pack([transfer(100 + k, debit_account_id=1,
+                                     credit_account_id=2, amount=1)]))
+    committed_before = c.replicas[0].commit_min
+    # Kill the primary the instant a fresh request reaches it.
+    client.request(types.Operation.create_transfers,
+                   pack([transfer(200, debit_account_id=1,
+                                  credit_account_id=2, amount=7)]))
+    c.step()
+    c.crash_replica(0)
+    c.run_until(lambda: client.reply is not None, max_steps=8000)
+    c.restart_replica(0)
+    c.settle(max_steps=8000)
+    for _ in range(30):
+        c.step()
+    c.check_linearized()
+    c.check_convergence()
+    for r in c.replicas:
+        assert r.commit_min >= committed_before
+        for k in range(5):
+            assert r.sm.transfer_timestamp(100 + k) is not None
+    # Transfer 200 either committed everywhere or nowhere.
+    states = {r.sm.transfer_timestamp(200) is not None for r in c.replicas}
+    assert len(states) == 1
+
+
+def test_deep_lag_catches_up_via_state_sync():
+    """A replica partitioned across multiple checkpoints rejoins via
+    state sync rather than WAL repair (reference: sync.zig supersedes
+    repair once the WAL has wrapped)."""
+    c = Cluster(replica_count=3, seed=32)
+    client = c.client(1000)
+    client.register()
+    c.run_until(lambda: client.registered)
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    c.network.partition(2)
+    interval = c.replicas[0].config.vsr_checkpoint_interval
+    for k in range(3 * interval):
+        c.run_request(client, types.Operation.create_transfers,
+                      pack([transfer(1000 + k, debit_account_id=1,
+                                     credit_account_id=2, amount=1)]))
+    assert c.replicas[0].checkpoint_op > 0
+    assert c.replicas[2].commit_min < c.replicas[0].commit_min
+    c.network.heal()
+    c.settle(max_steps=20000)
+    for _ in range(50):
+        c.step()
+    c.check_convergence()
+    assert c.replicas[2].sm.transfer_timestamp(1000 + 3 * interval - 1) is not None
+
+
+def test_wal_corruption_on_backup_repaired_from_peers():
+    """A backup's corrupt WAL slot is refetched from peers by checksum
+    (reference: protocol-aware WAL repair, replica.zig:2259-2497)."""
+    c = Cluster(replica_count=3, seed=33)
+    client = c.client(1000)
+    client.register()
+    c.run_until(lambda: client.registered)
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    for k in range(6):
+        c.run_request(client, types.Operation.create_transfers,
+                      pack([transfer(300 + k, debit_account_id=1,
+                                     credit_account_id=2, amount=2)]))
+    # Corrupt a committed prepare in backup 1's journal, then restart
+    # it so recovery sees the damage.
+    victim = c.replicas[1]
+    target_op = victim.commit_min - 2
+    slot = target_op % victim.config.journal_slot_count
+    c.storages[1].corrupt_sector(
+        c.storages[1].layout.prepare_slot_offset(slot)
+    )
+    c.restart_replica(1)
+    c.settle(max_steps=10000)
+    for _ in range(40):
+        c.step()
+    c.check_convergence()
+    assert c.replicas[1].sm.transfer_timestamp(305) is not None

@@ -115,6 +115,7 @@ class VsrReplica(Replica):
 
         self.pipeline: dict[int, PipelineEntry] = {}
         self.request_queue: list[tuple[np.ndarray, bytes]] = []
+        self._queued_keys: set[tuple[int, int]] = set()
 
         # Cluster clock synchronization (reference: src/vsr/clock.zig).
         self.clock = Clock(replica, replica_count)
@@ -351,19 +352,22 @@ class VsrReplica(Replica):
         """Queue a request exactly once: broadcast retransmissions of
         the same (client, request) must not pile up (a batched drain
         would execute every copy)."""
-        client = wire.u128(header, "client")
-        request = int(header["request"])
-        for qh, _ in self.request_queue:
-            if (
-                wire.u128(qh, "client") == client
-                and int(qh["request"]) == request
-            ):
-                return
+        key = (wire.u128(header, "client"), int(header["request"]))
+        if key in self._queued_keys:
+            return
+        self._queued_keys.add(key)
         self.request_queue.append((header, body))
+
+    def _pop_request(self) -> tuple[np.ndarray, bytes]:
+        h, b = self.request_queue.pop(0)
+        self._queued_keys.discard(
+            (wire.u128(h, "client"), int(h["request"]))
+        )
+        return h, b
 
     def _request_dedupe(
         self, header: np.ndarray, in_queue: bool = False,
-        peek: bool = False,
+        peek: bool = False, inflight=None,
     ) -> str | None:
         """At-most-once gate, shared by request arrival and queue drain.
 
@@ -420,7 +424,8 @@ class VsrReplica(Replica):
         # enters OUR pipeline) — a retransmission must not be prepared
         # a second time anywhere (reference: primary pipeline
         # message_by_client lookup).
-        inflight = self._inflight_requests(include_queue=not in_queue)
+        if inflight is None:
+            inflight = self._inflight_requests(include_queue=not in_queue)
         if inflight is UNDECIDABLE:
             return "queue"
         return "drop" if (client, request) in inflight else None
@@ -594,11 +599,14 @@ class VsrReplica(Replica):
         while self.request_queue and (
             len(self.pipeline) < self.config.pipeline_prepare_queue_max
         ):
-            h, b = self.request_queue.pop(0)
+            h, b = self._pop_request()
             # Queued requests re-run the at-most-once gate: their
             # duplicate may have committed (or become decidable) while
             # they waited.
-            verdict = self._request_dedupe(h, in_queue=True)
+            inflight = self._inflight_requests(include_queue=False)
+            verdict = self._request_dedupe(
+                h, in_queue=True, inflight=inflight
+            )
             if verdict == "drop":
                 continue
             if verdict == "queue":
@@ -623,17 +631,20 @@ class VsrReplica(Replica):
                     if total + len(b2) + sub_size > limit:
                         break
                     if (
-                        self._request_dedupe(h2, in_queue=True, peek=True)
+                        self._request_dedupe(
+                            h2, in_queue=True, peek=True, inflight=inflight
+                        )
                         is not None
                     ):
                         break  # handled/undecidable: not batchable now
-                    batch.append(self.request_queue.pop(0))
+                    batch.append(self._pop_request())
                     total += len(b2) + sub_size
             if batch:
                 self._primary_prepare_batch([(h, b)] + batch)
             else:
                 self._primary_prepare(h, b)
-        self.request_queue.extend(requeue)
+        for rh, rb in requeue:
+            self._enqueue_request(rh, rb)
 
     def _primary_prepare_batch(
         self, requests: list[tuple[np.ndarray, bytes]]
@@ -1215,6 +1226,7 @@ class VsrReplica(Replica):
         self.superblock.view_change(self.view, self.log_view, self.commit_max)
         self.pipeline.clear()
         self.request_queue.clear()
+        self._queued_keys.clear()
         self._svc_votes.clear()
         self._dvc.clear()
         self._last_primary_seen = self._ticks
