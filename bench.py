@@ -1,17 +1,34 @@
-"""Driver benchmark: create_transfers commit throughput, 1M-transfer replay.
+"""Driver benchmark: create_transfers commit throughput + oracle parity.
 
-Replays the BASELINE.json "simple" config (sequential-id posted
-transfers over 1k accounts, single ledger, batch=8190 — reference:
-src/tigerbeetle/cli.zig:80-101 benchmark defaults) through the TPU
-state machine and prints ONE JSON line.
+Runs ALL FIVE BASELINE.json configs through the TPU state machine:
+  simple     1M unlinked posted transfers over 1k accounts, one ledger
+  linked     chains (avg len 4) + must_not_exceed balance constraints
+  two_phase  pending -> post/void mix (30% void), in-batch pairs
+  zipf       1M transfers Zipf-skewed over 100 accounts (contention)
+  mixed      create_accounts + create_transfers + lookup_accounts
+             interleaved over 4 ledgers
 
-vs_baseline is measured against the reference's published headline Zig
-single-core number: 800,000 transfers/s (reference:
-docs/about/README.md:78, AlphaBeetle io_uring rewrite).
+and verifies parity against the CPU oracle (CpuStateMachine): per-batch
+reply bytes must match exactly, and the final wire-level state (every
+account row via lookup_accounts, a transfer sample via lookup_transfers)
+must be bit-identical.  The simple config's parity replay covers the
+full 1M stream (BASELINE.json north star: "bit-identical results ... on
+the 1M replay"); the other configs replay a truncated stream because
+the oracle is per-event Python (~17k tx/s) and runs unmetered.
+
+Prints ONE JSON line.  `value`/`vs_baseline` is the simple config
+(the graded metric, vs the reference's 800k tx/s AlphaBeetle headline,
+reference: docs/about/README.md:78); the other configs, the parity
+verdict, and the device/host work split ride along as extra keys.
+
+Env knobs: BENCH_SMALL=1 (quick dev run: 100k events, no parity),
+BENCH_PARITY=0 (skip parity), BENCH_FULL_PARITY=1 (full-stream parity
+for every config), BENCH_TRANSFERS=N (simple-config event count).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import sys
@@ -22,86 +39,539 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from tigerbeetle_tpu import types
-from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
-from tigerbeetle_tpu.testing.harness import SingleNodeHarness
-from tigerbeetle_tpu.types import ACCOUNT_DTYPE, TRANSFER_DTYPE, Operation
+from tigerbeetle_tpu.types import (
+    ACCOUNT_DTYPE,
+    TRANSFER_DTYPE,
+    U128_PAIR_DTYPE,
+    AccountFlags,
+    Operation,
+    TransferFlags,
+)
 
 BASELINE_TPS = 800_000.0
-N_ACCOUNTS = int(os.environ.get("BENCH_ACCOUNTS", 1_000))
-N_TRANSFERS = int(os.environ.get("BENCH_TRANSFERS", 1_000_000))
 BATCH = int(os.environ.get("BENCH_BATCH", 8_190))
+SMALL = os.environ.get("BENCH_SMALL") == "1"
+N_SIMPLE = int(
+    os.environ.get("BENCH_TRANSFERS", 100_000 if SMALL else 1_000_000)
+)
+N_OTHER = 100_000 if SMALL else 1_000_000
+PARITY = os.environ.get("BENCH_PARITY", "0" if SMALL else "1") == "1"
+FULL_PARITY = os.environ.get("BENCH_FULL_PARITY") == "1"
+# Truncated parity horizon for the non-simple configs (oracle is
+# per-event Python; it runs unmetered but not for free).
+N_PARITY_OTHER = 200_000
+
+TF = TransferFlags
+AF = AccountFlags
 
 
-def make_accounts(n: int) -> bytes:
-    arr = np.zeros(n, dtype=ACCOUNT_DTYPE)
-    arr["id_lo"] = np.arange(1, n + 1, dtype=np.uint64)
-    arr["ledger"] = 1
+def accounts_bytes(ids, ledger=None, flags=None) -> bytes:
+    ids = np.asarray(ids, np.uint64)
+    arr = np.zeros(len(ids), dtype=ACCOUNT_DTYPE)
+    arr["id_lo"] = ids
+    arr["ledger"] = 1 if ledger is None else ledger
     arr["code"] = 1
+    if flags is not None:
+        arr["flags"] = flags
     return arr.tobytes()
 
 
-def make_transfers(start_id: int, n: int, rng: np.random.Generator) -> bytes:
+def lookup_bytes(ids) -> bytes:
+    arr = np.zeros(len(ids), dtype=U128_PAIR_DTYPE)
+    arr["lo"] = np.asarray(ids, np.uint64)
+    return arr.tobytes()
+
+
+def transfers_bytes(
+    ids, dr, cr, amount, *, ledger=1, flags=None, pending_id=None, timeout=None
+) -> bytes:
+    n = len(ids)
     arr = np.zeros(n, dtype=TRANSFER_DTYPE)
-    arr["id_lo"] = np.arange(start_id, start_id + n, dtype=np.uint64)
-    dr = rng.integers(1, N_ACCOUNTS + 1, size=n, dtype=np.uint64)
-    # credit account != debit account, both in [1, N_ACCOUNTS]
-    cr = dr % np.uint64(N_ACCOUNTS) + np.uint64(1)
+    arr["id_lo"] = ids
     arr["debit_account_id_lo"] = dr
     arr["credit_account_id_lo"] = cr
-    arr["amount_lo"] = rng.integers(1, 100, size=n, dtype=np.uint64)
-    arr["ledger"] = 1
+    arr["amount_lo"] = amount
+    arr["ledger"] = ledger
     arr["code"] = 1
+    if flags is not None:
+        arr["flags"] = flags
+    if pending_id is not None:
+        arr["pending_id_lo"] = pending_id
+    if timeout is not None:
+        arr["timeout"] = timeout
     return arr.tobytes()
+
+
+def batched(ops_arrays, op=Operation.create_transfers):
+    """Split one big per-event array dict into (op, bytes) batches."""
+    out = []
+    n = len(ops_arrays["ids"])
+    for at in range(0, n, BATCH):
+        sl = slice(at, min(at + BATCH, n))
+        out.append(
+            (
+                op,
+                transfers_bytes(
+                    ops_arrays["ids"][sl],
+                    ops_arrays["dr"][sl],
+                    ops_arrays["cr"][sl],
+                    ops_arrays["amount"][sl],
+                    ledger=ops_arrays.get("ledger", 1),
+                    flags=None
+                    if "flags" not in ops_arrays
+                    else ops_arrays["flags"][sl],
+                    pending_id=None
+                    if "pending_id" not in ops_arrays
+                    else ops_arrays["pending_id"][sl],
+                    timeout=None
+                    if "timeout" not in ops_arrays
+                    else ops_arrays["timeout"][sl],
+                ),
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Config generators.  Each returns (setup_ops, timed_ops, sizing) where
+# ops are [(Operation, bytes)] and sizing = (account_cap, transfer_cap).
+# Setup includes one untimed warmup transfer batch (ids 50M+) so JIT
+# compilation and flush-shape warmup stay out of the timed window for
+# BOTH engines (the oracle replays the identical stream).
+
+TID0 = 1  # first timed transfer id
+WARM0 = 50_000_000  # warmup transfer ids
+
+
+def gen_simple(n_events: int):
+    rng = np.random.default_rng(42)
+    n_acct = 1_000
+    setup = [(Operation.create_accounts, accounts_bytes(range(1, n_acct + 1)))]
+    warm_n = min(BATCH, n_events)
+    dr = rng.integers(1, n_acct + 1, warm_n, np.uint64)
+    setup += batched(
+        {
+            "ids": np.arange(WARM0, WARM0 + warm_n, dtype=np.uint64),
+            "dr": dr,
+            "cr": dr % np.uint64(n_acct) + np.uint64(1),
+            "amount": rng.integers(1, 100, warm_n, np.uint64),
+        }
+    )
+    dr = rng.integers(1, n_acct + 1, n_events, np.uint64)
+    timed = batched(
+        {
+            "ids": np.arange(TID0, TID0 + n_events, dtype=np.uint64),
+            "dr": dr,
+            "cr": dr % np.uint64(n_acct) + np.uint64(1),
+            "amount": rng.integers(1, 100, n_events, np.uint64),
+        }
+    )
+    return setup, timed, (1 << 12, n_events + 2 * BATCH + 1024)
+
+
+def gen_linked(n_events: int):
+    """Chains avg len 4, half the accounts debit-limited (funded in
+    setup so most chains succeed while some trip the limit and roll
+    back whole chains)."""
+    rng = np.random.default_rng(43)
+    n_acct = 1_000
+    limited = np.arange(1, n_acct // 2 + 1, dtype=np.uint64)
+    flags = np.zeros(n_acct, np.uint16)
+    flags[: n_acct // 2] = int(AF.debits_must_not_exceed_credits)
+    setup = [
+        (
+            Operation.create_accounts,
+            accounts_bytes(range(1, n_acct + 1), flags=flags),
+        )
+    ]
+    # Fund the limited accounts: credit each from the last plain account.
+    setup += batched(
+        {
+            "ids": np.arange(WARM0, WARM0 + len(limited), dtype=np.uint64),
+            "dr": np.full(len(limited), n_acct, np.uint64),
+            "cr": limited,
+            "amount": np.full(len(limited), 50_000, np.uint64),
+        }
+    )
+    # Warmup chains (exercise the exact engine's compile-free path).
+    warm = _chain_events(rng, 2 * BATCH, n_acct, WARM0 + 1_000_000)
+    setup += _chain_batches(warm)
+
+    timed = _chain_batches(_chain_events(rng, n_events, n_acct, TID0))
+    n_total = sum(
+        len(b) // 128 for _op, b in timed
+    )
+    return setup, timed, (1 << 12, n_total + 4 * BATCH + len(limited) + 1024)
+
+
+def _chain_events(rng, n_events, n_acct, id0):
+    lens = rng.integers(1, 8, size=n_events // 2 + BATCH)  # avg 4
+    ends = np.cumsum(lens)
+    n_chains = int(np.searchsorted(ends, n_events, side="left")) + 1
+    lens = lens[:n_chains]
+    total = int(lens.sum())
+    # linked flag on every chain member except the last.
+    last_idx = np.cumsum(lens) - 1
+    flags = np.full(total, int(TF.linked), np.uint16)
+    flags[last_idx] = 0
+    dr = rng.integers(1, n_acct + 1, total, np.uint64)
+    cr = rng.integers(1, n_acct + 1, total, np.uint64)
+    clash = cr == dr
+    cr[clash] = dr[clash] % np.uint64(n_acct) + np.uint64(1)
+    return {
+        "ids": np.arange(id0, id0 + total, dtype=np.uint64),
+        "dr": dr,
+        "cr": cr,
+        "amount": rng.integers(1, 200, total, np.uint64),
+        "flags": flags,
+        "chain_ends": np.cumsum(lens),
+    }
+
+
+def _chain_batches(ev):
+    """Batch without splitting a chain across batches (an open chain at
+    the end of a batch fails with linked_event_chain_open)."""
+    out = []
+    ends = ev["chain_ends"]
+    total = len(ev["ids"])
+    start = 0
+    while start < total:
+        # Last chain end fitting within BATCH events of `start`.
+        hi = int(np.searchsorted(ends, start + BATCH, side="right"))
+        if hi == 0 or ends[hi - 1] <= start:
+            break
+        stop = int(ends[hi - 1])
+        sl = slice(start, stop)
+        out.append(
+            (
+                Operation.create_transfers,
+                transfers_bytes(
+                    ev["ids"][sl], ev["dr"][sl], ev["cr"][sl],
+                    ev["amount"][sl], flags=ev["flags"][sl],
+                ),
+            )
+        )
+        start = stop
+    return out
+
+
+def gen_two_phase(n_events: int):
+    """Adjacent (pending, post|void) pairs; 30% void, amount inherited
+    (zero-means-inherit, reference: src/state_machine.zig:1743-1804)."""
+    rng = np.random.default_rng(44)
+    n_acct = 1_000
+    setup = [(Operation.create_accounts, accounts_bytes(range(1, n_acct + 1)))]
+    n_pairs = n_events // 2
+
+    def pairs(n, id0):
+        ids = np.arange(id0, id0 + 2 * n, dtype=np.uint64)
+        flags = np.zeros(2 * n, np.uint16)
+        flags[0::2] = int(TF.pending)
+        void = rng.random(n) < 0.30
+        flags[1::2] = np.where(
+            void, int(TF.void_pending_transfer), int(TF.post_pending_transfer)
+        ).astype(np.uint16)
+        pending_id = np.zeros(2 * n, np.uint64)
+        pending_id[1::2] = ids[0::2]
+        dr = np.zeros(2 * n, np.uint64)
+        cr = np.zeros(2 * n, np.uint64)
+        dr[0::2] = rng.integers(1, n_acct + 1, n, np.uint64)
+        cr[0::2] = dr[0::2] % np.uint64(n_acct) + np.uint64(1)
+        amount = np.zeros(2 * n, np.uint64)
+        amount[0::2] = rng.integers(1, 100, n, np.uint64)
+        return {
+            "ids": ids, "dr": dr, "cr": cr, "amount": amount,
+            "flags": flags, "pending_id": pending_id,
+        }
+
+    warm_pairs = BATCH // 2
+    setup += batched(pairs(warm_pairs, WARM0))
+    timed = batched(pairs(n_pairs, TID0))
+    return setup, timed, (1 << 12, 2 * n_pairs + 4 * BATCH + 1024)
+
+
+def gen_zipf(n_events: int):
+    rng = np.random.default_rng(45)
+    n_acct = 100
+    ranks = np.arange(1, n_acct + 1, dtype=np.float64)
+    p = (1.0 / ranks) / (1.0 / ranks).sum()
+    setup = [(Operation.create_accounts, accounts_bytes(range(1, n_acct + 1)))]
+    warm_n = min(BATCH, n_events)
+
+    def draw(n):
+        dr = rng.choice(n_acct, size=n, p=p).astype(np.uint64) + np.uint64(1)
+        cr = rng.choice(n_acct, size=n, p=p).astype(np.uint64) + np.uint64(1)
+        clash = cr == dr
+        cr[clash] = dr[clash] % np.uint64(n_acct) + np.uint64(1)
+        return dr, cr
+
+    dr, cr = draw(warm_n)
+    setup += batched(
+        {
+            "ids": np.arange(WARM0, WARM0 + warm_n, dtype=np.uint64),
+            "dr": dr, "cr": cr,
+            "amount": rng.integers(1, 100, warm_n, np.uint64),
+        }
+    )
+    dr, cr = draw(n_events)
+    timed = batched(
+        {
+            "ids": np.arange(TID0, TID0 + n_events, dtype=np.uint64),
+            "dr": dr, "cr": cr,
+            "amount": rng.integers(1, 100, n_events, np.uint64),
+        }
+    )
+    return setup, timed, (1 << 12, n_events + 2 * BATCH + 1024)
+
+
+def gen_mixed(n_events: int):
+    """Interleaved create_accounts / create_transfers / lookup_accounts
+    over 4 ledgers (BASELINE.json config 5)."""
+    rng = np.random.default_rng(46)
+    n_ledgers = 4
+    per_ledger = [list(range(led * 100_000 + 1, led * 100_000 + 501))
+                  for led in range(1, n_ledgers + 1)]
+    setup = []
+    for led in range(1, n_ledgers + 1):
+        setup.append(
+            (
+                Operation.create_accounts,
+                accounts_bytes(per_ledger[led - 1], ledger=led),
+            )
+        )
+    warm_n = BATCH
+    led_accts = per_ledger[0]
+    dr = rng.choice(led_accts, warm_n).astype(np.uint64)
+    cr = rng.choice(led_accts, warm_n).astype(np.uint64)
+    clash = cr == dr
+    cr[clash] = np.where(
+        dr[clash] == led_accts[-1], led_accts[0], dr[clash] + 1
+    )
+    setup += batched(
+        {
+            "ids": np.arange(WARM0, WARM0 + warm_n, dtype=np.uint64),
+            "dr": dr, "cr": cr,
+            "amount": rng.integers(1, 100, warm_n, np.uint64),
+            "ledger": 1,
+        }
+    )
+
+    timed = []
+    next_tid = TID0
+    next_acct = {led: led * 100_000 + 501 for led in range(1, n_ledgers + 1)}
+    events = 0
+    k = 0
+    while events < n_events:
+        r = k % 10
+        if r == 3:
+            # New accounts on a rotating ledger.
+            led = (k // 10) % n_ledgers + 1
+            n_new = 500
+            ids = list(range(next_acct[led], next_acct[led] + n_new))
+            next_acct[led] += n_new
+            per_ledger[led - 1].extend(ids)
+            timed.append(
+                (Operation.create_accounts, accounts_bytes(ids, ledger=led))
+            )
+            events += n_new
+        elif r == 7:
+            led = rng.integers(1, n_ledgers + 1)
+            ids = rng.choice(per_ledger[int(led) - 1], 2_000)
+            timed.append((Operation.lookup_accounts, lookup_bytes(ids)))
+            events += len(ids)
+        else:
+            led = int(rng.integers(1, n_ledgers + 1))
+            accts = np.asarray(per_ledger[led - 1], np.uint64)
+            n = min(BATCH, n_events - events)
+            dr = rng.choice(accts, n)
+            cr = rng.choice(accts, n)
+            clash = cr == dr
+            cr[clash] = np.where(
+                dr[clash] == accts[-1], accts[0], dr[clash] + 1
+            )
+            timed += batched(
+                {
+                    "ids": np.arange(next_tid, next_tid + n, dtype=np.uint64),
+                    "dr": dr, "cr": cr,
+                    "amount": rng.integers(1, 100, n, np.uint64),
+                    "ledger": led,
+                }
+            )
+            next_tid += n
+            events += n
+        k += 1
+    return setup, timed, (1 << 15, (next_tid - TID0) + 4 * BATCH + 1024)
+
+
+CONFIGS = {
+    "simple": gen_simple,
+    "linked": gen_linked,
+    "two_phase": gen_two_phase,
+    "zipf": gen_zipf,
+    "mixed": gen_mixed,
+}
+
+
+# ---------------------------------------------------------------------------
+# Execution + parity.
+
+
+def _make_tpu(sizing):
+    from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+
+    return TpuStateMachine(
+        account_capacity=sizing[0], transfer_capacity=sizing[1]
+    )
+
+
+def replay(sm, ops, collect=False):
+    """Run ops through a fresh harness; returns (elapsed, replies)."""
+    from tigerbeetle_tpu.testing.harness import SingleNodeHarness
+
+    h = SingleNodeHarness(sm)
+    replies = [] if collect else None
+    t0 = time.perf_counter()
+    for op, body in ops:
+        reply = h.submit(op, body)
+        if collect:
+            replies.append(reply)
+    if hasattr(sm, "sync"):
+        sm.sync()
+    return time.perf_counter() - t0, replies, h
+
+
+def n_events_of(ops) -> int:
+    total = 0
+    for op, body in ops:
+        size = (
+            types.EVENT_DTYPE[op].itemsize if op in types.EVENT_DTYPE else 128
+        )
+        total += len(body) // size
+    return total
+
+
+def state_digest(h, account_ids, transfer_ids) -> str:
+    """Wire-level digest: every account row + a transfer sample."""
+    hasher = hashlib.sha256()
+    ids = np.asarray(account_ids, np.uint64)
+    for at in range(0, len(ids), BATCH):
+        reply = h.submit(
+            Operation.lookup_accounts, lookup_bytes(ids[at : at + BATCH])
+        )
+        hasher.update(reply)
+    tids = np.asarray(transfer_ids, np.uint64)
+    for at in range(0, len(tids), BATCH):
+        reply = h.submit(
+            Operation.lookup_transfers, lookup_bytes(tids[at : at + BATCH])
+        )
+        hasher.update(reply)
+    return hasher.hexdigest()
+
+
+def config_account_ids(name):
+    if name == "zipf":
+        return np.arange(1, 101, dtype=np.uint64)
+    if name == "mixed":
+        ids = []
+        for led in range(1, 5):
+            ids.extend(range(led * 100_000 + 1, led * 100_000 + 3_001))
+        return np.asarray(ids, np.uint64)
+    return np.arange(1, 1_001, dtype=np.uint64)
 
 
 def main() -> None:
-    import jax
+    from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
+    from tigerbeetle_tpu.testing.harness import SingleNodeHarness
 
-    # Static allocation, TigerBeetle-style: size the stores for the
-    # configured workload up front so the commit path never reallocates.
-    sm = TpuStateMachine(
-        account_capacity=1 << 12,
-        transfer_capacity=N_TRANSFERS + 2 * BATCH + 1024,
-    )
-    h = SingleNodeHarness(sm)
-    h.submit(Operation.create_accounts, make_accounts(N_ACCOUNTS))
+    configs_out = {}
+    parity_ok = True
+    parity_detail = {}
 
-    rng = np.random.default_rng(42)
+    for name, gen in CONFIGS.items():
+        n_events = N_SIMPLE if name == "simple" else N_OTHER
+        setup, timed, sizing = gen(n_events)
+        sm = _make_tpu(sizing)
+        _, _, h = replay(sm, setup)
+        if hasattr(sm, "sync"):
+            sm.sync()
+        # Only the timed window counts toward the device/host split.
+        sm.stat_device_events = 0
+        sm.stat_exact_events = 0
+        failed = 0
+        t0 = time.perf_counter()
+        for op, body in timed:
+            reply = h.submit(op, body)
+            if op == Operation.create_transfers:
+                failed += len(reply) // 8  # CREATE_RESULT_DTYPE entries
+        if hasattr(sm, "sync"):
+            sm.sync()
+        elapsed = time.perf_counter() - t0
+        # linked/two_phase legitimately reject events (limit trips,
+        # chain rollbacks); the all-success configs must stay clean —
+        # a silently-failing engine must not benchmark as a fast one.
+        if name in ("simple", "zipf", "mixed"):
+            assert failed == 0, f"{name}: {failed} transfers failed"
+        n_timed = n_events_of(timed)
+        dev = sm.stat_device_events
+        exact = sm.stat_exact_events
+        configs_out[name] = {
+            "events_per_sec": round(n_timed / elapsed, 1),
+            "events": n_timed,
+            "failed_events": failed,
+            "vs_baseline": round(n_timed / elapsed / BASELINE_TPS, 4),
+            "device_resolved_pct": round(100.0 * dev / max(1, dev + exact), 1),
+        }
+        del sm, h
 
-    # Warmup batch (compile) — not timed, not counted.
-    warm = make_transfers(10_000_000, BATCH, rng)
-    reply = h.submit(Operation.create_transfers, warm)
-    assert reply == b"", "warmup transfers must all succeed"
-    sm.sync()  # also compiles the flush kernel's steady-state shape
+    if PARITY:
+        for name, gen in CONFIGS.items():
+            n_parity = (
+                N_SIMPLE
+                if name == "simple" or FULL_PARITY
+                else min(N_OTHER, N_PARITY_OTHER)
+            )
+            setup, timed, sizing = gen(n_parity)
+            ops = setup + timed
+            sm_t = _make_tpu(sizing)
+            _, replies_t, h_t = replay(sm_t, ops, collect=True)
+            sm_c = CpuStateMachine()
+            _, replies_c, h_c = replay(sm_c, ops, collect=True)
+            mismatch = None
+            for i, (a, b) in enumerate(zip(replies_t, replies_c)):
+                if a != b:
+                    mismatch = f"reply[{i}] differs"
+                    break
+            if mismatch is None:
+                acct_ids = config_account_ids(name)
+                tid_sample = np.concatenate(
+                    [
+                        np.arange(TID0, TID0 + min(4_000, n_parity)),
+                        np.arange(
+                            max(TID0, TID0 + n_parity - 4_000), TID0 + n_parity
+                        ),
+                    ]
+                ).astype(np.uint64)
+                if state_digest(h_t, acct_ids, tid_sample) != state_digest(
+                    h_c, acct_ids, tid_sample
+                ):
+                    mismatch = "final state digest differs"
+            parity_detail[name] = mismatch or "ok"
+            if mismatch:
+                parity_ok = False
+            del sm_t, sm_c, h_t, h_c
 
-    # Pre-build all batches so generation isn't timed.
-    batches = []
-    next_id = 1
-    remaining = N_TRANSFERS
-    while remaining > 0:
-        n = min(BATCH, remaining)
-        batches.append(make_transfers(next_id, n, rng))
-        next_id += n
-        remaining -= n
-
-    t0 = time.perf_counter()
-    for body in batches:
-        reply = h.submit(Operation.create_transfers, body)
-        assert reply == b"", "replay transfers must all succeed"
-    sm.sync()
-    elapsed = time.perf_counter() - t0
-
-    tps = N_TRANSFERS / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "create_transfers_commits_per_sec",
-                "value": round(tps, 1),
-                "unit": "transfers/s",
-                "vs_baseline": round(tps / BASELINE_TPS, 4),
-            }
-        )
-    )
+    simple = configs_out["simple"]
+    out = {
+        "metric": "create_transfers_commits_per_sec",
+        "value": simple["events_per_sec"],
+        "unit": "transfers/s",
+        "vs_baseline": simple["vs_baseline"],
+        "configs": configs_out,
+        "parity": parity_ok if PARITY else None,
+    }
+    if PARITY:
+        out["parity_detail"] = parity_detail
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

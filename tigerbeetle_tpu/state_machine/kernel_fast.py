@@ -10,9 +10,10 @@ no host<->device sync anywhere on the hot path.
 Overflow admission runs on the host BalanceMirror (mirror.py) before
 enqueueing, so the device apply is a pure mod-2^128 addition;
 subtractions (pending expiry) ride the same path as two's-complement
-deltas. Deltas are accumulated as 4x32-bit limbs in uint64 lanes so
-scatter-adds cannot wrap (limb sums < 2^32 * entries), then one carry
-pass recombines exact sums.
+deltas. Queued deltas are compacted host-side to one entry per
+(slot, column) before each flush, so the device kernel scatters with
+unique indices (no accumulation on device) and finishes with a single
+elementwise u128 carry add over the table.
 
 The exact scan kernel (kernel.py) reads the table through a flush
 barrier, so order-dependent batches always see current state.
@@ -27,32 +28,41 @@ import jax.numpy as jnp
 
 from tigerbeetle_tpu.ops import u128 as w
 
-# Flush shape buckets: only a few shapes ever compile.
-_FLUSH_BUCKETS = (4096, 32768, 131072, 524288)
+# Fixed flush chunk: ONE compiled shape ever (larger delta sets loop).
+# Entries within a flush are unique per (slot, col) after compaction, so
+# the kernel scatters with unique_indices instead of accumulating — no
+# limb decomposition needed, just one u128 carry add over the table.
+_FLUSH_CHUNK = 4096
 # Queue high-water mark: flush (async) once this many entries queue up.
-# Low enough that device work overlaps the host commit loop (dispatch is
-# async); global compaction at flush time collapses each flush to at
-# most accounts*4 entries, so extra flushes cost one small dispatch, not
-# duplicated work — and the final drain barrier then waits on almost
-# nothing (the device link is high-latency, so a tail-end burst of
-# flushes is the worst case).
+# Bounds queue memory and overlaps device work with the host commit
+# loop; compaction collapses each flush to at most accounts*4 entries.
 FLUSH_THRESHOLD = 65_536
 
 
-def _flush_impl(balances, slots, cols, add_lo, add_hi):
-    """balances[slot, col] += delta (mod 2^128), fused over K entries.
+def _flush_impl(balances, packed):
+    """balances[slot, col] += delta (mod 2^128) for unique (slot, col).
 
-    Padding entries use slot 0 / col 0 / amount 0 (a no-op add).
+    packed is (4, _FLUSH_CHUNK) u64 rows: slot, col, delta_lo, delta_hi.
+    Padding entries use slot >= A and are dropped by the scatter.
     """
     A = balances.shape[0]
-    limbs = w.limbs32(add_lo, add_hi)
-    acc = jnp.zeros((A, 4, 4), jnp.uint64)
-    acc = acc.at[jnp.clip(slots, 0, A - 1), cols].add(limbs)
-    d_lo, d_hi, _ = w.from_limbs32(acc)  # (A, 4); mod 2^128 by design
-
+    slots = packed[0].astype(jnp.int32)
+    cols = packed[1].astype(jnp.int32)
+    dense_lo = (
+        jnp.zeros((A, 4), jnp.uint64)
+        .at[slots, cols]
+        .set(packed[2], mode="drop", unique_indices=True)
+    )
+    dense_hi = (
+        jnp.zeros((A, 4), jnp.uint64)
+        .at[slots, cols]
+        .set(packed[3], mode="drop", unique_indices=True)
+    )
     old_lo = balances[:, 0::2]
     old_hi = balances[:, 1::2]
-    (new_lo, new_hi), _ = w.add((old_lo, old_hi), (d_lo, d_hi))
+    new_lo = old_lo + dense_lo
+    carry = (new_lo < old_lo).astype(jnp.uint64)
+    new_hi = old_hi + dense_hi + carry
     return jnp.stack(
         [
             new_lo[:, 0], new_hi[:, 0],
@@ -103,7 +113,8 @@ class DeviceTable:
 
         The queue is first re-compacted globally — modular adds merge
         across batches — so one flush covers many commits with at most
-        accounts*4 entries, usually landing in the smallest bucket.
+        accounts*4 entries, and each compacted (slot, col) appears
+        exactly once (the kernel's unique_indices contract).
         """
         if not self._queued:
             return
@@ -132,24 +143,24 @@ class DeviceTable:
             a_hi = np.concatenate([p[3] for p in parts])
         u_slot, u_col, d_lo, d_hi, _ = compact_deltas(slots, cols, a_lo, a_hi)
 
+        A = self.balances.shape[0]
         at = 0
         while at < len(u_slot):
-            take = min(len(u_slot) - at, _FLUSH_BUCKETS[-1])
-            bucket = next(b for b in _FLUSH_BUCKETS if b >= take)
-            pad = np.zeros(bucket, np.int64)
-            pslots, pcols = pad.copy(), pad.copy()
-            plo = np.zeros(bucket, np.uint64)
-            phi = np.zeros(bucket, np.uint64)
-            pslots[:take] = u_slot[at : at + take]
-            pcols[:take] = u_col[at : at + take]
-            plo[:take] = d_lo[at : at + take]
-            phi[:take] = d_hi[at : at + take]
-            self.balances = _flush(
-                self.balances,
-                jnp.asarray(pslots.astype(np.int32)),
-                jnp.asarray(pcols.astype(np.int32)),
-                jnp.asarray(plo), jnp.asarray(phi),
-            )
+            take = min(len(u_slot) - at, _FLUSH_CHUNK)
+            # One packed host array -> ONE device transfer per chunk.
+            packed = np.empty((4, _FLUSH_CHUNK), np.uint64)
+            packed[0, :take] = u_slot[at : at + take].astype(np.uint64)
+            # Padding: DISTINCT out-of-range slots (dropped by the
+            # scatter) — duplicate indices would void the
+            # unique_indices promise even for dropped entries.
+            packed[0, take:] = A + np.arange(_FLUSH_CHUNK - take, dtype=np.uint64)
+            packed[1, :take] = u_col[at : at + take].astype(np.uint64)
+            packed[1, take:] = 0
+            packed[2, :take] = d_lo[at : at + take]
+            packed[2, take:] = 0
+            packed[3, :take] = d_hi[at : at + take]
+            packed[3, take:] = 0
+            self.balances = _flush(self.balances, jnp.asarray(packed))
             at += take
 
     def read(self):

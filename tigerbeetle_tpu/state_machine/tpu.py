@@ -218,6 +218,13 @@ class TpuStateMachine:
         self._expiry_rows: np.ndarray | None = None
         self._exp_dead = 0
 
+        # Device/host work-split accounting (reported by bench.py):
+        # events whose balance effects were admitted order-free and
+        # applied via device scatter-adds vs events resolved by the
+        # serial exact engine (host).
+        self.stat_device_events = 0
+        self.stat_exact_events = 0
+
     @property
     def _balances(self):
         """Current device table handle behind a flush barrier."""
@@ -604,6 +611,7 @@ class TpuStateMachine:
         if self._native is not None:
             native_out = self._native.commit_transfers(input_bytes, n, ts_base)
             if native_out is not None:
+                self.stat_device_events += n
                 return self._finish_native_fast(
                     events, n, ts_base, *native_out
                 )
@@ -805,6 +813,7 @@ class TpuStateMachine:
                     ledger, code, static,
                 )
                 if reply is not None:
+                    self.stat_device_events += n
                     return reply
 
         # Exact-path id groups: one compact index per distinct id value.
@@ -911,6 +920,7 @@ class TpuStateMachine:
             "p_tgt": _pad(p_tgt, B),
         }
 
+        self.stat_exact_events += n
         if self._native is not None:
             # Serial exact engine in C++ (native/tb_exact.inc): same
             # inputs and packed-output contract as the scan kernel.
