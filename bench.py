@@ -525,11 +525,12 @@ def main() -> None:
 
     if PARITY:
         for name, gen in CONFIGS.items():
-            n_parity = (
-                N_SIMPLE
-                if name == "simple" or FULL_PARITY
-                else min(N_OTHER, N_PARITY_OTHER)
-            )
+            if name == "simple":
+                n_parity = N_SIMPLE
+            elif FULL_PARITY:
+                n_parity = N_OTHER
+            else:
+                n_parity = min(N_OTHER, N_PARITY_OTHER)
             setup, timed, sizing = gen(n_parity)
             ops = setup + timed
             sm_t = _make_tpu(sizing)

@@ -1,0 +1,175 @@
+"""utils/snapshot.py: the fixed-layout checkpoint codec.
+
+The codec replaced pickle for every durable blob; these tests pin the
+round-trip contract and the decode hardening (state sync feeds this
+decoder bytes received from peers — reference discipline:
+src/vsr/checksum.zig:1-10 verify-before-cast).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.utils import snapshot as sc
+
+
+def test_roundtrip_types():
+    tree = {
+        "a": np.arange(7, dtype=np.uint64),
+        "b": {"c": np.zeros((3, 8), np.uint8), "d": (1 << 100) + 17},
+        "e": b"raw-bytes",
+        "f": np.array([True, False]),
+        "g": np.zeros(0, np.uint32),
+        "keys": np.zeros(4, "V16"),
+    }
+    blob = sc.encode_tree(tree)
+    out = sc.decode_tree(blob)
+    assert out["b"]["d"] == (1 << 100) + 17
+    assert out["e"] == b"raw-bytes"
+    np.testing.assert_array_equal(out["a"], tree["a"])
+    np.testing.assert_array_equal(out["b"]["c"], tree["b"]["c"])
+    np.testing.assert_array_equal(out["f"], tree["f"])
+    assert out["g"].dtype == np.uint32 and len(out["g"]) == 0
+    assert out["keys"].dtype == np.dtype("V16")
+
+
+def test_canonical():
+    tree = {"x": np.arange(5, dtype=np.int64), "y": 3}
+    assert sc.encode_tree(tree) == sc.encode_tree(tree)
+
+
+def test_checksum_detects_flips():
+    blob = bytearray(sc.encode({"x": np.arange(100, dtype=np.uint64)}))
+    for at in (len(blob) - 1, len(sc.MAGIC) + 12 + 32 + 2):
+        flipped = bytearray(blob)
+        flipped[at] ^= 0x40
+        with pytest.raises(sc.SnapshotError):
+            sc.decode(bytes(flipped))
+
+
+def test_truncation_rejected():
+    blob = sc.encode({"x": np.arange(100, dtype=np.uint64)})
+    for cut in (4, len(sc.MAGIC) + 5, len(blob) - 7):
+        with pytest.raises(sc.SnapshotError):
+            sc.decode(blob[:cut])
+
+
+def test_bad_magic_rejected():
+    blob = sc.encode({"x": 1})
+    with pytest.raises(sc.SnapshotError):
+        sc.decode(b"PICKLE00" + blob[8:])
+
+
+def test_object_dtype_rejected_on_encode():
+    with pytest.raises(sc.SnapshotError):
+        sc.encode({"x": np.array([object()])})
+
+
+def test_hostile_dtype_rejected_on_decode():
+    # Forge a blob whose dtype string is not allowlisted; the payload
+    # checksum is valid, so this exercises the dtype gate itself.
+    import hashlib
+    import struct
+
+    key = b"x"
+    ds = b"O8"  # object dtype: would be code execution under pickle
+    meta = struct.pack("<BH", 0, len(ds)) + ds + struct.pack("<BQ", 1, 8)
+    entry = struct.pack("<H", len(key)) + key + meta + struct.pack("<Q", 8)
+    entry += b"\x00" * 8
+    blob = (
+        sc.MAGIC
+        + struct.pack("<IQ", 1, len(entry))
+        + hashlib.sha256(entry).digest()
+        + entry
+    )
+    with pytest.raises(sc.SnapshotError):
+        sc.decode(blob)
+
+
+def test_duplicate_key_rejected():
+    one = sc.encode({"x": 1})
+    # Duplicate the single entry and fix up the header.
+    import hashlib
+    import struct
+
+    payload = one[len(sc.MAGIC) + 12 + 32 :]
+    doubled = payload + payload
+    blob = (
+        sc.MAGIC
+        + struct.pack("<IQ", 2, len(doubled))
+        + hashlib.sha256(doubled).digest()
+        + doubled
+    )
+    with pytest.raises(sc.SnapshotError):
+        sc.decode(blob)
+
+
+def test_size_mismatch_rejected():
+    import hashlib
+    import struct
+
+    key = b"x"
+    ds = b"<u8"
+    # claims shape (2,) but ships 8 bytes
+    meta = struct.pack("<BH", 0, len(ds)) + ds + struct.pack("<BQ", 1, 2)
+    entry = struct.pack("<H", len(key)) + key + meta + struct.pack("<Q", 8)
+    entry += b"\x00" * 8
+    blob = (
+        sc.MAGIC
+        + struct.pack("<IQ", 1, len(entry))
+        + hashlib.sha256(entry).digest()
+        + entry
+    )
+    with pytest.raises(sc.SnapshotError):
+        sc.decode(blob)
+
+
+def test_no_pickle_in_durable_paths():
+    """pickle must stay out of vsr/, state_machine/, and lsm/ — the
+    checkpoint/state-sync surface (VERDICT r1 item 3)."""
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "tigerbeetle_tpu"
+    offenders = []
+    for sub in ("vsr", "state_machine", "lsm"):
+        for path in (root / sub).rglob("*.py"):
+            text = path.read_text()
+            if any(
+                pat in text
+                for pat in ("import pickle", "pickle.loads", "pickle.dumps")
+            ):
+                offenders.append(str(path))
+    assert not offenders, offenders
+
+
+def test_sm_snapshot_restore_roundtrip_binary():
+    """Both engines' snapshots decode with the codec (no pickle) and
+    restore to equivalent state."""
+    from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
+    from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+    from tigerbeetle_tpu.testing import SingleNodeHarness, account, transfer
+    from tigerbeetle_tpu.types import TransferFlags
+
+    for cls in (CpuStateMachine, TpuStateMachine):
+        sm = cls()
+        h = SingleNodeHarness(sm)
+        h.create_accounts([account(1), account(2), account(3)])
+        h.create_transfers(
+            [transfer(10, debit_account_id=1, credit_account_id=2, amount=5)]
+        )
+        h.create_transfers(
+            [
+                transfer(
+                    11, debit_account_id=2, credit_account_id=3, amount=9,
+                    flags=TransferFlags.pending, timeout=60,
+                )
+            ]
+        )
+        blob = sm.snapshot()
+        sc.decode(blob)  # structurally valid, checksummed, pickle-free
+        sm2 = cls()
+        sm2.restore(blob)
+        assert sm2.snapshot() == blob
+        h2 = SingleNodeHarness(sm2)
+        h2.op = h.op
+        rows = h2.lookup_accounts([1, 2, 3])
+        assert len(rows) == 3

@@ -2,18 +2,16 @@
 
 reference: src/lsm/forest.zig:31,324,375,547 — the forest opens from
 the manifest, paces compaction, and checkpoints all trees plus the
-free set.  In this build the manifest + free set serialize into the
-replica's checkpoint blob (recovery between checkpoints is WAL replay,
-so an append-only manifest log is not needed for crash consistency —
-the blob is the durable boundary, reference-equivalent at checkpoint
-granularity).
+free set.  Manifests serialize through the fixed-layout snapshot codec
+(utils/snapshot.py) into the replica's checkpoint blob (recovery
+between checkpoints is WAL replay, so the blob is the durable boundary,
+reference-equivalent at checkpoint granularity).
 """
 
 from __future__ import annotations
 
-import pickle
-
 from tigerbeetle_tpu.lsm.groove import Groove
+from tigerbeetle_tpu.utils import snapshot as snapcodec
 from tigerbeetle_tpu.vsr.free_set import FreeSet
 from tigerbeetle_tpu.vsr.grid import Grid
 from tigerbeetle_tpu.vsr.storage import Storage
@@ -53,17 +51,16 @@ class Forest:
             for t in g.indexes.values():
                 t.seal_memtable()
         self.grid.free_set.checkpoint()
-        return pickle.dumps(
+        return snapcodec.encode_tree(
             {
                 "grooves": {n: g.manifest() for n, g in self.grooves.items()},
                 "free_set": self.grid.free_set.encode(),
                 "block_count": self.grid.block_count,
-            },
-            protocol=5,
+            }
         )
 
     def open(self, blob: bytes) -> None:
-        state = pickle.loads(blob)
+        state = snapcodec.decode_tree(blob)
         self.grid.free_set = FreeSet.decode(
             state["free_set"], state["block_count"]
         )

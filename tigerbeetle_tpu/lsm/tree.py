@@ -63,29 +63,50 @@ class Tree:
         self.value_size = value_size
         self.value_dtype = np.dtype(f"V{value_size}")
         self.memtable_max = memtable_max
-        # Memtable: insertion dict key-bytes -> (flags, value-bytes).
-        self.memtable: dict[bytes, tuple[int, bytes]] = {}
+        # Memtable: list of individually-sorted columnar batches
+        # (keys KEY_DTYPE, flags u8, values (n, value_size) u8), newest
+        # LAST.  Vectorized throughout — one put_batch is one argsort,
+        # no per-key Python (the spill path feeds 8k-row batches from
+        # the commit hot path).
+        self.memtable: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self.memtable_count = 0
         # levels[i] = runs, newest last.
         self.levels: list[list[Run]] = [[] for _ in range(LEVELS)]
 
     # ------------------------------------------------------------------
     # Writes.
 
+    def _push_batch(self, keys: np.ndarray, flags: np.ndarray,
+                    values: np.ndarray) -> None:
+        if len(keys) == 0:
+            return
+        # Stable sort + keep the LAST write per duplicate key within
+        # the batch (dict-overwrite semantics).
+        order = np.argsort(keys, kind="stable")
+        keys = keys[order]
+        flags = flags[order]
+        values = values[order]
+        keep = np.ones(len(keys), bool)
+        keep[:-1] = keys[:-1] != keys[1:]
+        if not keep.all():
+            keys, flags, values = keys[keep], flags[keep], values[keep]
+        self.memtable.append((keys, flags, values))
+        self.memtable_count += len(keys)
+
     def put_batch(self, keys: np.ndarray, values: np.ndarray) -> None:
         values = np.ascontiguousarray(values).view(np.uint8).reshape(
             len(keys), -1
         )
-        kb = keys.tobytes()
-        for i in range(len(keys)):
-            self.memtable[kb[16 * i : 16 * i + 16]] = (
-                0, values[i].tobytes()
-            )
+        self._push_batch(
+            np.asarray(keys, KEY_DTYPE), np.zeros(len(keys), np.uint8), values
+        )
 
     def remove_batch(self, keys: np.ndarray) -> None:
-        kb = keys.tobytes()
-        empty = bytes(self.value_size)
-        for i in range(len(keys)):
-            self.memtable[kb[16 * i : 16 * i + 16]] = (1, empty)
+        self._push_batch(
+            np.asarray(keys, KEY_DTYPE),
+            np.ones(len(keys), np.uint8),
+            np.zeros((len(keys), self.value_size), np.uint8),
+        )
 
     def put(self, key_hi: int, key_lo: int, value: bytes | int) -> None:
         key = pack_u128(
@@ -93,7 +114,10 @@ class Tree:
         )
         if isinstance(value, int):
             value = value.to_bytes(self.value_size, "little")
-        self.memtable[key.tobytes()] = (0, value)
+        self._push_batch(
+            key, np.zeros(1, np.uint8),
+            np.frombuffer(value, np.uint8).reshape(1, -1),
+        )
 
     # ------------------------------------------------------------------
     # Reads.
@@ -109,15 +133,20 @@ class Tree:
         resolved = np.zeros(n, bool)
         values = np.zeros((n, self.value_size), np.uint8)
 
-        if self.memtable:
-            kb = keys.tobytes()
-            for i in range(n):
-                hit = self.memtable.get(kb[16 * i : 16 * i + 16])
-                if hit is not None:
-                    resolved[i] = True
-                    if hit[0] == 0:
-                        found[i] = True
-                        values[i] = np.frombuffer(hit[1], np.uint8)
+        for bkeys, bflags, bvals in reversed(self.memtable):
+            todo = np.flatnonzero(~resolved)
+            if len(todo) == 0:
+                break
+            sub = keys[todo]
+            pos = np.searchsorted(bkeys, sub)
+            pos_c = np.minimum(pos, len(bkeys) - 1)
+            hit = bkeys[pos_c] == sub
+            hi = todo[hit]
+            p = pos_c[hit]
+            resolved[hi] = True
+            live = bflags[p] == 0
+            found[hi[live]] = True
+            values[hi[live]] = bvals[p[live]]
 
         for run in self._runs_newest_first():
             todo = np.flatnonzero(~resolved)
@@ -173,18 +202,13 @@ class Tree:
 
     def scan_range(self, key_min: bytes, key_max: bytes) -> tuple[np.ndarray, np.ndarray]:
         streams = []
-        if self.memtable:
-            items = sorted(
-                (k, fv) for k, fv in self.memtable.items()
-                if key_min <= k <= key_max
-            )
-            if items:
-                keys = np.array([k for k, _ in items], KEY_DTYPE)
-                flags = np.array([fv[0] for _, fv in items], np.uint8)
-                vals = np.frombuffer(
-                    b"".join(fv[1] for _, fv in items), np.uint8
-                ).reshape(len(items), self.value_size)
-                streams.append((keys, flags, vals))
+        kmin = np.frombuffer(key_min, KEY_DTYPE)
+        kmax = np.frombuffer(key_max, KEY_DTYPE)
+        for bkeys, bflags, bvals in reversed(self.memtable):
+            lo = np.searchsorted(bkeys, kmin)[0]
+            hi = np.searchsorted(bkeys, kmax, side="right")[0]
+            if lo < hi:
+                streams.append((bkeys[lo:hi], bflags[lo:hi], bvals[lo:hi]))
         for run in self._runs_newest_first():
             if run.key_max < key_min or run.key_min > key_max:
                 continue
@@ -208,19 +232,18 @@ class Tree:
     # Memtable seal + compaction.
 
     def maybe_seal(self) -> None:
-        if len(self.memtable) >= self.memtable_max:
+        if self.memtable_count >= self.memtable_max:
             self.seal_memtable()
 
     def seal_memtable(self) -> None:
         if not self.memtable:
             return
-        items = sorted(self.memtable.items())
-        keys = np.array([k for k, _ in items], KEY_DTYPE)
-        flags = np.array([fv[0] for _, fv in items], np.uint8)
-        vals = np.frombuffer(
-            b"".join(fv[1] for _, fv in items), np.uint8
-        ).reshape(len(items), self.value_size)
+        # Newest batch first: k_way_merge keeps the newest version.
+        keys, flags, vals = k_way_merge_flags(
+            list(reversed(self.memtable)), self.value_size
+        )
         self.memtable.clear()
+        self.memtable_count = 0
         run = self._write_run(keys, flags, vals)
         self.levels[0].append(run)
         self.compact()
@@ -295,26 +318,66 @@ class Tree:
     # Manifest (persisted inside the checkpoint blob).
 
     def manifest(self) -> dict:
-        return {
-            "levels": [
-                [
-                    [(b.address, b.count, b.key_min, b.key_max) for b in run.blocks]
-                    for run in level
-                ]
-                for level in self.levels
-            ],
-            "memtable": dict(self.memtable),
+        """Fixed-layout manifest: parallel arrays over all blocks (level
+        + run index recover the nesting) + memtable batches.  Snapshot-
+        codec friendly — no pickle anywhere in the durable path."""
+        blocks = []
+        for level, runs in enumerate(self.levels):
+            for run_idx, run in enumerate(runs):
+                for b in run.blocks:
+                    blocks.append((level, run_idx, b))
+        nb = len(blocks)
+        man = {
+            "level": np.array([t[0] for t in blocks], np.uint8),
+            "run": np.array([t[1] for t in blocks], np.uint32),
+            "addr": np.array([t[2].address for t in blocks], np.uint64),
+            "count": np.array([t[2].count for t in blocks], np.uint64),
+            "kmin": np.array([t[2].key_min for t in blocks], KEY_DTYPE)
+            if nb else np.zeros(0, KEY_DTYPE),
+            "kmax": np.array([t[2].key_max for t in blocks], KEY_DTYPE)
+            if nb else np.zeros(0, KEY_DTYPE),
         }
+        if self.memtable:
+            man["mt_keys"] = np.concatenate([b[0] for b in self.memtable])
+            man["mt_flags"] = np.concatenate([b[1] for b in self.memtable])
+            man["mt_vals"] = np.concatenate([b[2] for b in self.memtable])
+            man["mt_lens"] = np.array(
+                [len(b[0]) for b in self.memtable], np.uint64
+            )
+        return man
 
     def restore(self, manifest: dict) -> None:
-        self.levels = [
-            [
-                Run(blocks=[RunBlock(*t) for t in run])
-                for run in level
-            ]
-            for level in manifest["levels"]
-        ]
-        self.memtable = dict(manifest["memtable"])
+        self.levels = [[] for _ in range(LEVELS)]
+        level = np.asarray(manifest["level"])
+        run_of = np.asarray(manifest["run"])
+        kmin = np.asarray(manifest["kmin"]).astype(KEY_DTYPE, copy=False)
+        kmax = np.asarray(manifest["kmax"]).astype(KEY_DTYPE, copy=False)
+        for i in range(len(level)):
+            runs = self.levels[int(level[i])]
+            while len(runs) <= int(run_of[i]):
+                runs.append(Run(blocks=[]))
+            runs[int(run_of[i])].blocks.append(
+                RunBlock(
+                    address=int(manifest["addr"][i]),
+                    count=int(manifest["count"][i]),
+                    key_min=kmin[i].tobytes(),
+                    key_max=kmax[i].tobytes(),
+                )
+            )
+        self.memtable = []
+        self.memtable_count = 0
+        if "mt_lens" in manifest and len(manifest["mt_lens"]):
+            keys = np.asarray(manifest["mt_keys"]).astype(KEY_DTYPE, copy=False)
+            flags = np.asarray(manifest["mt_flags"])
+            vals = np.asarray(manifest["mt_vals"])
+            at = 0
+            for n in manifest["mt_lens"]:
+                n = int(n)
+                self.memtable.append(
+                    (keys[at : at + n], flags[at : at + n], vals[at : at + n])
+                )
+                at += n
+            self.memtable_count = at
 
 
 # ----------------------------------------------------------------------

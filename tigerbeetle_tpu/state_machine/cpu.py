@@ -1093,36 +1093,116 @@ class CpuStateMachine:
     # prepare_timestamp is primary-only in-memory state (re-derived from
     # commit_timestamp on the next prepare), so it is NOT part of the
     # snapshot — backups never advance it and must still converge.
-    _SNAPSHOT_FIELDS = (
-        "commit_timestamp", "pulse_next_timestamp",
-        "accounts", "accounts_by_timestamp",
-        "transfers", "transfers_by_timestamp",
-        "transfers_by_dr", "transfers_by_cr",
-        "expires_at_index", "transfers_pending", "account_balances",
-    )
+    # accounts_by_timestamp / transfers_by_timestamp are derived from
+    # the row sets and rebuilt on restore.
 
     def snapshot(self) -> bytes:
-        """Serialize all durable state (the reference checkpoints its
-        forest to grid blocks, reference: src/vsr/replica.zig:3886-4039;
-        here durable state is host-resident so the snapshot is one
-        checksummed blob)."""
-        import pickle
+        """Serialize all durable state to the fixed-layout binary
+        snapshot codec (utils/snapshot.py) — NOT pickle (checkpoint
+        blobs travel via state sync; decoding must be safe on
+        untrusted bytes and stable across versions).
 
-        state = {k: getattr(self, k) for k in self._SNAPSHOT_FIELDS}
-        # Sets pickle in history-dependent iteration order; canonicalize
-        # so equal states give byte-equal snapshots (the convergence
-        # checkers compare snapshot bytes).
-        state["expires_at_index"] = sorted(state["expires_at_index"])
-        return pickle.dumps(state, protocol=5)
+        Canonical: dict iteration order is commit-replay order, which
+        is identical across replicas with identical op streams, and
+        the set-backed expiry index is sorted — so equal states give
+        byte-equal snapshots (the convergence checkers rely on it).
+        """
+        from tigerbeetle_tpu.utils import snapshot as snapcodec
+
+        def rows_u8(recs, dtype):
+            arr = np.zeros(len(recs), dtype=dtype)
+            for i, rec in enumerate(recs):
+                rec.to_np(arr[i])
+            return arr.view(np.uint8).reshape(len(recs), dtype.itemsize)
+
+        def u128_pairs(values):
+            arr = np.zeros((len(values), 2), np.uint64)
+            for i, v in enumerate(values):
+                arr[i, 0] = v & U64_MAX
+                arr[i, 1] = v >> 64
+            return arr
+
+        def csr(index: dict[int, list[int]]):
+            keys = u128_pairs(list(index))
+            lens = np.array([len(v) for v in index.values()], np.uint64)
+            flat = np.array(
+                [ts for v in index.values() for ts in v], np.uint64
+            )
+            return {"keys": keys, "lens": lens, "values": flat}
+
+        exp = sorted(self.expires_at_index)
+        bal = self.account_balances
+        state = {
+            "commit_timestamp": self.commit_timestamp,
+            "pulse_next_timestamp": self.pulse_next_timestamp,
+            "accounts": rows_u8(list(self.accounts.values()), ACCOUNT_DTYPE),
+            "transfers": rows_u8(
+                list(self.transfers.values()), TRANSFER_DTYPE
+            ),
+            "by_dr": csr(self.transfers_by_dr),
+            "by_cr": csr(self.transfers_by_cr),
+            "expires_at": np.array(exp, np.uint64).reshape(len(exp), 2),
+            "pending_ts": np.array(list(self.transfers_pending), np.uint64),
+            "pending_status": np.array(
+                [int(s) for s in self.transfers_pending.values()], np.uint8
+            ),
+            "balances_ts": np.array(list(bal), np.uint64),
+            "balances": {
+                f: u128_pairs([getattr(b, f) for b in bal.values()])
+                for f in (
+                    "dr_account_id", "dr_debits_pending", "dr_debits_posted",
+                    "dr_credits_pending", "dr_credits_posted",
+                    "cr_account_id", "cr_debits_pending", "cr_debits_posted",
+                    "cr_credits_pending", "cr_credits_posted",
+                )
+            },
+        }
+        return snapcodec.encode_tree(state)
 
     def restore(self, data: bytes) -> None:
-        import pickle
+        from tigerbeetle_tpu.utils import snapshot as snapcodec
 
-        state = pickle.loads(data)
-        assert set(state) == set(self._SNAPSHOT_FIELDS)
-        state["expires_at_index"] = set(state["expires_at_index"])
-        for k, v in state.items():
-            setattr(self, k, v)
+        state = snapcodec.decode_tree(data)
+        self.commit_timestamp = state["commit_timestamp"]
+        self.pulse_next_timestamp = state["pulse_next_timestamp"]
+
+        def recs_of(u8, dtype, cls):
+            rows = np.ascontiguousarray(u8).view(dtype).reshape(-1)
+            return [cls.from_np(rows[i]) for i in range(len(rows))]
+
+        def uncsr(node) -> dict[int, list[int]]:
+            out: dict[int, list[int]] = {}
+            at = 0
+            for i in range(len(node["keys"])):
+                key = int(node["keys"][i, 0]) | (int(node["keys"][i, 1]) << 64)
+                n = int(node["lens"][i])
+                out[key] = [int(t) for t in node["values"][at : at + n]]
+                at += n
+            return out
+
+        accounts = recs_of(state["accounts"], ACCOUNT_DTYPE, AccountRec)
+        self.accounts = {a.id: a for a in accounts}
+        self.accounts_by_timestamp = {a.timestamp: a.id for a in accounts}
+        transfers = recs_of(state["transfers"], TRANSFER_DTYPE, TransferRec)
+        self.transfers = {t.id: t for t in transfers}
+        self.transfers_by_timestamp = {t.timestamp: t.id for t in transfers}
+        self.transfers_by_dr = uncsr(state["by_dr"])
+        self.transfers_by_cr = uncsr(state["by_cr"])
+        self.expires_at_index = {
+            (int(r[0]), int(r[1])) for r in state["expires_at"]
+        }
+        self.transfers_pending = {
+            int(ts): TransferPendingStatus(int(s))
+            for ts, s in zip(state["pending_ts"], state["pending_status"])
+        }
+        bal_fields = list(state["balances"])
+        self.account_balances = {}
+        for i, ts in enumerate(state["balances_ts"]):
+            rec = BalanceRec(timestamp=int(ts))
+            for f in bal_fields:
+                pair = state["balances"][f][i]
+                setattr(rec, f, int(pair[0]) | (int(pair[1]) << 64))
+            self.account_balances[int(ts)] = rec
         self.prepare_timestamp = self.commit_timestamp
         self._undo = UndoLog()
         self._expiry_buffer = None

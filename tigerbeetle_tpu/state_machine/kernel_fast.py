@@ -28,11 +28,14 @@ import jax.numpy as jnp
 
 from tigerbeetle_tpu.ops import u128 as w
 
-# Fixed flush chunk: ONE compiled shape ever (larger delta sets loop).
+# Fixed flush chunk: ONE compiled shape ever (larger delta sets loop —
+# chunks chain serially through the donated table, so the chunk is
+# sized to cover accounts*4 entries for large account tables in a few
+# dispatches; small flushes pad, which costs <1ms on the link).
 # Entries within a flush are unique per (slot, col) after compaction, so
 # the kernel scatters with unique_indices instead of accumulating — no
 # limb decomposition needed, just one u128 carry add over the table.
-_FLUSH_CHUNK = 4096
+_FLUSH_CHUNK = 32_768
 # Queue high-water mark: flush (async) once this many entries queue up.
 # Bounds queue memory and overlaps device work with the host commit
 # loop; compaction collapses each flush to at most accounts*4 entries.

@@ -1486,39 +1486,38 @@ def _pad(arr: np.ndarray, size: int) -> np.ndarray:
 def _tpu_snapshot(self) -> bytes:
     """Serialize durable state: columnar stores + the balance mirror
     (which exactly equals the device table after a queue drain —
-    kernel_fast.py write-behind contract)."""
-    import pickle
+    kernel_fast.py write-behind contract).  Fixed-layout binary
+    encoding (utils/snapshot.py), NOT pickle: checkpoint blobs travel
+    via state sync and must be safe to decode from untrusted bytes."""
+    from tigerbeetle_tpu.utils import snapshot as snapcodec
 
     self._dev.flush()  # queue drained; mirror == device content
     count = self._attrs.count
     # prepare_timestamp is primary-only in-memory state, re-derived from
     # commit_timestamp after restore — see cpu.py snapshot note.
     state = {
-        "scalars": (
-            self.commit_timestamp,
-            self.pulse_next_timestamp, self._exp_dead,
-        ),
-        "attrs": {k: self._attrs.col(k).copy() for k in _ATTR_FIELDS},
-        "store": {k: self._store.col(k).copy() for k in _STORE_FIELDS},
-        "exp": {
-            k: self._exp.col(k).copy() for k in ("expires_at", "row", "active")
-        },
-        "history": {k: self._history.col(k).copy() for k in _HISTORY_FIELDS},
-        "mirror_lo": self._mirror.lo[:count].copy(),
-        "mirror_hi": self._mirror.hi[:count].copy(),
+        "commit_timestamp": self.commit_timestamp,
+        "pulse_next_timestamp": self.pulse_next_timestamp,
+        "exp_dead": self._exp_dead,
+        "attrs": {k: self._attrs.col(k) for k in _ATTR_FIELDS},
+        "store": {k: self._store.col(k) for k in _STORE_FIELDS},
+        "exp": {k: self._exp.col(k) for k in ("expires_at", "row", "active")},
+        "history": {k: self._history.col(k) for k in _HISTORY_FIELDS},
+        "mirror_lo": self._mirror.lo[:count],
+        "mirror_hi": self._mirror.hi[:count],
     }
-    return pickle.dumps(state, protocol=5)
+    return snapcodec.encode_tree(state)
 
 
 def _tpu_restore(self, data: bytes) -> None:
     import jax.numpy as jnp
-    import pickle
 
-    state = pickle.loads(data)
-    (
-        self.commit_timestamp,
-        self.pulse_next_timestamp, self._exp_dead,
-    ) = state["scalars"]
+    from tigerbeetle_tpu.utils import snapshot as snapcodec
+
+    state = snapcodec.decode_tree(data)
+    self.commit_timestamp = state["commit_timestamp"]
+    self.pulse_next_timestamp = state["pulse_next_timestamp"]
+    self._exp_dead = state["exp_dead"]
     self.prepare_timestamp = self.commit_timestamp
 
     self._attrs = Columns(_ATTR_FIELDS)

@@ -412,27 +412,53 @@ class Replica:
         return self.storage.layout.grid_offset + region * span
 
     def _take_snapshot(self) -> bytes:
-        import pickle
+        from tigerbeetle_tpu.utils import snapshot as snapcodec
 
-        return pickle.dumps(
+        sessions = self.sessions
+        cl = np.zeros((len(sessions), 2), np.uint64)  # u128 client ids
+        meta = np.zeros((len(sessions), 4), np.uint64)
+        headers = []
+        for i, (client, s) in enumerate(sessions.items()):
+            cl[i, 0] = client & ((1 << 64) - 1)
+            cl[i, 1] = client >> 64
+            # meta[3]: registered-but-unreplied sessions carry an empty
+            # reply_header; encode presence explicitly.
+            meta[i] = (s.session, s.request, s.slot, 1 if s.reply_header else 0)
+            assert len(s.reply_header) in (0, HEADER_SIZE)
+            headers.append(
+                s.reply_header if s.reply_header else bytes(HEADER_SIZE)
+            )
+        return snapcodec.encode(
             {
                 "sm": self.sm.snapshot(),
-                "sessions": {
-                    c: dataclasses.asdict(s) for c, s in self.sessions.items()
-                },
+                "clients": cl,
+                "session_meta": meta,
+                "reply_headers": b"".join(headers),
                 "next_reply_slot": self._next_reply_slot,
-            },
-            protocol=5,
+            }
         )
 
     def _restore_snapshot(self, blob: bytes) -> None:
-        import pickle
+        from tigerbeetle_tpu.utils import snapshot as snapcodec
 
-        state = pickle.loads(blob)
+        state = snapcodec.decode(blob)
         self.sm.restore(state["sm"])
-        self.sessions = {
-            c: Session(**s) for c, s in state["sessions"].items()
-        }
+        self.sessions = {}
+        headers = state["reply_headers"]
+        for i in range(len(state["clients"])):
+            client = int(state["clients"][i, 0]) | (
+                int(state["clients"][i, 1]) << 64
+            )
+            self.sessions[client] = Session(
+                session=int(state["session_meta"][i, 0]),
+                request=int(state["session_meta"][i, 1]),
+                reply_header=(
+                    headers[i * HEADER_SIZE : (i + 1) * HEADER_SIZE]
+                    if int(state["session_meta"][i, 3])
+                    else b""
+                ),
+                slot=int(state["session_meta"][i, 2]),
+            )
         self._next_reply_slot = state["next_reply_slot"]
 
     def _write_grid(self, offset: int, blob: bytes) -> None:
