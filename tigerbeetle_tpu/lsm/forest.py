@@ -29,11 +29,12 @@ class Forest:
         self.grooves: dict[str, Groove] = {}
 
     def groove(self, name: str, *, object_size: int,
-               index_fields: list[str]) -> Groove:
+               index_fields: list[str], index_value_size: int = 1) -> Groove:
         assert name not in self.grooves
         g = Groove(
             self.grid, name, object_size=object_size,
             index_fields=index_fields, memtable_max=self.memtable_max,
+            index_value_size=index_value_size,
         )
         self.grooves[name] = g
         return g
@@ -41,6 +42,17 @@ class Forest:
     def compact(self) -> None:
         for g in self.grooves.values():
             g.maybe_seal()
+
+    def manifest_blob(self) -> bytes:
+        """Pure snapshot of the forest's manifests + free set (includes
+        unsealed memtable batches; mutates nothing)."""
+        return snapcodec.encode_tree(
+            {
+                "grooves": {n: g.manifest() for n, g in self.grooves.items()},
+                "free_set": self.grid.free_set.encode(),
+                "block_count": self.grid.block_count,
+            }
+        )
 
     def checkpoint(self) -> bytes:
         """Seal all memtables, release staged blocks, and return the
@@ -51,13 +63,7 @@ class Forest:
             for t in g.indexes.values():
                 t.seal_memtable()
         self.grid.free_set.checkpoint()
-        return snapcodec.encode_tree(
-            {
-                "grooves": {n: g.manifest() for n, g in self.grooves.items()},
-                "free_set": self.grid.free_set.encode(),
-                "block_count": self.grid.block_count,
-            }
-        )
+        return self.manifest_blob()
 
     def open(self, blob: bytes) -> None:
         state = snapcodec.decode_tree(blob)

@@ -24,7 +24,8 @@ def _ts_keys(timestamps: np.ndarray) -> np.ndarray:
 
 class Groove:
     def __init__(self, grid: Grid, name: str, *, object_size: int,
-                 index_fields: list[str], memtable_max: int = 8192) -> None:
+                 index_fields: list[str], memtable_max: int = 8192,
+                 index_value_size: int = 1) -> None:
         self.name = name
         self.object_size = object_size
         self.id_tree = Tree(
@@ -34,9 +35,13 @@ class Groove:
             grid, f"{name}.object", value_size=object_size,
             memtable_max=memtable_max,
         )
+        # index_value_size=8 stores a row/object pointer per index entry
+        # (the state machine's spill tier scans indexes straight to
+        # object-tree keys); the default 1-byte value is presence-only.
         self.indexes = {
             field: Tree(
-                grid, f"{name}.{field}", value_size=1, memtable_max=memtable_max
+                grid, f"{name}.{field}", value_size=index_value_size,
+                memtable_max=memtable_max,
             )
             for field in index_fields
         }

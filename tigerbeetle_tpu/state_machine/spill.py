@@ -1,0 +1,225 @@
+"""LSM spill tier for the TPU state machine's transfer + history state.
+
+The commit hot path appends to RAM columnar stores (tpu.py `Columns`) —
+the memtable of this design.  At checkpoint, rows that can no longer
+change (everything except live pendings, which post/void/expiry still
+mutate) spill into LSM grooves on the grid, so durable state scales
+past host RAM while the hot path never touches the LSM
+(reference: src/lsm/groove.zig:136-176 — grooves feed the state
+machine; src/state_machine.zig:178-324).
+
+Key scheme (vs the reference's IdTree/ObjectTree pair,
+src/lsm/groove.zig):
+- object tree: key = GLOBAL ROW NUMBER (commit order).  Rows are
+  assigned monotonically and timestamps rise with rows, so row order ==
+  timestamp order.  The id -> row map stays in the RAM run-compressed
+  id directories (utils/hashindex.py RunIndex + the native IdDir) —
+  sequential-id workloads compress to O(1) ranges; the object tree
+  rebuilds them after restore.
+- dr/cr index trees: key = (account slot, timestamp), value = row —
+  timestamp-ordered range scans per account for get_account_transfers
+  (reference: src/state_machine.zig:931-996).
+- history tree: key = transfer timestamp (unique), value = packed
+  dr/cr balance snapshots for get_account_balances.
+
+Spilled objects are immutable; `gather` serves reads for exists-ladder
+joins, lookup_transfers, and query materialization.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tigerbeetle_tpu.lsm.runs import pack_u128
+
+# Spilled transfer object layout (little-endian), 144 bytes:
+#   0..128  wire Transfer image (types.py TRANSFER_DTYPE, incl.
+#           timestamp at 120)
+# 128..132  dr_slot  i32
+# 132..136  cr_slot  i32
+# 136..137  status   u8 (TransferPendingStatus; final by spill time)
+# 137..144  pad
+TRANSFER_OBJECT_SIZE = 144
+
+# Spilled history object layout, 160 bytes total:
+#   0..16   dr account id (lo, hi)
+#  16..32   cr account id (lo, hi)
+#  32..96   dr balances (dp, dpo, cp, cpo as u128 lo/hi pairs, 64B)
+#  96..160  cr balances (same packing, 64B)
+HISTORY_OBJECT_SIZE = 160
+
+# Store-column -> byte offset within the 128B wire image.
+_WIRE_FIELDS = (
+    ("id_lo", 0, np.uint64), ("id_hi", 8, np.uint64),
+    # debit/credit account ids are not store columns (slots are); they
+    # are written from the attrs table at spill time.
+    ("amount_lo", 48, np.uint64), ("amount_hi", 56, np.uint64),
+    ("pending_lo", 64, np.uint64), ("pending_hi", 72, np.uint64),
+    ("ud128_lo", 80, np.uint64), ("ud128_hi", 88, np.uint64),
+    ("ud64", 96, np.uint64), ("ud32", 104, np.uint32),
+    ("timeout", 108, np.uint32),
+    ("ledger", 112, np.uint32), ("code", 116, np.uint16),
+    ("flags", 118, np.uint16), ("timestamp", 120, np.uint64),
+)
+
+
+def _row_keys(rows: np.ndarray) -> np.ndarray:
+    return pack_u128(
+        np.asarray(rows, np.uint64), np.zeros(len(rows), np.uint64)
+    )
+
+
+class TransferSpill:
+    """Spilled (immutable) transfer rows in a groove; `base` rows
+    [0, base) live here, the store's RAM tail holds [base, count)."""
+
+    def __init__(self, groove) -> None:
+        self.groove = groove
+        self.base = 0
+
+    # -- write (checkpoint path) ---------------------------------------
+
+    def spill(self, rows: np.ndarray, cols: dict, attrs) -> None:
+        """Append objects for global rows (ascending, == arange from
+        self.base) built from store columns + account attrs."""
+        n = len(rows)
+        if n == 0:
+            return
+        assert int(rows[0]) == self.base and int(rows[-1]) == self.base + n - 1
+        obj = np.zeros((n, TRANSFER_OBJECT_SIZE), np.uint8)
+        for name, off, dt in _WIRE_FIELDS:
+            width = np.dtype(dt).itemsize
+            obj[:, off : off + width] = (
+                np.ascontiguousarray(cols[name].astype(dt, copy=False))
+                .view(np.uint8)
+                .reshape(n, width)
+            )
+        dr = cols["dr_slot"].astype(np.int64)
+        cr = cols["cr_slot"].astype(np.int64)
+        obj[:, 16:24] = attrs["id_lo"][dr].view(np.uint8).reshape(n, 8)
+        obj[:, 24:32] = attrs["id_hi"][dr].view(np.uint8).reshape(n, 8)
+        obj[:, 32:40] = attrs["id_lo"][cr].view(np.uint8).reshape(n, 8)
+        obj[:, 40:48] = attrs["id_hi"][cr].view(np.uint8).reshape(n, 8)
+        obj[:, 128:132] = (
+            cols["dr_slot"].astype(np.int32).view(np.uint8).reshape(n, 4)
+        )
+        obj[:, 132:136] = (
+            cols["cr_slot"].astype(np.int32).view(np.uint8).reshape(n, 4)
+        )
+        obj[:, 136] = cols["status"].astype(np.uint8)
+
+        ts = cols["timestamp"].astype(np.uint64)
+        self.groove.object_tree.put_batch(_row_keys(rows), obj)
+        rows_v = np.asarray(rows, np.uint64).astype("<u8").view("V8")
+        self.groove.indexes["dr_slot"].put_batch(
+            pack_u128(ts, dr.astype(np.uint64)), rows_v
+        )
+        self.groove.indexes["cr_slot"].put_batch(
+            pack_u128(ts, cr.astype(np.uint64)), rows_v
+        )
+        self.base += n
+
+    # -- read ----------------------------------------------------------
+
+    def gather(self, rows: np.ndarray) -> np.ndarray:
+        """Global rows (< base) -> (n, TRANSFER_OBJECT_SIZE) u8."""
+        found, vals = self.groove.object_tree.lookup_batch(_row_keys(rows))
+        assert found.all(), "spilled row missing from object tree"
+        return vals
+
+    def update_status(self, rows: np.ndarray, statuses: np.ndarray) -> None:
+        """Finalize spilled pendings: rewrite their objects with the
+        new status (LSM overwrite; newest version wins on read).  The
+        only mutable byte of a spilled object — everything else is
+        immutable after spill."""
+        obj = self.gather(rows).copy()
+        obj[:, 136] = np.asarray(statuses, np.uint8)
+        self.groove.object_tree.put_batch(_row_keys(rows), obj)
+
+    def index_rows(self, field: str, slot: int, *, ts_min: int,
+                   ts_max: int) -> np.ndarray:
+        """Rows (ascending) where field == slot within the ts range."""
+        lo = pack_u128(
+            np.array([ts_min], np.uint64), np.array([slot], np.uint64)
+        ).tobytes()
+        hi = pack_u128(
+            np.array([ts_max], np.uint64), np.array([slot], np.uint64)
+        ).tobytes()
+        _keys, vals = self.groove.indexes[field].scan_range(lo, hi)
+        return vals.view("<u8").reshape(-1).astype(np.int64)
+
+    def iter_objects(self, batch: int = 8192):
+        """Yield (rows, objects) over all spilled rows ascending —
+        restore uses this to rebuild the RAM id directories."""
+        at = 0
+        while at < self.base:
+            n = min(batch, self.base - at)
+            rows = np.arange(at, at + n, dtype=np.int64)
+            yield rows, self.gather(rows)
+            at += n
+
+
+def unpack_objects(obj: np.ndarray) -> dict:
+    """(n, 144) u8 -> store-column dict (the inverse of spill)."""
+    n = len(obj)
+    out = {}
+    for name, off, dt in _WIRE_FIELDS:
+        width = np.dtype(dt).itemsize
+        out[name] = (
+            np.ascontiguousarray(obj[:, off : off + width])
+            .view(dt)
+            .reshape(n)
+        )
+    out["dr_slot"] = (
+        np.ascontiguousarray(obj[:, 128:132]).view(np.int32).reshape(n)
+    )
+    out["cr_slot"] = (
+        np.ascontiguousarray(obj[:, 132:136]).view(np.int32).reshape(n)
+    )
+    out["status"] = obj[:, 136].copy()
+    out["dr_id_lo"] = np.ascontiguousarray(obj[:, 16:24]).view(np.uint64).reshape(n)
+    out["dr_id_hi"] = np.ascontiguousarray(obj[:, 24:32]).view(np.uint64).reshape(n)
+    out["cr_id_lo"] = np.ascontiguousarray(obj[:, 32:40]).view(np.uint64).reshape(n)
+    out["cr_id_hi"] = np.ascontiguousarray(obj[:, 40:48]).view(np.uint64).reshape(n)
+    return out
+
+
+class HistorySpill:
+    """Spilled historical-balance rows keyed by transfer timestamp."""
+
+    def __init__(self, groove) -> None:
+        self.groove = groove
+        self.base = 0  # history rows [0, base) spilled
+
+    def spill(self, cols: dict) -> None:
+        n = len(cols["timestamp"])
+        if n == 0:
+            return
+        obj = np.zeros((n, HISTORY_OBJECT_SIZE), np.uint8)
+        obj[:, 0:8] = cols["dr_id_lo"].view(np.uint8).reshape(n, 8)
+        obj[:, 8:16] = cols["dr_id_hi"].view(np.uint8).reshape(n, 8)
+        obj[:, 16:24] = cols["cr_id_lo"].view(np.uint8).reshape(n, 8)
+        obj[:, 24:32] = cols["cr_id_hi"].view(np.uint8).reshape(n, 8)
+        obj[:, 32:96] = (
+            np.ascontiguousarray(cols["dr_bal"]).view(np.uint8).reshape(n, 64)
+        )
+        obj[:, 96:160] = (
+            np.ascontiguousarray(cols["cr_bal"]).view(np.uint8).reshape(n, 64)
+        )
+        ts = cols["timestamp"].astype(np.uint64)
+        self.groove.object_tree.put_batch(
+            pack_u128(ts, np.zeros(n, np.uint64)), obj
+        )
+        self.base += n
+
+    def gather_by_ts(self, ts: np.ndarray) -> tuple[np.ndarray, dict]:
+        found, obj = self.groove.object_tree.lookup_batch(
+            pack_u128(np.asarray(ts, np.uint64), np.zeros(len(ts), np.uint64))
+        )
+        n = len(obj)
+        return found, {
+            "dr_id_lo": np.ascontiguousarray(obj[:, 0:8]).view(np.uint64).reshape(n),
+            "dr_id_hi": np.ascontiguousarray(obj[:, 8:16]).view(np.uint64).reshape(n),
+            "dr_bal": np.ascontiguousarray(obj[:, 32:96]).view(np.uint64).reshape(n, 8),
+            "cr_bal": np.ascontiguousarray(obj[:, 96:160]).view(np.uint64).reshape(n, 8),
+        }

@@ -149,6 +149,114 @@ class Columns:
         return self._cols[name]
 
 
+class _GlobalCol:
+    """Indexing proxy translating GLOBAL rows to the RAM tail or the
+    LSM spill tier (read-only below the spill base)."""
+
+    __slots__ = ("_store", "_name")
+
+    def __init__(self, store: "TailStore", name: str) -> None:
+        self._store = store
+        self._name = name
+
+    def __getitem__(self, rows):
+        return self._store.gather(self._name, rows)
+
+    def __setitem__(self, rows, values) -> None:
+        base = self._store.base
+        if np.isscalar(rows) or isinstance(rows, (int, np.integer)):
+            rows = np.array([rows], np.int64)
+            values = np.asarray([values])
+        else:
+            rows = np.asarray(rows)
+            values = np.broadcast_to(np.asarray(values), rows.shape)
+        in_ram = rows >= base
+        if in_ram.any():
+            self._store.ram[self._name][rows[in_ram] - base] = values[in_ram]
+        if not in_ram.all():
+            # Spilled objects are immutable EXCEPT the pending status
+            # byte, which post/void/expiry finalize in place.
+            assert self._name == "status", "write to spilled row"
+            self._store.spill.update_status(rows[~in_ram], values[~in_ram])
+
+
+class TailStore:
+    """Columnar store whose rows [0, base) have spilled into an LSM
+    groove (state_machine/spill.py) and whose tail [base, count) stays
+    in RAM — the hot append path never touches the LSM.
+
+    Global row numbers are stable across spills: the id directories,
+    the expiry index, and the native library all keep global rows.
+    """
+
+    def __init__(self, fields: dict, capacity: int = 1024) -> None:
+        self.ram = Columns(fields, capacity)
+        self.base = 0
+        self.spill = None  # TransferSpill once a forest is attached
+
+    @property
+    def count(self) -> int:
+        return self.base + self.ram.count
+
+    def append(self, **arrays) -> np.ndarray:
+        return self.ram.append(**arrays) + self.base
+
+    def col(self, name: str) -> np.ndarray:
+        """RAM-tail view (physical); pair with .base for global rows."""
+        return self.ram.col(name)
+
+    def __getitem__(self, name: str) -> _GlobalCol:
+        return _GlobalCol(self, name)
+
+    def gather(self, name: str, rows):
+        from tigerbeetle_tpu.state_machine import spill as spill_mod
+
+        if np.isscalar(rows) or isinstance(rows, (int, np.integer)):
+            if rows >= self.base:
+                return self.ram[name][rows - self.base]
+            obj = self.spill.gather(np.array([rows], np.int64))
+            return spill_mod.unpack_objects(obj)[name][0]
+        rows = np.asarray(rows)
+        if len(rows) == 0 or (self.base == 0 or (rows >= self.base).all()):
+            return self.ram[name][rows - self.base]
+        out = np.empty(len(rows), self.ram[name].dtype)
+        in_ram = rows >= self.base
+        out[in_ram] = self.ram[name][rows[in_ram] - self.base]
+        cold = ~in_ram
+        obj = self.spill.gather(rows[cold])
+        out[cold] = spill_mod.unpack_objects(obj)[name]
+        return out
+
+    def gather_many(self, names: list[str], rows: np.ndarray) -> dict:
+        """One spill fetch for many columns (exact-path joins)."""
+        from tigerbeetle_tpu.state_machine import spill as spill_mod
+
+        rows = np.asarray(rows)
+        in_ram = rows >= self.base
+        if in_ram.all():
+            phys = rows - self.base
+            return {n: self.ram[n][phys] for n in names}
+        cold_rows = rows[~in_ram]
+        cold = spill_mod.unpack_objects(self.spill.gather(cold_rows))
+        phys = np.maximum(rows - self.base, 0)
+        out = {}
+        for n in names:
+            vals = self.ram[n][phys].copy()
+            vals[~in_ram] = cold[n]
+            out[n] = vals
+        return out
+
+    def drop_prefix(self, n: int) -> None:
+        """Advance base after `n` rows spilled (caller already wrote
+        them to the groove)."""
+        assert n <= self.ram.count
+        keep = self.ram.count - n
+        for name, colarr in self.ram._cols.items():
+            colarr[:keep] = colarr[n : self.ram.count]
+        self.ram.count = keep
+        self.base += n
+
+
 def _dir_capacity(entries: int) -> int:
     """Pow2 hash capacity holding `entries` at <=50% load (the hash is
     the RunIndex fallback for non-sequential ids; presizing it keeps
@@ -208,12 +316,22 @@ class TpuStateMachine:
 
         # Transfer state.
         self._tdir = RunIndex(_dir_capacity(transfer_capacity))
-        self._store = Columns(_STORE_FIELDS, capacity=max(1024, transfer_capacity))
-        # expires_at index: (expires_at, row, active).
+        self._store = TailStore(
+            _STORE_FIELDS, capacity=max(1024, transfer_capacity)
+        )
+        # expires_at index: (expires_at, row, active).  Rows are GLOBAL
+        # store rows; live pendings never spill, so active entries
+        # always resolve in the RAM tail.
         self._exp = Columns(
             {"expires_at": np.uint64, "row": np.uint32, "active": np.bool_}
         )
         self._history = Columns(_HISTORY_FIELDS)
+
+        # LSM spill tier (attach_forest): None in standalone mode —
+        # everything stays in RAM, as in the benchmark harness.  The
+        # replica attaches a Forest so state scales past host RAM.
+        self._forest = None
+        self._hspill = None
 
         self._expiry_rows: np.ndarray | None = None
         self._exp_dead = 0
@@ -237,6 +355,59 @@ class TpuStateMachine:
     def sync(self) -> None:
         """Drain the write-behind queue and wait for the device."""
         jax.block_until_ready(self._dev.read())
+
+    # ------------------------------------------------------------------
+    # LSM spill tier (replica mode).
+
+    def attach_forest(self, forest) -> None:
+        """Wire the LSM forest in: transfers + history grooves back the
+        columnar stores so durable state scales past host RAM
+        (reference: src/lsm/forest.zig:31, groove.zig:136-176)."""
+        from tigerbeetle_tpu.state_machine import spill as spill_mod
+
+        assert self._forest is None
+        self._forest = forest
+        transfers = forest.groove(
+            "transfers",
+            object_size=spill_mod.TRANSFER_OBJECT_SIZE,
+            index_fields=["dr_slot", "cr_slot"],
+            index_value_size=8,
+        )
+        history = forest.groove(
+            "account_history",
+            object_size=spill_mod.HISTORY_OBJECT_SIZE,
+            index_fields=[],
+        )
+        self._store.spill = spill_mod.TransferSpill(transfers)
+        self._hspill = spill_mod.HistorySpill(history)
+
+    def checkpoint_spill(self) -> None:
+        """Move the whole RAM tail into the LSM tier — including live
+        pendings, whose status byte stays mutable through
+        TransferSpill.update_status (a stuck pending must not pin every
+        later row in RAM).  Called by the replica at checkpoint —
+        deterministic across replicas (state-dependent only), keeping
+        checkpoint snapshots O(RAM tail), not O(history)
+        (reference: src/vsr/replica.zig:3886-4039 checkpoint_data)."""
+        if self._forest is None:
+            return
+        st = self._store
+        limit = st.ram.count
+        if limit > 0:
+            rows = np.arange(st.base, st.base + limit, dtype=np.int64)
+            cols = {
+                name: st.ram.col(name)[:limit] for name in _STORE_FIELDS
+            }
+            st.spill.spill(rows, cols, self._attrs)
+            st.drop_prefix(limit)
+        # History is append-only: spill everything.
+        h = self._history
+        if h.count:
+            self._hspill.spill(
+                {name: h.col(name) for name in _HISTORY_FIELDS}
+            )
+            h.truncate(0)
+        self._forest.checkpoint()
 
     # ------------------------------------------------------------------
     # Introspection helpers shared with CpuStateMachine.
@@ -586,9 +757,18 @@ class TpuStateMachine:
                 self._attrs.col("flags"), self._attrs.col("ledger"),
                 base_slot=0,
             )
-        if self._store.count:
+        if self._store.base:
+            from tigerbeetle_tpu.state_machine import spill as spill_mod
+
+            for rows, obj in self._store.spill.iter_objects():
+                cols = spill_mod.unpack_objects(obj)
+                native.add_transfer_ids(
+                    cols["id_lo"], cols["id_hi"], int(rows[0])
+                )
+        if self._store.ram.count:
             native.add_transfer_ids(
-                self._store.col("id_lo"), self._store.col("id_hi"), 0
+                self._store.col("id_lo"), self._store.col("id_hi"),
+                self._store.base,
             )
         self._native = native
         self._mirror.lo = native.lo
@@ -843,33 +1023,56 @@ class TpuStateMachine:
 
         st = self._store
 
-        # Durable joins: skip the fancy-index gathers entirely when the
-        # batch references no durable duplicate/pending rows (the
+        # Durable joins: ONE batched fetch per referenced row set (the
+        # rows may live in the LSM spill tier — per-column gathers
+        # would re-read the objects 13 times), skipped entirely when
+        # the batch references no durable duplicate/pending rows (the
         # common case for fresh-id batches).
+        _JOIN_FIELDS = (
+            "flags", "dr_slot", "cr_slot", "amount_lo", "amount_hi",
+            "pending_lo", "pending_hi", "ud128_lo", "ud128_hi",
+            "ud64", "ud32", "timeout", "ledger", "code", "timestamp",
+            "status",
+        )
+
         def _make_gather(found, rows):
-            has = bool(found.any())
-
-            def gather(col):
-                if not has:
-                    return np.zeros(n, st[col].dtype)
-                return np.where(found, st[col][rows], 0)
-
-            return gather
+            if not found.any():
+                empty = {
+                    f: np.zeros(n, np.dtype(_STORE_FIELDS[f]))
+                    for f in _JOIN_FIELDS
+                }
+                return lambda col: empty[col]
+            idx = np.flatnonzero(found)
+            got = st.gather_many(
+                list(_JOIN_FIELDS), rows[idx].astype(np.int64)
+            )
+            full = {}
+            for f in _JOIN_FIELDS:
+                arr = np.zeros(n, got[f].dtype)
+                arr[idx] = got[f]
+                full[f] = arr
+            return lambda col: full[col]
 
         gather_e = _make_gather(e_found, er)
         gather_p = _make_gather(p_found, pr)
 
-        # Durable-pending target dedupe + initial statuses.
+        # Durable-pending target dedupe + initial statuses (taken from
+        # the already-gathered join columns — no second LSM fetch).
         p_rows_valid = p_row[p_found].astype(np.int64)
-        uniq_rows, tgt_inverse = (
-            np.unique(p_rows_valid, return_inverse=True)
-            if len(p_rows_valid)
-            else (np.zeros(0, np.int64), np.zeros(0, np.int64))
-        )
+        if len(p_rows_valid):
+            uniq_rows, first_idx, tgt_inverse = np.unique(
+                p_rows_valid, return_index=True, return_inverse=True
+            )
+            rep_event = np.flatnonzero(p_found)[first_idx]
+            uniq_status = gather_p("status")[rep_event].astype(np.uint32)
+        else:
+            uniq_rows = np.zeros(0, np.int64)
+            tgt_inverse = np.zeros(0, np.int64)
+            uniq_status = np.zeros(0, np.uint32)
         p_tgt = np.full(n, -1, np.int32)
         p_tgt[p_found] = tgt_inverse.astype(np.int32)
         dstat_init = np.zeros(B, np.uint32)
-        dstat_init[: len(uniq_rows)] = st["status"][uniq_rows]
+        dstat_init[: len(uniq_rows)] = uniq_status
 
         ev = {
             "i": np.arange(B, dtype=np.int32),
@@ -1168,13 +1371,15 @@ class TpuStateMachine:
         else:
             row_of_event = np.full(n, -1, np.int64)
 
-        # 2. Durable pending-status updates (+ expires index removal).
+        # 2. Durable pending-status updates (+ expires index removal),
+        # batched: changed rows may live in the LSM spill tier.
         changed = np.flatnonzero(dstat[: len(uniq_rows)] != dstat_init[: len(uniq_rows)])
-        for t in changed:
-            row = int(uniq_rows[t])
-            self._store["status"][row] = int(dstat[t])
-            if int(self._store["timeout"][row]) > 0:
-                self._exp_deactivate(row)
+        if len(changed):
+            ch_rows = uniq_rows[changed]
+            self._store["status"][ch_rows] = dstat[changed].astype(np.uint8)
+            timeouts = self._store["timeout"][ch_rows]
+            for row in ch_rows[np.asarray(timeouts) > 0]:
+                self._exp_deactivate(int(row))
 
         # 3. New expires entries for still-pending in-batch creations.
         pend_created = np.flatnonzero(
@@ -1315,8 +1520,8 @@ class TpuStateMachine:
         neg_lo, neg_hi, _ = _sub_u128(zero, zero, amt_lo, amt_hi)
         self._dev.enqueue(slots, cols, neg_lo, neg_hi)
 
+        st["status"][rows] = np.uint8(TransferPendingStatus.expired)
         for row in rows:
-            st["status"][int(row)] = TransferPendingStatus.expired
             self._exp_deactivate(int(row))
         return b""
 
@@ -1352,25 +1557,37 @@ class TpuStateMachine:
 
     def _transfer_rows_to_np(self, rows: np.ndarray) -> np.ndarray:
         st = self._store
+        rows = np.asarray(rows, np.int64)
         out = np.zeros(len(rows), dtype=TRANSFER_DTYPE)
-        out["id_lo"], out["id_hi"] = st["id_lo"][rows], st["id_hi"][rows]
-        dr = st["dr_slot"][rows]
-        cr = st["cr_slot"][rows]
+        if len(rows) == 0:
+            return out
+        cols = st.gather_many(
+            [
+                "id_lo", "id_hi", "dr_slot", "cr_slot", "amount_lo",
+                "amount_hi", "pending_lo", "pending_hi", "ud128_lo",
+                "ud128_hi", "ud64", "ud32", "timeout", "ledger", "code",
+                "flags", "timestamp",
+            ],
+            rows,
+        )
+        out["id_lo"], out["id_hi"] = cols["id_lo"], cols["id_hi"]
+        dr = cols["dr_slot"].astype(np.int64)
+        cr = cols["cr_slot"].astype(np.int64)
         out["debit_account_id_lo"] = self._attrs["id_lo"][dr]
         out["debit_account_id_hi"] = self._attrs["id_hi"][dr]
         out["credit_account_id_lo"] = self._attrs["id_lo"][cr]
         out["credit_account_id_hi"] = self._attrs["id_hi"][cr]
-        out["amount_lo"], out["amount_hi"] = st["amount_lo"][rows], st["amount_hi"][rows]
-        out["pending_id_lo"], out["pending_id_hi"] = st["pending_lo"][rows], st["pending_hi"][rows]
-        out["user_data_128_lo"] = st["ud128_lo"][rows]
-        out["user_data_128_hi"] = st["ud128_hi"][rows]
-        out["user_data_64"] = st["ud64"][rows]
-        out["user_data_32"] = st["ud32"][rows]
-        out["timeout"] = st["timeout"][rows]
-        out["ledger"] = st["ledger"][rows]
-        out["code"] = st["code"][rows]
-        out["flags"] = st["flags"][rows]
-        out["timestamp"] = st["timestamp"][rows]
+        out["amount_lo"], out["amount_hi"] = cols["amount_lo"], cols["amount_hi"]
+        out["pending_id_lo"], out["pending_id_hi"] = cols["pending_lo"], cols["pending_hi"]
+        out["user_data_128_lo"] = cols["ud128_lo"]
+        out["user_data_128_hi"] = cols["ud128_hi"]
+        out["user_data_64"] = cols["ud64"]
+        out["user_data_32"] = cols["ud32"]
+        out["timeout"] = cols["timeout"]
+        out["ledger"] = cols["ledger"]
+        out["code"] = cols["code"]
+        out["flags"] = cols["flags"]
+        out["timestamp"] = cols["timestamp"]
         return out
 
     def _lookup_transfers(self, input_bytes: bytes) -> bytes:
@@ -1414,14 +1631,36 @@ class TpuStateMachine:
         st = self._store
         lo = TIMESTAMP_MIN if ts_min == 0 else ts_min
         hi = TIMESTAMP_MAX if ts_max == 0 else ts_max
-        mask = np.zeros(st.count, bool)
+        # Spilled rows: timestamp-ordered (slot, ts) index scans on the
+        # LSM tier (reference: src/state_machine.zig:931-996 builds the
+        # same dr/cr index scans through the ScanBuilder).
+        parts = []
+        if st.base:
+            if fflags & AccountFilterFlags.debits:
+                parts.append(
+                    st.spill.index_rows("dr_slot", slot, ts_min=lo, ts_max=hi)
+                )
+            if fflags & AccountFilterFlags.credits:
+                parts.append(
+                    st.spill.index_rows("cr_slot", slot, ts_min=lo, ts_max=hi)
+                )
+        if len(parts) == 2:
+            spilled = np.union1d(parts[0], parts[1])
+        elif parts:
+            spilled = parts[0]
+        else:
+            spilled = np.zeros(0, np.int64)
+        # RAM tail: vectorized column scan.
+        mask = np.zeros(st.ram.count, bool)
         if fflags & AccountFilterFlags.debits:
             mask |= st.col("dr_slot") == slot
         if fflags & AccountFilterFlags.credits:
             mask |= st.col("cr_slot") == slot
         ts = st.col("timestamp")
         mask &= (ts >= lo) & (ts <= hi)
-        rows = np.flatnonzero(mask)  # store order == timestamp order
+        tail_rows = np.flatnonzero(mask) + st.base
+        # Spilled rows all precede the tail; concat keeps ts order.
+        rows = np.concatenate([spilled, tail_rows])
         if fflags & AccountFilterFlags.reversed:
             rows = rows[::-1]
         return rows
@@ -1451,17 +1690,33 @@ class TpuStateMachine:
         )
         rows = rows[: min(int(filter_row["limit"]), batch_max)]
         # Map transfer timestamps -> history rows (same timestamps;
-        # history rows are store-ordered too).
-        want_ts = self._store["timestamp"][rows]
-        h_ts = self._history.col("timestamp")
-        pos = np.searchsorted(h_ts, want_ts)
-        assert (h_ts[pos] == want_ts).all()
-
+        # history rows are store-ordered too).  The RAM tail serves
+        # recent rows; older rows come from the LSM history groove.
+        want_ts = np.asarray(self._store["timestamp"][rows], np.uint64)
         h = self._history
+        h_ts = h.col("timestamp")
         id_lo = np.uint64(account_id & 0xFFFFFFFFFFFFFFFF)
         id_hi = np.uint64(account_id >> 64)
-        is_dr = (h["dr_id_lo"][pos] == id_lo) & (h["dr_id_hi"][pos] == id_hi)
-        bal = np.where(is_dr[:, None], h["dr_bal"][pos], h["cr_bal"][pos])
+        bal = np.zeros((len(rows), 8), np.uint64)
+        in_ram = np.zeros(len(rows), bool)
+        if len(h_ts):
+            pos = np.searchsorted(h_ts, want_ts)
+            pos_c = np.minimum(pos, len(h_ts) - 1)
+            in_ram = h_ts[pos_c] == want_ts
+            pr = pos_c[in_ram]
+            is_dr = (h["dr_id_lo"][pr] == id_lo) & (h["dr_id_hi"][pr] == id_hi)
+            bal[in_ram] = np.where(
+                is_dr[:, None], h["dr_bal"][pr], h["cr_bal"][pr]
+            )
+        cold = ~in_ram
+        if cold.any():
+            assert self._hspill is not None, "history row missing"
+            found, got = self._hspill.gather_by_ts(want_ts[cold])
+            assert found.all(), "history row missing from LSM tier"
+            is_dr = (got["dr_id_lo"] == id_lo) & (got["dr_id_hi"] == id_hi)
+            bal[cold] = np.where(
+                is_dr[:, None], got["dr_bal"], got["cr_bal"]
+            )
         out = np.zeros(len(rows), dtype=ACCOUNT_BALANCE_DTYPE)
         out["debits_pending_lo"], out["debits_pending_hi"] = bal[:, 0], bal[:, 1]
         out["debits_posted_lo"], out["debits_posted_hi"] = bal[:, 2], bal[:, 3]
@@ -1495,10 +1750,14 @@ def _tpu_snapshot(self) -> bytes:
     count = self._attrs.count
     # prepare_timestamp is primary-only in-memory state, re-derived from
     # commit_timestamp after restore — see cpu.py snapshot note.
+    # With a forest attached, the store section holds only the RAM tail
+    # (everything older lives in LSM grid blocks referenced by the
+    # manifest) — the blob is O(tail + accounts), not O(history).
     state = {
         "commit_timestamp": self.commit_timestamp,
         "pulse_next_timestamp": self.pulse_next_timestamp,
         "exp_dead": self._exp_dead,
+        "store_base": self._store.base,
         "attrs": {k: self._attrs.col(k) for k in _ATTR_FIELDS},
         "store": {k: self._store.col(k) for k in _STORE_FIELDS},
         "exp": {k: self._exp.col(k) for k in ("expires_at", "row", "active")},
@@ -1506,6 +1765,9 @@ def _tpu_snapshot(self) -> bytes:
         "mirror_lo": self._mirror.lo[:count],
         "mirror_hi": self._mirror.hi[:count],
     }
+    if self._forest is not None:
+        state["history_base"] = self._hspill.base
+        state["forest"] = self._forest.manifest_blob()
     return snapcodec.encode_tree(state)
 
 
@@ -1522,7 +1784,7 @@ def _tpu_restore(self, data: bytes) -> None:
 
     self._attrs = Columns(_ATTR_FIELDS)
     self._attrs.append(**state["attrs"])
-    self._store = Columns(_STORE_FIELDS)
+    self._store = TailStore(_STORE_FIELDS)
     self._store.append(**state["store"])
     self._exp = Columns(
         {"expires_at": np.uint64, "row": np.uint32, "active": np.bool_}
@@ -1531,7 +1793,29 @@ def _tpu_restore(self, data: bytes) -> None:
     self._history = Columns(_HISTORY_FIELDS)
     self._history.append(**state["history"])
 
-    # Rebuild directories (derived state, never serialized).
+    base = state.get("store_base", 0)
+    if "forest" in state:
+        from tigerbeetle_tpu.state_machine import spill as spill_mod
+
+        assert self._forest is not None, "snapshot requires a forest"
+        # Reopen the LSM tier from its manifest, then re-point the
+        # spill handles at the restored grooves.
+        self._forest.open(state["forest"])
+        self._store.spill = spill_mod.TransferSpill(
+            self._forest.grooves["transfers"]
+        )
+        self._store.spill.base = base
+        self._store.base = base
+        self._hspill = spill_mod.HistorySpill(
+            self._forest.grooves["account_history"]
+        )
+        self._hspill.base = state["history_base"]
+    else:
+        assert base == 0, "spilled snapshot but no forest attached"
+
+    # Rebuild directories (derived state, never serialized).  Spilled
+    # ids stream back from the object tree once; sequential-id runs
+    # compress to O(1) ranges in the directories.
     n_acct = self._attrs.count
     self._acct_dir = RunIndex(_dir_capacity(n_acct))
     self._acct_dir.insert(
@@ -1539,9 +1823,17 @@ def _tpu_restore(self, data: bytes) -> None:
         np.arange(n_acct, dtype=np.uint64),
     )
     self._tdir = RunIndex(_dir_capacity(self._store.count))
+    if base:
+        from tigerbeetle_tpu.state_machine import spill as spill_mod
+
+        for rows, obj in self._store.spill.iter_objects():
+            cols = spill_mod.unpack_objects(obj)
+            self._tdir.insert(
+                cols["id_lo"], cols["id_hi"], rows.astype(np.uint64)
+            )
     self._tdir.insert(
         self._store.col("id_lo"), self._store.col("id_hi"),
-        np.arange(self._store.count, dtype=np.uint64),
+        np.arange(base, base + self._store.ram.count, dtype=np.uint64),
     )
 
     cap = max(1 << 12, 1 << (n_acct - 1).bit_length() if n_acct else 1)

@@ -582,7 +582,11 @@ class VsrReplica(Replica):
             elif client:
                 self._send_reply(entry.header, reply_body)
             del self.pipeline[op]
-            if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
+            if self.commit_min - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
+                # Deterministic checkpoint point: commit_min crosses the
+                # interval boundary at the same op on every replica, so
+                # spill bases and manifests are byte-identical cluster-wide
+                # (the convergence checkers compare snapshot bytes).
                 self.checkpoint()
             self._drain_request_queue()
 
@@ -865,7 +869,11 @@ class VsrReplica(Replica):
                 return
             self._commit_prepare(header, body)
             self.commit_parent = wire.u128(header, "checksum")
-            if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
+            if self.commit_min - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
+                # Deterministic checkpoint point: commit_min crosses the
+                # interval boundary at the same op on every replica, so
+                # spill bases and manifests are byte-identical cluster-wide
+                # (the convergence checkers compare snapshot bytes).
                 self.checkpoint()
         if self.op < self.commit_max and not self.is_primary:
             # Our log ends below the commit frontier (e.g. we rejoined
@@ -1114,6 +1122,58 @@ class VsrReplica(Replica):
     # (reference: src/vsr/sync.zig stage machine; Command
     # .request_sync_checkpoint/.sync_checkpoint).
 
+    def _sync_wrap(self, blob: bytes) -> bytes:
+        """With a forest attached, the snapshot's manifest references
+        grid blocks that exist only in OUR grid zone — ship them with
+        the blob so the syncing replica can install a working LSM tier
+        (reference: the sync target fetches missing grid blocks,
+        src/vsr/grid_blocks_missing.zig)."""
+        if self.forest is None:
+            return blob
+        from tigerbeetle_tpu.utils import snapshot as snapcodec
+
+        grid = self.forest.grid
+        live = (np.flatnonzero(~grid.free_set.free) + 1).astype(np.uint64)
+        raw = bytearray()
+        for addr in live:
+            raw += self.storage.read(grid._offset(int(addr)), grid.block_size)
+        return snapcodec.encode(
+            {
+                "snapshot": blob,
+                "addrs": live,
+                "blocks": bytes(raw),
+                "block_size": grid.block_size,
+            }
+        )
+
+    def _sync_unwrap(self, payload: bytes) -> bytes:
+        """Install shipped grid blocks (verified by address + length)
+        and return the inner snapshot blob."""
+        if self.forest is None:
+            return payload
+        from tigerbeetle_tpu.utils import snapshot as snapcodec
+
+        state = snapcodec.decode(payload)
+        grid = self.forest.grid
+        addrs = state["addrs"]
+        blocks = state["blocks"]
+        bs = int(state["block_size"])
+        if bs != grid.block_size or len(blocks) != len(addrs) * bs:
+            raise ValueError("sync payload block geometry mismatch")
+        for i, addr in enumerate(addrs):
+            addr = int(addr)
+            if not 1 <= addr <= grid.block_count:
+                raise ValueError("sync payload block address out of range")
+            self.storage.write(
+                grid._offset(addr), blocks[i * bs : (i + 1) * bs]
+            )
+        # Invalidate the block cache: shipped blocks replace anything
+        # read before the sync.
+        from tigerbeetle_tpu.utils.cache import SetAssociativeCache
+
+        grid._cache = SetAssociativeCache(capacity=256, ways=4)
+        return state["snapshot"]
+
     def _send_sync_checkpoint(self, dst: int) -> None:
         sb = self.superblock.working
         size = int(sb["checkpoint_size"])
@@ -1124,11 +1184,8 @@ class VsrReplica(Replica):
         if self._ticks - last < 4 * REPAIR_RETRY_TICKS:
             return
         self._sync_sent[dst] = self._ticks
-        blob = self._read_grid(int(sb["checkpoint_offset"]), size)
-        blob_checksum = (
-            int(sb["checkpoint_checksum_lo"])
-            | (int(sb["checkpoint_checksum_hi"]) << 64)
-        )
+        blob = self._sync_wrap(self._read_grid(int(sb["checkpoint_offset"]), size))
+        blob_checksum = wire.checksum(blob)
         commit_min_checksum = (
             int(sb["commit_min_checksum_lo"])
             | (int(sb["commit_min_checksum_hi"]) << 64)
@@ -1176,10 +1233,16 @@ class VsrReplica(Replica):
             blob_checksum, int(header["commit"]),
         )
 
-    def _install_sync_checkpoint(self, blob: bytes, checkpoint_op: int,
+    def _install_sync_checkpoint(self, payload: bytes, checkpoint_op: int,
                                  commit_min_checksum: int, blob_checksum: int,
                                  remote_commit: int) -> None:
         assert checkpoint_op > self.commit_min  # guarded at receive
+        # Shipped grid blocks must land BEFORE restore: restoring a
+        # spilled snapshot reads the LSM tier to rebuild directories.
+        try:
+            blob = self._sync_unwrap(payload)
+        except Exception:
+            return  # malformed payload from peer: drop, retry later
         self._restore_snapshot(blob)
         self.sm.prepare_timestamp = self.sm.commit_timestamp
 
@@ -1193,7 +1256,7 @@ class VsrReplica(Replica):
             commit_max=max(self.commit_max, remote_commit),
             checkpoint_offset=offset,
             checkpoint_size=len(blob),
-            checkpoint_checksum=blob_checksum,
+            checkpoint_checksum=wire.checksum(blob),
             view=self.view,
         )
         self.checkpoint_op = checkpoint_op

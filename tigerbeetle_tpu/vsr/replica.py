@@ -42,6 +42,13 @@ def format(storage: Storage, cluster: int, replica: int = 0,
     journal.write_prepare(wire.root_prepare(cluster), b"")
 
 
+# Fixed A/B checkpoint-snapshot reservation when an LSM forest shares
+# the grid zone (spilling bounds the blob well below this; asserted at
+# checkpoint).  Without a forest the regions size dynamically as before.
+SNAPSHOT_SPAN = 1 << 28
+FOREST_BLOCK_COUNT = 1 << 12
+
+
 @dataclasses.dataclass
 class Session:
     """Client session entry (reference: src/vsr/client_sessions.zig)."""
@@ -69,6 +76,23 @@ class Replica:
 
         self.superblock = SuperBlock(storage, cluster)
         self.journal = Journal(storage, cluster)
+
+        # LSM forest over the grid zone's block region (state machines
+        # that support it spill frozen state there, so checkpoints stay
+        # O(RAM tail) and durable state scales past host RAM —
+        # reference: src/lsm/forest.zig:31).  The A/B snapshot regions
+        # get a fixed reservation ahead of the block region; the file
+        # is sparse, so unused reservation costs nothing on disk.
+        self.forest = None
+        if hasattr(state_machine, "attach_forest"):
+            from tigerbeetle_tpu.lsm.forest import Forest
+
+            self.forest = Forest(
+                storage,
+                base_offset=storage.layout.grid_offset + 2 * SNAPSHOT_SPAN,
+                block_count=FOREST_BLOCK_COUNT,
+            )
+            state_machine.attach_forest(self.forest)
 
         self.op = 0                  # highest prepared op
         self.commit_min = 0          # highest committed op
@@ -387,6 +411,11 @@ class Replica:
             # often as checkpoints (reference: src/aof.zig fsyncs).
             self.aof.sync()
 
+        if self.forest is not None:
+            # Spill frozen state into LSM grid blocks first so the
+            # snapshot blob covers only the RAM tail (O(delta)).
+            self.sm.checkpoint_spill()
+
         blob = self._take_snapshot()
         region = int(self.superblock.working["sequence"]) % 2
         offset = self._grid_region_offset(region, len(blob))
@@ -405,6 +434,11 @@ class Replica:
         self.checkpoint_op = self.commit_min
 
     def _grid_region_offset(self, region: int, blob_len: int) -> int:
+        if self.forest is not None:
+            # Fixed reservation: the forest's block region starts at
+            # 2 * SNAPSHOT_SPAN (spilling keeps blobs bounded).
+            assert blob_len <= SNAPSHOT_SPAN, "snapshot exceeds reservation"
+            return self.storage.layout.grid_offset + region * SNAPSHOT_SPAN
         # Region B starts past the largest blob either region has held;
         # sized live from the current blob and the previous checkpoint.
         prev = int(self.superblock.working["checkpoint_size"])

@@ -161,41 +161,64 @@ class MemoryStorage(Storage):
       errors.
     """
 
+    _PAGE = 1 << 16
+
     def __init__(self, layout: ZoneLayout, seed: int = 0,
                  p_lose_unsynced: float = 1.0) -> None:
         self.layout = layout
-        self._data = bytearray(layout.total_size)
-        self._synced = bytearray(layout.total_size)
+        # Page-sparse images: only written pages materialize, so large
+        # reserved regions (snapshot spans, the forest block zone) cost
+        # nothing — mirroring a sparse file on a real filesystem.
+        self._pages: dict[int, bytearray] = {}      # current contents
+        self._spages: dict[int, bytearray] = {}     # last-synced contents
         self._dirty: set[int] = set()  # dirty sector indices
         self._rng = np.random.default_rng(seed)
         self._p_lose = p_lose_unsynced
         self.reads = 0
         self.writes = 0
 
-    def _grow(self, need: int) -> None:
-        if need > len(self._data):
-            extra = _sectors(need) - len(self._data)
-            self._data.extend(bytes(extra))
-            self._synced.extend(bytes(extra))
+    def _read_range(self, pages: dict, offset: int, size: int) -> bytes:
+        out = bytearray(size)
+        at = 0
+        while at < size:
+            pi, po = divmod(offset + at, self._PAGE)
+            n = min(self._PAGE - po, size - at)
+            page = pages.get(pi)
+            if page is not None:
+                out[at : at + n] = page[po : po + n]
+            at += n
+        return bytes(out)
+
+    def _write_range(self, pages: dict, offset: int, data) -> None:
+        at = 0
+        size = len(data)
+        while at < size:
+            pi, po = divmod(offset + at, self._PAGE)
+            n = min(self._PAGE - po, size - at)
+            page = pages.get(pi)
+            if page is None:
+                page = pages.setdefault(pi, bytearray(self._PAGE))
+            page[po : po + n] = data[at : at + n]
+            at += n
 
     def read(self, offset: int, size: int) -> bytes:
         self._check(offset, size)
-        self._grow(offset + size)
         self.reads += 1
-        return bytes(self._data[offset : offset + size])
+        return self._read_range(self._pages, offset, size)
 
     def write(self, offset: int, data: bytes) -> None:
         self._check(offset, len(data))
-        self._grow(offset + len(data))
         self.writes += 1
-        self._data[offset : offset + len(data)] = data
+        self._write_range(self._pages, offset, data)
         for s in range(offset // SECTOR_SIZE, (offset + len(data)) // SECTOR_SIZE):
             self._dirty.add(s)
 
     def sync(self) -> None:
         for s in self._dirty:
             off = s * SECTOR_SIZE
-            self._synced[off : off + SECTOR_SIZE] = self._data[off : off + SECTOR_SIZE]
+            self._write_range(
+                self._spages, off, self._read_range(self._pages, off, SECTOR_SIZE)
+            )
         self._dirty.clear()
 
     def crash(self) -> None:
@@ -204,17 +227,19 @@ class MemoryStorage(Storage):
         for s in self._dirty:
             off = s * SECTOR_SIZE
             if self._rng.random() < self._p_lose:
-                self._data[off : off + SECTOR_SIZE] = self._synced[
-                    off : off + SECTOR_SIZE
-                ]
+                self._write_range(
+                    self._pages, off,
+                    self._read_range(self._spages, off, SECTOR_SIZE),
+                )
             else:
-                self._synced[off : off + SECTOR_SIZE] = self._data[
-                    off : off + SECTOR_SIZE
-                ]
+                self._write_range(
+                    self._spages, off,
+                    self._read_range(self._pages, off, SECTOR_SIZE),
+                )
         self._dirty.clear()
 
     def corrupt_sector(self, offset: int) -> None:
         off = offset // SECTOR_SIZE * SECTOR_SIZE
         noise = self._rng.integers(0, 256, SECTOR_SIZE, np.uint8).tobytes()
-        self._data[off : off + SECTOR_SIZE] = noise
-        self._synced[off : off + SECTOR_SIZE] = noise
+        self._write_range(self._pages, off, noise)
+        self._write_range(self._spages, off, noise)
