@@ -1,16 +1,23 @@
 """Forest: owns every groove's trees; open/compact/checkpoint.
 
 reference: src/lsm/forest.zig:31,324,375,547 — the forest opens from
-the manifest, paces compaction, and checkpoints all trees plus the
-free set.  Manifests serialize through the fixed-layout snapshot codec
-(utils/snapshot.py) into the replica's checkpoint blob (recovery
-between checkpoints is WAL replay, so the blob is the durable boundary,
-reference-equivalent at checkpoint granularity).
+the manifest log, paces compaction, and checkpoints all trees plus the
+free set.  Run/block metadata persists through the append-only,
+self-compacting manifest LOG in grid blocks (lsm/manifest_log.py;
+reference: src/lsm/manifest_log.zig): each checkpoint appends only the
+run add/remove events since the last one, so checkpoint cost is
+O(delta) even when the forest holds millions of blocks.  The checkpoint
+blob carries just the log's block addresses, any unflushed tail
+events, per-tree memtable batches, and the free set (snapshot codec —
+no pickle anywhere in the durable path).
 """
 
 from __future__ import annotations
 
+import numpy as np
+
 from tigerbeetle_tpu.lsm.groove import Groove
+from tigerbeetle_tpu.lsm.manifest_log import ManifestLog
 from tigerbeetle_tpu.utils import snapshot as snapcodec
 from tigerbeetle_tpu.vsr.free_set import FreeSet
 from tigerbeetle_tpu.vsr.grid import Grid
@@ -27,6 +34,11 @@ class Forest:
         )
         self.memtable_max = memtable_max
         self.grooves: dict[str, Groove] = {}
+        self.mlog = ManifestLog(self.grid)
+        # tree_id -> Tree, assigned in groove-creation order (stable
+        # across restarts because grooves are re-declared identically
+        # before open()).
+        self._trees: list = []
 
     def groove(self, name: str, *, object_size: int,
                index_fields: list[str], index_value_size: int = 1) -> Groove:
@@ -37,6 +49,10 @@ class Forest:
             index_value_size=index_value_size,
         )
         self.grooves[name] = g
+        for tree in (g.id_tree, g.object_tree, *g.indexes.values()):
+            tree.tree_id = len(self._trees)
+            tree.mlog = self.mlog
+            self._trees.append(tree)
         return g
 
     def compact(self) -> None:
@@ -44,24 +60,31 @@ class Forest:
             g.maybe_seal()
 
     def manifest_blob(self) -> bytes:
-        """Pure snapshot of the forest's manifests + free set (includes
-        unsealed memtable batches; mutates nothing)."""
+        """Pure snapshot: log addresses + unflushed tail + memtable
+        batches + free set.  Mutates nothing (mid-interval snapshots
+        and the convergence checkers call this between checkpoints)."""
         return snapcodec.encode_tree(
             {
-                "grooves": {n: g.manifest() for n, g in self.grooves.items()},
+                "log_addrs": np.array(self.mlog.blocks, np.uint64),
+                "log_tail": self.mlog.tail_bytes(),
+                "memtables": {
+                    str(t.tree_id): t.memtable_manifest()
+                    for t in self._trees
+                },
                 "free_set": self.grid.free_set.encode(),
                 "block_count": self.grid.block_count,
             }
         )
 
     def checkpoint(self) -> bytes:
-        """Seal all memtables, release staged blocks, and return the
-        manifest+free-set blob for the superblock-referenced snapshot."""
-        for g in self.grooves.values():
-            g.id_tree.seal_memtable()
-            g.object_tree.seal_memtable()
-            for t in g.indexes.values():
-                t.seal_memtable()
+        """Seal all memtables, flush+compact the manifest log, release
+        staged blocks, and return the checkpoint blob."""
+        for tree in self._trees:
+            tree.seal_memtable()
+        # Log flush acquires blocks BEFORE staged releases activate, so
+        # blocks referenced by the previous superblock are never
+        # overwritten inside this checkpoint's crash window.
+        self.mlog.checkpoint()
         self.grid.free_set.checkpoint()
         return self.manifest_blob()
 
@@ -70,5 +93,13 @@ class Forest:
         self.grid.free_set = FreeSet.decode(
             state["free_set"], state["block_count"]
         )
-        for name, manifest in state["grooves"].items():
-            self.grooves[name].restore(manifest)
+        runs = self.mlog.open(
+            [int(a) for a in state["log_addrs"]], state["log_tail"]
+        )
+        per_tree: dict[int, dict] = {}
+        for (tree_id, level, run_id), refs in runs.items():
+            per_tree.setdefault(tree_id, {})[(level, run_id)] = refs
+        memtables = state.get("memtables", {})
+        for tree in self._trees:
+            tree.restore_runs(per_tree.get(tree.tree_id, {}))
+            tree.restore_memtable(memtables.get(str(tree.tree_id), {}))

@@ -59,10 +59,12 @@ class Groove:
             ts.astype("<u8").view("V8"),
         )
         self.object_tree.put_batch(_ts_keys(ts), objects)
-        ones = np.zeros((n, 1), np.uint8)
         for field, values in index_values.items():
             keys = pack_u128(ts, np.asarray(values, np.uint64))
-            self.indexes[field].put_batch(keys, ones)
+            tree = self.indexes[field]
+            # Entry payload sized to the tree (presence-only by
+            # default; 8-byte row pointers for the spill tier).
+            tree.put_batch(keys, np.zeros((n, tree.value_size), np.uint8))
         self.maybe_seal()
 
     def remove_index_batch(self, field: str, values, timestamps) -> None:
@@ -114,17 +116,6 @@ class Groove:
         for tree in self.indexes.values():
             tree.maybe_seal()
 
-    # ------------------------------------------------------------------
-
-    def manifest(self) -> dict:
-        return {
-            "id": self.id_tree.manifest(),
-            "object": self.object_tree.manifest(),
-            "indexes": {f: t.manifest() for f, t in self.indexes.items()},
-        }
-
-    def restore(self, manifest: dict) -> None:
-        self.id_tree.restore(manifest["id"])
-        self.object_tree.restore(manifest["object"])
-        for field, t in self.indexes.items():
-            t.restore(manifest["indexes"][field])
+    # Run/block persistence lives in the forest's manifest log
+    # (lsm/manifest_log.py); memtables ride the checkpoint blob via
+    # Tree.memtable_manifest/restore_memtable.

@@ -41,6 +41,7 @@ class RunBlock:
 @dataclasses.dataclass
 class Run:
     blocks: list[RunBlock]
+    id: int = 0  # tree-scoped creation counter (manifest-log identity)
 
     @property
     def count(self) -> int:
@@ -63,6 +64,12 @@ class Tree:
         self.value_size = value_size
         self.value_dtype = np.dtype(f"V{value_size}")
         self.memtable_max = memtable_max
+        # Manifest-log wiring (set by the forest): run add/remove
+        # events append to the shared log instead of full-manifest
+        # rewrites (reference: src/lsm/manifest_log.zig).
+        self.tree_id = 0
+        self.mlog = None
+        self._next_run_id = 0
         # Memtable: list of individually-sorted columnar batches
         # (keys KEY_DTYPE, flags u8, values (n, value_size) u8), newest
         # LAST.  Vectorized throughout — one put_batch is one argsort,
@@ -96,6 +103,10 @@ class Tree:
     def put_batch(self, keys: np.ndarray, values: np.ndarray) -> None:
         values = np.ascontiguousarray(values).view(np.uint8).reshape(
             len(keys), -1
+        )
+        assert values.shape[1] == self.value_size, (
+            f"{self.name}: value width {values.shape[1]} != "
+            f"value_size {self.value_size}"
         )
         self._push_batch(
             np.asarray(keys, KEY_DTYPE), np.zeros(len(keys), np.uint8), values
@@ -244,9 +255,23 @@ class Tree:
         )
         self.memtable.clear()
         self.memtable_count = 0
-        run = self._write_run(keys, flags, vals)
+        run = self._new_run(keys, flags, vals, level=0)
         self.levels[0].append(run)
         self.compact()
+
+    def _new_run(self, keys, flags, vals, *, level: int) -> Run:
+        run = self._write_run(keys, flags, vals)
+        run.id = self._next_run_id
+        self._next_run_id += 1
+        if self.mlog is not None:
+            self.mlog.run_add(
+                self.tree_id, level, run.id,
+                [
+                    (b.address, b.count, b.key_min, b.key_max)
+                    for b in run.blocks
+                ],
+            )
+        return run
 
     def _write_run(self, keys, flags, vals) -> Run:
         per_block = (self.grid.payload_size - 4) // _entry_size(self.value_size)
@@ -299,11 +324,17 @@ class Tree:
             if drop_tombstones:
                 live = flags == 0
                 keys, flags, vals = keys[live], flags[live], vals[live]
+            if self.mlog is not None:
+                for lvl in (level, level + 1):
+                    for run in self.levels[lvl]:
+                        self.mlog.run_remove(self.tree_id, lvl, run.id)
             for run in self.levels[level] + self.levels[level + 1]:
                 self._release_run(run)
             self.levels[level] = []
             self.levels[level + 1] = (
-                [self._write_run(keys, flags, vals)] if len(keys) else []
+                [self._new_run(keys, flags, vals, level=level + 1)]
+                if len(keys)
+                else []
             )
 
     def _read_run_all(self, run: Run):
@@ -317,26 +348,10 @@ class Tree:
     # ------------------------------------------------------------------
     # Manifest (persisted inside the checkpoint blob).
 
-    def manifest(self) -> dict:
-        """Fixed-layout manifest: parallel arrays over all blocks (level
-        + run index recover the nesting) + memtable batches.  Snapshot-
-        codec friendly — no pickle anywhere in the durable path."""
-        blocks = []
-        for level, runs in enumerate(self.levels):
-            for run_idx, run in enumerate(runs):
-                for b in run.blocks:
-                    blocks.append((level, run_idx, b))
-        nb = len(blocks)
-        man = {
-            "level": np.array([t[0] for t in blocks], np.uint8),
-            "run": np.array([t[1] for t in blocks], np.uint32),
-            "addr": np.array([t[2].address for t in blocks], np.uint64),
-            "count": np.array([t[2].count for t in blocks], np.uint64),
-            "kmin": np.array([t[2].key_min for t in blocks], KEY_DTYPE)
-            if nb else np.zeros(0, KEY_DTYPE),
-            "kmax": np.array([t[2].key_max for t in blocks], KEY_DTYPE)
-            if nb else np.zeros(0, KEY_DTYPE),
-        }
+    def memtable_manifest(self) -> dict:
+        """Memtable batches only — run/block state lives in the
+        manifest log (lsm/manifest_log.py), not here."""
+        man = {}
         if self.memtable:
             man["mt_keys"] = np.concatenate([b[0] for b in self.memtable])
             man["mt_flags"] = np.concatenate([b[1] for b in self.memtable])
@@ -346,24 +361,7 @@ class Tree:
             )
         return man
 
-    def restore(self, manifest: dict) -> None:
-        self.levels = [[] for _ in range(LEVELS)]
-        level = np.asarray(manifest["level"])
-        run_of = np.asarray(manifest["run"])
-        kmin = np.asarray(manifest["kmin"]).astype(KEY_DTYPE, copy=False)
-        kmax = np.asarray(manifest["kmax"]).astype(KEY_DTYPE, copy=False)
-        for i in range(len(level)):
-            runs = self.levels[int(level[i])]
-            while len(runs) <= int(run_of[i]):
-                runs.append(Run(blocks=[]))
-            runs[int(run_of[i])].blocks.append(
-                RunBlock(
-                    address=int(manifest["addr"][i]),
-                    count=int(manifest["count"][i]),
-                    key_min=kmin[i].tobytes(),
-                    key_max=kmax[i].tobytes(),
-                )
-            )
+    def restore_memtable(self, manifest: dict) -> None:
         self.memtable = []
         self.memtable_count = 0
         if "mt_lens" in manifest and len(manifest["mt_lens"]):
@@ -378,6 +376,24 @@ class Tree:
                 )
                 at += n
             self.memtable_count = at
+
+    def restore_runs(self, runs: dict) -> None:
+        """runs: {(level, run_id): [(addr, count, kmin, kmax), ...]}
+        from the manifest-log replay.  Run order within a level is
+        run_id order (creation order == newest last)."""
+        self.levels = [[] for _ in range(LEVELS)]
+        next_id = 0
+        for (level, run_id), refs in sorted(runs.items(), key=lambda kv: (kv[0][0], kv[0][1])):
+            blocks = [
+                RunBlock(
+                    address=int(addr), count=int(count),
+                    key_min=bytes(kmin), key_max=bytes(kmax),
+                )
+                for addr, count, kmin, kmax in refs
+            ]
+            self.levels[level].append(Run(blocks=blocks, id=run_id))
+            next_id = max(next_id, run_id + 1)
+        self._next_run_id = next_id
 
 
 # ----------------------------------------------------------------------
