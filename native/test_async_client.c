@@ -1,0 +1,261 @@
+// End-to-end C integration test for the asynchronous packet client
+// (tb_async.cpp) — the analog of the reference's C client sample +
+// tb_client integration tests (reference: src/clients/c/tb_client.zig,
+// samples).  Driven by tests/test_async_client.py against a live
+// in-process server:   ./test_async_client <port>
+//
+// Exercises:
+//  1. create_accounts packet completes OK with an empty result set;
+//  2. THREE packets in flight at once (two create_transfers and one
+//     lookup_accounts submitted while paused) — the two create packets
+//     coalesce into ONE wire request and complete BEFORE the lookup
+//     that was submitted between them: out-of-order completion;
+//  3. per-packet demux re-bases failure indices (a failing transfer in
+//     the second create packet reports index 0, not its batch offset);
+//  4. lookup replies carry the expected balances;
+//  5. invalid operation fails synchronously without touching the wire.
+//
+// Exits 0 on success; prints the failing check and exits 1 otherwise.
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <pthread.h>
+
+#include "tb_client.h"
+
+#define CHECK(cond, ...)                                        \
+    do {                                                        \
+        if (!(cond)) {                                          \
+            fprintf(stderr, "FAIL %s:%d: ", __FILE__, __LINE__); \
+            fprintf(stderr, __VA_ARGS__);                       \
+            fprintf(stderr, "\n");                              \
+            exit(1);                                            \
+        }                                                       \
+    } while (0)
+
+// 128-byte wire layouts (tigerbeetle_tpu/types.py; reference:
+// src/tigerbeetle.zig:7-111).
+#pragma pack(push, 1)
+typedef struct {
+    uint64_t id_lo, id_hi;
+    uint64_t debits_pending_lo, debits_pending_hi;
+    uint64_t debits_posted_lo, debits_posted_hi;
+    uint64_t credits_pending_lo, credits_pending_hi;
+    uint64_t credits_posted_lo, credits_posted_hi;
+    uint64_t user_data_128_lo, user_data_128_hi;
+    uint64_t user_data_64;
+    uint32_t user_data_32;
+    uint32_t reserved;
+    uint32_t ledger;
+    uint16_t code;
+    uint16_t flags;
+    uint64_t timestamp;
+} wire_account_t;
+
+typedef struct {
+    uint64_t id_lo, id_hi;
+    uint64_t debit_account_id_lo, debit_account_id_hi;
+    uint64_t credit_account_id_lo, credit_account_id_hi;
+    uint64_t amount_lo, amount_hi;
+    uint64_t pending_id_lo, pending_id_hi;
+    uint64_t user_data_128_lo, user_data_128_hi;
+    uint64_t user_data_64;
+    uint32_t user_data_32;
+    uint32_t timeout;
+    uint32_t ledger;
+    uint16_t code;
+    uint16_t flags;
+    uint64_t timestamp;
+} wire_transfer_t;
+
+typedef struct {
+    uint32_t index;
+    uint32_t result;
+} wire_create_result_t;
+#pragma pack(pop)
+
+// Completion log: order + per-packet reply copies, cross-thread.
+typedef struct {
+    pthread_mutex_t mu;
+    pthread_cond_t cv;
+    int order[16];        // packet tags in completion order
+    int statuses[16];
+    uint8_t replies[16][4096];
+    uint32_t reply_lens[16];
+    int completed;
+} harness_t;
+
+static void on_completion(void* ctx, tb_packet_t* packet,
+                          const uint8_t* reply, uint32_t reply_len) {
+    harness_t* h = (harness_t*)ctx;
+    int tag = (int)(intptr_t)packet->user_data;
+    pthread_mutex_lock(&h->mu);
+    h->order[h->completed] = tag;
+    h->statuses[tag] = packet->status;
+    if (reply && reply_len <= sizeof(h->replies[0])) {
+        memcpy(h->replies[tag], reply, reply_len);
+        h->reply_lens[tag] = reply_len;
+    } else {
+        h->reply_lens[tag] = 0;
+    }
+    h->completed++;
+    pthread_cond_broadcast(&h->cv);
+    pthread_mutex_unlock(&h->mu);
+}
+
+static void wait_completed(harness_t* h, int n) {
+    pthread_mutex_lock(&h->mu);
+    while (h->completed < n) pthread_cond_wait(&h->cv, &h->mu);
+    pthread_mutex_unlock(&h->mu);
+}
+
+static int pos_of(harness_t* h, int tag) {
+    for (int i = 0; i < h->completed; i++)
+        if (h->order[i] == tag) return i;
+    return -1;
+}
+
+int main(int argc, char** argv) {
+    CHECK(argc == 2, "usage: test_async_client <port>");
+    uint16_t port = (uint16_t)atoi(argv[1]);
+
+    harness_t h;
+    memset(&h, 0, sizeof(h));
+    pthread_mutex_init(&h.mu, NULL);
+    pthread_cond_init(&h.cv, NULL);
+
+    tb_async_client_t* c =
+        tb_async_init("127.0.0.1", port, 3, 0xC0FFEE, 0, on_completion, &h);
+    CHECK(c != NULL, "tb_async_init");
+
+    // --- 1. create_accounts -----------------------------------------
+    wire_account_t accounts[2];
+    memset(accounts, 0, sizeof(accounts));
+    accounts[0].id_lo = 1;
+    accounts[0].ledger = 1;
+    accounts[0].code = 1;
+    accounts[1].id_lo = 2;
+    accounts[1].ledger = 1;
+    accounts[1].code = 1;
+
+    tb_packet_t p_acct;
+    memset(&p_acct, 0, sizeof(p_acct));
+    p_acct.user_data = (void*)(intptr_t)0;
+    p_acct.operation = TB_OPERATION_CREATE_ACCOUNTS;
+    p_acct.data = accounts;
+    p_acct.data_size = sizeof(accounts);
+    CHECK(tb_async_submit(c, &p_acct) == 0, "submit accounts");
+    wait_completed(&h, 1);
+    CHECK(h.statuses[0] == TB_PACKET_OK, "accounts status %d", h.statuses[0]);
+    CHECK(h.reply_lens[0] == 0, "accounts should all succeed (len %u)",
+          h.reply_lens[0]);
+
+    // --- 2-3. paused fan-out: T1, LOOKUP, T2 ------------------------
+    // While paused, submit create packet T1, then a lookup, then
+    // create packet T2 (whose second transfer is invalid: same debit
+    // and credit account).  On resume, T1+T2 coalesce into one request
+    // ahead of the lookup, so T2 completes before the lookup despite
+    // being submitted after it.
+    wire_transfer_t t1[2];
+    memset(t1, 0, sizeof(t1));
+    for (int i = 0; i < 2; i++) {
+        t1[i].id_lo = 100 + (uint64_t)i;
+        t1[i].debit_account_id_lo = 1;
+        t1[i].credit_account_id_lo = 2;
+        t1[i].amount_lo = 10;
+        t1[i].ledger = 1;
+        t1[i].code = 1;
+    }
+    wire_transfer_t t2[2];
+    memset(t2, 0, sizeof(t2));
+    t2[0].id_lo = 200;
+    t2[0].debit_account_id_lo = 2;
+    t2[0].credit_account_id_lo = 1;
+    t2[0].amount_lo = 5;
+    t2[0].ledger = 1;
+    t2[0].code = 1;
+    t2[1].id_lo = 201;  // accounts_must_be_different => result 12
+    t2[1].debit_account_id_lo = 1;
+    t2[1].credit_account_id_lo = 1;
+    t2[1].amount_lo = 5;
+    t2[1].ledger = 1;
+    t2[1].code = 1;
+
+    struct {
+        uint64_t lo, hi;
+    } lookup_ids[2] = {{1, 0}, {2, 0}};
+
+    tb_packet_t p_t1, p_lookup, p_t2;
+    memset(&p_t1, 0, sizeof(p_t1));
+    p_t1.user_data = (void*)(intptr_t)1;
+    p_t1.operation = TB_OPERATION_CREATE_TRANSFERS;
+    p_t1.data = t1;
+    p_t1.data_size = sizeof(t1);
+    memset(&p_lookup, 0, sizeof(p_lookup));
+    p_lookup.user_data = (void*)(intptr_t)2;
+    p_lookup.operation = TB_OPERATION_LOOKUP_ACCOUNTS;
+    p_lookup.data = lookup_ids;
+    p_lookup.data_size = sizeof(lookup_ids);
+    memset(&p_t2, 0, sizeof(p_t2));
+    p_t2.user_data = (void*)(intptr_t)3;
+    p_t2.operation = TB_OPERATION_CREATE_TRANSFERS;
+    p_t2.data = t2;
+    p_t2.data_size = sizeof(t2);
+
+    tb_async_pause(c);
+    CHECK(tb_async_submit(c, &p_t1) == 0, "submit t1");
+    CHECK(tb_async_submit(c, &p_lookup) == 0, "submit lookup");
+    CHECK(tb_async_submit(c, &p_t2) == 0, "submit t2");
+    tb_async_resume(c);
+    wait_completed(&h, 4);
+
+    CHECK(h.statuses[1] == TB_PACKET_OK, "t1 status %d", h.statuses[1]);
+    CHECK(h.statuses[2] == TB_PACKET_OK, "lookup status %d", h.statuses[2]);
+    CHECK(h.statuses[3] == TB_PACKET_OK, "t2 status %d", h.statuses[3]);
+
+    // Out-of-order completion: t2 (submitted last) completed before
+    // the lookup (submitted second) by riding t1's request.
+    CHECK(pos_of(&h, 3) < pos_of(&h, 2),
+          "t2 should complete before lookup (order: t1=%d lookup=%d t2=%d)",
+          pos_of(&h, 1), pos_of(&h, 2), pos_of(&h, 3));
+
+    // t1: no failures.  t2: exactly one failure, re-based to index 1.
+    CHECK(h.reply_lens[1] == 0, "t1 failures (%u bytes)", h.reply_lens[1]);
+    CHECK(h.reply_lens[3] == sizeof(wire_create_result_t),
+          "t2 failure count (%u bytes)", h.reply_lens[3]);
+    wire_create_result_t r;
+    memcpy(&r, h.replies[3], sizeof(r));
+    CHECK(r.index == 1, "t2 failure index %u (demux re-base)", r.index);
+    CHECK(r.result == 12, "t2 failure result %u", r.result);
+
+    // --- 4. lookup balances: 1 posted 2x10 debit, 5 credit ----------
+    CHECK(h.reply_lens[2] == 2 * sizeof(wire_account_t), "lookup rows %u",
+          h.reply_lens[2]);
+    wire_account_t rows[2];
+    memcpy(rows, h.replies[2], sizeof(rows));
+    CHECK(rows[0].id_lo == 1 && rows[1].id_lo == 2, "lookup row ids");
+    CHECK(rows[0].debits_posted_lo == 20, "acct1 debits %llu",
+          (unsigned long long)rows[0].debits_posted_lo);
+    CHECK(rows[0].credits_posted_lo == 5, "acct1 credits %llu",
+          (unsigned long long)rows[0].credits_posted_lo);
+    CHECK(rows[1].debits_posted_lo == 5, "acct2 debits %llu",
+          (unsigned long long)rows[1].debits_posted_lo);
+    CHECK(rows[1].credits_posted_lo == 20, "acct2 credits %llu",
+          (unsigned long long)rows[1].credits_posted_lo);
+
+    // --- 5. invalid operation fails synchronously -------------------
+    tb_packet_t p_bad;
+    memset(&p_bad, 0, sizeof(p_bad));
+    p_bad.user_data = (void*)(intptr_t)4;
+    p_bad.operation = 77;
+    CHECK(tb_async_submit(c, &p_bad) == -1, "bad op should reject");
+    CHECK(p_bad.status == TB_PACKET_INVALID_OPERATION, "bad op status %d",
+          p_bad.status);
+
+    tb_async_deinit(c);
+    printf("async client ok: 5 packets, out-of-order completion verified\n");
+    return 0;
+}

@@ -1,0 +1,98 @@
+"""End-to-end test of the async packet-based C client (tb_async.cpp).
+
+Spawns the Python replica server in-process, compiles the C test
+program (native/test_async_client.c) against the native runtime
+library, and runs it as a real foreign-language client over TCP —
+the same shape as the reference's per-language client integration
+tests (reference: src/integration_tests.zig, src/scripts/ci.zig
+spawning a server per language client).
+"""
+
+import os
+import shutil
+import subprocess
+import threading
+
+import pytest
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu.runtime.native import native_available
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native runtime not built"
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+CLUSTER = 3
+
+
+class ServerFixture:
+    def __init__(self, tmp_path):
+        from tigerbeetle_tpu.runtime.server import (
+            ReplicaServer,
+            format_data_file,
+        )
+
+        config = cfg.TEST_MIN
+        path = str(tmp_path / "data.tigerbeetle")
+        format_data_file(path, cluster=CLUSTER, config=config)
+        self.server = ReplicaServer(
+            path, cluster=CLUSTER, addresses=["127.0.0.1:0"],
+            replica_index=0,
+            state_machine_factory=lambda: CpuStateMachine(config),
+            config=config,
+        )
+        self.port = self.server.port
+        self._stop = False
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            self.server.poll_once(timeout_ms=1)
+
+    def close(self):
+        self._stop = True
+        self.thread.join(timeout=5)
+        self.server.close()
+
+
+@pytest.fixture
+def server(tmp_path):
+    f = ServerFixture(tmp_path)
+    yield f
+    f.close()
+
+
+@pytest.fixture(scope="module")
+def test_binary(tmp_path_factory):
+    cc = shutil.which("gcc") or shutil.which("cc") or shutil.which("g++")
+    if cc is None:
+        pytest.skip("no C compiler")
+    out = str(tmp_path_factory.mktemp("cbin") / "test_async_client")
+    subprocess.run(
+        [
+            cc, "-O2", "-o", out,
+            os.path.join(NATIVE, "test_async_client.c"),
+            "-I", NATIVE,
+            "-L", NATIVE, "-ltb_runtime",
+            f"-Wl,-rpath,{NATIVE}",
+            "-pthread",
+        ],
+        check=True, capture_output=True,
+    )
+    return out
+
+
+def test_async_c_client_end_to_end(server, test_binary):
+    proc = subprocess.run(
+        [test_binary, str(server.port)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, (
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+    )
+    assert "out-of-order completion verified" in proc.stdout

@@ -118,3 +118,27 @@ def test_mid_interval_snapshot_carries_tail():
         ids, np.zeros(3, np.uint64)
     )
     assert found.all()
+
+
+def test_oversized_run_splits_to_block_capacity():
+    """A run with more block refs than fit one grid block must split
+    into OP_ADD + continuation records sized from grid.payload_size —
+    regression: a fixed 1024-ref split crashed checkpoint on 4KiB
+    blocks."""
+    from tigerbeetle_tpu.lsm.manifest_log import ManifestLog
+    from tigerbeetle_tpu.vsr.grid import Grid
+
+    st = MemoryStorage(ZoneLayout(config=cfg.TEST_MIN, grid_size=1 << 22))
+    grid = Grid(st, block_size=4096, block_count=1 << 9)
+    mlog = ManifestLog(grid)
+    refs = [
+        (1000 + i, 7, b"\x01" * 16, b"\x02" * 16) for i in range(300)
+    ]
+    mlog.run_add(5, 0, 1, refs)
+    addresses = mlog.checkpoint()
+
+    replayed = ManifestLog(grid).open(addresses)
+    assert list(replayed.keys()) == [(5, 0, 1)]
+    got = replayed[(5, 0, 1)]
+    assert len(got) == 300
+    assert [r[0] for r in got] == [1000 + i for i in range(300)]

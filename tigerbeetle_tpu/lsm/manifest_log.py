@@ -35,14 +35,16 @@ OP_ADD = 1
 OP_REMOVE = 2
 OP_ADD_CONT = 3  # continuation: extends the refs of a prior OP_ADD
 
-# A single event record must fit one grid-block payload; runs with
-# more blocks split into OP_ADD + OP_ADD_CONT records.
-MAX_REFS_PER_EVENT = 1024
-
-
 class ManifestLog:
     def __init__(self, grid) -> None:
         self.grid = grid
+        # A single event record must fit one grid-block payload (4-byte
+        # record count + event head + refs); runs with more blocks
+        # split into OP_ADD + OP_ADD_CONT records.
+        self._refs_per_event = (
+            grid.payload_size - 4 - _EV_HEAD.size
+        ) // _BLOCK_REF.size
+        assert self._refs_per_event >= 1, grid.payload_size
         # Closed log blocks (addresses, oldest first).
         self.blocks: list[int] = []
         # Open tail: encoded event records not yet written to a block.
@@ -59,8 +61,8 @@ class ManifestLog:
             _BLOCK_REF.pack(addr, count, kmin, kmax)
             for addr, count, kmin, kmax in blocks
         ]
-        for at in range(0, max(len(refs), 1), MAX_REFS_PER_EVENT):
-            chunk = refs[at : at + MAX_REFS_PER_EVENT]
+        for at in range(0, max(len(refs), 1), self._refs_per_event):
+            chunk = refs[at : at + self._refs_per_event]
             op = OP_ADD if at == 0 else OP_ADD_CONT
             self._tail.append(
                 _EV_HEAD.pack(tree_id, op, level, run_id, len(chunk))
