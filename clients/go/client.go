@@ -1,0 +1,227 @@
+// Package tigerbeetle is a pure-Go client for the tigerbeetle_tpu
+// cluster: it speaks the TCP wire protocol directly (256-byte
+// checksummed headers, tigerbeetle_tpu/vsr/wire.py) with no cgo
+// dependency — the same role the reference's Go client fills over its
+// tb_client C ABI (reference: src/clients/go/).
+//
+// The client is a synchronous VSR session: it registers on first use,
+// keeps one request in flight, and relies on the server's
+// at-most-once session dedupe for safe retransmission.  For pipelined
+// multi-packet load use the native async client (native/tb_client.h)
+// via cgo.
+package tigerbeetle
+
+import (
+	"encoding/binary"
+	"fmt"
+	"net"
+	"time"
+)
+
+// BatchMax is the most events a single request may carry
+// (1 MiB message - 256 B header, 128 B per event; reference:
+// src/state_machine.zig:75-81).
+const BatchMax = (messageSizeMax - headerSize) / 128
+
+
+// ErrEvicted is returned once the cluster evicts this session; the
+// client must be re-created with a fresh ID.
+var ErrEvicted = fmt.Errorf("tigerbeetle: session evicted")
+
+// Client is one registered session against a cluster.  Not safe for
+// concurrent use; wrap with a mutex or use one Client per goroutine.
+type Client struct {
+	conn          net.Conn
+	cluster       uint64
+	clientID      [2]uint64
+	requestNumber uint32
+	registered    bool
+	evicted       bool
+	recv          []byte
+	Timeout       time.Duration // per-request deadline (default 30s)
+}
+
+// NewClient connects to `address` ("host:port") for `cluster`.
+// clientID must be unique per live session ([lo, hi] limbs of a u128).
+func NewClient(address string, cluster uint64, clientID [2]uint64) (*Client, error) {
+	conn, err := net.Dial("tcp", address)
+	if err != nil {
+		return nil, err
+	}
+	if tc, ok := conn.(*net.TCPConn); ok {
+		tc.SetNoDelay(true)
+	}
+	return &Client{
+		conn:     conn,
+		cluster:  cluster,
+		clientID: clientID,
+		Timeout:  30 * time.Second,
+	}, nil
+}
+
+func (c *Client) Close() error { return c.conn.Close() }
+
+// roundtrip sends one request and blocks for its reply body.
+func (c *Client) roundtrip(operation uint8, requestNumber uint32, body []byte) ([]byte, error) {
+	if c.evicted {
+		return nil, ErrEvicted
+	}
+	msg := buildRequest(c.cluster, c.clientID, requestNumber, operation, body)
+	deadline := time.Now().Add(c.Timeout)
+	c.conn.SetDeadline(deadline)
+	if _, err := c.conn.Write(msg); err != nil {
+		return nil, err
+	}
+	for {
+		reply, err := c.readMessage()
+		if err != nil {
+			return nil, err
+		}
+		h := reply[:headerSize]
+		if h[offCommand] == cmdEviction {
+			c.evicted = true
+			return nil, ErrEvicted
+		}
+		if h[offCommand] != cmdReply {
+			continue
+		}
+		if binary.LittleEndian.Uint32(h[offRequest:]) != requestNumber {
+			continue // stale duplicate
+		}
+		return reply[headerSize:], nil
+	}
+}
+
+// readMessage reads and verifies one framed message.
+func (c *Client) readMessage() ([]byte, error) {
+	for {
+		// Complete message already buffered?
+		if len(c.recv) >= headerSize {
+			size := binary.LittleEndian.Uint32(c.recv[offSize:])
+			if size < headerSize || size > messageSizeMax+headerSize {
+				return nil, fmt.Errorf("tigerbeetle: bad frame size %d", size)
+			}
+			if uint32(len(c.recv)) >= size {
+				msg := c.recv[:size]
+				c.recv = c.recv[size:]
+				if err := verifyMessage(msg); err != nil {
+					return nil, err
+				}
+				return msg, nil
+			}
+		}
+		buf := make([]byte, 1<<16)
+		n, err := c.conn.Read(buf)
+		if err != nil {
+			return nil, err
+		}
+		c.recv = append(c.recv, buf[:n]...)
+	}
+}
+
+func (c *Client) ensureRegistered() error {
+	if c.registered {
+		return nil
+	}
+	if _, err := c.roundtrip(opRegister, 0, nil); err != nil {
+		return err
+	}
+	c.registered = true
+	return nil
+}
+
+func (c *Client) request(operation uint8, body []byte) ([]byte, error) {
+	if err := c.ensureRegistered(); err != nil {
+		return nil, err
+	}
+	c.requestNumber++
+	return c.roundtrip(operation, c.requestNumber, body)
+}
+
+// CreateAccounts submits a batch; the result lists FAILURES only
+// (an empty slice means every account was created or already existed
+// identically).
+func (c *Client) CreateAccounts(accounts []Account) ([]CreateResult, error) {
+	if len(accounts) > BatchMax {
+		return nil, fmt.Errorf("tigerbeetle: batch exceeds %d events", BatchMax)
+	}
+	reply, err := c.request(uint8(OperationCreateAccounts), marshalAccounts(accounts))
+	if err != nil {
+		return nil, err
+	}
+	return unmarshalCreateResults(reply), nil
+}
+
+// CreateTransfers submits a batch; the result lists FAILURES only.
+func (c *Client) CreateTransfers(transfers []Transfer) ([]CreateResult, error) {
+	if len(transfers) > BatchMax {
+		return nil, fmt.Errorf("tigerbeetle: batch exceeds %d events", BatchMax)
+	}
+	reply, err := c.request(uint8(OperationCreateTransfers), marshalTransfers(transfers))
+	if err != nil {
+		return nil, err
+	}
+	return unmarshalCreateResults(reply), nil
+}
+
+// LookupAccounts returns the rows found (missing ids are omitted).
+func (c *Client) LookupAccounts(ids [][2]uint64) ([]Account, error) {
+	if len(ids) > BatchMax {
+		return nil, fmt.Errorf("tigerbeetle: batch exceeds %d events", BatchMax)
+	}
+	reply, err := c.request(uint8(OperationLookupAccounts), marshalIds(ids))
+	if err != nil {
+		return nil, err
+	}
+	out := make([]Account, len(reply)/accountSize)
+	for i := range out {
+		out[i] = unmarshalAccount(reply[i*accountSize:])
+	}
+	return out, nil
+}
+
+// LookupTransfers returns the rows found (missing ids are omitted).
+func (c *Client) LookupTransfers(ids [][2]uint64) ([]Transfer, error) {
+	if len(ids) > BatchMax {
+		return nil, fmt.Errorf("tigerbeetle: batch exceeds %d events", BatchMax)
+	}
+	reply, err := c.request(uint8(OperationLookupTransfers), marshalIds(ids))
+	if err != nil {
+		return nil, err
+	}
+	out := make([]Transfer, len(reply)/transferSize)
+	for i := range out {
+		out[i] = unmarshalTransfer(reply[i*transferSize:])
+	}
+	return out, nil
+}
+
+// GetAccountTransfers scans transfers touching filter.AccountId.
+func (c *Client) GetAccountTransfers(filter AccountFilter) ([]Transfer, error) {
+	reply, err := c.request(uint8(OperationGetAccountTransfers), marshalFilter(filter))
+	if err != nil {
+		return nil, err
+	}
+	out := make([]Transfer, len(reply)/transferSize)
+	for i := range out {
+		out[i] = unmarshalTransfer(reply[i*transferSize:])
+	}
+	return out, nil
+}
+
+// GetAccountBalances returns historical balances for an account with
+// the history flag.
+func (c *Client) GetAccountBalances(filter AccountFilter) ([]AccountBalance, error) {
+	reply, err := c.request(uint8(OperationGetAccountBalances), marshalFilter(filter))
+	if err != nil {
+		return nil, err
+	}
+	out := make([]AccountBalance, len(reply)/balanceSize)
+	for i := range out {
+		out[i] = unmarshalBalance(reply[i*balanceSize:])
+	}
+	return out, nil
+}
+
+// U128 builds a [lo, hi] id from a uint64.
+func U128(v uint64) [2]uint64 { return [2]uint64{v, 0} }

@@ -1,0 +1,90 @@
+// Integration test against a live server.  Run via
+// tests/test_foreign_clients.py (which spawns the server and sets
+// TB_ADDRESS / TB_CLUSTER), or by hand:
+//
+//	TB_ADDRESS=127.0.0.1:3000 TB_CLUSTER=3 go test ./...
+//
+// Skips when no server address is configured.
+package tigerbeetle
+
+import (
+	"os"
+	"strconv"
+	"testing"
+)
+
+func dial(t *testing.T) *Client {
+	addr := os.Getenv("TB_ADDRESS")
+	if addr == "" {
+		t.Skip("TB_ADDRESS not set")
+	}
+	cluster := uint64(0)
+	if s := os.Getenv("TB_CLUSTER"); s != "" {
+		v, err := strconv.ParseUint(s, 10, 64)
+		if err != nil {
+			t.Fatal(err)
+		}
+		cluster = v
+	}
+	c, err := NewClient(addr, cluster, U128(0xD0_60))
+	if err != nil {
+		t.Fatal(err)
+	}
+	return c
+}
+
+func TestEndToEnd(t *testing.T) {
+	c := dial(t)
+	defer c.Close()
+
+	res, err := c.CreateAccounts([]Account{
+		{Id: U128(9001), Ledger: 1, Code: 1},
+		{Id: U128(9002), Ledger: 1, Code: 1},
+	})
+	if err != nil {
+		t.Fatal(err)
+	}
+	if len(res) != 0 {
+		t.Fatalf("create_accounts failures: %+v", res)
+	}
+
+	res, err = c.CreateTransfers([]Transfer{
+		{Id: U128(99001), DebitAccountId: U128(9001),
+			CreditAccountId: U128(9002), Amount: U128(250),
+			Ledger: 1, Code: 1},
+		{Id: U128(99002), DebitAccountId: U128(9001),
+			CreditAccountId: U128(9001), Amount: U128(1),
+			Ledger: 1, Code: 1}, // accounts_must_be_different
+	})
+	if err != nil {
+		t.Fatal(err)
+	}
+	if len(res) != 1 || res[0].Index != 1 ||
+		res[0].Result != uint32(CreateTransferResultAccountsMustBeDifferent) {
+		t.Fatalf("expected one accounts_must_be_different failure, got %+v", res)
+	}
+
+	rows, err := c.LookupAccounts([][2]uint64{U128(9001), U128(9002)})
+	if err != nil {
+		t.Fatal(err)
+	}
+	if len(rows) != 2 {
+		t.Fatalf("lookup rows: %d", len(rows))
+	}
+	if rows[0].DebitsPosted != U128(250) || rows[1].CreditsPosted != U128(250) {
+		t.Fatalf("balances: %+v %+v", rows[0], rows[1])
+	}
+
+	transfers, err := c.GetAccountTransfers(AccountFilter{
+		AccountId:    U128(9001),
+		TimestampMax: 1<<63 - 1,
+		Limit:        10,
+		Flags:        AccountFilterFlagsDebits | AccountFilterFlagsCredits,
+	})
+	if err != nil {
+		t.Fatal(err)
+	}
+	if len(transfers) != 1 || transfers[0].Amount != U128(250) {
+		t.Fatalf("get_account_transfers: %+v", transfers)
+	}
+}

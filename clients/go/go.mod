@@ -1,0 +1,3 @@
+module github.com/tigerbeetle-tpu/tigerbeetle-go
+
+go 1.21

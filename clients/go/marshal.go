@@ -1,0 +1,151 @@
+// Binary marshaling of the 128-byte wire structs.
+//
+// Field offsets follow tigerbeetle_tpu/types.py (the single source of
+// truth, itself mirroring reference: src/tigerbeetle.zig:7-111); all
+// integers are little-endian and the layouts are tightly packed.
+package tigerbeetle
+
+import "encoding/binary"
+
+const (
+	accountSize       = 128
+	transferSize      = 128
+	balanceSize       = 128
+	filterSize        = 64
+	idPairSize        = 16
+	createResultSize  = 8
+)
+
+func putU128(b []byte, v [2]uint64) {
+	binary.LittleEndian.PutUint64(b, v[0])
+	binary.LittleEndian.PutUint64(b[8:], v[1])
+}
+
+func getU128(b []byte) [2]uint64 {
+	return [2]uint64{
+		binary.LittleEndian.Uint64(b),
+		binary.LittleEndian.Uint64(b[8:]),
+	}
+}
+
+func marshalAccounts(events []Account) []byte {
+	out := make([]byte, len(events)*accountSize)
+	for i := range events {
+		e := &events[i]
+		b := out[i*accountSize:]
+		putU128(b[0:], e.Id)
+		putU128(b[16:], e.DebitsPending)
+		putU128(b[32:], e.DebitsPosted)
+		putU128(b[48:], e.CreditsPending)
+		putU128(b[64:], e.CreditsPosted)
+		putU128(b[80:], e.UserData128)
+		binary.LittleEndian.PutUint64(b[96:], e.UserData64)
+		binary.LittleEndian.PutUint32(b[104:], e.UserData32)
+		binary.LittleEndian.PutUint32(b[108:], e.Reserved)
+		binary.LittleEndian.PutUint32(b[112:], e.Ledger)
+		binary.LittleEndian.PutUint16(b[116:], e.Code)
+		binary.LittleEndian.PutUint16(b[118:], uint16(e.Flags))
+		binary.LittleEndian.PutUint64(b[120:], e.Timestamp)
+	}
+	return out
+}
+
+func unmarshalAccount(b []byte) Account {
+	return Account{
+		Id:             getU128(b[0:]),
+		DebitsPending:  getU128(b[16:]),
+		DebitsPosted:   getU128(b[32:]),
+		CreditsPending: getU128(b[48:]),
+		CreditsPosted:  getU128(b[64:]),
+		UserData128:    getU128(b[80:]),
+		UserData64:     binary.LittleEndian.Uint64(b[96:]),
+		UserData32:     binary.LittleEndian.Uint32(b[104:]),
+		Reserved:       binary.LittleEndian.Uint32(b[108:]),
+		Ledger:         binary.LittleEndian.Uint32(b[112:]),
+		Code:           binary.LittleEndian.Uint16(b[116:]),
+		Flags:          AccountFlags(binary.LittleEndian.Uint16(b[118:])),
+		Timestamp:      binary.LittleEndian.Uint64(b[120:]),
+	}
+}
+
+func marshalTransfers(events []Transfer) []byte {
+	out := make([]byte, len(events)*transferSize)
+	for i := range events {
+		e := &events[i]
+		b := out[i*transferSize:]
+		putU128(b[0:], e.Id)
+		putU128(b[16:], e.DebitAccountId)
+		putU128(b[32:], e.CreditAccountId)
+		putU128(b[48:], e.Amount)
+		putU128(b[64:], e.PendingId)
+		putU128(b[80:], e.UserData128)
+		binary.LittleEndian.PutUint64(b[96:], e.UserData64)
+		binary.LittleEndian.PutUint32(b[104:], e.UserData32)
+		binary.LittleEndian.PutUint32(b[108:], e.Timeout)
+		binary.LittleEndian.PutUint32(b[112:], e.Ledger)
+		binary.LittleEndian.PutUint16(b[116:], e.Code)
+		binary.LittleEndian.PutUint16(b[118:], uint16(e.Flags))
+		binary.LittleEndian.PutUint64(b[120:], e.Timestamp)
+	}
+	return out
+}
+
+func unmarshalTransfer(b []byte) Transfer {
+	return Transfer{
+		Id:              getU128(b[0:]),
+		DebitAccountId:  getU128(b[16:]),
+		CreditAccountId: getU128(b[32:]),
+		Amount:          getU128(b[48:]),
+		PendingId:       getU128(b[64:]),
+		UserData128:     getU128(b[80:]),
+		UserData64:      binary.LittleEndian.Uint64(b[96:]),
+		UserData32:      binary.LittleEndian.Uint32(b[104:]),
+		Timeout:         binary.LittleEndian.Uint32(b[108:]),
+		Ledger:          binary.LittleEndian.Uint32(b[112:]),
+		Code:            binary.LittleEndian.Uint16(b[116:]),
+		Flags:           TransferFlags(binary.LittleEndian.Uint16(b[118:])),
+		Timestamp:       binary.LittleEndian.Uint64(b[120:]),
+	}
+}
+
+func unmarshalBalance(b []byte) AccountBalance {
+	var out AccountBalance
+	out.DebitsPending = getU128(b[0:])
+	out.DebitsPosted = getU128(b[16:])
+	out.CreditsPending = getU128(b[32:])
+	out.CreditsPosted = getU128(b[48:])
+	out.Timestamp = binary.LittleEndian.Uint64(b[64:])
+	copy(out.Reserved[:], b[72:128])
+	return out
+}
+
+func marshalFilter(f AccountFilter) []byte {
+	b := make([]byte, filterSize)
+	putU128(b[0:], f.AccountId)
+	binary.LittleEndian.PutUint64(b[16:], f.TimestampMin)
+	binary.LittleEndian.PutUint64(b[24:], f.TimestampMax)
+	binary.LittleEndian.PutUint32(b[32:], f.Limit)
+	binary.LittleEndian.PutUint32(b[36:], uint32(f.Flags))
+	copy(b[40:], f.Reserved[:])
+	return b
+}
+
+func marshalIds(ids [][2]uint64) []byte {
+	out := make([]byte, len(ids)*idPairSize)
+	for i, id := range ids {
+		putU128(out[i*idPairSize:], id)
+	}
+	return out
+}
+
+func unmarshalCreateResults(b []byte) []CreateResult {
+	n := len(b) / createResultSize
+	out := make([]CreateResult, n)
+	for i := 0; i < n; i++ {
+		out[i] = CreateResult{
+			Index:  binary.LittleEndian.Uint32(b[i*8:]),
+			Result: binary.LittleEndian.Uint32(b[i*8+4:]),
+		}
+	}
+	return out
+}

@@ -1,0 +1,88 @@
+// Wire protocol: the 256-byte VSR message header and checksums.
+//
+// Layout mirrors tigerbeetle_tpu/vsr/wire.py HEADER_DTYPE (a
+// re-design of the reference's per-command header unions into one
+// flat little-endian layout — reference:
+// src/vsr/message_header.zig:17-103).  Checksums are SHA-256
+// truncated to 128 bits: `checksum` covers header bytes [16, 256),
+// `checksum_body` covers the body; both are verified before any
+// message is trusted.
+package tigerbeetle
+
+import (
+	"crypto/sha256"
+	"encoding/binary"
+	"fmt"
+)
+
+const (
+	headerSize     = 256
+	messageSizeMax = 1 << 20
+
+	offChecksum     = 0
+	offChecksumBody = 16
+	offClient       = 48
+	offCluster      = 64
+	offRequest      = 112
+	offSize         = 144
+	offCommand      = 153
+	offOperation    = 154
+	offVersion      = 155
+
+	cmdRequest  = 5
+	cmdReply    = 8
+	cmdEviction = 18
+
+	opRegister = 2
+
+	wireVersion = 1
+)
+
+// checksum128 returns the first 16 bytes of SHA-256(data).
+func checksum128(data []byte) [16]byte {
+	sum := sha256.Sum256(data)
+	var out [16]byte
+	copy(out[:], sum[:16])
+	return out
+}
+
+// buildRequest frames one request message: header + body, checksums
+// finalized.
+func buildRequest(cluster uint64, clientID [2]uint64, requestNumber uint32,
+	operation uint8, body []byte) []byte {
+	msg := make([]byte, headerSize+len(body))
+	copy(msg[headerSize:], body)
+	h := msg[:headerSize]
+	binary.LittleEndian.PutUint64(h[offClient:], clientID[0])
+	binary.LittleEndian.PutUint64(h[offClient+8:], clientID[1])
+	binary.LittleEndian.PutUint64(h[offCluster:], cluster)
+	binary.LittleEndian.PutUint32(h[offRequest:], requestNumber)
+	binary.LittleEndian.PutUint32(h[offSize:], uint32(headerSize+len(body)))
+	h[offCommand] = cmdRequest
+	h[offOperation] = operation
+	h[offVersion] = wireVersion
+
+	bodySum := checksum128(body)
+	copy(h[offChecksumBody:], bodySum[:])
+	headSum := checksum128(h[16:headerSize])
+	copy(h[offChecksum:], headSum[:])
+	return msg
+}
+
+// verifyMessage checks both checksums of a framed message.
+func verifyMessage(msg []byte) error {
+	h := msg[:headerSize]
+	headSum := checksum128(h[16:headerSize])
+	for i := 0; i < 16; i++ {
+		if h[offChecksum+i] != headSum[i] {
+			return fmt.Errorf("tigerbeetle: header checksum mismatch")
+		}
+	}
+	bodySum := checksum128(msg[headerSize:])
+	for i := 0; i < 16; i++ {
+		if h[offChecksumBody+i] != bodySum[i] {
+			return fmt.Errorf("tigerbeetle: body checksum mismatch")
+		}
+	}
+	return nil
+}

@@ -1,0 +1,427 @@
+// TypeScript (Node) client for a tigerbeetle_tpu cluster.
+//
+// Pure TypeScript over node:net — it speaks the TCP wire protocol
+// directly (256-byte SHA-256/128-checksummed headers,
+// tigerbeetle_tpu/vsr/wire.py) instead of wrapping the C ABI, the
+// role the reference's Node client fills via N-API (reference:
+// src/clients/node/, src/node.zig).  One VSR session, promise-based:
+// requests queue client-side and go out one at a time (the session
+// invariant); results resolve with FAILURES ONLY for create batches.
+//
+// u64/u128 values are `bigint` end to end.
+
+import * as net from "node:net";
+import { createHash } from "node:crypto";
+
+import {
+  Account,
+  AccountBalance,
+  AccountFilter,
+  AccountFilterFlags,
+  CreateResult,
+  Operation,
+  Transfer,
+} from "./types.ts";
+
+export { Operation };
+
+const HEADER_SIZE = 256;
+const MESSAGE_SIZE_MAX = 1 << 20;
+const OFF_CHECKSUM = 0;
+const OFF_CHECKSUM_BODY = 16;
+const OFF_CLIENT = 48;
+const OFF_CLUSTER = 64;
+const OFF_REQUEST = 112;
+const OFF_SIZE = 144;
+const OFF_COMMAND = 153;
+const OFF_OPERATION = 154;
+const OFF_VERSION = 155;
+const CMD_REQUEST = 5;
+const CMD_REPLY = 8;
+const CMD_EVICTION = 18;
+const OP_REGISTER = 2;
+const WIRE_VERSION = 1;
+
+/** Max events per request (1 MiB message − 256 B header, 128 B/event). */
+export const BATCH_MAX = Math.floor((MESSAGE_SIZE_MAX - HEADER_SIZE) / 128);
+
+const ACCOUNT_SIZE = 128;
+const TRANSFER_SIZE = 128;
+const BALANCE_SIZE = 128;
+const FILTER_SIZE = 64;
+
+function checksum128(data: Buffer): Buffer {
+  return createHash("sha256").update(data).digest().subarray(0, 16);
+}
+
+// ---------------------------------------------------------------------
+// Struct codecs (field offsets: tigerbeetle_tpu/types.py).
+
+function writeU128(buf: Buffer, at: number, v: bigint): void {
+  buf.writeBigUInt64LE(v & 0xffffffffffffffffn, at);
+  buf.writeBigUInt64LE(v >> 64n, at + 8);
+}
+
+function readU128(buf: Buffer, at: number): bigint {
+  return buf.readBigUInt64LE(at) | (buf.readBigUInt64LE(at + 8) << 64n);
+}
+
+function encodeAccounts(events: Partial<Account>[]): Buffer {
+  const buf = Buffer.alloc(events.length * ACCOUNT_SIZE);
+  events.forEach((e, i) => {
+    const at = i * ACCOUNT_SIZE;
+    writeU128(buf, at + 0, e.id ?? 0n);
+    writeU128(buf, at + 16, e.debitsPending ?? 0n);
+    writeU128(buf, at + 32, e.debitsPosted ?? 0n);
+    writeU128(buf, at + 48, e.creditsPending ?? 0n);
+    writeU128(buf, at + 64, e.creditsPosted ?? 0n);
+    writeU128(buf, at + 80, e.userData128 ?? 0n);
+    buf.writeBigUInt64LE(e.userData64 ?? 0n, at + 96);
+    buf.writeUInt32LE(e.userData32 ?? 0, at + 104);
+    buf.writeUInt32LE(e.reserved ?? 0, at + 108);
+    buf.writeUInt32LE(e.ledger ?? 0, at + 112);
+    buf.writeUInt16LE(e.code ?? 0, at + 116);
+    buf.writeUInt16LE(e.flags ?? 0, at + 118);
+    buf.writeBigUInt64LE(e.timestamp ?? 0n, at + 120);
+  });
+  return buf;
+}
+
+function decodeAccount(buf: Buffer, at: number): Account {
+  return {
+    id: readU128(buf, at + 0),
+    debitsPending: readU128(buf, at + 16),
+    debitsPosted: readU128(buf, at + 32),
+    creditsPending: readU128(buf, at + 48),
+    creditsPosted: readU128(buf, at + 64),
+    userData128: readU128(buf, at + 80),
+    userData64: buf.readBigUInt64LE(at + 96),
+    userData32: buf.readUInt32LE(at + 104),
+    reserved: buf.readUInt32LE(at + 108),
+    ledger: buf.readUInt32LE(at + 112),
+    code: buf.readUInt16LE(at + 116),
+    flags: buf.readUInt16LE(at + 118),
+    timestamp: buf.readBigUInt64LE(at + 120),
+  };
+}
+
+function encodeTransfers(events: Partial<Transfer>[]): Buffer {
+  const buf = Buffer.alloc(events.length * TRANSFER_SIZE);
+  events.forEach((e, i) => {
+    const at = i * TRANSFER_SIZE;
+    writeU128(buf, at + 0, e.id ?? 0n);
+    writeU128(buf, at + 16, e.debitAccountId ?? 0n);
+    writeU128(buf, at + 32, e.creditAccountId ?? 0n);
+    writeU128(buf, at + 48, e.amount ?? 0n);
+    writeU128(buf, at + 64, e.pendingId ?? 0n);
+    writeU128(buf, at + 80, e.userData128 ?? 0n);
+    buf.writeBigUInt64LE(e.userData64 ?? 0n, at + 96);
+    buf.writeUInt32LE(e.userData32 ?? 0, at + 104);
+    buf.writeUInt32LE(e.timeout ?? 0, at + 108);
+    buf.writeUInt32LE(e.ledger ?? 0, at + 112);
+    buf.writeUInt16LE(e.code ?? 0, at + 116);
+    buf.writeUInt16LE(e.flags ?? 0, at + 118);
+    buf.writeBigUInt64LE(e.timestamp ?? 0n, at + 120);
+  });
+  return buf;
+}
+
+function decodeTransfer(buf: Buffer, at: number): Transfer {
+  return {
+    id: readU128(buf, at + 0),
+    debitAccountId: readU128(buf, at + 16),
+    creditAccountId: readU128(buf, at + 32),
+    amount: readU128(buf, at + 48),
+    pendingId: readU128(buf, at + 64),
+    userData128: readU128(buf, at + 80),
+    userData64: buf.readBigUInt64LE(at + 96),
+    userData32: buf.readUInt32LE(at + 104),
+    timeout: buf.readUInt32LE(at + 108),
+    ledger: buf.readUInt32LE(at + 112),
+    code: buf.readUInt16LE(at + 116),
+    flags: buf.readUInt16LE(at + 118),
+    timestamp: buf.readBigUInt64LE(at + 120),
+  };
+}
+
+function decodeBalance(buf: Buffer, at: number): AccountBalance {
+  return {
+    debitsPending: readU128(buf, at + 0),
+    debitsPosted: readU128(buf, at + 16),
+    creditsPending: readU128(buf, at + 32),
+    creditsPosted: readU128(buf, at + 48),
+    timestamp: buf.readBigUInt64LE(at + 64),
+  };
+}
+
+function encodeFilter(f: Partial<AccountFilter>): Buffer {
+  const buf = Buffer.alloc(FILTER_SIZE);
+  writeU128(buf, 0, f.accountId ?? 0n);
+  buf.writeBigUInt64LE(f.timestampMin ?? 0n, 16);
+  buf.writeBigUInt64LE(f.timestampMax ?? 0n, 24);
+  buf.writeUInt32LE(f.limit ?? 0, 32);
+  buf.writeUInt32LE(
+    f.flags ?? AccountFilterFlags.debits | AccountFilterFlags.credits,
+    36,
+  );
+  return buf;
+}
+
+function encodeIds(ids: bigint[]): Buffer {
+  const buf = Buffer.alloc(ids.length * 16);
+  ids.forEach((id, i) => writeU128(buf, i * 16, id));
+  return buf;
+}
+
+function decodeCreateResults(buf: Buffer): CreateResult[] {
+  const out: CreateResult[] = [];
+  for (let at = 0; at + 8 <= buf.length; at += 8) {
+    out.push({
+      index: buf.readUInt32LE(at),
+      result: buf.readUInt32LE(at + 4),
+    });
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------
+// Wire framing.
+
+export function buildRequest(
+  cluster: bigint,
+  clientId: bigint,
+  requestNumber: number,
+  operation: number,
+  body: Buffer,
+): Buffer {
+  const msg = Buffer.alloc(HEADER_SIZE + body.length);
+  body.copy(msg, HEADER_SIZE);
+  writeU128(msg, OFF_CLIENT, clientId);
+  writeU128(msg, OFF_CLUSTER, cluster);
+  msg.writeUInt32LE(requestNumber, OFF_REQUEST);
+  msg.writeUInt32LE(HEADER_SIZE + body.length, OFF_SIZE);
+  msg[OFF_COMMAND] = CMD_REQUEST;
+  msg[OFF_OPERATION] = operation;
+  msg[OFF_VERSION] = WIRE_VERSION;
+  checksum128(body).copy(msg, OFF_CHECKSUM_BODY);
+  checksum128(msg.subarray(16, HEADER_SIZE)).copy(msg, OFF_CHECKSUM);
+  return msg;
+}
+
+function verifyMessage(msg: Buffer): boolean {
+  const head = checksum128(msg.subarray(16, HEADER_SIZE));
+  if (!head.equals(msg.subarray(OFF_CHECKSUM, OFF_CHECKSUM + 16))) {
+    return false;
+  }
+  const body = checksum128(msg.subarray(HEADER_SIZE));
+  return body.equals(msg.subarray(OFF_CHECKSUM_BODY, OFF_CHECKSUM_BODY + 16));
+}
+
+// ---------------------------------------------------------------------
+// Client.
+
+interface Pending {
+  requestNumber: number;
+  resolve: (body: Buffer) => void;
+  reject: (err: Error) => void;
+}
+
+export interface ClientOptions {
+  cluster?: bigint;
+  /** Unique per live session. */
+  clientId?: bigint;
+  timeoutMs?: number;
+}
+
+export class Client {
+  private socket: net.Socket;
+  private recv: Buffer = Buffer.alloc(0);
+  private cluster: bigint;
+  private clientId: bigint;
+  private requestNumber = 0;
+  private registered: Promise<void> | null = null;
+  private inflight: Pending | null = null;
+  private chain: Promise<unknown> = Promise.resolve();
+  private timeoutMs: number;
+  private dead: Error | null = null;
+  private connected: Promise<void>;
+
+  constructor(address: string, options: ClientOptions = {}) {
+    const [host, port] = splitAddress(address);
+    this.cluster = options.cluster ?? 0n;
+    this.clientId =
+      options.clientId ?? BigInt(Math.floor(Math.random() * 2 ** 52)) + 1n;
+    this.timeoutMs = options.timeoutMs ?? 30_000;
+    this.socket = net.connect({ host, port, noDelay: true });
+    this.connected = new Promise((resolve, reject) => {
+      this.socket.once("connect", resolve);
+      this.socket.once("error", reject);
+    });
+    this.socket.on("data", (chunk) => this.onData(chunk));
+    this.socket.on("error", (err) => this.fail(err));
+    this.socket.on("close", () => this.fail(new Error("connection closed")));
+  }
+
+  close(): void {
+    // Reject the in-flight request BEFORE marking dead (fail() is a
+    // no-op once this.dead is set).
+    this.fail(new Error("client closed"));
+    this.socket.destroy();
+  }
+
+  private fail(err: Error): void {
+    if (this.dead) return;
+    this.dead = err;
+    if (this.inflight) {
+      this.inflight.reject(err);
+      this.inflight = null;
+    }
+  }
+
+  private onData(chunk: Buffer): void {
+    this.recv = Buffer.concat([this.recv, chunk]);
+    for (;;) {
+      if (this.recv.length < HEADER_SIZE) return;
+      const size = this.recv.readUInt32LE(OFF_SIZE);
+      if (size < HEADER_SIZE || size > MESSAGE_SIZE_MAX + HEADER_SIZE) {
+        this.fail(new Error(`bad frame size ${size}`));
+        return;
+      }
+      if (this.recv.length < size) return;
+      const msg = this.recv.subarray(0, size);
+      this.recv = this.recv.subarray(size);
+      if (!verifyMessage(msg)) continue;
+      if (msg[OFF_COMMAND] === CMD_EVICTION) {
+        this.fail(new Error("session evicted"));
+        return;
+      }
+      if (msg[OFF_COMMAND] !== CMD_REPLY) continue;
+      const req = msg.readUInt32LE(OFF_REQUEST);
+      if (this.inflight && req === this.inflight.requestNumber) {
+        const pending = this.inflight;
+        this.inflight = null;
+        pending.resolve(Buffer.from(msg.subarray(HEADER_SIZE)));
+      }
+    }
+  }
+
+  private roundtrip(operation: number, requestNumber: number, body: Buffer): Promise<Buffer> {
+    if (this.dead) return Promise.reject(this.dead);
+    return new Promise<Buffer>((resolve, reject) => {
+      const timer = setTimeout(
+        () => reject(new Error("request timeout")),
+        this.timeoutMs,
+      );
+      this.inflight = {
+        requestNumber,
+        resolve: (b) => {
+          clearTimeout(timer);
+          resolve(b);
+        },
+        reject: (e) => {
+          clearTimeout(timer);
+          reject(e);
+        },
+      };
+      this.socket.write(
+        buildRequest(this.cluster, this.clientId, requestNumber, operation, body),
+      );
+    });
+  }
+
+  /** Serialize requests: one in flight per session. */
+  private request(operation: number, body: Buffer): Promise<Buffer> {
+    const run = this.chain.then(async () => {
+      await this.connected;
+      if (this.registered === null) {
+        // A failed registration resets so the next request retries it
+        // (the server replays the register reply for an existing
+        // session, so re-registering is always safe).
+        const attempt = this.roundtrip(OP_REGISTER, 0, Buffer.alloc(0)).then(
+          () => undefined,
+          (err) => {
+            this.registered = null;
+            throw err;
+          },
+        );
+        this.registered = attempt;
+      }
+      await this.registered;
+      this.requestNumber += 1;
+      return this.roundtrip(operation, this.requestNumber, body);
+    });
+    this.chain = run.catch(() => undefined);
+    return run;
+  }
+
+  /** Returns FAILURES only — `[]` means every account applied. */
+  async createAccounts(accounts: Partial<Account>[]): Promise<CreateResult[]> {
+    if (accounts.length > BATCH_MAX) throw new Error("batch too large");
+    const reply = await this.request(
+      Operation.create_accounts,
+      encodeAccounts(accounts),
+    );
+    return decodeCreateResults(reply);
+  }
+
+  /** Returns FAILURES only — `[]` means every transfer applied. */
+  async createTransfers(transfers: Partial<Transfer>[]): Promise<CreateResult[]> {
+    if (transfers.length > BATCH_MAX) throw new Error("batch too large");
+    const reply = await this.request(
+      Operation.create_transfers,
+      encodeTransfers(transfers),
+    );
+    return decodeCreateResults(reply);
+  }
+
+  /** Missing ids are omitted from the result. */
+  async lookupAccounts(ids: bigint[]): Promise<Account[]> {
+    if (ids.length > BATCH_MAX) throw new Error("batch too large");
+    const reply = await this.request(Operation.lookup_accounts, encodeIds(ids));
+    const out: Account[] = [];
+    for (let at = 0; at + ACCOUNT_SIZE <= reply.length; at += ACCOUNT_SIZE) {
+      out.push(decodeAccount(reply, at));
+    }
+    return out;
+  }
+
+  async lookupTransfers(ids: bigint[]): Promise<Transfer[]> {
+    if (ids.length > BATCH_MAX) throw new Error("batch too large");
+    const reply = await this.request(Operation.lookup_transfers, encodeIds(ids));
+    const out: Transfer[] = [];
+    for (let at = 0; at + TRANSFER_SIZE <= reply.length; at += TRANSFER_SIZE) {
+      out.push(decodeTransfer(reply, at));
+    }
+    return out;
+  }
+
+  async getAccountTransfers(filter: Partial<AccountFilter>): Promise<Transfer[]> {
+    const reply = await this.request(
+      Operation.get_account_transfers,
+      encodeFilter(filter),
+    );
+    const out: Transfer[] = [];
+    for (let at = 0; at + TRANSFER_SIZE <= reply.length; at += TRANSFER_SIZE) {
+      out.push(decodeTransfer(reply, at));
+    }
+    return out;
+  }
+
+  async getAccountBalances(filter: Partial<AccountFilter>): Promise<AccountBalance[]> {
+    const reply = await this.request(
+      Operation.get_account_balances,
+      encodeFilter(filter),
+    );
+    const out: AccountBalance[] = [];
+    for (let at = 0; at + BALANCE_SIZE <= reply.length; at += BALANCE_SIZE) {
+      out.push(decodeBalance(reply, at));
+    }
+    return out;
+  }
+}
+
+function splitAddress(address: string): [string, number] {
+  const i = address.lastIndexOf(":");
+  if (i < 0) return ["127.0.0.1", Number(address)];
+  return [address.slice(0, i) || "127.0.0.1", Number(address.slice(i + 1))];
+}

@@ -1,0 +1,117 @@
+// End-to-end + fixture test for the TypeScript client.  Run directly
+// (no build step — the sources are erasable-syntax TypeScript):
+//
+//   node --experimental-strip-types test/e2e.ts <port>      # live server
+//   node --experimental-strip-types test/e2e.ts --fixtures  # offline
+//
+// Driven by tests/test_foreign_clients.py when a Node toolchain
+// exists.  Prints "e2e ok" / "fixtures ok" and exits 0 on success.
+
+import { readFileSync } from "node:fs";
+import { dirname, join } from "node:path";
+import { fileURLToPath } from "node:url";
+
+import { buildRequest, Client } from "../src/client.ts";
+import { AccountFilterFlags, CreateTransferResult } from "../src/types.ts";
+
+function check(cond: boolean, message: string): void {
+  if (!cond) {
+    console.error(`FAIL: ${message}`);
+    process.exit(1);
+  }
+}
+
+function fixtures(): void {
+  const here = dirname(fileURLToPath(import.meta.url));
+  const raw = readFileSync(join(here, "../../fixtures/frames.json"), "utf8");
+  const cases = JSON.parse(raw) as Array<{
+    name: string;
+    cluster: number;
+    client_lo: number;
+    client_hi: number;
+    request: number;
+    operation: number;
+    body_hex: string;
+    frame_hex: string;
+  }>;
+  check(cases.length > 0, "empty fixtures");
+  for (const c of cases) {
+    const clientId =
+      BigInt(c.client_lo) | (BigInt(c.client_hi) << 64n);
+    const got = buildRequest(
+      BigInt(c.cluster),
+      clientId,
+      c.request,
+      c.operation,
+      Buffer.from(c.body_hex, "hex"),
+    );
+    check(
+      got.toString("hex") === c.frame_hex,
+      `${c.name}: frame mismatch\n got ${got.toString("hex")}\nwant ${c.frame_hex}`,
+    );
+  }
+  console.log("fixtures ok");
+}
+
+async function e2e(port: number): Promise<void> {
+  const client = new Client(`127.0.0.1:${port}`, {
+    cluster: 3n,
+    clientId: 0xabcdefn,
+  });
+
+  let failures = await client.createAccounts([
+    { id: 8001n, ledger: 1, code: 1 },
+    { id: 8002n, ledger: 1, code: 1 },
+  ]);
+  check(failures.length === 0, `create_accounts failures: ${JSON.stringify(failures)}`);
+
+  failures = await client.createTransfers([
+    {
+      id: 88001n,
+      debitAccountId: 8001n,
+      creditAccountId: 8002n,
+      amount: 250n,
+      ledger: 1,
+      code: 1,
+    },
+    {
+      id: 88002n,
+      debitAccountId: 8001n,
+      creditAccountId: 8001n, // accounts_must_be_different
+      amount: 1n,
+      ledger: 1,
+      code: 1,
+    },
+  ]);
+  check(failures.length === 1, `expected 1 failure, got ${failures.length}`);
+  check(failures[0].index === 1, `failure index ${failures[0].index}`);
+  check(
+    failures[0].result === CreateTransferResult.accounts_must_be_different,
+    `failure result ${failures[0].result}`,
+  );
+
+  const rows = await client.lookupAccounts([8001n, 8002n]);
+  check(rows.length === 2, `lookup rows ${rows.length}`);
+  check(rows[0].debitsPosted === 250n, `debits ${rows[0].debitsPosted}`);
+  check(rows[1].creditsPosted === 250n, `credits ${rows[1].creditsPosted}`);
+
+  const transfers = await client.getAccountTransfers({
+    accountId: 8001n,
+    timestampMax: (1n << 63n) - 1n,
+    limit: 10,
+    flags: AccountFilterFlags.debits | AccountFilterFlags.credits,
+  });
+  check(transfers.length === 1, `get_account_transfers ${transfers.length}`);
+  check(transfers[0].amount === 250n, `amount ${transfers[0].amount}`);
+
+  client.close();
+  console.log("e2e ok");
+}
+
+const arg = process.argv[2];
+if (arg === "--fixtures") {
+  fixtures();
+} else {
+  fixtures();
+  await e2e(Number(arg));
+}

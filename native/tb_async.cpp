@@ -82,7 +82,7 @@ uint32_t event_size_of(uint8_t op) {
             return 16;
         case TB_OPERATION_GET_ACCOUNT_TRANSFERS:
         case TB_OPERATION_GET_ACCOUNT_BALANCES:
-            return 128;  // one AccountFilter
+            return 64;  // one AccountFilter (types.py ACCOUNT_FILTER_DTYPE)
         default:
             return 0;
     }
@@ -454,6 +454,13 @@ int tb_async_submit(tb_async_client_t* c, tb_packet_t* p) {
         return -1;
     }
     if (p->data_size % esize != 0) {
+        complete(c, p, TB_PACKET_INVALID_DATA_SIZE, nullptr, 0);
+        return -1;
+    }
+    // Query operations take exactly one AccountFilter.
+    if ((p->operation == TB_OPERATION_GET_ACCOUNT_TRANSFERS ||
+         p->operation == TB_OPERATION_GET_ACCOUNT_BALANCES) &&
+        p->data_size != esize) {
         complete(c, p, TB_PACKET_INVALID_DATA_SIZE, nullptr, 0);
         return -1;
     }

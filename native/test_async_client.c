@@ -75,6 +75,14 @@ typedef struct {
     uint32_t index;
     uint32_t result;
 } wire_create_result_t;
+
+typedef struct {
+    uint64_t account_id_lo, account_id_hi;
+    uint64_t timestamp_min, timestamp_max;
+    uint32_t limit;
+    uint32_t flags;
+    uint8_t reserved[24];
+} wire_account_filter_t;
 #pragma pack(pop)
 
 // Completion log: order + per-packet reply copies, cross-thread.
@@ -246,7 +254,35 @@ int main(int argc, char** argv) {
     CHECK(rows[1].credits_posted_lo == 20, "acct2 credits %llu",
           (unsigned long long)rows[1].credits_posted_lo);
 
-    // --- 5. invalid operation fails synchronously -------------------
+    // --- 5. get_account_transfers: 64-byte filter, row reply --------
+    wire_account_filter_t filter;
+    memset(&filter, 0, sizeof(filter));
+    filter.account_id_lo = 1;
+    filter.timestamp_max = ~0ull >> 1;
+    filter.limit = 10;
+    filter.flags = 3;  // debits | credits
+    tb_packet_t p_filter;
+    memset(&p_filter, 0, sizeof(p_filter));
+    p_filter.user_data = (void*)(intptr_t)5;
+    p_filter.operation = TB_OPERATION_GET_ACCOUNT_TRANSFERS;
+    p_filter.data = &filter;
+    p_filter.data_size = sizeof(filter);
+    CHECK(sizeof(filter) == 64, "filter wire size %zu", sizeof(filter));
+    CHECK(tb_async_submit(c, &p_filter) == 0, "submit filter");
+    wait_completed(&h, 5);
+    CHECK(h.statuses[5] == TB_PACKET_OK, "filter status %d", h.statuses[5]);
+    CHECK(h.reply_lens[5] == 3 * sizeof(wire_transfer_t),
+          "account 1 touched by 3 transfers (%u bytes)", h.reply_lens[5]);
+    wire_transfer_t got[3];
+    memcpy(got, h.replies[5], sizeof(got));
+    CHECK(got[0].amount_lo == 10 && got[1].amount_lo == 10 &&
+              got[2].amount_lo == 5,
+          "transfer amounts %llu %llu %llu",
+          (unsigned long long)got[0].amount_lo,
+          (unsigned long long)got[1].amount_lo,
+          (unsigned long long)got[2].amount_lo);
+
+    // --- 6. invalid operation fails synchronously -------------------
     tb_packet_t p_bad;
     memset(&p_bad, 0, sizeof(p_bad));
     p_bad.user_data = (void*)(intptr_t)4;

@@ -217,7 +217,7 @@ def test_bindings_cover_all_enums_and_fields(tmp_path):
     c = (tmp_path / "tb_types.h").read_text()
     # Every CreateTransferResult code appears in every language.
     for member in types.CreateTransferResult:
-        assert f"  {member.name} = {member.value}," in ts
+        assert f"  {member.name}: {member.value}," in ts
         camel = "".join(p.capitalize() for p in member.name.split("_"))
         assert f"CreateTransferResult{camel} CreateTransferResult = {member.value}" in go
         assert (

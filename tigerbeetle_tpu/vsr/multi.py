@@ -331,6 +331,19 @@ class VsrReplica(Replica):
             # Forward to the primary (clients may have a stale view).
             self.bus.send(self.primary_index(), header, body)
             return
+        operation = int(header["operation"])
+        if operation >= constants.VSR_OPERATIONS_RESERVED:
+            # Malformed client input (unknown op byte, wrong event
+            # size, over batch_max) must not reach the state machine's
+            # asserting prepare path: drop it here.  Well-behaved
+            # clients validate before sending; only a buggy or
+            # malicious client hits this.
+            try:
+                op_enum = types.Operation(operation)
+            except ValueError:
+                return
+            if not self.sm.input_valid(op_enum, body):
+                return
         verdict = self._request_dedupe(header)
         if verdict is not None:
             if verdict == "queue":
