@@ -61,34 +61,54 @@ func NewClient(address string, cluster uint64, clientID [2]uint64) (*Client, err
 
 func (c *Client) Close() error { return c.conn.Close() }
 
-// roundtrip sends one request and blocks for its reply body.
+// retransmitInterval is how often an unanswered request is re-sent
+// within the overall Timeout.  Retransmission under the SAME request
+// number is always safe: the server's at-most-once session dedupe
+// replays the stored reply for a request it already committed instead
+// of re-executing it.
+const retransmitInterval = time.Second
+
+// roundtrip sends one request and blocks for its reply body,
+// retransmitting periodically until the Timeout deadline.
 func (c *Client) roundtrip(operation uint8, requestNumber uint32, body []byte) ([]byte, error) {
 	if c.evicted {
 		return nil, ErrEvicted
 	}
 	msg := buildRequest(c.cluster, c.clientID, requestNumber, operation, body)
 	deadline := time.Now().Add(c.Timeout)
-	c.conn.SetDeadline(deadline)
-	if _, err := c.conn.Write(msg); err != nil {
-		return nil, err
-	}
 	for {
-		reply, err := c.readMessage()
-		if err != nil {
+		if time.Now().After(deadline) {
+			return nil, fmt.Errorf("tigerbeetle: request %d timed out", requestNumber)
+		}
+		if _, err := c.conn.Write(msg); err != nil {
 			return nil, err
 		}
-		h := reply[:headerSize]
-		if h[offCommand] == cmdEviction {
-			c.evicted = true
-			return nil, ErrEvicted
+		step := time.Now().Add(retransmitInterval)
+		if step.After(deadline) {
+			step = deadline
 		}
-		if h[offCommand] != cmdReply {
-			continue
+		c.conn.SetDeadline(step)
+		for {
+			reply, err := c.readMessage()
+			if err != nil {
+				if ne, ok := err.(net.Error); ok && ne.Timeout() {
+					break // retransmit
+				}
+				return nil, err
+			}
+			h := reply[:headerSize]
+			if h[offCommand] == cmdEviction {
+				c.evicted = true
+				return nil, ErrEvicted
+			}
+			if h[offCommand] != cmdReply {
+				continue
+			}
+			if binary.LittleEndian.Uint32(h[offRequest:]) != requestNumber {
+				continue // stale duplicate
+			}
+			return reply[headerSize:], nil
 		}
-		if binary.LittleEndian.Uint32(h[offRequest:]) != requestNumber {
-			continue // stale duplicate
-		}
-		return reply[headerSize:], nil
 	}
 }
 

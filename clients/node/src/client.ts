@@ -308,24 +308,38 @@ export class Client {
   private roundtrip(operation: number, requestNumber: number, body: Buffer): Promise<Buffer> {
     if (this.dead) return Promise.reject(this.dead);
     return new Promise<Buffer>((resolve, reject) => {
-      const timer = setTimeout(
-        () => reject(new Error("request timeout")),
-        this.timeoutMs,
+      const msg = buildRequest(
+        this.cluster, this.clientId, requestNumber, operation, body,
       );
+      // Retransmit under the SAME request number until answered: the
+      // server's at-most-once dedupe replays the stored reply for a
+      // request it already committed, never re-executing it.
+      const resend = setInterval(() => {
+        if (!this.dead) this.socket.write(msg);
+      }, 1000);
+      const done = () => {
+        clearTimeout(timer);
+        clearInterval(resend);
+        if (this.inflight?.requestNumber === requestNumber) {
+          this.inflight = null;
+        }
+      };
+      const timer = setTimeout(() => {
+        done();
+        reject(new Error("request timeout"));
+      }, this.timeoutMs);
       this.inflight = {
         requestNumber,
         resolve: (b) => {
-          clearTimeout(timer);
+          done();
           resolve(b);
         },
         reject: (e) => {
-          clearTimeout(timer);
+          done();
           reject(e);
         },
       };
-      this.socket.write(
-        buildRequest(this.cluster, this.clientId, requestNumber, operation, body),
-      );
+      this.socket.write(msg);
     });
   }
 

@@ -1,0 +1,106 @@
+"""Automated single-block peer repair (scrubber -> request_blocks ->
+block) — a corrupt grid block on one replica heals from a peer with no
+operator action and no full state sync (reference:
+src/vsr/grid_blocks_missing.zig:1-30, src/vsr/grid_scrubber.zig)."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.harness import account, pack, transfer
+from tigerbeetle_tpu.types import Operation as Op
+
+
+def build_cluster_with_grid_state(seed=11):
+    """3 replicas, committed past a checkpoint so every replica's
+    forest holds live grid blocks (spilled rows + manifest log)."""
+    c = Cluster(
+        replica_count=3, seed=seed,
+        state_machine_factory=lambda: TpuStateMachine(cfg.TEST_MIN),
+    )
+    client = c.client(500)
+    client.register()
+    c.run_until(lambda: client.registered)
+    c.run_request(client, Op.create_accounts, pack([account(1), account(2)]))
+    interval = c.replicas[0].config.vsr_checkpoint_interval
+    for k in range(interval + 4):
+        c.run_request(
+            client, Op.create_transfers,
+            pack([transfer(1000 + k, debit_account_id=1,
+                           credit_account_id=2, amount=1)]),
+        )
+    assert c.replicas[0].checkpoint_op > 0
+    return c
+
+
+def corrupt_one_block(replica):
+    """Corrupt the first allocated grid block on disk; returns its
+    address."""
+    grid = replica.forest.grid
+    allocated = np.flatnonzero(~grid.free_set.free)
+    assert len(allocated) > 0, "no live grid blocks to corrupt"
+    addr = int(allocated[0]) + 1
+    grid._cache.remove(addr)
+    replica.storage.corrupt_sector(grid._offset(addr))
+    assert not grid.verify_block(addr)
+    return addr
+
+
+def test_scrubber_finds_and_repairs_from_peer():
+    c = build_cluster_with_grid_state()
+    victim = c.replicas[1]  # a backup
+    addr = corrupt_one_block(victim)
+
+    for _ in range(20000):
+        c.step()
+        if victim.stat_blocks_repaired >= 1 and not victim._blocks_missing:
+            break
+    assert victim.stat_blocks_repaired >= 1, "block never repaired"
+    assert victim.forest.grid.verify_block(addr)
+    # Bit-identical to the intact peer's copy.
+    healthy = c.replicas[0]
+    assert (
+        victim.storage.read(
+            victim.forest.grid._offset(addr), victim.forest.grid.block_size
+        )
+        == healthy.storage.read(
+            healthy.forest.grid._offset(addr), healthy.forest.grid.block_size
+        )
+    )
+    c.check_convergence()
+
+
+def test_repair_routes_around_corrupt_peer():
+    """When the first peer asked ALSO has a corrupt copy, the
+    round-robin retry heals from the remaining intact replica (the
+    fault model guarantees >= 1 intact copy cluster-wide)."""
+    c = build_cluster_with_grid_state(seed=12)
+    victim = c.replicas[2]
+    addr = corrupt_one_block(victim)
+    # Corrupt the SAME block on one more replica: only replica 1 keeps
+    # an intact copy.
+    other = c.replicas[0]
+    other.forest.grid._cache.remove(addr)
+    other.storage.corrupt_sector(other.forest.grid._offset(addr))
+
+    for _ in range(40000):
+        c.step()
+        if victim.stat_blocks_repaired >= 1 and not victim._blocks_missing:
+            break
+    assert victim.stat_blocks_repaired >= 1, "block never repaired"
+    assert victim.forest.grid.verify_block(addr)
+
+
+def test_primary_repairs_too():
+    c = build_cluster_with_grid_state(seed=13)
+    primary = next(r for r in c.replicas if r.is_primary)
+    addr = corrupt_one_block(primary)
+    for _ in range(20000):
+        c.step()
+        if primary.stat_blocks_repaired >= 1 and not primary._blocks_missing:
+            break
+    assert primary.stat_blocks_repaired >= 1
+    assert primary.forest.grid.verify_block(addr)
+    c.check_convergence()

@@ -78,9 +78,12 @@ def test_grid_blocks_checksummed():
     g.write_block(a2, b"x" * 1000)
     assert g.read_block(a1) == b"hello world"
     assert g.verify_block(a2)
-    # Corrupt the sector behind a2: verify fails, read raises.
+    # Corrupt the sector behind a2: verify fails (it probes the DISK,
+    # leaving the cache alone), and a disk read raises.
     g.storage.corrupt_sector(g._offset(a2))
     assert not g.verify_block(a2)
+    assert g.read_block(a2) == b"x" * 1000  # cache still serves RAM copy
+    g._cache.remove(a2)
     with pytest.raises(RuntimeError):
         g.read_block(a2)
 

@@ -91,12 +91,16 @@ class Grid:
         return payload
 
     def verify_block(self, address: int) -> bool:
-        """Scrubber probe: is the on-disk block intact? (bypasses cache,
-        reference: src/vsr/grid_scrubber.zig)."""
-        try:
-            self._cache.remove(address)
-            self.read_block(address)
-            return True
-        except RuntimeError:
+        """Scrubber probe: is the on-disk block intact?  Reads the disk
+        directly and leaves the cache alone — steady-state scrubbing
+        must not churn hot entries (reference:
+        src/vsr/grid_scrubber.zig)."""
+        raw = self.storage.read(self._offset(address), self.block_size)
+        h = np.frombuffer(raw[:BLOCK_HEADER_SIZE], BLOCK_DTYPE)[0]
+        length = int(h["length"])
+        if int(h["address"]) != address or length > self.payload_size:
             return False
+        payload = raw[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + length]
+        want = int(h["checksum_lo"]) | (int(h["checksum_hi"]) << 64)
+        return wire.checksum(payload) == want
 

@@ -48,6 +48,24 @@ PING_TICKS = 2
 VIEW_CHANGE_TICKS = 10
 VIEW_CHANGE_RESEND_TICKS = 4
 REPAIR_RETRY_TICKS = 3
+# Scrub one block probe per interval: a full cycle over a 4k-block
+# grid takes ~minutes at 10ms ticks, matching the reference's
+# hours-per-cycle pacing philosophy scaled to test horizons.
+SCRUB_INTERVAL_TICKS = 8
+
+
+def _block_frame_valid(frame: bytes, address: int, payload_size: int) -> bool:
+    """Self-consistency of a raw grid block frame (header address,
+    length bound, payload checksum) without touching any cache."""
+    from tigerbeetle_tpu.vsr.grid import BLOCK_DTYPE, BLOCK_HEADER_SIZE
+
+    bh = np.frombuffer(frame[:BLOCK_HEADER_SIZE], BLOCK_DTYPE)[0]
+    length = int(bh["length"])
+    if int(bh["address"]) != address or length > payload_size:
+        return False
+    payload = frame[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + length]
+    want = int(bh["checksum_lo"]) | (int(bh["checksum_hi"]) << 64)
+    return wire.checksum(payload) == want
 
 # Sentinel: the in-flight request set cannot be determined yet.
 UNDECIDABLE = object()
@@ -133,6 +151,24 @@ class VsrReplica(Replica):
         self._vc_last_sent = 0
         self._repair_last_sent = 0
         self._sync_last_requested = -10**9
+
+        # Grid scrubber + automated peer block repair (reference:
+        # src/vsr/grid_scrubber.zig, src/vsr/grid_blocks_missing.zig).
+        # The forest writes grid blocks only at checkpoint and
+        # checkpoints are byte-identical cluster-wide, so any peer at
+        # the same checkpoint_op holds an intact copy of every live
+        # block.
+        self.scrubber = None
+        if self.forest is not None:
+            from tigerbeetle_tpu.vsr.scrubber import GridScrubber
+
+            self.scrubber = GridScrubber(
+                self.forest.grid, blocks_per_tick=1
+            )
+        self._blocks_missing: set[int] = set()
+        self._block_repair_last = -10**9
+        self._block_repair_attempt = 0
+        self.stat_blocks_repaired = 0
         self._last_retransmit = 0
 
         # Pending canonical-log install after passively entering a view
@@ -221,6 +257,13 @@ class VsrReplica(Replica):
             self._ticks - self._repair_last_sent >= REPAIR_RETRY_TICKS
         ):
             self._send_repair_requests(force=True)
+        if self.scrubber is not None and self.status == "normal":
+            if self._ticks % SCRUB_INTERVAL_TICKS == 0:
+                self._blocks_missing.update(self.scrubber.tick())
+            if self._blocks_missing and self.replica_count > 1 and (
+                self._ticks - self._block_repair_last >= REPAIR_RETRY_TICKS
+            ):
+                self._send_request_blocks()
         if (
             self._canon_pending
             and self.status == "normal"
@@ -315,6 +358,8 @@ class VsrReplica(Replica):
             Command.request_start_view: self._on_request_start_view,
             Command.request_sync_checkpoint: self._on_request_sync,
             Command.sync_checkpoint: self._on_sync_checkpoint,
+            Command.request_blocks: self._on_request_blocks,
+            Command.block: self._on_block,
             Command.ping: self._on_ping,
             Command.pong: self._on_pong,
         }.get(cmd)
@@ -1186,6 +1231,99 @@ class VsrReplica(Replica):
 
         grid._cache = SetAssociativeCache(capacity=256, ways=4)
         return state["snapshot"]
+
+    # ------------------------------------------------------------------
+    # Single-block peer repair: scrubber findings heal from any peer at
+    # the same checkpoint without re-shipping the whole snapshot
+    # (reference: src/vsr/grid_blocks_missing.zig:1-30,
+    # Command.request_blocks / Command.block, src/vsr/grid.zig:34-60).
+    # Scrub pacing: one probe every SCRUB_INTERVAL_TICKS, cycling the
+    # whole grid over many seconds (reference: grid_scrubber paces on a
+    # slow timer) — steady-state cost stays negligible.
+
+    def _send_request_blocks(self) -> None:
+        """Ask a peer for our corrupt blocks (round-robin over peers,
+        bounded batch per request)."""
+        self._block_repair_last = self._ticks
+        # Blocks freed since they were flagged no longer need repair.
+        free = self.forest.grid.free_set.free
+        self._blocks_missing = {
+            a for a in self._blocks_missing if not free[a - 1]
+        }
+        if not self._blocks_missing:
+            return
+        peers = [r for r in range(self.replica_count) if r != self.replica]
+        dst = peers[self._block_repair_attempt % len(peers)]
+        self._block_repair_attempt += 1
+        addrs = np.asarray(sorted(self._blocks_missing)[:64], np.uint64)
+        h = wire.make_header(
+            command=Command.request_blocks, cluster=self.cluster,
+            replica=self.replica, op=self.checkpoint_op,
+        )
+        body = addrs.tobytes()
+        wire.finalize_header(h, body)
+        self.bus.send(dst, h, body)
+
+    def _on_request_blocks(self, header: np.ndarray, body: bytes) -> None:
+        """Serve raw block frames — only when our grid is guaranteed
+        identical to the requester's (same checkpoint; the forest
+        writes blocks only at checkpoint)."""
+        if self.forest is None or self.status != "normal":
+            return
+        if int(header["op"]) != self.checkpoint_op:
+            return
+        if len(body) % 8 != 0:
+            return  # malformed (this handler takes untrusted input)
+        dst = int(header["replica"])
+        if not 0 <= dst < self.replica_count or dst == self.replica:
+            return
+        grid = self.forest.grid
+        # Serve at most the sender's cap regardless of what the body
+        # claims — one message must not trigger unbounded disk reads.
+        for addr in np.frombuffer(body, np.uint64)[:64]:
+            addr = int(addr)
+            if not 1 <= addr <= grid.block_count:
+                continue
+            if grid.free_set.free[addr - 1]:
+                continue  # not live here (diverged free set: stale req)
+            # One raw read serves both the intactness check and the
+            # reply payload.
+            frame = self.storage.read(grid._offset(addr), grid.block_size)
+            if not _block_frame_valid(frame, addr, grid.payload_size):
+                continue  # our copy is corrupt too; another peer's turn
+            bh = wire.make_header(
+                command=Command.block, cluster=self.cluster,
+                replica=self.replica, op=self.checkpoint_op,
+            )
+            wire.finalize_header(bh, frame)
+            self.bus.send(dst, bh, frame)
+
+    def _on_block(self, header: np.ndarray, body: bytes) -> None:
+        """Install a repaired block after verifying its self-described
+        address + payload checksum against what we asked for."""
+        from tigerbeetle_tpu.vsr.grid import BLOCK_DTYPE, BLOCK_HEADER_SIZE
+
+        if self.forest is None or int(header["op"]) != self.checkpoint_op:
+            return
+        grid = self.forest.grid
+        if len(body) != grid.block_size:
+            return
+        bh = np.frombuffer(body[:BLOCK_HEADER_SIZE], BLOCK_DTYPE)[0]
+        addr = int(bh["address"])
+        if addr not in self._blocks_missing:
+            return
+        length = int(bh["length"])
+        if length > grid.payload_size:
+            return
+        payload = body[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + length]
+        want = int(bh["checksum_lo"]) | (int(bh["checksum_hi"]) << 64)
+        if wire.checksum(payload) != want:
+            return
+        self.storage.write(grid._offset(addr), body)
+        grid._cache.remove(addr)
+        self._blocks_missing.discard(addr)
+        self._block_repair_attempt = 0
+        self.stat_blocks_repaired += 1
 
     def _send_sync_checkpoint(self, dst: int) -> None:
         sb = self.superblock.working
