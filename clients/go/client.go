@@ -80,14 +80,17 @@ func (c *Client) roundtrip(operation uint8, requestNumber uint32, body []byte) (
 		if time.Now().After(deadline) {
 			return nil, fmt.Errorf("tigerbeetle: request %d timed out", requestNumber)
 		}
-		if _, err := c.conn.Write(msg); err != nil {
-			return nil, err
-		}
+		// The deadline covers this iteration's write AND reads; it
+		// must be set BEFORE Write (a stale expired deadline from the
+		// previous iteration would fail the retransmit instantly).
 		step := time.Now().Add(retransmitInterval)
 		if step.After(deadline) {
 			step = deadline
 		}
 		c.conn.SetDeadline(step)
+		if _, err := c.conn.Write(msg); err != nil {
+			return nil, err
+		}
 		for {
 			reply, err := c.readMessage()
 			if err != nil {

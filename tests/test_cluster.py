@@ -278,3 +278,102 @@ def test_wal_corruption_on_backup_repaired_from_peers():
         c.step()
     c.check_convergence()
     assert c.replicas[1].sm.transfer_timestamp(305) is not None
+
+
+def test_sync_install_preserves_journal_tail_above_checkpoint():
+    """State sync supersedes WAL repair only BELOW the installed
+    checkpoint: a replica holding a journal tail above it (e.g. a new
+    primary that adopted the canonical tail via DVC, then synced its
+    lagging prefix) must keep that tail — truncating it wiped committed
+    ops cluster-wide (VOPR corruption nemesis, seed 8006)."""
+    c = Cluster(replica_count=3, seed=31)
+    client = c.client(700)
+    client.register()
+    c.run_until(lambda: client.registered)
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    interval = c.replicas[0].config.vsr_checkpoint_interval
+    for k in range(interval + 6):
+        c.run_request(client, types.Operation.create_transfers,
+                      pack([transfer(900 + k, debit_account_id=1,
+                                     credit_account_id=2, amount=1)]))
+    c.settle(max_steps=10000)
+    sender = c.replicas[0]
+    assert sender.checkpoint_op > 0
+
+    receiver = c.replicas[1]
+    op_before = receiver.op
+    parent_before = receiver.parent_checksum
+    assert op_before > sender.checkpoint_op
+    # Forge a lagging commit frontier below the checkpoint (the sync
+    # receive path guards checkpoint_op > commit_min), keeping the
+    # journaled tail — the state a DVC-adopting primary is in.
+    receiver.commit_min = sender.checkpoint_op - 2
+
+    sb = sender.superblock.working
+    blob = sender._read_grid(
+        int(sb["checkpoint_offset"]), int(sb["checkpoint_size"])
+    )
+    payload = sender._sync_wrap(blob)
+    from tigerbeetle_tpu.vsr import wire as wire_mod
+
+    receiver._install_sync_checkpoint(
+        payload, sender.checkpoint_op,
+        int(sb["commit_min_checksum_lo"])
+        | (int(sb["commit_min_checksum_hi"]) << 64),
+        wire_mod.checksum(payload), sender.commit_min,
+    )
+    # The preserved tail re-commits immediately (every prepare is in
+    # the journal), so the frontier lands back at the tail head — the
+    # old truncating install left it at checkpoint_op with op reset.
+    assert receiver.commit_min >= sender.checkpoint_op
+    assert receiver.op == op_before, "sync truncated the journal tail"
+    assert receiver.parent_checksum == parent_before
+
+
+def test_dvc_vouches_for_unreadable_committed_ops():
+    """A replica whose committed prepare is torn/corrupt must still
+    vouch for that op in its DVC headers via the redundant ring —
+    understating DVCs let a view-change quorum of damaged replicas
+    truncate committed history (VOPR corruption nemesis, seed 8018)."""
+    c = Cluster(replica_count=3, seed=41)
+    client = c.client(800)
+    client.register()
+    c.run_until(lambda: client.registered)
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    for k in range(8):
+        c.run_request(client, types.Operation.create_transfers,
+                      pack([transfer(400 + k, debit_account_id=1,
+                                     credit_account_id=2, amount=1)]))
+    c.settle(max_steps=10000)
+
+    victim = c.replicas[1]
+    target_op = victim.commit_min - 3
+    assert target_op > victim.checkpoint_op
+    slot = victim.journal.slot_for_op(target_op)
+    c.storages[1].corrupt_sector(
+        c.storages[1].layout.prepare_slot_offset(slot)
+    )
+    assert victim.journal.read_prepare(target_op) is None
+
+    # After a restart the commit frontier falls back to the checkpoint,
+    # so the DVC window covers the committed suffix.  Recovery must
+    # PRESERVE the head across the damaged slot (repair refills the
+    # prepare from peers), and the corrupt op's header must come from
+    # the on-disk redundant ring even though its prepare is unreadable.
+    from tigerbeetle_tpu.vsr import wire
+
+    old_op = victim.op
+    c.restart_replica(1)
+    restarted = c.replicas[1]
+    assert restarted.commit_min < target_op
+    assert restarted.op == old_op, "recovery truncated at the damaged slot"
+    vouched = {
+        int(wire.header_from_bytes(raw)["op"])
+        for raw in restarted._tail_headers()
+    }
+    # Every committed op above the new commit frontier is vouched —
+    # including the one whose prepare is corrupt.
+    for op in range(restarted.commit_min + 1, victim.commit_min + 1):
+        assert op in vouched, op

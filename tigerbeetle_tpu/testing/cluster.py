@@ -295,6 +295,11 @@ class Cluster:
 
     def _deliver(self, dst, header: np.ndarray, body: bytes) -> None:
         if isinstance(dst, int) and dst < self.replica_count:
+            # A crashed process receives nothing: in-flight packets to
+            # it die with it (processing them would let a zombie
+            # journal prepares and send acks from beyond the grave).
+            if self.replicas[dst].status == "crashed":
+                return
             self.replicas[dst].on_message(header, body)
         else:
             client = self.clients.get(dst)

@@ -115,11 +115,29 @@ class Workload:
         )
 
 
+class FaultAtlas:
+    """Seeded targeting for sector corruption that guarantees >= 1
+    intact copy of everything cluster-wide (reference:
+    src/testing/storage.zig:58-95 ClusterFaultAtlas): corruption only
+    ever hits a fixed minority of replicas (f = (n-1)//2), and locally
+    at most one of the four superblock copies."""
+
+    def __init__(self, seed: int, replica_count: int) -> None:
+        rng = np.random.default_rng(seed)
+        f = (replica_count - 1) // 2
+        self.faulty: set[int] = (
+            {int(x) for x in rng.choice(replica_count, size=f, replace=False)}
+            if f else set()
+        )
+
+
 class Vopr:
     def __init__(self, seed: int, *, replica_count: int = 3,
                  requests: int = 40,
                  packet_loss: float = 0.02,
                  crash_probability: float = 0.01,
+                 corruption_probability: float = 0.0,
+                 upgrade_nemesis: bool = False,
                  state_machine_factory=None) -> None:
         self.seed = seed
         self.rng = np.random.default_rng(seed + 1)
@@ -131,8 +149,13 @@ class Vopr:
         self.workload = Workload(seed + 2)
         self.requests = requests
         self.crash_probability = crash_probability
+        self.corruption_probability = corruption_probability
+        self.upgrade_nemesis = upgrade_nemesis
+        self.atlas = FaultAtlas(seed + 3, replica_count)
         self.crashed: set[int] = set()
         self.restart_check_skipped = False
+        self.corruptions = 0
+        self._sb_corrupt_copy: dict[int, int] = {}
 
     def run(self) -> None:
         c = self.cluster
@@ -166,7 +189,45 @@ class Vopr:
             c.restart_replica(i)
         self.crashed.clear()
         c.run_until(lambda: not client.busy(), max_steps=20_000)
+        if self.upgrade_nemesis:
+            # Finish any half-rolled upgrade BEFORE requiring
+            # convergence: a replica still on the old release cannot
+            # execute prepares stamped with the new one (the reference
+            # re-execs each process; the harness restarts it).
+            for _ in range(4):
+                target = max(
+                    max(r.release for r in c.replicas),
+                    max((r.upgrade_target or 0) for r in c.replicas),
+                )
+                stale = [
+                    i for i, r in enumerate(c.replicas)
+                    if r.release < target
+                ]
+                if not stale:
+                    break
+                for i in stale:
+                    c.restart_replica(
+                        i, release=target,
+                        releases_available=tuple(range(1, target + 1)),
+                    )
+                for _ in range(400):
+                    c.step()
         c.settle(max_steps=20_000)
+        if self.corruption_probability:
+            # Surface and heal ALL latent WAL damage before the
+            # journal-reading checkers run: production paces scrubbing
+            # over minutes; the harness forces full passes (repair may
+            # take a couple of request/response rounds).
+            for _ in range(6):
+                for r in c.replicas:
+                    r.wal_scrub_window()
+                for _ in range(8 * c.replica_count):
+                    c.step()
+                if all(not r._wal_scrub_wanted for r in c.replicas):
+                    break
+            # The extra steps may have committed a pulse mid-stride:
+            # re-quiesce before the checkers read cluster state.
+            c.settle(max_steps=20_000)
         c.check_linearized()
         c.check_convergence()
         self.check_conservation()
@@ -196,6 +257,12 @@ class Vopr:
         if self.rng.random() < 0.01:
             i = int(self.rng.integers(c.replica_count))
             c.clock_skew[i] = int(self.rng.integers(-5_000_000, 5_000_000))
+        if self.corruption_probability and (
+            self.rng.random() < self.corruption_probability
+        ):
+            self._corrupt_random_sector()
+        if self.upgrade_nemesis:
+            self._upgrade_tick()
         if self.crashed:
             # Restart with probability ~5%/tick so outages are short.
             if self.rng.random() < 0.05:
@@ -206,6 +273,77 @@ class Vopr:
             i = int(self.rng.integers(c.replica_count))
             c.crash_replica(i)
             self.crashed.add(i)
+
+    def _corrupt_random_sector(self) -> None:
+        """Latent-sector-error nemesis over live replicas, targeted by
+        the FaultAtlas: WAL prepare slots, WAL header-ring sectors, one
+        superblock copy, and live forest grid blocks — every zone with
+        an automated recovery path (redundant headers + protocol WAL
+        repair, superblock quorum, scrubber block repair)."""
+        from tigerbeetle_tpu.vsr.storage import SECTOR_SIZE
+        from tigerbeetle_tpu.vsr.superblock import SUPERBLOCK_COPIES
+
+        c = self.cluster
+        candidates = [
+            i for i in sorted(self.atlas.faulty) if i not in self.crashed
+        ]
+        if not candidates:
+            return
+        i = int(self.rng.choice(candidates))
+        storage = c.storages[i]
+        layout = storage.layout
+        replica = c.replicas[i]
+        zones = ["wal_prepare", "wal_header", "superblock"]
+        if replica.forest is not None and (
+            ~replica.forest.grid.free_set.free
+        ).any():
+            zones.append("grid")
+        zone = zones[int(self.rng.integers(len(zones)))]
+        if zone == "wal_prepare":
+            slot = int(self.rng.integers(layout.config.journal_slot_count))
+            offset = layout.prepare_slot_offset(slot)
+        elif zone == "wal_header":
+            n_sectors = layout.wal_headers_size // SECTOR_SIZE
+            offset = (
+                layout.wal_headers_offset
+                + int(self.rng.integers(n_sectors)) * SECTOR_SIZE
+            )
+        elif zone == "superblock":
+            # At most ONE copy per replica ever corrupts (4-copy
+            # quorum stays decidable locally).
+            copy = self._sb_corrupt_copy.setdefault(
+                i, int(self.rng.integers(SUPERBLOCK_COPIES))
+            )
+            offset = layout.superblock_offset + copy * (
+                layout.superblock_size // SUPERBLOCK_COPIES
+            )
+        else:
+            grid = replica.forest.grid
+            allocated = np.flatnonzero(~grid.free_set.free)
+            addr = int(self.rng.choice(allocated)) + 1
+            offset = grid._offset(addr)
+        storage.corrupt_sector(offset)
+        self.corruptions += 1
+
+    def _upgrade_tick(self) -> None:
+        """Release-upgrade nemesis (reference: src/simulator.zig
+        :194-204 restart-with-new-release probabilities): roll replicas
+        to advertise release 2, then re-exec each one once the upgrade
+        op commits its target."""
+        c = self.cluster
+        if self.rng.random() < 0.005:
+            i = int(self.rng.integers(c.replica_count))
+            if i not in self.crashed and (
+                max(c.replicas[i].releases_available) < 2
+            ):
+                c.restart_replica(i, releases_available=(1, 2))
+        for i, r in enumerate(c.replicas):
+            if i in self.crashed:
+                continue
+            if r.upgrade_target == 2 and r.release == 1 and (
+                self.rng.random() < 0.05
+            ):
+                c.restart_replica(i, release=2, releases_available=(1, 2))
 
     # -- checkers --
 
@@ -238,7 +376,15 @@ class Vopr:
         chain — replay_tail=True executes it deliberately (a normal
         multi-replica open defers the tail to consensus re-commit)."""
         c = self.cluster
-        live = c.replicas[0]
+        # Corruption targets atlas replicas; restart-replay needs a
+        # replica whose local WAL is intact.
+        live_index = 0
+        if self.corruption_probability:
+            live_index = next(
+                i for i in range(c.replica_count)
+                if i not in self.atlas.faulty
+            )
+        live = c.replicas[live_index]
         if live.op != live.commit_min:
             # A prepared-but-uncommitted suffix remains (quorum raced
             # the end of the run); tail replay would execute it, so the
@@ -251,8 +397,11 @@ class Vopr:
         # Deep-copy the storage: replay writes reply slots (stamped
         # with the recovered view) and must not mutate live state.
         fresh = VsrReplica(
-            copy.deepcopy(c.storages[0]), c.cluster_id, c._factory(),
-            live.bus, replica=0, replica_count=c.replica_count,
+            copy.deepcopy(c.storages[live_index]), c.cluster_id,
+            c._factory(), live.bus, replica=live_index,
+            replica_count=c.replica_count,
+            release=live.release,
+            releases_available=live.releases_available,
         )
         fresh.open(replay_tail=True)
         assert fresh.commit_min == live.commit_min

@@ -96,11 +96,18 @@ class Grid:
         must not churn hot entries (reference:
         src/vsr/grid_scrubber.zig)."""
         raw = self.storage.read(self._offset(address), self.block_size)
-        h = np.frombuffer(raw[:BLOCK_HEADER_SIZE], BLOCK_DTYPE)[0]
-        length = int(h["length"])
-        if int(h["address"]) != address or length > self.payload_size:
-            return False
-        payload = raw[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + length]
-        want = int(h["checksum_lo"]) | (int(h["checksum_hi"]) << 64)
-        return wire.checksum(payload) == want
+        return block_frame_valid(raw, address, self.payload_size)
 
+
+
+def block_frame_valid(frame: bytes, address: int, payload_size: int) -> bool:
+    """Self-consistency of a raw block frame (header address, length
+    bound, payload checksum) — shared by the scrubber probe and the
+    peer-repair serve/install paths, without touching any cache."""
+    h = np.frombuffer(frame[:BLOCK_HEADER_SIZE], BLOCK_DTYPE)[0]
+    length = int(h["length"])
+    if int(h["address"]) != address or length > payload_size:
+        return False
+    payload = frame[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + length]
+    want = int(h["checksum_lo"]) | (int(h["checksum_hi"]) << 64)
+    return wire.checksum(payload) == want

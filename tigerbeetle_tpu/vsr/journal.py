@@ -56,6 +56,9 @@ class Journal:
         self.slot_count = self.config.journal_slot_count
         # In-memory redundant header ring (mirrors the disk ring).
         self.headers = np.zeros(self.slot_count, HEADER_DTYPE)
+        from tigerbeetle_tpu.utils import tracer as tracer_mod
+
+        self.tracer = tracer_mod.NULL
 
     # ------------------------------------------------------------------
 
@@ -74,16 +77,36 @@ class Journal:
         op = int(header["op"])
         slot = self.slot_for_op(op)
 
-        msg = header.tobytes() + body
-        padded = msg.ljust(_sectors(len(msg)), b"\x00")
-        self.storage.write(self.layout.prepare_slot_offset(slot), padded)
-        if sync:
-            self.storage.sync()
+        with self.tracer.span("journal_write", op=op, bytes=len(body)):
+            msg = header.tobytes() + body
+            padded = msg.ljust(_sectors(len(msg)), b"\x00")
+            self.storage.write(self.layout.prepare_slot_offset(slot), padded)
+            if sync:
+                self.storage.sync()
 
-        self.headers[slot] = header
+            self.headers[slot] = header
+            self._write_header_sector(slot)
+            if sync:
+                self.storage.sync()
+
+    def header_sector_intact(self, slot: int) -> bool:
+        """Does the DISK redundant-header sector for `slot` match the
+        in-memory ring?  (Scrubber probe for latent sector errors.)"""
+        sector_index = slot // HEADERS_PER_SECTOR
+        first = sector_index * HEADERS_PER_SECTOR
+        want = self.headers[first : first + HEADERS_PER_SECTOR].tobytes()
+        want = want.ljust(SECTOR_SIZE, b"\x00")
+        disk = self.storage.read(
+            self.layout.wal_headers_offset + sector_index * SECTOR_SIZE,
+            SECTOR_SIZE,
+        )
+        return disk == want
+
+    def rewrite_header_sector(self, slot: int) -> None:
+        """Self-heal a latent error in the redundant ring from the
+        in-memory copy (authoritative while the process lives)."""
         self._write_header_sector(slot)
-        if sync:
-            self.storage.sync()
+        self.storage.sync()
 
     def _write_header_sector(self, slot: int) -> None:
         sector_index = slot // HEADERS_PER_SECTOR

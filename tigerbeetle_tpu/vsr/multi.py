@@ -54,18 +54,6 @@ REPAIR_RETRY_TICKS = 3
 SCRUB_INTERVAL_TICKS = 8
 
 
-def _block_frame_valid(frame: bytes, address: int, payload_size: int) -> bool:
-    """Self-consistency of a raw grid block frame (header address,
-    length bound, payload checksum) without touching any cache."""
-    from tigerbeetle_tpu.vsr.grid import BLOCK_DTYPE, BLOCK_HEADER_SIZE
-
-    bh = np.frombuffer(frame[:BLOCK_HEADER_SIZE], BLOCK_DTYPE)[0]
-    length = int(bh["length"])
-    if int(bh["address"]) != address or length > payload_size:
-        return False
-    payload = frame[BLOCK_HEADER_SIZE : BLOCK_HEADER_SIZE + length]
-    want = int(bh["checksum_lo"]) | (int(bh["checksum_hi"]) << 64)
-    return wire.checksum(payload) == want
 
 # Sentinel: the in-flight request set cannot be determined yet.
 UNDECIDABLE = object()
@@ -169,6 +157,25 @@ class VsrReplica(Replica):
         self._block_repair_last = -10**9
         self._block_repair_attempt = 0
         self.stat_blocks_repaired = 0
+        # WAL scrubber: probes committed journal slots for latent
+        # sector errors, self-healing the redundant header ring from
+        # memory and fetching corrupt prepares from peers pinned by
+        # their canonical checksum.
+        self._wal_scrub_cursor = 0
+        self._wal_scrub_attempt = 0
+        self._wal_scrub_wanted: dict[int, int] = {}
+        self.stat_wal_scrub_repaired = 0
+        # Canonical vouches: op -> checksum of the prepare the current
+        # view's history assigns to that op.  The commit path executes
+        # an op ONLY with a matching vouch — the parent-linkage check
+        # alone cannot reject a stale SIBLING (same parent, different
+        # content, e.g. an old primary's pulse superseded by a view
+        # change: VOPR seed 8005).  Vouch sources: own prepares
+        # (primary), accepted current-view prepares (self + their
+        # parent), heartbeat commit checksums, start_view / DVC
+        # canonical headers, checksum-pinned repairs.  View transitions
+        # clear vouches above commit_min.
+        self._vouched: dict[int, int] = {}
         self._last_retransmit = 0
 
         # Pending canonical-log install after passively entering a view
@@ -257,6 +264,8 @@ class VsrReplica(Replica):
             self._ticks - self._repair_last_sent >= REPAIR_RETRY_TICKS
         ):
             self._send_repair_requests(force=True)
+        if self.status == "normal" and self._ticks % SCRUB_INTERVAL_TICKS == 0:
+            self._wal_scrub_tick()
         if self.scrubber is not None and self.status == "normal":
             if self._ticks % SCRUB_INTERVAL_TICKS == 0:
                 self._blocks_missing.update(self.scrubber.tick())
@@ -329,6 +338,10 @@ class VsrReplica(Replica):
         h = wire.make_header(
             command=Command.commit, cluster=self.cluster, view=self.view,
             replica=self.replica, commit=self.commit_min,
+            # Canonical checksum of the prepare at commit_min, so
+            # backups vouch their local copy before executing
+            # (reference: Command.commit carries commit_checksum).
+            context=self.commit_parent or 0,
         )
         wire.finalize_header(h, b"")
         for r in range(self.replica_count):
@@ -561,6 +574,7 @@ class VsrReplica(Replica):
         self.journal.write_prepare(prepare, body)
         self.op = op
         self.parent_checksum = wire.u128(prepare, "checksum")
+        self._vouched[op] = self.parent_checksum  # we ARE the canon
         self.pipeline[op] = PipelineEntry(prepare, body, {self.replica}, subs)
         self._replicate(prepare, body)
         self._maybe_commit_pipeline()
@@ -839,6 +853,11 @@ class VsrReplica(Replica):
         self.journal.write_prepare(header, body)
         self.op = op
         self.parent_checksum = wire.u128(header, "checksum")
+        # A current-view prepare is canonical for its op, and its
+        # parent field vouches its predecessor.
+        self._vouched[op] = self.parent_checksum
+        if op - 1 > self.commit_min:
+            self._vouched.setdefault(op - 1, wire.u128(header, "parent"))
         self._repair_wanted.pop(op, None)
         self._replicate(header, body)
         self._send_prepare_ok(header)
@@ -879,7 +898,30 @@ class VsrReplica(Replica):
         if int(header["view"]) > self.view:
             self._enter_view(int(header["view"]))
         self._last_primary_seen = self._ticks
-        self._advance_commit(int(header["commit"]))
+        commit = int(header["commit"])
+        vouch = wire.u128(header, "context")
+        if vouch and commit > self.commit_min:
+            self._vouched[commit] = vouch
+        self._advance_commit(commit)
+
+    def _extend_vouches_down(self) -> None:
+        """Derive vouches downward: if op K's canonical content is
+        vouched and our journal's K matches it, K's parent field
+        vouches K-1 — repeat to the commit frontier."""
+        for k in sorted(self._vouched, reverse=True):
+            while k - 1 > self.commit_min and k - 1 not in self._vouched:
+                # The in-memory redundant header ring supplies the
+                # checksum/parent fields without re-reading (and
+                # re-hashing) the full 1 MiB prepare slot.
+                mem = self.journal.headers[self.journal.slot_for_op(k)]
+                if (
+                    int(mem["op"]) != k
+                    or int(mem["command"]) != int(Command.prepare)
+                    or wire.u128(mem, "checksum") != self._vouched[k]
+                ):
+                    break  # cannot derive through missing/divergent slot
+                self._vouched[k - 1] = wire.u128(mem, "parent")
+                k -= 1
 
     def _advance_commit(self, commit_max: int) -> None:
         self.commit_max = max(self.commit_max, commit_max)
@@ -925,8 +967,22 @@ class VsrReplica(Replica):
                 self._repair_wanted.setdefault(op, 0)
                 self._send_repair_requests()
                 return
+            # Canonical vouch gate: parent linkage alone cannot reject
+            # a stale SIBLING (same parent, different content).  Only
+            # execute content the current history vouches for; without
+            # a vouch, wait (the next heartbeat / prepare / start_view
+            # supplies one within ticks).
+            self._extend_vouches_down()
+            want = self._vouched.get(op)
+            if want is None:
+                return
+            if wire.u128(header, "checksum") != want:
+                self._repair_wanted[op] = want
+                self._send_repair_requests()
+                return
             self._commit_prepare(header, body)
             self.commit_parent = wire.u128(header, "checksum")
+            self._vouched.pop(op, None)
             if self.commit_min - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
                 # Deterministic checkpoint point: commit_min crosses the
                 # interval boundary at the same op on every replica, so
@@ -1027,6 +1083,19 @@ class VsrReplica(Replica):
         want = self._repair_wanted.get(op)
         have = self.journal.read_prepare(op)
         checksum = wire.u128(header, "checksum")
+        if (
+            have is None
+            and checksum != 0
+            and self._wal_scrub_wanted.get(op) == checksum
+        ):
+            # WAL-scrub repair of a committed slot: the pin came from
+            # OUR in-memory redundant header, so this content is the
+            # committed canonical prepare — rewrite both rings.
+            self.journal.write_prepare(header, body)
+            del self._wal_scrub_wanted[op]
+            self.stat_wal_scrub_repaired += 1
+            self.tracer.instant("wal_scrub", op=op)
+            return
         if have is not None and wire.u128(have[0], "checksum") == checksum:
             if want == checksum:
                 # The local copy already IS the pinned canonical one:
@@ -1043,6 +1112,7 @@ class VsrReplica(Replica):
             return
         self.journal.write_prepare(header, body)
         self._repair_wanted.pop(op, None)
+        self._vouched[op] = checksum  # pinned fill == canonical content
         if op == self.op:
             self.parent_checksum = checksum
         # Re-verify: the canonical fill vouches for its predecessor,
@@ -1140,6 +1210,17 @@ class VsrReplica(Replica):
             if self._repair_wanted.get(op) == 0:
                 self._repair_wanted[op] = wire.u128(h, "checksum")
                 pinned_any = True
+            if self._wal_scrub_wanted.get(op) == 0 and op <= self.commit_min:
+                # Scrub pin resolved: fetch the prepare by checksum.
+                checksum = wire.u128(h, "checksum")
+                self._wal_scrub_wanted[op] = checksum
+                req = wire.make_header(
+                    command=Command.request_prepare, cluster=self.cluster,
+                    view=self.view, op=op, replica=self.replica,
+                    context=checksum,
+                )
+                wire.finalize_header(req, b"")
+                self.bus.send(int(header["replica"]), req, b"")
         if pinned_any:
             self._send_repair_requests(force=True)
 
@@ -1241,6 +1322,69 @@ class VsrReplica(Replica):
     # whole grid over many seconds (reference: grid_scrubber paces on a
     # slow timer) — steady-state cost stays negligible.
 
+    def _wal_scrub_tick(self) -> None:
+        """Probe one committed journal slot above the checkpoint for
+        latent sector errors (reference's scrubbing philosophy applied
+        to the WAL; the uncommitted window is covered by the normal
+        repair protocol)."""
+        lo, hi = self.checkpoint_op + 1, self.commit_min
+        if hi < lo:
+            return
+        op = lo + self._wal_scrub_cursor % (hi - lo + 1)
+        self._wal_scrub_cursor += 1
+        self._wal_scrub_probe(op)
+
+    def wal_scrub_window(self) -> None:
+        """Probe the ENTIRE committed window at once — used by test
+        harnesses before journal-reading checkers, and usable by an
+        operator hook; production pacing uses the per-tick probe."""
+        for op in range(self.checkpoint_op + 1, self.commit_min + 1):
+            self._wal_scrub_probe(op)
+
+    def _wal_scrub_probe(self, op: int) -> None:
+        """Header-ring damage self-heals from the in-memory ring;
+        prepare-sector damage repairs from a peer, pinned by the
+        canonical checksum from memory — or, when that was lost too
+        (restart after double corruption), resolved via a targeted
+        request_headers round first."""
+        slot = self.journal.slot_for_op(op)
+        have = self.journal.read_prepare(op)
+        if have is not None:
+            self._wal_scrub_wanted.pop(op, None)
+            if not self.journal.header_sector_intact(slot):
+                self.journal.rewrite_header_sector(slot)
+                self.stat_wal_scrub_repaired += 1
+            return
+        if self.replica_count <= 1:
+            return
+        # Rotate targets across probes: the preferred peer may hold
+        # the same latent damage (block repair round-robins the same
+        # way).
+        peers = [r for r in range(self.replica_count) if r != self.replica]
+        target = peers[self._wal_scrub_attempt % len(peers)]
+        self._wal_scrub_attempt += 1
+        mem = self.journal.headers[slot]
+        if int(mem["op"]) == op and int(mem["command"]) == Command.prepare:
+            checksum = wire.u128(mem, "checksum")
+        else:
+            checksum = 0
+        self._wal_scrub_wanted[op] = checksum
+        if checksum:
+            h = wire.make_header(
+                command=Command.request_prepare, cluster=self.cluster,
+                view=self.view, op=op, replica=self.replica,
+                context=checksum,
+            )
+        else:
+            # Canonical checksum unknown locally: learn it from a peer
+            # (ops <= commit_min are committed, hence unique per op).
+            h = wire.make_header(
+                command=Command.request_headers, cluster=self.cluster,
+                view=self.view, replica=self.replica, op=op, commit=op,
+            )
+        wire.finalize_header(h, b"")
+        self.bus.send(target, h, b"")
+
     def _send_request_blocks(self) -> None:
         """Ask a peer for our corrupt blocks (round-robin over peers,
         bounded batch per request)."""
@@ -1277,6 +1421,8 @@ class VsrReplica(Replica):
         dst = int(header["replica"])
         if not 0 <= dst < self.replica_count or dst == self.replica:
             return
+        from tigerbeetle_tpu.vsr.grid import block_frame_valid
+
         grid = self.forest.grid
         # Serve at most the sender's cap regardless of what the body
         # claims — one message must not trigger unbounded disk reads.
@@ -1289,7 +1435,7 @@ class VsrReplica(Replica):
             # One raw read serves both the intactness check and the
             # reply payload.
             frame = self.storage.read(grid._offset(addr), grid.block_size)
-            if not _block_frame_valid(frame, addr, grid.payload_size):
+            if not block_frame_valid(frame, addr, grid.payload_size):
                 continue  # our copy is corrupt too; another peer's turn
             bh = wire.make_header(
                 command=Command.block, cluster=self.cluster,
@@ -1324,6 +1470,7 @@ class VsrReplica(Replica):
         self._blocks_missing.discard(addr)
         self._block_repair_attempt = 0
         self.stat_blocks_repaired += 1
+        self.tracer.instant("block_repair", address=addr)
 
     def _send_sync_checkpoint(self, dst: int) -> None:
         sb = self.superblock.working
@@ -1413,14 +1560,30 @@ class VsrReplica(Replica):
         self.checkpoint_op = checkpoint_op
         self.commit_min = checkpoint_op
         self.commit_max = max(self.commit_max, remote_commit)
-        self.op = checkpoint_op
-        self.parent_checksum = commit_min_checksum
         self.commit_parent = commit_min_checksum
+        # State sync supersedes WAL repair only BELOW the new
+        # checkpoint (reference: src/vsr/sync.zig).  A journal tail
+        # above it — e.g. the canonical tail a new primary adopted via
+        # DVC before syncing its lagging prefix — holds committed ops
+        # that MUST survive: truncating to checkpoint_op here would
+        # make the primary's start_view advertise the shorter log and
+        # wipe the committed suffix cluster-wide (found by the VOPR
+        # corruption nemesis, seed 8006).
+        if self.op <= checkpoint_op:
+            self.op = checkpoint_op
+            self.parent_checksum = commit_min_checksum
+            self._repair_wanted.clear()
+            self._stash.clear()
+        else:
+            for o in [o for o in self._repair_wanted if o <= checkpoint_op]:
+                del self._repair_wanted[o]
+            for o in [o for o in self._stash if o <= checkpoint_op]:
+                del self._stash[o]
         self._canon_pending = False
-        self._repair_wanted.clear()
-        self._stash.clear()
         self._sync_chunks.clear()
         self._advance_commit(self.commit_max)
+        if self._repair_wanted:
+            self._send_repair_requests(force=True)
 
     # ------------------------------------------------------------------
     # View change.
@@ -1443,6 +1606,10 @@ class VsrReplica(Replica):
         self._queued_keys.clear()
         self._svc_votes.clear()
         self._dvc.clear()
+        # Old-view vouches above the commit frontier are void: the new
+        # view may have chosen different siblings there.
+        for k in [k for k in self._vouched if k > self.commit_min]:
+            del self._vouched[k]
         self._last_primary_seen = self._ticks
         if self.op > self.commit_min and not self.is_primary:
             self._canon_pending = True
@@ -1465,6 +1632,8 @@ class VsrReplica(Replica):
             self._send_start_view(dst=int(header["replica"]))
 
     def _start_view_change(self, view: int) -> None:
+        for k in [k for k in self._vouched if k > self.commit_min]:
+            del self._vouched[k]
         self._canon_pending = False  # the DVC/start_view round re-canonizes
         self.status = "view_change"
         self.view = view
@@ -1524,14 +1693,32 @@ class VsrReplica(Replica):
             self.bus.send(target, h, body)
 
     def _tail_headers(self) -> list[bytes]:
-        """Headers of the last pipeline-window ops (the uncommitted
-        suffix a new primary might need to adopt)."""
+        """Headers of EVERY op we know above commit_min — from the
+        in-memory redundant ring, which recovery populates even for
+        slots whose prepares are torn or corrupt.  A damaged replica
+        thus still VOUCHES for committed ops it can no longer read:
+        the new primary pins their checksums and repairs the bodies
+        from peers instead of silently truncating them (the reference
+        gets the same property from DVC headers + nacks; understating
+        DVCs lost committed ops — VOPR seed 8018)."""
         out = []
-        lo = max(self.commit_min, self.op - self.config.pipeline_prepare_queue_max)
-        for op in range(lo, self.op + 1):
-            read = self.journal.read_prepare(op)
-            if read is not None:
-                out.append(read[0].tobytes())
+        for slot in range(self.journal.slot_count):
+            h = self.journal.headers[slot]
+            if int(h["command"]) != int(Command.prepare):
+                continue
+            op = int(h["op"])
+            # Bounded by our head claim: ring leftovers ABOVE the
+            # recovered head are stale garbage from older generations
+            # and must not ride into the canonical merge (VOPR seed
+            # 8005); everything within (commit_min, op] is our
+            # knowledge of the current history — including ops whose
+            # prepares are damaged, which the redundant header still
+            # vouches (VOPR seeds 8006/8018).
+            if not self.commit_min < op <= self.op:
+                continue
+            if not wire.verify_header(h):
+                continue
+            out.append(h.tobytes())
         return out
 
     def _on_do_view_change(self, header: np.ndarray, body: bytes) -> None:
@@ -1554,13 +1741,32 @@ class VsrReplica(Replica):
         if self.status != "view_change":
             return
 
-        # Adopt the longest log of the highest log_view (VRR rule).
-        best = max(
-            self._dvc.values(), key=lambda d: (d["log_view"], d["op"])
-        )
-        canonical = [wire.header_from_bytes(raw) for raw in best["headers"]]
+        # Adopt the longest log of the highest log_view (VRR rule),
+        # MERGING headers across the highest-log_view cohort: each DVC
+        # vouches for every op its redundant ring knows, so the union
+        # covers committed ops even when every cohort member's
+        # chain-verified head understates (recovery truncation).
+        # Same-op conflicts (a stale sibling surviving in one ring)
+        # resolve to the header prepared in the later view.
+        best_log_view = max(d["log_view"] for d in self._dvc.values())
+        cohort = [
+            d for d in self._dvc.values()
+            if d["log_view"] == best_log_view
+        ]
+        merged: dict[int, np.ndarray] = {}
+        for d in cohort:
+            for raw in d["headers"]:
+                h = wire.header_from_bytes(raw)
+                if not wire.verify_header(h):
+                    continue
+                op = int(h["op"])
+                have = merged.get(op)
+                if have is None or int(h["view"]) > int(have["view"]):
+                    merged[op] = h
+        canonical = [merged[op] for op in sorted(merged)]
+        op_claimed = max(d["op"] for d in cohort)
         commit_floor = max(d["commit_min"] for d in self._dvc.values())
-        self._install_log(canonical, best["op"], commit_floor)
+        self._install_log(canonical, op_claimed, commit_floor)
 
         self.status = "normal"
         self.log_view = self.view
@@ -1583,6 +1789,13 @@ class VsrReplica(Replica):
         (committed ops always reach a quorum's journals) and truncates.
         """
         self._canon_pending = False  # the canonical tail is now known
+        # The canonical headers vouch their checksums for the commit
+        # gate; anything above commit_min not re-vouched here is stale.
+        for k in [k for k in self._vouched if k > self.commit_min]:
+            del self._vouched[k]
+        for h in canonical:
+            if int(h["op"]) > self.commit_min:
+                self._vouched[int(h["op"])] = wire.u128(h, "checksum")
         have_ops = [int(h["op"]) for h in canonical]
         # Never regress below our own commit frontier: committed ops
         # are immutable.

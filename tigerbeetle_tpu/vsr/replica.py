@@ -70,6 +70,11 @@ class Replica:
         # for determinism-divergence pinpointing (reference:
         # src/testing/hash_log.zig).
         self.hash_log = None
+        # Span tracer (utils/tracer.py; reference: src/tracer.zig
+        # hooked in the commit path) — NULL until set_tracer().
+        from tigerbeetle_tpu.utils import tracer as tracer_mod
+
+        self.tracer = tracer_mod.NULL
         self.config = storage.layout.config
         self.replica = replica
         self.replica_count = replica_count
@@ -147,15 +152,23 @@ class Replica:
         if recovery.faulty_ops and self.replica_count == 1:
             raise RuntimeError(f"WAL data loss at ops {recovery.faulty_ops}")
 
-        # The contiguous prefix above the checkpoint.  A gap (faulty
-        # slot) truncates the head there; with replicas > 1 the VSR
-        # repair protocol refetches the rest from peers.
+        # Walk the readable prefix above the checkpoint.  When a tail
+        # replay is requested (single-replica recovery, restart-replay
+        # checkers) a gap truncates the head there — execution needs
+        # the bodies.  A multi-replica open PRESERVES the full
+        # recovered head instead: the ops above a damaged slot are
+        # still vouched by the redundant ring, and the VSR repair
+        # protocol refetches the missing prepares from peers —
+        # truncating here made a damaged replica understate its DVC
+        # and let a view-change quorum of damaged replicas discard
+        # committed ops (VOPR corruption nemesis, seed 8006).
         op_head = recovery.op_head
         for op in range(self.checkpoint_op + 1, recovery.op_head + 1):
             read = self.journal.read_prepare(op)
             if read is None:
                 assert self.replica_count > 1
-                op_head = op - 1
+                if replay_tail:
+                    op_head = op - 1
                 break
             if replay_tail:
                 header, body = read
@@ -260,6 +273,12 @@ class Replica:
             self.checkpoint()
         return reply
 
+    def set_tracer(self, tracer) -> None:
+        """Attach a utils.tracer.Tracer to this replica's hot paths
+        (commit stages, checkpoint, journal writes)."""
+        self.tracer = tracer
+        self.journal.tracer = tracer
+
     def _commit_prepare(self, header: np.ndarray, body: bytes,
                         replay: bool = False) -> bytes:
         """The commit stage chain (reference: src/vsr/replica.zig:
@@ -304,8 +323,16 @@ class Replica:
                 # batch once, then demux + store each sub-request's
                 # reply slice (state_machine/demuxer.py).
                 events, subs = demuxer.decode_trailer(body, n_subs)
-                self.sm.prefetch(sm_op, events, prefetch_timestamp=timestamp)
-                reply = self.sm.commit(client, op, timestamp, sm_op, events)
+                with self.tracer.span("state_machine_prefetch"):
+                    self.sm.prefetch(
+                        sm_op, events, prefetch_timestamp=timestamp
+                    )
+                with self.tracer.span(
+                    "state_machine_commit", op=op, bytes=len(events)
+                ):
+                    reply = self.sm.commit(
+                        client, op, timestamp, sm_op, events
+                    )
                 dm = demuxer.Demuxer(sm_op, reply)
                 offset = 0
                 for sub_client, sub_request, count in subs:
@@ -321,8 +348,12 @@ class Replica:
                 if self.hash_log is not None and not replay:
                     self.hash_log.record(op, header.tobytes(), reply)
                 return reply
-            self.sm.prefetch(sm_op, body, prefetch_timestamp=timestamp)
-            reply = self.sm.commit(client, op, timestamp, sm_op, body)
+            with self.tracer.span("state_machine_prefetch"):
+                self.sm.prefetch(sm_op, body, prefetch_timestamp=timestamp)
+            with self.tracer.span(
+                "state_machine_commit", op=op, bytes=len(body)
+            ):
+                reply = self.sm.commit(client, op, timestamp, sm_op, body)
 
         self.commit_min = op
         # Replayed commits are not recorded: a recovered WAL tail may
@@ -402,9 +433,24 @@ class Replica:
         """Write a snapshot blob to the grid zone (A/B alternating),
         then advance the superblock — write ordering guarantees the
         previous checkpoint survives a torn snapshot write."""
+        with self.tracer.span("checkpoint", op=self.commit_min):
+            self._checkpoint()
+
+    def _checkpoint(self) -> None:
         head = self.journal.read_prepare(self.commit_min)
-        assert head is not None
-        head_header, _ = head
+        if head is not None:
+            head_checksum = wire.u128(head[0], "checksum")
+        else:
+            # Latent sector error on the checkpoint-head slot, found
+            # before the paced scrubber reached it: the in-memory
+            # redundant ring still holds the committed header — use
+            # its checksum (peer repair heals the slot asynchronously).
+            slot = self.journal.slot_for_op(self.commit_min)
+            mem = self.journal.headers[slot]
+            assert int(mem["op"]) == self.commit_min and int(
+                mem["command"]
+            ) == wire.Command.prepare, "checkpoint head unrecoverable"
+            head_checksum = wire.u128(mem, "checksum")
 
         if self.aof is not None:
             # The AOF is a recovery stream: make it durable at least as
@@ -414,7 +460,8 @@ class Replica:
         if self.forest is not None:
             # Spill frozen state into LSM grid blocks first so the
             # snapshot blob covers only the RAM tail (O(delta)).
-            self.sm.checkpoint_spill()
+            with self.tracer.span("lsm_spill"):
+                self.sm.checkpoint_spill()
 
         blob = self._take_snapshot()
         region = int(self.superblock.working["sequence"]) % 2
@@ -424,7 +471,7 @@ class Replica:
 
         self.superblock.checkpoint(
             commit_min=self.commit_min,
-            commit_min_checksum=wire.u128(head_header, "checksum"),
+            commit_min_checksum=head_checksum,
             commit_max=self.commit_min,
             checkpoint_offset=offset,
             checkpoint_size=len(blob),
