@@ -34,6 +34,68 @@ def test_tracer_spans():
     assert json.loads(none.dump())["traceEvents"] == []
 
 
+def test_tracer_counters_instants_and_bound():
+    t = Tracer(backend="json", buffer_max=10)
+    t.count("pipeline_depth", 3)
+    t.instant("view_change", view=2)
+    with t.span("commit", slot=5, op=77):
+        pass
+    doc = json.loads(t.dump())
+    by_name = {e["name"]: e for e in doc["traceEvents"]}
+    assert by_name["pipeline_depth"]["ph"] == "C"
+    assert by_name["pipeline_depth"]["args"]["value"] == 3
+    assert by_name["view_change"]["ph"] == "i"
+    assert by_name["commit"]["tid"] == 5
+    assert by_name["commit"]["args"]["op"] == 77
+    # Bounded buffer: oldest events drop, drop count reported.
+    for i in range(50):
+        t.count("x", i)
+    doc = json.loads(t.dump())
+    assert len(doc["traceEvents"]) == 10
+    assert doc["otherData"]["dropped_events"] == 43
+
+
+def test_server_writes_trace(tmp_path):
+    from tigerbeetle_tpu import constants as cfg
+    from tigerbeetle_tpu.runtime.native import native_available
+    from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+    if not native_available():
+        pytest.skip("native runtime not built")
+    from tigerbeetle_tpu.client import Client
+    from tigerbeetle_tpu.runtime.server import (
+        ReplicaServer,
+        format_data_file,
+    )
+
+    path = str(tmp_path / "data.tigerbeetle")
+    trace = str(tmp_path / "trace.json")
+    format_data_file(path, cluster=1, config=cfg.TEST_MIN)
+    server = ReplicaServer(
+        path, cluster=1, addresses=["127.0.0.1:0"], replica_index=0,
+        state_machine_factory=lambda: CpuStateMachine(cfg.TEST_MIN),
+        config=cfg.TEST_MIN, trace_path=trace,
+    )
+    import threading
+
+    stop = []
+    thread = threading.Thread(
+        target=lambda: [server.poll_once(1) for _ in iter(
+            lambda: not stop, False)], daemon=True
+    )
+    thread.start()
+    c = Client(f"127.0.0.1:{server.port}", 1, client_id=9)
+    assert c.create_accounts([{"id": 1, "ledger": 1, "code": 1}]) == []
+    c.close()
+    stop.append(1)
+    thread.join(timeout=5)
+    server.close()
+    doc = json.loads(open(trace).read())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "state_machine_commit" in names
+    assert "journal_write" in names
+
+
 def test_statsd_lines():
     recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     recv.bind(("127.0.0.1", 0))

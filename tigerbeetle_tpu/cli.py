@@ -25,7 +25,7 @@ USAGE = """usage: tigerbeetle-tpu <command> [flags]
 commands:
   format     --cluster=<int> --replica=<i> --replica-count=<n> <path>
   start      --addresses=<host:port,...> --replica=<i> [--cpu]
-             [--aof=<path>] <path>...
+             [--aof=<path>] [--trace=<path>] <path>...
   version
   repl       --addresses=<host:port> [--cluster=<int>] [--command=<stmts>]
   benchmark  [--transfers=N] [--accounts=N] [--batch=N] [--addresses=...]
@@ -64,7 +64,7 @@ def cmd_start(args: list[str]) -> None:
     opts, paths = flags.parse(
         args,
         {"addresses": None, "replica": 0, "cluster": 0, "cpu": False,
-         "aof": ""},
+         "aof": "", "trace": ""},
     )
     if len(paths) != 1:
         flags.fatal("start requires exactly one data-file path")
@@ -75,9 +75,21 @@ def cmd_start(args: list[str]) -> None:
         addresses=opts["addresses"].split(","), replica_index=opts["replica"],
         state_machine_factory=_sm_factory(opts["cpu"]),
         aof_path=opts["aof"] or None,
+        trace_path=opts["trace"] or None,
     )
     print(f"listening on port {server.port}", flush=True)
-    server.serve_forever()
+    # Graceful shutdown on SIGTERM/SIGINT: flush the AOF and write the
+    # trace file (close() is the only writer of --trace output).
+    import signal
+
+    def _stop(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _stop)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.close()
 
 
 def cmd_repl(args: list[str]) -> None:

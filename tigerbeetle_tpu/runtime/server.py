@@ -117,7 +117,8 @@ class ReplicaServer:
     def __init__(self, data_path: str, *, cluster: int,
                  addresses: list[str], replica_index: int,
                  state_machine_factory, config: cfg.Config = cfg.PRODUCTION,
-                 grid_size: int = 1 << 20, aof_path: str | None = None) -> None:
+                 grid_size: int = 1 << 20, aof_path: str | None = None,
+                 trace_path: str | None = None) -> None:
         layout = ZoneLayout(config=config, grid_size=grid_size)
         self.storage = FileStorage(data_path, layout)
         self.bus = TcpBus(addresses, replica_index, config.message_size_max)
@@ -133,6 +134,15 @@ class ReplicaServer:
             self.storage, cluster, state_machine_factory(), self.bus,
             replica=replica_index, replica_count=len(addresses), aof=aof,
         )
+        self._trace_path = trace_path
+        if trace_path:
+            # Chrome-trace span recording of the commit/checkpoint/
+            # journal hot paths (utils/tracer.py; written at close).
+            from tigerbeetle_tpu.utils.tracer import Tracer
+
+            self.replica.set_tracer(
+                Tracer("json", process_id=replica_index)
+            )
         self.replica.open()
         self._last_tick = 0
 
@@ -192,6 +202,8 @@ class ReplicaServer:
     def close(self) -> None:
         if self.replica.aof is not None:
             self.replica.aof.close()
+        if self._trace_path:
+            self.replica.tracer.write(self._trace_path)
         self.bus.native.close()
         self.storage.close()
 

@@ -19,11 +19,7 @@ import sys
 import numpy as np
 
 from tigerbeetle_tpu import constants as cfg
-from tigerbeetle_tpu.vsr.storage import (
-    SECTOR_SIZE,
-    MemoryStorage,
-    ZoneLayout,
-)
+from tigerbeetle_tpu.vsr.storage import MemoryStorage, ZoneLayout
 
 
 def _layout(grid_size: int = 1 << 20) -> ZoneLayout:
@@ -377,6 +373,9 @@ def main(argv: list[str]) -> int:
     rounds = 400
     args = argv[1:]
     while args:
+        if args[0] in ("--seed", "--rounds") and len(args) < 2:
+            print(f"{args[0]} requires a value")
+            return 2
         if args[0] == "--seed":
             seed = int(args[1])
         elif args[0] == "--rounds":
