@@ -1,0 +1,136 @@
+"""Standby replicas: hot spares that replicate, commit, and repair
+like backups but never ack, never vote, and never lead (reference:
+cluster topology standbys, src/simulator.zig:117-122)."""
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.harness import account, pack, transfer
+
+
+def make_cluster(**kw):
+    c = Cluster(replica_count=3, standby_count=1, **kw)
+    client = c.client(1000)
+    client.register()
+    c.run_until(lambda: client.registered)
+    return c, client
+
+
+def load(c, client, n=8, base=100):
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    for k in range(n):
+        c.run_request(client, types.Operation.create_transfers,
+                      pack([transfer(base + k, debit_account_id=1,
+                                     credit_account_id=2, amount=1)]))
+
+
+def test_standby_replicates_and_converges():
+    c, client = make_cluster()
+    load(c, client)
+    c.settle(max_steps=10000)
+    c.check_linearized()
+    c.check_convergence()
+    standby = c.replicas[3]
+    assert standby.standby
+    assert standby.commit_min == c.replicas[0].commit_min
+    assert standby.sm.transfer_timestamp(107) is not None
+
+
+def test_standby_never_acks_or_leads():
+    c, client = make_cluster()
+    load(c, client, n=4)
+    c.settle(max_steps=10000)
+    standby = c.replicas[3]
+    assert not standby.is_primary
+    # Two of three actives die: no quorum can form even though the
+    # standby is alive and current — it must not substitute for a
+    # voting replica.
+    c.crash_replica(0)
+    c.crash_replica(1)
+    live_active = c.replicas[2]
+    commit_before = live_active.commit_min
+    for _ in range(3000):
+        c.step()
+    assert live_active.commit_min == commit_before, (
+        "cluster progressed without a voting quorum"
+    )
+    # The standby never collected votes or proposed a view.
+    assert standby.status == "normal"
+    assert not standby._dvc
+    assert not standby.is_primary
+
+
+def test_standby_survives_view_change_and_repairs():
+    c, client = make_cluster()
+    load(c, client, n=5)
+    old_primary = c.replicas[0].primary_index()
+    c.network.partition(old_primary)
+    reply = c.run_request(
+        client, types.Operation.create_transfers,
+        pack([transfer(300, debit_account_id=1, credit_account_id=2,
+                       amount=7)]),
+        max_steps=6000,
+    )
+    assert reply == b""
+    c.network.heal()
+    c.settle(max_steps=10000)
+    c.check_linearized()
+    c.check_convergence()
+    standby = c.replicas[3]
+    assert standby.view == c.replicas[1].view
+    assert standby.sm.transfer_timestamp(300) is not None
+
+
+def test_standby_restart_catches_up():
+    c, client = make_cluster()
+    load(c, client, n=6)
+    c.settle(max_steps=10000)
+    c.crash_replica(3)
+    load(c, client, n=6, base=500)
+    c.restart_replica(3)
+    c.settle(max_steps=12000)
+    c.check_convergence()
+    assert c.replicas[3].sm.transfer_timestamp(505) is not None
+
+
+def test_vopr_with_standby():
+    """Whole-cluster fuzz with a standby in the topology: crash/
+    partition nemesis may hit the standby too; all invariants hold and
+    the standby converges with the actives."""
+    from tigerbeetle_tpu.testing.vopr import Vopr
+
+    v = Vopr(4242, requests=120, standby_count=1)
+    v.run()
+    standby = v.cluster.replicas[3]
+    assert standby.standby
+    assert standby.commit_min == v.cluster.replicas[0].commit_min
+
+
+def test_upgrade_waits_for_standby():
+    """The primary must not commit an upgrade while the standby still
+    runs the old binary — the hot spare would silently stop committing
+    release-2 prepares and go stale."""
+    c, client = make_cluster()
+    load(c, client, n=3)
+    # Roll only the actives: no upgrade may be proposed.
+    for i in range(3):
+        c.restart_replica(i, releases_available=(1, 2))
+    for _ in range(600):
+        c.step()
+    assert all(r.upgrade_target is None for r in c.replicas)
+    # Roll the standby too: now the upgrade commits cluster-wide.
+    c.restart_replica(3, releases_available=(1, 2))
+    c.run_until(
+        lambda: all(
+            r.upgrade_target == 2 for i, r in enumerate(c.replicas)
+            if i < 3
+        ),
+        max_steps=8000,
+    )
+    for i in range(4):
+        c.restart_replica(i, release=2, releases_available=(1, 2))
+    c.settle(max_steps=10000)
+    assert all(r.release == 2 for r in c.replicas)
+    c.check_convergence()

@@ -25,7 +25,8 @@ USAGE = """usage: tigerbeetle-tpu <command> [flags]
 commands:
   format     --cluster=<int> --replica=<i> --replica-count=<n> <path>
   start      --addresses=<host:port,...> --replica=<i> [--cpu]
-             [--aof=<path>] [--trace=<path>] <path>...
+             [--aof=<path>] [--trace=<path>] [--standby-count=<n>]
+             <path>...
   version
   repl       --addresses=<host:port> [--cluster=<int>] [--command=<stmts>]
   benchmark  [--transfers=N] [--accounts=N] [--batch=N] [--addresses=...]
@@ -64,7 +65,7 @@ def cmd_start(args: list[str]) -> None:
     opts, paths = flags.parse(
         args,
         {"addresses": None, "replica": 0, "cluster": 0, "cpu": False,
-         "aof": "", "trace": ""},
+         "aof": "", "trace": "", "standby_count": 0},
     )
     if len(paths) != 1:
         flags.fatal("start requires exactly one data-file path")
@@ -76,6 +77,7 @@ def cmd_start(args: list[str]) -> None:
         state_machine_factory=_sm_factory(opts["cpu"]),
         aof_path=opts["aof"] or None,
         trace_path=opts["trace"] or None,
+        standby_count=opts["standby_count"],
     )
     print(f"listening on port {server.port}", flush=True)
     # Graceful shutdown on SIGTERM/SIGINT: flush the AOF and write the
@@ -89,6 +91,9 @@ def cmd_start(args: list[str]) -> None:
     try:
         server.serve_forever()
     except KeyboardInterrupt:
+        pass
+    finally:
+        # Crashes flush the trace/AOF too, not just clean shutdowns.
         server.close()
 
 

@@ -118,7 +118,8 @@ class ReplicaServer:
                  addresses: list[str], replica_index: int,
                  state_machine_factory, config: cfg.Config = cfg.PRODUCTION,
                  grid_size: int = 1 << 20, aof_path: str | None = None,
-                 trace_path: str | None = None) -> None:
+                 trace_path: str | None = None,
+                 standby_count: int = 0) -> None:
         layout = ZoneLayout(config=config, grid_size=grid_size)
         self.storage = FileStorage(data_path, layout)
         self.bus = TcpBus(addresses, replica_index, config.message_size_max)
@@ -130,9 +131,18 @@ class ReplicaServer:
             from tigerbeetle_tpu.vsr.aof import AOF
 
             aof = AOF(aof_path)
+        # The address list covers actives THEN standbys; the last
+        # `standby_count` processes replicate without voting.
+        if not 0 <= standby_count < len(addresses):
+            raise ValueError(
+                f"standby_count {standby_count} must leave at least one "
+                f"active replica among {len(addresses)} addresses"
+            )
         self.replica = VsrReplica(
             self.storage, cluster, state_machine_factory(), self.bus,
-            replica=replica_index, replica_count=len(addresses), aof=aof,
+            replica=replica_index,
+            replica_count=len(addresses) - standby_count,
+            standby_count=standby_count, aof=aof,
         )
         self._trace_path = trace_path
         if trace_path:

@@ -200,11 +200,13 @@ class SimClient:
 
 class Cluster:
     def __init__(self, replica_count: int = 3, *, seed: int = 0,
+                 standby_count: int = 0,
                  config: cfg.Config = cfg.TEST_MIN,
                  options: PacketOptions | None = None,
                  state_machine_factory=None) -> None:
         self.cluster_id = 0xC1
         self.replica_count = replica_count
+        self.standby_count = standby_count
         self.config = config
         self.network = PacketSimulator(options or PacketOptions(), seed)
         factory = state_machine_factory or (lambda: CpuStateMachine(config))
@@ -212,7 +214,7 @@ class Cluster:
 
         self.replicas: list[VsrReplica] = []
         self.storages: list[MemoryStorage] = []
-        for i in range(replica_count):
+        for i in range(replica_count + standby_count):
             storage = MemoryStorage(
                 ZoneLayout(config=config, grid_size=1 << 20), seed=seed + i
             )
@@ -220,6 +222,7 @@ class Cluster:
             r = VsrReplica(
                 storage, self.cluster_id, factory(), _Bus(self, i),
                 replica=i, replica_count=replica_count,
+                standby_count=standby_count,
             )
             r.hash_log = HashLog()
             r.open()
@@ -233,12 +236,13 @@ class Cluster:
         # observes realtime + clock_skew[i].  The synchronized clock
         # (vsr/clock.py) must keep primary timestamps near true time
         # despite this.
-        self.clock_skew = [0] * replica_count
+        self.clock_skew = [0] * (replica_count + standby_count)
 
     def client(self, client_id: int) -> SimClient:
-        # Replica addresses occupy [0, replica_count) in the packet
-        # simulator's flat namespace.
-        assert client_id >= self.replica_count, "client id collides with replica"
+        # Replica addresses (actives then standbys) occupy
+        # [0, replica_count + standby_count) in the packet simulator's
+        # flat namespace.
+        assert client_id >= len(self.replicas), "client id collides with replica"
         c = SimClient(self, client_id)
         self.clients[client_id] = c
         return c
@@ -269,6 +273,7 @@ class Cluster:
             storage, self.cluster_id,
             state_machine or self._factory(), _Bus(self, index),
             replica=index, replica_count=self.replica_count,
+            standby_count=self.standby_count,
             release=release if release is not None else old.release,
             releases_available=avail,
         )
@@ -294,7 +299,7 @@ class Cluster:
         self.network.advance(self._deliver)
 
     def _deliver(self, dst, header: np.ndarray, body: bytes) -> None:
-        if isinstance(dst, int) and dst < self.replica_count:
+        if isinstance(dst, int) and dst < len(self.replicas):
             # A crashed process receives nothing: in-flight packets to
             # it die with it (processing them would let a zombie
             # journal prepares and send acks from beyond the grave).
@@ -326,8 +331,8 @@ class Cluster:
     def check_linearized(self) -> None:
         """Every pair of replicas agrees on the prepare at every op
         both have committed."""
-        for a in range(self.replica_count):
-            for b in range(a + 1, self.replica_count):
+        for a in range(len(self.replicas)):
+            for b in range(a + 1, len(self.replicas)):
                 ra, rb = self.replicas[a], self.replicas[b]
                 # The checkpoint op itself may never have been
                 # journaled (state sync installs state, not prepares):

@@ -133,6 +133,7 @@ class FaultAtlas:
 
 class Vopr:
     def __init__(self, seed: int, *, replica_count: int = 3,
+                 standby_count: int = 0,
                  requests: int = 40,
                  packet_loss: float = 0.02,
                  crash_probability: float = 0.01,
@@ -143,6 +144,7 @@ class Vopr:
         self.rng = np.random.default_rng(seed + 1)
         self.cluster = Cluster(
             replica_count=replica_count, seed=seed,
+            standby_count=standby_count,
             options=PacketOptions(packet_loss_probability=packet_loss),
             state_machine_factory=state_machine_factory,
         )
@@ -270,7 +272,7 @@ class Vopr:
                 c.restart_replica(i)
             return
         if self.rng.random() < self.crash_probability:
-            i = int(self.rng.integers(c.replica_count))
+            i = int(self.rng.integers(len(c.replicas)))
             c.crash_replica(i)
             self.crashed.add(i)
 
@@ -332,7 +334,7 @@ class Vopr:
         op commits its target."""
         c = self.cluster
         if self.rng.random() < 0.005:
-            i = int(self.rng.integers(c.replica_count))
+            i = int(self.rng.integers(len(c.replicas)))
             if i not in self.crashed and (
                 max(c.replicas[i].releases_available) < 2
             ):

@@ -21,6 +21,7 @@ can leave tracing on.
 
 from __future__ import annotations
 
+import collections
 import json
 import time
 
@@ -48,7 +49,11 @@ class Tracer:
         self.clock = clock
         self.buffer_max = buffer_max
         self._open: dict[tuple[str, int], tuple[int, dict | None]] = {}
-        self._spans: list[dict] = []
+        # deque(maxlen) drops oldest in O(1); a list shift per event
+        # would make every traced hot-path op O(buffer_max) once full.
+        self._spans: collections.deque[dict] = collections.deque(
+            maxlen=buffer_max
+        )
         self.dropped = 0
 
     # -- spans ---------------------------------------------------------
@@ -113,17 +118,15 @@ class Tracer:
     # -- output --------------------------------------------------------
 
     def _push(self, event: dict) -> None:
+        if len(self._spans) == self.buffer_max:
+            self.dropped += 1
         self._spans.append(event)
-        if len(self._spans) > self.buffer_max:
-            drop = len(self._spans) - self.buffer_max
-            del self._spans[:drop]
-            self.dropped += drop
 
     def dump(self) -> str:
         assert not self._open, f"open spans at dump: {list(self._open)}"
         return json.dumps(
             {
-                "traceEvents": self._spans,
+                "traceEvents": list(self._spans),
                 "otherData": {"dropped_events": self.dropped},
             }
         )

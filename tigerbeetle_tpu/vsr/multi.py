@@ -85,6 +85,7 @@ class VsrReplica(Replica):
 
     def __init__(self, storage, cluster, state_machine, bus, *,
                  replica: int, replica_count: int,
+                 standby_count: int = 0,
                  release: int = 1,
                  releases_available: tuple[int, ...] = (1,),
                  aof=None) -> None:
@@ -92,6 +93,13 @@ class VsrReplica(Replica):
                          replica=replica, replica_count=replica_count,
                          aof=aof)
         self.bus = bus
+        # Standbys (reference: replicas beyond replica_count in the
+        # cluster topology): journal prepares, commit, repair, and
+        # state-sync like backups, but never ack (prepare_ok), never
+        # vote in view changes, and never become primary — hot spares
+        # that don't count toward (or endanger) any quorum.
+        self.standby_count = standby_count
+        self.standby = replica >= replica_count
         self.status = "recovering"
         self.log_view = 0
 
@@ -239,7 +247,7 @@ class VsrReplica(Replica):
                 self._request_start_view()
         if not self.monotonic_external:
             self.monotonic += TICK_NS
-        if self.replica_count > 1:
+        if self.replica_count > 1 and not self.standby:
             if self._ticks - self._last_clock_ping >= PING_TICKS:
                 self._send_clock_pings()
             self.clock.expire(self.monotonic)
@@ -256,7 +264,13 @@ class VsrReplica(Replica):
                     self._retransmit_pipeline()
             else:
                 if self._ticks - self._last_primary_seen >= VIEW_CHANGE_TICKS:
-                    self._start_view_change(self.view + 1)
+                    if self.standby:
+                        # Cannot vote a new view in: poll the actives
+                        # for the canonical state instead.
+                        self._last_primary_seen = self._ticks
+                        self._request_start_view()
+                    else:
+                        self._start_view_change(self.view + 1)
         elif self.status == "view_change":
             if self._ticks - self._vc_last_sent >= VIEW_CHANGE_RESEND_TICKS:
                 self._broadcast_svc()
@@ -300,7 +314,7 @@ class VsrReplica(Replica):
             return
         if self.replica_count > 1 and not self.clock.synchronized:
             return  # same clock gate as every other prepare path
-        if len(self.peer_release) < self.replica_count:
+        if len(self.peer_release) < self.total_count:
             return
         target = min(self.peer_release.values())
         if target <= self.release:
@@ -333,6 +347,11 @@ class VsrReplica(Replica):
         wire.finalize_header(req, b"")
         self._primary_prepare(req, b"")
 
+    @property
+    def total_count(self) -> int:
+        """Actives + standbys."""
+        return self.replica_count + self.standby_count
+
     def _send_heartbeat(self) -> None:
         self._last_ping_sent = self._ticks
         h = wire.make_header(
@@ -344,7 +363,7 @@ class VsrReplica(Replica):
             context=self.commit_parent or 0,
         )
         wire.finalize_header(h, b"")
-        for r in range(self.replica_count):
+        for r in range(self.total_count):
             if r != self.replica:
                 self.bus.send(r, h, b"")
 
@@ -581,8 +600,12 @@ class VsrReplica(Replica):
 
     def _replicate(self, prepare: np.ndarray, body: bytes) -> None:
         """Ring forwarding: send to successor only (reference:
-        src/vsr/replica.zig:1532-1556)."""
-        if self.replica_count == 1:
+        src/vsr/replica.zig:1532-1556).  The primary additionally
+        feeds each standby directly; standbys never forward."""
+        if self.is_primary:
+            for s in range(self.replica_count, self.total_count):
+                self.bus.send(s, prepare, body)
+        if self.standby or self.replica_count == 1:
             return
         succ = (self.replica + 1) % self.replica_count
         if succ != self.primary_index(int(prepare["view"])):
@@ -881,8 +904,8 @@ class VsrReplica(Replica):
             self._send_repair_requests()
 
     def _send_prepare_ok(self, prepare: np.ndarray) -> None:
-        if self.status != "normal" or self.is_primary:
-            return
+        if self.status != "normal" or self.is_primary or self.standby:
+            return  # standbys replicate without acking: no quorum role
         ok = wire.make_header(
             command=Command.prepare_ok, cluster=self.cluster, view=self.view,
             op=int(prepare["op"]), replica=self.replica,
@@ -1018,7 +1041,10 @@ class VsrReplica(Replica):
             release=max(self.releases_available),
         )
         wire.finalize_header(ping, b"")
-        for r in range(self.replica_count):
+        # Standbys are pinged too: their pong advertises their release,
+        # so an upgrade never commits while the hot spare would be left
+        # behind unable to execute the new release's prepares.
+        for r in range(self.total_count):
             if r != self.replica:
                 self.bus.send(r, ping, b"")
 
@@ -1044,6 +1070,8 @@ class VsrReplica(Replica):
 
     def _on_pong(self, header: np.ndarray, body: bytes) -> None:
         self._learn_peer_release(header)
+        if int(header["replica"]) >= self.replica_count:
+            return  # standby pongs advertise releases, not clock samples
         self.clock.learn(
             int(header["replica"]),
             m0=int(header["timestamp"]),
@@ -1652,6 +1680,8 @@ class VsrReplica(Replica):
                 self.bus.send(r, h, b"")
 
     def _on_start_view_change(self, header: np.ndarray, body: bytes) -> None:
+        if self.standby:
+            return  # non-voting; the start_view brings the outcome
         view = int(header["view"])
         if view < self.view:
             return
@@ -1671,6 +1701,8 @@ class VsrReplica(Replica):
             self._send_do_view_change()
 
     def _send_do_view_change(self) -> None:
+        if self.standby:
+            return
         # Persist before participating (reference: superblock view_change).
         self.superblock.view_change(self.view, self.log_view, self.commit_max)
         payload = {
@@ -1866,7 +1898,7 @@ class VsrReplica(Replica):
         wire.finalize_header(h, body)
         targets = (
             [dst] if dst is not None
-            else [r for r in range(self.replica_count) if r != self.replica]
+            else [r for r in range(self.total_count) if r != self.replica]
         )
         for r in targets:
             self.bus.send(r, h, body)
