@@ -134,3 +134,24 @@ def test_upgrade_waits_for_standby():
     c.settle(max_steps=10000)
     assert all(r.release == 2 for r in c.replicas)
     c.check_convergence()
+
+
+def test_upgrade_works_with_solo_active_and_standby():
+    """A 1-active + 1-standby topology still upgrades: release
+    advertisement rides clock pings, which a solo active must keep
+    sending when standbys exist."""
+    c = Cluster(replica_count=1, standby_count=1)
+    client = c.client(1000)
+    client.register()
+    c.run_until(lambda: client.registered)
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    for i in range(2):
+        c.restart_replica(i, releases_available=(1, 2))
+    c.run_until(
+        lambda: c.replicas[0].upgrade_target == 2, max_steps=8000
+    )
+    for i in range(2):
+        c.restart_replica(i, release=2, releases_available=(1, 2))
+    c.settle(max_steps=8000)
+    assert all(r.release == 2 for r in c.replicas)

@@ -81,19 +81,48 @@ _flush = jax.jit(_flush_impl, donate_argnums=(0,))
 
 
 class DeviceTable:
-    """The authoritative HBM balance table + its write-behind queue."""
+    """The authoritative HBM balance table + its write-behind queue.
+
+    On a multi-device mesh the table is sharded ROW-WISE across every
+    device (jax.sharding.NamedSharding over a 1-D "shard" mesh), so
+    the fused flush scatter runs SPMD with XLA-inserted collectives —
+    the production-path integration of the parallel/sharded.py design.
+    Single-device (the common one-chip TPU case) stays a plain array.
+    """
 
     def __init__(self, capacity: int) -> None:
-        self.balances = jnp.zeros((capacity, 8), jnp.uint64)
+        self.sharding = None
+        devices = jax.devices()
+        if len(devices) > 1 and capacity % len(devices) == 0:
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            mesh = Mesh(np.array(devices), ("shard",))
+            self.sharding = NamedSharding(mesh, P("shard", None))
+        self.balances = self._place(jnp.zeros((capacity, 8), jnp.uint64))
         self._q: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = []
         self._queued = 0
+
+    def _place(self, table):
+        if self.sharding is None:
+            return table
+        return jax.device_put(table, self.sharding)
 
     def grow(self, capacity: int) -> None:
         have = self.balances.shape[0]
         if capacity <= have:
             return
         extra = jnp.zeros((capacity - have, 8), jnp.uint64)
-        self.balances = jnp.concatenate([self.balances, extra])
+        if self.sharding is None:
+            # Stays on-device and async — growth must not introduce a
+            # host round-trip on the commit path.
+            self.balances = jnp.concatenate([self.balances, extra])
+        else:
+            # Resharding to the new row count goes through the host
+            # (row boundaries move between devices).
+            self.balances = self._place(
+                jnp.concatenate([jax.device_get(self.balances), extra])
+            )
 
     def enqueue(self, slots, cols, add_lo, add_hi) -> None:
         """Queue compact (slot, col, delta) modular adds."""

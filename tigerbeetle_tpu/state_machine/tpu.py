@@ -1843,8 +1843,8 @@ def _tpu_restore(self, data: bytes) -> None:
     if self._native is not None:
         self._rebuild_native(cap)
     self._dev = kernel_fast.DeviceTable(cap)
-    self._dev.balances = jnp.asarray(
-        self._mirror.rows8(np.arange(cap, dtype=np.int64))
+    self._dev.balances = self._dev._place(
+        jnp.asarray(self._mirror.rows8(np.arange(cap, dtype=np.int64)))
     )
     self._expiry_rows = None
 

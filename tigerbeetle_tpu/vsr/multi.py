@@ -247,7 +247,10 @@ class VsrReplica(Replica):
                 self._request_start_view()
         if not self.monotonic_external:
             self.monotonic += TICK_NS
-        if self.replica_count > 1 and not self.standby:
+        if self.total_count > 1 and not self.standby:
+            # Pings double as release advertisement, so a solo active
+            # with standbys still pings (upgrades gate on EVERY
+            # process's release, standbys included).
             if self._ticks - self._last_clock_ping >= PING_TICKS:
                 self._send_clock_pings()
             self.clock.expire(self.monotonic)
