@@ -42,6 +42,8 @@ constexpr int OFF_DR_LO = 16;
 constexpr int OFF_CR_LO = 32;
 constexpr int OFF_AMOUNT_LO = 48;
 constexpr int OFF_PENDING_LO = 64;
+constexpr int OFF_UD128_LO = 80;
+constexpr int OFF_UD64 = 96;
 constexpr int OFF_UD32 = 104;
 constexpr int OFF_TIMEOUT = 108;
 constexpr int OFF_LEDGER = 112;
@@ -221,6 +223,9 @@ struct Fastpath {
     // flat arrays over slot*4+col — O(1) accumulate with no hashing and
     // no per-batch clearing.
     std::unordered_set<u128, U128Hash> batch_ids;
+    std::unordered_map<u128, uint32_t, U128Hash> batch_map;  // id -> index
+    std::unordered_map<int64_t, uint32_t> dur_map;  // store row -> status
+    std::vector<uint8_t> st_scratch;   // in-batch pending statuses
     std::vector<u128> delta_sum;       // capacity*4
     std::vector<uint32_t> delta_epoch; // capacity*4
     std::vector<uint64_t> delta_keys;  // touched keys, insertion order
@@ -455,3 +460,5 @@ int tb_fp_commit_transfers(
 }  // extern "C"
 
 #include "tb_exact.inc"
+#include "tb_linked.inc"
+#include "tb_two_phase.inc"

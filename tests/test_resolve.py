@@ -1,0 +1,293 @@
+"""Differential coverage of the vectorized order-dependent resolvers.
+
+resolve.py puts linked-chain and two-phase batches on the device
+scatter-add path; these tests fuzz exactly the workload shapes that
+route there and diff every reply and the final wire state against the
+CPU oracle — asserting via the routing counters that the new paths
+actually ran (a silently-punting resolver must not pass as covered).
+
+reference: src/state_machine.zig:1220-1306 (chain loop), :1608-1741
+(post/void) — the semantics under test.
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+from tigerbeetle_tpu.testing.harness import SingleNodeHarness
+
+TF = types.TransferFlags
+AF = types.AccountFlags
+Op = types.Operation
+
+
+@pytest.fixture(params=["native", "python"])
+def engine(request):
+    """Both resolver backends: the native serial resolvers
+    (tb_linked.inc / tb_two_phase.inc) and the pure-numpy fallbacks
+    (resolve.py) must produce identical durable state."""
+    return request.param
+
+
+def replay_both(ops, engine="native"):
+    replies = []
+    machines = []
+    for sm in (TpuStateMachine(), CpuStateMachine()):
+        if engine == "python" and getattr(sm, "_native", None) is not None:
+            # Drop the native resolvers but keep the shared mirror
+            # memory alive (the views hold the owner reference).
+            sm._native = None
+        h = SingleNodeHarness(sm)
+        replies.append([h.submit(op, body) for op, body in ops])
+        machines.append((sm, h))
+    return replies, machines
+
+
+def assert_parity(ops, account_ids, transfer_ids, engine="native"):
+    (rt, rc), machines = replay_both(ops, engine)
+    for i, (a, b) in enumerate(zip(rt, rc)):
+        assert a == b, f"reply {i} differs"
+    lk = np.zeros(len(account_ids), dtype=types.U128_PAIR_DTYPE)
+    lk["lo"] = account_ids
+    lt = np.zeros(len(transfer_ids), dtype=types.U128_PAIR_DTYPE)
+    lt["lo"] = transfer_ids
+    final = []
+    for sm, h in machines:
+        final.append(
+            (
+                h.submit(Op.lookup_accounts, lk.tobytes()),
+                h.submit(Op.lookup_transfers, lt.tobytes()),
+            )
+        )
+    assert final[0] == final[1], "final wire state differs"
+    return machines[0][0]  # the TpuStateMachine, for routing asserts
+
+
+def make_accounts(n, limit_frac=0.0, rng=None):
+    flags = np.zeros(n, np.uint16)
+    if limit_frac:
+        k = int(n * limit_frac)
+        flags[: k // 2] = int(AF.debits_must_not_exceed_credits)
+        flags[k // 2 : k] = int(AF.credits_must_not_exceed_debits)
+    accts = np.zeros(n, dtype=types.ACCOUNT_DTYPE)
+    accts["id_lo"] = np.arange(1, n + 1)
+    accts["ledger"] = 1
+    accts["code"] = 1
+    accts["flags"] = flags
+    return accts
+
+
+def chain_batch(rng, n_events, n_acct, id0, max_len=7, amt_hi=200):
+    lens = rng.integers(1, max_len + 1, n_events)
+    ends = np.cumsum(lens)
+    n_chains = int(np.searchsorted(ends, n_events)) + 1
+    lens = lens[:n_chains]
+    total = int(lens.sum())
+    last = np.cumsum(lens) - 1
+    tf = np.zeros(total, dtype=types.TRANSFER_DTYPE)
+    tf["id_lo"] = np.arange(id0, id0 + total)
+    fl = np.full(total, int(TF.linked), np.uint16)
+    fl[last] = 0
+    tf["flags"] = fl
+    dr = rng.integers(1, n_acct + 1, total)
+    cr = rng.integers(1, n_acct + 1, total)
+    clash = cr == dr
+    cr[clash] = dr[clash] % n_acct + 1
+    tf["debit_account_id_lo"] = dr
+    tf["credit_account_id_lo"] = cr
+    tf["amount_lo"] = rng.integers(1, amt_hi, total)
+    tf["ledger"] = 1
+    tf["code"] = 1
+    return tf
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_linked_limit_parity(seed, engine):
+    """Chains over limit accounts: failure cascades, rollbacks, and
+    re-credits interact across the batch; the fixpoint must land on
+    the sequential answer."""
+    rng = np.random.default_rng(seed)
+    n_acct = 24
+    ops = [(Op.create_accounts, make_accounts(n_acct, limit_frac=0.5).tobytes())]
+    # Fund limited accounts tightly so trips are common.
+    k = n_acct // 2
+    f = np.zeros(k, dtype=types.TRANSFER_DTYPE)
+    f["id_lo"] = np.arange(900_000, 900_000 + k)
+    f["debit_account_id_lo"] = n_acct
+    f["credit_account_id_lo"] = np.arange(1, k + 1)
+    f["amount_lo"] = rng.integers(100, 800, k)
+    f["ledger"] = 1
+    f["code"] = 1
+    ops.append((Op.create_transfers, f.tobytes()))
+    tid = []
+    for b in range(4):
+        tf = chain_batch(rng, 300, n_acct, 1_000 + b * 10_000)
+        tid.extend(tf["id_lo"])
+        ops.append((Op.create_transfers, tf.tobytes()))
+    sm = assert_parity(ops, np.arange(1, n_acct + 1), np.asarray(tid), engine)
+    assert sm.stat_linked_batches >= 4
+
+
+def test_linked_static_failures_in_chains(engine):
+    """Static failures (bad ledger, zero amount, not-found accounts)
+    inside chains must fail the whole chain with correct codes."""
+    rng = np.random.default_rng(99)
+    n_acct = 10
+    ops = [(Op.create_accounts, make_accounts(n_acct, limit_frac=0.4).tobytes())]
+    tf = chain_batch(rng, 120, n_acct, 5_000)
+    # Poison scattered members.
+    tf["ledger"][10] = 9  # wrong ledger
+    tf["amount_lo"][33] = 0  # amount_must_not_be_zero
+    tf["debit_account_id_lo"][57] = 4_242  # not found
+    tf["credit_account_id_lo"][80] = tf["debit_account_id_lo"][80]  # same acct
+    ops.append((Op.create_transfers, tf.tobytes()))
+    sm = assert_parity(ops, np.arange(1, n_acct + 1), tf["id_lo"], engine)
+    assert sm.stat_linked_batches >= 1
+
+
+def test_linked_chain_open_tail(engine):
+    """A batch ending on an open chain: chain_open sticks to the last
+    event even when the chain already failed earlier."""
+    n_acct = 6
+    ops = [(Op.create_accounts, make_accounts(n_acct, limit_frac=0.5).tobytes())]
+    tf = np.zeros(5, dtype=types.TRANSFER_DTYPE)
+    tf["id_lo"] = np.arange(100, 105)
+    tf["flags"] = [0, int(TF.linked), int(TF.linked), int(TF.linked), int(TF.linked)]
+    tf["debit_account_id_lo"] = [4, 1, 4, 5, 4]  # account 1 is debit-limited
+    tf["credit_account_id_lo"] = [5, 4, 5, 4, 6]
+    tf["amount_lo"] = [5, 1_000_000, 7, 8, 9]  # member 1 trips the limit
+    tf["ledger"] = 1
+    tf["code"] = 1
+    ops.append((Op.create_transfers, tf.tobytes()))
+    sm = assert_parity(ops, np.arange(1, n_acct + 1), tf["id_lo"], engine)
+    assert sm.stat_linked_batches >= 1
+
+
+def test_plain_batch_on_limit_accounts_routes(engine):
+    """Chain-free batches touching limit accounts take the resolver
+    (not the serial engine): all chains have length 1."""
+    rng = np.random.default_rng(5)
+    n_acct = 16
+    ops = [(Op.create_accounts, make_accounts(n_acct, limit_frac=0.5).tobytes())]
+    tf = np.zeros(200, dtype=types.TRANSFER_DTYPE)
+    tf["id_lo"] = np.arange(300, 500)
+    dr = rng.integers(1, n_acct + 1, 200)
+    cr = rng.integers(1, n_acct + 1, 200)
+    clash = cr == dr
+    cr[clash] = dr[clash] % n_acct + 1
+    tf["debit_account_id_lo"] = dr
+    tf["credit_account_id_lo"] = cr
+    tf["amount_lo"] = rng.integers(1, 50, 200)
+    tf["ledger"] = 1
+    tf["code"] = 1
+    ops.append((Op.create_transfers, tf.tobytes()))
+    sm = assert_parity(ops, np.arange(1, n_acct + 1), tf["id_lo"], engine)
+    assert sm.stat_linked_batches >= 1
+    assert sm.stat_exact_events == 0
+
+
+def two_phase_batch(rng, n_pairs, n_acct, id0, prev_pend, void_frac=0.3):
+    ids = np.arange(id0, id0 + 2 * n_pairs, dtype=np.uint64)
+    tf = np.zeros(2 * n_pairs, dtype=types.TRANSFER_DTYPE)
+    tf["id_lo"] = ids
+    fl = np.zeros(2 * n_pairs, np.uint16)
+    fl[0::2] = int(TF.pending)
+    void = rng.random(n_pairs) < void_frac
+    fl[1::2] = np.where(
+        void, int(TF.void_pending_transfer), int(TF.post_pending_transfer)
+    )
+    tf["flags"] = fl
+    dr = rng.integers(1, n_acct + 1, n_pairs).astype(np.uint64)
+    tf["debit_account_id_lo"][0::2] = dr
+    tf["credit_account_id_lo"][0::2] = dr % n_acct + 1
+    tf["amount_lo"][0::2] = rng.integers(1, 100, n_pairs)
+    pend_id = ids[0::2].copy()
+    for i in range(n_pairs):
+        r = rng.random()
+        if prev_pend and r < 0.15:
+            pend_id[i] = rng.choice(prev_pend)  # durable target (or race)
+        elif r < 0.20:
+            pend_id[i] = ids[0::2][rng.integers(0, n_pairs)]  # in-batch race
+        elif r < 0.25:
+            pend_id[i] = 77_000_000 + i  # not found
+    tf["pending_id_lo"][1::2] = pend_id
+    # Partial amounts, inherits, mismatching fields.
+    part = rng.random(n_pairs) < 0.3
+    tf["amount_lo"][1::2] = np.where(part, rng.integers(0, 130, n_pairs), 0)
+    tf["user_data_64"][1::2] = np.where(rng.random(n_pairs) < 0.1, 9, 0)
+    mism = rng.random(n_pairs) < 0.08
+    tf["ledger"][1::2] = np.where(mism, 3, 0)
+    tf["ledger"][0::2] = 1
+    tf["code"][0::2] = 1
+    tf["code"][1::2] = np.where(rng.random(n_pairs) < 0.08, 5, 0)
+    return tf, ids[0::2]
+
+
+@pytest.mark.parametrize("seed", [10, 11, 12, 13])
+def test_two_phase_parity(seed, engine):
+    """Pending/post/void with in-batch + durable references, races,
+    inherits, partial posts, and mismatch codes."""
+    rng = np.random.default_rng(seed)
+    n_acct = 40
+    ops = [(Op.create_accounts, make_accounts(n_acct).tobytes())]
+    prev_pend = []
+    tid = []
+    for b in range(4):
+        tf, pend_ids = two_phase_batch(rng, 250, n_acct, 1 + b * 100_000, prev_pend)
+        tid.extend(tf["id_lo"])
+        ops.append((Op.create_transfers, tf.tobytes()))
+        prev_pend.extend(pend_ids[rng.random(len(pend_ids)) < 0.25])
+    sm = assert_parity(ops, np.arange(1, n_acct + 1), np.asarray(tid), engine)
+    assert sm.stat_two_phase_batches >= 3  # adversarial shapes may punt one
+
+
+def test_two_phase_cross_batch_status(engine):
+    """A pending finalized in batch 1 must reject re-finalization in
+    batch 2 with the winner's status code, through the resolver."""
+    n_acct = 4
+    ops = [(Op.create_accounts, make_accounts(n_acct).tobytes())]
+    t1 = np.zeros(2, dtype=types.TRANSFER_DTYPE)
+    t1["id_lo"] = [10, 11]
+    t1["flags"] = [int(TF.pending), int(TF.post_pending_transfer)]
+    t1["debit_account_id_lo"][0] = 1
+    t1["credit_account_id_lo"][0] = 2
+    t1["amount_lo"][0] = 50
+    t1["pending_id_lo"][1] = 10
+    t1["ledger"][0] = 1
+    t1["code"][0] = 1
+    ops.append((Op.create_transfers, t1.tobytes()))
+    t2 = np.zeros(2, dtype=types.TRANSFER_DTYPE)
+    t2["id_lo"] = [20, 21]
+    t2["flags"] = [int(TF.void_pending_transfer), int(TF.post_pending_transfer)]
+    t2["pending_id_lo"] = [10, 10]
+    ops.append((Op.create_transfers, t2.tobytes()))
+    sm = assert_parity(ops, np.arange(1, n_acct + 1), np.asarray([10, 11, 20, 21]), engine)
+    assert sm.stat_two_phase_batches >= 2
+
+
+def test_resolver_punts_stay_exact(engine):
+    """Shapes outside the resolvers' contracts (duplicate ids in a pv
+    batch, balancing flags in chains) must flow to the exact engine
+    and still match the oracle."""
+    rng = np.random.default_rng(77)
+    n_acct = 12
+    ops = [(Op.create_accounts, make_accounts(n_acct, limit_frac=0.5).tobytes())]
+    tf = chain_batch(rng, 60, n_acct, 3_000)
+    tf["flags"][5] |= int(TF.balancing_debit)
+    ops.append((Op.create_transfers, tf.tobytes()))
+    dup = np.zeros(4, dtype=types.TRANSFER_DTYPE)
+    dup["id_lo"] = [7_000, 7_000, 7_001, 7_002]  # in-batch duplicate
+    dup["flags"][3] = int(TF.post_pending_transfer)
+    dup["debit_account_id_lo"][:3] = 9
+    dup["credit_account_id_lo"][:3] = 10
+    dup["amount_lo"][:3] = 5
+    dup["pending_id_lo"][3] = 7_000
+    dup["ledger"][:3] = 1
+    dup["code"][:3] = 1
+    ops.append((Op.create_transfers, dup.tobytes()))
+    assert_parity(
+        ops, np.arange(1, n_acct + 1),
+        np.concatenate([tf["id_lo"], dup["id_lo"]]), engine,
+    )

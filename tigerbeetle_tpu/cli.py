@@ -64,15 +64,24 @@ def cmd_format(args: list[str]) -> None:
 def cmd_start(args: list[str]) -> None:
     opts, paths = flags.parse(
         args,
-        {"addresses": None, "replica": 0, "cluster": 0, "cpu": False,
+        {"addresses": None, "replica": 0, "cluster": "", "cpu": False,
          "aof": "", "trace": "", "standby_count": 0},
     )
     if len(paths) != 1:
         flags.fatal("start requires exactly one data-file path")
     from tigerbeetle_tpu.runtime.server import ReplicaServer
 
+    # --cluster is optional: the data file records it at format time
+    # (reference: src/tigerbeetle/main.zig start reads the superblock);
+    # passing it explicitly just adds a consistency check.
+    cluster = None
+    if opts["cluster"]:
+        try:
+            cluster = int(opts["cluster"], 0)
+        except ValueError:
+            flags.fatal(f"--cluster: invalid integer {opts['cluster']!r}")
     server = ReplicaServer(
-        paths[0], cluster=opts["cluster"],
+        paths[0], cluster=cluster,
         addresses=opts["addresses"].split(","), replica_index=opts["replica"],
         state_machine_factory=_sm_factory(opts["cpu"]),
         aof_path=opts["aof"] or None,

@@ -85,6 +85,25 @@ def _load():
             ctypes.c_void_p, _U8P, ctypes.c_uint32, ctypes.c_uint64,
             _U32P, _I32P, _I32P, _I64P, _I64P, _U64P, _U64P, _U32P,
         ]
+        lib.tb_fp_commit_linked.restype = ctypes.c_int
+        lib.tb_fp_commit_linked.argtypes = [
+            ctypes.c_void_p, _U8P, ctypes.c_uint32, ctypes.c_uint64,
+            _U32P, _I32P, _I32P, _I64P, _I64P, _U64P, _U64P, _U32P,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.tb_fp_commit_two_phase.restype = ctypes.c_int
+        lib.tb_fp_commit_two_phase.argtypes = [
+            ctypes.c_void_p, _U8P, ctypes.c_uint32, ctypes.c_uint64,
+            # durable-target join
+            _I64P, _U32P, _I32P, _I32P, _U64P, _U64P, _U32P, _U32P,
+            _U64P, _U64P, _U64P, _U32P, _U32P, _U32P,
+            # outputs
+            _U32P, _I32P, _I32P, _U64P, _U64P, _U64P, _U64P, _U64P,
+            _U32P, _U32P, _U32P, _U32P,
+            _I64P, _U32P, _U32P,
+            _I64P, _I64P, _U64P, _U64P, _U32P,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
         lib.tb_fp_commit_exact.restype = ctypes.c_int
         lib.tb_fp_commit_exact.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
@@ -147,6 +166,24 @@ class NativeFastpath:
         self._ndeltas = ctypes.c_uint32(0)
         self._packed = None
         self._field_dtypes = None
+        self._last_applied = ctypes.c_int32(-1)
+        # Two-phase resolver outputs (reused per call).
+        self._tp_amt_lo = np.empty(n_max, np.uint64)
+        self._tp_amt_hi = np.empty(n_max, np.uint64)
+        self._tp_ud128_lo = np.empty(n_max, np.uint64)
+        self._tp_ud128_hi = np.empty(n_max, np.uint64)
+        self._tp_ud64 = np.empty(n_max, np.uint64)
+        self._tp_ud32 = np.empty(n_max, np.uint32)
+        self._tp_ledger = np.empty(n_max, np.uint32)
+        self._tp_code = np.empty(n_max, np.uint32)
+        self._tp_inb = np.empty(n_max, np.uint32)
+        self._tp_dur_rows = np.empty(n_max, np.int64)
+        self._tp_dur_status = np.empty(n_max, np.uint32)
+        self._tp_ndur = ctypes.c_uint32(0)
+        self._tp_empty_u64 = np.zeros(n_max, np.uint64)
+        self._tp_empty_u32 = np.zeros(n_max, np.uint32)
+        self._tp_empty_i32 = np.full(n_max, -1, np.int32)
+        self._tp_empty_i64 = np.full(n_max, -1, np.int64)
 
     def __del__(self):
         if getattr(self, "_fp", None):
@@ -249,6 +286,109 @@ class NativeFastpath:
             self._results[:n], self._dr_slot[:n], self._cr_slot[:n],
             (self._dslot[:k], self._dcol[:k], self._dlo[:k], self._dhi[:k]),
         )
+
+
+    def commit_linked(self, body: bytes, n: int, ts_base: int):
+        """Serial native resolver for linked-chain / limit-account
+        batches (native/tb_linked.inc).  -> None (fallback) or
+        (results, dr_slot, cr_slot, deltas, last_applied)."""
+        if n > len(self._results):
+            return None
+        buf = ctypes.cast(ctypes.c_char_p(body), _U8P)
+        rc = self._lib.tb_fp_commit_linked(
+            self._fp, buf, n, ts_base,
+            _p(self._results, _U32P), _p(self._dr_slot, _I32P),
+            _p(self._cr_slot, _I32P), _p(self._dslot, _I64P),
+            _p(self._dcol, _I64P), _p(self._dlo, _U64P),
+            _p(self._dhi, _U64P), ctypes.byref(self._ndeltas),
+            ctypes.byref(self._last_applied),
+        )
+        if rc != 0:
+            return None
+        k = self._ndeltas.value
+        return (
+            self._results[:n], self._dr_slot[:n], self._cr_slot[:n],
+            (self._dslot[:k], self._dcol[:k], self._dlo[:k], self._dhi[:k]),
+            int(self._last_applied.value),
+        )
+
+    def commit_two_phase(self, body: bytes, n: int, ts_base: int,
+                         join: dict | None):
+        """Serial native resolver for two-phase batches
+        (native/tb_two_phase.inc).  `join` carries the durable pending
+        targets' columns (None when the batch references none);
+        -> None (fallback) or a dict of output views valid until the
+        next native call."""
+        if n > len(self._results):
+            return None
+        buf = ctypes.cast(ctypes.c_char_p(body), _U8P)
+        if join is None:
+            j_row = self._tp_empty_i64
+            j_flags = j_ledger = j_code = j_ud32 = j_timeout = j_status = (
+                self._tp_empty_u32
+            )
+            j_dr = j_cr = self._tp_empty_i32
+            j_amt_lo = j_amt_hi = j_u128lo = j_u128hi = j_ud64 = (
+                self._tp_empty_u64
+            )
+        else:
+            j_row = np.ascontiguousarray(join["row"], np.int64)
+            j_flags = np.ascontiguousarray(join["flags"], np.uint32)
+            j_dr = np.ascontiguousarray(join["dr_slot"], np.int32)
+            j_cr = np.ascontiguousarray(join["cr_slot"], np.int32)
+            j_amt_lo = np.ascontiguousarray(join["amount_lo"], np.uint64)
+            j_amt_hi = np.ascontiguousarray(join["amount_hi"], np.uint64)
+            j_ledger = np.ascontiguousarray(join["ledger"], np.uint32)
+            j_code = np.ascontiguousarray(join["code"], np.uint32)
+            j_u128lo = np.ascontiguousarray(join["ud128_lo"], np.uint64)
+            j_u128hi = np.ascontiguousarray(join["ud128_hi"], np.uint64)
+            j_ud64 = np.ascontiguousarray(join["ud64"], np.uint64)
+            j_ud32 = np.ascontiguousarray(join["ud32"], np.uint32)
+            j_timeout = np.ascontiguousarray(join["timeout"], np.uint32)
+            j_status = np.ascontiguousarray(join["status"], np.uint32)
+        rc = self._lib.tb_fp_commit_two_phase(
+            self._fp, buf, n, ts_base,
+            _p(j_row, _I64P), _p(j_flags, _U32P), _p(j_dr, _I32P),
+            _p(j_cr, _I32P), _p(j_amt_lo, _U64P), _p(j_amt_hi, _U64P),
+            _p(j_ledger, _U32P), _p(j_code, _U32P), _p(j_u128lo, _U64P),
+            _p(j_u128hi, _U64P), _p(j_ud64, _U64P), _p(j_ud32, _U32P),
+            _p(j_timeout, _U32P), _p(j_status, _U32P),
+            _p(self._results, _U32P), _p(self._dr_slot, _I32P),
+            _p(self._cr_slot, _I32P), _p(self._tp_amt_lo, _U64P),
+            _p(self._tp_amt_hi, _U64P), _p(self._tp_ud128_lo, _U64P),
+            _p(self._tp_ud128_hi, _U64P), _p(self._tp_ud64, _U64P),
+            _p(self._tp_ud32, _U32P), _p(self._tp_ledger, _U32P),
+            _p(self._tp_code, _U32P), _p(self._tp_inb, _U32P),
+            _p(self._tp_dur_rows, _I64P), _p(self._tp_dur_status, _U32P),
+            ctypes.byref(self._tp_ndur),
+            _p(self._dslot, _I64P), _p(self._dcol, _I64P),
+            _p(self._dlo, _U64P), _p(self._dhi, _U64P),
+            ctypes.byref(self._ndeltas), ctypes.byref(self._last_applied),
+        )
+        if rc != 0:
+            return None
+        k = self._ndeltas.value
+        nd = self._tp_ndur.value
+        return {
+            "results": self._results[:n],
+            "row_dr": self._dr_slot[:n],
+            "row_cr": self._cr_slot[:n],
+            "amt_lo": self._tp_amt_lo[:n],
+            "amt_hi": self._tp_amt_hi[:n],
+            "ud128_lo": self._tp_ud128_lo[:n],
+            "ud128_hi": self._tp_ud128_hi[:n],
+            "ud64": self._tp_ud64[:n],
+            "ud32": self._tp_ud32[:n],
+            "ledger": self._tp_ledger[:n],
+            "code": self._tp_code[:n],
+            "inb_status": self._tp_inb[:n],
+            "dur_rows": self._tp_dur_rows[:nd],
+            "dur_status": self._tp_dur_status[:nd],
+            "deltas": (
+                self._dslot[:k], self._dcol[:k], self._dlo[:k], self._dhi[:k]
+            ),
+            "last_applied": int(self._last_applied.value),
+        }
 
 
 def available() -> bool:

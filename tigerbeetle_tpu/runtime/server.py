@@ -114,7 +114,7 @@ class TcpBus:
 
 
 class ReplicaServer:
-    def __init__(self, data_path: str, *, cluster: int,
+    def __init__(self, data_path: str, *, cluster: int | None = None,
                  addresses: list[str], replica_index: int,
                  state_machine_factory, config: cfg.Config = cfg.PRODUCTION,
                  grid_size: int = 1 << 20, aof_path: str | None = None,

@@ -111,7 +111,10 @@ class BalanceMirror:
         self.lo[uniq] = rows[pick][:, 0::2]
         self.hi[uniq] = rows[pick][:, 1::2]
 
-    def try_apply_adds(self, dr_slot, cr_slot, amt_lo, amt_hi, is_pending, mask):
+    def try_apply_adds(
+        self, dr_slot, cr_slot, amt_lo, amt_hi, is_pending, mask,
+        commit: bool = True,
+    ):
         """Fast-path admission + commit.
 
         Applies non-negative balance additions (pending -> dp/cp,
@@ -121,6 +124,12 @@ class BalanceMirror:
         when committed, or None — meaning the caller must take the
         exact scan path (reference overflow codes:
         src/state_machine.zig:1531-1545).
+
+        With commit=False this is a pure admission dry-run: nothing is
+        mutated; a non-None return proves that applying ANY SUBSET of
+        the masked additions cannot overflow (deltas are non-negative,
+        so every prefix state is bounded by the all-applied state) —
+        the superset guarantee the linked-batch resolver relies on.
         """
         m = mask
         if not m.any():
@@ -159,14 +168,20 @@ class BalanceMirror:
         d_hi = (c2 & mask32) | ((c3 & mask32) << np.uint64(32))
         if ((c3 >> np.uint64(32)) != 0).any():
             return None  # column delta alone exceeds u128
+        if not self._admit_commit(u_slot, u_col, d_lo, d_hi, commit):
+            return None
+        return (u_slot, u_col, d_lo, d_hi)
+
+    def _admit_commit(self, u_slot, u_col, d_lo, d_hi, commit: bool) -> bool:
+        """Shared admission tail: per-column u128 overflow + combined
+        dp+dpo / cp+cpo totals of every touched account, checked
+        against the all-applied upper bound; mutates only when BOTH
+        pass and commit=True."""
         old_lo = self.lo[u_slot, u_col]
         old_hi = self.hi[u_slot, u_col]
         new_lo, new_hi, add_ov = _add_u128(old_lo, old_hi, d_lo, d_hi)
         if add_ov.any():
-            return None
-
-        # Combined totals dp+dpo / cp+cpo are monotone too; check the
-        # final sums of every touched account.
+            return False
         touched = np.unique(u_slot)
         cand_lo = self.lo[touched].copy()
         cand_hi = self.hi[touched].copy()
@@ -180,10 +195,29 @@ class BalanceMirror:
             cand_lo[:, 2], cand_hi[:, 2], cand_lo[:, 3], cand_hi[:, 3]
         )
         if dr_tot_ov.any() or cr_tot_ov.any():
-            return None
+            return False
+        if commit:
+            self.lo[u_slot, u_col] = new_lo
+            self.hi[u_slot, u_col] = new_hi
+        return True
 
-        self.lo[u_slot, u_col] = new_lo
-        self.hi[u_slot, u_col] = new_hi
+    def try_apply_deltas(self, slots, cols, amt_lo, amt_hi):
+        """General checked addition over explicit (slot, col) targets
+        (the two-phase resolver's mixed dp/dpo/cp/cpo adds).  Same
+        admission rules as try_apply_adds, checked BEFORE any
+        mutation.  Returns compact device deltas or None (caller falls
+        back to the exact path, mirror untouched)."""
+        if len(slots) == 0:
+            z = np.zeros(0, np.int64)
+            return (z, z.copy(), np.zeros(0, np.uint64), np.zeros(0, np.uint64))
+        u_slot, u_col, d_lo, d_hi, limb_ov = compact_deltas(
+            np.asarray(slots, np.int64), np.asarray(cols, np.int64),
+            amt_lo, amt_hi,
+        )
+        if limb_ov.any():
+            return None
+        if not self._admit_commit(u_slot, u_col, d_lo, d_hi, True):
+            return None
         return (u_slot, u_col, d_lo, d_hi)
 
     def apply_subs(self, slots, cols, amt_lo, amt_hi) -> None:

@@ -33,7 +33,7 @@ from tigerbeetle_tpu import constants as cfg
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.lsm import pack_u128
 from tigerbeetle_tpu.utils import HashIndex, RunIndex
-from tigerbeetle_tpu.state_machine import kernel, kernel_fast
+from tigerbeetle_tpu.state_machine import kernel, kernel_fast, resolve
 from tigerbeetle_tpu.state_machine.mirror import BalanceMirror, _sub_u128
 from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
 from tigerbeetle_tpu.types import (
@@ -342,6 +342,11 @@ class TpuStateMachine:
         # serial exact engine (host).
         self.stat_device_events = 0
         self.stat_exact_events = 0
+        # Vectorized order-dependent resolution (resolve.py): batches
+        # routed + fixpoint iterations spent (perf observability).
+        self.stat_linked_batches = 0
+        self.stat_two_phase_batches = 0
+        self.stat_resolve_iters = 0
 
     @property
     def _balances(self):
@@ -795,6 +800,23 @@ class TpuStateMachine:
                 return self._finish_native_fast(
                     events, n, ts_base, *native_out
                 )
+            # Order-dependent native resolvers (tb_linked.inc /
+            # tb_two_phase.inc): serial C++ over the wire bytes with
+            # exact ladders, feeding the same device scatter-add queue.
+            nl = self._native.commit_linked(input_bytes, n, ts_base)
+            if nl is not None:
+                results, dr_slot, cr_slot, deltas, last_applied = nl
+                self.stat_device_events += n
+                self.stat_linked_batches += 1
+                return self._finish_native_fast(
+                    events, n, ts_base, results, dr_slot, cr_slot, deltas,
+                    last_applied=last_applied,
+                )
+            reply = self._try_native_two_phase(input_bytes, events, n, ts_base)
+            if reply is not None:
+                self.stat_device_events += n
+                self.stat_two_phase_batches += 1
+                return reply
 
         # Same-width fields stay strided views into the 1 MiB wire
         # buffer (it lives in L2 after the first pass, so elementwise
@@ -968,7 +990,12 @@ class TpuStateMachine:
                 | ((id_hi[1:] == id_hi[:-1]) & (id_lo[1:] > id_lo[:-1]))
             ).all()
         )
-        if order_free:
+        # The resolver routes exclude only balancing batches (order_free
+        # already implies no balancing flags).
+        route_candidate = not (
+            flags & np.uint32(TF.balancing_debit | TF.balancing_credit)
+        ).any()
+        if route_candidate:
             ids_unique = ascending
             if not ids_unique:
                 id_mix = id_lo * np.uint64(0x9E3779B97F4A7C15) + id_hi * np.uint64(
@@ -995,6 +1022,37 @@ class TpuStateMachine:
                 if reply is not None:
                     self.stat_device_events += n
                     return reply
+
+        # Linked-chain / limit-account resolution (resolve.py): plain
+        # posted transfers — chains and balance-limit accounts allowed
+        # (a chain-free batch is just all chains of length 1) — get
+        # exact verdicts from a vectorized fixpoint, then scatter-add
+        # apply.  Batches without limits or chains never reach here
+        # (the order-free path above took them).
+        if (
+            ids_unique
+            and not (
+                flags
+                & np.uint32(
+                    TF.pending
+                    | TF.post_pending_transfer
+                    | TF.void_pending_transfer
+                    | TF.balancing_debit
+                    | TF.balancing_credit
+                )
+            ).any()
+            and not e_found.any()
+            and not ((dr_flags | cr_flags) & np.uint32(AF.history)).any()
+        ):
+            reply = self._commit_linked_fast(
+                n, ts_base, events, id_lo, id_hi, flags, timeout,
+                dr_slot, cr_slot, amount_lo, amount_hi, ledger, code,
+                static, dr_flags, cr_flags,
+            )
+            if reply is not None:
+                self.stat_device_events += n
+                self.stat_linked_batches += 1
+                return reply
 
         # Exact-path id groups: one compact index per distinct id value.
         id_key = pack_u128(id_lo, id_hi)
@@ -1073,6 +1131,22 @@ class TpuStateMachine:
         p_tgt[p_found] = tgt_inverse.astype(np.int32)
         dstat_init = np.zeros(B, np.uint32)
         dstat_init[: len(uniq_rows)] = uniq_status
+
+        # Two-phase resolution (resolve.py): post/void batches whose
+        # verdicts are balance-independent resolve in one vectorized
+        # pass — pendings, first-wins finalization, scatter-add apply.
+        if is_pv.any() and ids_unique and not e_found.any():
+            reply = self._try_two_phase_fast(
+                n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi, flags,
+                timeout, dr_slot, cr_slot, amount_lo, amount_hi, ledger,
+                code, static, is_pv, dr_flags, cr_flags,
+                unique_ids, id_group, p_group, p_found, gather_p,
+                uniq_rows, p_tgt, uniq_status,
+            )
+            if reply is not None:
+                self.stat_device_events += n
+                self.stat_two_phase_batches += 1
+                return reply
 
         ev = {
             "i": np.arange(B, dtype=np.int32),
@@ -1177,7 +1251,7 @@ class TpuStateMachine:
         self._post_process_transfers(
             n, ts_base, id_lo, id_hi, flags, timeout,
             results, created_mask, created, inb_status,
-            dstat_init, dstat, uniq_rows, p_found, p_row, p_group, id_group,
+            dstat_init, dstat, uniq_rows,
             hist_dr, hist_cr,
             int(out["last_applied"]),
             out["pulse_create"][:n],
@@ -1191,8 +1265,89 @@ class TpuStateMachine:
         reply["result"] = results[fail_idx]
         return reply.tobytes()
 
+    def _try_native_two_phase(
+        self, input_bytes, events, n, ts_base
+    ) -> bytes | None:
+        """Two-phase batch via the native serial resolver
+        (native/tb_two_phase.inc).  Python prefetches the durable
+        pending targets' columns (they may live in the LSM spill tier)
+        and finishes the store/expiry bookkeeping; the resolver owns
+        decode, ladders, reference resolution, and balance effects."""
+        flags16 = np.asarray(events["flags"])
+        pv16 = np.uint16(TF.post_pending_transfer | TF.void_pending_transfer)
+        pv_mask = (flags16 & pv16) != 0
+        if not pv_mask.any():
+            return None
+        # Cheap shape gate before paying for the durable join (the
+        # native pass-0 would reject these anyway, but only after the
+        # tdir lookup + LSM gather below already ran).
+        if (flags16 & np.uint16(TF.linked)).any():
+            return None
+        pv_idx = np.flatnonzero(pv_mask)
+        pend_lo = np.asarray(events["pending_id_lo"])[pv_idx]
+        pend_hi = np.asarray(events["pending_id_hi"])[pv_idx]
+        found, rows = self._tdir.lookup(pend_lo, pend_hi)
+        join = None
+        if found.any():
+            hit = pv_idx[found]
+            hit_rows = rows[found].astype(np.int64)
+            got = self._store.gather_many(
+                [
+                    "flags", "dr_slot", "cr_slot", "amount_lo", "amount_hi",
+                    "ledger", "code", "ud128_lo", "ud128_hi", "ud64", "ud32",
+                    "timeout", "status",
+                ],
+                hit_rows,
+            )
+            join = {"row": np.full(n, -1, np.int64)}
+            join["row"][hit] = hit_rows
+            for f, dt in (
+                ("flags", np.uint32), ("dr_slot", np.int32),
+                ("cr_slot", np.int32), ("amount_lo", np.uint64),
+                ("amount_hi", np.uint64), ("ledger", np.uint32),
+                ("code", np.uint32), ("ud128_lo", np.uint64),
+                ("ud128_hi", np.uint64), ("ud64", np.uint64),
+                ("ud32", np.uint32), ("timeout", np.uint32),
+                ("status", np.uint32),
+            ):
+                arr = np.zeros(n, dt)
+                arr[hit] = got[f].astype(dt)
+                join[f] = arr
+        r = self._native.commit_two_phase(input_bytes, n, ts_base, join)
+        if r is None:
+            return None
+        d = r["deltas"]
+        self._dev.enqueue(d[0].copy(), d[1].copy(), d[2].copy(), d[3].copy())
+        # Durable finalizations: status byte updates (rows may be
+        # spilled; referenced targets are timeout-free by the
+        # resolver's contract, so no expiry-index deactivation).
+        if len(r["dur_rows"]):
+            self._store["status"][r["dur_rows"].copy()] = r[
+                "dur_status"
+            ].astype(np.uint8)
+        flags = flags16.astype(np.uint32)
+        timeout = np.asarray(events["timeout"]).astype(np.uint64)
+        created = {
+            "flags": flags,
+            "dr_slot": r["row_dr"], "cr_slot": r["row_cr"],
+            "amount_lo": r["amt_lo"], "amount_hi": r["amt_hi"],
+            "pending_lo": np.asarray(events["pending_id_lo"]),
+            "pending_hi": np.asarray(events["pending_id_hi"]),
+            "ud128_lo": r["ud128_lo"], "ud128_hi": r["ud128_hi"],
+            "ud64": r["ud64"], "ud32": r["ud32"],
+            "timeout": timeout,
+            "ledger": r["ledger"], "code": r["code"],
+        }
+        return self._finish_fast(
+            n, ts_base, np.asarray(events["id_lo"]),
+            np.asarray(events["id_hi"]), flags, timeout, r["results"],
+            created, last_applied=r["last_applied"],
+            inb_status=r["inb_status"],
+        )
+
     def _finish_native_fast(
-        self, events, n, ts_base, results, dr_slot, cr_slot, deltas
+        self, events, n, ts_base, results, dr_slot, cr_slot, deltas,
+        last_applied: int | None = None,
     ) -> bytes:
         """Bookkeeping after a native fast-path apply: device enqueue,
         store append, expiry/pulse updates, reply (mirrors
@@ -1225,6 +1380,7 @@ class TpuStateMachine:
         return self._finish_fast(
             n, ts_base, np.asarray(events["id_lo"]),
             np.asarray(events["id_hi"]), flags, timeout, results, created,
+            last_applied=last_applied,
         )
 
     def _commit_fast(
@@ -1287,22 +1443,309 @@ class TpuStateMachine:
             n, ts_base, id_lo, id_hi, flags, timeout, results, created
         )
 
+    def _commit_linked_fast(
+        self, n, ts_base, events, id_lo, id_hi, flags, timeout,
+        dr_slot, cr_slot, amount_lo, amount_hi, ledger, code,
+        static, dr_flags, cr_flags,
+    ) -> bytes | None:
+        """Linked-chain batch via the vectorized fixpoint resolver.
+
+        Preconditions were checked by the router (plain posted
+        transfers only, unique fresh ids, no history accounts).  The
+        superset overflow admission below proves no overflow result
+        code can fire for ANY subset of the batch (deltas are
+        non-negative), which reduces the dynamic ladder to the limit
+        checks that resolve.linked_resolve models exactly."""
+        ts_nonzero = np.asarray(events["timestamp"] != 0)
+        # Superset = every event that could conceivably apply (static
+        # failures — including account-not-found, so slots here are
+        # always valid — never touch balances).
+        may_apply = (static == 0) & ~ts_nonzero
+        if not may_apply.any():
+            pass  # nothing can apply; resolver handles codes
+        elif (
+            self._mirror.try_apply_adds(
+                dr_slot.astype(np.int64), cr_slot.astype(np.int64),
+                amount_lo, amount_hi, np.zeros(n, bool), may_apply,
+                commit=False,
+            )
+            is None
+        ):
+            return None
+        r = resolve.linked_resolve(
+            static, ts_nonzero, flags, dr_slot, cr_slot,
+            amount_lo, amount_hi, dr_flags, cr_flags, self._mirror,
+        )
+        if r is None:
+            return None
+        results, last_applied, iters = r
+        self.stat_resolve_iters += iters
+        deltas = self._mirror.try_apply_adds(
+            dr_slot.astype(np.int64), cr_slot.astype(np.int64),
+            amount_lo, amount_hi, np.zeros(n, bool), results == 0,
+        )
+        assert deltas is not None  # subset of the admitted superset
+        self._dev.enqueue(*deltas)
+        created = {
+            "flags": flags,
+            "dr_slot": dr_slot.astype(np.int32),
+            "cr_slot": cr_slot.astype(np.int32),
+            "amount_lo": amount_lo, "amount_hi": amount_hi,
+            "pending_lo": np.zeros(n, np.uint64),
+            "pending_hi": np.zeros(n, np.uint64),
+            "ud128_lo": np.asarray(events["user_data_128_lo"]),
+            "ud128_hi": np.asarray(events["user_data_128_hi"]),
+            "ud64": np.asarray(events["user_data_64"]),
+            "ud32": np.asarray(events["user_data_32"]),
+            "timeout": timeout,
+            "ledger": ledger, "code": code,
+        }
+        return self._finish_fast(
+            n, ts_base, id_lo, id_hi, flags, timeout, results, created,
+            last_applied=last_applied,
+        )
+
+    def _try_two_phase_fast(
+        self, n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi, flags,
+        timeout, dr_slot, cr_slot, amount_lo, amount_hi, ledger, code,
+        static, is_pv, dr_flags, cr_flags,
+        unique_ids, id_group, p_group, p_found, gather_p,
+        uniq_rows, p_tgt, uniq_status,
+    ) -> bytes | None:
+        """Two-phase batch via the closed-form resolver.
+
+        Remaining preconditions (the router already checked unique
+        fresh ids): no linked/balancing flags, zero timeouts
+        everywhere (event timeouts AND durable targets'), no limit or
+        history flags on any touched account including durable
+        targets' accounts, and in-batch pending references that point
+        at actual pending creates.  Anything else returns None — the
+        serial exact engine owns it."""
+        if (
+            flags
+            & np.uint32(TF.linked | TF.balancing_debit | TF.balancing_credit)
+        ).any():
+            return None
+        if timeout.any():
+            return None
+        LIMH = np.uint32(
+            AF.debits_must_not_exceed_credits
+            | AF.credits_must_not_exceed_debits
+            | AF.history
+        )
+        if ((dr_flags | cr_flags) & LIMH).any():
+            return None
+        attrs = self._attrs
+        if p_found.any():
+            if (gather_p("timeout") != 0).any():
+                return None
+            pj_dr = np.clip(gather_p("dr_slot").astype(np.int64), 0, None)
+            pj_cr = np.clip(gather_p("cr_slot").astype(np.int64), 0, None)
+            pj_flags = np.where(
+                p_found,
+                attrs["flags"][pj_dr] | attrs["flags"][pj_cr],
+                0,
+            ).astype(np.uint32)
+            if (pj_flags & LIMH).any():
+                return None
+
+        # In-batch pending-reference resolution: creator event of each
+        # distinct id (ids are unique, so this is a permutation).
+        creator = np.empty(len(unique_ids), np.int64)
+        creator[id_group] = np.arange(n)
+        tgt_ev = np.where(
+            p_group >= 0, creator[np.clip(p_group, 0, None)], -1
+        )
+        idx = np.arange(n)
+        ib = is_pv & (tgt_ev >= 0) & (tgt_ev < idx)
+        if (
+            ib
+            & (
+                (flags[np.clip(tgt_ev, 0, None)] & np.uint32(TF.pending))
+                == 0
+            )
+        ).any():
+            # Reference resolution on a non-pending in-batch row would
+            # couple pv verdicts to each other — exact engine decides.
+            return None
+
+        ts_nonzero = np.asarray(events["timestamp"] != 0)
+        p_join = {
+            f: gather_p(f)
+            for f in (
+                "flags", "dr_slot", "cr_slot", "amount_lo", "amount_hi",
+                "ledger", "code", "ud128_lo", "ud128_hi", "ud64", "ud32",
+            )
+        }
+        ud128_lo = np.asarray(events["user_data_128_lo"])
+        ud128_hi = np.asarray(events["user_data_128_hi"])
+        ud64 = np.asarray(events["user_data_64"])
+        ud32 = np.asarray(events["user_data_32"]).astype(np.uint32)
+        r = resolve.two_phase_resolve(
+            static, ts_nonzero, flags, is_pv,
+            np.asarray(events["debit_account_id_lo"]),
+            np.asarray(events["debit_account_id_hi"]),
+            np.asarray(events["credit_account_id_lo"]),
+            np.asarray(events["credit_account_id_hi"]),
+            amount_lo, amount_hi,
+            ud128_lo, ud128_hi, ud64, ud32,
+            np.asarray(events["ledger"]), code,
+            tgt_ev, p_found, p_tgt, p_join, uniq_status, attrs,
+        )
+        if r is None:
+            return None
+
+        results = r["results"]
+        ok = r["ok"]
+        winner = r["winner"]
+        post = r["post"]
+        pend_flag = r["pend_flag"]
+        tgt_c = np.clip(tgt_ev, 0, None)
+        in_batch = r["in_batch"]
+        # Unified target slots (in-batch event columns or durable join).
+        p_drs = np.where(
+            in_batch,
+            dr_slot[tgt_c].astype(np.int64),
+            np.clip(p_join["dr_slot"].astype(np.int64), 0, None),
+        )
+        p_crs = np.where(
+            in_batch,
+            cr_slot[tgt_c].astype(np.int64),
+            np.clip(p_join["cr_slot"].astype(np.int64), 0, None),
+        )
+
+        # --- balance deltas.  Adds are admission-checked atomically;
+        # pending releases can never underflow (each live pending's
+        # amount is contained in dp/cp by invariant).
+        pend_ok = ok & pend_flag
+        plain_ok = ok & ~pend_flag & ~is_pv
+        post_win = winner & post
+        add_slots = np.concatenate([
+            dr_slot[pend_ok].astype(np.int64), cr_slot[pend_ok].astype(np.int64),
+            dr_slot[plain_ok].astype(np.int64), cr_slot[plain_ok].astype(np.int64),
+            p_drs[post_win], p_crs[post_win],
+        ])
+        n_pend = int(pend_ok.sum())
+        n_plain = int(plain_ok.sum())
+        n_post = int(post_win.sum())
+        add_cols = np.concatenate([
+            np.zeros(n_pend, np.int64), np.full(n_pend, 2, np.int64),
+            np.ones(n_plain, np.int64), np.full(n_plain, 3, np.int64),
+            np.ones(n_post, np.int64), np.full(n_post, 3, np.int64),
+        ])
+        add_lo = np.concatenate([
+            amount_lo[pend_ok], amount_lo[pend_ok],
+            amount_lo[plain_ok], amount_lo[plain_ok],
+            r["res_amt_lo"][post_win], r["res_amt_lo"][post_win],
+        ])
+        add_hi = np.concatenate([
+            amount_hi[pend_ok], amount_hi[pend_ok],
+            amount_hi[plain_ok], amount_hi[plain_ok],
+            r["res_amt_hi"][post_win], r["res_amt_hi"][post_win],
+        ])
+        deltas = self._mirror.try_apply_deltas(
+            add_slots, add_cols, add_lo, add_hi
+        )
+        if deltas is None:
+            return None  # overflow codes in play — exact engine decides
+        n_win = int(winner.sum())
+        sub_slots = np.concatenate([p_drs[winner], p_crs[winner]])
+        sub_cols = np.concatenate(
+            [np.zeros(n_win, np.int64), np.full(n_win, 2, np.int64)]
+        )
+        sub_lo = np.concatenate([r["p_amt_lo"][winner]] * 2)
+        sub_hi = np.concatenate([r["p_amt_hi"][winner]] * 2)
+        if n_win:
+            self._mirror.apply_subs(sub_slots, sub_cols, sub_lo, sub_hi)
+            zero = np.zeros(2 * n_win, np.uint64)
+            neg_lo, neg_hi, _ = _sub_u128(zero, zero, sub_lo, sub_hi)
+            self._dev.enqueue(
+                np.concatenate([deltas[0], sub_slots]),
+                np.concatenate([deltas[1], sub_cols]),
+                np.concatenate([deltas[2], neg_lo]),
+                np.concatenate([deltas[3], neg_hi]),
+            )
+        else:
+            self._dev.enqueue(*deltas)
+
+        # --- durable store rows (zero-means-inherit resolution for
+        # created pv rows; reference: src/state_machine.zig:1697-1720).
+        ud128_set = (ud128_lo != 0) | (ud128_hi != 0)
+        created = {
+            "flags": flags,
+            "dr_slot": np.where(is_pv, p_drs, dr_slot.astype(np.int64)).astype(np.int32),
+            "cr_slot": np.where(is_pv, p_crs, cr_slot.astype(np.int64)).astype(np.int32),
+            "amount_lo": np.where(is_pv, r["res_amt_lo"], amount_lo),
+            "amount_hi": np.where(is_pv, r["res_amt_hi"], amount_hi),
+            "pending_lo": pend_lo, "pending_hi": pend_hi,
+            "ud128_lo": np.where(is_pv & ~ud128_set, r["p_ud128_lo"], ud128_lo),
+            "ud128_hi": np.where(is_pv & ~ud128_set, r["p_ud128_hi"], ud128_hi),
+            "ud64": np.where(is_pv & (ud64 == 0), r["p_ud64"], ud64),
+            "ud32": np.where(is_pv & (ud32 == 0), r["p_ud32"], ud32),
+            "timeout": np.zeros(n, np.uint64),
+            "ledger": np.where(
+                is_pv, r["p_ledger"], np.asarray(events["ledger"])
+            ).astype(np.uint32),
+            "code": np.where(is_pv, r["p_code"], code).astype(np.uint32),
+        }
+        inb_status = np.where(
+            pend_ok, np.uint32(kernel.S_PENDING), np.uint32(0)
+        )
+        ib_win = winner & in_batch
+        if ib_win.any():
+            inb_status[tgt_ev[ib_win]] = np.where(
+                post[ib_win],
+                np.uint32(kernel.S_POSTED),
+                np.uint32(kernel.S_VOIDED),
+            )
+        dstat_init = uniq_status.copy()
+        dstat = uniq_status.copy()
+        dur_win = winner & r["durable"]
+        if dur_win.any():
+            dstat[p_tgt[dur_win]] = np.where(
+                post[dur_win],
+                np.uint32(kernel.S_POSTED),
+                np.uint32(kernel.S_VOIDED),
+            )
+        zeros_u64 = np.zeros(n, np.uint64)
+        self._post_process_transfers(
+            n, ts_base, id_lo, id_hi, flags, timeout,
+            results, ok, created, inb_status,
+            dstat_init, dstat, uniq_rows,
+            np.zeros((n, 8), np.uint64), np.zeros((n, 8), np.uint64),
+            r["last_applied"], zeros_u64, zeros_u64,
+            no_history=True,
+        )
+        fail_idx = np.flatnonzero(results != 0)
+        reply = np.zeros(len(fail_idx), dtype=CREATE_RESULT_DTYPE)
+        reply["index"] = fail_idx.astype(np.uint32)
+        reply["result"] = results[fail_idx]
+        return reply.tobytes()
+
     def _finish_fast(
-        self, n, ts_base, id_lo, id_hi, flags, timeout, results, created
+        self, n, ts_base, id_lo, id_hi, flags, timeout, results, created,
+        last_applied: int | None = None,
+        inb_status: np.ndarray | None = None,
     ) -> bytes:
         """Shared fast-path tail (native and Python admission paths):
         expiry/pulse signals, store bookkeeping, failure reply.  Must
-        stay one implementation — both paths\' durable state depends on
-        it being identical."""
+        stay one implementation — every fast path\'s durable state
+        depends on it being identical.  `inb_status` overrides the
+        default created-pending statuses when the caller finalized
+        pendings within the batch (two-phase resolver)."""
         apply_mask = results == 0
         is_pending = (flags & np.uint32(TF.pending)) != 0
         ts_i = np.uint64(ts_base) + np.arange(n, dtype=np.uint64)
         expires = ts_i + timeout * np.uint64(NS_PER_S)
-        inb_status = np.where(
-            apply_mask & is_pending, np.uint32(kernel.S_PENDING), np.uint32(0)
-        )
-        applied_idx = np.flatnonzero(apply_mask)
-        last_applied = int(applied_idx[-1]) if len(applied_idx) else -1
+        if inb_status is None:
+            inb_status = np.where(
+                apply_mask & is_pending,
+                np.uint32(kernel.S_PENDING),
+                np.uint32(0),
+            )
+        if last_applied is None:
+            applied_idx = np.flatnonzero(apply_mask)
+            last_applied = int(applied_idx[-1]) if len(applied_idx) else -1
         pulse_create = np.where(
             apply_mask & is_pending & (timeout > 0), expires, np.uint64(0)
         )
@@ -1312,8 +1755,6 @@ class TpuStateMachine:
             results, apply_mask, created, inb_status,
             np.zeros(0, np.uint32), np.zeros(0, np.uint32),
             np.zeros(0, np.int64),
-            np.zeros(n, bool), np.zeros(n, np.uint64), np.full(n, -1, np.int32),
-            np.zeros(n, np.int32),
             np.zeros((n, 8), np.uint64), np.zeros((n, 8), np.uint64),
             last_applied, pulse_create, np.zeros(n, np.uint64),
             no_history=True,
@@ -1328,7 +1769,7 @@ class TpuStateMachine:
     def _post_process_transfers(
         self, n, ts_base, id_lo, id_hi, flags, timeout,
         results, created_mask, created, inb_status,
-        dstat_init, dstat, uniq_rows, p_found, p_row, p_group, id_group,
+        dstat_init, dstat, uniq_rows,
         hist_dr, hist_cr, last_applied, pulse_create, pulse_remove,
         no_history: bool = False,
     ) -> None:

@@ -133,6 +133,12 @@ class Replica:
         if replay_tail is None:
             replay_tail = self.replica_count == 1
         sb = self.superblock.open()
+        if self.cluster is None:
+            # cluster=None = adopt the id `format` recorded (see
+            # SuperBlock.open); the journal shares it for prepare
+            # checksum verification.
+            self.cluster = self.superblock.cluster
+            self.journal.cluster = self.cluster
         self.view = int(sb["view"])
         self.checkpoint_op = int(sb["commit_min"])
 

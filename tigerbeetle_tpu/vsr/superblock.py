@@ -129,7 +129,12 @@ class SuperBlock:
 
     def open(self) -> np.ndarray:
         """Quorum read: highest sequence with >= QUORUM_OPEN agreeing
-        valid copies wins."""
+        valid copies wins.
+
+        With cluster=None the superblock adopts the cluster id found
+        in the file (`tigerbeetle start` doesn't ask the operator to
+        repeat what `format` already recorded — reference:
+        src/tigerbeetle/main.zig start reads it from the superblock)."""
         copies = []
         for copy in range(SUPERBLOCK_COPIES):
             raw = self.storage.read(
@@ -149,9 +154,44 @@ class SuperBlock:
             if len(group) >= QUORUM_OPEN
         ]
         if not quorums:
-            raise RuntimeError("superblock: no quorum of valid copies")
+            raise RuntimeError(
+                "superblock: no quorum of valid copies"
+                + (
+                    f" for cluster {self.cluster} (data file formatted for"
+                    " a different cluster?)"
+                    if self.cluster is not None and self._any_other_cluster()
+                    else ""
+                )
+            )
         self.working = max(quorums, key=lambda h: int(h["sequence"])).copy()
+        if self.cluster is None:
+            self.cluster = int(self.working["cluster_lo"]) | (
+                int(self.working["cluster_hi"]) << 64
+            )
         return self.working
+
+    def _any_other_cluster(self) -> bool:
+        """True if any copy is checksum-valid under SOME cluster id
+        other than ours (diagnostic for the mismatch error; a copy
+        valid under our OWN cluster means corruption, not mismatch)."""
+        saved, self.cluster = self.cluster, None
+        try:
+            for copy in range(SUPERBLOCK_COPIES):
+                raw = self.storage.read(
+                    self.storage.layout.superblock_offset
+                    + copy * SUPERBLOCK_COPY_SIZE,
+                    SUPERBLOCK_COPY_SIZE,
+                )
+                h = np.frombuffer(raw, SUPERBLOCK_DTYPE)[0]
+                if self._valid(h):
+                    found = int(h["cluster_lo"]) | (
+                        int(h["cluster_hi"]) << 64
+                    )
+                    if found != saved:
+                        return True
+            return False
+        finally:
+            self.cluster = saved
 
     def _valid(self, h: np.ndarray) -> bool:
         payload = h.tobytes()[16:]
@@ -161,4 +201,5 @@ class SuperBlock:
         if int(h["checksum_hi"]) != c >> 64:
             return False
         cluster = int(h["cluster_lo"]) | (int(h["cluster_hi"]) << 64)
-        return cluster == self.cluster and int(h["version"]) == wire.VERSION
+        cluster_ok = self.cluster is None or cluster == self.cluster
+        return cluster_ok and int(h["version"]) == wire.VERSION
