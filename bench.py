@@ -479,6 +479,93 @@ def config_account_ids(name):
     return np.arange(1, 1_001, dtype=np.uint64)
 
 
+def run_durable(n_events: int) -> dict:
+    """The FULL server path at scale: real data file on disk, WAL
+    append per op, forest attached, LSM spill + paced compaction at
+    checkpoints — nothing stubbed (VERDICT r2 item 2: benchmark the
+    real system, not the standalone machine).
+
+    Checkpoints fire every 24 create ops (~196k events) — far more
+    often than production's 960-op interval would at this batch size,
+    deliberately: each one spills the whole RAM tail and creates merge
+    debt for the beat pacing to absorb, which is the cost this config
+    prices.  Reports commit p50/p99/p100 alongside throughput.
+    """
+    import shutil
+    import tempfile
+
+    from tigerbeetle_tpu.vsr import replica as vsr_replica
+    from tigerbeetle_tpu.vsr.storage import FileStorage, ZoneLayout
+
+    conf = __import__(
+        "tigerbeetle_tpu.constants", fromlist=["PRODUCTION"]
+    ).PRODUCTION
+    forest_blocks = 1 << 14  # 16k x 64KiB = 1 GiB block region
+    layout = ZoneLayout(
+        config=conf,
+        grid_size=2 * vsr_replica.SNAPSHOT_SPAN + (forest_blocks << 16),
+    )
+    tmp = tempfile.mkdtemp(prefix="tb_bench_durable_")
+    path = os.path.join(tmp, "0_0.tigerbeetle")
+    try:
+        storage = FileStorage(path, layout, create=True)
+        vsr_replica.format(storage, cluster=0xB, replica=0, replica_count=1)
+        from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+
+        sm = TpuStateMachine(
+            conf, account_capacity=1 << 12,
+            transfer_capacity=n_events + 2 * BATCH + 1024,
+        )
+        r = vsr_replica.Replica(
+            storage, 0xB, sm, forest_block_count=forest_blocks
+        )
+        r.open()
+
+        setup, timed, _sizing = gen_simple(n_events)
+        for op, body in setup:
+            r.on_request(int(op), body)
+        sm.sync()
+        # ~5 checkpoints over the stream, min every 4 ops (small runs
+        # must still exercise spill + compaction debt).
+        ckpt_every = max(4, min(48, len(timed) // 3))
+        lat = []
+        failed = 0
+        n_ckpt = 0
+        t0 = time.perf_counter()
+        for k, (op, body) in enumerate(timed):
+            b0 = time.perf_counter()
+            reply = r.on_request(int(op), body)
+            if (k + 1) % ckpt_every == 0:
+                r.checkpoint()
+                n_ckpt += 1
+            lat.append(time.perf_counter() - b0)
+            failed += len(reply) // 8
+        sm.sync()
+        elapsed = time.perf_counter() - t0
+        assert failed == 0, f"durable: {failed} transfers failed"
+        n_timed = n_events_of(timed)
+        lat_ms = np.sort(np.asarray(lat)) * 1e3
+        return {
+            "events_per_sec": round(n_timed / elapsed, 1),
+            "events": n_timed,
+            "failed_events": failed,
+            "vs_baseline": round(n_timed / elapsed / BASELINE_TPS, 4),
+            "device_resolved_pct": round(
+                100.0
+                * sm.stat_device_events
+                / max(1, sm.stat_device_events + sm.stat_exact_events),
+                1,
+            ),
+            "commit_p50_ms": round(float(lat_ms[len(lat_ms) // 2]), 2),
+            "commit_p99_ms": round(float(lat_ms[int(len(lat_ms) * 0.99)]), 2),
+            "commit_p100_ms": round(float(lat_ms[-1]), 2),
+            "checkpoints": n_ckpt,
+            "spilled_rows": int(sm._store.base),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
     from tigerbeetle_tpu.testing.harness import SingleNodeHarness
@@ -522,6 +609,8 @@ def main() -> None:
             "device_resolved_pct": round(100.0 * dev / max(1, dev + exact), 1),
         }
         del sm, h
+
+    configs_out["durable"] = run_durable(N_OTHER)
 
     if PARITY:
         for name, gen in CONFIGS.items():

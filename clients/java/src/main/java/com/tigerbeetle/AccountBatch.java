@@ -1,0 +1,54 @@
+// Typed cursor over 128-byte Account wire elements
+// (tigerbeetle_tpu/types.py ACCOUNT_DTYPE; reference:
+// src/tigerbeetle.zig:7-40).
+package com.tigerbeetle;
+
+import java.nio.ByteBuffer;
+
+public final class AccountBatch extends Batch {
+    static final int ELEMENT_SIZE = 128;
+
+    public AccountBatch(int capacity) {
+        super(capacity, ELEMENT_SIZE);
+    }
+
+    AccountBatch(ByteBuffer wrapped) {
+        super(wrapped, ELEMENT_SIZE);
+    }
+
+    public void setId(long lo, long hi) { setU64(0, lo); setU64(8, hi); }
+    public long getIdLo() { return getU64(0); }
+    public long getIdHi() { return getU64(8); }
+
+    public long getDebitsPendingLo() { return getU64(16); }
+    public long getDebitsPendingHi() { return getU64(24); }
+    public long getDebitsPostedLo() { return getU64(32); }
+    public long getDebitsPostedHi() { return getU64(40); }
+    public long getCreditsPendingLo() { return getU64(48); }
+    public long getCreditsPendingHi() { return getU64(56); }
+    public long getCreditsPostedLo() { return getU64(64); }
+    public long getCreditsPostedHi() { return getU64(72); }
+
+    public void setUserData128(long lo, long hi) { setU64(80, lo); setU64(88, hi); }
+    public long getUserData128Lo() { return getU64(80); }
+    public long getUserData128Hi() { return getU64(88); }
+
+    public void setUserData64(long value) { setU64(96, value); }
+    public long getUserData64() { return getU64(96); }
+
+    public void setUserData32(int value) { setU32(104, value); }
+    public int getUserData32() { return getU32(104); }
+
+    public void setLedger(int ledger) { setU32(112, ledger); }
+    public int getLedger() { return getU32(112); }
+
+    public void setCode(int code) { setU16(116, code); }
+    public int getCode() { return getU16(116); }
+
+    /** Bit set of Types.AccountFlags values. */
+    public void setFlags(int flags) { setU16(118, flags); }
+    public int getFlags() { return getU16(118); }
+
+    /** Server-assigned; must be zero on create. */
+    public long getTimestamp() { return getU64(120); }
+}

@@ -1,0 +1,123 @@
+// Pure-Java client for the tigerbeetle_tpu cluster.
+//
+// Batch is the zero-copy event encoder the north star names: a cursor
+// over a direct little-endian ByteBuffer holding fixed 128-byte wire
+// elements, filled in place and handed to the socket without any
+// per-event object allocation (the same shape as the reference's
+// com.tigerbeetle.Batch — src/clients/java/src/main/java/com/
+// tigerbeetle/Batch.java:15-45 — minus JNI: this client speaks the
+// TCP wire protocol directly, like the Go/TS clients here).
+package com.tigerbeetle;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+/** Cursor over a direct little-endian buffer of fixed-size elements. */
+public abstract class Batch {
+    final ByteBuffer buffer;
+    private final int elementSize;
+    private int length;    // elements written
+    private int position;  // current element index, -1 = before first
+
+    Batch(int capacity, int elementSize) {
+        this.buffer =
+            ByteBuffer.allocateDirect(capacity * elementSize)
+                .order(ByteOrder.LITTLE_ENDIAN);
+        this.elementSize = elementSize;
+        this.length = 0;
+        this.position = -1;
+    }
+
+    /** Wraps reply bytes (read path). */
+    Batch(ByteBuffer wrapped, int elementSize) {
+        this.buffer = wrapped.order(ByteOrder.LITTLE_ENDIAN);
+        this.elementSize = elementSize;
+        this.length = wrapped.capacity() / elementSize;
+        this.position = -1;
+    }
+
+    /** Number of elements in the batch. */
+    public int getLength() {
+        return length;
+    }
+
+    public int getCapacity() {
+        return buffer.capacity() / elementSize;
+    }
+
+    /** Appends a zeroed element and moves the cursor to it. */
+    public void add() {
+        if (length >= getCapacity()) {
+            throw new IndexOutOfBoundsException("batch is full");
+        }
+        position = length++;
+        int base = at(0);
+        for (int i = 0; i < elementSize; i += 8) {
+            buffer.putLong(base + i, 0L);
+        }
+    }
+
+    /** Advances the cursor; false when past the last element. */
+    public boolean next() {
+        if (position + 1 >= length) {
+            return false;
+        }
+        position++;
+        return true;
+    }
+
+    public void beforeFirst() {
+        position = -1;
+    }
+
+    public void setPosition(int index) {
+        if (index < 0 || index >= length) {
+            throw new IndexOutOfBoundsException("position " + index);
+        }
+        position = index;
+    }
+
+    public int getPosition() {
+        return position;
+    }
+
+    final int at(int fieldOffset) {
+        if (position < 0) {
+            throw new IllegalStateException("cursor before first element");
+        }
+        return position * elementSize + fieldOffset;
+    }
+
+    final long getU64(int offset) {
+        return buffer.getLong(at(offset));
+    }
+
+    final void setU64(int offset, long value) {
+        buffer.putLong(at(offset), value);
+    }
+
+    final int getU32(int offset) {
+        return buffer.getInt(at(offset));
+    }
+
+    final void setU32(int offset, int value) {
+        buffer.putInt(at(offset), value);
+    }
+
+    final int getU16(int offset) {
+        return buffer.getShort(at(offset)) & 0xFFFF;
+    }
+
+    final void setU16(int offset, int value) {
+        buffer.putShort(at(offset), (short) value);
+    }
+
+    /** Serializes the written elements (for the request body). */
+    final byte[] toArray() {
+        byte[] out = new byte[length * elementSize];
+        ByteBuffer dup = buffer.duplicate();
+        dup.position(0).limit(out.length);
+        dup.get(out);
+        return out;
+    }
+}

@@ -1,0 +1,196 @@
+// Blocking pure-Java client session for the tigerbeetle_tpu cluster —
+// the TCP counterpart of the reference's com.tigerbeetle.Client
+// (src/clients/java/src/main/java/com/tigerbeetle/Client.java), minus
+// JNI: like the Go/TS clients here it speaks the wire protocol
+// directly.  One registered VSR session, one request in flight,
+// retransmission under the same request number is made safe by the
+// server's at-most-once session dedupe.
+package com.tigerbeetle;
+
+import java.io.IOException;
+import java.io.InputStream;
+import java.io.OutputStream;
+import java.net.InetSocketAddress;
+import java.net.Socket;
+import java.net.SocketTimeoutException;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.util.Random;
+
+public final class Client implements AutoCloseable {
+    /** Most events per request (1 MiB message - 256 B header,
+     * 128 B/event; reference: src/state_machine.zig:75-81). */
+    public static final int BATCH_MAX =
+        (Wire.MESSAGE_SIZE_MAX - Wire.HEADER_SIZE) / 128;
+
+    // Operation codes (tigerbeetle_tpu/types.py Operation).
+    static final int OP_CREATE_ACCOUNTS = 128;
+    static final int OP_CREATE_TRANSFERS = 129;
+    static final int OP_LOOKUP_ACCOUNTS = 130;
+    static final int OP_LOOKUP_TRANSFERS = 131;
+    static final int OP_GET_ACCOUNT_TRANSFERS = 132;
+    static final int OP_GET_ACCOUNT_BALANCES = 133;
+
+    private final Socket socket;
+    private final InputStream in;
+    private final OutputStream out;
+    private final long cluster;
+    private final long clientLo;
+    private final long clientHi;
+    private int requestNumber;
+    private boolean registered;
+    private boolean evicted;
+    private byte[] recv = new byte[0];
+    private int recvLen = 0;
+
+    /** Per-request deadline in milliseconds (default 30s). */
+    public int timeoutMillis = 30_000;
+    private static final int RETRANSMIT_MILLIS = 1_000;
+
+    public Client(String host, int port, long cluster) throws IOException {
+        this(host, port, cluster, new Random().nextLong() | 1L, 0L);
+    }
+
+    /** clientId (lo, hi limbs) must be unique per live session. */
+    public Client(String host, int port, long cluster, long clientLo,
+                  long clientHi) throws IOException {
+        this.socket = new Socket();
+        this.socket.connect(new InetSocketAddress(host, port), 10_000);
+        this.socket.setTcpNoDelay(true);
+        this.in = socket.getInputStream();
+        this.out = socket.getOutputStream();
+        this.cluster = cluster;
+        this.clientLo = clientLo;
+        this.clientHi = clientHi;
+    }
+
+    @Override
+    public void close() throws IOException {
+        socket.close();
+    }
+
+    /** create_accounts: reply lists FAILURES only (empty = all ok). */
+    public CreateResultBatch createAccounts(AccountBatch batch)
+            throws IOException {
+        return new CreateResultBatch(
+            wrap(request(OP_CREATE_ACCOUNTS, batch.toArray())));
+    }
+
+    /** create_transfers: reply lists FAILURES only (empty = all ok). */
+    public CreateResultBatch createTransfers(TransferBatch batch)
+            throws IOException {
+        return new CreateResultBatch(
+            wrap(request(OP_CREATE_TRANSFERS, batch.toArray())));
+    }
+
+    /** lookup_accounts: found records only. */
+    public AccountBatch lookupAccounts(IdBatch ids) throws IOException {
+        return new AccountBatch(
+            wrap(request(OP_LOOKUP_ACCOUNTS, ids.toArray())));
+    }
+
+    /** lookup_transfers: found records only. */
+    public TransferBatch lookupTransfers(IdBatch ids) throws IOException {
+        return new TransferBatch(
+            wrap(request(OP_LOOKUP_TRANSFERS, ids.toArray())));
+    }
+
+    private static ByteBuffer wrap(byte[] body) {
+        return ByteBuffer.wrap(body).order(ByteOrder.LITTLE_ENDIAN);
+    }
+
+    /** Raw request: registers on first use, returns the reply body. */
+    public synchronized byte[] request(int operation, byte[] body)
+            throws IOException {
+        if (!registered) {
+            roundtrip(Wire.OP_REGISTER, 0, new byte[0]);
+            registered = true;
+        }
+        requestNumber++;
+        return roundtrip(operation, requestNumber, body);
+    }
+
+    private byte[] roundtrip(int operation, int reqNumber, byte[] body)
+            throws IOException {
+        if (evicted) {
+            throw new IOException("session evicted");
+        }
+        byte[] msg = Wire.buildRequest(
+            cluster, clientLo, clientHi, reqNumber, operation, body);
+        long deadline = System.currentTimeMillis() + timeoutMillis;
+        while (true) {
+            long now = System.currentTimeMillis();
+            if (now > deadline) {
+                throw new IOException("request " + reqNumber + " timed out");
+            }
+            socket.setSoTimeout(
+                (int) Math.min(RETRANSMIT_MILLIS, deadline - now));
+            out.write(msg);
+            out.flush();
+            while (true) {
+                byte[] reply;
+                int size;
+                try {
+                    int[] sz = new int[1];
+                    reply = readMessage(sz);
+                    size = sz[0];
+                } catch (SocketTimeoutException e) {
+                    break; // retransmit under the same request number
+                }
+                ByteBuffer h =
+                    ByteBuffer.wrap(reply).order(ByteOrder.LITTLE_ENDIAN);
+                int command = reply[Wire.OFF_COMMAND] & 0xFF;
+                if (command == Wire.CMD_EVICTION) {
+                    evicted = true;
+                    throw new IOException("session evicted");
+                }
+                if (command != Wire.CMD_REPLY) {
+                    continue;
+                }
+                if (h.getInt(Wire.OFF_REQUEST) != reqNumber) {
+                    continue; // stale duplicate
+                }
+                byte[] bodyOut = new byte[size - Wire.HEADER_SIZE];
+                System.arraycopy(reply, Wire.HEADER_SIZE, bodyOut, 0,
+                                 bodyOut.length);
+                return bodyOut;
+            }
+        }
+    }
+
+    private byte[] readMessage(int[] sizeOut) throws IOException {
+        while (true) {
+            if (recvLen >= Wire.HEADER_SIZE) {
+                ByteBuffer h =
+                    ByteBuffer.wrap(recv).order(ByteOrder.LITTLE_ENDIAN);
+                int size = h.getInt(Wire.OFF_SIZE);
+                if (size < Wire.HEADER_SIZE
+                    || size > Wire.MESSAGE_SIZE_MAX + Wire.HEADER_SIZE) {
+                    throw new IOException("bad frame size " + size);
+                }
+                if (recvLen >= size) {
+                    byte[] msg = new byte[size];
+                    System.arraycopy(recv, 0, msg, 0, size);
+                    System.arraycopy(recv, size, recv, 0, recvLen - size);
+                    recvLen -= size;
+                    Wire.verifyMessage(msg, size);
+                    sizeOut[0] = size;
+                    return msg;
+                }
+            }
+            byte[] buf = new byte[1 << 16];
+            int n = in.read(buf);
+            if (n < 0) {
+                throw new IOException("connection closed");
+            }
+            if (recvLen + n > recv.length) {
+                byte[] grown =
+                    new byte[Math.max(recv.length * 2, recvLen + n)];
+                System.arraycopy(recv, 0, grown, 0, recvLen);
+                recv = grown;
+            }
+            System.arraycopy(buf, 0, recv, recvLen, n);
+            recvLen += n;
+        }
+    }
+}

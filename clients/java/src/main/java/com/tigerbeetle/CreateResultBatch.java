@@ -1,0 +1,23 @@
+// Read-only cursor over {index u32, result u32} reply elements for
+// create_accounts / create_transfers.  The reply contains FAILURES
+// ONLY: an empty batch means every event succeeded
+// (tigerbeetle_tpu/types.py CREATE_RESULT_DTYPE; reference:
+// src/tigerbeetle.zig:267-285).
+package com.tigerbeetle;
+
+import java.nio.ByteBuffer;
+
+public final class CreateResultBatch extends Batch {
+    static final int ELEMENT_SIZE = 8;
+
+    CreateResultBatch(ByteBuffer wrapped) {
+        super(wrapped, ELEMENT_SIZE);
+    }
+
+    /** Index of the failed event within the request batch. */
+    public int getIndex() { return getU32(0); }
+
+    /** Raw result code (Types.CreateAccountResult /
+     * Types.CreateTransferResult value). */
+    public int getResult() { return getU32(4); }
+}

@@ -1,0 +1,20 @@
+// Cursor over 16-byte u128 ids (lookup_accounts / lookup_transfers
+// request bodies — tigerbeetle_tpu/types.py U128_PAIR_DTYPE).
+package com.tigerbeetle;
+
+public final class IdBatch extends Batch {
+    static final int ELEMENT_SIZE = 16;
+
+    public IdBatch(int capacity) {
+        super(capacity, ELEMENT_SIZE);
+    }
+
+    public void add(long lo, long hi) {
+        add();
+        setU64(0, lo);
+        setU64(8, hi);
+    }
+
+    public long getLo() { return getU64(0); }
+    public long getHi() { return getU64(8); }
+}

@@ -1,0 +1,65 @@
+// Typed cursor over 128-byte Transfer wire elements
+// (tigerbeetle_tpu/types.py TRANSFER_DTYPE; reference:
+// src/tigerbeetle.zig:80-111 and the reference's generated
+// TransferBatch — src/clients/java/src/main/java/com/tigerbeetle/).
+package com.tigerbeetle;
+
+import java.nio.ByteBuffer;
+
+public final class TransferBatch extends Batch {
+    static final int ELEMENT_SIZE = 128;
+
+    public TransferBatch(int capacity) {
+        super(capacity, ELEMENT_SIZE);
+    }
+
+    TransferBatch(ByteBuffer wrapped) {
+        super(wrapped, ELEMENT_SIZE);
+    }
+
+    public void setId(long lo, long hi) { setU64(0, lo); setU64(8, hi); }
+    public long getIdLo() { return getU64(0); }
+    public long getIdHi() { return getU64(8); }
+
+    public void setDebitAccountId(long lo, long hi) { setU64(16, lo); setU64(24, hi); }
+    public long getDebitAccountIdLo() { return getU64(16); }
+    public long getDebitAccountIdHi() { return getU64(24); }
+
+    public void setCreditAccountId(long lo, long hi) { setU64(32, lo); setU64(40, hi); }
+    public long getCreditAccountIdLo() { return getU64(32); }
+    public long getCreditAccountIdHi() { return getU64(40); }
+
+    public void setAmount(long lo, long hi) { setU64(48, lo); setU64(56, hi); }
+    public long getAmountLo() { return getU64(48); }
+    public long getAmountHi() { return getU64(56); }
+
+    public void setPendingId(long lo, long hi) { setU64(64, lo); setU64(72, hi); }
+    public long getPendingIdLo() { return getU64(64); }
+    public long getPendingIdHi() { return getU64(72); }
+
+    public void setUserData128(long lo, long hi) { setU64(80, lo); setU64(88, hi); }
+    public long getUserData128Lo() { return getU64(80); }
+    public long getUserData128Hi() { return getU64(88); }
+
+    public void setUserData64(long value) { setU64(96, value); }
+    public long getUserData64() { return getU64(96); }
+
+    public void setUserData32(int value) { setU32(104, value); }
+    public int getUserData32() { return getU32(104); }
+
+    public void setTimeout(int seconds) { setU32(108, seconds); }
+    public int getTimeout() { return getU32(108); }
+
+    public void setLedger(int ledger) { setU32(112, ledger); }
+    public int getLedger() { return getU32(112); }
+
+    public void setCode(int code) { setU16(116, code); }
+    public int getCode() { return getU16(116); }
+
+    /** Bit set of Types.TransferFlags values. */
+    public void setFlags(int flags) { setU16(118, flags); }
+    public int getFlags() { return getU16(118); }
+
+    /** Server-assigned; must be zero on create. */
+    public long getTimestamp() { return getU64(120); }
+}

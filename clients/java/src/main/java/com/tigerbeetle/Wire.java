@@ -1,0 +1,93 @@
+// Wire protocol: the 256-byte VSR message header and checksums.
+//
+// Layout mirrors tigerbeetle_tpu/vsr/wire.py HEADER_DTYPE (a
+// re-design of the reference's per-command header unions into one
+// flat little-endian layout — reference:
+// src/vsr/message_header.zig:17-103).  Checksums are SHA-256
+// truncated to 128 bits: `checksum` covers header bytes [16, 256),
+// `checksum_body` covers the body; both are verified before any
+// message is trusted.  Byte-identical to the Go/TS clients
+// (clients/fixtures/frames.json).
+package com.tigerbeetle;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.security.MessageDigest;
+import java.security.NoSuchAlgorithmException;
+
+final class Wire {
+    static final int HEADER_SIZE = 256;
+    static final int MESSAGE_SIZE_MAX = 1 << 20;
+
+    static final int OFF_CHECKSUM = 0;
+    static final int OFF_CHECKSUM_BODY = 16;
+    static final int OFF_CLIENT = 48;
+    static final int OFF_CLUSTER = 64;
+    static final int OFF_REQUEST = 112;
+    static final int OFF_SIZE = 144;
+    static final int OFF_COMMAND = 153;
+    static final int OFF_OPERATION = 154;
+    static final int OFF_VERSION = 155;
+
+    static final int CMD_REQUEST = 5;
+    static final int CMD_REPLY = 8;
+    static final int CMD_EVICTION = 18;
+
+    static final int OP_REGISTER = 2;
+
+    static final int WIRE_VERSION = 1;
+
+    private Wire() {}
+
+    static byte[] checksum128(byte[] data, int offset, int length) {
+        try {
+            MessageDigest d = MessageDigest.getInstance("SHA-256");
+            d.update(data, offset, length);
+            byte[] sum = d.digest();
+            byte[] out = new byte[16];
+            System.arraycopy(sum, 0, out, 0, 16);
+            return out;
+        } catch (NoSuchAlgorithmException e) {
+            throw new AssertionError(e);
+        }
+    }
+
+    /** Frames one request: header + body, checksums finalized. */
+    static byte[] buildRequest(long cluster, long clientLo, long clientHi,
+                               int requestNumber, int operation,
+                               byte[] body) {
+        byte[] msg = new byte[HEADER_SIZE + body.length];
+        System.arraycopy(body, 0, msg, HEADER_SIZE, body.length);
+        ByteBuffer h = ByteBuffer.wrap(msg).order(ByteOrder.LITTLE_ENDIAN);
+        h.putLong(OFF_CLIENT, clientLo);
+        h.putLong(OFF_CLIENT + 8, clientHi);
+        h.putLong(OFF_CLUSTER, cluster);
+        h.putInt(OFF_REQUEST, requestNumber);
+        h.putInt(OFF_SIZE, msg.length);
+        h.put(OFF_COMMAND, (byte) CMD_REQUEST);
+        h.put(OFF_OPERATION, (byte) operation);
+        h.put(OFF_VERSION, (byte) WIRE_VERSION);
+
+        byte[] bodySum = checksum128(msg, HEADER_SIZE, body.length);
+        System.arraycopy(bodySum, 0, msg, OFF_CHECKSUM_BODY, 16);
+        byte[] headSum = checksum128(msg, 16, HEADER_SIZE - 16);
+        System.arraycopy(headSum, 0, msg, OFF_CHECKSUM, 16);
+        return msg;
+    }
+
+    /** Verifies both checksums of a framed message. */
+    static void verifyMessage(byte[] msg, int size) {
+        byte[] headSum = checksum128(msg, 16, HEADER_SIZE - 16);
+        for (int i = 0; i < 16; i++) {
+            if (msg[OFF_CHECKSUM + i] != headSum[i]) {
+                throw new IllegalStateException("header checksum mismatch");
+            }
+        }
+        byte[] bodySum = checksum128(msg, HEADER_SIZE, size - HEADER_SIZE);
+        for (int i = 0; i < 16; i++) {
+            if (msg[OFF_CHECKSUM_BODY + i] != bodySum[i]) {
+                throw new IllegalStateException("body checksum mismatch");
+            }
+        }
+    }
+}

@@ -1,0 +1,91 @@
+// End-to-end test against a live server (spawned by
+// tests/test_foreign_clients.py, which passes host:port + cluster via
+// env).  Plain main() — no test-framework dependency; prints "e2e ok"
+// on success, exits nonzero on failure.
+package com.tigerbeetle;
+
+public final class E2ETest {
+    public static void main(String[] args) throws Exception {
+        String addr = System.getenv("TB_ADDRESS");
+        long cluster = Long.parseLong(System.getenv("TB_CLUSTER"));
+        String[] parts = addr.split(":");
+        try (Client client =
+                 new Client(parts[0], Integer.parseInt(parts[1]), cluster)) {
+            AccountBatch accounts = new AccountBatch(3);
+            for (int id = 1; id <= 3; id++) {
+                accounts.add();
+                accounts.setId(id, 0);
+                accounts.setLedger(1);
+                accounts.setCode(1);
+            }
+            CreateResultBatch r = client.createAccounts(accounts);
+            expect(r.getLength() == 0, "create_accounts failures");
+
+            // Duplicate id with different code -> exists_with_different_code.
+            AccountBatch dup = new AccountBatch(1);
+            dup.add();
+            dup.setId(1, 0);
+            dup.setLedger(1);
+            dup.setCode(9);
+            r = client.createAccounts(dup);
+            expect(r.getLength() == 1, "dup should fail");
+            r.next();
+            expect(
+                r.getResult()
+                    == Types.CreateAccountResult.ExistsWithDifferentCode.value,
+                "dup code " + r.getResult());
+
+            TransferBatch transfers = new TransferBatch(3);
+            transfers.add();
+            transfers.setId(10, 0);
+            transfers.setDebitAccountId(1, 0);
+            transfers.setCreditAccountId(2, 0);
+            transfers.setAmount(100, 0);
+            transfers.setLedger(1);
+            transfers.setCode(1);
+            transfers.add();  // pending
+            transfers.setId(11, 0);
+            transfers.setDebitAccountId(2, 0);
+            transfers.setCreditAccountId(3, 0);
+            transfers.setAmount(40, 0);
+            transfers.setLedger(1);
+            transfers.setCode(1);
+            transfers.setFlags(Types.TransferFlags.Pending);
+            transfers.add();  // post it (amount inherited)
+            transfers.setId(12, 0);
+            transfers.setPendingId(11, 0);
+            transfers.setFlags(Types.TransferFlags.PostPendingTransfer);
+            CreateResultBatch tr = client.createTransfers(transfers);
+            expect(tr.getLength() == 0, "create_transfers failures");
+
+            IdBatch ids = new IdBatch(3);
+            ids.add(1, 0);
+            ids.add(2, 0);
+            ids.add(3, 0);
+            AccountBatch got = client.lookupAccounts(ids);
+            expect(got.getLength() == 3, "lookup count " + got.getLength());
+            got.next();
+            expect(got.getDebitsPostedLo() == 100, "acct1 dpo");
+            got.next();
+            expect(got.getDebitsPostedLo() == 40, "acct2 dpo");
+            expect(got.getCreditsPostedLo() == 100, "acct2 cpo");
+            got.next();
+            expect(got.getCreditsPostedLo() == 40, "acct3 cpo");
+
+            IdBatch tid = new IdBatch(1);
+            tid.add(12, 0);
+            TransferBatch t12 = client.lookupTransfers(tid);
+            expect(t12.getLength() == 1, "t12 found");
+            t12.next();
+            expect(t12.getAmountLo() == 40, "t12 inherited amount");
+            expect(t12.getPendingIdLo() == 11, "t12 pending id");
+        }
+        System.out.println("e2e ok");
+    }
+
+    private static void expect(boolean cond, String what) {
+        if (!cond) {
+            throw new AssertionError(what);
+        }
+    }
+}

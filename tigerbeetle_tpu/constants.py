@@ -38,6 +38,12 @@ class Config:
     journal_slot_count: int
     # reference: src/config.zig:151
     clients_max: int = 64
+    # Hot RAM tail retained across checkpoints: spill beats keep the
+    # durable store at most this many rows ahead of the LSM tier, and
+    # checkpoints spill only the excess — so checkpoint latency is
+    # O(one beat), not O(interval).  0 = spill everything at
+    # checkpoint (the small-state test configs).
+    spill_keep_rows: int = 0
     quorum_replication_max: int = 3
 
     @property
@@ -68,6 +74,7 @@ PRODUCTION = Config(
     lsm_batch_multiple=32,
     pipeline_prepare_queue_max=8,
     journal_slot_count=1024,
+    spill_keep_rows=16_384,
 )
 
 # reference: src/config.zig:256-286 (config=test_min)

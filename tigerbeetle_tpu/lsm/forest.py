@@ -39,6 +39,7 @@ class Forest:
         # across restarts because grooves are re-declared identically
         # before open()).
         self._trees: list = []
+        self._beat_cursor = 0
 
     def groove(self, name: str, *, object_size: int,
                index_fields: list[str], index_value_size: int = 1) -> Groove:
@@ -59,10 +60,41 @@ class Forest:
         for g in self.grooves.values():
             g.maybe_seal()
 
+    def compact_beat(self, block_budget: int = 16) -> int:
+        """One beat of paced compaction: advance pending merges by at
+        most `block_budget` grid blocks across all trees, round-robin
+        from where the last beat stopped (reference:
+        src/lsm/forest.zig:846 CompactionPipeline beats).  Driven once
+        per commit by the replica — commit-count pacing keeps replicas
+        deterministic."""
+        used = 0
+        n = len(self._trees)
+        for k in range(n):
+            if used >= block_budget:
+                break
+            tree = self._trees[(self._beat_cursor + k) % n]
+            used += tree.compact_beat(block_budget - used)
+        self._beat_cursor = (self._beat_cursor + 1) % max(1, n)
+        return used
+
+    def compaction_pending(self) -> bool:
+        return any(t.compaction_pending() for t in self._trees)
+
     def manifest_blob(self) -> bytes:
         """Pure snapshot: log addresses + unflushed tail + memtable
-        batches + free set.  Mutates nothing (mid-interval snapshots
-        and the convergence checkers call this between checkpoints)."""
+        batches + free set + in-flight merge outputs.  Mutates nothing
+        (mid-interval snapshots and the convergence checkers call this
+        between checkpoints).
+
+        `orphans`: output blocks of merges still in flight.  The free
+        set counts them allocated but no manifest entry references
+        them; a restore releases them and the merge restarts from its
+        (still-referenced) inputs — which is what lets checkpoints
+        proceed WITHOUT draining compaction."""
+        orphans = []
+        for tree in self._trees:
+            if tree._job is not None:
+                orphans.extend(b.address for b in tree._job.out_blocks)
         return snapcodec.encode_tree(
             {
                 "log_addrs": np.array(self.mlog.blocks, np.uint64),
@@ -73,14 +105,27 @@ class Forest:
                 },
                 "free_set": self.grid.free_set.encode(),
                 "block_count": self.grid.block_count,
+                "orphans": np.array(orphans, np.uint64),
             }
         )
 
     def checkpoint(self) -> bytes:
-        """Seal all memtables, flush+compact the manifest log, release
-        staged blocks, and return the checkpoint blob."""
+        """Seal all memtables (bounds the blob), finish any ACTIVE
+        merge jobs, flush+compact the manifest log, release staged
+        blocks, and return the checkpoint blob.
+
+        Draining only the in-flight jobs — not every over-full level —
+        keeps checkpoints deterministic cluster-wide (no job ever
+        crosses a checkpoint, so blobs are state-functions; a crashed
+        replica restoring the blob converges with one that kept
+        running) while the latency stays bounded: an active job is at
+        most one level merge, and the disjoint-range moves that
+        dominate the big trees are metadata-only.  Remaining over-full
+        levels start their merges in the next interval's beats."""
         for tree in self._trees:
             tree.seal_memtable()
+            while tree._job is not None:
+                tree.compact_beat(1 << 30)
         # Log flush acquires blocks BEFORE staged releases activate, so
         # blocks referenced by the previous superblock are never
         # overwritten inside this checkpoint's crash window.
@@ -89,10 +134,24 @@ class Forest:
         return self.manifest_blob()
 
     def open(self, blob: bytes) -> None:
+        # Cancel any in-flight merges from the pre-restore state: a
+        # stale job would release blocks and log manifest events
+        # against the RESTORED free set/manifest (double-free).  Its
+        # partially-written output blocks are unreferenced in the
+        # restored state and simply get reused.
+        for tree in self._trees:
+            tree._job = None
+        self._beat_cursor = 0
         state = snapcodec.decode_tree(blob)
         self.grid.free_set = FreeSet.decode(
             state["free_set"], state["block_count"]
         )
+        # Merge outputs that were in flight at checkpoint time: no
+        # manifest entry references them — reclaim (staged; activates
+        # at the next checkpoint, so re-crashing re-releases them
+        # idempotently from the same blob).
+        for addr in state.get("orphans", np.zeros(0, np.uint64)):
+            self.grid.free_set.release(int(addr))
         runs = self.mlog.open(
             [int(a) for a in state["log_addrs"]], state["log_tail"]
         )

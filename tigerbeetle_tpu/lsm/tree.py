@@ -79,6 +79,8 @@ class Tree:
         self.memtable_count = 0
         # levels[i] = runs, newest last.
         self.levels: list[list[Run]] = [[] for _ in range(LEVELS)]
+        # At most one resumable merge in flight per tree.
+        self._job: "CompactionJob | None" = None
 
     # ------------------------------------------------------------------
     # Writes.
@@ -86,6 +88,13 @@ class Tree:
     def _push_batch(self, keys: np.ndarray, flags: np.ndarray,
                     values: np.ndarray) -> None:
         if len(keys) == 0:
+            return
+        # Strictly-increasing input (spill streams keyed by row number
+        # / timestamp) skips the sort AND the dedupe — void-dtype
+        # argsort is the hot cost of the LSM ingest path.
+        if len(keys) == 1 or not keys_le(keys[1:], keys[:-1]).any():
+            self.memtable.append((keys, flags, values))
+            self.memtable_count += len(keys)
             return
         # Stable sort + keep the LAST write per duplicate key within
         # the batch (dict-overwrite semantics).
@@ -247,6 +256,11 @@ class Tree:
             self.seal_memtable()
 
     def seal_memtable(self) -> None:
+        """Seal the memtable into a level-0 run.  Compaction debt this
+        creates is NOT paid here — beats (compact_beat) amortize it
+        across commits, and compact_drain() settles the rest at
+        checkpoint (reference: src/lsm/compaction.zig:1-32 paces the
+        same debt across the beats of a bar)."""
         if not self.memtable:
             return
         # Newest batch first: k_way_merge keeps the newest version.
@@ -257,7 +271,6 @@ class Tree:
         self.memtable_count = 0
         run = self._new_run(keys, flags, vals, level=0)
         self.levels[0].append(run)
-        self.compact()
 
     def _new_run(self, keys, flags, vals, *, level: int) -> Run:
         run = self._write_run(keys, flags, vals)
@@ -300,46 +313,82 @@ class Tree:
         return Run(blocks=blocks)
 
     def _level_run_max(self, level: int) -> int:
-        return GROWTH if level == 0 else GROWTH
+        """Constant run cap per level IS the geometric invariant here:
+        a level-L run is the merge of ~GROWTH level-(L-1) runs, so run
+        SIZE grows by GROWTH per level and a cap of GROWTH runs gives
+        each level ~GROWTH^L capacity (reference: src/config.zig
+        lsm_growth_factor; table-count-based in the reference because
+        its tables are fixed-size — ours are not)."""
+        del level
+        return GROWTH
 
-    def compact(self) -> None:
-        """Merge any over-full level into the next (whole-level merge;
-        the reference merges table-by-table per beat — pacing is a
-        throughput refinement, the shape invariant is the same)."""
+    # -- paced compaction -------------------------------------------------
+    #
+    # A merge of level L into L+1 reads both levels and rewrites them —
+    # done synchronously it is a latency cliff that grows with state.
+    # Instead an over-full level opens a resumable CompactionJob that
+    # advances a bounded number of grid blocks per beat; the replica
+    # beats every commit and drains at checkpoint
+    # (reference: src/lsm/compaction.zig:1-32, forest.zig:846
+    # CompactionPipeline).
+
+    def _over_full_level(self) -> int | None:
         for level in range(LEVELS - 1):
-            if len(self.levels[level]) <= self._level_run_max(level):
-                continue
-            merged_streams = []
-            # Newest first so k_way_merge keeps the newest version.
-            for run in reversed(self.levels[level]):
-                merged_streams.append(self._read_run_all(run))
-            for run in reversed(self.levels[level + 1]):
-                merged_streams.append(self._read_run_all(run))
-            drop_tombstones = level + 1 == LEVELS - 1 or not any(
-                self.levels[i] for i in range(level + 2, LEVELS)
-            )
-            keys, flags, vals = k_way_merge_flags(
-                merged_streams, self.value_size
-            )
-            if drop_tombstones:
-                live = flags == 0
-                keys, flags, vals = keys[live], flags[live], vals[live]
-            if self.mlog is not None:
-                for lvl in (level, level + 1):
-                    for run in self.levels[lvl]:
-                        self.mlog.run_remove(self.tree_id, lvl, run.id)
-            for run in self.levels[level] + self.levels[level + 1]:
-                self._release_run(run)
-            self.levels[level] = []
-            self.levels[level + 1] = (
-                [self._new_run(keys, flags, vals, level=level + 1)]
-                if len(keys)
-                else []
-            )
+            if len(self.levels[level]) > self._level_run_max(level):
+                return level
+        return None
+
+    def compaction_pending(self) -> bool:
+        return self._job is not None or self._over_full_level() is not None
+
+    def compact_beat(self, block_budget: int) -> int:
+        """Advance compaction by at most `block_budget` grid blocks
+        (read + written); returns blocks actually used.  Deterministic:
+        driven by commit count, never wall clock, so replicas stay
+        byte-identical."""
+        used = 0
+        while used < block_budget:
+            if self._job is None:
+                level = self._over_full_level()
+                if level is None:
+                    break
+                self._job = CompactionJob(self, level)
+            used += self._job.step(block_budget - used)
+            if self._job.done:
+                self._job = None
+        return used
+
+    def compact_drain(self) -> None:
+        """Checkpoint barrier: settle every pending merge (the free
+        set and manifest log must not reference half-built runs in a
+        checkpoint)."""
+        while self.compaction_pending():
+            self.compact_beat(1 << 30)
+
+    # Whole-batch compatibility shim (tests, standalone harnesses).
+    def compact(self) -> None:
+        self.compact_drain()
 
     def _read_run_all(self, run: Run):
         parts = [self._read_run_block(b) for b in run.blocks]
         return tuple(np.concatenate([p[j] for p in parts]) for j in range(3))
+
+    def _write_one_block(self, keys, flags, vals) -> RunBlock:
+        """Write a single run block (incremental output of a paced
+        merge; _write_run covers the whole-run seal path)."""
+        fs = self.grid.free_set
+        reservation = fs.reserve(1)
+        address = fs.acquire(reservation)
+        fs.forfeit(reservation)
+        payload = (
+            len(keys).to_bytes(4, "little")
+            + keys.tobytes() + flags.tobytes() + vals.tobytes()
+        )
+        self.grid.write_block(address, payload)
+        return RunBlock(
+            address=address, count=len(keys),
+            key_min=keys[0].tobytes(), key_max=keys[-1].tobytes(),
+        )
 
     def _release_run(self, run: Run) -> None:
         for block in run.blocks:
@@ -396,6 +445,219 @@ class Tree:
         self._next_run_id = next_id
 
 
+class _JobInput:
+    """Cursor over one input run's blocks (newest-precedence order is
+    the inputs list order, not anything here)."""
+
+    __slots__ = ("run", "block", "keys", "flags", "vals", "offset")
+
+    def __init__(self, run: Run) -> None:
+        self.run = run
+        self.block = 0
+        self.keys = None
+        self.flags = None
+        self.vals = None
+        self.offset = 0
+
+    @property
+    def exhausted(self) -> bool:
+        return self.keys is None and self.block >= len(self.run.blocks)
+
+
+class CompactionJob:
+    """Resumable merge of level L (+ level L+1) into one level-(L+1)
+    run, advanced a bounded number of blocks at a time.
+
+    Visibility: input runs stay in `tree.levels` (reads keep working)
+    until the final step, which atomically swaps them for the output
+    run and records the change in the manifest log.  A crash mid-job
+    loses only unreferenced output blocks — the last checkpoint's free
+    set never saw them (checkpoints drain jobs first).
+
+    Chunk correctness: each step merges all entries with key <= bound,
+    where bound = min over loaded blocks of that block's key_max.  Any
+    entry <= bound must live in its input's CURRENT block (later
+    blocks start above their predecessor's key_max >= bound), so
+    newest-wins dedupe within the chunk is globally correct.
+    """
+
+    def __init__(self, tree: Tree, level: int) -> None:
+        self.tree = tree
+        self.level = level
+        # Snapshot the input run lists: new seals arriving at level 0
+        # during the job are NOT part of it.
+        self.inputs_a = list(tree.levels[level])
+        self.inputs_b = list(tree.levels[level + 1])
+        # Newest first across both levels for merge precedence.
+        self.inputs = [
+            _JobInput(r) for r in reversed(self.inputs_a + self.inputs_b)
+        ]
+        self.drop_tombstones = level + 1 == LEVELS - 1 or not any(
+            tree.levels[i] for i in range(level + 2, LEVELS)
+        )
+        self.out_blocks: list[RunBlock] = []
+        self._buf: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self._buf_count = 0
+        self.done = False
+
+    def _try_move(self) -> bool:
+        """Move optimization (reference: src/lsm/compaction.zig
+        disjoint-table move): when the input runs cover pairwise
+        disjoint key ranges — the common case for trees keyed by
+        monotonically increasing values, like the spill object trees'
+        row numbers — the merge is pure metadata: the SAME grid blocks
+        re-file as one level-(L+1) run, no reads, no rewrites.
+
+        Only the level-L runs move; level L+1 keeps its runs untouched
+        (disjointness makes cross-level shadowing impossible).  That
+        keeps each move's manifest event O(level-L blocks): re-listing
+        an ever-growing merged L+1 run every move would be O(total
+        state) metadata per beat — the superlinear drag this bounds."""
+        runs = self.inputs_a + self.inputs_b
+        ordered = sorted(runs, key=lambda r: r.key_min)
+        for prev, cur in zip(ordered, ordered[1:]):
+            if not prev.key_max < cur.key_min:
+                return False
+        tree = self.tree
+        level = self.level
+        moved = sorted(self.inputs_a, key=lambda r: r.key_min)
+        if tree.mlog is not None:
+            for run in self.inputs_a:
+                tree.mlog.run_remove(tree.tree_id, level, run.id)
+        drop = set(id(r) for r in self.inputs_a)
+        tree.levels[level] = [
+            r for r in tree.levels[level] if id(r) not in drop
+        ]
+        out = Run(blocks=[b for r in moved for b in r.blocks])
+        out.id = tree._next_run_id
+        tree._next_run_id += 1
+        if tree.mlog is not None:
+            tree.mlog.run_add(
+                tree.tree_id, level + 1, out.id,
+                [
+                    (b.address, b.count, b.key_min, b.key_max)
+                    for b in out.blocks
+                ],
+            )
+        tree.levels[level + 1].append(out)
+        self.done = True
+        return True
+
+    def step(self, block_budget: int) -> int:
+        if not self.done and not self.out_blocks and not self._buf:
+            # First step: a disjoint input set moves instead of merging.
+            if self._try_move():
+                return 0
+        tree = self.tree
+        per_block = (tree.grid.payload_size - 4) // _entry_size(
+            tree.value_size
+        )
+        used = 0
+        while used < block_budget and not self.done:
+            # Load the current block of every non-exhausted input.
+            loaded = []
+            for inp in self.inputs:
+                if inp.keys is None and inp.block < len(inp.run.blocks):
+                    if used >= block_budget:
+                        return used
+                    inp.keys, inp.flags, inp.vals = tree._read_run_block(
+                        inp.run.blocks[inp.block]
+                    )
+                    inp.offset = 0
+                    used += 1
+                if inp.keys is not None:
+                    loaded.append(inp)
+            if not loaded:
+                used += self._finalize(per_block)
+                return used
+            # bytes comparison == key order (big-endian pack).
+            bound = np.frombuffer(
+                min(inp.keys[-1].tobytes() for inp in loaded), KEY_DTYPE
+            )
+            chunk = []
+            for inp in loaded:
+                hi = int(
+                    np.searchsorted(
+                        inp.keys[inp.offset :], bound, side="right"
+                    )[0]
+                ) + inp.offset
+                if hi > inp.offset:
+                    chunk.append(
+                        (
+                            inp.keys[inp.offset : hi],
+                            inp.flags[inp.offset : hi],
+                            inp.vals[inp.offset : hi],
+                        )
+                    )
+                inp.offset = hi
+                if inp.offset == len(inp.keys):
+                    inp.keys = inp.flags = inp.vals = None
+                    inp.block += 1
+            keys, flags, vals = k_way_merge_flags(chunk, tree.value_size)
+            if self.drop_tombstones:
+                live = flags == 0
+                keys, flags, vals = keys[live], flags[live], vals[live]
+            if len(keys):
+                self._buf.append((keys, flags, vals))
+                self._buf_count += len(keys)
+            while self._buf_count >= per_block and used < block_budget:
+                used += self._flush_block(per_block)
+        return used
+
+    def _pop_buffered(self, count: int):
+        keys = np.concatenate([b[0] for b in self._buf])
+        flags = np.concatenate([b[1] for b in self._buf])
+        vals = np.concatenate([b[2] for b in self._buf])
+        take = (keys[:count], flags[:count], vals[:count])
+        rest = keys[count:], flags[count:], vals[count:]
+        self._buf = [rest] if len(rest[0]) else []
+        self._buf_count = len(rest[0])
+        return take
+
+    def _flush_block(self, per_block: int) -> int:
+        keys, flags, vals = self._pop_buffered(per_block)
+        self.out_blocks.append(self.tree._write_one_block(keys, flags, vals))
+        return 1
+
+    def _finalize(self, per_block: int) -> int:
+        used = 0
+        while self._buf_count:
+            used += self._flush_block(per_block)
+        tree = self.tree
+        level = self.level
+        if tree.mlog is not None:
+            for lvl, runs in ((level, self.inputs_a), (level + 1, self.inputs_b)):
+                for run in runs:
+                    tree.mlog.run_remove(tree.tree_id, lvl, run.id)
+        for run in self.inputs_a + self.inputs_b:
+            tree._release_run(run)
+        # New seals may have landed at `level` during the job: keep them.
+        drop = set(id(r) for r in self.inputs_a + self.inputs_b)
+        tree.levels[level] = [
+            r for r in tree.levels[level] if id(r) not in drop
+        ]
+        survivors = [
+            r for r in tree.levels[level + 1] if id(r) not in drop
+        ]
+        if self.out_blocks:
+            out = Run(blocks=self.out_blocks)
+            out.id = tree._next_run_id
+            tree._next_run_id += 1
+            if tree.mlog is not None:
+                tree.mlog.run_add(
+                    tree.tree_id, level + 1, out.id,
+                    [
+                        (b.address, b.count, b.key_min, b.key_max)
+                        for b in out.blocks
+                    ],
+                )
+            tree.levels[level + 1] = [out] + survivors
+        else:
+            tree.levels[level + 1] = survivors
+        self.done = True
+        return used
+
+
 # ----------------------------------------------------------------------
 # Merges (reference: src/lsm/k_way_merge.zig, zig_zag_merge.zig).
 
@@ -403,11 +665,25 @@ class Tree:
 def k_way_merge_flags(streams, value_size: int):
     """Merge (keys, flags, values) streams, NEWEST FIRST: the first
     stream containing a key wins.  Returns sorted unique arrays with
-    tombstones retained."""
+    tombstones retained.  Inputs are individually sorted+unique (run
+    blocks and memtable batches are, by construction), which enables
+    two fast paths: a single stream passes through, and streams with
+    pairwise-disjoint key ranges concatenate without sorting."""
+    streams = [s for s in streams if len(s[0])]
     if not streams:
         return (
             np.zeros(0, KEY_DTYPE), np.zeros(0, np.uint8),
             np.zeros((0, value_size), np.uint8),
+        )
+    if len(streams) == 1:
+        return streams[0]
+    ordered = sorted(streams, key=lambda s: s[0][0].tobytes())
+    if all(
+        ordered[i][0][-1].tobytes() < ordered[i + 1][0][0].tobytes()
+        for i in range(len(ordered) - 1)
+    ):
+        return tuple(
+            np.concatenate([s[j] for s in ordered]) for j in range(3)
         )
     keys = np.concatenate([s[0] for s in streams])
     flags = np.concatenate([s[1] for s in streams])

@@ -462,10 +462,6 @@ def two_phase_resolve(
         "in_batch": in_batch,
         "durable": durable,
         "tgt_ev": tgt_ev,
-        "p_dr_slot": np.where(
-            in_batch, 0, p_join["dr_slot"].astype(np.int64)
-        ),  # caller overlays in-batch slots
-        "p_cr_slot": np.where(in_batch, 0, p_join["cr_slot"].astype(np.int64)),
         "res_amt_lo": res_amt_lo,
         "res_amt_hi": res_amt_hi,
         "p_amt_lo": p_amt_lo,

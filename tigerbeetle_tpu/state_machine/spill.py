@@ -117,6 +117,11 @@ class TransferSpill:
         self.groove.indexes["cr_slot"].put_batch(
             pack_u128(ts, cr.astype(np.uint64)), rows_v
         )
+        # Seal overflowing memtables NOW: paced spill beats must turn
+        # into bounded level-0 runs per beat, not one giant run at the
+        # checkpoint (which would re-create the latency cliff the
+        # beats exist to remove).
+        self.groove.maybe_seal()
         self.base += n
 
     # -- read ----------------------------------------------------------

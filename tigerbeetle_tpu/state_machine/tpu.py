@@ -172,7 +172,9 @@ class _GlobalCol:
             values = np.broadcast_to(np.asarray(values), rows.shape)
         in_ram = rows >= base
         if in_ram.any():
-            self._store.ram[self._name][rows[in_ram] - base] = values[in_ram]
+            self._store.ram[self._name][
+                rows[in_ram] - base + self._store._off
+            ] = values[in_ram]
         if not in_ram.all():
             # Spilled objects are immutable EXCEPT the pending status
             # byte, which post/void/expiry finalize in place.
@@ -192,36 +194,48 @@ class TailStore:
     def __init__(self, fields: dict, capacity: int = 1024) -> None:
         self.ram = Columns(fields, capacity)
         self.base = 0
+        # Dead physical rows at the front of `ram` (already spilled):
+        # drop_prefix advances this offset in O(1) and compacts only
+        # when dead rows dominate — per-beat spills must not pay an
+        # O(tail) memmove on the commit path.
+        self._off = 0
         self.spill = None  # TransferSpill once a forest is attached
 
     @property
     def count(self) -> int:
-        return self.base + self.ram.count
+        return self.base + self.ram.count - self._off
 
     def append(self, **arrays) -> np.ndarray:
-        return self.ram.append(**arrays) + self.base
+        return self.ram.append(**arrays) - self._off + self.base
 
     def col(self, name: str) -> np.ndarray:
-        """RAM-tail view (physical); pair with .base for global rows."""
-        return self.ram.col(name)
+        """Live RAM-tail view; index 0 corresponds to global row
+        .base."""
+        return self.ram.col(name)[self._off :]
+
+    def tail_count(self) -> int:
+        return self.ram.count - self._off
 
     def __getitem__(self, name: str) -> _GlobalCol:
         return _GlobalCol(self, name)
+
+    def _phys(self, rows):
+        return rows - self.base + self._off
 
     def gather(self, name: str, rows):
         from tigerbeetle_tpu.state_machine import spill as spill_mod
 
         if np.isscalar(rows) or isinstance(rows, (int, np.integer)):
             if rows >= self.base:
-                return self.ram[name][rows - self.base]
+                return self.ram[name][self._phys(rows)]
             obj = self.spill.gather(np.array([rows], np.int64))
             return spill_mod.unpack_objects(obj)[name][0]
         rows = np.asarray(rows)
         if len(rows) == 0 or (self.base == 0 or (rows >= self.base).all()):
-            return self.ram[name][rows - self.base]
+            return self.ram[name][self._phys(rows)]
         out = np.empty(len(rows), self.ram[name].dtype)
         in_ram = rows >= self.base
-        out[in_ram] = self.ram[name][rows[in_ram] - self.base]
+        out[in_ram] = self.ram[name][self._phys(rows[in_ram])]
         cold = ~in_ram
         obj = self.spill.gather(rows[cold])
         out[cold] = spill_mod.unpack_objects(obj)[name]
@@ -234,11 +248,11 @@ class TailStore:
         rows = np.asarray(rows)
         in_ram = rows >= self.base
         if in_ram.all():
-            phys = rows - self.base
+            phys = self._phys(rows)
             return {n: self.ram[n][phys] for n in names}
         cold_rows = rows[~in_ram]
         cold = spill_mod.unpack_objects(self.spill.gather(cold_rows))
-        phys = np.maximum(rows - self.base, 0)
+        phys = np.maximum(self._phys(rows), 0)
         out = {}
         for n in names:
             vals = self.ram[n][phys].copy()
@@ -248,13 +262,19 @@ class TailStore:
 
     def drop_prefix(self, n: int) -> None:
         """Advance base after `n` rows spilled (caller already wrote
-        them to the groove)."""
-        assert n <= self.ram.count
-        keep = self.ram.count - n
-        for name, colarr in self.ram._cols.items():
-            colarr[:keep] = colarr[n : self.ram.count]
-        self.ram.count = keep
+        them to the groove).  O(1); the physical compaction amortizes."""
+        assert n <= self.tail_count()
+        self._off += n
         self.base += n
+        # Compact when dead >= live: the move cost (live rows) is then
+        # bounded by the rows dropped since the last compaction, i.e.
+        # amortized O(1) per spilled row.
+        if self._off and self._off * 2 >= self.ram.count:
+            keep = self.ram.count - self._off
+            for _name, colarr in self.ram._cols.items():
+                colarr[:keep] = colarr[self._off : self.ram.count]
+            self.ram.count = keep
+            self._off = 0
 
 
 def _dir_capacity(entries: int) -> int:
@@ -378,6 +398,12 @@ class TpuStateMachine:
             index_fields=["dr_slot", "cr_slot"],
             index_value_size=8,
         )
+        # Index entries are 25B vs 161B objects; sealing them 8x less
+        # often keeps their levels shallow (every index run overlaps —
+        # (slot, ts) keys never move-optimize), cutting merge rewrite
+        # volume on the commit path.
+        for tree in transfers.indexes.values():
+            tree.memtable_max *= 8
         history = forest.groove(
             "account_history",
             object_size=spill_mod.HISTORY_OBJECT_SIZE,
@@ -385,6 +411,34 @@ class TpuStateMachine:
         )
         self._store.spill = spill_mod.TransferSpill(transfers)
         self._hspill = spill_mod.HistorySpill(history)
+
+    def spill_beat(
+        self, max_rows: int = 8192, keep_min: int | None = None
+    ) -> int:
+        """Paced spill: move at most `max_rows` of the OLDEST RAM-tail
+        rows into the LSM tier, keeping the most recent `keep_min` hot
+        in RAM.  Called once per commit by the replica, so the spill
+        cost (and the compaction debt it creates) amortizes across the
+        interval instead of landing inside the checkpoint
+        (reference: src/lsm/compaction.zig — data enters the LSM per
+        beat, not per checkpoint).  Deterministic: state-dependent
+        only."""
+        if self._forest is None:
+            return 0
+        if keep_min is None:
+            keep_min = max(self.config.spill_keep_rows, 16_384)
+        st = self._store
+        if st.tail_count() <= keep_min:
+            return 0
+        take = min(max_rows, st.tail_count() - keep_min)
+        rows = np.arange(st.base, st.base + take, dtype=np.int64)
+        cols = {name: st.col(name)[:take] for name in _STORE_FIELDS}
+        st.spill.spill(rows, cols, self._attrs)
+        st.drop_prefix(take)
+        # History spills at checkpoint only (checkpoint_spill): its
+        # rows are append-only and bounded per interval, and a per-beat
+        # prefix rebuild would cost more copying than it saves.
+        return take
 
     def checkpoint_spill(self) -> None:
         """Move the whole RAM tail into the LSM tier — including live
@@ -397,11 +451,14 @@ class TpuStateMachine:
         if self._forest is None:
             return
         st = self._store
-        limit = st.ram.count
+        # Retain the hot tail across checkpoints when configured: the
+        # snapshot blob carries it, so checkpoint cost is O(one beat's
+        # residue) instead of O(interval).
+        limit = max(0, st.tail_count() - self.config.spill_keep_rows)
         if limit > 0:
             rows = np.arange(st.base, st.base + limit, dtype=np.int64)
             cols = {
-                name: st.ram.col(name)[:limit] for name in _STORE_FIELDS
+                name: st.col(name)[:limit] for name in _STORE_FIELDS
             }
             st.spill.spill(rows, cols, self._attrs)
             st.drop_prefix(limit)
@@ -770,7 +827,7 @@ class TpuStateMachine:
                 native.add_transfer_ids(
                     cols["id_lo"], cols["id_hi"], int(rows[0])
                 )
-        if self._store.ram.count:
+        if self._store.tail_count():
             native.add_transfer_ids(
                 self._store.col("id_lo"), self._store.col("id_hi"),
                 self._store.base,
@@ -2092,7 +2149,7 @@ class TpuStateMachine:
         else:
             spilled = np.zeros(0, np.int64)
         # RAM tail: vectorized column scan.
-        mask = np.zeros(st.ram.count, bool)
+        mask = np.zeros(st.tail_count(), bool)
         if fflags & AccountFilterFlags.debits:
             mask |= st.col("dr_slot") == slot
         if fflags & AccountFilterFlags.credits:
@@ -2274,7 +2331,7 @@ def _tpu_restore(self, data: bytes) -> None:
             )
     self._tdir.insert(
         self._store.col("id_lo"), self._store.col("id_hi"),
-        np.arange(base, base + self._store.ram.count, dtype=np.uint64),
+        np.arange(base, base + self._store.tail_count(), dtype=np.uint64),
     )
 
     cap = max(1 << 12, 1 << (n_acct - 1).bit_length() if n_acct else 1)

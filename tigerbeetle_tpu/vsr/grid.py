@@ -72,6 +72,9 @@ class Grid:
         h["checksum_hi"] = c >> 64
         block = (h.tobytes() + payload).ljust(self.block_size, b"\x00")
         self.storage.write(self._offset(address), block)
+        # Kick async writeback now so the next checkpoint's full sync
+        # finds these pages already clean (no interval-sized stall).
+        self.storage.writeback_hint(self._offset(address), self.block_size)
         self._cache.put(address, payload)
 
     def read_block(self, address: int) -> bytes:

@@ -81,13 +81,18 @@ class Journal:
             msg = header.tobytes() + body
             padded = msg.ljust(_sectors(len(msg)), b"\x00")
             self.storage.write(self.layout.prepare_slot_offset(slot), padded)
-            if sync:
-                self.storage.sync()
-
             self.headers[slot] = header
             self._write_header_sector(slot)
             if sync:
-                self.storage.sync()
+                # ONE fdatasync of the WAL FILE covers both rings
+                # (device cache flush included — scoped alternatives
+                # like sync_file_range do NOT flush the drive cache).
+                # Safe: the op is only acked after this returns; a
+                # crash beforehand leaves torn states recovery already
+                # classifies.  The grid lives in its own file
+                # (storage.py FileStorage), so LSM spill/compaction
+                # writeback never rides the ack latency.
+                self.storage.sync_wal()
 
     def header_sector_intact(self, slot: int) -> bool:
         """Does the DISK redundant-header sector for `slot` match the

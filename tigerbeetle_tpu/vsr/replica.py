@@ -61,7 +61,8 @@ class Session:
 
 class Replica:
     def __init__(self, storage: Storage, cluster: int, state_machine,
-                 replica: int = 0, replica_count: int = 1, aof=None) -> None:
+                 replica: int = 0, replica_count: int = 1, aof=None,
+                 forest_block_count: int = FOREST_BLOCK_COUNT) -> None:
         self.storage = storage
         self.cluster = cluster
         self.sm = state_machine
@@ -95,7 +96,7 @@ class Replica:
             self.forest = Forest(
                 storage,
                 base_offset=storage.layout.grid_offset + 2 * SNAPSHOT_SPAN,
-                block_count=FOREST_BLOCK_COUNT,
+                block_count=forest_block_count,
             )
             state_machine.attach_forest(self.forest)
 
@@ -350,6 +351,7 @@ class Replica:
                         sub_h["client_hi"] = sub_client >> 64
                         sub_h["request"] = sub_request
                         self._store_reply(sub_h, piece)
+                self._compact_beat()
                 self.commit_min = op
                 if self.hash_log is not None and not replay:
                     self.hash_log.record(op, header.tobytes(), reply)
@@ -361,6 +363,7 @@ class Replica:
             ):
                 reply = self.sm.commit(client, op, timestamp, sm_op, body)
 
+        self._compact_beat()
         self.commit_min = op
         # Replayed commits are not recorded: a recovered WAL tail may
         # include speculative ops that never reached quorum and are
@@ -370,6 +373,22 @@ class Replica:
         if client and operation != int(VsrOperation.register):
             self._store_reply(header, reply)
         return reply
+
+    def _compact_beat(self) -> None:
+        """One beat of paced LSM work per commit (reference:
+        src/vsr/replica.zig:3847 .compact_state_machine stage,
+        src/lsm/compaction.zig beats): spill a bounded chunk of frozen
+        state into the LSM and advance a bounded slice of merge debt,
+        so checkpoints only settle a small residue instead of stalling
+        on a whole interval's worth."""
+        if self.forest is None:
+            return
+        spilled = 0
+        if hasattr(self.sm, "spill_beat"):
+            spilled = self.sm.spill_beat()
+        if spilled or self.forest.compaction_pending():
+            with self.tracer.span("lsm_compact_beat", rows=spilled):
+                self.forest.compact_beat(64)
 
     # ------------------------------------------------------------------
     # Client replies (reference: src/vsr/client_replies.zig).

@@ -111,6 +111,17 @@ class Storage:
     def sync(self) -> None:
         raise NotImplementedError
 
+    def writeback_hint(self, offset: int, size: int) -> None:
+        """START async writeback of a range without waiting (grid
+        block writes: the next checkpoint's full sync then finds most
+        pages already clean instead of stalling on an interval's worth
+        of dirty data).  Purely advisory — default no-op."""
+
+    def sync_wal(self) -> None:
+        """Durably flush the control/WAL zones only (ack path).
+        Backends without zone isolation flush everything."""
+        self.sync()
+
     def close(self) -> None:
         pass
 
@@ -123,31 +134,95 @@ class Storage:
         assert offset >= 0
 
 
+# Linux sync_file_range(2) via libc (no Python binding exists).
+_SFR_WAIT_BEFORE, _SFR_WRITE, _SFR_WAIT_AFTER = 1, 2, 4
+_sync_file_range = None
+try:
+    import ctypes as _ctypes
+
+    _libc = _ctypes.CDLL(None, use_errno=True)
+    _raw_sfr = _libc.sync_file_range
+    _raw_sfr.restype = _ctypes.c_int
+    _raw_sfr.argtypes = [
+        _ctypes.c_int, _ctypes.c_long, _ctypes.c_long, _ctypes.c_uint,
+    ]
+    _sync_file_range = _raw_sfr
+except (OSError, AttributeError):
+    _sync_file_range = None
+
+
 class FileStorage(Storage):
+    """Two files: `path` holds the control zones (superblock, WAL
+    rings, client replies) and `path`.grid holds the grid zone.  The
+    commit path's per-op fdatasync then flushes ONLY the WAL file —
+    LSM spill/compaction writeback in the grid file never rides the
+    ack latency (the isolation the reference gets from O_DIRECT; a
+    fdatasync on a shared inode would flush everything).  sync()
+    flushes both (checkpoint ordering barrier)."""
+
     def __init__(self, path: str, layout: ZoneLayout, create: bool = False) -> None:
         self.layout = layout
         flags = os.O_RDWR | (os.O_CREAT if create else 0)
         self._fd = os.open(path, flags, 0o644)
+        try:
+            self._fd_grid = os.open(path + ".grid", flags, 0o644)
+        except FileNotFoundError:
+            os.close(self._fd)
+            raise RuntimeError(
+                f"{path}.grid is missing: the data file's grid zone "
+                "lives in a sibling .grid file (keep them together; "
+                "re-run `format` to create a fresh pair)"
+            ) from None
         if create:
-            os.ftruncate(self._fd, layout.total_size)
+            os.ftruncate(self._fd, layout.grid_offset)
+        self._grid_off = layout.grid_offset
+        self._grid_dirty = False
+        self._wal_dirty = False
+
+    def _at(self, offset: int) -> tuple[int, int]:
+        if offset >= self._grid_off:
+            return self._fd_grid, offset - self._grid_off
+        return self._fd, offset
 
     def read(self, offset: int, size: int) -> bytes:
         self._check(offset, size)
-        data = os.pread(self._fd, size, offset)
+        fd, off = self._at(offset)
+        data = os.pread(fd, size, off)
         if len(data) < size:  # reading past EOF in the grid zone
             data = data.ljust(size, b"\x00")
         return data
 
     def write(self, offset: int, data: bytes) -> None:
         self._check(offset, len(data))
-        written = os.pwrite(self._fd, data, offset)
+        fd, off = self._at(offset)
+        written = os.pwrite(fd, data, off)
         assert written == len(data)
+        if fd == self._fd_grid:
+            self._grid_dirty = True
+        else:
+            self._wal_dirty = True
 
     def sync(self) -> None:
+        if self._wal_dirty:
+            os.fdatasync(self._fd)
+            self._wal_dirty = False
+        if self._grid_dirty:
+            os.fdatasync(self._fd_grid)
+            self._grid_dirty = False
+
+    def sync_wal(self) -> None:
+        """Flush the control/WAL file only (per-op ack durability)."""
         os.fdatasync(self._fd)
+        self._wal_dirty = False
+
+    def writeback_hint(self, offset: int, size: int) -> None:
+        if _sync_file_range is not None:
+            fd, off = self._at(offset)
+            _sync_file_range(fd, off, size, _SFR_WRITE)
 
     def close(self) -> None:
         os.close(self._fd)
+        os.close(self._fd_grid)
 
 
 class MemoryStorage(Storage):
