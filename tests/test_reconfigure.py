@@ -1,0 +1,132 @@
+"""Reconfiguration: replicated membership change with epoch bump
+(reference: src/vsr.zig:273-311).  First use case: standby promotion —
+swap a dead active's slot with a standby that has been replicating all
+along, without losing committed state."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.harness import account, ids_bytes, pack, transfer
+from tigerbeetle_tpu.vsr.replica import Replica
+from tigerbeetle_tpu.vsr.wire import VsrOperation
+
+
+def make_cluster(**kw):
+    c = Cluster(replica_count=3, standby_count=1, **kw)
+    client = c.client(1000)
+    client.register()
+    c.run_until(lambda: client.registered)
+    return c, client
+
+
+def reconfigure_body(epoch, members):
+    return Replica.encode_reconfigure(epoch, members)
+
+
+def test_standby_promotion_preserves_committed_state():
+    c, client = make_cluster()
+    assert c.run_request(
+        client, types.Operation.create_accounts, pack([account(1), account(2)])
+    ) == b""
+    for i in range(5):
+        assert c.run_request(
+            client, types.Operation.create_transfers,
+            pack([transfer(10 + i, debit_account_id=1, credit_account_id=2,
+                           amount=10)]),
+        ) == b""
+
+    # Kill active slot 2 (a backup); promote the standby (process 3).
+    c.crash_replica(2)
+    reply = c.run_request(
+        client, VsrOperation.reconfigure, reconfigure_body(1, [0, 1, 3, 2])
+    )
+    assert int.from_bytes(reply, "little") == 0
+    # Every live replica adopted the new membership and roles.
+    for proc in (0, 1, 3):
+        r = c.replicas[proc]
+        assert r.epoch == 1
+        assert r.members == [0, 1, 3, 2]
+    assert c.replicas[3].replica == 2          # promoted into slot 2
+    assert not c.replicas[3].standby
+    # The cluster keeps committing with the promoted member acking.
+    for i in range(5):
+        assert c.run_request(
+            client, types.Operation.create_transfers,
+            pack([transfer(50 + i, debit_account_id=1, credit_account_id=2,
+                           amount=10)]),
+        ) == b""
+    out = c.run_request(
+        client, types.Operation.lookup_accounts, ids_bytes([1])
+    )
+    rows = np.frombuffer(out, types.ACCOUNT_DTYPE)
+    assert types.u128_get(rows[0], "debits_posted") == 100
+    # The promoted process holds the full committed state.
+    assert c.replicas[3].sm.transfer_timestamp(54) is not None
+    assert c.replicas[3].sm.transfer_timestamp(10) is not None
+
+
+def test_reconfigure_rejects_stale_epoch_and_bad_members():
+    c, client = make_cluster()
+    reply = c.run_request(
+        client, VsrOperation.reconfigure, reconfigure_body(7, [0, 1, 2, 3])
+    )
+    assert int.from_bytes(reply, "little") == 1  # epoch must be current+1
+    reply = c.run_request(
+        client, VsrOperation.reconfigure, reconfigure_body(1, [0, 1, 2, 2])
+    )
+    assert int.from_bytes(reply, "little") == 2  # not a permutation
+    reply = c.run_request(
+        client, VsrOperation.reconfigure, reconfigure_body(1, [0, 2, 1, 3])
+    )
+    assert int.from_bytes(reply, "little") == 0
+    c.run_until(
+        lambda: c.replicas[1].epoch == 1 and c.replicas[2].epoch == 1
+    )
+    assert c.replicas[1].replica == 2 and c.replicas[2].replica == 1
+
+
+def test_restarted_process_relearns_membership_from_wal():
+    """A process that crashed BEFORE a reconfigure committed must
+    re-derive its new (standby) role from the replicated log after
+    restarting."""
+    c, client = make_cluster()
+    assert c.run_request(
+        client, types.Operation.create_accounts, pack([account(1), account(2)])
+    ) == b""
+    c.crash_replica(2)
+    reply = c.run_request(
+        client, VsrOperation.reconfigure, reconfigure_body(1, [0, 1, 3, 2])
+    )
+    assert int.from_bytes(reply, "little") == 0
+    assert c.run_request(
+        client, types.Operation.create_transfers,
+        pack([transfer(10, debit_account_id=1, credit_account_id=2, amount=5)]),
+    ) == b""
+    c.restart_replica(2)
+    c.settle()
+    # Repair/catch-up replays the reconfigure op: the old process now
+    # fills the standby slot and still replicates commits.
+    c.run_until(lambda: c.replicas[2].epoch == 1, max_steps=4000)
+    assert c.replicas[2].members == [0, 1, 3, 2]
+    assert c.replicas[2].replica == 3
+    assert c.replicas[2].standby
+    c.run_until(
+        lambda: c.replicas[2].sm.transfer_timestamp(10) is not None,
+        max_steps=4000,
+    )
+
+
+def test_reconfigure_malformed_body_is_rejected_not_fatal():
+    """A poison reconfigure body (too short / bad count) must commit
+    with a result code, never crash the commit path of the cluster."""
+    c, client = make_cluster()
+    for body in (b"", b"\x01" * 5, (1).to_bytes(8, "little") + b"\xff"):
+        reply = c.run_request(client, VsrOperation.reconfigure, body)
+        assert int.from_bytes(reply, "little") == 2, body
+    # The cluster is still alive and at epoch 0.
+    assert c.run_request(
+        client, types.Operation.create_accounts, pack([account(1)])
+    ) == b""
+    assert all(r.epoch == 0 for r in c.replicas)

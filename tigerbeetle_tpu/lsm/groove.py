@@ -88,15 +88,20 @@ class Groove:
         return found, values
 
     def index_scan(self, field: str, value: int, *, ts_min: int = 0,
-                   ts_max: int = (1 << 64) - 1) -> np.ndarray:
-        """-> matching timestamps, ascending."""
+                   ts_max: int = (1 << 64) - 1,
+                   return_values: bool = False) -> np.ndarray:
+        """-> matching timestamps, ascending — or, with return_values,
+        the index entries' 8-byte payloads (e.g. the spill grooves'
+        row pointers, which ascend with timestamp) in the same order."""
         lo = pack_u128(
             np.array([ts_min], np.uint64), np.array([value], np.uint64)
         ).tobytes()
         hi = pack_u128(
             np.array([ts_max], np.uint64), np.array([value], np.uint64)
         ).tobytes()
-        keys, _ = self.indexes[field].scan_range(lo, hi)
+        keys, vals = self.indexes[field].scan_range(lo, hi)
+        if return_values:
+            return vals.view("<u8").reshape(-1).astype(np.uint64)
         # Key layout is (hi=value, lo=timestamp) big-endian packed:
         # the low 8 bytes are the big-endian timestamp.
         raw = keys.tobytes()

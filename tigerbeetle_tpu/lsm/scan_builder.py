@@ -70,21 +70,31 @@ class ScanBuilder:
         ts_max: int = U64_MAX,
         reversed: bool = False,
         limit: int | None = None,
+        return_values: bool = False,
     ) -> np.ndarray:
-        """-> matching timestamps in scan direction, limited."""
-        ts = self._eval(scan, ts_min, ts_max)
+        """-> matching timestamps in scan direction, limited.  With
+        return_values, the index entries' 8-byte payloads instead (the
+        spill grooves' row pointers — monotone with timestamp, so the
+        set algebra is identical)."""
+        ts = self._eval(scan, ts_min, ts_max, return_values)
         if reversed:
             ts = ts[::-1]
         if limit is not None:
             ts = ts[:limit]
         return np.ascontiguousarray(ts)
 
-    def _eval(self, scan: Scan, ts_min: int, ts_max: int) -> np.ndarray:
+    def _eval(
+        self, scan: Scan, ts_min: int, ts_max: int, return_values: bool
+    ) -> np.ndarray:
         if scan.kind == "eq":
             return self.groove.index_scan(
-                scan.field, scan.value, ts_min=ts_min, ts_max=ts_max
+                scan.field, scan.value, ts_min=ts_min, ts_max=ts_max,
+                return_values=return_values,
             )
-        parts = [self._eval(c, ts_min, ts_max) for c in scan.children]
+        parts = [
+            self._eval(c, ts_min, ts_max, return_values)
+            for c in scan.children
+        ]
         if scan.kind == "union":
             out = parts[0]
             for p in parts[1:]:

@@ -48,15 +48,26 @@ class TcpBus:
         self.native = NativeBus(message_size_max)
         host, port = parse_address(addresses[replica_index])
         self.port = self.native.listen(host, port)
-        self.replica_conns: dict[int, int] = {}
+        self.replica_conns: dict[int, int] = {}  # keyed by PROCESS index
         self.client_conns: dict[int, int] = {}
         self._conn_peer: dict[int, tuple[str, object]] = {}
         self._pending_connects: dict[int, int] = {}  # conn -> replica
+        # Protocol slot -> process index (reconfiguration re-points
+        # slots at different processes; connections stay per-process).
+        self._slot_map: list[int] | None = None
+
+    def set_slot_map(self, members: list[int]) -> None:
+        self._slot_map = list(members)
 
     # -- VsrReplica interface --
 
     def send(self, dst_replica: int, header: np.ndarray, body: bytes) -> None:
-        conn = self.replica_conns.get(dst_replica)
+        process = (
+            self._slot_map[dst_replica]
+            if self._slot_map is not None and dst_replica < len(self._slot_map)
+            else dst_replica
+        )
+        conn = self.replica_conns.get(process)
         if conn is None:
             return  # not connected yet; protocol retransmits
         self.native.send(conn, header.tobytes() + body)
@@ -93,9 +104,19 @@ class TcpBus:
         self.native.send(conn, h.tobytes())
 
     def register_peer(self, conn: int, replica_index: int) -> None:
+        """`replica_index` is the sender's protocol SLOT (from its
+        message headers); connections are keyed by PROCESS, so the
+        slot map translates here too — otherwise a reconfigured peer's
+        pings would overwrite another process's connection entry."""
         self._pending_connects.pop(conn, None)
-        self.replica_conns[replica_index] = conn
-        self._conn_peer[conn] = ("replica", replica_index)
+        process = (
+            self._slot_map[replica_index]
+            if self._slot_map is not None
+            and replica_index < len(self._slot_map)
+            else replica_index
+        )
+        self.replica_conns[process] = conn
+        self._conn_peer[conn] = ("replica", process)
 
     def register_client(self, conn: int, client: int) -> None:
         self.client_conns[client] = conn

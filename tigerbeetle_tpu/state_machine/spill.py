@@ -141,17 +141,6 @@ class TransferSpill:
         obj[:, 136] = np.asarray(statuses, np.uint8)
         self.groove.object_tree.put_batch(_row_keys(rows), obj)
 
-    def index_rows(self, field: str, slot: int, *, ts_min: int,
-                   ts_max: int) -> np.ndarray:
-        """Rows (ascending) where field == slot within the ts range."""
-        lo = pack_u128(
-            np.array([ts_min], np.uint64), np.array([slot], np.uint64)
-        ).tobytes()
-        hi = pack_u128(
-            np.array([ts_max], np.uint64), np.array([slot], np.uint64)
-        ).tobytes()
-        _keys, vals = self.groove.indexes[field].scan_range(lo, hi)
-        return vals.view("<u8").reshape(-1).astype(np.int64)
 
     def iter_objects(self, batch: int = 8192):
         """Yield (rows, objects) over all spilled rows ascending —

@@ -2129,23 +2129,23 @@ class TpuStateMachine:
         st = self._store
         lo = TIMESTAMP_MIN if ts_min == 0 else ts_min
         hi = TIMESTAMP_MAX if ts_max == 0 else ts_max
-        # Spilled rows: timestamp-ordered (slot, ts) index scans on the
-        # LSM tier (reference: src/state_machine.zig:931-996 builds the
-        # same dr/cr index scans through the ScanBuilder).
-        parts = []
+        # Spilled rows: the query composes through the ScanBuilder —
+        # the same expression engine (eq / union / intersect over the
+        # (slot, ts) index trees) the reference routes queries through
+        # (reference: src/state_machine.zig:931-996 -> src/lsm/
+        # scan_builder.zig:529).  Values mode yields row pointers.
         if st.base:
+            from tigerbeetle_tpu.lsm.scan_builder import ScanBuilder
+
+            sb = ScanBuilder(st.spill.groove)
+            scans = []
             if fflags & AccountFilterFlags.debits:
-                parts.append(
-                    st.spill.index_rows("dr_slot", slot, ts_min=lo, ts_max=hi)
-                )
+                scans.append(sb.eq("dr_slot", slot))
             if fflags & AccountFilterFlags.credits:
-                parts.append(
-                    st.spill.index_rows("cr_slot", slot, ts_min=lo, ts_max=hi)
-                )
-        if len(parts) == 2:
-            spilled = np.union1d(parts[0], parts[1])
-        elif parts:
-            spilled = parts[0]
+                scans.append(sb.eq("cr_slot", slot))
+            spilled = sb.evaluate(
+                sb.union(*scans), ts_min=lo, ts_max=hi, return_values=True
+            ).astype(np.int64)
         else:
             spilled = np.zeros(0, np.int64)
         # RAM tail: vectorized column scan.

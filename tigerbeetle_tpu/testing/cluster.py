@@ -93,13 +93,21 @@ class PacketSimulator:
 
 
 class _Bus:
-    """Per-replica bus endpoint feeding the packet simulator."""
+    """Per-replica bus endpoint feeding the packet simulator.  `src`
+    is the PROCESS index; protocol messages address SLOTS, which the
+    slot map (reconfiguration) translates back to processes."""
 
     def __init__(self, cluster: "Cluster", src) -> None:
         self.cluster = cluster
         self.src = src
+        self._slot_map: list[int] | None = None
+
+    def set_slot_map(self, members) -> None:
+        self._slot_map = list(members)
 
     def send(self, dst: int, header: np.ndarray, body: bytes) -> None:
+        if self._slot_map is not None and dst < len(self._slot_map):
+            dst = self._slot_map[dst]
         self.cluster.network.submit(self.src, dst, header, body)
 
     def send_client(self, client: int, header: np.ndarray, body: bytes) -> None:
@@ -195,7 +203,9 @@ class SimClient:
             else [self.view_guess % self.cluster.replica_count]
         )
         for r in targets:
-            self.cluster.network.submit(self.id, r, header, body)
+            self.cluster.network.submit(
+                self.id, self.cluster.process_of_slot(r), header, body
+            )
 
 
 class Cluster:
@@ -237,6 +247,16 @@ class Cluster:
         # (vsr/clock.py) must keep primary timestamps near true time
         # despite this.
         self.clock_skew = [0] * (replica_count + standby_count)
+
+    def process_of_slot(self, slot: int) -> int:
+        """Current process filling a protocol slot (reconfiguration
+        moves slots between processes; any live replica's membership
+        view serves — they agree at commit boundaries)."""
+        for r in self.replicas:
+            if r.status == "normal" and r.members is not None:
+                if slot < len(r.members):
+                    return r.members[slot]
+        return slot
 
     def client(self, client_id: int) -> SimClient:
         # Replica addresses (actives then standbys) occupy

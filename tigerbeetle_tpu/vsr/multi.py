@@ -102,6 +102,9 @@ class VsrReplica(Replica):
         self.standby = replica >= replica_count
         self.status = "recovering"
         self.log_view = 0
+        # Identity membership until a reconfigure op (or a restored
+        # superblock) says otherwise: slot k is process k.
+        self.members = list(range(replica_count + standby_count))
 
         # Multiversion upgrades (reference: src/vsr/replica.zig:4298
         # replica_release_execute, Operation.upgrade, `release` in every
@@ -208,6 +211,28 @@ class VsrReplica(Replica):
 
     def primary_index(self, view: int | None = None) -> int:
         return (self.view if view is None else view) % self.replica_count
+
+    # ------------------------------------------------------------------
+    # Reconfiguration (reference: src/vsr.zig:273-311): protocol slots
+    # are stable; a committed epoch bump re-assigns which PROCESS fills
+    # each slot (standby promotion: swap a dead active's slot with a
+    # standby's — the standby has been replicating all along, so it
+    # carries the state its new active role needs).
+
+    def _member_total(self) -> int:
+        return self.total_count
+
+    def _apply_membership(self, members: list[int]) -> None:
+        self.members = list(members)
+        slot = self.members.index(self.process_index)
+        self.replica = slot
+        self.standby = slot >= self.replica_count
+        if hasattr(self.bus, "set_slot_map"):
+            self.bus.set_slot_map(self.members)
+        # Clock samples are slot-keyed; restart sampling under the new
+        # identity (commits gate on resynchronization, briefly).
+        self.clock = Clock(slot, self.replica_count)
+        self.peer_release = {slot: max(self.releases_available)}
 
     @property
     def is_primary(self) -> bool:
@@ -357,6 +382,12 @@ class VsrReplica(Replica):
 
     def _send_heartbeat(self) -> None:
         self._last_ping_sent = self._ticks
+        # Body: committed membership advertisement (see _on_commit).
+        body = (
+            self.encode_reconfigure(self.epoch, self.members)
+            if self.epoch
+            else b""
+        )
         h = wire.make_header(
             command=Command.commit, cluster=self.cluster, view=self.view,
             replica=self.replica, commit=self.commit_min,
@@ -365,10 +396,10 @@ class VsrReplica(Replica):
             # (reference: Command.commit carries commit_checksum).
             context=self.commit_parent or 0,
         )
-        wire.finalize_header(h, b"")
+        wire.finalize_header(h, body)
         for r in range(self.total_count):
             if r != self.replica:
-                self.bus.send(r, h, b"")
+                self.bus.send(r, h, body)
 
     # ------------------------------------------------------------------
     # Message dispatch.
@@ -921,6 +952,23 @@ class VsrReplica(Replica):
     def _on_commit(self, header: np.ndarray, body: bytes) -> None:
         if int(header["view"]) < self.view or self.status != "normal":
             return
+        # Heartbeats advertise committed membership: a process that
+        # crashed before a reconfigure committed re-learns its role
+        # here (epoch is monotonic committed state, so adopting a
+        # NEWER one out-of-band is safe; the replicated op later
+        # replays idempotently).  Without this the stale process is
+        # unreachable — its repair requests carry the old slot, so
+        # responses route to whoever fills that slot now.
+        if body:
+            decoded = self.decode_reconfigure(body)
+            if decoded is not None:
+                epoch, members = decoded
+                if epoch > self.epoch and sorted(members) == list(
+                    range(self.total_count)
+                ):
+                    self.epoch = epoch
+                    self._reconfig_history[epoch] = list(members)
+                    self._apply_membership(members)
         if int(header["view"]) > self.view:
             self._enter_view(int(header["view"]))
         self._last_primary_seen = self._ticks

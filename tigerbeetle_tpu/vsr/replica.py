@@ -79,6 +79,15 @@ class Replica:
         self.config = storage.layout.config
         self.replica = replica
         self.replica_count = replica_count
+        # Reconfiguration (reference: src/vsr.zig:273-311): the FIXED
+        # process identity (index into the operator's address list) vs
+        # the protocol slot (`self.replica`) the process currently
+        # fills.  `members[slot] = process`; epoch bumps per change.
+        self.process_index = replica
+        self.epoch = 0
+        self.members: list[int] | None = None
+        # epoch -> members actually applied (replay idempotency).
+        self._reconfig_history: dict[int, list[int]] = {}
 
         self.superblock = SuperBlock(storage, cluster)
         self.journal = Journal(storage, cluster)
@@ -140,6 +149,13 @@ class Replica:
             # checksum verification.
             self.cluster = self.superblock.cluster
             self.journal.cluster = self.cluster
+        if int(sb["member_count"]):
+            self.epoch = int(sb["epoch"])
+            members = list(
+                bytes(sb["members"])[: int(sb["member_count"])]
+            )
+            self._reconfig_history[self.epoch] = list(members)
+            self._apply_membership(members)
         self.view = int(sb["view"])
         self.checkpoint_op = int(sb["commit_min"])
 
@@ -311,6 +327,11 @@ class Replica:
                 slot=self._alloc_reply_slot(),
             )
             assert len(self.sessions) <= self.config.clients_max
+        elif operation == int(VsrOperation.reconfigure):
+            # Replicated membership change (reference:
+            # src/vsr.zig:273-311): epoch bump + slot->process
+            # permutation; reply is a 4-byte result code.
+            reply = self._commit_reconfigure(body)
         elif operation == int(VsrOperation.upgrade):
             # Cluster-coordinated release switch (reference:
             # src/vsr/replica.zig:4298 replica_release_execute): the
@@ -373,6 +394,69 @@ class Replica:
         if client and operation != int(VsrOperation.register):
             self._store_reply(header, reply)
         return reply
+
+    # ------------------------------------------------------------------
+    # Reconfiguration (reference: src/vsr.zig:273-311).
+
+    @staticmethod
+    def decode_reconfigure(body: bytes) -> tuple[int, list[int]] | None:
+        """None = malformed (a poison body must fail with a result
+        code, never crash the commit path of every replica)."""
+        if len(body) < 9:
+            return None
+        epoch = int.from_bytes(body[:8], "little")
+        count = body[8]
+        if count == 0 or count > 64 or len(body) < 9 + count:
+            return None
+        return epoch, list(body[9 : 9 + count])
+
+    @staticmethod
+    def encode_reconfigure(epoch: int, members: list[int]) -> bytes:
+        return (
+            epoch.to_bytes(8, "little")
+            + bytes([len(members)])
+            + bytes(members)
+        )
+
+    def validate_reconfigure(self, epoch: int, members: list[int]) -> int:
+        """-> 0 ok; 1 stale/skipped epoch; 2 malformed membership."""
+        if epoch != self.epoch + 1:
+            return 1
+        if sorted(members) != list(range(self._member_total())):
+            return 2
+        return 0
+
+    def _member_total(self) -> int:
+        return self.replica_count  # multi.py adds standbys
+
+    def _commit_reconfigure(self, body: bytes) -> bytes:
+        decoded = self.decode_reconfigure(body)
+        if decoded is None:
+            return (2).to_bytes(4, "little")
+        epoch, members = decoded
+        if self._reconfig_history.get(epoch) == members:
+            # Idempotent replay: a process that adopted membership
+            # out-of-band (heartbeat advertisement) replays the op with
+            # the same success code every live replica recorded.  (A
+            # process crashed across SEVERAL reconfigures learns only
+            # the latest via heartbeats; replies for the intermediate
+            # ops would need the full history — acceptable residual:
+            # clients retry reconfigure against the session reply only
+            # within one epoch.)
+            return (0).to_bytes(4, "little")
+        code = self.validate_reconfigure(epoch, members)
+        if code == 0:
+            self.epoch = epoch
+            self._reconfig_history[epoch] = list(members)
+            self._apply_membership(members)
+        return code.to_bytes(4, "little")
+
+    def _apply_membership(self, members: list[int]) -> None:
+        """Adopt the slot this process fills under `members`
+        (single-replica base: bookkeeping only; multi.py re-derives
+        roles, ring, and clock)."""
+        self.members = members
+        self.replica = members.index(self.process_index)
 
     def _compact_beat(self) -> None:
         """One beat of paced LSM work per commit (reference:
@@ -502,6 +586,8 @@ class Replica:
             checkpoint_size=len(blob),
             checkpoint_checksum=wire.checksum(blob),
             view=self.view,
+            epoch=self.epoch,
+            members=self.members,
         )
         self.checkpoint_op = self.commit_min
 

@@ -40,7 +40,13 @@ SUPERBLOCK_DTYPE = np.dtype(
         ("checkpoint_offset", "<u8"),
         ("checkpoint_size", "<u8"),
         ("checkpoint_checksum_lo", "<u8"), ("checkpoint_checksum_hi", "<u8"),
-        ("reserved", f"V{SUPERBLOCK_COPY_SIZE - 120}"),
+        # Cluster membership (reconfiguration; reference:
+        # src/vsr.zig:273-311): epoch + the slot->process permutation.
+        # member_count == 0 means the identity default.
+        ("epoch", "<u8"),
+        ("member_count", "<u2"),
+        ("members", "V64"),
+        ("reserved", f"V{SUPERBLOCK_COPY_SIZE - 194}"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == SUPERBLOCK_COPY_SIZE
@@ -81,12 +87,20 @@ class SuperBlock:
         checkpoint_checksum: int,
         view: int | None = None,
         log_view: int | None = None,
+        epoch: int | None = None,
+        members: list[int] | None = None,
     ) -> None:
         """Durably advance to a new checkpoint (snapshot must already
         be synced in the grid zone — write ordering is the caller's
         contract)."""
         h = self.working.copy()
         h["sequence"] = int(h["sequence"]) + 1
+        if epoch is not None:
+            h["epoch"] = epoch
+        if members is not None:
+            assert len(members) <= 64
+            h["member_count"] = len(members)
+            h["members"] = bytes(members).ljust(64, b"\x00")
         h["commit_min"] = commit_min
         h["commit_max"] = commit_max
         h["commit_min_checksum_lo"] = commit_min_checksum & 0xFFFFFFFFFFFFFFFF
