@@ -576,6 +576,14 @@ def main() -> None:
     parity_ok = True
     parity_detail = {}
 
+    # Durable config FIRST: it is disk/page-cache sensitive, and the
+    # five in-memory 1M replays would otherwise leave it competing
+    # with their residual heap + dirty pages.
+    configs_out["durable"] = run_durable(N_OTHER)
+    import gc
+
+    gc.collect()
+
     for name, gen in CONFIGS.items():
         n_events = N_SIMPLE if name == "simple" else N_OTHER
         setup, timed, sizing = gen(n_events)
@@ -611,8 +619,6 @@ def main() -> None:
             "device_resolved_pct": round(100.0 * dev / max(1, dev + exact), 1),
         }
         del sm, h
-
-    configs_out["durable"] = run_durable(N_OTHER)
 
     if PARITY:
         for name, gen in CONFIGS.items():

@@ -1,0 +1,162 @@
+// Batch encoders/decoders: cursors over little-endian byte buffers of
+// fixed 128-byte wire elements (tigerbeetle_tpu/types.py layouts;
+// reference: the generated com.tigerbeetle / TigerBeetle dotnet batch
+// classes, src/dotnet_bindings.zig).
+using System;
+using System.Buffers.Binary;
+
+namespace TigerBeetle;
+
+public abstract class Batch
+{
+    internal readonly byte[] Buffer;
+    private readonly int _elementSize;
+    private int _length;
+    private int _position = -1;
+
+    private protected Batch(int capacity, int elementSize)
+    {
+        Buffer = new byte[capacity * elementSize];
+        _elementSize = elementSize;
+    }
+
+    private protected Batch(byte[] wrapped, int elementSize)
+    {
+        Buffer = wrapped;
+        _elementSize = elementSize;
+        _length = wrapped.Length / elementSize;
+    }
+
+    public int Length => _length;
+    public int Capacity => Buffer.Length / _elementSize;
+    public int Position => _position;
+
+    /// Appends a zeroed element and moves the cursor to it.
+    public void Add()
+    {
+        if (_length >= Capacity)
+            throw new IndexOutOfRangeException("batch is full");
+        _position = _length++;
+        Buffer.AsSpan(_position * _elementSize, _elementSize).Clear();
+    }
+
+    /// Advances the cursor; false when past the last element.
+    public bool Next()
+    {
+        if (_position + 1 >= _length) return false;
+        _position++;
+        return true;
+    }
+
+    public void BeforeFirst() => _position = -1;
+
+    public void SetPosition(int index)
+    {
+        if (index < 0 || index >= _length)
+            throw new IndexOutOfRangeException($"position {index}");
+        _position = index;
+    }
+
+    private protected Span<byte> At(int offset)
+    {
+        if (_position < 0)
+            throw new InvalidOperationException("cursor before first element");
+        return Buffer.AsSpan(_position * _elementSize + offset);
+    }
+
+    private protected ulong GetU64(int o) =>
+        BinaryPrimitives.ReadUInt64LittleEndian(At(o));
+    private protected void SetU64(int o, ulong v) =>
+        BinaryPrimitives.WriteUInt64LittleEndian(At(o), v);
+    private protected uint GetU32(int o) =>
+        BinaryPrimitives.ReadUInt32LittleEndian(At(o));
+    private protected void SetU32(int o, uint v) =>
+        BinaryPrimitives.WriteUInt32LittleEndian(At(o), v);
+    private protected ushort GetU16(int o) =>
+        BinaryPrimitives.ReadUInt16LittleEndian(At(o));
+    private protected void SetU16(int o, ushort v) =>
+        BinaryPrimitives.WriteUInt16LittleEndian(At(o), v);
+
+    internal byte[] ToArray() => Buffer.AsSpan(0, _length * _elementSize).ToArray();
+}
+
+public sealed class AccountBatch : Batch
+{
+    internal const int ElementSize = 128;
+
+    public AccountBatch(int capacity) : base(capacity, ElementSize) { }
+    internal AccountBatch(byte[] wrapped) : base(wrapped, ElementSize) { }
+
+    public void SetId(ulong lo, ulong hi) { SetU64(0, lo); SetU64(8, hi); }
+    public ulong IdLo => GetU64(0);
+    public ulong IdHi => GetU64(8);
+    public ulong DebitsPendingLo => GetU64(16);
+    public ulong DebitsPostedLo => GetU64(32);
+    public ulong CreditsPendingLo => GetU64(48);
+    public ulong CreditsPostedLo => GetU64(64);
+    public void SetUserData128(ulong lo, ulong hi) { SetU64(80, lo); SetU64(88, hi); }
+    public ulong UserData64 { get => GetU64(96); set => SetU64(96, value); }
+    public uint UserData32 { get => GetU32(104); set => SetU32(104, value); }
+    public uint Ledger { get => GetU32(112); set => SetU32(112, value); }
+    public ushort Code { get => GetU16(116); set => SetU16(116, value); }
+    public AccountFlags Flags
+    {
+        get => (AccountFlags)GetU16(118);
+        set => SetU16(118, (ushort)value);
+    }
+    public ulong Timestamp => GetU64(120);
+}
+
+public sealed class TransferBatch : Batch
+{
+    internal const int ElementSize = 128;
+
+    public TransferBatch(int capacity) : base(capacity, ElementSize) { }
+    internal TransferBatch(byte[] wrapped) : base(wrapped, ElementSize) { }
+
+    public void SetId(ulong lo, ulong hi) { SetU64(0, lo); SetU64(8, hi); }
+    public ulong IdLo => GetU64(0);
+    public void SetDebitAccountId(ulong lo, ulong hi) { SetU64(16, lo); SetU64(24, hi); }
+    public void SetCreditAccountId(ulong lo, ulong hi) { SetU64(32, lo); SetU64(40, hi); }
+    public void SetAmount(ulong lo, ulong hi) { SetU64(48, lo); SetU64(56, hi); }
+    public ulong AmountLo => GetU64(48);
+    public void SetPendingId(ulong lo, ulong hi) { SetU64(64, lo); SetU64(72, hi); }
+    public ulong PendingIdLo => GetU64(64);
+    public void SetUserData128(ulong lo, ulong hi) { SetU64(80, lo); SetU64(88, hi); }
+    public ulong UserData64 { get => GetU64(96); set => SetU64(96, value); }
+    public uint UserData32 { get => GetU32(104); set => SetU32(104, value); }
+    public uint Timeout { get => GetU32(108); set => SetU32(108, value); }
+    public uint Ledger { get => GetU32(112); set => SetU32(112, value); }
+    public ushort Code { get => GetU16(116); set => SetU16(116, value); }
+    public TransferFlags Flags
+    {
+        get => (TransferFlags)GetU16(118);
+        set => SetU16(118, (ushort)value);
+    }
+    public ulong Timestamp => GetU64(120);
+}
+
+public sealed class IdBatch : Batch
+{
+    internal const int ElementSize = 16;
+
+    public IdBatch(int capacity) : base(capacity, ElementSize) { }
+
+    public void Add(ulong lo, ulong hi)
+    {
+        Add();
+        SetU64(0, lo);
+        SetU64(8, hi);
+    }
+}
+
+/// Failures only: an empty batch means every event succeeded.
+public sealed class CreateResultBatch : Batch
+{
+    internal const int ElementSize = 8;
+
+    internal CreateResultBatch(byte[] wrapped) : base(wrapped, ElementSize) { }
+
+    public uint Index => GetU32(0);
+    public uint Result => GetU32(4);
+}

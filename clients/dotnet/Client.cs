@@ -1,0 +1,153 @@
+// Blocking pure-C# client session for the tigerbeetle_tpu cluster —
+// the TCP counterpart of the reference's dotnet client
+// (src/clients/dotnet), minus P/Invoke: like the Go/TS/Java clients
+// here it speaks the checksummed wire protocol directly.  One
+// registered VSR session, one request in flight; retransmission under
+// the same request number is safe (server-side at-most-once dedupe).
+using System;
+using System.Buffers.Binary;
+using System.IO;
+using System.Net.Sockets;
+
+namespace TigerBeetle;
+
+public sealed class Client : IDisposable
+{
+    /// Most events per request (1 MiB message - 256 B header,
+    /// 128 B/event; reference: src/state_machine.zig:75-81).
+    public const int BatchMax = (Wire.MessageSizeMax - Wire.HeaderSize) / 128;
+
+    private const byte OpCreateAccounts = 128;
+    private const byte OpCreateTransfers = 129;
+    private const byte OpLookupAccounts = 130;
+    private const byte OpLookupTransfers = 131;
+
+    private readonly TcpClient _socket;
+    private readonly NetworkStream _stream;
+    private readonly ulong _cluster;
+    private readonly ulong _clientLo;
+    private readonly ulong _clientHi;
+    private uint _requestNumber;
+    private bool _registered;
+    private bool _evicted;
+    private byte[] _recv = new byte[1 << 16];
+    private int _recvLen;
+
+    public int TimeoutMillis { get; set; } = 30_000;
+    private const int RetransmitMillis = 1_000;
+
+    public Client(string host, int port, ulong cluster)
+        : this(host, port, cluster,
+               (ulong)Random.Shared.NextInt64() | 1UL, 0UL) { }
+
+    public Client(string host, int port, ulong cluster, ulong clientLo,
+                  ulong clientHi)
+    {
+        _socket = new TcpClient();
+        _socket.Connect(host, port);
+        _socket.NoDelay = true;
+        _stream = _socket.GetStream();
+        _cluster = cluster;
+        _clientLo = clientLo;
+        _clientHi = clientHi;
+    }
+
+    public void Dispose() => _socket.Dispose();
+
+    public CreateResultBatch CreateAccounts(AccountBatch batch) =>
+        new(Request(OpCreateAccounts, batch.ToArray()));
+
+    public CreateResultBatch CreateTransfers(TransferBatch batch) =>
+        new(Request(OpCreateTransfers, batch.ToArray()));
+
+    public AccountBatch LookupAccounts(IdBatch ids) =>
+        new(Request(OpLookupAccounts, ids.ToArray()));
+
+    public TransferBatch LookupTransfers(IdBatch ids) =>
+        new(Request(OpLookupTransfers, ids.ToArray()));
+
+    /// Raw request: registers on first use, returns the reply body.
+    public byte[] Request(byte operation, byte[] body)
+    {
+        lock (this)
+        {
+            if (!_registered)
+            {
+                Roundtrip(Wire.OpRegister, 0, Array.Empty<byte>());
+                _registered = true;
+            }
+            _requestNumber++;
+            return Roundtrip(operation, _requestNumber, body);
+        }
+    }
+
+    private byte[] Roundtrip(byte operation, uint requestNumber, byte[] body)
+    {
+        if (_evicted) throw new IOException("session evicted");
+        var msg = Wire.BuildRequest(
+            _cluster, _clientLo, _clientHi, requestNumber, operation, body);
+        long deadline = Environment.TickCount64 + TimeoutMillis;
+        while (true)
+        {
+            long now = Environment.TickCount64;
+            if (now > deadline)
+                throw new IOException($"request {requestNumber} timed out");
+            _socket.ReceiveTimeout =
+                (int)Math.Min(RetransmitMillis, deadline - now);
+            _stream.Write(msg);
+            while (true)
+            {
+                byte[] reply;
+                try
+                {
+                    reply = ReadMessage();
+                }
+                catch (IOException e) when (
+                    e.InnerException is SocketException se
+                    && se.SocketErrorCode == SocketError.TimedOut)
+                {
+                    break; // retransmit under the same request number
+                }
+                byte command = reply[Wire.OffCommand];
+                if (command == Wire.CmdEviction)
+                {
+                    _evicted = true;
+                    throw new IOException("session evicted");
+                }
+                if (command != Wire.CmdReply) continue;
+                uint got = BinaryPrimitives.ReadUInt32LittleEndian(
+                    reply.AsSpan(Wire.OffRequest));
+                if (got != requestNumber) continue; // stale duplicate
+                return reply[Wire.HeaderSize..];
+            }
+        }
+    }
+
+    private byte[] ReadMessage()
+    {
+        while (true)
+        {
+            if (_recvLen >= Wire.HeaderSize)
+            {
+                int size = (int)BinaryPrimitives.ReadUInt32LittleEndian(
+                    _recv.AsSpan(Wire.OffSize));
+                if (size < Wire.HeaderSize
+                    || size > Wire.MessageSizeMax + Wire.HeaderSize)
+                    throw new IOException($"bad frame size {size}");
+                if (_recvLen >= size)
+                {
+                    var msg = _recv.AsSpan(0, size).ToArray();
+                    _recv.AsSpan(size, _recvLen - size).CopyTo(_recv);
+                    _recvLen -= size;
+                    Wire.VerifyMessage(msg);
+                    return msg;
+                }
+            }
+            if (_recvLen == _recv.Length)
+                Array.Resize(ref _recv, _recv.Length * 2);
+            int n = _stream.Read(_recv, _recvLen, _recv.Length - _recvLen);
+            if (n <= 0) throw new IOException("connection closed");
+            _recvLen += n;
+        }
+    }
+}

@@ -1,0 +1,79 @@
+// Wire protocol: the 256-byte VSR message header and checksums.
+//
+// Layout mirrors tigerbeetle_tpu/vsr/wire.py HEADER_DTYPE (a
+// re-design of the reference's per-command header unions into one
+// flat little-endian layout — reference:
+// src/vsr/message_header.zig:17-103).  Checksums are SHA-256
+// truncated to 128 bits: `checksum` covers header bytes [16, 256),
+// `checksum_body` covers the body; both are verified before any
+// message is trusted.  Byte-identical to the Go/TS/Java clients
+// (clients/fixtures/frames.json).
+using System;
+using System.Buffers.Binary;
+using System.Security.Cryptography;
+
+namespace TigerBeetle;
+
+internal static class Wire
+{
+    public const int HeaderSize = 256;
+    public const int MessageSizeMax = 1 << 20;
+
+    public const int OffChecksum = 0;
+    public const int OffChecksumBody = 16;
+    public const int OffClient = 48;
+    public const int OffCluster = 64;
+    public const int OffRequest = 112;
+    public const int OffSize = 144;
+    public const int OffCommand = 153;
+    public const int OffOperation = 154;
+    public const int OffVersion = 155;
+
+    public const byte CmdRequest = 5;
+    public const byte CmdReply = 8;
+    public const byte CmdEviction = 18;
+
+    public const byte OpRegister = 2;
+
+    public const byte WireVersion = 1;
+
+    internal static byte[] Checksum128(ReadOnlySpan<byte> data)
+    {
+        Span<byte> sum = stackalloc byte[32];
+        SHA256.HashData(data, sum);
+        return sum[..16].ToArray();
+    }
+
+    /// Frames one request: header + body, checksums finalized.
+    internal static byte[] BuildRequest(
+        ulong cluster, ulong clientLo, ulong clientHi, uint requestNumber,
+        byte operation, ReadOnlySpan<byte> body)
+    {
+        var msg = new byte[HeaderSize + body.Length];
+        body.CopyTo(msg.AsSpan(HeaderSize));
+        var h = msg.AsSpan(0, HeaderSize);
+        BinaryPrimitives.WriteUInt64LittleEndian(h[OffClient..], clientLo);
+        BinaryPrimitives.WriteUInt64LittleEndian(h[(OffClient + 8)..], clientHi);
+        BinaryPrimitives.WriteUInt64LittleEndian(h[OffCluster..], cluster);
+        BinaryPrimitives.WriteUInt32LittleEndian(h[OffRequest..], requestNumber);
+        BinaryPrimitives.WriteUInt32LittleEndian(h[OffSize..], (uint)msg.Length);
+        h[OffCommand] = CmdRequest;
+        h[OffOperation] = operation;
+        h[OffVersion] = WireVersion;
+
+        Checksum128(msg.AsSpan(HeaderSize)).CopyTo(msg, OffChecksumBody);
+        Checksum128(msg.AsSpan(16, HeaderSize - 16)).CopyTo(msg, OffChecksum);
+        return msg;
+    }
+
+    /// Verifies both checksums of a framed message.
+    internal static void VerifyMessage(ReadOnlySpan<byte> msg)
+    {
+        var head = Checksum128(msg.Slice(16, HeaderSize - 16));
+        if (!msg.Slice(OffChecksum, 16).SequenceEqual(head))
+            throw new InvalidOperationException("header checksum mismatch");
+        var body = Checksum128(msg[HeaderSize..]);
+        if (!msg.Slice(OffChecksumBody, 16).SequenceEqual(body))
+            throw new InvalidOperationException("body checksum mismatch");
+    }
+}

@@ -1,0 +1,55 @@
+// End-to-end test against a live server (spawned by
+// tests/test_foreign_clients.py; TB_ADDRESS/TB_CLUSTER via env).
+// Prints "e2e ok" on success, throws on failure.
+using TigerBeetle;
+
+var addr = Environment.GetEnvironmentVariable("TB_ADDRESS")!.Split(':');
+var cluster = ulong.Parse(Environment.GetEnvironmentVariable("TB_CLUSTER")!);
+using var client = new Client(addr[0], int.Parse(addr[1]), cluster);
+
+var accounts = new AccountBatch(2);
+for (ulong id = 1; id <= 2; id++)
+{
+    accounts.Add();
+    accounts.SetId(id, 0);
+    accounts.Ledger = 1;
+    accounts.Code = 1;
+}
+if (client.CreateAccounts(accounts).Length != 0)
+    throw new Exception("create_accounts failed");
+
+var transfers = new TransferBatch(2);
+transfers.Add();                       // pending 40: 1 -> 2
+transfers.SetId(10, 0);
+transfers.SetDebitAccountId(1, 0);
+transfers.SetCreditAccountId(2, 0);
+transfers.SetAmount(40, 0);
+transfers.Ledger = 1;
+transfers.Code = 1;
+transfers.Flags = TransferFlags.Pending;
+transfers.Add();                       // post it, amount inherited
+transfers.SetId(11, 0);
+transfers.SetPendingId(10, 0);
+transfers.Flags = TransferFlags.PostPendingTransfer;
+if (client.CreateTransfers(transfers).Length != 0)
+    throw new Exception("create_transfers failed");
+
+var ids = new IdBatch(2);
+ids.Add(1, 0);
+ids.Add(2, 0);
+var got = client.LookupAccounts(ids);
+if (got.Length != 2) throw new Exception($"lookup count {got.Length}");
+got.Next();
+if (got.DebitsPostedLo != 40) throw new Exception("acct1 dpo");
+got.Next();
+if (got.CreditsPostedLo != 40) throw new Exception("acct2 cpo");
+
+var tid = new IdBatch(1);
+tid.Add(11, 0);
+var t = client.LookupTransfers(tid);
+if (t.Length != 1) throw new Exception("t11 missing");
+t.Next();
+if (t.AmountLo != 40 || t.PendingIdLo != 10)
+    throw new Exception("t11 fields");
+
+Console.WriteLine("e2e ok");

@@ -116,6 +116,10 @@ def test_generated_types_in_sync():
         assert fp.read() == bindings.emit_java(), (
             "clients/java Types.java stale"
         )
+    with open(os.path.join(CLIENTS, "dotnet", "Types.cs")) as fp:
+        assert fp.read() == bindings.emit_csharp(), (
+            "clients/dotnet/Types.cs stale"
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -300,3 +304,21 @@ def test_fixture_replay_end_to_end(server):
             if case["name"] == "lookup_accounts":
                 rows = np.frombuffer(body, types.ACCOUNT_DTYPE)
                 assert len(rows) == 1 and int(rows[0]["id_lo"]) == 9001
+
+
+def test_dotnet_client_end_to_end(server):
+    dotnet = shutil.which("dotnet")
+    if dotnet is None:
+        pytest.skip("no .NET toolchain")
+    env = dict(os.environ)
+    env["TB_ADDRESS"] = f"127.0.0.1:{server.port}"
+    env["TB_CLUSTER"] = str(CLUSTER)
+    proc = subprocess.run(
+        [dotnet, "run", "--project", "e2e"],
+        cwd=os.path.join(CLIENTS, "dotnet"),
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+    )
+    assert "e2e ok" in proc.stdout

@@ -130,3 +130,15 @@ def test_reconfigure_malformed_body_is_rejected_not_fatal():
         client, types.Operation.create_accounts, pack([account(1)])
     ) == b""
     assert all(r.epoch == 0 for r in c.replicas)
+
+
+def test_reconfigure_cannot_displace_primary():
+    """Swapping the committing primary's slot is rejected (code 3):
+    an accepted self-demotion would orphan the in-flight pipeline."""
+    c, client = make_cluster()
+    # View 0 primary is slot 0 (process 0); try to move it.
+    reply = c.run_request(
+        client, VsrOperation.reconfigure, reconfigure_body(1, [1, 0, 2, 3])
+    )
+    assert int.from_bytes(reply, "little") == 3
+    assert all(r.epoch == 0 for r in c.replicas if r.status == "normal")

@@ -62,12 +62,7 @@ class TcpBus:
     # -- VsrReplica interface --
 
     def send(self, dst_replica: int, header: np.ndarray, body: bytes) -> None:
-        process = (
-            self._slot_map[dst_replica]
-            if self._slot_map is not None and dst_replica < len(self._slot_map)
-            else dst_replica
-        )
-        conn = self.replica_conns.get(process)
+        conn = self.replica_conns.get(self._to_process(dst_replica))
         if conn is None:
             return  # not connected yet; protocol retransmits
         self.native.send(conn, header.tobytes() + body)
@@ -95,25 +90,36 @@ class TcpBus:
             self._pending_connects[conn] = j
             self._announce(conn, cluster, view)
 
+    # Transport-handshake marker: announce pings identify the sender
+    # by PROCESS index (the stable address-list position), while
+    # protocol pings carry the sender's SLOT — the request field
+    # disambiguates so registration never mixes the two spaces.
+    ANNOUNCE_REQUEST = 0xB0B0_B0B0
+
     def _announce(self, conn: int, cluster: int, view: int) -> None:
         h = wire.make_header(
             command=Command.ping, cluster=cluster, view=view,
-            replica=self.index,
+            replica=self.index, request=self.ANNOUNCE_REQUEST,
         )
         wire.finalize_header(h, b"")
         self.native.send(conn, h.tobytes())
 
-    def register_peer(self, conn: int, replica_index: int) -> None:
-        """`replica_index` is the sender's protocol SLOT (from its
-        message headers); connections are keyed by PROCESS, so the
-        slot map translates here too — otherwise a reconfigured peer's
-        pings would overwrite another process's connection entry."""
+    def _to_process(self, slot: int) -> int:
+        """Protocol SLOT -> process index (identity until reconfigured)."""
+        if self._slot_map is not None and slot < len(self._slot_map):
+            return self._slot_map[slot]
+        return slot
+
+    def register_peer(self, conn: int, replica_index: int,
+                      is_process: bool = False) -> None:
+        """Connections are keyed by PROCESS.  Announce handshakes carry
+        the process index directly (is_process); protocol messages
+        carry the sender's SLOT, translated through the slot map —
+        otherwise a reconfigured peer's pings would overwrite another
+        process's connection entry."""
         self._pending_connects.pop(conn, None)
-        process = (
-            self._slot_map[replica_index]
-            if self._slot_map is not None
-            and replica_index < len(self._slot_map)
-            else replica_index
+        process = replica_index if is_process else self._to_process(
+            replica_index
         )
         self.replica_conns[process] = conn
         self._conn_peer[conn] = ("replica", process)
@@ -212,7 +218,10 @@ class ReplicaServer:
             # connection.  Then forward into the replica — pings carry
             # clock-sync samples (vsr/clock.py) and the replica's pong
             # reply rides the now-registered connection.
-            self.bus.register_peer(conn, int(header["replica"]))
+            announce = int(header["request"]) == TcpBus.ANNOUNCE_REQUEST
+            self.bus.register_peer(
+                conn, int(header["replica"]), is_process=announce
+            )
             self.replica.on_message(header, body)
             return
         if cmd == Command.request:

@@ -330,8 +330,11 @@ class Replica:
         elif operation == int(VsrOperation.reconfigure):
             # Replicated membership change (reference:
             # src/vsr.zig:273-311): epoch bump + slot->process
-            # permutation; reply is a 4-byte result code.
-            reply = self._commit_reconfigure(body)
+            # permutation; reply is a 4-byte result code.  The
+            # prepare's view rides along so the primary-displacement
+            # check is deterministic across replicas (the header is
+            # replicated bit-exact; live view state is not).
+            reply = self._commit_reconfigure(body, int(header["view"]))
         elif operation == int(VsrOperation.upgrade):
             # Cluster-coordinated release switch (reference:
             # src/vsr/replica.zig:4298 replica_release_execute): the
@@ -418,18 +421,27 @@ class Replica:
             + bytes(members)
         )
 
-    def validate_reconfigure(self, epoch: int, members: list[int]) -> int:
-        """-> 0 ok; 1 stale/skipped epoch; 2 malformed membership."""
+    def validate_reconfigure(
+        self, epoch: int, members: list[int], view: int = 0
+    ) -> int:
+        """-> 0 ok; 1 stale/skipped epoch; 2 malformed membership;
+        3 would displace the primary that committed it (an accepted
+        self-demotion would orphan the in-flight pipeline — the slot
+        of `view`'s primary must keep its process)."""
         if epoch != self.epoch + 1:
             return 1
         if sorted(members) != list(range(self._member_total())):
             return 2
+        current = self.members or list(range(self._member_total()))
+        primary_slot = view % self.replica_count
+        if members[primary_slot] != current[primary_slot]:
+            return 3
         return 0
 
     def _member_total(self) -> int:
         return self.replica_count  # multi.py adds standbys
 
-    def _commit_reconfigure(self, body: bytes) -> bytes:
+    def _commit_reconfigure(self, body: bytes, view: int = 0) -> bytes:
         decoded = self.decode_reconfigure(body)
         if decoded is None:
             return (2).to_bytes(4, "little")
@@ -444,7 +456,7 @@ class Replica:
             # clients retry reconfigure against the session reply only
             # within one epoch.)
             return (0).to_bytes(4, "little")
-        code = self.validate_reconfigure(epoch, members)
+        code = self.validate_reconfigure(epoch, members, view)
         if code == 0:
             self.epoch = epoch
             self._reconfig_history[epoch] = list(members)
