@@ -17,10 +17,12 @@ public sealed class Client : IDisposable
     /// 128 B/event; reference: src/state_machine.zig:75-81).
     public const int BatchMax = (Wire.MessageSizeMax - Wire.HeaderSize) / 128;
 
-    private const byte OpCreateAccounts = 128;
-    private const byte OpCreateTransfers = 129;
-    private const byte OpLookupAccounts = 130;
-    private const byte OpLookupTransfers = 131;
+    // Operation codes from the generated enum (tigerbeetle_tpu/
+    // types.py Operation is the single source of truth).
+    private const byte OpCreateAccounts = (byte)Operation.CreateAccounts;
+    private const byte OpCreateTransfers = (byte)Operation.CreateTransfers;
+    private const byte OpLookupAccounts = (byte)Operation.LookupAccounts;
+    private const byte OpLookupTransfers = (byte)Operation.LookupTransfers;
 
     private readonly TcpClient _socket;
     private readonly NetworkStream _stream;
@@ -92,8 +94,9 @@ public sealed class Client : IDisposable
             long now = Environment.TickCount64;
             if (now > deadline)
                 throw new IOException($"request {requestNumber} timed out");
+            // Clamp >= 1: a 0 ReceiveTimeout means INFINITE in .NET.
             _socket.ReceiveTimeout =
-                (int)Math.Min(RetransmitMillis, deadline - now);
+                (int)Math.Max(1, Math.Min(RetransmitMillis, deadline - now));
             _stream.Write(msg);
             while (true)
             {

@@ -23,13 +23,16 @@ public final class Client implements AutoCloseable {
     public static final int BATCH_MAX =
         (Wire.MESSAGE_SIZE_MAX - Wire.HEADER_SIZE) / 128;
 
-    // Operation codes (tigerbeetle_tpu/types.py Operation).
-    static final int OP_CREATE_ACCOUNTS = 128;
-    static final int OP_CREATE_TRANSFERS = 129;
-    static final int OP_LOOKUP_ACCOUNTS = 130;
-    static final int OP_LOOKUP_TRANSFERS = 131;
-    static final int OP_GET_ACCOUNT_TRANSFERS = 132;
-    static final int OP_GET_ACCOUNT_BALANCES = 133;
+    // Operation codes from the generated enum (tigerbeetle_tpu/
+    // types.py Operation is the single source of truth).
+    static final int OP_CREATE_ACCOUNTS =
+        Types.Operation.CreateAccounts.value;
+    static final int OP_CREATE_TRANSFERS =
+        Types.Operation.CreateTransfers.value;
+    static final int OP_LOOKUP_ACCOUNTS =
+        Types.Operation.LookupAccounts.value;
+    static final int OP_LOOKUP_TRANSFERS =
+        Types.Operation.LookupTransfers.value;
 
     private final Socket socket;
     private final InputStream in;
@@ -123,8 +126,9 @@ public final class Client implements AutoCloseable {
             if (now > deadline) {
                 throw new IOException("request " + reqNumber + " timed out");
             }
+            // Clamp >= 1: a 0 soTimeout means INFINITE in Java.
             socket.setSoTimeout(
-                (int) Math.min(RETRANSMIT_MILLIS, deadline - now));
+                (int) Math.max(1, Math.min(RETRANSMIT_MILLIS, deadline - now)));
             out.write(msg);
             out.flush();
             while (true) {

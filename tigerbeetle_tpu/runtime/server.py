@@ -96,9 +96,11 @@ class TcpBus:
     # disambiguates so registration never mixes the two spaces.
     ANNOUNCE_REQUEST = 0xB0B0_B0B0
 
-    def _announce(self, conn: int, cluster: int, view: int) -> None:
+    def _announce(self, conn: int, cluster: int, view: int,
+                  pong: bool = False) -> None:
         h = wire.make_header(
-            command=Command.ping, cluster=cluster, view=view,
+            command=Command.pong if pong else Command.ping,
+            cluster=cluster, view=view,
             replica=self.index, request=self.ANNOUNCE_REQUEST,
         )
         wire.finalize_header(h, b"")
@@ -214,14 +216,25 @@ class ReplicaServer:
             return
         cmd = int(header["command"])
         if cmd in (Command.ping, Command.pong):
-            # Transport handshake: any ping/pong identifies the peer
-            # connection.  Then forward into the replica — pings carry
-            # clock-sync samples (vsr/clock.py) and the replica's pong
-            # reply rides the now-registered connection.
             announce = int(header["request"]) == TcpBus.ANNOUNCE_REQUEST
             self.bus.register_peer(
                 conn, int(header["replica"]), is_process=announce
             )
+            if announce:
+                # Transport-only handshake: the replica field is a
+                # PROCESS index, which the protocol layer would misread
+                # as a slot (polluting slot-keyed release/clock maps) —
+                # answer with a reciprocal announce so the connector
+                # registers this side too, and stop here.
+                if cmd == int(Command.ping):
+                    # Pong-flavored so the reciprocal doesn't echo.
+                    self.bus._announce(
+                        conn, self.replica.cluster, self.replica.view,
+                        pong=True,
+                    )
+                return
+            # Protocol ping/pong: carries clock-sync samples
+            # (vsr/clock.py); the reply rides the registered conn.
             self.replica.on_message(header, body)
             return
         if cmd == Command.request:
