@@ -576,13 +576,17 @@ def main() -> None:
     parity_ok = True
     parity_detail = {}
 
-    # Durable config FIRST: it is disk/page-cache sensitive, and the
-    # five in-memory 1M replays would otherwise leave it competing
-    # with their residual heap + dirty pages.
-    configs_out["durable"] = run_durable(N_OTHER)
-    import gc
+    # Durable config in a FRESH subprocess: it is disk/page-cache
+    # sensitive and the in-memory 1M replays are heap-sensitive —
+    # sharing a process squeezes whichever runs second.
+    import subprocess
 
-    gc.collect()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--durable-only"],
+        capture_output=True, text=True, timeout=3600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    configs_out["durable"] = json.loads(proc.stdout.strip().splitlines()[-1])
 
     for name, gen in CONFIGS.items():
         n_events = N_SIMPLE if name == "simple" else N_OTHER
@@ -676,4 +680,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--durable-only" in sys.argv:
+        print(json.dumps(run_durable(N_OTHER)))
+    else:
+        main()
