@@ -1,0 +1,99 @@
+"""Probe 9: true d2h cost of COMPUTED arrays by size; ring-buffer
+result collection pattern."""
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+A = 4096
+B = 8190
+rng = np.random.default_rng(0)
+
+
+# --- true d2h: compute fresh data on device, block, then fetch
+@jax.jit
+def gen(x, salt):
+    return x * salt + jnp.uint64(1)
+
+
+for size in (4 << 10, 64 << 10, 512 << 10, 4 << 20):
+    n = size // 8
+    x = jax.block_until_ready(jnp.arange(n, dtype=jnp.uint64))
+    outs = []
+    for s in range(6):
+        y = jax.block_until_ready(gen(x, jnp.uint64(s + 1)))
+        t0 = time.perf_counter()
+        np.asarray(y)
+        outs.append(time.perf_counter() - t0)
+    ms = np.median(outs) * 1e3
+    print(f"d2h computed {size>>10:5d}KB: {ms:8.2f} ms "
+          f"({size/1e6/(ms/1e3):6.1f} MB/s)")
+
+
+# --- ring-buffer collection: kernel appends results to (K,B) device
+# buffer; single fetch every K batches.
+@jax.jit
+def chain_ring(table, ring, k, x):
+    s = x.sum(axis=0)
+    table = table + s[None, :2]
+    res = x[:, 0].astype(jnp.uint32)
+    ring = jax.lax.dynamic_update_slice(ring, res[None, :], (k, 0))
+    return table, ring
+
+
+def fresh():
+    return rng.integers(0, 1 << 20, (B, 6)).astype(np.uint64)
+
+
+for K in (8, 16, 32):
+    table = jnp.zeros((A, 2), jnp.uint64)
+    ring = jnp.zeros((K, B), jnp.uint32)
+    jax.block_until_ready(chain_ring(table, ring, 0, jnp.asarray(fresh())))
+    table = jnp.zeros((A, 2), jnp.uint64)
+    ring = jnp.zeros((K, B), jnp.uint32)
+    N = 96
+    t0 = time.perf_counter()
+    k = 0
+    for i in range(N):
+        table, ring = chain_ring_call = chain_ring(
+            table, ring, k, jnp.asarray(fresh())
+        )
+        k += 1
+        if k == K:
+            np.asarray(ring)  # one fetch for K batches
+            k = 0
+    if k:
+        np.asarray(ring)
+    ms = (time.perf_counter() - t0) / N * 1e3
+    print(f"ring K={K:3d}: {ms:7.2f} ms/batch -> {B/(ms/1e3):,.0f} ev/s")
+
+# --- ring + async: fetch ring K/2 batches after rotation via second buffer
+for K in (16, 32):
+    table = jnp.zeros((A, 2), jnp.uint64)
+    ring = jnp.zeros((K, B), jnp.uint32)
+    jax.block_until_ready(chain_ring(table, ring, 0, jnp.asarray(fresh())))
+    table = jnp.zeros((A, 2), jnp.uint64)
+    ring = jnp.zeros((K, B), jnp.uint32)
+    N = 96
+    t0 = time.perf_counter()
+    k = 0
+    pending_ring = None
+    for i in range(N):
+        table, ring = chain_ring(table, ring, k, jnp.asarray(fresh()))
+        k += 1
+        if k == K:
+            if pending_ring is not None:
+                np.asarray(pending_ring)  # fetch PREVIOUS full ring
+            pending_ring = ring
+            pending_ring.copy_to_host_async()
+            ring = jnp.zeros((K, B), jnp.uint32)
+            k = 0
+    if pending_ring is not None:
+        np.asarray(pending_ring)
+    np.asarray(ring)
+    ms = (time.perf_counter() - t0) / N * 1e3
+    print(f"ring-async K={K:3d}: {ms:7.2f} ms/batch -> {B/(ms/1e3):,.0f} ev/s")

@@ -1,0 +1,122 @@
+"""Probe 6: why do h2d transfers slow to ~25-50ms inside a dispatch
+loop?  Isolate: transfer-only loops, stream business, donation."""
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+A = 4096
+B = 8190
+dev = jax.devices()[0]
+rng = np.random.default_rng(0)
+
+
+def fresh():
+    return rng.integers(0, 1 << 60, (B, 6)).astype(np.uint64)
+
+
+# A. h2d-only loop, fresh data, block only at end
+for n in (30,):
+    arrs = []
+    t0 = time.perf_counter()
+    for _ in range(n):
+        arrs.append(jnp.asarray(fresh()))
+    jax.block_until_ready(arrs)
+    ms = (time.perf_counter() - t0) / n * 1e3
+    print(f"A h2d-only fresh 400KB: {ms:6.2f} ms each")
+
+# A2. h2d-only, block each
+t0 = time.perf_counter()
+for _ in range(30):
+    jax.block_until_ready(jnp.asarray(fresh()))
+ms = (time.perf_counter() - t0) / 30 * 1e3
+print(f"A2 h2d-only blocked each: {ms:6.2f} ms each")
+
+
+# B. h2d + trivial kernel on the same fresh data (no donation)
+@jax.jit
+def red(x):
+    return x.sum(axis=0)
+
+
+jax.block_until_ready(red(jnp.asarray(fresh())))
+outs = []
+t0 = time.perf_counter()
+for _ in range(30):
+    outs.append(red(jnp.asarray(fresh())))
+jax.block_until_ready(outs)
+ms = (time.perf_counter() - t0) / 30 * 1e3
+print(f"B h2d + reduce (no donation): {ms:6.2f} ms each")
+
+# C. h2d + chained donated-table kernel (like production), block each
+@jax.jit
+def chaink(table, x):
+    return table + x.sum(axis=0)[None, :2], x[:, 0]
+
+
+chainkd = jax.jit(chaink, donate_argnums=(0,))
+table = jnp.zeros((A, 2), jnp.uint64)
+table, r = chainkd(table, jnp.asarray(fresh()))
+jax.block_until_ready(r)
+t0 = time.perf_counter()
+for _ in range(30):
+    table, r = chainkd(table, jnp.asarray(fresh()))
+    np.asarray(r)
+ms = (time.perf_counter() - t0) / 30 * 1e3
+print(f"C h2d + donated chain, sync each: {ms:6.2f} ms each")
+
+# D. h2d + donated chain, never fetch (block end)
+table = jnp.zeros((A, 2), jnp.uint64)
+rs = []
+t0 = time.perf_counter()
+for _ in range(30):
+    table, r = chainkd(table, jnp.asarray(fresh()))
+    rs.append(r)
+jax.block_until_ready(rs)
+ms = (time.perf_counter() - t0) / 30 * 1e3
+print(f"D h2d + donated chain, block end: {ms:6.2f} ms each")
+
+# E. same as D but reuse ONE device-resident input (no h2d)
+x0 = jax.block_until_ready(jnp.asarray(fresh()))
+table = jnp.zeros((A, 2), jnp.uint64)
+rs = []
+t0 = time.perf_counter()
+for _ in range(30):
+    table, r = chainkd(table, x0)
+    rs.append(r)
+jax.block_until_ready(rs)
+ms = (time.perf_counter() - t0) / 30 * 1e3
+print(f"E no-h2d donated chain, block end: {ms:6.2f} ms each")
+
+# F. D with a host sleep per iter (is h2d fine when stream drains?)
+table = jnp.zeros((A, 2), jnp.uint64)
+rs = []
+t0 = time.perf_counter()
+for _ in range(30):
+    table, r = chainkd(table, jnp.asarray(fresh()))
+    rs.append(r)
+    time.sleep(0.02)
+jax.block_until_ready(rs)
+ms = (time.perf_counter() - t0) / 30 * 1e3 - 20
+print(f"F h2d + donated chain + 20ms sleep: {ms:6.2f} ms each (sleep excluded)")
+
+# G. smaller h2d payloads in the loop
+@jax.jit
+def redsm(x):
+    return x.sum()
+
+
+jax.block_until_ready(redsm(jnp.asarray(np.zeros(1024, np.uint64))))
+for size in (1024, 16384, B * 6):
+    outs = []
+    data = [rng.integers(0, 1 << 60, size).astype(np.uint64) for _ in range(30)]
+    t0 = time.perf_counter()
+    for d in data:
+        outs.append(redsm(jnp.asarray(d)))
+    jax.block_until_ready(outs)
+    ms = (time.perf_counter() - t0) / 30 * 1e3
+    print(f"G h2d {size*8>>10:5d}KB + tiny reduce: {ms:6.2f} ms each")

@@ -1,0 +1,261 @@
+"""Probe 3: sustained async dispatch rate, kernel-variant compute cost,
+and latency hiding via copy_to_host_async + host-side delay."""
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+A = 4096
+B = 8190
+MASK32 = jnp.uint64(0xFFFFFFFF)
+dev = jax.devices()[0]
+
+
+@jax.jit
+def trivial(t):
+    return t + jnp.uint64(1)
+
+
+def ladder_only(table, dr_slot, cr_slot, amt_lo, amt_hi, flags, ledger,
+                acct_ledger):
+    drc = jnp.clip(dr_slot, 0, A - 1)
+    crc = jnp.clip(cr_slot, 0, A - 1)
+    dr_ledger = acct_ledger[drc]
+    cr_ledger = acct_ledger[crc]
+    r = jnp.zeros(B, jnp.uint32)
+
+    def app(r, cond, c):
+        return jnp.where((r == 0) & cond, jnp.uint32(c), r)
+
+    r = app(r, dr_slot < 0, 42)
+    r = app(r, cr_slot < 0, 43)
+    r = app(r, dr_slot == cr_slot, 12)
+    r = app(r, (amt_lo == 0) & (amt_hi == 0), 20)
+    r = app(r, ledger == 0, 21)
+    r = app(r, dr_ledger != cr_ledger, 30)
+    r = app(r, ledger != dr_ledger, 31)
+    return r
+
+
+def scatter8(table, dr_slot, cr_slot, amt_lo, amt_hi, flags, ledger,
+             acct_ledger):
+    r = ladder_only(table, dr_slot, cr_slot, amt_lo, amt_hi, flags, ledger,
+                    acct_ledger)
+    ok = r == 0
+    is_pending = (flags & 2) != 0
+    drc = jnp.clip(dr_slot, 0, A - 1)
+    crc = jnp.clip(cr_slot, 0, A - 1)
+    zero = jnp.uint64(0)
+    l0 = jnp.where(ok, amt_lo & MASK32, zero)
+    l1 = jnp.where(ok, amt_lo >> jnp.uint64(32), zero)
+    dcol = jnp.where(is_pending, 0, 1)
+    ccol = jnp.where(is_pending, 2, 3)
+    acc = jnp.zeros((A, 4, 2), jnp.uint64)
+    acc = acc.at[drc, dcol, 0].add(l0, mode="drop")
+    acc = acc.at[drc, dcol, 1].add(l1, mode="drop")
+    acc = acc.at[crc, ccol, 0].add(l0, mode="drop")
+    acc = acc.at[crc, ccol, 1].add(l1, mode="drop")
+    c0 = acc[:, :, 0]
+    c1 = acc[:, :, 1] + (c0 >> jnp.uint64(32))
+    d_lo = (c0 & MASK32) | ((c1 & MASK32) << jnp.uint64(32))
+    old_lo = table[:, 0::2]
+    new_lo = old_lo + d_lo
+    ov = (new_lo < old_lo).any()
+    table = jnp.where(ov, table, table.at[:, 0::2].set(new_lo))
+    return table, jnp.where(ov, jnp.uint32(0xFFFF), r)
+
+
+def scatter_vec(table, dr_slot, cr_slot, amt_lo, amt_hi, flags, ledger,
+                acct_ledger):
+    """One scatter with vector payload (2B, 4) limbs."""
+    r = ladder_only(table, dr_slot, cr_slot, amt_lo, amt_hi, flags, ledger,
+                    acct_ledger)
+    ok = r == 0
+    is_pending = (flags & 2) != 0
+    drc = jnp.clip(dr_slot, 0, A - 1)
+    crc = jnp.clip(cr_slot, 0, A - 1)
+    zero = jnp.uint64(0)
+    limbs = jnp.stack(
+        [
+            jnp.where(ok, amt_lo & MASK32, zero),
+            jnp.where(ok, amt_lo >> jnp.uint64(32), zero),
+            jnp.where(ok, amt_hi & MASK32, zero),
+            jnp.where(ok, amt_hi >> jnp.uint64(32), zero),
+        ],
+        axis=-1,
+    )
+    dcol = jnp.where(is_pending, 0, 1)
+    ccol = jnp.where(is_pending, 2, 3)
+    idx = jnp.concatenate([drc * 4 + dcol, crc * 4 + ccol])
+    payload = jnp.concatenate([limbs, limbs])
+    acc = jnp.zeros((A * 4, 4), jnp.uint64).at[idx].add(payload)
+    c0 = acc[:, 0]
+    c1 = acc[:, 1] + (c0 >> jnp.uint64(32))
+    c2 = acc[:, 2] + (c1 >> jnp.uint64(32))
+    c3 = acc[:, 3] + (c2 >> jnp.uint64(32))
+    d_lo = ((c0 & MASK32) | ((c1 & MASK32) << jnp.uint64(32))).reshape(A, 4)
+    d_hi = ((c2 & MASK32) | ((c3 & MASK32) << jnp.uint64(32))).reshape(A, 4)
+    old_lo = table[:, 0::2]
+    old_hi = table[:, 1::2]
+    new_lo = old_lo + d_lo
+    carry = (new_lo < old_lo).astype(jnp.uint64)
+    new_hi = old_hi + d_hi + carry
+    ov = ((new_hi < old_hi).any()) | ((c3 >> jnp.uint64(32)) != 0).any()
+    nt = jnp.stack(
+        [new_lo[:, 0], new_hi[:, 0], new_lo[:, 1], new_hi[:, 1],
+         new_lo[:, 2], new_hi[:, 2], new_lo[:, 3], new_hi[:, 3]], axis=-1)
+    table = jnp.where(ov, table, nt)
+    return table, jnp.where(ov, jnp.uint32(0xFFFF), r)
+
+
+def sortseg(table, dr_slot, cr_slot, amt_lo, amt_hi, flags, ledger,
+            acct_ledger):
+    """Sort by (slot,col) key + segmented cumsum + unique scatter."""
+    r = ladder_only(table, dr_slot, cr_slot, amt_lo, amt_hi, flags, ledger,
+                    acct_ledger)
+    ok = r == 0
+    is_pending = (flags & 2) != 0
+    drc = jnp.clip(dr_slot, 0, A - 1)
+    crc = jnp.clip(cr_slot, 0, A - 1)
+    zero = jnp.uint64(0)
+    dcol = jnp.where(is_pending, 0, 1)
+    ccol = jnp.where(is_pending, 2, 3)
+    idx = jnp.concatenate([drc * 4 + dcol, crc * 4 + ccol]).astype(jnp.int32)
+    l0 = jnp.where(ok, amt_lo & MASK32, zero)
+    l1 = jnp.where(ok, amt_lo >> jnp.uint64(32), zero)
+    l2 = jnp.where(ok, amt_hi & MASK32, zero)
+    l3 = jnp.where(ok, amt_hi >> jnp.uint64(32), zero)
+    key, p0, p1, p2, p3 = jax.lax.sort(
+        [idx, jnp.concatenate([l0, l0]), jnp.concatenate([l1, l1]),
+         jnp.concatenate([l2, l2]), jnp.concatenate([l3, l3])],
+        num_keys=1,
+    )
+    m = key.shape[0]
+    seg_end = jnp.concatenate(
+        [key[1:] != key[:-1], jnp.ones(1, bool)]
+    )
+    out = []
+    for p in (p0, p1, p2, p3):
+        cs = jnp.cumsum(p)
+        out.append(cs)
+    # segment totals at segment ends: total = cs[end] - cs[prev_end]
+    ends = jnp.where(seg_end, jnp.arange(m), -1)
+    # scatter unique: use key at ends
+    acc = jnp.zeros((A * 4, 4), jnp.uint64)
+    prev = [jnp.where(seg_end, c, 0) for c in out]
+    # exclusive totals per segment: cs at end minus cs at previous seg end
+    # previous seg end cumsum: use segment-start gather
+    seg_start = jnp.concatenate([jnp.ones(1, bool), key[1:] != key[:-1]])
+    start_idx = jnp.where(seg_start, jnp.arange(m), 0)
+    start_idx = jax.lax.associative_scan(jnp.maximum, start_idx)
+    sums = [
+        c - jnp.take(c, start_idx) + p
+        for c, p in zip(out, (p0, p1, p2, p3))
+    ]
+    for k, s in enumerate(sums):
+        acc = acc.at[key, k].set(
+            jnp.where(seg_end, s, acc[key, k]), mode="drop",
+            unique_indices=False,
+        )
+    c0, c1, c2, c3 = acc[:, 0], acc[:, 1], acc[:, 2], acc[:, 3]
+    c1 = c1 + (c0 >> jnp.uint64(32))
+    c2 = c2 + (c1 >> jnp.uint64(32))
+    c3 = c3 + (c2 >> jnp.uint64(32))
+    d_lo = ((c0 & MASK32) | ((c1 & MASK32) << jnp.uint64(32))).reshape(A, 4)
+    d_hi = ((c2 & MASK32) | ((c3 & MASK32) << jnp.uint64(32))).reshape(A, 4)
+    old_lo = table[:, 0::2]
+    old_hi = table[:, 1::2]
+    new_lo = old_lo + d_lo
+    carry = (new_lo < old_lo).astype(jnp.uint64)
+    new_hi = old_hi + d_hi + carry
+    ov = (new_hi < old_hi).any()
+    nt = jnp.stack(
+        [new_lo[:, 0], new_hi[:, 0], new_lo[:, 1], new_hi[:, 1],
+         new_lo[:, 2], new_hi[:, 2], new_lo[:, 3], new_hi[:, 3]], axis=-1)
+    table = jnp.where(ov, table, nt)
+    return table, jnp.where(ov, jnp.uint32(0xFFFF), r)
+
+
+rng = np.random.default_rng(0)
+dr = rng.integers(0, 1000, B).astype(np.int32)
+inputs = dict(
+    dr_slot=jnp.asarray(dr),
+    cr_slot=jnp.asarray(((dr + 1) % 1000).astype(np.int32)),
+    amt_lo=jnp.asarray(rng.integers(1, 100, B, np.uint64)),
+    amt_hi=jnp.zeros(B, jnp.uint64),
+    flags=jnp.zeros(B, jnp.uint32),
+    ledger=jnp.ones(B, jnp.uint32),
+)
+acct_ledger = jnp.ones(A, jnp.uint32)
+
+
+def sustained(fn, name, n=100):
+    table = jnp.zeros((A, 8), jnp.uint64)
+    jf = jax.jit(fn, donate_argnums=(0,))
+    table, res = jf(table, acct_ledger=acct_ledger, **inputs)
+    jax.block_until_ready(res)
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(n):
+        table, last = jf(table, acct_ledger=acct_ledger, **inputs)
+    jax.block_until_ready(last)
+    ms = (time.perf_counter() - t0) / n * 1e3
+    print(f"{name:12s}: {ms:6.2f} ms/batch -> {B/(ms/1e3):,.0f} ev/s")
+    return ms
+
+
+# trivial dispatch rate
+t = jnp.zeros((A, 8), jnp.uint64)
+jax.block_until_ready(trivial(t))
+t0 = time.perf_counter()
+for _ in range(200):
+    t = trivial(t)
+jax.block_until_ready(t)
+ms = (time.perf_counter() - t0) / 200 * 1e3
+print(f"trivial      : {ms:6.2f} ms/dispatch")
+
+sustained(scatter8, "scatter8(lo)")
+sustained(scatter_vec, "scatter_vec")
+sustained(sortseg, "sortseg")
+
+# --- latency hiding: dispatch, host work X ms, then fetch
+jf = jax.jit(scatter_vec, donate_argnums=(0,))
+table = jnp.zeros((A, 8), jnp.uint64)
+table, res = jf(table, acct_ledger=acct_ledger, **inputs)
+jax.block_until_ready(res)
+for delay in (0.0, 0.05, 0.15, 0.3):
+    fetches = []
+    for _ in range(5):
+        table, res = jf(table, acct_ledger=acct_ledger, **inputs)
+        res.copy_to_host_async()
+        time.sleep(delay)
+        f0 = time.perf_counter()
+        np.asarray(res)
+        fetches.append(time.perf_counter() - f0)
+    print(f"fetch after {delay*1e3:5.0f} ms host delay: "
+          f"{np.median(fetches)*1e3:7.2f} ms")
+
+# --- deep pipeline with deferred fetches (drain every K batches)
+for K in (8, 32, 64):
+    table = jnp.zeros((A, 8), jnp.uint64)
+    pend = []
+    n = 128
+    t0 = time.perf_counter()
+    for i in range(n):
+        table, res = jf(table, acct_ledger=acct_ledger, **inputs)
+        res.copy_to_host_async()
+        pend.append(res)
+        if len(pend) >= K:
+            for r_ in pend:
+                np.asarray(r_)
+            pend.clear()
+    for r_ in pend:
+        np.asarray(r_)
+    ms = (time.perf_counter() - t0) / n * 1e3
+    print(f"deferred drain K={K:3d}: {ms:6.2f} ms/batch -> "
+          f"{B/(ms/1e3):,.0f} ev/s")

@@ -1,0 +1,92 @@
+"""Probe 8: find the fast dispatch pattern for chained-state kernels
+with per-batch h2d."""
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+A = 4096
+B = 8190
+rng = np.random.default_rng(0)
+
+
+@jax.jit
+def chaink(table, x):
+    s = x.sum(axis=0)
+    return table + s[None, :2], x[:, 0]
+
+
+def fresh():
+    return rng.integers(0, 1 << 20, (B, 6)).astype(np.uint64)
+
+
+table0 = jnp.zeros((A, 2), jnp.uint64)
+jax.block_until_ready(chaink(table0, jnp.asarray(fresh())))
+
+N = 60
+
+# V1: chain + h2d, no fetch, block end
+table = table0
+rs = []
+t0 = time.perf_counter()
+for _ in range(N):
+    table, r = chaink(table, jnp.asarray(fresh()))
+    rs.append(r)
+jax.block_until_ready(rs)
+print(f"V1 chain+h2d no-fetch: {(time.perf_counter()-t0)/N*1e3:7.2f} ms")
+
+# V3: block each h2d BEFORE dispatch
+table = table0
+rs = []
+t0 = time.perf_counter()
+for _ in range(N):
+    pk = jnp.asarray(fresh())
+    pk.block_until_ready()
+    table, r = chaink(table, pk)
+    rs.append(r)
+jax.block_until_ready(rs)
+print(f"V3 blocked-h2d chain:  {(time.perf_counter()-t0)/N*1e3:7.2f} ms")
+
+# V4: double-buffered h2d (issue k+1, block k, dispatch k)
+table = table0
+rs = []
+nxt = jnp.asarray(fresh())
+t0 = time.perf_counter()
+for _ in range(N):
+    cur = nxt
+    nxt = jnp.asarray(fresh())
+    cur.block_until_ready()
+    table, r = chaink(table, cur)
+    rs.append(r)
+jax.block_until_ready(rs)
+print(f"V4 double-buffer h2d:  {(time.perf_counter()-t0)/N*1e3:7.2f} ms")
+
+# V5: V3 + rolling fetch W=8
+table = table0
+pend = []
+t0 = time.perf_counter()
+for _ in range(N):
+    pk = jnp.asarray(fresh())
+    pk.block_until_ready()
+    table, r = chaink(table, pk)
+    r.copy_to_host_async()
+    pend.append(r)
+    if len(pend) > 8:
+        np.asarray(pend.pop(0))
+for r_ in pend:
+    np.asarray(r_)
+print(f"V5 blocked-h2d W=8:    {(time.perf_counter()-t0)/N*1e3:7.2f} ms")
+
+# V6: V3 + sync fetch each (depth 1!)
+table = table0
+t0 = time.perf_counter()
+for _ in range(N):
+    pk = jnp.asarray(fresh())
+    pk.block_until_ready()
+    table, r = chaink(table, pk)
+    np.asarray(r)
+print(f"V6 blocked-h2d sync:   {(time.perf_counter()-t0)/N*1e3:7.2f} ms")
