@@ -13,6 +13,10 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
+# Shrink the device-kernel event bucket: the semantic kernels' one-hot
+# matmuls at the production bucket (8192) are far too slow on the CPU
+# backend.  Production size is exercised by the tpu-marked tests.
+os.environ.setdefault("TB_DEV_B", "512")
 
 import jax
 

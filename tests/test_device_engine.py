@@ -1,0 +1,474 @@
+"""Device-authoritative engine: differential + hazard + fallback tests.
+
+The engine (state_machine/device_engine.py) computes create_transfers
+result codes ON the device via the semantic kernels and materializes
+replies from failure-sparse summaries.  These tests pin it to the CPU
+oracle across the bench workload shapes and adversarial cases:
+cross-batch hazards, fallback recovery, pulse interaction, and the
+checkpoint checksum tripwire.
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
+from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+from tigerbeetle_tpu.testing import harness as hz
+from tigerbeetle_tpu.types import (
+    AccountFlags,
+    CreateTransferResult,
+    Operation,
+    TransferFlags,
+)
+
+AF = AccountFlags
+TF = TransferFlags
+CTR = CreateTransferResult
+
+
+def mk_pair():
+    sm_d = TpuStateMachine(engine="device", account_capacity=1 << 12)
+    sm_c = CpuStateMachine()
+    return hz.SingleNodeHarness(sm_d), hz.SingleNodeHarness(sm_c)
+
+
+def replay_both(h_d, h_c, ops):
+    futs = [h_d.submit_async(op, body) for op, body in ops]
+    replies_d = [f.result() for f in futs]
+    replies_c = [h_c.submit(op, body) for op, body in ops]
+    for i, (a, b) in enumerate(zip(replies_d, replies_c)):
+        assert a == b, f"reply {i} differs: {ops[i][0]!r}"
+    return replies_d
+
+
+def accounts(ids, flags=0, ledger=1):
+    return hz.pack([hz.account(i, flags=flags, ledger=ledger) for i in ids])
+
+
+def transfers(rows):
+    return hz.pack([hz.transfer(**r) for r in rows])
+
+
+def test_bench_config_differential():
+    """Scaled-down versions of every bench config, multi-fetch."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ["BENCH_BATCH"] = "400"
+    import importlib
+
+    import bench
+
+    importlib.reload(bench)
+    for name, gen in bench.CONFIGS.items():
+        setup, timed, sizing = gen(4000)
+        ops = setup + timed
+        sm_d = TpuStateMachine(
+            account_capacity=sizing[0], transfer_capacity=sizing[1],
+            engine="device",
+        )
+        h_d = hz.SingleNodeHarness(sm_d)
+        futs = [h_d.submit_async(op, body) for op, body in ops]
+        replies_d = [f.result() for f in futs]
+        sm_c = CpuStateMachine()
+        h_c = hz.SingleNodeHarness(sm_c)
+        for i, (op, body) in enumerate(ops):
+            assert replies_d[i] == h_c.submit(op, body), f"{name} op {i}"
+        acct_ids = bench.config_account_ids(name)
+        tids = np.arange(bench.TID0, bench.TID0 + 2000).astype(np.uint64)
+        assert bench.state_digest(h_d, acct_ids, tids) == bench.state_digest(
+            h_c, acct_ids, tids
+        ), name
+        assert sm_d._dev.stat_semantic_events > 0, name
+    os.environ.pop("BENCH_BATCH", None)
+    importlib.reload(bench)
+
+
+def test_cross_batch_pending_reference_hazard():
+    """A post in batch k+1 referencing a pending created in batch k
+    (still in flight) must drain and resolve exactly."""
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts([1, 2]))]
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [
+                    dict(id=10, debit_account_id=1, credit_account_id=2,
+                         amount=50, flags=int(TF.pending)),
+                ]
+            ),
+        )
+    )
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [
+                    dict(id=11, pending_id=10,
+                         flags=int(TF.post_pending_transfer)),
+                    dict(id=12, pending_id=10,
+                         flags=int(TF.post_pending_transfer)),
+                ]
+            ),
+        )
+    )
+    ops.append((Operation.lookup_accounts, hz.ids_bytes([1, 2])))
+    replay_both(h_d, h_c, ops)
+
+
+def test_cross_batch_duplicate_id_hazard():
+    """A duplicate id against an in-flight batch must not be treated
+    as fresh."""
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts([1, 2]))]
+    t = dict(id=10, debit_account_id=1, credit_account_id=2, amount=5)
+    ops.append((Operation.create_transfers, transfers([t])))
+    ops.append((Operation.create_transfers, transfers([t])))  # exact dup
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [dict(id=10, debit_account_id=1, credit_account_id=2,
+                      amount=6)]
+            ),
+        )
+    )
+    replay_both(h_d, h_c, ops)
+
+
+def test_fallback_overflow_orderfree():
+    """Amounts near 2^128 trip the admission check -> exact host
+    fallback, still bit-identical to the oracle."""
+    big = (1 << 127) + 5
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts([1, 2, 3]))]
+    # Two debits of ~2^127 on the same account: the second overflows
+    # debits_posted, so total-sum admission must refuse the batch.
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [
+                    dict(id=10, debit_account_id=1, credit_account_id=2,
+                         amount=big),
+                    dict(id=11, debit_account_id=1, credit_account_id=3,
+                         amount=big),
+                ]
+            ),
+        )
+    )
+    # Later clean batch must still be exact after recovery.
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [dict(id=12, debit_account_id=1, credit_account_id=3,
+                      amount=7)]
+            ),
+        )
+    )
+    ops.append((Operation.lookup_accounts, hz.ids_bytes([1, 2, 3])))
+    replay_both(h_d, h_c, ops)
+    assert h_d.sm._dev.stat_fallback_batches >= 1
+
+
+def test_fallback_recovery_redispatches_inflight(monkeypatch):
+    """Batches dispatched AFTER one that falls back are re-executed
+    against the corrected table."""
+    import tigerbeetle_tpu.state_machine.device_engine as de
+
+    monkeypatch.setattr(de, "_FETCH_EVERY", 64)
+    h_d, h_c = mk_pair()
+    big = (1 << 127) + 5
+    ops = [(Operation.create_accounts, accounts([1, 2, 3]))]
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [
+                    dict(id=10, debit_account_id=1, credit_account_id=2,
+                         amount=big),
+                    dict(id=11, debit_account_id=1, credit_account_id=3,
+                         amount=big),
+                ]
+            ),
+        )
+    )
+    for k in range(4):
+        ops.append(
+            (
+                Operation.create_transfers,
+                transfers(
+                    [dict(id=20 + k, debit_account_id=1,
+                          credit_account_id=3, amount=3 + k)]
+                ),
+            )
+        )
+    ops.append((Operation.lookup_accounts, hz.ids_bytes([1, 2, 3])))
+    replay_both(h_d, h_c, ops)
+    assert h_d.sm._dev.stat_fallback_batches >= 1
+
+
+def test_fallback_cap_exceeded():
+    """More failures than the summary cap -> host re-execution with
+    full failure list."""
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts([1, 2]))]
+    rows = [
+        dict(id=100 + i, debit_account_id=1, credit_account_id=1, amount=1)
+        for i in range(100)  # accounts_must_be_different x100 > cap 60
+    ]
+    ops.append((Operation.create_transfers, transfers(rows)))
+    replay_both(h_d, h_c, ops)
+    assert h_d.sm._dev.stat_fallback_batches >= 1
+
+
+def test_linked_precondition_fallback():
+    """Limit accounts with u128-scale balances exceed the fixpoint's
+    u64-safety precondition -> device flags, host decides."""
+    h_d, h_c = mk_pair()
+    huge = 1 << 62
+    ops = [
+        (
+            Operation.create_accounts,
+            accounts([1], flags=int(AF.debits_must_not_exceed_credits))
+            + accounts([2, 3]),
+        )
+    ]
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [dict(id=5, debit_account_id=2, credit_account_id=1,
+                      amount=huge)]
+            ),
+        )
+    )
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [
+                    dict(id=10, debit_account_id=1, credit_account_id=2,
+                         amount=10, flags=int(TF.linked)),
+                    dict(id=11, debit_account_id=1, credit_account_id=3,
+                         amount=20),
+                ]
+            ),
+        )
+    )
+    ops.append((Operation.lookup_accounts, hz.ids_bytes([1, 2, 3])))
+    replay_both(h_d, h_c, ops)
+
+
+def test_linked_fixpoint_multi_iteration():
+    """Interleaved chains contending on limited accounts force the
+    Jacobi fixpoint past one iteration; verdicts stay exact."""
+    rng = np.random.default_rng(7)
+    n_acct = 6
+    h_d, h_c = mk_pair()
+    ops = [
+        (
+            Operation.create_accounts,
+            accounts(
+                range(1, n_acct + 1),
+                flags=int(AF.debits_must_not_exceed_credits),
+            )
+            + accounts([99]),
+        )
+    ]
+    # Fund tightly so later chain members trip limits depending on
+    # earlier verdicts.
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [
+                    dict(id=100 + i, debit_account_id=99,
+                         credit_account_id=i + 1, amount=30)
+                    for i in range(n_acct)
+                ]
+            ),
+        )
+    )
+    rows = []
+    tid = 200
+    for _chain in range(40):
+        ln = int(rng.integers(1, 5))
+        for j in range(ln):
+            dr = int(rng.integers(1, n_acct + 1))
+            cr = int(rng.integers(1, n_acct + 1))
+            if cr == dr:
+                cr = dr % n_acct + 1
+            rows.append(
+                dict(
+                    id=tid, debit_account_id=dr, credit_account_id=cr,
+                    amount=int(rng.integers(1, 25)),
+                    flags=int(TF.linked) if j < ln - 1 else 0,
+                )
+            )
+            tid += 1
+    ops.append((Operation.create_transfers, transfers(rows)))
+    ops.append(
+        (Operation.lookup_accounts, hz.ids_bytes(list(range(1, n_acct + 1))))
+    )
+    replay_both(h_d, h_c, ops)
+
+
+def test_pulse_with_inflight_timeout_pending():
+    """A timeout pending created through the device path must still
+    expire on schedule (pulse drains the pipeline first)."""
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts([1, 2]))]
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [
+                    dict(id=10, debit_account_id=1, credit_account_id=2,
+                         amount=50, flags=int(TF.pending), timeout=1),
+                ]
+            ),
+        )
+    )
+    futs = [h_d.submit_async(op, body) for op, body in ops]
+    replies_c = [h_c.submit(op, body) for op, body in ops]
+    # Advance realtime past the expiry on both engines.
+    later = int(2e9) + h_d.sm.prepare_timestamp
+    # First submit advances prepare_timestamp past the expiry; the
+    # second one's tick_pulses fires the pulse (prepare-time decision,
+    # reference: src/vsr/replica.zig:3126-3143).
+    for _ in range(2):
+        a = h_d.submit_async(
+            Operation.lookup_accounts, hz.ids_bytes([1, 2]), realtime=later
+        )
+        b = h_c.submit(
+            Operation.lookup_accounts, hz.ids_bytes([1, 2]), realtime=later
+        )
+    for f, r in zip(futs, replies_c):
+        assert f.result() == r
+    assert a.result() == b
+    acc = np.frombuffer(a.result(), dtype=types.ACCOUNT_DTYPE)
+    assert int(acc[0]["debits_pending_lo"]) == 0  # expired and released
+
+
+def test_checkpoint_checksum_catches_divergence():
+    sm = TpuStateMachine(engine="device")
+    h = hz.SingleNodeHarness(sm)
+    h.submit(Operation.create_accounts, accounts([1, 2]))
+    h.submit(
+        Operation.create_transfers,
+        transfers(
+            [dict(id=10, debit_account_id=1, credit_account_id=2, amount=5)]
+        ),
+    )
+    sm.verify_device_mirror()  # clean
+    sm._mirror.lo[0, 1] += 1  # corrupt the mirror
+    with pytest.raises(AssertionError, match="divergence"):
+        sm.verify_device_mirror()
+    sm._mirror.lo[0, 1] -= 1
+    sm.snapshot()  # checkpoint barrier runs the verify
+
+
+def test_lookup_accounts_sees_inflight_batches(monkeypatch):
+    """Device-side balance gather reflects batches that have not
+    materialized yet (no drain)."""
+    import tigerbeetle_tpu.state_machine.device_engine as de
+
+    monkeypatch.setattr(de, "_FETCH_EVERY", 1000)
+    sm = TpuStateMachine(engine="device")
+    h = hz.SingleNodeHarness(sm)
+    h.submit(Operation.create_accounts, accounts([1, 2]))
+    f1 = h.submit_async(
+        Operation.create_transfers,
+        transfers(
+            [dict(id=10, debit_account_id=1, credit_account_id=2, amount=5)]
+        ),
+    )
+    f2 = h.submit_async(Operation.lookup_accounts, hz.ids_bytes([1, 2]))
+    assert not f1.done()  # still in flight
+    acc = np.frombuffer(f2.result(), dtype=types.ACCOUNT_DTYPE)
+    assert int(acc[0]["debits_posted_lo"]) == 5
+    assert int(acc[1]["credits_posted_lo"]) == 5
+    assert f1.result() == b""
+
+
+def test_pipelined_double_finalize_same_pending(monkeypatch):
+    """Two pipelined one-event batches posting the SAME durable
+    pending: the second must drain on the recorded pending-ref key of
+    the first (not just its transfer id) and fail with
+    already_posted — the code-review repro for the id_keys hazard."""
+    import tigerbeetle_tpu.state_machine.device_engine as de
+
+    monkeypatch.setattr(de, "_FETCH_EVERY", 64)
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts([1, 2]))]
+    ops.append(
+        (
+            Operation.create_transfers,
+            transfers(
+                [
+                    dict(id=10, debit_account_id=1, credit_account_id=2,
+                         amount=50, flags=int(TF.pending)),
+                    dict(id=11, debit_account_id=1, credit_account_id=2,
+                         amount=500, flags=int(TF.pending)),
+                ]
+            ),
+        )
+    )
+    futs1 = [h_d.submit_async(op, body) for op, body in ops]
+    replies_1 = [f.result() for f in futs1]  # pendings land durably
+    ops2 = [
+        (
+            Operation.create_transfers,
+            transfers(
+                [dict(id=30, pending_id=10,
+                      flags=int(TF.post_pending_transfer))]
+            ),
+        ),
+        (
+            Operation.create_transfers,
+            transfers(
+                [dict(id=31, pending_id=10,
+                      flags=int(TF.post_pending_transfer))]
+            ),
+        ),
+        (Operation.lookup_accounts, hz.ids_bytes([1, 2])),
+    ]
+    futs2 = [h_d.submit_async(op, body) for op, body in ops2]
+    replies_d = replies_1 + [f.result() for f in futs2]
+    replies_c = [h_c.submit(op, body) for op, body in ops + ops2]
+    assert replies_d == replies_c
+    res = np.frombuffer(replies_d[-2], dtype=types.CREATE_RESULT_DTYPE)
+    assert len(res) == 1
+    assert res[0]["result"] == int(CTR.pending_transfer_already_posted)
+
+
+def test_two_phase_cross_batch_durable_targets():
+    """Pendings land durably (drained), then posts/voids reference them
+    from later batches, including double-finalize races."""
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts([1, 2, 3]))]
+    pends = [
+        dict(id=10 + i, debit_account_id=1, credit_account_id=2,
+             amount=10 + i, flags=int(TF.pending))
+        for i in range(6)
+    ]
+    ops.append((Operation.create_transfers, transfers(pends)))
+    finalize = [
+        dict(id=30, pending_id=10, flags=int(TF.post_pending_transfer)),
+        dict(id=31, pending_id=11, flags=int(TF.void_pending_transfer)),
+        dict(id=32, pending_id=10, flags=int(TF.void_pending_transfer)),
+        dict(id=33, pending_id=12, flags=int(TF.post_pending_transfer),
+             amount=5),
+        dict(id=34, pending_id=99, flags=int(TF.post_pending_transfer)),
+    ]
+    ops.append((Operation.create_transfers, transfers(finalize)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes([1, 2])))
+    ops.append(
+        (Operation.lookup_transfers, hz.ids_bytes([30, 31, 32, 33, 34]))
+    )
+    replay_both(h_d, h_c, ops)

@@ -1,0 +1,366 @@
+"""Device-authoritative execution pipeline for create_transfers.
+
+Owns the authoritative HBM balance table + account-meta table and a
+stream of semantic-kernel dispatches (device_kernels.py).  The host
+submits packed batches and gets back *reply futures*; result codes are
+computed on device, ride the failure-sparse summary ring, and
+materialize when the host fetches the ring — once per burst, because
+the tunneled link's downlink costs ~105 ms per fetch regardless of
+size (experiments/README.md).
+
+Execution model
+---------------
+- ``submit(kind, pk, n, ts_base, finish, fallback)`` dispatches one
+  kernel against the current table/ring and appends an in-flight
+  record.  Dispatches are asynchronous; the device executes them in
+  stream order, so every kernel sees exactly the committed-so-far
+  state (serial consistency without host round trips).
+- When the in-flight window reaches ``fetch_every`` (or on
+  ``drain()``), the host fetches the ring snapshot ONCE and
+  materializes every covered batch in order: the ``finish`` callback
+  turns device codes into bookkeeping + reply bytes.
+- A batch whose summary carries a fallback flag (balance overflow in
+  play, failure-cap exceeded, precondition violated) triggers exact
+  recovery: the host re-executes that batch through the host engine
+  (``fallback`` callback, which updates the mirror), re-uploads the
+  corrected table, and re-dispatches every later in-flight batch.
+  Replies stay exact for ANY input; the flags only cost latency.
+
+The pipeline also carries the write-behind lane the host exact path
+uses (``enqueue``/``flush``, same contract as kernel_fast.DeviceTable)
+so host-resolved batches keep the device table current in stream
+order, and a device-side ``lookup`` used to serve lookup_accounts
+balances from the authoritative table (not the host mirror).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+_FETCH_EVERY = int(os.environ.get("TB_DEV_FETCH", "48"))
+_RING = int(os.environ.get("TB_DEV_RING", "256"))
+
+
+class ReplyFuture:
+    """Reply bytes that materialize at the batch's ring fetch."""
+
+    __slots__ = ("_value", "_engine")
+
+    def __init__(self, engine=None, value: bytes | None = None) -> None:
+        self._value = value
+        self._engine = engine
+
+    def done(self) -> bool:
+        return self._value is not None
+
+    def resolve(self, value: bytes) -> None:
+        self._value = value
+
+    def result(self) -> bytes:
+        if self._value is None:
+            self._engine.drain()
+            assert self._value is not None, "drain did not materialize reply"
+        return self._value
+
+
+class _InFlight:
+    """One stream entry: a dispatched semantic batch or a lookup
+    gather, in submission order (ordering matters for exact fallback
+    recovery)."""
+
+    __slots__ = (
+        "kind", "pk", "n", "ts_base", "finish", "fallback", "future",
+        "ring_at", "id_keys", "handle", "slots",
+    )
+
+    def __init__(self, kind, future, finish, *, pk=None, n=0, ts_base=0,
+                 fallback=None, ring_at=-1, id_keys=None, handle=None,
+                 slots=None):
+        self.kind = kind
+        self.pk = pk
+        self.n = n
+        self.ts_base = ts_base
+        self.finish = finish
+        self.fallback = fallback
+        self.future = future
+        self.ring_at = ring_at
+        self.id_keys = id_keys  # sorted u128-packed ids (hazard probes)
+        self.handle = handle    # lookup gather output handle
+        self.slots = slots      # lookup slots (for re-gather)
+
+
+class DeviceEngine:
+    """Authoritative device tables + semantic dispatch pipeline."""
+
+    def __init__(self, capacity: int, mirror) -> None:
+        self.capacity = capacity
+        self.mirror = mirror  # host bookkeeping copy (recovery + parity)
+        self.balances = jnp.zeros((capacity, 8), jnp.uint64)
+        self.meta = jnp.zeros((capacity, 2), jnp.uint32)
+        self._meta_host = np.zeros((capacity, 2), np.uint32)
+        self.ring = jnp.zeros((_RING, dk.SUMMARY_WORDS), jnp.uint64)
+        self._ring_at = 0
+        self._stream: list[_InFlight] = []
+        self._n_batches = 0
+        # Write-behind lane for host-resolved batches (exact path).
+        self._q: list[tuple] = []
+        self._queued = 0
+        self._suppress_enqueue = False
+        # Stats.
+        self.stat_semantic_events = 0
+        self.stat_fallback_batches = 0
+        self.stat_fetches = 0
+
+    # ------------------------------------------------------------------
+    # Account meta maintenance (create_accounts path).
+
+    def add_accounts(self, slots, acct_flags, acct_ledger) -> None:
+        slots = np.asarray(slots, np.int64)
+        self._meta_host[slots, 0] = acct_flags
+        self._meta_host[slots, 1] = acct_ledger
+        self.meta = dk.meta_update(
+            self.meta,
+            jnp.asarray(slots),
+            jnp.asarray(np.asarray(acct_flags, np.uint32)),
+            jnp.asarray(np.asarray(acct_ledger, np.uint32)),
+        )
+
+    def remove_accounts(self, slots) -> None:
+        """Linked create_accounts rollback support."""
+        slots = np.asarray(slots, np.int64)
+        self._meta_host[slots] = 0
+        z = np.zeros(len(slots), np.uint32)
+        self.meta = dk.meta_update(
+            self.meta, jnp.asarray(slots), jnp.asarray(z), jnp.asarray(z)
+        )
+
+    def grow(self, capacity: int) -> None:
+        if capacity <= self.capacity:
+            return
+        self.drain()
+        self.flush()
+        extra = capacity - self.capacity
+        self.balances = jnp.concatenate(
+            [self.balances, jnp.zeros((extra, 8), jnp.uint64)]
+        )
+        self.meta = jnp.concatenate(
+            [self.meta, jnp.zeros((extra, 2), jnp.uint32)]
+        )
+        mh = np.zeros((capacity, 2), np.uint32)
+        mh[: self.capacity] = self._meta_host
+        self._meta_host = mh
+        self.capacity = capacity
+
+    # ------------------------------------------------------------------
+    # Semantic dispatch.
+
+    def submit(self, kind, pk, n, ts_base, finish, fallback,
+               id_keys=None) -> ReplyFuture:
+        """Dispatch one semantic batch; returns its reply future.
+
+        `finish(summary) -> bytes` runs at materialization (device codes
+        -> bookkeeping + reply).  `fallback() -> bytes` re-executes the
+        batch exactly on the host engine against the mirror.
+        """
+        self.flush()  # earlier exact-path deltas must precede us
+        fut = ReplyFuture(self)
+        rec = _InFlight(
+            kind, fut, finish, pk=pk, n=n, ts_base=ts_base,
+            fallback=fallback, id_keys=id_keys,
+        )
+        self._dispatch(rec)
+        self._stream.append(rec)
+        self._n_batches += 1
+        if self._n_batches >= _FETCH_EVERY:
+            self._materialize()
+        return fut
+
+    def _dispatch(self, rec: _InFlight) -> None:
+        kernel = {
+            "orderfree": dk.orderfree,
+            "linked": dk.linked,
+            "two_phase": dk.two_phase,
+        }[rec.kind]
+        self.balances, self.ring = kernel(
+            self.balances, self.meta, self.ring, self._ring_at,
+            jnp.asarray(rec.pk), rec.n, jnp.uint64(rec.ts_base),
+        )
+        rec.ring_at = self._ring_at
+        self._ring_at = (self._ring_at + 1) % _RING
+
+    def lookup(self, slots, finish) -> ReplyFuture:
+        """Device-side balance gather for lookup_accounts: rides the
+        dispatch stream, so it sees every in-flight batch's effects.
+        `finish(rows)` builds the reply from the fetched (k, 8) rows
+        at materialization."""
+        fut = ReplyFuture(self)
+        slots = np.asarray(slots, np.int64)
+        rec = _InFlight("lookup", fut, finish, slots=slots)
+        rec.handle = self._gather(slots)
+        self._stream.append(rec)
+        return fut
+
+    def _gather(self, slots):
+        pad = ((len(slots) + 255) & ~255) or 256
+        sl = np.full(pad, -1, np.int64)
+        sl[: len(slots)] = slots
+        return dk.lookup(self.balances, jnp.asarray(sl))
+
+    # ------------------------------------------------------------------
+    # Hazard probe: does any probe id match an in-flight batch's ids?
+
+    def inflight_ids_hit(self, keys: np.ndarray) -> bool:
+        """keys: u128-packed (V16) id probes, any order."""
+        if not self._stream or len(keys) == 0:
+            return False
+        keys = np.sort(keys)
+        # V16 keys order numerically by their bytes; scalar compares go
+        # through .tobytes() (numpy void scalars lack ufunc ordering).
+        lo = keys[0].tobytes()
+        hi = keys[-1].tobytes()
+        for rec in self._stream:
+            ik = rec.id_keys
+            if ik is None or len(ik) == 0:
+                continue
+            if hi < ik[0].tobytes() or lo > ik[-1].tobytes():
+                continue
+            pos = np.searchsorted(ik, keys)
+            pos = np.minimum(pos, len(ik) - 1)
+            if (ik[pos] == keys).any():
+                return True
+        return False
+
+    def has_inflight(self) -> bool:
+        return bool(self._stream)
+
+    # ------------------------------------------------------------------
+    # Materialization.
+
+    def _materialize(self) -> None:
+        """Fetch the ring once; resolve the stream in order.
+
+        On a fallback flag: the host re-executes that batch exactly
+        (updating the mirror), the table is rebuilt from the mirror,
+        and the REST of the stream — later batches and lookup gathers,
+        whose device snapshots included wrong state — is re-dispatched
+        in order against the corrected table.  Repeats until the
+        stream drains."""
+        while self._stream:
+            covered = self._stream
+            self._stream = []
+            self._n_batches = 0
+            if any(rec.kind != "lookup" for rec in covered):
+                self.stat_fetches += 1
+                ring_np = np.asarray(self.ring)  # THE burst fetch
+            failed_at = None
+            for i, rec in enumerate(covered):
+                if rec.kind == "lookup":
+                    rec.future.resolve(rec.finish(np.asarray(rec.handle)))
+                    continue
+                s = dk.unpack_summary(ring_np[rec.ring_at])
+                if s["overflow"] or s["cap_exceeded"] or s["precond"]:
+                    failed_at = i
+                    self.stat_fallback_batches += 1
+                    rec.future.resolve(rec.fallback())
+                    break
+                self.stat_semantic_events += rec.n
+                rec.future.resolve(rec.finish(s))
+            if failed_at is None:
+                continue
+            # Recovery: mirror reflects every batch up to and including
+            # the fallback; rebuild the device table from it and replay
+            # the rest of the stream in order.
+            self._upload_from_mirror()
+            for rec in covered[failed_at + 1 :]:
+                if rec.kind == "lookup":
+                    rec.handle = self._gather(rec.slots)
+                else:
+                    self._dispatch(rec)
+                    self._n_batches += 1
+                self._stream.append(rec)
+
+    def _upload_from_mirror(self) -> None:
+        table = np.zeros((self.capacity, 8), np.uint64)
+        n = min(len(self.mirror.lo), self.capacity)
+        table[:n, 0::2] = self.mirror.lo[:n]
+        table[:n, 1::2] = self.mirror.hi[:n]
+        self.balances = jnp.asarray(table)
+
+    def drain(self) -> None:
+        self._materialize()
+
+    # ------------------------------------------------------------------
+    # Write-behind lane (host exact path) — kernel_fast.DeviceTable API.
+
+    def enqueue(self, slots, cols, add_lo, add_hi) -> None:
+        if self._suppress_enqueue or len(slots) == 0:
+            return
+        self._q.append(
+            (
+                np.asarray(slots, np.int64),
+                np.asarray(cols, np.int64),
+                np.asarray(add_lo, np.uint64),
+                np.asarray(add_hi, np.uint64),
+            )
+        )
+        self._queued += len(slots)
+
+    def flush(self) -> None:
+        if not self._queued:
+            return
+        from tigerbeetle_tpu.state_machine.mirror import compact_deltas
+
+        slots = np.concatenate([e[0] for e in self._q])
+        cols = np.concatenate([e[1] for e in self._q])
+        a_lo = np.concatenate([e[2] for e in self._q])
+        a_hi = np.concatenate([e[3] for e in self._q])
+        self._q.clear()
+        self._queued = 0
+        chunk = (1 << 21) - 1
+        if len(slots) > chunk:
+            parts = [
+                compact_deltas(
+                    slots[i : i + chunk], cols[i : i + chunk],
+                    a_lo[i : i + chunk], a_hi[i : i + chunk],
+                )
+                for i in range(0, len(slots), chunk)
+            ]
+            slots = np.concatenate([p[0] for p in parts])
+            cols = np.concatenate([p[1] for p in parts])
+            a_lo = np.concatenate([p[2] for p in parts])
+            a_hi = np.concatenate([p[3] for p in parts])
+        u_slot, u_col, d_lo, d_hi, _ = compact_deltas(slots, cols, a_lo, a_hi)
+        at = 0
+        CH = 32_768
+        while at < len(u_slot):
+            take = min(len(u_slot) - at, CH)
+            packed = np.empty((4, CH), np.uint64)
+            packed[0, :take] = u_slot[at : at + take].astype(np.uint64)
+            packed[0, take:] = self.capacity + np.arange(
+                CH - take, dtype=np.uint64
+            )
+            packed[1, :take] = u_col[at : at + take].astype(np.uint64)
+            packed[1, take:] = 0
+            packed[2, :take] = d_lo[at : at + take]
+            packed[2, take:] = 0
+            packed[3, :take] = d_hi[at : at + take]
+            packed[3, take:] = 0
+            self.balances = dk.apply_deltas(self.balances, jnp.asarray(packed))
+            at += take
+
+    def read(self):
+        """Flush barrier + device handle (DeviceTable API compat)."""
+        self.drain()
+        self.flush()
+        return self.balances
+
+    def checksum(self) -> np.ndarray:
+        """Device-side table digest (drained + flushed first)."""
+        return np.asarray(dk.checksum(self.read()))

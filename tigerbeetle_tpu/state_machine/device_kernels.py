@@ -1,0 +1,995 @@
+"""Device-resident semantic kernels: result codes computed ON the TPU.
+
+Round 3 kept all create_transfers semantics on the host and used the
+device as a write-behind balance replica.  Round 4 inverts that
+authority for the three vectorizable batch classes (order-free,
+linked-chain, two-phase): the kernels below read the authoritative
+HBM balance/meta tables, run the full precedence ladder + the
+order-dependent resolution, apply balance effects, and emit result
+codes — the host's role shrinks to joins (id-directory probes, durable
+row gathers), routing, and bookkeeping derived from the codes.
+
+reference: src/state_machine.zig:1220-1306 (execute loop),
+:1462-1741 (create_transfer + post/void), src/tigerbeetle.zig:31-39
+(limit formulas).  The semantics ported here are the same ones the
+vectorized host resolvers (resolve.py) implement; differential fuzz in
+tests/test_device_engine.py pins all three kernels to the CPU oracle.
+
+Link constraints (measured, experiments/README.md): the tunneled-TPU
+downlink costs ~105 ms per fetch at ~15 MB/s, serialized.  Per-event
+result readback is impossible at millions of events/s, so each kernel
+writes a fixed-size FAILURE-SPARSE summary row (60 failure slots +
+status flags) into a device ring; the host fetches the ring once per
+burst.  Batches whose failures exceed the cap — or that hit an
+overflow/precondition edge — raise a flag and are re-executed exactly
+on the host engine (the fallback path), so the sparse encoding never
+loses information.
+
+Input marshaling split (who computes what): the host packs raw event
+columns and *stateless byte predicates* (id == 0, id == maxInt,
+debit id == credit id, ...) plus join booleans (duplicate-id found,
+pending target found) into one u64 matrix per batch — pure wire
+decoding and directory probes.  Every *decision* — precedence ladder
+order, balance math, limit fixpoint, two-phase winner resolution,
+overflow admission — happens on device against device state.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+from tigerbeetle_tpu.types import CreateTransferResult as CTR
+
+# ---------------------------------------------------------------------------
+# Batch geometry.
+
+# Fixed event bucket (batches pad up to this; larger batches take the
+# host path).  Tests shrink it via TB_DEV_B — CPU-backend matmuls at
+# the production size would dominate the suite's runtime.
+B = int(os.environ.get("TB_DEV_B", "8192"))
+# _accum_cols exactness bound: f32 partial sums of 8-bit pieces over at
+# most 4B rows (the two_phase add matmul) must stay below 2^24.
+assert 4 * B * 255 < (1 << 24), "TB_DEV_B too large for exact f32 sums"
+SUMMARY_WORDS = 64
+FAIL_CAP = SUMMARY_WORDS - 4   # failure entries per batch summary
+
+# Summary flag bits (word [1]).
+FLAG_OVERFLOW = 1 << 0     # balance-overflow admission failed
+FLAG_CAP = 1 << 1          # more than FAIL_CAP failures
+FLAG_PRECOND = 1 << 2      # kernel precondition (u64-safety, fixpoint cap)
+ITERS_SHIFT = 16           # linked fixpoint iterations (diagnostics)
+
+# Packed input columns (u64 each, B rows).
+COL_BITS = 0
+COL_SLOTS = 1      # (dr_slot+1) u32 | (cr_slot+1) << 32 ; 0 = not found
+COL_AMT_LO = 2
+COL_AMT_HI = 3
+COL_MISC = 4       # flags u16 | code u16 << 16 | ledger u32 << 32
+COL_TIMEOUT = 5    # timeout u32 | (p_tgt+1) u32 << 32
+N_COLS = 6
+# two-phase extension columns:
+COL_TP_JOIN = 6    # p_flags u16 | p_code u16 << 16 | p_ledger u32 << 32
+COL_TP_SLOTS = 7   # (p_dr_slot+1) u32 | (p_cr_slot+1) u32 << 32  (durable)
+COL_TP_AMT_LO = 8  # durable target amount
+COL_TP_AMT_HI = 9
+COL_TP_REF = 10    # (tgt_ev+1) u32 | dstat_init u32 << 32
+N_COLS_TP = 11
+
+# COL_BITS bits (host-marshaled stateless predicates + join booleans).
+BIT_TS_NONZERO = 1 << 0
+BIT_ID_ZERO = 1 << 1
+BIT_ID_MAX = 1 << 2
+BIT_DR_ZERO = 1 << 3
+BIT_DR_MAX = 1 << 4
+BIT_CR_ZERO = 1 << 5
+BIT_CR_MAX = 1 << 6
+BIT_SAME_ACCT = 1 << 7
+BIT_PEND_NONZERO = 1 << 8
+BIT_PEND_MAX = 1 << 9
+BIT_PEND_SELF = 1 << 10
+BIT_E_FOUND = 1 << 11
+BIT_P_FOUND = 1 << 12
+BIT_T_DR_SET = 1 << 13   # event names a debit account (pv ladder)
+BIT_T_CR_SET = 1 << 14
+BIT_DR_EQ_P = 1 << 15    # event dr id == target's dr id
+BIT_CR_EQ_P = 1 << 16
+BIT_LEDGER_EQ_P = 1 << 17  # unused (ledger compare runs on device)
+
+# TransferFlags bits (reference: src/tigerbeetle.zig:127-140).
+F_LINKED = 1 << 0
+F_PENDING = 1 << 1
+F_POST = 1 << 2
+F_VOID = 1 << 3
+F_BAL_DR = 1 << 4
+F_BAL_CR = 1 << 5
+
+# AccountFlags bits (reference: src/tigerbeetle.zig:42-63).
+AF_DR_LIMIT = 1 << 1
+AF_CR_LIMIT = 1 << 2
+
+S_PENDING, S_POSTED, S_VOIDED, S_EXPIRED = 1, 2, 3, 4
+
+NS_PER_S = jnp.uint64(1_000_000_000)
+_MASK8 = jnp.uint64(0xFF)
+_MASK16 = jnp.uint64(0xFFFF)
+_MASK32 = jnp.uint64(0xFFFFFFFF)
+# u64-exactness bound for the linked fixpoint (see resolve.py).
+_U64_SAFE = np.uint64(1) << np.uint64(61)
+
+
+def _first_nonzero(*pairs):
+    r = jnp.uint32(0)
+    for cond, code in pairs:
+        r = jnp.where((r == 0) & cond, jnp.uint32(code), r)
+    return r
+
+
+def _unpack(pk):
+    """Split the packed (B, C) u64 matrix into named columns."""
+    bits = pk[:, COL_BITS]
+    slots = pk[:, COL_SLOTS]
+    misc = pk[:, COL_MISC]
+    return {
+        "bits": bits,
+        "dr_slot": (slots & _MASK32).astype(jnp.int64) - 1,
+        "cr_slot": (slots >> jnp.uint64(32)).astype(jnp.int64) - 1,
+        "amt_lo": pk[:, COL_AMT_LO],
+        "amt_hi": pk[:, COL_AMT_HI],
+        "flags": (misc & _MASK16).astype(jnp.uint32),
+        "code": ((misc >> jnp.uint64(16)) & _MASK16).astype(jnp.uint32),
+        "ledger": (misc >> jnp.uint64(32)).astype(jnp.uint32),
+        "timeout": (pk[:, COL_TIMEOUT] & _MASK32),
+        "p_tgt": (pk[:, COL_TIMEOUT] >> jnp.uint64(32)).astype(jnp.int64) - 1,
+    }
+
+
+def _bit(bits, mask):
+    return (bits & jnp.uint64(mask)) != 0
+
+
+def _static_ladder_normal(ev, meta, active):
+    """Static precedence ladder for non-post/void transfers, evaluated
+    on device (reference ladder: src/state_machine.zig:1465-1504).
+    `meta` is the (A, 2) u32 device table [flags, ledger]."""
+    bits = ev["bits"]
+    flags = ev["flags"]
+    A = meta.shape[0]
+    drc = jnp.clip(ev["dr_slot"], 0, A - 1)
+    crc = jnp.clip(ev["cr_slot"], 0, A - 1)
+    dr_found = ev["dr_slot"] >= 0
+    cr_found = ev["cr_slot"] >= 0
+    dr_ledger = jnp.where(dr_found, meta[drc, 1], 0)
+    cr_ledger = jnp.where(cr_found, meta[crc, 1], 0)
+    not_pending = (flags & F_PENDING) == 0
+    not_balancing = (flags & (F_BAL_DR | F_BAL_CR)) == 0
+    amount_zero = (ev["amt_lo"] == 0) & (ev["amt_hi"] == 0)
+    r = _first_nonzero(
+        (_bit(bits, BIT_TS_NONZERO), CTR.timestamp_must_be_zero),
+        ((flags & ~jnp.uint32(0x3F)) != 0, CTR.reserved_flag),
+        (_bit(bits, BIT_ID_ZERO), CTR.id_must_not_be_zero),
+        (_bit(bits, BIT_ID_MAX), CTR.id_must_not_be_int_max),
+        (_bit(bits, BIT_DR_ZERO), CTR.debit_account_id_must_not_be_zero),
+        (_bit(bits, BIT_DR_MAX), CTR.debit_account_id_must_not_be_int_max),
+        (_bit(bits, BIT_CR_ZERO), CTR.credit_account_id_must_not_be_zero),
+        (_bit(bits, BIT_CR_MAX), CTR.credit_account_id_must_not_be_int_max),
+        (_bit(bits, BIT_SAME_ACCT), CTR.accounts_must_be_different),
+        (_bit(bits, BIT_PEND_NONZERO), CTR.pending_id_must_be_zero),
+        (
+            not_pending & (ev["timeout"] != 0),
+            CTR.timeout_reserved_for_pending_transfer,
+        ),
+        (not_balancing & amount_zero, CTR.amount_must_not_be_zero),
+        (ev["ledger"] == 0, CTR.ledger_must_not_be_zero),
+        (ev["code"] == 0, CTR.code_must_not_be_zero),
+        (~dr_found, CTR.debit_account_not_found),
+        (~cr_found, CTR.credit_account_not_found),
+        (dr_ledger != cr_ledger, CTR.accounts_must_have_the_same_ledger),
+        (
+            ev["ledger"] != dr_ledger,
+            CTR.transfer_must_have_the_same_ledger_as_accounts,
+        ),
+    )
+    # Inactive (padding) rows: poisoned so they never apply.
+    return jnp.where(active, r, jnp.uint32(CTR.linked_event_failed))
+
+
+def _accum_cols(slot_rows, col_rows, amt_lo_rows, amt_hi_rows, valid, A):
+    """Exact per-(slot, column) u128 sums via one-hot MXU matmul.
+
+    Amounts decompose into 8-bit pieces (each < 2^8); the one-hot
+    (rows, A) bf16 matmul accumulates them in f32 — sums stay below
+    rows * 255 < 2^24, so every partial is exact — and a base-256
+    carry recombination rebuilds exact u128 column deltas.
+
+    Returns (d_lo, d_hi, limb_ov) of shape (A, 4).
+    """
+    rows = slot_rows.shape[0]
+    zero = jnp.uint64(0)
+    lo = jnp.where(valid, amt_lo_rows, zero)
+    hi = jnp.where(valid, amt_hi_rows, zero)
+    pieces = [((lo >> jnp.uint64(s)) & _MASK8).astype(jnp.float32)
+              for s in range(0, 64, 8)]
+    pieces += [((hi >> jnp.uint64(s)) & _MASK8).astype(jnp.float32)
+               for s in range(0, 64, 8)]
+    P = jnp.stack(pieces, axis=-1)  # (rows, 16)
+    colmask = jax.nn.one_hot(col_rows, 4, dtype=jnp.float32)  # (rows, 4)
+    payload = (colmask[:, :, None] * P[:, None, :]).reshape(rows, 64)
+    safe_slots = jnp.where(valid, slot_rows, A)  # A = dropped lane
+    onehot = jax.nn.one_hot(safe_slots, A, dtype=jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        onehot.T, payload.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).reshape(A, 4, 16).astype(jnp.uint64)
+    c = acc[:, :, 0]
+    d_lo = c & _MASK8
+    carry = c >> jnp.uint64(8)
+    for k in range(1, 8):
+        c = acc[:, :, k] + carry
+        d_lo = d_lo | ((c & _MASK8) << jnp.uint64(8 * k))
+        carry = c >> jnp.uint64(8)
+    c = acc[:, :, 8] + carry
+    d_hi = c & _MASK8
+    carry = c >> jnp.uint64(8)
+    for k in range(1, 8):
+        c = acc[:, :, 8 + k] + carry
+        d_hi = d_hi | ((c & _MASK8) << jnp.uint64(8 * k))
+        carry = c >> jnp.uint64(8)
+    return d_lo, d_hi, carry != 0
+
+
+def _admit_apply(table, d_lo, d_hi, limb_ov):
+    """Admission + apply: add exact column deltas iff NO column u128
+    add overflows and no account's combined dp+dpo / cp+cpo total
+    overflows (mirrors BalanceMirror._admit_commit, which the host
+    fast path proved bit-parity for).  Returns (new_table, ov)."""
+    old_lo = table[:, 0::2]
+    old_hi = table[:, 1::2]
+    new_lo = old_lo + d_lo
+    cy = (new_lo < old_lo).astype(jnp.uint64)
+    hi_p = old_hi + d_hi
+    add_ov1 = hi_p < old_hi
+    new_hi = hi_p + cy
+    add_ov = add_ov1 | (new_hi < hi_p)
+
+    def tot_ov(lo_a, hi_a, lo_b, hi_b):
+        # u128 (a + b) overflow flag.
+        lo = lo_a + lo_b
+        c = (lo < lo_a).astype(jnp.uint64)
+        hp = hi_a + hi_b
+        h = hp + c
+        return (hp < hi_a) | (h < hp)
+
+    dr_tot_ov = tot_ov(new_lo[:, 0], new_hi[:, 0], new_lo[:, 1], new_hi[:, 1])
+    cr_tot_ov = tot_ov(new_lo[:, 2], new_hi[:, 2], new_lo[:, 3], new_hi[:, 3])
+    ov = limb_ov.any() | add_ov.any() | dr_tot_ov.any() | cr_tot_ov.any()
+    nt = jnp.stack(
+        [new_lo[:, 0], new_hi[:, 0], new_lo[:, 1], new_hi[:, 1],
+         new_lo[:, 2], new_hi[:, 2], new_lo[:, 3], new_hi[:, 3]],
+        axis=-1,
+    )
+    return jnp.where(ov, table, nt), ov
+
+
+def _summary(results, active, flags_word, last_applied):
+    """Failure-sparse fixed-size summary row: [n_fail, flags,
+    last_applied+1, n_active, entries...] as (SUMMARY_WORDS,) u64."""
+    fail = active & (results != 0)
+    n_fail = fail.sum().astype(jnp.uint64)
+    pos = jnp.cumsum(fail) - 1
+    ent = (jnp.arange(B, dtype=jnp.uint64) << jnp.uint64(32)) | results.astype(
+        jnp.uint64
+    )
+    entries = jnp.zeros(FAIL_CAP, jnp.uint64).at[
+        jnp.where(fail, pos, FAIL_CAP)
+    ].set(ent, mode="drop")
+    cap = n_fail > FAIL_CAP
+    flags_word = flags_word | jnp.where(
+        cap, jnp.uint64(FLAG_CAP), jnp.uint64(0)
+    )
+    head = jnp.stack(
+        [
+            n_fail,
+            flags_word,
+            (last_applied + 1).astype(jnp.uint64),
+            active.sum().astype(jnp.uint64),
+        ]
+    )
+    return jnp.concatenate([head, entries])
+
+
+# ---------------------------------------------------------------------------
+# Order-free kernel.
+
+
+def _orderfree(table, meta, ring, ring_at, pk, n, ts_base):
+    """Order-independent batch: full static ladder + overflow admission
+    + scatter apply + result codes, all on device.
+
+    Host routing guarantees (same class the r3 host fast path took):
+    no linked/post/void/balancing flags, unique fresh ids, no
+    limit/history accounts touched.  Within that class the only
+    dynamic codes are the overflow family — excluded wholesale by the
+    total-sum admission check (amounts are non-negative, so any prefix
+    is bounded by the all-applied total; reference:
+    src/state_machine.zig:1531-1545) — and overflows_timeout, which is
+    order-independent and computed per event here.
+    """
+    ev = _unpack(pk)
+    A = table.shape[0]
+    iota = jnp.arange(B, dtype=jnp.int64)
+    active = iota < n
+    r = _static_ladder_normal(ev, meta, active)
+
+    ts_i = ts_base + iota.astype(jnp.uint64)
+    expires = ts_i + ev["timeout"] * NS_PER_S
+    ov_timeout = (ev["timeout"] != 0) & (expires < ts_i)
+    r = jnp.where((r == 0) & ov_timeout, jnp.uint32(CTR.overflows_timeout), r)
+
+    ok = active & (r == 0)
+    is_pending = (ev["flags"] & F_PENDING) != 0
+    dcol = jnp.where(is_pending, 0, 1)
+    ccol = jnp.where(is_pending, 2, 3)
+    slot_rows = jnp.concatenate([ev["dr_slot"], ev["cr_slot"]])
+    col_rows = jnp.concatenate([dcol, ccol])
+    amt_lo2 = jnp.concatenate([ev["amt_lo"]] * 2)
+    amt_hi2 = jnp.concatenate([ev["amt_hi"]] * 2)
+    valid = jnp.concatenate([ok, ok])
+    d_lo, d_hi, limb_ov = _accum_cols(
+        slot_rows, col_rows, amt_lo2, amt_hi2, valid, A
+    )
+    new_table, ov = _admit_apply(table, d_lo, d_hi, limb_ov)
+
+    applied_idx = jnp.where(ok, iota, -1)
+    last_applied = applied_idx.max()
+    flags_word = jnp.where(ov, jnp.uint64(FLAG_OVERFLOW), jnp.uint64(0))
+    s = _summary(r, active, flags_word, last_applied)
+    ring = jax.lax.dynamic_update_slice(ring, s[None, :], (ring_at, 0))
+    return new_table, ring
+
+
+# ---------------------------------------------------------------------------
+# Linked-chain kernel (port of resolve.linked_resolve to device).
+
+
+def _linked(table, meta, ring, ring_at, pk, n, ts_base):
+    """Linked-chain batch of plain posted transfers; limit-flag
+    accounts allowed.  Jacobi fixpoint over per-account segmented
+    prefix sums converges to the exact sequential verdicts (see
+    resolve.py for the correctness argument; reference:
+    src/state_machine.zig:1220-1306, src/tigerbeetle.zig:31-39)."""
+    ev = _unpack(pk)
+    A = table.shape[0]
+    iota = jnp.arange(B, dtype=jnp.int64)
+    active = iota < n
+    static = _static_ladder_normal(ev, meta, active)
+
+    linked = active & ((ev["flags"] & F_LINKED) != 0)
+    # Chain structure: maximal runs of linked + following event.
+    start = jnp.concatenate(
+        [jnp.ones(1, bool), ~linked[:-1]]
+    )
+    chain_id = jnp.cumsum(start.astype(jnp.int64)) - 1
+    # chain_start event per chain (segment min of index).
+    chain_start_ev = jax.ops.segment_min(iota, chain_id, num_segments=B)
+    chain_last_ev = jax.ops.segment_max(iota, chain_id, num_segments=B)
+    start_of_ev = chain_start_ev[chain_id]
+
+    # Unconditional per-event codes; chain_open overrides on the last
+    # active event when it still carries the linked flag.
+    code0 = static
+    is_last = iota == (n - 1)
+    code0 = jnp.where(
+        is_last & linked, jnp.uint32(CTR.linked_event_chain_open), code0
+    )
+    static_ok = active & (code0 == 0)
+
+    drc = jnp.clip(ev["dr_slot"], 0, A - 1)
+    crc = jnp.clip(ev["cr_slot"], 0, A - 1)
+    dr_flags = jnp.where(ev["dr_slot"] >= 0, meta[drc, 0], 0)
+    cr_flags = jnp.where(ev["cr_slot"] >= 0, meta[crc, 0], 0)
+    LIM = jnp.uint32(AF_DR_LIMIT | AF_CR_LIMIT)
+    dlim = (dr_flags & AF_DR_LIMIT) != 0
+    clim = (cr_flags & AF_CR_LIMIT) != 0
+
+    # ---- preconditions (device-evaluated; violations -> host fallback)
+    precond_bad = (static_ok & (ev["amt_hi"] != 0)).any()
+    ent_d = static_ok & ((dr_flags & LIM) != 0)
+    ent_c = static_ok & ((cr_flags & LIM) != 0)
+    lim_touch = jnp.zeros(A + 1, bool)
+    lim_touch = lim_touch.at[jnp.where(ent_d, drc, A)].set(True, mode="drop")
+    lim_touch = lim_touch.at[jnp.where(ent_c, crc, A)].set(True, mode="drop")
+    lim_touch = lim_touch[:A]
+    hi_cols = table[:, 1::2]
+    lo_cols = table[:, 0::2]
+    precond_bad = precond_bad | (
+        lim_touch[:, None] & (hi_cols != 0)
+    ).any() | (
+        lim_touch[:, None] & (lo_cols >= jnp.uint64(_U64_SAFE))
+    ).any()
+    contrib = jnp.where(static_ok, ev["amt_lo"], jnp.uint64(0))
+    precond_bad = precond_bad | (
+        contrib.astype(jnp.float64).sum() >= jnp.float64(_U64_SAFE)
+    )
+
+    # ---- superset overflow admission (static_ok events, posted cols).
+    slot_rows = jnp.concatenate([ev["dr_slot"], ev["cr_slot"]])
+    col_rows = jnp.concatenate(
+        [jnp.ones(B, jnp.int32), jnp.full(B, 3, jnp.int32)]
+    )
+    amt_lo2 = jnp.concatenate([ev["amt_lo"]] * 2)
+    amt_hi2 = jnp.concatenate([ev["amt_hi"]] * 2)
+    sup_valid = jnp.concatenate([static_ok, static_ok])
+    d_lo_s, d_hi_s, limb_ov_s = _accum_cols(
+        slot_rows, col_rows, amt_lo2, amt_hi2, sup_valid, A
+    )
+    _, sup_ov = _admit_apply(table, d_lo_s, d_hi_s, limb_ov_s)
+
+    # ---- fixpoint over (slot, event)-sorted limit entries.
+    # Entries: 2B rows (dr side then cr side); invalid rows get
+    # sentinel keys that sort to the end.
+    evs2 = jnp.concatenate([iota, iota])
+    eslot2 = jnp.concatenate([ev["dr_slot"], ev["cr_slot"]])
+    eamt2 = jnp.concatenate([ev["amt_lo"]] * 2)
+    edeb2 = jnp.concatenate([jnp.ones(B, bool), jnp.zeros(B, bool)])
+    entv = jnp.concatenate([ent_d, ent_c])
+    key = jnp.where(
+        entv,
+        (eslot2.astype(jnp.uint64) << jnp.uint64(32))
+        | evs2.astype(jnp.uint64),
+        jnp.uint64(0xFFFFFFFFFFFFFFFF),
+    )
+    key_s, evs_s, eslot_s, eamt_s, edeb_s, valid_s = jax.lax.sort(
+        [key, evs2.astype(jnp.int32), eslot2.astype(jnp.int32), eamt2,
+         edeb2, entv],
+        num_keys=1,
+    )
+    M = 2 * B
+    jpos = jnp.arange(M)
+    seg_new = jnp.concatenate(
+        [jnp.ones(1, bool), eslot_s[1:] != eslot_s[:-1]]
+    ) & valid_s
+    seg_first = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(seg_new, jpos, 0)
+    )
+    bkey = jnp.where(
+        valid_s,
+        (eslot_s.astype(jnp.uint64) << jnp.uint64(32))
+        | start_of_ev[jnp.clip(evs_s, 0, B - 1)].astype(jnp.uint64),
+        jnp.uint64(0xFFFFFFFFFFFFFFFF),
+    )
+    bpos = jnp.searchsorted(key_s, bkey, side="left")
+
+    esl = jnp.clip(eslot_s, 0, A - 1)
+    init_dp = table[esl, 0]
+    init_dpo = table[esl, 2]
+    init_cp = table[esl, 4]
+    init_cpo = table[esl, 6]
+    evc = jnp.clip(evs_s, 0, B - 1)
+    view_d = valid_s & edeb_s & dlim[evc]
+    view_c = valid_s & ~edeb_s & clim[evc]
+    amt_d = jnp.where(edeb_s & valid_s, eamt_s, jnp.uint64(0))
+    amt_c = jnp.where(~edeb_s & valid_s, eamt_s, jnp.uint64(0))
+
+    def chain_state(pass_):
+        fails = (~pass_ & active).astype(jnp.int32)
+        F = jnp.cumsum(fails)
+        base = (F - fails)[chain_start_ev]
+        applied_prefix = (F - base[chain_id]) == 0
+        chain_ok = applied_prefix[chain_last_ev]
+        return applied_prefix, chain_ok
+
+    def excl_prefix(v):
+        # Exact u64 inclusive cumsum via four 16-bit-piece i32 cumsums
+        # (totals < 2^61 by the precondition; piece sums < M * 2^16
+        # < 2^31).  A direct u64 cumsum lowers to a variadic (u32, u32)
+        # reduce-window that blows XLA:TPU's scoped vmem inside
+        # while_loop bodies — see experiments/tpu_compile_check.py.
+        cs = jnp.uint64(0)
+        for k in range(4):
+            p = ((v >> jnp.uint64(16 * k)) & _MASK16).astype(jnp.int32)
+            cs = cs + (jnp.cumsum(p).astype(jnp.uint64) << jnp.uint64(16 * k))
+        return cs - v  # exclusive prefix at each position
+
+    def body(state):
+        pass_prev, _dr_fail, _cr_fail, it, _conv = state
+        applied_prefix, chain_ok = chain_state(pass_prev)
+        wce = chain_ok[chain_id][evc]
+        wie = applied_prefix[evc]
+        Pdc = excl_prefix(jnp.where(wce, amt_d, jnp.uint64(0)))
+        Pcc = excl_prefix(jnp.where(wce, amt_c, jnp.uint64(0)))
+        Pdi = excl_prefix(jnp.where(wie, amt_d, jnp.uint64(0)))
+        Pci = excl_prefix(jnp.where(wie, amt_c, jnp.uint64(0)))
+
+        def seg_diff(P, at):
+            # inclusive-exclusive segmented windows: P is the exclusive
+            # prefix, so P[b] - P[a] sums entries [a, b).
+            return P[at]
+
+        deb_before = (
+            seg_diff(Pdc, bpos) - seg_diff(Pdc, seg_first)
+        ) + (Pdi[jpos] - seg_diff(Pdi, bpos))
+        cred_before = (
+            seg_diff(Pcc, bpos) - seg_diff(Pcc, seg_first)
+        ) + (Pci[jpos] - seg_diff(Pci, bpos))
+        bad_d = view_d & (
+            init_dp + init_dpo + deb_before + eamt_s
+            > init_cpo + cred_before
+        )
+        bad_c = view_c & (
+            init_cp + init_cpo + cred_before + eamt_s
+            > init_dpo + deb_before
+        )
+        dr_fail = jnp.zeros(B, bool).at[jnp.where(bad_d, evc, B)].set(
+            True, mode="drop"
+        )
+        cr_fail = jnp.zeros(B, bool).at[jnp.where(bad_c, evc, B)].set(
+            True, mode="drop"
+        )
+        pass_ = static_ok & ~dr_fail & ~cr_fail
+        conv = (pass_ == pass_prev).all()
+        return pass_, dr_fail, cr_fail, it + 1, conv
+
+    def cond(state):
+        _p, _d, _c, it, conv = state
+        return (~conv) & (it < 64)
+
+    init = (
+        static_ok,
+        jnp.zeros(B, bool),
+        jnp.zeros(B, bool),
+        jnp.int32(0),
+        jnp.bool_(False),
+    )
+    # One unconditional iteration then loop to convergence: matches the
+    # host resolver's "verdict of event 0 is unconditional" induction.
+    state = body(init)
+    pass_, dr_fail, cr_fail, iters, conv = jax.lax.while_loop(
+        cond, body, state
+    )
+    fix_failed = ~conv
+
+    applied_prefix, chain_ok = chain_state(pass_)
+
+    # ---- result codes.
+    results = jnp.zeros(B, jnp.uint32)
+    bad_chain = ~chain_ok
+    member_bad = bad_chain[chain_id] & active
+    fail_pos = jnp.where(active & ~pass_, iota, B)
+    first_fail = jax.ops.segment_min(fail_pos, chain_id, num_segments=B)
+    ff_of_ev = first_fail[chain_id]
+    own_code = jnp.where(
+        code0 != 0,
+        code0,
+        jnp.where(
+            dr_fail,
+            jnp.uint32(CTR.exceeds_credits),
+            jnp.uint32(CTR.exceeds_debits),
+        ),
+    )
+    results = jnp.where(
+        member_bad, jnp.uint32(CTR.linked_event_failed), results
+    )
+    is_ff = member_bad & (iota == ff_of_ev)
+    results = jnp.where(is_ff, own_code, results)
+    results = jnp.where(
+        is_last & linked & member_bad,
+        jnp.uint32(CTR.linked_event_chain_open),
+        results,
+    )
+
+    # ---- apply (events with results == 0 are exactly the members of
+    # fully-passing chains).
+    okev = active & (results == 0)
+    ap_valid = jnp.concatenate([okev, okev])
+    d_lo, d_hi, limb_ov = _accum_cols(
+        slot_rows, col_rows, amt_lo2, amt_hi2, ap_valid, A
+    )
+    fallback = sup_ov | precond_bad | fix_failed
+    new_table, _ov2 = _admit_apply(table, d_lo, d_hi, limb_ov)
+    new_table = jnp.where(fallback, table, new_table)
+
+    last_applied = jnp.where(applied_prefix & active, iota, -1).max()
+    flags_word = (
+        jnp.where(sup_ov, jnp.uint64(FLAG_OVERFLOW), jnp.uint64(0))
+        | jnp.where(
+            precond_bad | fix_failed, jnp.uint64(FLAG_PRECOND), jnp.uint64(0)
+        )
+        | (iters.astype(jnp.uint64) << jnp.uint64(ITERS_SHIFT))
+    )
+    s = _summary(results, active, flags_word, last_applied)
+    ring = jax.lax.dynamic_update_slice(ring, s[None, :], (ring_at, 0))
+    return new_table, ring
+
+
+# ---------------------------------------------------------------------------
+# Two-phase kernel (port of resolve.two_phase_resolve to device).
+
+
+def _two_phase(table, meta, ring, ring_at, pk, n, ts_base):
+    """Pending-create + post/void batch with balance-independent
+    verdicts (router preconditions: no linked/balancing, all timeouts
+    zero, no limit/history accounts, unique fresh ids).  Closed-form:
+    vectorized ladder + first-wins winner reduction, then scatter
+    apply of adds and releases (reference:
+    src/state_machine.zig:1608-1741)."""
+    ev = _unpack(pk)
+    A = table.shape[0]
+    iota = jnp.arange(B, dtype=jnp.int64)
+    active = iota < n
+    bits = ev["bits"]
+    flags = ev["flags"]
+    is_pv = (flags & (F_POST | F_VOID)) != 0
+    pend_flag = (flags & F_PENDING) != 0
+
+    # --- static ladders (normal for creates, pv prefix for post/void).
+    static_n = _static_ladder_normal(ev, meta, active)
+    post = (flags & F_POST) != 0
+    void = (flags & F_VOID) != 0
+    pv_excl = (
+        (post & void)
+        | (is_pv & ((flags & F_PENDING) != 0))
+        | (is_pv & ((flags & F_BAL_DR) != 0))
+        | (is_pv & ((flags & F_BAL_CR) != 0))
+    )
+    static_pv = _first_nonzero(
+        (_bit(bits, BIT_TS_NONZERO), CTR.timestamp_must_be_zero),
+        ((flags & ~jnp.uint32(0x3F)) != 0, CTR.reserved_flag),
+        (_bit(bits, BIT_ID_ZERO), CTR.id_must_not_be_zero),
+        (_bit(bits, BIT_ID_MAX), CTR.id_must_not_be_int_max),
+        (pv_excl, CTR.flags_are_mutually_exclusive),
+        (~_bit(bits, BIT_PEND_NONZERO), CTR.pending_id_must_not_be_zero),
+        (_bit(bits, BIT_PEND_MAX), CTR.pending_id_must_not_be_int_max),
+        (_bit(bits, BIT_PEND_SELF), CTR.pending_id_must_be_different),
+        (ev["timeout"] != 0, CTR.timeout_reserved_for_pending_transfer),
+    )
+    static_pv = jnp.where(
+        active, static_pv, jnp.uint32(CTR.linked_event_failed)
+    )
+    code = jnp.where(is_pv, static_pv, static_n)
+
+    # --- pv dynamic ladder.
+    tp_join = pk[:, COL_TP_JOIN]
+    p_flags_d = (tp_join & _MASK16).astype(jnp.uint32)
+    p_code_d = ((tp_join >> jnp.uint64(16)) & _MASK16).astype(jnp.uint32)
+    p_ledger_d = (tp_join >> jnp.uint64(32)).astype(jnp.uint32)
+    tp_slots = pk[:, COL_TP_SLOTS]
+    p_dr_slot_d = (tp_slots & _MASK32).astype(jnp.int64) - 1
+    p_cr_slot_d = (tp_slots >> jnp.uint64(32)).astype(jnp.int64) - 1
+    p_amt_lo_d = pk[:, COL_TP_AMT_LO]
+    p_amt_hi_d = pk[:, COL_TP_AMT_HI]
+    tp_ref = pk[:, COL_TP_REF]
+    tgt_ev = (tp_ref & _MASK32).astype(jnp.int64) - 1
+    dstat_init = (tp_ref >> jnp.uint64(32)).astype(jnp.uint32)
+    p_found = _bit(bits, BIT_P_FOUND)
+
+    pv = is_pv & (code == 0)
+    tgt_c = jnp.clip(tgt_ev, 0, B - 1)
+    in_batch = pv & (tgt_ev >= 0) & (tgt_ev < iota)
+    tgt_created = in_batch & (code[tgt_c] == 0)
+    durable = pv & p_found & ~in_batch
+    found = tgt_created | durable
+
+    def app(c, cond, v):
+        return jnp.where((c == 0) & cond & is_pv, jnp.uint32(v), c)
+
+    code = app(code, pv & ~found, CTR.pending_transfer_not_found)
+    p_flags = jnp.where(in_batch, flags[tgt_c], p_flags_d)
+    code = app(
+        code,
+        found & ((p_flags & F_PENDING) == 0),
+        CTR.pending_transfer_not_pending,
+    )
+    # Account-id mismatches: host ships equality predicates (u128 id
+    # compares are stateless byte predicates); validity gating here.
+    code = app(
+        code,
+        found & _bit(bits, BIT_T_DR_SET) & ~_bit(bits, BIT_DR_EQ_P),
+        CTR.pending_transfer_has_different_debit_account_id,
+    )
+    code = app(
+        code,
+        found & _bit(bits, BIT_T_CR_SET) & ~_bit(bits, BIT_CR_EQ_P),
+        CTR.pending_transfer_has_different_credit_account_id,
+    )
+    p_ledger = jnp.where(in_batch, ev["ledger"][tgt_c], p_ledger_d)
+    p_code_t = jnp.where(in_batch, ev["code"][tgt_c], p_code_d)
+    code = app(
+        code,
+        found & (ev["ledger"] > 0) & (ev["ledger"] != p_ledger),
+        CTR.pending_transfer_has_different_ledger,
+    )
+    code = app(
+        code,
+        found & (ev["code"] > 0) & (ev["code"] != p_code_t),
+        CTR.pending_transfer_has_different_code,
+    )
+    p_amt_lo = jnp.where(in_batch, ev["amt_lo"][tgt_c], p_amt_lo_d)
+    p_amt_hi = jnp.where(in_batch, ev["amt_hi"][tgt_c], p_amt_hi_d)
+    t_amt_set = (ev["amt_lo"] != 0) | (ev["amt_hi"] != 0)
+    res_amt_lo = jnp.where(t_amt_set, ev["amt_lo"], p_amt_lo)
+    res_amt_hi = jnp.where(t_amt_set, ev["amt_hi"], p_amt_hi)
+
+    def gt128(a_lo, a_hi, b_lo, b_hi):
+        return (a_hi > b_hi) | ((a_hi == b_hi) & (a_lo > b_lo))
+
+    code = app(
+        code,
+        found & gt128(res_amt_lo, res_amt_hi, p_amt_lo, p_amt_hi),
+        CTR.exceeds_pending_transfer_amount,
+    )
+    code = app(
+        code,
+        found & void & gt128(p_amt_lo, p_amt_hi, res_amt_lo, res_amt_hi),
+        CTR.pending_transfer_has_different_amount,
+    )
+    dstat_ev = jnp.where(durable, dstat_init, jnp.uint32(S_PENDING))
+    code = app(code, durable & (dstat_ev == S_POSTED),
+               CTR.pending_transfer_already_posted)
+    code = app(code, durable & (dstat_ev == S_VOIDED),
+               CTR.pending_transfer_already_voided)
+    code = app(code, durable & (dstat_ev == S_EXPIRED),
+               CTR.pending_transfer_expired)
+
+    # --- first-wins winner per target.
+    cand = pv & (code == 0)
+    p_tgt = ev["p_tgt"]
+    tkey = jnp.where(
+        cand,
+        jnp.where(in_batch, tgt_c, B + jnp.clip(p_tgt, 0, B - 1)),
+        2 * B,
+    )
+    first_idx = jax.ops.segment_min(
+        jnp.where(cand, iota, B), tkey, num_segments=2 * B + 1
+    )
+    winner = cand & (iota == first_idx[tkey])
+    loser = cand & ~winner
+    win_ev = jnp.clip(first_idx[tkey], 0, B - 1)
+    code = jnp.where(
+        loser,
+        jnp.where(
+            post[win_ev],
+            jnp.uint32(CTR.pending_transfer_already_posted),
+            jnp.uint32(CTR.pending_transfer_already_voided),
+        ),
+        code,
+    )
+
+    ok = active & (code == 0)
+
+    # --- apply.  Unified target slots for pv rows.
+    p_drs = jnp.where(in_batch, ev["dr_slot"][tgt_c], p_dr_slot_d)
+    p_crs = jnp.where(in_batch, ev["cr_slot"][tgt_c], p_cr_slot_d)
+    pend_ok = ok & pend_flag
+    plain_ok = ok & ~pend_flag & ~is_pv
+    post_win = ok & winner & post
+
+    # Adds: pending -> dp/cp, plain -> dpo/cpo, post -> dpo/cpo at
+    # target slots.  4B rows.
+    add_slots = jnp.concatenate([
+        ev["dr_slot"], ev["cr_slot"], p_drs, p_crs,
+    ])
+    add_cols = jnp.concatenate([
+        jnp.where(pend_flag, 0, 1), jnp.where(pend_flag, 2, 3),
+        jnp.ones(B, jnp.int32), jnp.full(B, 3, jnp.int32),
+    ])
+    add_amt_lo = jnp.concatenate(
+        [ev["amt_lo"], ev["amt_lo"], res_amt_lo, res_amt_lo]
+    )
+    add_amt_hi = jnp.concatenate(
+        [ev["amt_hi"], ev["amt_hi"], res_amt_hi, res_amt_hi]
+    )
+    add_valid = jnp.concatenate(
+        [pend_ok | plain_ok, pend_ok | plain_ok, post_win, post_win]
+    )
+    d_lo, d_hi, limb_ov = _accum_cols(
+        add_slots, add_cols, add_amt_lo, add_amt_hi, add_valid, A
+    )
+    mid_table, ov = _admit_apply(table, d_lo, d_hi, limb_ov)
+
+    # Releases: winners subtract the pending amount from dp/cp (cannot
+    # underflow: each live pending's amount is contained by invariant).
+    sub_slots = jnp.concatenate([p_drs, p_crs])
+    sub_cols = jnp.concatenate(
+        [jnp.zeros(B, jnp.int32), jnp.full(B, 2, jnp.int32)]
+    )
+    sub_amt_lo = jnp.concatenate([p_amt_lo] * 2)
+    sub_amt_hi = jnp.concatenate([p_amt_hi] * 2)
+    win2 = jnp.concatenate([ok & winner, ok & winner])
+    s_lo, s_hi, s_limb = _accum_cols(
+        sub_slots, sub_cols, sub_amt_lo, sub_amt_hi, win2, A
+    )
+    old_lo = mid_table[:, 0::2]
+    old_hi = mid_table[:, 1::2]
+    n_lo = old_lo - s_lo
+    borrow = (old_lo < s_lo).astype(jnp.uint64)
+    n_hi = old_hi - s_hi - borrow
+    under = (old_hi < s_hi) | ((old_hi == s_hi) & (old_lo < s_lo))
+    final = jnp.stack(
+        [n_lo[:, 0], n_hi[:, 0], n_lo[:, 1], n_hi[:, 1],
+         n_lo[:, 2], n_hi[:, 2], n_lo[:, 3], n_hi[:, 3]],
+        axis=-1,
+    )
+    fallback = ov | s_limb.any() | under.any()
+    new_table = jnp.where(fallback, table, final)
+
+    last_applied = jnp.where(ok, iota, -1).max()
+    flags_word = jnp.where(fallback, jnp.uint64(FLAG_OVERFLOW), jnp.uint64(0))
+    s = _summary(code, active, flags_word, last_applied)
+    ring = jax.lax.dynamic_update_slice(ring, s[None, :], (ring_at, 0))
+    return new_table, ring
+
+
+# ---------------------------------------------------------------------------
+# Auxiliary device ops.
+
+
+def _lookup(table, slots):
+    """Gather balance rows for lookup_accounts: slot < 0 -> zeros."""
+    A = table.shape[0]
+    rows = table[jnp.clip(slots, 0, A - 1)]
+    return jnp.where(slots[:, None] >= 0, rows, jnp.uint64(0))
+
+
+def _apply_deltas(table, packed):
+    """Compact unique (slot, col, delta) modular adds — the exact-path
+    write-behind lane (mirrors kernel_fast._flush_impl)."""
+    A = table.shape[0]
+    slots = packed[0].astype(jnp.int32)
+    cols = packed[1].astype(jnp.int32)
+    dense_lo = (
+        jnp.zeros((A, 4), jnp.uint64)
+        .at[slots, cols]
+        .set(packed[2], mode="drop", unique_indices=True)
+    )
+    dense_hi = (
+        jnp.zeros((A, 4), jnp.uint64)
+        .at[slots, cols]
+        .set(packed[3], mode="drop", unique_indices=True)
+    )
+    old_lo = table[:, 0::2]
+    old_hi = table[:, 1::2]
+    new_lo = old_lo + dense_lo
+    carry = (new_lo < old_lo).astype(jnp.uint64)
+    new_hi = old_hi + dense_hi + carry
+    return jnp.stack(
+        [new_lo[:, 0], new_hi[:, 0], new_lo[:, 1], new_hi[:, 1],
+         new_lo[:, 2], new_hi[:, 2], new_lo[:, 3], new_hi[:, 3]],
+        axis=-1,
+    )
+
+
+def _meta_update(meta, slots, acct_flags, acct_ledger):
+    m = meta.at[slots, 0].set(acct_flags, mode="drop")
+    return m.at[slots, 1].set(acct_ledger, mode="drop")
+
+
+def _checksum(table):
+    """Order-independent table digest: per-column modular sums plus a
+    position-mixed sum (catches transposed rows)."""
+    col_sums = table.sum(axis=0)
+    rows = jnp.arange(table.shape[0], dtype=jnp.uint64)[:, None]
+    mixed = (table * (rows * jnp.uint64(0x9E3779B97F4A7C15) + jnp.uint64(1))).sum(
+        axis=0
+    )
+    return jnp.concatenate([col_sums, mixed])
+
+
+orderfree = jax.jit(_orderfree)
+linked = jax.jit(_linked)
+two_phase = jax.jit(_two_phase)
+lookup = jax.jit(_lookup)
+apply_deltas = jax.jit(_apply_deltas)
+meta_update = jax.jit(_meta_update)
+checksum = jax.jit(_checksum)
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing (wire decoding + stateless predicates + joins).
+
+
+def pack_base(
+    n, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi, pend_lo, pend_hi,
+    amount_lo, amount_hi, flags, ledger, code, timeout, ts_nonzero,
+    dr_slot, cr_slot, e_found, p_found=None, p_tgt=None,
+    n_cols: int = N_COLS,
+):
+    """Build the packed (B, n_cols) u64 input matrix on the host.
+
+    Everything here is wire decoding, stateless byte predicates, and
+    join results — no result-code decisions (those live on device)."""
+    U64M = np.uint64(0xFFFFFFFFFFFFFFFF)
+    pk = np.zeros((B, n_cols), np.uint64)
+    bits = np.zeros(n, np.uint64)
+
+    def setbit(mask, cond):
+        np.bitwise_or(bits, np.where(cond, np.uint64(mask), np.uint64(0)),
+                      out=bits)
+
+    setbit(BIT_TS_NONZERO, ts_nonzero)
+    setbit(BIT_ID_ZERO, (id_lo == 0) & (id_hi == 0))
+    setbit(BIT_ID_MAX, (id_lo == U64M) & (id_hi == U64M))
+    setbit(BIT_DR_ZERO, (dr_lo == 0) & (dr_hi == 0))
+    setbit(BIT_DR_MAX, (dr_lo == U64M) & (dr_hi == U64M))
+    setbit(BIT_CR_ZERO, (cr_lo == 0) & (cr_hi == 0))
+    setbit(BIT_CR_MAX, (cr_lo == U64M) & (cr_hi == U64M))
+    setbit(BIT_SAME_ACCT, (dr_lo == cr_lo) & (dr_hi == cr_hi))
+    setbit(BIT_PEND_NONZERO, (pend_lo != 0) | (pend_hi != 0))
+    setbit(BIT_PEND_MAX, (pend_lo == U64M) & (pend_hi == U64M))
+    setbit(BIT_PEND_SELF, (pend_lo == id_lo) & (pend_hi == id_hi))
+    setbit(BIT_E_FOUND, e_found)
+    if p_found is not None:
+        setbit(BIT_P_FOUND, p_found)
+    pk[:n, COL_BITS] = bits
+    pk[:n, COL_SLOTS] = (
+        (dr_slot.astype(np.int64) + 1).astype(np.uint64)
+        | ((cr_slot.astype(np.int64) + 1).astype(np.uint64) << np.uint64(32))
+    )
+    pk[:n, COL_AMT_LO] = amount_lo
+    pk[:n, COL_AMT_HI] = amount_hi
+    pk[:n, COL_MISC] = (
+        flags.astype(np.uint64)
+        | (code.astype(np.uint64) << np.uint64(16))
+        | (ledger.astype(np.uint64) << np.uint64(32))
+    )
+    tcol = timeout.astype(np.uint64)
+    if p_tgt is not None:
+        tcol = tcol | (
+            (p_tgt.astype(np.int64) + 1).astype(np.uint64) << np.uint64(32)
+        )
+    pk[:n, COL_TIMEOUT] = tcol
+    return pk
+
+
+def pack_two_phase_ext(
+    pk, n, bits_extra_mask,
+    p_flags, p_code, p_ledger, p_dr_slot, p_cr_slot,
+    p_amt_lo, p_amt_hi, tgt_ev, dstat_init_ev,
+):
+    """Fill the two-phase join columns (durable target fields) and OR
+    extra predicate bits into COL_BITS."""
+    pk[:n, COL_BITS] |= bits_extra_mask
+    pk[:n, COL_TP_JOIN] = (
+        p_flags.astype(np.uint64)
+        | (p_code.astype(np.uint64) << np.uint64(16))
+        | (p_ledger.astype(np.uint64) << np.uint64(32))
+    )
+    pk[:n, COL_TP_SLOTS] = (
+        (p_dr_slot.astype(np.int64) + 1).astype(np.uint64)
+        | ((p_cr_slot.astype(np.int64) + 1).astype(np.uint64) << np.uint64(32))
+    )
+    pk[:n, COL_TP_AMT_LO] = p_amt_lo
+    pk[:n, COL_TP_AMT_HI] = p_amt_hi
+    pk[:n, COL_TP_REF] = (
+        (tgt_ev.astype(np.int64) + 1).astype(np.uint64)
+        | (dstat_init_ev.astype(np.uint64) << np.uint64(32))
+    )
+    return pk
+
+
+def unpack_summary(row: np.ndarray) -> dict:
+    """Decode one (SUMMARY_WORDS,) u64 summary row."""
+    n_fail = int(row[0])
+    flags = int(row[1])
+    entries = row[4 : 4 + min(n_fail, FAIL_CAP)]
+    idx = (entries >> np.uint64(32)).astype(np.int64)
+    codes = (entries & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return {
+        "n_fail": n_fail,
+        "overflow": bool(flags & FLAG_OVERFLOW),
+        "cap_exceeded": bool(flags & FLAG_CAP) or n_fail > FAIL_CAP,
+        "precond": bool(flags & FLAG_PRECOND),
+        "iters": flags >> ITERS_SHIFT,
+        "last_applied": int(row[2]) - 1,
+        "n_active": int(row[3]),
+        "fail_idx": idx,
+        "fail_codes": codes,
+    }

@@ -300,13 +300,30 @@ class TpuStateMachine:
         config: cfg.Config = cfg.PRODUCTION,
         account_capacity: int = 1 << 16,
         transfer_capacity: int = 1 << 16,
+        engine: str | None = None,
     ) -> None:
         """Capacities follow the reference's static-allocation design:
         all large buffers are sized up front from operator-configured
         limits (reference: docs/DESIGN.md static allocation;
         src/config.zig storage limits), so the steady-state commit path
-        never grows or faults fresh pages."""
+        never grows or faults fresh pages.
+
+        `engine` selects the create_transfers execution authority:
+        - "host" (default): host C++/numpy resolvers compute result
+          codes; the device table is a write-behind replica
+          (round-3 architecture — lowest latency on this link).
+        - "device": result codes are computed ON the TPU by the
+          semantic kernels (device_kernels.py) through the pipelined
+          DeviceEngine; the host mirror is demoted to bookkeeping,
+          recovery, and checkpoint parity.  Replies materialize
+          asynchronously (commit_async); commit() drains.
+        Override via TB_ENGINE env var.
+        """
+        import os as _os
+
         self.config = config
+        self.engine = engine or _os.environ.get("TB_ENGINE", "host")
+        assert self.engine in ("host", "device"), self.engine
         self.prepare_timestamp = 0
         self.commit_timestamp = 0
         self.pulse_next_timestamp = TIMESTAMP_MIN
@@ -316,8 +333,15 @@ class TpuStateMachine:
         # blocking on the device link (see mirror.py / kernel_fast.py).
         self._acct_dir = RunIndex(_dir_capacity(account_capacity))
         self._attrs = Columns(_ATTR_FIELDS, capacity=max(1024, account_capacity))
-        self._dev = kernel_fast.DeviceTable(account_capacity)
         self._mirror = BalanceMirror(account_capacity)
+        if self.engine == "device":
+            from tigerbeetle_tpu.state_machine.device_engine import (
+                DeviceEngine,
+            )
+
+            self._dev = DeviceEngine(account_capacity, self._mirror)
+        else:
+            self._dev = kernel_fast.DeviceTable(account_capacity)
         # Native C++ fast path (native/tb_fastpath.cpp): wire decode,
         # static ladder, account resolution, duplicate detection and
         # u128 overflow admission run natively; the balance mirror is
@@ -362,11 +386,24 @@ class TpuStateMachine:
         # serial exact engine (host).
         self.stat_device_events = 0
         self.stat_exact_events = 0
+        # Device-SEMANTIC split (VERDICT r3 #1e): events whose result
+        # codes were computed by a device kernel (the
+        # stat_device_semantic_events property) vs on the host.
+        self.stat_host_semantic_events = 0
+        self.stat_fallback_events = 0
+        self._inflight_timeouts = False
         # Vectorized order-dependent resolution (resolve.py): batches
         # routed + fixpoint iterations spent (perf observability).
         self.stat_linked_batches = 0
         self.stat_two_phase_batches = 0
         self.stat_resolve_iters = 0
+
+    @property
+    def stat_device_semantic_events(self) -> int:
+        """Events whose result codes were computed on device."""
+        return (
+            self._dev.stat_semantic_events if self.engine == "device" else 0
+        )
 
     @property
     def _balances(self):
@@ -380,6 +417,34 @@ class TpuStateMachine:
     def sync(self) -> None:
         """Drain the write-behind queue and wait for the device."""
         jax.block_until_ready(self._dev.read())
+
+    def _engine_drain(self) -> None:
+        if self.engine == "device":
+            self._dev.drain()
+
+    def verify_device_mirror(self) -> None:
+        """Compare the device balance table against the host mirror via
+        an order-independent digest; crash loudly on divergence
+        (VERDICT r3 #4).  Called from the checkpoint barrier."""
+        from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+        dev_sum = np.asarray(dk.checksum(self._dev.read()))
+        cap = self._dev.balances.shape[0]
+        table = np.zeros((cap, 8), np.uint64)
+        ncount = min(len(self._mirror.lo), cap)
+        table[:ncount, 0::2] = self._mirror.lo[:ncount]
+        table[:ncount, 1::2] = self._mirror.hi[:ncount]
+        col_sums = table.sum(axis=0, dtype=np.uint64)
+        rows = np.arange(cap, dtype=np.uint64)[:, None]
+        mixed = (
+            table * (rows * np.uint64(0x9E3779B97F4A7C15) + np.uint64(1))
+        ).sum(axis=0, dtype=np.uint64)
+        host_sum = np.concatenate([col_sums, mixed])
+        if not (dev_sum == host_sum).all():
+            raise AssertionError(
+                "device/mirror balance divergence at checkpoint: "
+                f"device={dev_sum.tolist()} host={host_sum.tolist()}"
+            )
 
     # ------------------------------------------------------------------
     # LSM spill tier (replica mode).
@@ -516,12 +581,27 @@ class TpuStateMachine:
         CpuStateMachine.prepare(self, operation, input_bytes)
 
     def pulse_needed(self) -> bool:
+        # In device mode an in-flight batch may be about to create a
+        # timeout-carrying pending, which would pull
+        # pulse_next_timestamp earlier — drain before deciding so the
+        # pulse schedule matches the oracle exactly.  Timeout batches
+        # are routed to the host path anyway, so this only fires when
+        # such a batch is genuinely in flight.
+        if (
+            self.engine == "device"
+            and self._inflight_timeouts
+            and self._dev.has_inflight()
+        ):
+            self._engine_drain()
+        if self.engine == "device" and not self._dev.has_inflight():
+            self._inflight_timeouts = False
         return self.pulse_next_timestamp <= self.prepare_timestamp
 
     def prefetch(
         self, operation: Operation, input_bytes: bytes, prefetch_timestamp: int
     ) -> None:
         if operation == Operation.pulse:
+            self._engine_drain()
             self._expiry_rows = self._scan_expired(prefetch_timestamp)
 
     def commit(
@@ -532,23 +612,58 @@ class TpuStateMachine:
         operation: Operation,
         input_bytes: bytes,
     ) -> bytes:
+        return self.commit_async(
+            client, op, timestamp, operation, input_bytes
+        ).result()
+
+    def commit_async(
+        self,
+        client: int,
+        op: int,
+        timestamp: int,
+        operation: Operation,
+        input_bytes: bytes,
+    ):
+        """Dispatch one committed operation; returns a ReplyFuture.
+
+        In host-engine mode every reply resolves synchronously.  In
+        device mode create_transfers batches (and lookup_accounts
+        balance gathers) resolve when their summary/gather rides the
+        next ring fetch — the pipelined path the benchmark and the
+        replica drive (reference: the reference client pipelines
+        batches the same way, src/clients/c/tb_client/packet.zig).
+        """
+        from tigerbeetle_tpu.state_machine.device_engine import ReplyFuture
+
         assert op != 0
         assert self.input_valid(operation, input_bytes)
         assert timestamp > self.commit_timestamp
-        if operation == Operation.pulse:
-            return self._commit_expire(timestamp)
-        if operation == Operation.create_accounts:
-            return self._commit_create_accounts(timestamp, input_bytes)
         if operation == Operation.create_transfers:
-            return self._commit_create_transfers(timestamp, input_bytes)
+            if self.engine == "device":
+                return self._commit_create_transfers_device(
+                    timestamp, input_bytes
+                )
+            return ReplyFuture(
+                value=self._commit_create_transfers(timestamp, input_bytes)
+            )
         if operation == Operation.lookup_accounts:
-            return self._lookup_accounts(input_bytes)
+            if self.engine == "device" and self._dev.has_inflight():
+                return self._lookup_accounts_device(input_bytes)
+            return ReplyFuture(value=self._lookup_accounts(input_bytes))
+        if operation == Operation.pulse:
+            return ReplyFuture(value=self._commit_expire(timestamp))
+        if operation == Operation.create_accounts:
+            return ReplyFuture(
+                value=self._commit_create_accounts(timestamp, input_bytes)
+            )
+        # Store-reading queries: exact only against materialized state.
+        self._engine_drain()
         if operation == Operation.lookup_transfers:
-            return self._lookup_transfers(input_bytes)
+            return ReplyFuture(value=self._lookup_transfers(input_bytes))
         if operation == Operation.get_account_transfers:
-            return self._get_account_transfers(input_bytes)
+            return ReplyFuture(value=self._get_account_transfers(input_bytes))
         if operation == Operation.get_account_balances:
-            return self._get_account_balances(input_bytes)
+            return ReplyFuture(value=self._get_account_balances(input_bytes))
         raise AssertionError(operation)
 
     # ------------------------------------------------------------------
@@ -561,12 +676,26 @@ class TpuStateMachine:
         )
         return int(slot[0]) if found[0] else None
 
+    def _sync_engine_meta(self, n0: int) -> None:
+        """Register accounts created since slot n0 with the device
+        engine's meta table (device-mode ladder/limit inputs)."""
+        if self.engine != "device" or self._attrs.count <= n0:
+            return
+        slots = np.arange(n0, self._attrs.count, dtype=np.int64)
+        self._dev.add_accounts(
+            slots,
+            self._attrs.col("flags")[n0:],
+            self._attrs.col("ledger")[n0:],
+        )
+
     def _commit_create_accounts(self, timestamp: int, input_bytes: bytes) -> bytes:
         events = np.frombuffer(input_bytes, dtype=ACCOUNT_DTYPE)
         n = len(events)
+        n0 = self._attrs.count
 
         reply = self._commit_create_accounts_fast(timestamp, events, n)
         if reply is not None:
+            self._sync_engine_meta(n0)
             return reply
         results: list[tuple[int, int]] = []
 
@@ -693,6 +822,7 @@ class TpuStateMachine:
                 chain_broken = False
 
         self._ensure_balance_capacity(self._attrs.count)
+        self._sync_engine_meta(n0)
 
         out = np.zeros(len(results), dtype=CREATE_RESULT_DTYPE)
         for i, (index, result) in enumerate(results):
@@ -839,11 +969,610 @@ class TpuStateMachine:
     # ------------------------------------------------------------------
     # create_transfers (the hot path).
 
+    # ------------------------------------------------------------------
+    # Device-authoritative create_transfers (engine == "device").
+
+    def _commit_create_transfers_device(self, timestamp: int, input_bytes: bytes):
+        """Route a batch to a device semantic kernel; host does joins,
+        the device computes result codes (VERDICT r3 #1).  Falls back
+        to the (drained) host path for shapes outside the kernels'
+        classes — the same residual classes the r3 fast paths punted.
+        """
+        from tigerbeetle_tpu.state_machine import device_kernels as dk
+        from tigerbeetle_tpu.state_machine.device_engine import ReplyFuture
+
+        events = np.frombuffer(input_bytes, dtype=TRANSFER_DTYPE)
+        n = len(events)
+        ts_base = timestamp - n + 1
+
+        def host_path() -> ReplyFuture:
+            self._engine_drain()
+            return ReplyFuture(
+                value=self._commit_create_transfers(timestamp, input_bytes)
+            )
+
+        if n == 0 or n > dk.B:
+            return host_path()
+
+        id_lo = np.asarray(events["id_lo"])
+        id_hi = np.asarray(events["id_hi"])
+        flags16 = np.asarray(events["flags"])
+        flags = flags16.astype(np.uint32)
+        timeout = events["timeout"].astype(np.uint64)
+        amount_hi = np.asarray(events["amount_hi"])
+
+        has_linked = bool((flags16 & np.uint16(TF.linked)).any())
+        has_pending = bool((flags16 & np.uint16(TF.pending)).any())
+        pv16 = np.uint16(TF.post_pending_transfer | TF.void_pending_transfer)
+        has_pv = bool((flags16 & pv16).any())
+        has_bal = bool(
+            (flags16 & np.uint16(TF.balancing_debit | TF.balancing_credit)).any()
+        )
+
+        # Unique-id check (shared with the host router): ascending ids
+        # prove uniqueness; else a 64-bit key mix.
+        ascending = n == 1 or bool(
+            (
+                (id_hi[1:] > id_hi[:-1])
+                | ((id_hi[1:] == id_hi[:-1]) & (id_lo[1:] > id_lo[:-1]))
+            ).all()
+        )
+        if ascending:
+            ids_unique = True
+        else:
+            mix = id_lo * np.uint64(0x9E3779B97F4A7C15) + id_hi * np.uint64(
+                0xC2B2AE3D27D4EB4F
+            )
+            ids_unique = len(np.unique(mix)) == n
+        if not ids_unique or has_bal:
+            return host_path()
+
+        # In-flight hazards: this batch's ids (duplicate checks) and —
+        # for pv batches — its pending references must not collide
+        # with batches whose bookkeeping hasn't materialized yet.  A pv
+        # batch also RECORDS its pending-reference keys so a later
+        # pipelined finalize of the same durable pending drains instead
+        # of reading a stale status join (double-finalize hazard).
+        keys = pack_u128(id_lo, id_hi)
+        probe = keys
+        if has_pv:
+            probe = np.concatenate(
+                [
+                    probe,
+                    pack_u128(
+                        np.asarray(events["pending_id_lo"]),
+                        np.asarray(events["pending_id_hi"]),
+                    ),
+                ]
+            )
+        keys_sorted = np.sort(probe) if (has_pv or not ascending) else keys
+        if self._dev.inflight_ids_hit(probe):
+            self._engine_drain()
+
+        e_found, _e_row = self._tdir.lookup(id_lo, id_hi)
+        if e_found.any():
+            return host_path()
+
+        # Account joins (slots + flags for routing).
+        dr_lo = np.asarray(events["debit_account_id_lo"])
+        dr_hi = np.asarray(events["debit_account_id_hi"])
+        cr_lo = np.asarray(events["credit_account_id_lo"])
+        cr_hi = np.asarray(events["credit_account_id_hi"])
+        dr_found, dr_slot_u = self._acct_dir.lookup(dr_lo, dr_hi)
+        cr_found, cr_slot_u = self._acct_dir.lookup(cr_lo, cr_hi)
+        dr_slot = np.where(dr_found, dr_slot_u.astype(np.int64), -1)
+        cr_slot = np.where(cr_found, cr_slot_u.astype(np.int64), -1)
+        attrs = self._attrs
+        dr_flags = np.where(
+            dr_found, attrs["flags"][np.clip(dr_slot, 0, None)], 0
+        ).astype(np.uint32)
+        cr_flags = np.where(
+            cr_found, attrs["flags"][np.clip(cr_slot, 0, None)], 0
+        ).astype(np.uint32)
+        LIMH = np.uint32(
+            AF.debits_must_not_exceed_credits
+            | AF.credits_must_not_exceed_debits
+            | AF.history
+        )
+        touch_limit_hist = bool(((dr_flags | cr_flags) & LIMH).any())
+        touch_hist = bool(
+            ((dr_flags | cr_flags) & np.uint32(AF.history)).any()
+        )
+
+        common = dict(
+            events=events, n=n, ts_base=ts_base, id_lo=id_lo, id_hi=id_hi,
+            dr_lo=dr_lo, dr_hi=dr_hi, cr_lo=cr_lo, cr_hi=cr_hi,
+            flags=flags, timeout=timeout, dr_slot=dr_slot, cr_slot=cr_slot,
+            keys_sorted=keys_sorted, timestamp=timestamp,
+            input_bytes=input_bytes,
+        )
+
+        if not (has_linked or has_pv) and not touch_limit_hist:
+            return self._submit_device_orderfree(**common)
+        if (
+            has_linked
+            and not (has_pending or has_pv)
+            and not touch_hist
+            and not amount_hi.any()
+        ):
+            return self._submit_device_linked(**common)
+        if has_pv and not has_linked and not timeout.any() and not touch_limit_hist:
+            fut = self._submit_device_two_phase(**common)
+            if fut is not None:
+                return fut
+        return host_path()
+
+    def _device_pack_base(
+        self, n, events, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
+        flags, timeout, dr_slot, cr_slot, p_found=None, p_tgt=None,
+        n_cols=None,
+    ):
+        from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+        return dk.pack_base(
+            n, id_lo=id_lo, id_hi=id_hi,
+            dr_lo=dr_lo, dr_hi=dr_hi, cr_lo=cr_lo, cr_hi=cr_hi,
+            pend_lo=np.asarray(events["pending_id_lo"]),
+            pend_hi=np.asarray(events["pending_id_hi"]),
+            amount_lo=np.asarray(events["amount_lo"]),
+            amount_hi=np.asarray(events["amount_hi"]),
+            flags=flags, ledger=np.asarray(events["ledger"]),
+            code=events["code"].astype(np.uint32),
+            timeout=events["timeout"].astype(np.uint32),
+            ts_nonzero=np.asarray(events["timestamp"] != 0),
+            dr_slot=dr_slot, cr_slot=cr_slot,
+            e_found=np.zeros(n, bool),  # router guarantees no dups
+            p_found=p_found, p_tgt=p_tgt,
+            n_cols=n_cols or dk.N_COLS,
+        )
+
+    def _device_fallback(self, timestamp, input_bytes):
+        """Exact host re-execution for a flagged batch (engine has
+        drained up to the batch before it; mirror is current)."""
+
+        def run() -> bytes:
+            self.stat_fallback_events = getattr(
+                self, "stat_fallback_events", 0
+            ) + len(input_bytes) // TRANSFER_DTYPE.itemsize
+            self._dev._suppress_enqueue = True
+            try:
+                return self._commit_create_transfers(timestamp, input_bytes)
+            finally:
+                self._dev._suppress_enqueue = False
+
+        return run
+
+    def _submit_device_orderfree(
+        self, events, n, ts_base, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
+        flags, timeout, dr_slot, cr_slot, keys_sorted, timestamp, input_bytes,
+    ):
+        pk = self._device_pack_base(
+            n, events, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
+            flags, timeout, dr_slot, cr_slot,
+        )
+        if timeout.any():
+            self._inflight_timeouts = True
+        amount_lo = np.asarray(events["amount_lo"])
+        amount_hi = np.asarray(events["amount_hi"])
+        created = {
+            "flags": flags,
+            "dr_slot": dr_slot.astype(np.int32),
+            "cr_slot": cr_slot.astype(np.int32),
+            "amount_lo": amount_lo, "amount_hi": amount_hi,
+            "pending_lo": np.asarray(events["pending_id_lo"]),
+            "pending_hi": np.asarray(events["pending_id_hi"]),
+            "ud128_lo": np.asarray(events["user_data_128_lo"]),
+            "ud128_hi": np.asarray(events["user_data_128_hi"]),
+            "ud64": np.asarray(events["user_data_64"]),
+            "ud32": np.asarray(events["user_data_32"]),
+            "timeout": timeout,
+            "ledger": np.asarray(events["ledger"]),
+            "code": events["code"].astype(np.uint32),
+        }
+
+        def finish(summary) -> bytes:
+            results = np.zeros(n, np.uint32)
+            results[summary["fail_idx"]] = summary["fail_codes"]
+            apply_mask = results == 0
+            is_pending = (flags & np.uint32(TF.pending)) != 0
+            # Mirror bookkeeping doubles as a free admission parity
+            # check: the device admitted, so this can never refuse.
+            deltas = self._mirror.try_apply_adds(
+                dr_slot, cr_slot, amount_lo, amount_hi, is_pending,
+                apply_mask,
+            )
+            assert deltas is not None, "device/mirror admission divergence"
+            return self._finish_fast(
+                n, ts_base, id_lo, id_hi, flags, timeout, results, created,
+                last_applied=summary["last_applied"],
+            )
+
+        return self._dev.submit(
+            "orderfree", pk, n, ts_base, finish,
+            self._device_fallback(timestamp, input_bytes),
+            id_keys=keys_sorted,
+        )
+
+    def _submit_device_linked(
+        self, events, n, ts_base, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
+        flags, timeout, dr_slot, cr_slot, keys_sorted, timestamp, input_bytes,
+    ):
+        pk = self._device_pack_base(
+            n, events, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
+            flags, timeout, dr_slot, cr_slot,
+        )
+        amount_lo = np.asarray(events["amount_lo"])
+        amount_hi = np.asarray(events["amount_hi"])
+        created = {
+            "flags": flags,
+            "dr_slot": dr_slot.astype(np.int32),
+            "cr_slot": cr_slot.astype(np.int32),
+            "amount_lo": amount_lo, "amount_hi": amount_hi,
+            "pending_lo": np.zeros(n, np.uint64),
+            "pending_hi": np.zeros(n, np.uint64),
+            "ud128_lo": np.asarray(events["user_data_128_lo"]),
+            "ud128_hi": np.asarray(events["user_data_128_hi"]),
+            "ud64": np.asarray(events["user_data_64"]),
+            "ud32": np.asarray(events["user_data_32"]),
+            "timeout": timeout,
+            "ledger": np.asarray(events["ledger"]),
+            "code": events["code"].astype(np.uint32),
+        }
+
+        def finish(summary) -> bytes:
+            results = np.zeros(n, np.uint32)
+            results[summary["fail_idx"]] = summary["fail_codes"]
+            self.stat_linked_batches += 1
+            self.stat_resolve_iters += summary["iters"]
+            deltas = self._mirror.try_apply_adds(
+                dr_slot, cr_slot, amount_lo, amount_hi,
+                np.zeros(n, bool), results == 0,
+            )
+            assert deltas is not None, "device/mirror admission divergence"
+            return self._finish_fast(
+                n, ts_base, id_lo, id_hi, flags, timeout, results, created,
+                last_applied=summary["last_applied"],
+            )
+
+        return self._dev.submit(
+            "linked", pk, n, ts_base, finish,
+            self._device_fallback(timestamp, input_bytes),
+            id_keys=keys_sorted,
+        )
+
+    def _submit_device_two_phase(
+        self, events, n, ts_base, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
+        flags, timeout, dr_slot, cr_slot, keys_sorted, timestamp, input_bytes,
+    ):
+        """Build two-phase join columns and dispatch; None -> host path
+        (same residual class the r3 host router punted to the serial
+        exact engine)."""
+        from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+        pend_lo = np.asarray(events["pending_id_lo"])
+        pend_hi = np.asarray(events["pending_id_hi"])
+        is_pv = (flags & np.uint32(TF.post_pending_transfer | TF.void_pending_transfer)) != 0
+
+        # In-batch pending references (ids unique -> creator is the
+        # unique event with that id).
+        id_key = pack_u128(id_lo, id_hi)
+        order = np.argsort(id_key, kind="stable")
+        sorted_keys = id_key[order]
+        pend_key = pack_u128(pend_lo, pend_hi)
+        pos = np.searchsorted(sorted_keys, pend_key)
+        pos_c = np.minimum(pos, n - 1)
+        tgt_ev = np.where(
+            is_pv & (sorted_keys[pos_c] == pend_key), order[pos_c], -1
+        ).astype(np.int64)
+        idx = np.arange(n)
+        ib = is_pv & (tgt_ev >= 0) & (tgt_ev < idx)
+        # Keep r3 routing parity: an in-batch reference to a
+        # non-pending create goes to the serial exact engine.
+        if (
+            ib
+            & ((flags[np.clip(tgt_ev, 0, None)] & np.uint32(TF.pending)) == 0)
+        ).any():
+            return None
+
+        # Durable pending-target join.
+        if is_pv.any():
+            p_found, p_row = self._tdir.lookup(pend_lo, pend_hi)
+            p_found = p_found & is_pv & ~ib
+        else:
+            p_found = np.zeros(n, bool)
+            p_row = np.zeros(n, np.uint64)
+        p_rows_valid = p_row[p_found].astype(np.int64)
+        if len(p_rows_valid):
+            uniq_rows, first_idx, tgt_inverse = np.unique(
+                p_rows_valid, return_index=True, return_inverse=True
+            )
+            join = self._store.gather_many(
+                [
+                    "flags", "dr_slot", "cr_slot", "amount_lo", "amount_hi",
+                    "ledger", "code", "ud128_lo", "ud128_hi", "ud64", "ud32",
+                    "timeout", "status",
+                ],
+                uniq_rows,
+            )
+            if (join["timeout"] != 0).any():
+                return None
+            pj_dr_u = np.clip(join["dr_slot"].astype(np.int64), 0, None)
+            pj_cr_u = np.clip(join["cr_slot"].astype(np.int64), 0, None)
+            LIMH = np.uint32(
+                AF.debits_must_not_exceed_credits
+                | AF.credits_must_not_exceed_debits
+                | AF.history
+            )
+            pj_acct_flags = (
+                self._attrs["flags"][pj_dr_u] | self._attrs["flags"][pj_cr_u]
+            ).astype(np.uint32)
+            if (pj_acct_flags & LIMH).any():
+                return None
+            p_tgt = np.full(n, -1, np.int64)
+            p_tgt[p_found] = tgt_inverse
+            uniq_status = join["status"].astype(np.uint32)
+
+            def jcol(name, dtype):
+                out = np.zeros(n, dtype)
+                out[p_found] = join[name][tgt_inverse].astype(dtype)
+                return out
+
+        else:
+            uniq_rows = np.zeros(0, np.int64)
+            uniq_status = np.zeros(0, np.uint32)
+            p_tgt = np.full(n, -1, np.int64)
+
+            def jcol(name, dtype):
+                return np.zeros(n, dtype)
+
+        pk = self._device_pack_base(
+            n, events, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
+            flags, timeout, dr_slot, cr_slot,
+            p_found=p_found, p_tgt=p_tgt, n_cols=dk.N_COLS_TP,
+        )
+        # Target account-id equality predicates (host marshaling: u128
+        # byte compares against in-batch events or durable attrs).
+        tgt_c = np.clip(tgt_ev, 0, None)
+        pj_dr_slot = jcol("dr_slot", np.int64)
+        pj_cr_slot = jcol("cr_slot", np.int64)
+        p_drs = np.where(ib, dr_slot[tgt_c], pj_dr_slot)
+        p_crs = np.where(ib, cr_slot[tgt_c], pj_cr_slot)
+        pd = np.clip(p_drs, 0, None)
+        pc = np.clip(p_crs, 0, None)
+        p_dr_id_lo = self._attrs["id_lo"][pd]
+        p_dr_id_hi = self._attrs["id_hi"][pd]
+        p_cr_id_lo = self._attrs["id_lo"][pc]
+        p_cr_id_hi = self._attrs["id_hi"][pc]
+        t_dr_set = (dr_lo != 0) | (dr_hi != 0)
+        t_cr_set = (cr_lo != 0) | (cr_hi != 0)
+        dr_eq = (dr_lo == p_dr_id_lo) & (dr_hi == p_dr_id_hi)
+        cr_eq = (cr_lo == p_cr_id_lo) & (cr_hi == p_cr_id_hi)
+        bits_extra = (
+            np.where(t_dr_set, np.uint64(dk.BIT_T_DR_SET), np.uint64(0))
+            | np.where(t_cr_set, np.uint64(dk.BIT_T_CR_SET), np.uint64(0))
+            | np.where(dr_eq, np.uint64(dk.BIT_DR_EQ_P), np.uint64(0))
+            | np.where(cr_eq, np.uint64(dk.BIT_CR_EQ_P), np.uint64(0))
+        )
+        p_amt_lo_d = jcol("amount_lo", np.uint64)
+        p_amt_hi_d = jcol("amount_hi", np.uint64)
+        dstat_ev = np.zeros(n, np.uint32)
+        if len(uniq_rows):
+            dstat_ev[p_found] = uniq_status[p_tgt[p_found]]
+        pk = dk.pack_two_phase_ext(
+            pk, n, bits_extra_mask=bits_extra,
+            p_flags=jcol("flags", np.uint32).astype(np.uint16),
+            p_code=jcol("code", np.uint32).astype(np.uint16),
+            p_ledger=jcol("ledger", np.uint32),
+            p_dr_slot=pj_dr_slot, p_cr_slot=pj_cr_slot,
+            p_amt_lo=p_amt_lo_d, p_amt_hi=p_amt_hi_d,
+            tgt_ev=tgt_ev, dstat_init_ev=dstat_ev,
+        )
+        amount_lo = np.asarray(events["amount_lo"])
+        amount_hi = np.asarray(events["amount_hi"])
+        p_amt_lo = np.where(ib, amount_lo[tgt_c], p_amt_lo_d)
+        p_amt_hi = np.where(ib, amount_hi[tgt_c], p_amt_hi_d)
+        ud128_lo = np.asarray(events["user_data_128_lo"])
+        ud128_hi = np.asarray(events["user_data_128_hi"])
+        ud64 = np.asarray(events["user_data_64"])
+        ud32 = np.asarray(events["user_data_32"]).astype(np.uint32)
+        ledger_arr = np.asarray(events["ledger"])
+        code_arr = events["code"].astype(np.uint32)
+        pend_flag = (flags & np.uint32(TF.pending)) != 0
+        post = (flags & np.uint32(TF.post_pending_transfer)) != 0
+
+        ctx = dict(
+            n=n, ts_base=ts_base, is_pv=is_pv, ib=ib, tgt_ev=tgt_ev,
+            p_drs=p_drs, p_crs=p_crs, p_amt_lo=p_amt_lo, p_amt_hi=p_amt_hi,
+            p_ud128_lo=np.where(ib, ud128_lo[tgt_c], jcol("ud128_lo", np.uint64)),
+            p_ud128_hi=np.where(ib, ud128_hi[tgt_c], jcol("ud128_hi", np.uint64)),
+            p_ud64=np.where(ib, ud64[tgt_c], jcol("ud64", np.uint64)),
+            p_ud32=np.where(ib, ud32[tgt_c], jcol("ud32", np.uint32)),
+            p_ledger=np.where(
+                ib, ledger_arr[tgt_c].astype(np.uint32), jcol("ledger", np.uint32)
+            ),
+            p_code=np.where(ib, code_arr[tgt_c], jcol("code", np.uint32)),
+            uniq_rows=uniq_rows, uniq_status=uniq_status, p_tgt=p_tgt,
+            pend_flag=pend_flag, post=post,
+        )
+
+        def finish(summary) -> bytes:
+            return self._finish_device_two_phase(
+                summary, events, id_lo, id_hi, flags, timeout,
+                amount_lo, amount_hi, pend_lo, pend_hi,
+                ud128_lo, ud128_hi, ud64, ud32, ledger_arr, code_arr,
+                dr_slot, cr_slot, ctx,
+            )
+
+        self.stat_two_phase_batches += 1
+        return self._dev.submit(
+            "two_phase", pk, n, ts_base, finish,
+            self._device_fallback(timestamp, input_bytes),
+            id_keys=keys_sorted,
+        )
+
+    def _finish_device_two_phase(
+        self, summary, events, id_lo, id_hi, flags, timeout,
+        amount_lo, amount_hi, pend_lo, pend_hi,
+        ud128_lo, ud128_hi, ud64, ud32, ledger_arr, code_arr,
+        dr_slot, cr_slot, ctx,
+    ) -> bytes:
+        """Bookkeeping from device codes (mirrors the tail of
+        _try_two_phase_fast, with verdicts arriving from the kernel)."""
+        n = ctx["n"]
+        ts_base = ctx["ts_base"]
+        is_pv = ctx["is_pv"]
+        results = np.zeros(n, np.uint32)
+        results[summary["fail_idx"]] = summary["fail_codes"]
+        ok = results == 0
+        winner = ok & is_pv
+        post = ctx["post"]
+        pend_flag = ctx["pend_flag"]
+        p_drs, p_crs = ctx["p_drs"], ctx["p_crs"]
+        p_amt_lo, p_amt_hi = ctx["p_amt_lo"], ctx["p_amt_hi"]
+        t_amt_set = (amount_lo != 0) | (amount_hi != 0)
+        res_amt_lo = np.where(is_pv & ~t_amt_set, p_amt_lo, amount_lo)
+        res_amt_hi = np.where(is_pv & ~t_amt_set, p_amt_hi, amount_hi)
+
+        # Mirror bookkeeping (device already applied; these asserts are
+        # the admission-parity tripwire).
+        pend_ok = ok & pend_flag
+        plain_ok = ok & ~pend_flag & ~is_pv
+        post_win = winner & post
+        add_slots = np.concatenate([
+            dr_slot[pend_ok], cr_slot[pend_ok],
+            dr_slot[plain_ok], cr_slot[plain_ok],
+            p_drs[post_win], p_crs[post_win],
+        ])
+        n_pend = int(pend_ok.sum())
+        n_plain = int(plain_ok.sum())
+        n_post = int(post_win.sum())
+        add_cols = np.concatenate([
+            np.zeros(n_pend, np.int64), np.full(n_pend, 2, np.int64),
+            np.ones(n_plain, np.int64), np.full(n_plain, 3, np.int64),
+            np.ones(n_post, np.int64), np.full(n_post, 3, np.int64),
+        ])
+        add_lo = np.concatenate([
+            amount_lo[pend_ok], amount_lo[pend_ok],
+            amount_lo[plain_ok], amount_lo[plain_ok],
+            res_amt_lo[post_win], res_amt_lo[post_win],
+        ])
+        add_hi = np.concatenate([
+            amount_hi[pend_ok], amount_hi[pend_ok],
+            amount_hi[plain_ok], amount_hi[plain_ok],
+            res_amt_hi[post_win], res_amt_hi[post_win],
+        ])
+        deltas = self._mirror.try_apply_deltas(
+            add_slots, add_cols, add_lo, add_hi
+        )
+        assert deltas is not None, "device/mirror admission divergence"
+        n_win = int(winner.sum())
+        if n_win:
+            sub_slots = np.concatenate([p_drs[winner], p_crs[winner]])
+            sub_cols = np.concatenate(
+                [np.zeros(n_win, np.int64), np.full(n_win, 2, np.int64)]
+            )
+            self._mirror.apply_subs(
+                sub_slots, sub_cols,
+                np.concatenate([p_amt_lo[winner]] * 2),
+                np.concatenate([p_amt_hi[winner]] * 2),
+            )
+
+        ud128_set = (ud128_lo != 0) | (ud128_hi != 0)
+        created = {
+            "flags": flags,
+            "dr_slot": np.where(is_pv, p_drs, dr_slot).astype(np.int32),
+            "cr_slot": np.where(is_pv, p_crs, cr_slot).astype(np.int32),
+            "amount_lo": np.where(is_pv, res_amt_lo, amount_lo),
+            "amount_hi": np.where(is_pv, res_amt_hi, amount_hi),
+            "pending_lo": pend_lo, "pending_hi": pend_hi,
+            "ud128_lo": np.where(is_pv & ~ud128_set, ctx["p_ud128_lo"], ud128_lo),
+            "ud128_hi": np.where(is_pv & ~ud128_set, ctx["p_ud128_hi"], ud128_hi),
+            "ud64": np.where(is_pv & (ud64 == 0), ctx["p_ud64"], ud64),
+            "ud32": np.where(is_pv & (ud32 == 0), ctx["p_ud32"], ud32),
+            "timeout": np.zeros(n, np.uint64),
+            "ledger": np.where(is_pv, ctx["p_ledger"], ledger_arr).astype(np.uint32),
+            "code": np.where(is_pv, ctx["p_code"], code_arr).astype(np.uint32),
+        }
+        inb_status = np.where(
+            pend_ok, np.uint32(kernel.S_PENDING), np.uint32(0)
+        )
+        ib_win = winner & ctx["ib"]
+        if ib_win.any():
+            inb_status[ctx["tgt_ev"][ib_win]] = np.where(
+                post[ib_win],
+                np.uint32(kernel.S_POSTED),
+                np.uint32(kernel.S_VOIDED),
+            )
+        uniq_rows = ctx["uniq_rows"]
+        uniq_status = ctx["uniq_status"]
+        dstat_init = uniq_status.copy()
+        dstat = uniq_status.copy()
+        dur_win = winner & ~ctx["ib"]
+        if dur_win.any():
+            dstat[ctx["p_tgt"][dur_win]] = np.where(
+                post[dur_win],
+                np.uint32(kernel.S_POSTED),
+                np.uint32(kernel.S_VOIDED),
+            )
+        zeros_u64 = np.zeros(n, np.uint64)
+        self._post_process_transfers(
+            n, ts_base, id_lo, id_hi, flags, timeout,
+            results, ok, created, inb_status,
+            dstat_init, dstat, uniq_rows,
+            np.zeros((n, 8), np.uint64), np.zeros((n, 8), np.uint64),
+            summary["last_applied"], zeros_u64, zeros_u64,
+            no_history=True,
+        )
+        fail_idx = np.flatnonzero(results != 0)
+        reply = np.zeros(len(fail_idx), dtype=CREATE_RESULT_DTYPE)
+        reply["index"] = fail_idx.astype(np.uint32)
+        reply["result"] = results[fail_idx]
+        return reply.tobytes()
+
+    def _lookup_accounts_device(self, input_bytes: bytes):
+        """lookup_accounts with balances gathered from the DEVICE table
+        (rides the dispatch stream, so in-flight batches are visible
+        without draining) — VERDICT r3 #1d."""
+        ids = np.frombuffer(input_bytes, dtype=types.U128_PAIR_DTYPE)
+        found, slots = self._acct_dir.lookup(
+            ids["lo"].astype(np.uint64), ids["hi"].astype(np.uint64)
+        )
+        hit = np.flatnonzero(found)
+        if len(hit) == 0:
+            from tigerbeetle_tpu.state_machine.device_engine import (
+                ReplyFuture,
+            )
+
+            return ReplyFuture(value=b"")
+        slots_hit = slots[hit].astype(np.int64)
+
+        def finish(rows) -> bytes:
+            balances = rows[: len(slots_hit)]
+            out = np.zeros(len(hit), dtype=ACCOUNT_DTYPE)
+            a = self._attrs
+            out["id_lo"], out["id_hi"] = a["id_lo"][slots_hit], a["id_hi"][slots_hit]
+            out["debits_pending_lo"], out["debits_pending_hi"] = balances[:, 0], balances[:, 1]
+            out["debits_posted_lo"], out["debits_posted_hi"] = balances[:, 2], balances[:, 3]
+            out["credits_pending_lo"], out["credits_pending_hi"] = balances[:, 4], balances[:, 5]
+            out["credits_posted_lo"], out["credits_posted_hi"] = balances[:, 6], balances[:, 7]
+            out["user_data_128_lo"] = a["ud128_lo"][slots_hit]
+            out["user_data_128_hi"] = a["ud128_hi"][slots_hit]
+            out["user_data_64"] = a["ud64"][slots_hit]
+            out["user_data_32"] = a["ud32"][slots_hit]
+            out["ledger"] = a["ledger"][slots_hit]
+            out["code"] = a["code"][slots_hit]
+            out["flags"] = a["flags"][slots_hit]
+            out["timestamp"] = a["timestamp"][slots_hit]
+            return out.tobytes()
+
+        return self._dev.lookup(slots_hit, finish)
+
     def _commit_create_transfers(self, timestamp: int, input_bytes: bytes) -> bytes:
         events = np.frombuffer(input_bytes, dtype=TRANSFER_DTYPE)
         n = len(events)
         if n == 0:
             return b""
+        self.stat_host_semantic_events += n
         ts_base = timestamp - n + 1
 
         # Native C++ fast path: one call covers decode, static ladder,
@@ -2244,7 +2973,18 @@ def _tpu_snapshot(self) -> bytes:
     via state sync and must be safe to decode from untrusted bytes."""
     from tigerbeetle_tpu.utils import snapshot as snapcodec
 
+    if self.engine == "device":
+        self._dev.drain()
     self._dev.flush()  # queue drained; mirror == device content
+    # Device<->mirror checksum at the checkpoint barrier (VERDICT r3
+    # #4): in device mode the mirror is a demoted parity oracle, so a
+    # silent divergence would otherwise surface only on a fallback.
+    # Host mode pays a ~100ms fetch on this link, so it verifies only
+    # when asked (TB_CKPT_VERIFY=1; tests and VOPR set it).
+    import os as _os
+
+    if self.engine == "device" or _os.environ.get("TB_CKPT_VERIFY") == "1":
+        self.verify_device_mirror()
     count = self._attrs.count
     # prepare_timestamp is primary-only in-memory state, re-derived from
     # commit_timestamp after restore — see cpu.py snapshot note.
@@ -2340,10 +3080,23 @@ def _tpu_restore(self, data: bytes) -> None:
     self._mirror.hi[:n_acct] = state["mirror_hi"]
     if self._native is not None:
         self._rebuild_native(cap)
-    self._dev = kernel_fast.DeviceTable(cap)
-    self._dev.balances = self._dev._place(
-        jnp.asarray(self._mirror.rows8(np.arange(cap, dtype=np.int64)))
-    )
+    if self.engine == "device":
+        from tigerbeetle_tpu.state_machine.device_engine import DeviceEngine
+
+        self._dev = DeviceEngine(cap, self._mirror)
+        self._dev._upload_from_mirror()
+        if n_acct:
+            self._dev.add_accounts(
+                np.arange(n_acct, dtype=np.int64),
+                self._attrs.col("flags"),
+                self._attrs.col("ledger"),
+            )
+    else:
+        self._dev = kernel_fast.DeviceTable(cap)
+        self._dev.balances = self._dev._place(
+            jnp.asarray(self._mirror.rows8(np.arange(cap, dtype=np.int64)))
+        )
+    self._inflight_timeouts = False
     self._expiry_rows = None
 
 

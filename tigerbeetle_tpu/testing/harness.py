@@ -128,8 +128,9 @@ class SingleNodeHarness:
             if self.sm.pulse_next_timestamp == before:
                 break
 
-    def _run(self, operation: Operation, input_bytes: bytes) -> bytes:
-        # Timestamping (reference: src/vsr/replica.zig:5762-5772).
+    def _dispatch(self, operation: Operation, input_bytes: bytes):
+        """Shared prepare/prefetch/commit prologue; returns the reply
+        future (timestamping reference: src/vsr/replica.zig:5762-5772)."""
         self.sm.prepare_timestamp = max(
             max(self.sm.prepare_timestamp, self.sm.commit_timestamp) + 1,
             self.realtime,
@@ -138,16 +139,39 @@ class SingleNodeHarness:
         timestamp = self.sm.prepare_timestamp
         self.op += 1
         self.sm.prefetch(operation, input_bytes, prefetch_timestamp=timestamp)
-        return self.sm.commit(0, self.op, timestamp, operation, input_bytes)
+        if hasattr(self.sm, "commit_async"):
+            return self.sm.commit_async(
+                0, self.op, timestamp, operation, input_bytes
+            )
+        from tigerbeetle_tpu.state_machine.device_engine import ReplyFuture
+
+        return ReplyFuture(
+            value=self.sm.commit(0, self.op, timestamp, operation, input_bytes)
+        )
+
+    def _run(self, operation: Operation, input_bytes: bytes) -> bytes:
+        return self._dispatch(operation, input_bytes).result()
 
     def submit(
         self, operation: Operation, input_bytes: bytes, *, realtime: int | None = None
     ) -> bytes:
+        return self.submit_async(
+            operation, input_bytes, realtime=realtime
+        ).result()
+
+    def submit_async(
+        self, operation: Operation, input_bytes: bytes, *, realtime: int | None = None
+    ):
+        """Pipelined submission: returns a reply future (resolved
+        immediately for state machines without commit_async).  The
+        device-engine path materializes replies in submission order at
+        ring-fetch boundaries — the same pipelining the reference's
+        async client drives (src/clients/c/tb_client/packet.zig)."""
         if realtime is not None:
             self.realtime = realtime
         if operation != Operation.pulse:
             self.tick_pulses()
-        return self._run(operation, input_bytes)
+        return self._dispatch(operation, input_bytes)
 
     # Convenience wrappers -------------------------------------------------
 
