@@ -46,6 +46,7 @@ from tigerbeetle_tpu.state_machine import device_kernels as dk
 
 _FETCH_EVERY = int(os.environ.get("TB_DEV_FETCH", "48"))
 _RING = int(os.environ.get("TB_DEV_RING", "256"))
+_STAGE = int(os.environ.get("TB_DEV_STAGE", "8"))
 
 
 class ReplyFuture:
@@ -109,6 +110,10 @@ class DeviceEngine:
         self._ring_at = 0
         self._stream: list[_InFlight] = []
         self._n_batches = 0
+        # Staging: batches accumulate host-side and ship in ONE
+        # superbatch h2d per _STAGE batches (in-stream transfers cost
+        # ~25 ms each on this link; amortize them).
+        self._stage: list[_InFlight] = []
         # Write-behind lane for host-resolved batches (exact path).
         self._q: list[tuple] = []
         self._queued = 0
@@ -175,18 +180,59 @@ class DeviceEngine:
             kind, fut, finish, pk=pk, n=n, ts_base=ts_base,
             fallback=fallback, id_keys=id_keys,
         )
-        self._dispatch(rec)
+        self._stage.append(rec)
         self._stream.append(rec)
         self._n_batches += 1
+        if len(self._stage) >= _STAGE:
+            self._flush_stage()
         if self._n_batches >= _FETCH_EVERY:
             self._materialize()
         return fut
 
+    def _flush_stage(self) -> None:
+        """Ship the staged batches' inputs in one superbatch h2d per
+        column layout, then dispatch their kernels in stream order."""
+        stage, self._stage = self._stage, []
+        if not stage:
+            return
+        # One superbatch transfer per column layout; dispatch then
+        # follows STAGE order (cross-layout batches may depend on each
+        # other's balance effects).
+        supers = {}
+        slot_of = {}
+        for ncols in (dk.N_COLS, dk.N_COLS_TP):
+            group = [r for r in stage if r.pk.shape[1] == ncols]
+            if not group:
+                continue
+            buf = np.zeros((_STAGE * dk.B, ncols), np.uint64)
+            for g, rec in enumerate(group):
+                buf[g * dk.B : (g + 1) * dk.B] = rec.pk
+                slot_of[id(rec)] = g
+            supers[ncols] = jax.device_put(buf)
+        for rec in stage:
+            kernel = {
+                "orderfree": dk.orderfree_staged,
+                "orderfree_lo": dk.orderfree_lo_staged,
+                "linked": dk.linked_staged,
+                "two_phase": dk.two_phase_staged,
+                "two_phase_lo": dk.two_phase_lo_staged,
+            }[rec.kind]
+            self.balances, self.ring = kernel(
+                self.balances, self.meta, self.ring, self._ring_at,
+                supers[rec.pk.shape[1]], slot_of[id(rec)], rec.n,
+                jnp.uint64(rec.ts_base),
+            )
+            rec.ring_at = self._ring_at
+            self._ring_at = (self._ring_at + 1) % _RING
+
     def _dispatch(self, rec: _InFlight) -> None:
+        """Immediate single-batch dispatch (fallback re-dispatch path)."""
         kernel = {
             "orderfree": dk.orderfree,
+            "orderfree_lo": dk.orderfree_lo,
             "linked": dk.linked,
             "two_phase": dk.two_phase,
+            "two_phase_lo": dk.two_phase_lo,
         }[rec.kind]
         self.balances, self.ring = kernel(
             self.balances, self.meta, self.ring, self._ring_at,
@@ -200,6 +246,7 @@ class DeviceEngine:
         dispatch stream, so it sees every in-flight batch's effects.
         `finish(rows)` builds the reply from the fetched (k, 8) rows
         at materialization."""
+        self._flush_stage()  # gather must sequence after staged batches
         fut = ReplyFuture(self)
         slots = np.asarray(slots, np.int64)
         rec = _InFlight("lookup", fut, finish, slots=slots)
@@ -253,6 +300,7 @@ class DeviceEngine:
         in order against the corrected table.  Repeats until the
         stream drains."""
         while self._stream:
+            self._flush_stage()
             covered = self._stream
             self._stream = []
             self._n_batches = 0
@@ -302,6 +350,10 @@ class DeviceEngine:
     def enqueue(self, slots, cols, add_lo, add_hi) -> None:
         if self._suppress_enqueue or len(slots) == 0:
             return
+        # Exact-path deltas only arrive after a drain (the host path
+        # drains before running), so they can never overtake staged
+        # semantic batches.
+        assert not self._stage, "write-behind enqueue with staged batches"
         self._q.append(
             (
                 np.asarray(slots, np.int64),

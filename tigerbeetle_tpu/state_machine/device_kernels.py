@@ -201,7 +201,8 @@ def _static_ladder_normal(ev, meta, active):
     return jnp.where(active, r, jnp.uint32(CTR.linked_event_failed))
 
 
-def _accum_cols(slot_rows, col_rows, amt_lo_rows, amt_hi_rows, valid, A):
+def _accum_cols(slot_rows, col_rows, amt_lo_rows, amt_hi_rows, valid, A,
+                lo_only=False):
     """Exact per-(slot, column) u128 sums via one-hot MXU matmul.
 
     Amounts decompose into 8-bit pieces (each < 2^8); the one-hot
@@ -209,26 +210,34 @@ def _accum_cols(slot_rows, col_rows, amt_lo_rows, amt_hi_rows, valid, A):
     rows * 255 < 2^24, so every partial is exact — and a base-256
     carry recombination rebuilds exact u128 column deltas.
 
+    `lo_only` halves the payload (8 pieces) when every amount's high
+    limb is zero — a trace-time specialization the host router selects
+    (the high-limb sum is then just the carry chain's overflow).
+
     Returns (d_lo, d_hi, limb_ov) of shape (A, 4).
     """
     rows = slot_rows.shape[0]
     zero = jnp.uint64(0)
     lo = jnp.where(valid, amt_lo_rows, zero)
-    hi = jnp.where(valid, amt_hi_rows, zero)
     pieces = [((lo >> jnp.uint64(s)) & _MASK8).astype(jnp.float32)
               for s in range(0, 64, 8)]
-    pieces += [((hi >> jnp.uint64(s)) & _MASK8).astype(jnp.float32)
-               for s in range(0, 64, 8)]
-    P = jnp.stack(pieces, axis=-1)  # (rows, 16)
+    if not lo_only:
+        hi = jnp.where(valid, amt_hi_rows, zero)
+        pieces += [((hi >> jnp.uint64(s)) & _MASK8).astype(jnp.float32)
+                   for s in range(0, 64, 8)]
+    npieces = len(pieces)
+    P = jnp.stack(pieces, axis=-1)  # (rows, npieces)
     colmask = jax.nn.one_hot(col_rows, 4, dtype=jnp.float32)  # (rows, 4)
-    payload = (colmask[:, :, None] * P[:, None, :]).reshape(rows, 64)
+    payload = (colmask[:, :, None] * P[:, None, :]).reshape(
+        rows, 4 * npieces
+    )
     safe_slots = jnp.where(valid, slot_rows, A)  # A = dropped lane
     onehot = jax.nn.one_hot(safe_slots, A, dtype=jnp.bfloat16)
     acc = jax.lax.dot_general(
         onehot.T, payload.astype(jnp.bfloat16),
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ).reshape(A, 4, 16).astype(jnp.uint64)
+    ).reshape(A, 4, npieces).astype(jnp.uint64)
     c = acc[:, :, 0]
     d_lo = c & _MASK8
     carry = c >> jnp.uint64(8)
@@ -236,6 +245,8 @@ def _accum_cols(slot_rows, col_rows, amt_lo_rows, amt_hi_rows, valid, A):
         c = acc[:, :, k] + carry
         d_lo = d_lo | ((c & _MASK8) << jnp.uint64(8 * k))
         carry = c >> jnp.uint64(8)
+    if lo_only:
+        return d_lo, carry, jnp.zeros((A, 4), bool)
     c = acc[:, :, 8] + carry
     d_hi = c & _MASK8
     carry = c >> jnp.uint64(8)
@@ -310,7 +321,7 @@ def _summary(results, active, flags_word, last_applied):
 # Order-free kernel.
 
 
-def _orderfree(table, meta, ring, ring_at, pk, n, ts_base):
+def _orderfree(table, meta, ring, ring_at, pk, n, ts_base, lo_only=False):
     """Order-independent batch: full static ladder + overflow admission
     + scatter apply + result codes, all on device.
 
@@ -344,7 +355,7 @@ def _orderfree(table, meta, ring, ring_at, pk, n, ts_base):
     amt_hi2 = jnp.concatenate([ev["amt_hi"]] * 2)
     valid = jnp.concatenate([ok, ok])
     d_lo, d_hi, limb_ov = _accum_cols(
-        slot_rows, col_rows, amt_lo2, amt_hi2, valid, A
+        slot_rows, col_rows, amt_lo2, amt_hi2, valid, A, lo_only=lo_only
     )
     new_table, ov = _admit_apply(table, d_lo, d_hi, limb_ov)
 
@@ -429,7 +440,7 @@ def _linked(table, meta, ring, ring_at, pk, n, ts_base):
     amt_hi2 = jnp.concatenate([ev["amt_hi"]] * 2)
     sup_valid = jnp.concatenate([static_ok, static_ok])
     d_lo_s, d_hi_s, limb_ov_s = _accum_cols(
-        slot_rows, col_rows, amt_lo2, amt_hi2, sup_valid, A
+        slot_rows, col_rows, amt_lo2, amt_hi2, sup_valid, A, lo_only=True
     )
     _, sup_ov = _admit_apply(table, d_lo_s, d_hi_s, limb_ov_s)
 
@@ -591,7 +602,7 @@ def _linked(table, meta, ring, ring_at, pk, n, ts_base):
     okev = active & (results == 0)
     ap_valid = jnp.concatenate([okev, okev])
     d_lo, d_hi, limb_ov = _accum_cols(
-        slot_rows, col_rows, amt_lo2, amt_hi2, ap_valid, A
+        slot_rows, col_rows, amt_lo2, amt_hi2, ap_valid, A, lo_only=True
     )
     fallback = sup_ov | precond_bad | fix_failed
     new_table, _ov2 = _admit_apply(table, d_lo, d_hi, limb_ov)
@@ -614,7 +625,7 @@ def _linked(table, meta, ring, ring_at, pk, n, ts_base):
 # Two-phase kernel (port of resolve.two_phase_resolve to device).
 
 
-def _two_phase(table, meta, ring, ring_at, pk, n, ts_base):
+def _two_phase(table, meta, ring, ring_at, pk, n, ts_base, lo_only=False):
     """Pending-create + post/void batch with balance-independent
     verdicts (router preconditions: no linked/balancing, all timeouts
     zero, no limit/history accounts, unique fresh ids).  Closed-form:
@@ -791,7 +802,8 @@ def _two_phase(table, meta, ring, ring_at, pk, n, ts_base):
         [pend_ok | plain_ok, pend_ok | plain_ok, post_win, post_win]
     )
     d_lo, d_hi, limb_ov = _accum_cols(
-        add_slots, add_cols, add_amt_lo, add_amt_hi, add_valid, A
+        add_slots, add_cols, add_amt_lo, add_amt_hi, add_valid, A,
+        lo_only=lo_only,
     )
     mid_table, ov = _admit_apply(table, d_lo, d_hi, limb_ov)
 
@@ -805,7 +817,8 @@ def _two_phase(table, meta, ring, ring_at, pk, n, ts_base):
     sub_amt_hi = jnp.concatenate([p_amt_hi] * 2)
     win2 = jnp.concatenate([ok & winner, ok & winner])
     s_lo, s_hi, s_limb = _accum_cols(
-        sub_slots, sub_cols, sub_amt_lo, sub_amt_hi, win2, A
+        sub_slots, sub_cols, sub_amt_lo, sub_amt_hi, win2, A,
+        lo_only=lo_only,
     )
     old_lo = mid_table[:, 0::2]
     old_hi = mid_table[:, 1::2]
@@ -883,9 +896,33 @@ def _checksum(table):
     return jnp.concatenate([col_sums, mixed])
 
 
+import functools as _ft
+
 orderfree = jax.jit(_orderfree)
+orderfree_lo = jax.jit(_ft.partial(_orderfree, lo_only=True))
 linked = jax.jit(_linked)
 two_phase = jax.jit(_two_phase)
+two_phase_lo = jax.jit(_ft.partial(_two_phase, lo_only=True))
+
+
+def _staged(fn, ncols):
+    """Staged variant: the batch is a slice of a device-resident
+    superbatch (one h2d covers many batches — transfers issued while
+    the stream is busy cost ~25 ms each on this link, so they are
+    amortized across a stage; see experiments/staged_probe.py)."""
+
+    def run(table, meta, ring, ring_at, super_pk, g, n, ts_base):
+        pk = jax.lax.dynamic_slice(super_pk, (g * B, 0), (B, ncols))
+        return fn(table, meta, ring, ring_at, pk, n, ts_base)
+
+    return jax.jit(run)
+
+
+orderfree_staged = _staged(_orderfree, N_COLS)
+orderfree_lo_staged = _staged(_ft.partial(_orderfree, lo_only=True), N_COLS)
+linked_staged = _staged(_linked, N_COLS)
+two_phase_staged = _staged(_two_phase, N_COLS_TP)
+two_phase_lo_staged = _staged(_ft.partial(_two_phase, lo_only=True), N_COLS_TP)
 lookup = jax.jit(_lookup)
 apply_deltas = jax.jit(_apply_deltas)
 meta_update = jax.jit(_meta_update)

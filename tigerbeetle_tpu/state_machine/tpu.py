@@ -1036,15 +1036,12 @@ class TpuStateMachine:
         keys = pack_u128(id_lo, id_hi)
         probe = keys
         if has_pv:
-            probe = np.concatenate(
-                [
-                    probe,
-                    pack_u128(
-                        np.asarray(events["pending_id_lo"]),
-                        np.asarray(events["pending_id_hi"]),
-                    ),
-                ]
-            )
+            # Only real references: pending_id == 0 means "no
+            # reference" and must not alias across batches.
+            plo = np.asarray(events["pending_id_lo"])
+            phi = np.asarray(events["pending_id_hi"])
+            ref = (plo != 0) | (phi != 0)
+            probe = np.concatenate([probe, pack_u128(plo[ref], phi[ref])])
         keys_sorted = np.sort(probe) if (has_pv or not ascending) else keys
         if self._dev.inflight_ids_hit(probe):
             self._engine_drain()
@@ -1187,8 +1184,9 @@ class TpuStateMachine:
                 last_applied=summary["last_applied"],
             )
 
+        kind = "orderfree" if amount_hi.any() else "orderfree_lo"
         return self._dev.submit(
-            "orderfree", pk, n, ts_base, finish,
+            kind, pk, n, ts_base, finish,
             self._device_fallback(timestamp, input_bytes),
             id_keys=keys_sorted,
         )
@@ -1404,8 +1402,13 @@ class TpuStateMachine:
             )
 
         self.stat_two_phase_batches += 1
+        kind = (
+            "two_phase_lo"
+            if not (amount_hi.any() or p_amt_hi.any())
+            else "two_phase"
+        )
         return self._dev.submit(
-            "two_phase", pk, n, ts_base, finish,
+            kind, pk, n, ts_base, finish,
             self._device_fallback(timestamp, input_bytes),
             id_keys=keys_sorted,
         )
