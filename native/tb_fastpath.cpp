@@ -462,3 +462,4 @@ int tb_fp_commit_transfers(
 #include "tb_exact.inc"
 #include "tb_linked.inc"
 #include "tb_two_phase.inc"
+#include "tb_lsm.inc"

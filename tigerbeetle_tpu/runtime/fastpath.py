@@ -40,6 +40,42 @@ _U8P = ctypes.POINTER(ctypes.c_uint8)
 FALLBACK = 1
 
 
+def kway_merge(streams, value_size: int):
+    """Native k-way merge of sorted-unique (keys V16, flags u8, vals
+    (n, value_size) u8) streams, newest first.  Returns merged arrays
+    or None when the native library is unavailable."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        return None
+    k = len(streams)
+    total = sum(len(s[0]) for s in streams)
+    keys_c = [np.ascontiguousarray(s[0]) for s in streams]
+    flags_c = [np.ascontiguousarray(s[1]) for s in streams]
+    vals_c = [np.ascontiguousarray(s[2]) for s in streams]
+    key_ptrs = (ctypes.POINTER(ctypes.c_uint8) * k)(
+        *[a.ctypes.data_as(_U8P) for a in keys_c]
+    )
+    flag_ptrs = (ctypes.POINTER(ctypes.c_uint8) * k)(
+        *[a.ctypes.data_as(_U8P) for a in flags_c]
+    )
+    val_ptrs = (ctypes.POINTER(ctypes.c_uint8) * k)(
+        *[a.ctypes.data_as(_U8P) for a in vals_c]
+    )
+    lens = (ctypes.c_int64 * k)(*[len(s[0]) for s in streams])
+    out_keys = np.empty(total, dtype="V16")
+    out_flags = np.empty(total, np.uint8)
+    out_vals = np.empty((total, value_size), np.uint8)
+    n = lib.tb_lsm_kway_merge(
+        k, key_ptrs, flag_ptrs, val_ptrs, lens, value_size,
+        out_keys.ctypes.data_as(_U8P) if total else None,
+        out_flags.ctypes.data_as(_U8P) if total else None,
+        out_vals.ctypes.data_as(_U8P) if total else None,
+    )
+    return out_keys[:n], out_flags[:n], out_vals[:n]
+
+
 def _load():
     global _lib
     with _lib_lock:
@@ -110,6 +146,13 @@ def _load():
             ctypes.c_uint32, _U32P, ctypes.c_uint32, ctypes.c_uint32,
             ctypes.c_uint64, ctypes.c_uint32,
             _U64P, _I64P, _I64P, _U64P, _U64P, _U32P,
+        ]
+        lib.tb_lsm_kway_merge.restype = ctypes.c_int64
+        lib.tb_lsm_kway_merge.argtypes = [
+            ctypes.c_int32,
+            ctypes.POINTER(_U8P), ctypes.POINTER(_U8P),
+            ctypes.POINTER(_U8P), _I64P, ctypes.c_int32,
+            _U8P, _U8P, _U8P,
         ]
         _lib = lib
         return _lib

@@ -1,0 +1,53 @@
+"""Serial background worker on a daemon thread.
+
+A minimal stand-in for ThreadPoolExecutor(max_workers=1) whose thread
+is a DAEMON: replicas and grids are constructed/discarded freely in
+crash-recovery loops and fuzz harnesses, and must not leak non-daemon
+threads that pin the process (or the storage objects) alive.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class _Job:
+    __slots__ = ("fn", "args", "_done", "_exc")
+
+    def __init__(self, fn, args):
+        self.fn = fn
+        self.args = args
+        self._done = threading.Event()
+        self._exc: BaseException | None = None
+
+    def result(self) -> None:
+        self._done.wait()
+        if self._exc is not None:
+            raise self._exc
+
+
+class SerialWorker:
+    """FIFO execution of submitted jobs on one daemon thread."""
+
+    def __init__(self, name: str) -> None:
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, fn, *args) -> _Job:
+        job = _Job(fn, args)
+        self._q.put(job)
+        return job
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                job.fn(*job.args)
+            except BaseException as e:  # surfaced at job.result()
+                job._exc = e
+            finally:
+                job._done.set()

@@ -1351,6 +1351,7 @@ class VsrReplica(Replica):
         from tigerbeetle_tpu.utils import snapshot as snapcodec
 
         grid = self.forest.grid
+        grid.flush_writes()  # queued async block writes must be on disk
         live = (np.flatnonzero(~grid.free_set.free) + 1).astype(np.uint64)
         raw = bytearray()
         for addr in live:
@@ -1373,6 +1374,10 @@ class VsrReplica(Replica):
 
         state = snapcodec.decode(payload)
         grid = self.forest.grid
+        # Drain OUR stale queued writes first — a pre-sync write
+        # landing after the install would silently overwrite a shipped
+        # block with old-lineage (checksum-valid) content.
+        grid.flush_writes()
         addrs = state["addrs"]
         blocks = state["blocks"]
         bs = int(state["block_size"])
@@ -1544,6 +1549,7 @@ class VsrReplica(Replica):
         want = int(bh["checksum_lo"]) | (int(bh["checksum_hi"]) << 64)
         if wire.checksum(payload) != want:
             return
+        grid.flush_writes()  # stale queued write must not overwrite us
         self.storage.write(grid._offset(addr), body)
         grid._cache.remove(addr)
         self._blocks_missing.discard(addr)
@@ -1626,6 +1632,8 @@ class VsrReplica(Replica):
         region = int(self.superblock.working["sequence"]) % 2
         offset = self._grid_region_offset(region, len(blob))
         self._write_grid(offset, blob)
+        if self.forest is not None:
+            self.forest.grid.flush_writes()
         self.storage.sync()
         self.superblock.checkpoint(
             commit_min=checkpoint_op,

@@ -67,6 +67,19 @@ class Replica:
         self.cluster = cluster
         self.sm = state_machine
         self.aof = aof  # optional vsr.aof.AOF (reference: src/aof.zig)
+        # One daemon worker overlaps each op's WAL fdatasync (disk
+        # wait) with its commit-stage CPU work; _prepare_and_commit
+        # joins before replying, preserving the durability-before-ack
+        # contract.  Only on backends whose sync is thread-safe
+        # against concurrent writes (FileStorage); the fault-injecting
+        # MemoryStorage keeps the synchronous path so its seeded crash
+        # model stays deterministic.
+        self._wal_sync_worker = None
+        self._wal_sync_inflight = None
+        if getattr(storage, "supports_async_writeback", False):
+            from tigerbeetle_tpu.utils.worker import SerialWorker
+
+            self._wal_sync_worker = SerialWorker("wal-sync")
         # Optional testing.hash_log.HashLog: per-commit chained digests
         # for determinism-divergence pinpointing (reference:
         # src/testing/hash_log.zig).
@@ -110,6 +123,7 @@ class Replica:
             state_machine.attach_forest(self.forest)
 
         self.op = 0                  # highest prepared op
+        self._ckpt_interval_observed = 0  # ops between checkpoints
         self.commit_min = 0          # highest committed op
         self.commit_parent = None    # checksum of last committed prepare
         self.view = 0
@@ -283,18 +297,39 @@ class Replica:
         )
         wire.finalize_header(header, body)
 
-        # WAL append is THE durability point.
-        self.journal.write_prepare(header, body)
-        self.op = op
-        self.parent_checksum = wire.u128(header, "checksum")
-
-        reply = self._commit_prepare(header, body)
+        # WAL append is THE durability point — but the fdatasync (disk
+        # wait, ~8ms on this container) overlaps the commit stage's CPU
+        # work: the reply is only returned after the sync JOINS, so the
+        # contract (no ack before WAL durability) is unchanged
+        # (reference: the prepare pipeline overlaps journal writes with
+        # commit execution the same way, src/vsr/replica.zig pipeline).
+        if self._wal_sync_worker is not None:
+            self.journal.write_prepare(header, body, sync=False)
+            self.op = op
+            self.parent_checksum = wire.u128(header, "checksum")
+            self._wal_sync_inflight = self._wal_sync_worker.submit(
+                self.storage.sync_wal
+            )
+            try:
+                reply = self._commit_prepare(header, body)
+            finally:
+                self._join_wal_sync()
+        else:
+            self.journal.write_prepare(header, body)
+            self.op = op
+            self.parent_checksum = wire.u128(header, "checksum")
+            reply = self._commit_prepare(header, body)
 
         # Checkpoint cadence (reference: src/constants.zig:55-81) — must
         # run before the WAL ring wraps over the previous checkpoint.
         if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
             self.checkpoint()
         return reply
+
+    def _join_wal_sync(self) -> None:
+        if self._wal_sync_inflight is not None:
+            self._wal_sync_inflight.result()
+            self._wal_sync_inflight = None
 
     def set_tracer(self, tracer) -> None:
         """Attach a utils.tracer.Tracer to this replica's hot paths
@@ -317,7 +352,10 @@ class Replica:
             # value reproduces the live prepare exactly).
             self.sm.prepare_timestamp = timestamp
         elif self.aof is not None:
-            # reference: src/vsr/replica.zig:4136-4141 — AOF before apply.
+            # reference: src/vsr/replica.zig:4136-4141 — AOF before
+            # apply, and never ahead of the WAL's durability: the AOF
+            # must not record an op a crash could erase from the WAL.
+            self._join_wal_sync()
             self.aof.write(header, body)
 
         if operation == int(VsrOperation.register):
@@ -483,8 +521,21 @@ class Replica:
         if hasattr(self.sm, "spill_beat"):
             spilled = self.sm.spill_beat()
         if spilled or self.forest.compaction_pending():
+            # Escalate the budget as the next checkpoint nears so
+            # in-flight merges land BEFORE the barrier instead of
+            # draining inside it as one latency spike (the p100 tail).
+            # The cadence is learned from the PREVIOUS interval
+            # (operators may checkpoint more often than
+            # vsr_checkpoint_interval — the durable benchmark does);
+            # op-count-driven, so replicas stay deterministic.
+            interval = min(
+                self.config.vsr_checkpoint_interval,
+                self._ckpt_interval_observed or (1 << 30),
+            )
+            left = self.checkpoint_op + interval - self.op
+            budget = 64 if left > 8 else 64 * (10 - max(left, 0))
             with self.tracer.span("lsm_compact_beat", rows=spilled):
-                self.forest.compact_beat(64)
+                self.forest.compact_beat(budget)
 
     # ------------------------------------------------------------------
     # Client replies (reference: src/vsr/client_replies.zig).
@@ -554,6 +605,10 @@ class Replica:
         """Write a snapshot blob to the grid zone (A/B alternating),
         then advance the superblock — write ordering guarantees the
         previous checkpoint survives a torn snapshot write."""
+        # Learn the operator's checkpoint cadence for compaction
+        # pacing (_compact_beat escalates toward the next barrier).
+        if self.op > self.checkpoint_op:
+            self._ckpt_interval_observed = self.op - self.checkpoint_op
         with self.tracer.span("checkpoint", op=self.commit_min):
             self._checkpoint()
 
@@ -588,6 +643,10 @@ class Replica:
         region = int(self.superblock.working["sequence"]) % 2
         offset = self._grid_region_offset(region, len(blob))
         self._write_grid(offset, blob)
+        if self.forest is not None:
+            # Outstanding async block writes must be on disk before
+            # the sync that the new superblock's references rely on.
+            self.forest.grid.flush_writes()
         self.storage.sync()
 
         self.superblock.checkpoint(

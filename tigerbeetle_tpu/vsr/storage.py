@@ -160,6 +160,8 @@ class FileStorage(Storage):
     fdatasync on a shared inode would flush everything).  sync()
     flushes both (checkpoint ordering barrier)."""
 
+    supports_async_writeback = True  # grid writer thread (vsr/grid.py)
+
     def __init__(self, path: str, layout: ZoneLayout, create: bool = False) -> None:
         self.layout = layout
         flags = os.O_RDWR | (os.O_CREAT if create else 0)
@@ -203,17 +205,21 @@ class FileStorage(Storage):
             self._wal_dirty = True
 
     def sync(self) -> None:
+        # Clear-then-sync ordering: a concurrent write landing after
+        # the clear re-marks the file dirty, so the NEXT sync covers
+        # it even if this fdatasync raced past it (sync_wal runs on
+        # the replica's WAL worker thread).
         if self._wal_dirty:
-            os.fdatasync(self._fd)
             self._wal_dirty = False
+            os.fdatasync(self._fd)
         if self._grid_dirty:
-            os.fdatasync(self._fd_grid)
             self._grid_dirty = False
+            os.fdatasync(self._fd_grid)
 
     def sync_wal(self) -> None:
         """Flush the control/WAL file only (per-op ack durability)."""
-        os.fdatasync(self._fd)
         self._wal_dirty = False
+        os.fdatasync(self._fd)
 
     def writeback_hint(self, offset: int, size: int) -> None:
         if _sync_file_range is not None:
