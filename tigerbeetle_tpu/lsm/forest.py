@@ -28,14 +28,23 @@ class Forest:
     def __init__(self, storage: Storage, *, block_size: int = 1 << 16,
                  block_count: int = 1 << 12, base_offset: int | None = None,
                  memtable_max: int = 8192,
-                 cache_blocks: int = 4096) -> None:
+                 cache_blocks: int | None = None) -> None:
         # The grid cache absorbs compaction's read-back of recently
-        # written runs.  The default (4096 x 64KiB = 256MiB) mirrors
-        # the reference's GiB-scale grid cache (src/vsr/grid.zig cache
-        # sizing): on this container the OS page cache is evicted
-        # under cgroup pressure, so grid preads cost ~5ms of real disk
-        # latency without it (profiled: 8s of a 4.1s-budget durable
-        # run went to pread).
+        # written runs.  The file-backed default (4096 x 64KiB =
+        # 256MiB) mirrors the reference's GiB-scale grid cache
+        # (src/vsr/grid.zig cache sizing): on this container the OS
+        # page cache is evicted under cgroup pressure, so grid preads
+        # cost ~5ms of real disk latency without it (profiled: 8s of a
+        # 4.1s-budget durable run went to pread).  Memory backends
+        # (tests, fuzz clusters) keep a small cache — their reads are
+        # already RAM copies, and dozens of in-process replicas must
+        # not each pin 256MiB.
+        if cache_blocks is None:
+            cache_blocks = (
+                4096
+                if getattr(storage, "supports_async_writeback", False)
+                else 256
+            )
         self.grid = Grid(
             storage, block_size=block_size, block_count=block_count,
             base_offset=base_offset, cache_blocks=cache_blocks,

@@ -103,8 +103,22 @@ class DeviceEngine:
     def __init__(self, capacity: int, mirror) -> None:
         self.capacity = capacity
         self.mirror = mirror  # host bookkeeping copy (recovery + parity)
-        self.balances = jnp.zeros((capacity, 8), jnp.uint64)
-        self.meta = jnp.zeros((capacity, 2), jnp.uint32)
+        # Multi-device: the authoritative tables shard ROW-WISE across
+        # every visible device (NamedSharding over a 1-D "shard" mesh);
+        # the semantic kernels then run SPMD with XLA-inserted
+        # collectives — the same dispatch code path single-chip uses
+        # (exercised by __graft_entry__.dryrun_multichip on a virtual
+        # CPU mesh).
+        self.sharding = None
+        devices = jax.devices()
+        if len(devices) > 1 and capacity % len(devices) == 0:
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            mesh = Mesh(np.array(devices), ("shard",))
+            self.sharding = NamedSharding(mesh, P("shard", None))
+        self.balances = self._place(jnp.zeros((capacity, 8), jnp.uint64))
+        self.meta = self._place(jnp.zeros((capacity, 2), jnp.uint32))
         self._meta_host = np.zeros((capacity, 2), np.uint32)
         self.ring = jnp.zeros((_RING, dk.SUMMARY_WORDS), jnp.uint64)
         self._ring_at = 0
@@ -122,6 +136,11 @@ class DeviceEngine:
         self.stat_semantic_events = 0
         self.stat_fallback_batches = 0
         self.stat_fetches = 0
+
+    def _place(self, table):
+        if self.sharding is None:
+            return table
+        return jax.device_put(table, self.sharding)
 
     # ------------------------------------------------------------------
     # Account meta maintenance (create_accounts path).
@@ -151,13 +170,22 @@ class DeviceEngine:
             return
         self.drain()
         self.flush()
+        if self.sharding is not None:
+            ndev = self.sharding.mesh.devices.size
+            if capacity % ndev != 0:
+                self.sharding = None  # re-place replicated
         extra = capacity - self.capacity
-        self.balances = jnp.concatenate(
-            [self.balances, jnp.zeros((extra, 8), jnp.uint64)]
-        )
-        self.meta = jnp.concatenate(
-            [self.meta, jnp.zeros((extra, 2), jnp.uint32)]
-        )
+
+        def widen(table, width, dtype):
+            # Sharded tables re-place through the host (row boundaries
+            # move between devices on grow).
+            base = jax.device_get(table) if self.sharding is not None else table
+            return self._place(
+                jnp.concatenate([base, jnp.zeros((extra, width), dtype)])
+            )
+
+        self.balances = widen(self.balances, 8, jnp.uint64)
+        self.meta = widen(self.meta, 2, jnp.uint32)
         mh = np.zeros((capacity, 2), np.uint32)
         mh[: self.capacity] = self._meta_host
         self._meta_host = mh
@@ -339,7 +367,7 @@ class DeviceEngine:
         n = min(len(self.mirror.lo), self.capacity)
         table[:n, 0::2] = self.mirror.lo[:n]
         table[:n, 1::2] = self.mirror.hi[:n]
-        self.balances = jnp.asarray(table)
+        self.balances = self._place(jnp.asarray(table))
 
     def drain(self) -> None:
         self._materialize()

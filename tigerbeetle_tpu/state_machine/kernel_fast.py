@@ -24,6 +24,9 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+
+jax.config.update("jax_enable_x64", True)  # u64 limb math throughout
+
 import jax.numpy as jnp
 
 from tigerbeetle_tpu.ops import u128 as w

@@ -28,23 +28,39 @@ class _Job:
 
 
 class SerialWorker:
-    """FIFO execution of submitted jobs on one daemon thread."""
+    """FIFO execution of submitted jobs on one daemon thread.
+
+    `close()` stops the thread (idempotent); owners should either call
+    it or register it with `weakref.finalize` so discarded owners
+    (replicas/grids in crash-recovery loops) reclaim their thread
+    instead of leaking one blocked in q.get() per construction."""
+
+    _STOP = object()
 
     def __init__(self, name: str) -> None:
         self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._closed = False
         self._thread = threading.Thread(
             target=self._run, name=name, daemon=True
         )
         self._thread.start()
 
     def submit(self, fn, *args) -> _Job:
+        assert not self._closed, "submit on closed SerialWorker"
         job = _Job(fn, args)
         self._q.put(job)
         return job
 
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._q.put(self._STOP)
+
     def _run(self) -> None:
         while True:
             job = self._q.get()
+            if job is self._STOP:
+                return
             try:
                 job.fn(*job.args)
             except BaseException as e:  # surfaced at job.result()

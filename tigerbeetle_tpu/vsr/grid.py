@@ -63,12 +63,17 @@ class Grid:
         self._pending_writes: dict[int, int] = {}  # address -> refcount
         if getattr(storage, "supports_async_writeback", False):
             import threading
+            import weakref
 
             from tigerbeetle_tpu.utils.worker import SerialWorker
 
             self._writer = SerialWorker("grid-write")
             self._write_futures: list = []
+            self._write_error: BaseException | None = None
             self._pending_lock = threading.Lock()
+            # Discarded grids (crash-recovery loops) reclaim their
+            # worker thread instead of leaking it.
+            weakref.finalize(self, self._writer.close)
 
     @property
     def payload_size(self) -> int:
@@ -102,33 +107,52 @@ class Grid:
 
     def _write_one(self, address: int, payload: bytes,
                    block_type: int) -> None:
-        h = np.zeros(1, BLOCK_DTYPE)[0]
-        h["address"] = address
-        h["length"] = len(payload)
-        h["block_type"] = block_type
-        c = wire.checksum(payload)
-        h["checksum_lo"] = c & 0xFFFFFFFFFFFFFFFF
-        h["checksum_hi"] = c >> 64
-        block = (h.tobytes() + payload).ljust(self.block_size, b"\x00")
-        self.storage.write(self._offset(address), block)
-        # Kick async writeback now so the next checkpoint's full sync
-        # finds these pages already clean (no interval-sized stall).
-        self.storage.writeback_hint(self._offset(address), self.block_size)
-        if self._writer is not None:
-            with self._pending_lock:
-                n = self._pending_writes.get(address, 0) - 1
-                if n <= 0:
-                    self._pending_writes.pop(address, None)
-                else:
-                    self._pending_writes[address] = n
+        try:
+            h = np.zeros(1, BLOCK_DTYPE)[0]
+            h["address"] = address
+            h["length"] = len(payload)
+            h["block_type"] = block_type
+            c = wire.checksum(payload)
+            h["checksum_lo"] = c & 0xFFFFFFFFFFFFFFFF
+            h["checksum_hi"] = c >> 64
+            block = (h.tobytes() + payload).ljust(self.block_size, b"\x00")
+            self.storage.write(self._offset(address), block)
+            # Kick async writeback now so the next checkpoint's full
+            # sync finds these pages already clean.
+            self.storage.writeback_hint(
+                self._offset(address), self.block_size
+            )
+        finally:
+            if self._writer is not None:
+                with self._pending_lock:
+                    n = self._pending_writes.get(address, 0) - 1
+                    if n <= 0:
+                        self._pending_writes.pop(address, None)
+                    else:
+                        self._pending_writes[address] = n
 
     def flush_writes(self) -> None:
-        """Join every queued block write (checkpoint/read barrier)."""
+        """Join every queued block write (checkpoint/read barrier).
+
+        A write failure is STICKY: once any queued write errors, every
+        later flush re-raises — a checkpoint must never advance past a
+        block the disk refused (storage failure is fatal here, as in
+        the reference's storage fault model)."""
         if self._writer is None:
             return
+        if self._write_error is not None:
+            raise self._write_error
         futures, self._write_futures = self._write_futures, []
+        first_exc = None
         for f in futures:
-            f.result()
+            try:
+                f.result()
+            except BaseException as e:
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            self._write_error = first_exc
+            raise first_exc
 
     def read_block(self, address: int) -> bytes:
         cached = self._cache.get(address)

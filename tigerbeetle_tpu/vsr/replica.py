@@ -77,9 +77,12 @@ class Replica:
         self._wal_sync_worker = None
         self._wal_sync_inflight = None
         if getattr(storage, "supports_async_writeback", False):
+            import weakref
+
             from tigerbeetle_tpu.utils.worker import SerialWorker
 
             self._wal_sync_worker = SerialWorker("wal-sync")
+            weakref.finalize(self, self._wal_sync_worker.close)
         # Optional testing.hash_log.HashLog: per-commit chained digests
         # for determinism-divergence pinpointing (reference:
         # src/testing/hash_log.zig).

@@ -208,18 +208,31 @@ class FileStorage(Storage):
         # Clear-then-sync ordering: a concurrent write landing after
         # the clear re-marks the file dirty, so the NEXT sync covers
         # it even if this fdatasync raced past it (sync_wal runs on
-        # the replica's WAL worker thread).
+        # the replica's WAL worker thread).  On failure the flag is
+        # restored — an error must not launder unsynced data as clean.
         if self._wal_dirty:
             self._wal_dirty = False
-            os.fdatasync(self._fd)
+            try:
+                os.fdatasync(self._fd)
+            except OSError:
+                self._wal_dirty = True
+                raise
         if self._grid_dirty:
             self._grid_dirty = False
-            os.fdatasync(self._fd_grid)
+            try:
+                os.fdatasync(self._fd_grid)
+            except OSError:
+                self._grid_dirty = True
+                raise
 
     def sync_wal(self) -> None:
         """Flush the control/WAL file only (per-op ack durability)."""
         self._wal_dirty = False
-        os.fdatasync(self._fd)
+        try:
+            os.fdatasync(self._fd)
+        except OSError:
+            self._wal_dirty = True
+            raise
 
     def writeback_hint(self, offset: int, size: int) -> None:
         if _sync_file_range is not None:
