@@ -600,6 +600,148 @@ def run_durable(n_events: int) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_replicated(n_events: int) -> dict:
+    """3-replica TCP cluster, real ReplicaServer processes, driven by
+    the TCP client (VERDICT r3 #7): prices ring replication + quorum
+    prepare_oks + remote WAL sync on top of the durable single-replica
+    path.  Reference: src/tigerbeetle/benchmark_load.zig drives a real
+    cluster the same way."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+
+    from tigerbeetle_tpu.client import Client
+
+    n_replicas = 3
+    tmp = tempfile.mkdtemp(prefix="tb_bench_repl_")
+    ports = []
+    socks = []
+    for _ in range(n_replicas):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    addresses = ",".join(f"127.0.0.1:{p}" for p in ports)
+    here = os.path.dirname(os.path.abspath(__file__))
+    procs = []
+    try:
+        for i in range(n_replicas):
+            path = os.path.join(tmp, f"0_{i}.tigerbeetle")
+            subprocess.run(
+                [
+                    sys.executable, "-m", "tigerbeetle_tpu", "format",
+                    "--cluster=12", f"--replica={i}",
+                    f"--replica-count={n_replicas}", path,
+                ],
+                check=True, capture_output=True, cwd=here, timeout=120,
+            )
+        runner = (
+            "import sys; sys.path.insert(0, {here!r})\n"
+            "from tigerbeetle_tpu.runtime.server import ReplicaServer\n"
+            "from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine\n"
+            "s = ReplicaServer({path!r}, addresses={addrs!r}.split(','),\n"
+            "    replica_index={i}, grid_size=1 << 30,\n"
+            "    state_machine_factory=lambda: TpuStateMachine(\n"
+            "        account_capacity=1 << 12,\n"
+            "        transfer_capacity={cap}))\n"
+            "print('listening', flush=True)\n"
+            "s.serve_forever()\n"
+        )
+        log_paths = []
+        for i in range(n_replicas):
+            path = os.path.join(tmp, f"0_{i}.tigerbeetle")
+            # Output to FILES, not pipes: a replica chattering past the
+            # ~64KiB pipe buffer during the run would block on write
+            # and stall the whole cluster.
+            log_path = os.path.join(tmp, f"replica{i}.log")
+            log_paths.append(log_path)
+            log = open(log_path, "w")
+            p = subprocess.Popen(
+                [
+                    sys.executable, "-c",
+                    runner.format(
+                        here=here, path=path, addrs=addresses, i=i,
+                        cap=n_events + 2 * BATCH + 1024,
+                    ),
+                ],
+                stdout=log, stderr=subprocess.STDOUT, cwd=here,
+            )
+            procs.append(p)
+        deadline = time.time() + 120
+        for lp in log_paths:
+            while time.time() < deadline:
+                try:
+                    if "listening" in open(lp).read():
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.5)
+            else:
+                raise AssertionError(f"replica did not start: {lp}")
+
+        client = Client(addresses, 12, timeout_ms=60_000)
+        n_acct = 1_000
+        ids = np.arange(1, n_acct + 1, dtype=np.uint64)
+        acct = np.frombuffer(accounts_bytes(ids), dtype=ACCOUNT_DTYPE)
+        reply = client._native.request(
+            Operation.create_accounts, acct.tobytes(), 60_000
+        )
+        assert reply == b"", "replicated setup: account failures"
+
+        rng = np.random.default_rng(47)
+        dr = rng.integers(1, n_acct + 1, n_events, np.uint64)
+        bodies = [
+            b
+            for _op, b in batched(
+                {
+                    "ids": np.arange(1, n_events + 1, dtype=np.uint64),
+                    "dr": dr,
+                    "cr": dr % np.uint64(n_acct) + np.uint64(1),
+                    "amount": rng.integers(1, 100, n_events, np.uint64),
+                }
+            )
+        ]
+        lat = []
+        failed = 0
+        t0 = time.perf_counter()
+        for body in bodies:
+            b0 = time.perf_counter()
+            reply = client._native.request(
+                Operation.create_transfers, body, 60_000
+            )
+            lat.append(time.perf_counter() - b0)
+            failed += len(reply) // 8
+        elapsed = time.perf_counter() - t0
+        assert failed == 0, f"replicated: {failed} transfers failed"
+        lat_ms = np.sort(np.asarray(lat)) * 1e3
+        return {
+            "events_per_sec": round(n_events / elapsed, 1),
+            "events": n_events,
+            "failed_events": failed,
+            "vs_baseline": round(n_events / elapsed / BASELINE_TPS, 4),
+            "engine": "host",
+            "replicas": n_replicas,
+            "device_semantic_pct": 0.0,
+            "request_p50_ms": round(float(lat_ms[len(lat_ms) // 2]), 2),
+            "request_p99_ms": round(float(lat_ms[int(len(lat_ms) * 0.99)]), 2),
+            "request_p100_ms": round(float(lat_ms[-1]), 2),
+            # Context for the absolute number: every replica executes
+            # the full durable path (WAL fsync + LSM spill/compaction),
+            # and this container exposes ONE CPU core (nproc=1), so
+            # three replica processes + the client serialize on it —
+            # p50 is ~3x the single-replica commit latency by
+            # construction.
+            "host_cores": os.cpu_count(),
+        }
+    finally:
+        for p in procs:
+            p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
     from tigerbeetle_tpu.testing.harness import SingleNodeHarness
@@ -619,6 +761,15 @@ def main() -> None:
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     configs_out["durable"] = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--replicated-only"],
+        capture_output=True, text=True, timeout=3600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    configs_out["replicated"] = json.loads(
+        proc.stdout.strip().splitlines()[-1]
+    )
 
     for name, gen in CONFIGS.items():
         n_events = N_SIMPLE if name == "simple" else N_OTHER
@@ -788,5 +939,7 @@ def trend_tripwire(configs_out: dict) -> list[str]:
 if __name__ == "__main__":
     if "--durable-only" in sys.argv:
         print(json.dumps(run_durable(N_OTHER)))
+    elif "--replicated-only" in sys.argv:
+        print(json.dumps(run_replicated(N_OTHER)))
     else:
         main()

@@ -341,6 +341,13 @@ struct tb_client {
     bool registered = false;
     std::string host;
     uint16_t port = 0;
+    // Additional cluster addresses: retransmits rotate through them so
+    // a view change (new primary without this client's conn) recovers
+    // — the reference client similarly re-targets replicas on timeout
+    // (src/vsr/client.zig).
+    std::vector<std::pair<std::string, uint16_t>> extra_addrs;
+    std::vector<int> extra_conns;
+    size_t target = 0;  // 0 = primary conn, 1.. = extra
     std::vector<uint8_t> reply;
     int32_t last_status = 0;  // 0 ok, -2 evicted, -3 timeout, -4 io
 };
@@ -348,6 +355,30 @@ struct tb_client {
 static int client_connect(tb_client* c) {
     c->conn = tb_bus_connect(c->bus, c->host.c_str(), c->port);
     return c->conn >= 0 ? 0 : -1;
+}
+
+static int client_conn_for_target(tb_client* c) {
+    if (c->target == 0 || c->extra_addrs.empty()) {
+        if (c->conn < 0) client_connect(c);  // primary died: reconnect
+        return c->conn;
+    }
+    size_t idx = (c->target - 1) % c->extra_addrs.size();
+    while (c->extra_conns.size() <= idx) c->extra_conns.push_back(-1);
+    if (c->extra_conns[idx] < 0) {
+        c->extra_conns[idx] = tb_bus_connect(
+            c->bus, c->extra_addrs[idx].first.c_str(),
+            c->extra_addrs[idx].second);
+    }
+    return c->extra_conns[idx] >= 0 ? c->extra_conns[idx] : c->conn;
+}
+
+// A closed connection must not abort the request when other replicas
+// (or a reconnect) can still serve it — invalidate the cached id and
+// let the retransmission rotation recover.
+static void client_note_closed(tb_client* c, int conn) {
+    if (conn == c->conn) c->conn = -1;
+    for (auto& ec : c->extra_conns)
+        if (ec == conn) ec = -1;
 }
 
 tb_client* tb_client_init(const char* host, uint16_t port, uint64_t cluster,
@@ -365,6 +396,10 @@ tb_client* tb_client_init(const char* host, uint16_t port, uint64_t cluster,
         return nullptr;
     }
     return c;
+}
+
+void tb_client_add_address(tb_client* c, const char* host, uint16_t port) {
+    c->extra_addrs.emplace_back(host, port);
 }
 
 void tb_client_deinit(tb_client* c) {
@@ -392,17 +427,37 @@ static int64_t client_roundtrip(tb_client* c, uint8_t operation,
 
     std::vector<uint8_t> msg(header, header + HEADER_SIZE);
     msg.insert(msg.end(), body, body + body_len);
-    if (tb_bus_send(c->bus, c->conn, msg.data(), uint32_t(msg.size())) < 0)
-        return -4;
+    // A failed initial send is not fatal: the retransmission loop
+    // rotates targets (and reconnects) until the timeout.
+    tb_bus_send(c->bus, client_conn_for_target(c), msg.data(),
+                uint32_t(msg.size()));
 
     int waited = 0;
     const int step = 10;
+    // Retransmit cadence: a lost reply (or a request that landed while
+    // the primary was mid-repair or mid-view-change) is recovered by
+    // resending the SAME request — session dedupe returns the stored
+    // reply, so repeats are harmless — ROTATING through the cluster
+    // addresses so a new primary that lacks this client's connection
+    // learns it (reference: src/vsr/client.zig request_timeout
+    // retransmission + replica re-targeting).
+    int next_retransmit = 1000;
     while (waited <= timeout_ms) {
         tb_bus_poll(c->bus, step);
         waited += step;
+        if (waited >= next_retransmit) {
+            next_retransmit += 1000;
+            if (!c->extra_addrs.empty())
+                c->target = (c->target + 1) % (c->extra_addrs.size() + 1);
+            int conn = client_conn_for_target(c);
+            tb_bus_send(c->bus, conn, msg.data(), uint32_t(msg.size()));
+        }
         tb_event ev;
         while (tb_bus_next_event(c->bus, &ev)) {
-            if (ev.type == 4) return -4;  // closed
+            if (ev.type == 4) {  // closed: rotation/reconnect recovers
+                client_note_closed(c, ev.conn);
+                continue;
+            }
             if (ev.type != 3) continue;
             const uint8_t* h = ev.data;
             uint32_t size = get_u32(h + SIZE_OFFSET);

@@ -37,12 +37,19 @@ class Client:
 
     def __init__(self, address: str, cluster_id: int = 0, *,
                  client_id: int | None = None, timeout_ms: int = 10_000) -> None:
-        host, _, port = address.rpartition(":")
+        # `address` may be a comma-separated cluster list: the first is
+        # the initial target; retransmissions rotate through the rest
+        # so view changes recover (reference: src/vsr/client.zig).
+        addrs = address.split(",")
+        host, _, port = addrs[0].rpartition(":")
         if client_id is None:
             client_id = int.from_bytes(__import__("os").urandom(8), "little") | 1
         self._native = NativeClient(
             host or "127.0.0.1", int(port), cluster_id, client_id
         )
+        for extra in addrs[1:]:
+            h, _, p = extra.rpartition(":")
+            self._native.add_address(h or "127.0.0.1", int(p))
         self.timeout_ms = timeout_ms
 
     def close(self) -> None:

@@ -79,6 +79,9 @@ def _load():
             ctypes.c_uint64, ctypes.c_uint64,
         ]
         lib.tb_client_deinit.argtypes = [ctypes.c_void_p]
+        lib.tb_client_add_address.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16,
+        ]
         lib.tb_client_request.restype = ctypes.c_int64
         lib.tb_client_request.argtypes = [
             ctypes.c_void_p, ctypes.c_uint8, ctypes.c_char_p, ctypes.c_uint32,
@@ -170,6 +173,14 @@ class NativeClient:
         if not self._client:
             raise OSError(f"tb_client_init {host}:{port} failed")
         self._reply_buf = ctypes.create_string_buffer(reply_cap)
+
+    def add_address(self, host: str, port: int) -> None:
+        """Additional cluster replica: retransmissions rotate through
+        every known address, so a view change (new primary without
+        this client's connection) recovers."""
+        self._lib.tb_client_add_address(
+            self._client, host.encode(), port
+        )
 
     def request(self, operation: int, body: bytes = b"",
                 timeout_ms: int = 10_000) -> bytes:

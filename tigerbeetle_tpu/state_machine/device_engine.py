@@ -170,16 +170,16 @@ class DeviceEngine:
             return
         self.drain()
         self.flush()
-        if self.sharding is not None:
-            ndev = self.sharding.mesh.devices.size
-            if capacity % ndev != 0:
-                self.sharding = None  # re-place replicated
+        was_sharded = self.sharding is not None
+        if was_sharded and capacity % self.sharding.mesh.devices.size != 0:
+            self.sharding = None  # re-place replicated from here on
         extra = capacity - self.capacity
 
         def widen(table, width, dtype):
-            # Sharded tables re-place through the host (row boundaries
-            # move between devices on grow).
-            base = jax.device_get(table) if self.sharding is not None else table
+            # Previously-sharded tables come back through the host (row
+            # boundaries move between devices on grow, and a dropped
+            # sharding must not leave a committed sharded base behind).
+            base = jax.device_get(table) if was_sharded else table
             return self._place(
                 jnp.concatenate([base, jnp.zeros((extra, width), dtype)])
             )

@@ -45,7 +45,16 @@ from tigerbeetle_tpu.vsr.wire import Command, VsrOperation
 # Timeout cadences, in ticks (reference tunes these in src/constants.zig;
 # ratios preserved: heartbeat << view-change timeout).
 PING_TICKS = 2
-VIEW_CHANGE_TICKS = 10
+# Election timeout: ~5s of primary silence (TICK_NS = 10ms).  This must
+# comfortably exceed the primary's worst-case scheduling + commit stall
+# — an 8190-event durable commit beat runs ~60-100ms, a checkpoint
+# several hundred, and on a single-core host (this container: nproc=1)
+# co-located replicas legitimately starve each other for over a second
+# — or loaded clusters thrash through spurious view changes (observed:
+# the replicated benchmark stalling seconds per false election at the
+# original 100ms setting).  Deterministic simulation tests drive ticks
+# directly, so this only prices real-time failover.
+VIEW_CHANGE_TICKS = 500
 VIEW_CHANGE_RESEND_TICKS = 4
 REPAIR_RETRY_TICKS = 3
 # Scrub one block probe per interval: a full cycle over a 4k-block
