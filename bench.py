@@ -627,6 +627,8 @@ def run_replicated(n_events: int) -> dict:
     addresses = ",".join(f"127.0.0.1:{p}" for p in ports)
     here = os.path.dirname(os.path.abspath(__file__))
     procs = []
+    logs = []
+    client = None
     try:
         for i in range(n_replicas):
             path = os.path.join(tmp, f"0_{i}.tigerbeetle")
@@ -659,6 +661,7 @@ def run_replicated(n_events: int) -> dict:
             log_path = os.path.join(tmp, f"replica{i}.log")
             log_paths.append(log_path)
             log = open(log_path, "w")
+            logs.append(log)
             p = subprocess.Popen(
                 [
                     sys.executable, "-c",
@@ -671,8 +674,13 @@ def run_replicated(n_events: int) -> dict:
             )
             procs.append(p)
         deadline = time.time() + 120
-        for lp in log_paths:
+        for i, lp in enumerate(log_paths):
             while time.time() < deadline:
+                if procs[i].poll() is not None:
+                    raise AssertionError(
+                        f"replica {i} exited rc={procs[i].returncode}:\n"
+                        + open(lp).read()[-2000:]
+                    )
                 try:
                     if "listening" in open(lp).read():
                         break
@@ -680,7 +688,10 @@ def run_replicated(n_events: int) -> dict:
                     pass
                 time.sleep(0.5)
             else:
-                raise AssertionError(f"replica did not start: {lp}")
+                raise AssertionError(
+                    f"replica did not start: {lp}\n"
+                    + open(lp).read()[-2000:]
+                )
 
         client = Client(addresses, 12, timeout_ms=60_000)
         n_acct = 1_000
@@ -737,8 +748,14 @@ def run_replicated(n_events: int) -> dict:
             "host_cores": os.cpu_count(),
         }
     finally:
+        try:
+            client.close()
+        except Exception:
+            pass
         for p in procs:
             p.kill()
+        for log in logs:
+            log.close()
         shutil.rmtree(tmp, ignore_errors=True)
 
 

@@ -1,0 +1,230 @@
+// Asynchronous pipelined client: the packet-queue model of the
+// reference's async API (reference: src/clients/dotnet/TigerBeetle/
+// Client.cs async surface over src/clients/c/tb_client/packet.zig)
+// on the pure-TCP session.
+//
+// Submissions enqueue PACKETS and return Tasks immediately; a worker
+// thread drains the queue, COALESCING adjacent packets of the same
+// batchable operation (create_accounts / create_transfers — the
+// server's logical-batching surface, tigerbeetle_tpu/state_machine/
+// demuxer.py) into one wire request up to BatchMax events, and on
+// reply DEMUXES the result slices back to each packet's Task with
+// indexes rebased to its sub-batch.  One wire request in flight, any
+// number of packets queued — the reference's client pipeline.
+
+using System;
+using System.Collections.Generic;
+using System.Threading;
+using System.Threading.Tasks;
+
+namespace TigerBeetle;
+
+public sealed class AsyncClient : IDisposable
+{
+    private const int EventSize = 128;
+
+    private readonly Client _client;
+    private readonly Queue<Packet> _queue = new();
+    private readonly object _lock = new();
+    private readonly Thread _worker;
+    private volatile bool _closed;
+
+    private sealed class Packet
+    {
+        public readonly byte Operation;
+        public readonly byte[] Body;
+        public readonly TaskCompletionSource<byte[]> Completion =
+            new(TaskCreationOptions.RunContinuationsAsynchronously);
+
+        public Packet(byte operation, byte[] body)
+        {
+            Operation = operation;
+            Body = body;
+        }
+
+        public int EventCount => Body.Length / EventSize;
+    }
+
+    public AsyncClient(string host, int port, ulong cluster)
+    {
+        _client = new Client(host, port, cluster);
+        _worker = new Thread(DrainLoop) { IsBackground = true, Name = "tb-async-client" };
+        _worker.Start();
+    }
+
+    public void Dispose()
+    {
+        _closed = true;
+        lock (_lock) Monitor.PulseAll(_lock);
+        _worker.Join(5_000);
+        FailPending("client disposed");
+        _client.Dispose();
+    }
+
+    private void FailPending(string why)
+    {
+        lock (_lock)
+        {
+            while (_queue.Count > 0)
+            {
+                _queue.Dequeue().Completion.SetException(
+                    new ObjectDisposedException(nameof(AsyncClient), why));
+            }
+        }
+    }
+
+    public Task<CreateResultBatch> CreateAccountsAsync(AccountBatch batch) =>
+        Submit(Client.OpCreateAccounts, batch.ToArray())
+            .ContinueWith(t => new CreateResultBatch(t.Result));
+
+    public Task<CreateResultBatch> CreateTransfersAsync(TransferBatch batch) =>
+        Submit(Client.OpCreateTransfers, batch.ToArray())
+            .ContinueWith(t => new CreateResultBatch(t.Result));
+
+    public Task<AccountBatch> LookupAccountsAsync(IdBatch ids) =>
+        Submit(Client.OpLookupAccounts, ids.ToArray())
+            .ContinueWith(t => new AccountBatch(t.Result));
+
+    public Task<TransferBatch> LookupTransfersAsync(IdBatch ids) =>
+        Submit(Client.OpLookupTransfers, ids.ToArray())
+            .ContinueWith(t => new TransferBatch(t.Result));
+
+    /// <summary>Enqueue one packet; the Task completes when its
+    /// (possibly coalesced) wire request's reply is demuxed.</summary>
+    public Task<byte[]> Submit(byte operation, byte[] body)
+    {
+        var packet = new Packet(operation, body);
+        lock (_lock)
+        {
+            // Re-check under the lock: a concurrent Dispose may have
+            // already drained the queue and stopped the worker.
+            if (_closed)
+            {
+                packet.Completion.SetException(
+                    new ObjectDisposedException(nameof(AsyncClient)));
+                return packet.Completion.Task;
+            }
+            _queue.Enqueue(packet);
+            Monitor.PulseAll(_lock);
+        }
+        return packet.Completion.Task;
+    }
+
+    private static bool Batchable(byte operation) =>
+        operation == Client.OpCreateAccounts
+        || operation == Client.OpCreateTransfers;
+
+    /// <summary>A packet whose FINAL event carries flags.linked has an
+    /// open chain: coalescing another packet behind it would splice
+    /// that packet's first events into the chain.  Both event types
+    /// keep flags as a u16 at byte 118 of the 128-byte record.</summary>
+    private static bool EndsWithOpenChain(byte[] body)
+    {
+        if (body.Length < EventSize) return false;
+        int off = body.Length - EventSize + 118;
+        int flags = body[off] | (body[off + 1] << 8);
+        return (flags & 1) != 0;
+    }
+
+    private void DrainLoop()
+    {
+        while (true)
+        {
+            var group = new List<Packet>();
+            lock (_lock)
+            {
+                while (_queue.Count == 0 && !_closed) Monitor.Wait(_lock);
+                if (_queue.Count == 0) return; // closed and drained
+                var head = _queue.Dequeue();
+                group.Add(head);
+                // Coalesce adjacent same-operation batchable packets
+                // while the combined batch stays within BatchMax.
+                if (Batchable(head.Operation))
+                {
+                    int events = head.EventCount;
+                    while (_queue.Count > 0
+                           && _queue.Peek().Operation == head.Operation
+                           && !EndsWithOpenChain(group[^1].Body)
+                           && events + _queue.Peek().EventCount
+                               <= Client.BatchMax)
+                    {
+                        var next = _queue.Dequeue();
+                        events += next.EventCount;
+                        group.Add(next);
+                    }
+                }
+            }
+            RunGroup(group);
+        }
+    }
+
+    private void RunGroup(List<Packet> group)
+    {
+        int total = 0;
+        foreach (var p in group) total += p.Body.Length;
+        var events = new byte[total];
+        int at = 0;
+        foreach (var p in group)
+        {
+            System.Buffer.BlockCopy(p.Body, 0, events, at, p.Body.Length);
+            at += p.Body.Length;
+        }
+        byte[] reply;
+        try
+        {
+            reply = _client.Request(group[0].Operation, events);
+        }
+        catch (Exception e)
+        {
+            foreach (var p in group) p.Completion.SetException(e);
+            return;
+        }
+        if (group.Count == 1)
+        {
+            group[0].Completion.SetResult(reply);
+            return;
+        }
+        var counts = new int[group.Count];
+        for (int i = 0; i < group.Count; i++) counts[i] = group[i].EventCount;
+        var slices = DemuxSlices(counts, reply);
+        for (int i = 0; i < group.Count; i++)
+        {
+            group[i].Completion.SetResult(slices[i]);
+        }
+    }
+
+    /// <summary>Split a coalesced create_* reply ({index u32, result
+    /// u32} pairs sorted by index) into per-packet slices with rebased
+    /// indexes — the client-side mirror of the server demuxer
+    /// (reference: src/state_machine.zig:133-176 DemuxerType).  Pure
+    /// function: asserted against clients/fixtures/demux.json.</summary>
+    public static byte[][] DemuxSlices(int[] eventCounts, byte[] reply)
+    {
+        int n = reply.Length / 8;
+        var output = new byte[eventCounts.Length][];
+        int cursor = 0;  // next unread result pair
+        int offset = 0;  // first event index of the current packet
+        for (int k = 0; k < eventCounts.Length; k++)
+        {
+            int count = eventCounts[k];
+            int start = cursor;
+            while (cursor < n
+                   && BitConverter.ToUInt32(reply, cursor * 8)
+                       < (uint)(offset + count))
+            {
+                cursor++;
+            }
+            var slice = new byte[(cursor - start) * 8];
+            for (int i = start; i < cursor; i++)
+            {
+                uint index = BitConverter.ToUInt32(reply, i * 8) - (uint)offset;
+                uint result = BitConverter.ToUInt32(reply, i * 8 + 4);
+                BitConverter.GetBytes(index).CopyTo(slice, (i - start) * 8);
+                BitConverter.GetBytes(result).CopyTo(slice, (i - start) * 8 + 4);
+            }
+            offset += count;
+            output[k] = slice;
+        }
+        return output;
+    }
+}

@@ -19,10 +19,10 @@ public sealed class Client : IDisposable
 
     // Operation codes from the generated enum (tigerbeetle_tpu/
     // types.py Operation is the single source of truth).
-    private const byte OpCreateAccounts = (byte)Operation.CreateAccounts;
-    private const byte OpCreateTransfers = (byte)Operation.CreateTransfers;
-    private const byte OpLookupAccounts = (byte)Operation.LookupAccounts;
-    private const byte OpLookupTransfers = (byte)Operation.LookupTransfers;
+    internal const byte OpCreateAccounts = (byte)Operation.CreateAccounts;
+    internal const byte OpCreateTransfers = (byte)Operation.CreateTransfers;
+    internal const byte OpLookupAccounts = (byte)Operation.LookupAccounts;
+    internal const byte OpLookupTransfers = (byte)Operation.LookupTransfers;
 
     private readonly TcpClient _socket;
     private readonly NetworkStream _stream;

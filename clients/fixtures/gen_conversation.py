@@ -1,0 +1,193 @@
+"""Generate conversation.json: a full client<->server wire transcript.
+
+The fixture is the cross-language CONTRACT (VERDICT r3 #5): a scripted
+session — register, create_accounts, create_transfers (with a failure),
+a RETRANSMIT of the same request (byte-identical reply via session
+dedupe), lookups, and a query — recorded as exact request/reply frame
+bytes against a live in-process TCP server whose wall clock is pinned
+(prepare timestamps then derive from event counts alone, so the
+transcript is deterministic and replayable forever).
+
+Every language client asserts its encoder produces EXACTLY these
+request frames and its decoder accepts these reply frames; the
+in-container test (tests/test_client_conversations.py) replays the
+requests against a live server and asserts the reply bytes — so the
+wire contract is verified here with zero toolchains.
+
+Regenerate: python clients/fixtures/gen_conversation.py
+(reference conversation shape: src/scripts/ci.zig:20-62.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+CLUSTER = 3
+CLIENT_LO = 0xC0FFEE
+PINNED_NS = 1_000_000_000
+
+
+def pinned_server(tmp):
+    """A real TCP ReplicaServer with time.time_ns pinned (prepare
+    timestamps then advance by event count only) AND monotonic_ns
+    pinned (the tick cadence never fires, so no pulse/ping op ever
+    lands at a scheduling-dependent position) — deterministic."""
+    time.time_ns = lambda: PINNED_NS  # monkeypatch BEFORE server import
+    time.monotonic_ns = lambda: 0
+
+    from tigerbeetle_tpu.runtime.server import ReplicaServer, format_data_file
+    from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+    path = os.path.join(tmp, "0_0.tigerbeetle")
+    format_data_file(path, cluster=CLUSTER, replica_index=0, replica_count=1)
+    server = ReplicaServer(
+        path, addresses=["127.0.0.1:0"], replica_index=0,
+        state_machine_factory=CpuStateMachine,
+    )
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            server.poll_once(10)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return server, stop, t
+
+
+def build_frames():
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.vsr import wire
+
+    def frame(request, operation, body, command=wire.Command.request):
+        h = wire.make_header(
+            command=command, cluster=CLUSTER, client=CLIENT_LO,
+            request=request, operation=operation,
+        )
+        wire.finalize_header(h, body)
+        return h.tobytes() + body
+
+    steps = []
+    steps.append(("register", frame(0, int(wire.VsrOperation.register), b""), False))
+
+    a = np.zeros(2, types.ACCOUNT_DTYPE)
+    a["id_lo"] = [9001, 9002]
+    a["ledger"] = 1
+    a["code"] = 1
+    steps.append(
+        ("create_accounts", frame(1, int(types.Operation.create_accounts), a.tobytes()), False)
+    )
+
+    t = np.zeros(3, types.TRANSFER_DTYPE)
+    t["id_lo"] = [501, 502, 503]
+    t["debit_account_id_lo"] = [9001, 9001, 9001]
+    t["credit_account_id_lo"] = [9002, 9001, 9002]  # 502: same account
+    t["amount_lo"] = [100, 5, 40]
+    t["ledger"] = 1
+    t["code"] = 1
+    tf = frame(2, int(types.Operation.create_transfers), t.tobytes())
+    steps.append(("create_transfers", tf, False))
+    # Retransmission of the SAME request: session dedupe must return a
+    # byte-identical stored reply (reference: at-most-once sessions,
+    # src/vsr/client_sessions.zig).
+    steps.append(("create_transfers_retransmit", tf, True))
+
+    ids = np.zeros(2, types.U128_PAIR_DTYPE)
+    ids["lo"] = [9001, 9002]
+    steps.append(
+        ("lookup_accounts", frame(3, int(types.Operation.lookup_accounts), ids.tobytes()), False)
+    )
+
+    tids = np.zeros(3, types.U128_PAIR_DTYPE)
+    tids["lo"] = [501, 502, 503]
+    steps.append(
+        ("lookup_transfers", frame(4, int(types.Operation.lookup_transfers), tids.tobytes()), False)
+    )
+
+    f = np.zeros(1, types.ACCOUNT_FILTER_DTYPE)
+    f[0]["account_id_lo"] = 9001
+    f[0]["limit"] = 10
+    f[0]["flags"] = int(types.AccountFilterFlags.debits | types.AccountFilterFlags.credits)
+    steps.append(
+        (
+            "get_account_transfers",
+            frame(5, int(types.Operation.get_account_transfers), f.tobytes()),
+            False,
+        )
+    )
+    return steps
+
+
+def converse(port, steps):
+    from tigerbeetle_tpu.vsr import wire
+
+    HEADER_SIZE = 256
+    sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+    sock.settimeout(30)
+    buf = b""
+    out = []
+    for name, req, is_retransmit in steps:
+        sock.sendall(req)
+        # One reply frame per request.
+        while True:
+            if len(buf) >= HEADER_SIZE:
+                size = int.from_bytes(buf[144:148], "little")
+                if len(buf) >= size:
+                    reply, buf = buf[:size], buf[size:]
+                    break
+            chunk = sock.recv(1 << 20)
+            assert chunk, "server closed"
+            buf += chunk
+        h = wire.header_from_bytes(reply[:HEADER_SIZE])
+        assert wire.verify_header(h, reply[HEADER_SIZE:]), name
+        assert int(h["command"]) == int(wire.Command.reply), name
+        out.append(
+            {
+                "name": name,
+                "retransmit_of": name.replace("_retransmit", "")
+                if is_retransmit
+                else None,
+                "request_hex": req.hex(),
+                "reply_hex": reply.hex(),
+            }
+        )
+    sock.close()
+    return out
+
+
+def generate():
+    tmp = tempfile.mkdtemp(prefix="tb_conv_")
+    server, stop, t = pinned_server(tmp)
+    try:
+        steps = build_frames()
+        out = converse(server.port, steps)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        server.close()
+    return out
+
+
+if __name__ == "__main__":
+    out = generate()
+    # Retransmit reply must equal the original's reply byte-for-byte.
+    by_name = {e["name"]: e for e in out}
+    assert (
+        by_name["create_transfers_retransmit"]["reply_hex"]
+        == by_name["create_transfers"]["reply_hex"]
+    ), "retransmit reply diverged"
+    dest = os.path.join(os.path.dirname(os.path.abspath(__file__)), "conversation.json")
+    with open(dest, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"wrote {dest} ({len(out)} steps)")

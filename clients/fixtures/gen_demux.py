@@ -1,0 +1,73 @@
+"""Generate demux.json: client-side reply-demux test vectors.
+
+Each case: a coalesced create_* reply (sorted {index u32, result u32}
+pairs) + per-packet event counts -> the expected rebased slice per
+packet, produced by the SERVER's own demuxer
+(tigerbeetle_tpu/state_machine/demuxer.py — reference:
+src/state_machine.zig:133-176 DemuxerType).  The async Java/C# clients
+assert their demux against these vectors, so all implementations split
+coalesced replies identically.
+
+Regenerate: python clients/fixtures/gen_demux.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+from tigerbeetle_tpu.state_machine.demuxer import Demuxer
+from tigerbeetle_tpu.types import CREATE_RESULT_DTYPE, Operation
+
+
+def results(pairs):
+    arr = np.zeros(len(pairs), CREATE_RESULT_DTYPE)
+    for i, (idx, res) in enumerate(pairs):
+        arr[i]["index"] = idx
+        arr[i]["result"] = res
+    return arr.tobytes()
+
+
+CASES = [
+    # (name, reply pairs, packet event counts)
+    ("all_ok", [], [3, 2, 4]),
+    ("spread_failures", [(0, 46), (3, 12), (4, 33), (8, 5)], [2, 3, 4]),
+    ("first_packet_only", [(0, 1), (1, 1)], [2, 5]),
+    ("last_packet_only", [(6, 46)], [3, 3, 1]),
+    ("dense", [(i, 40 + (i % 3)) for i in range(9)], [4, 1, 4]),
+    ("single_event_packets", [(1, 5), (2, 6)], [1, 1, 1, 1]),
+]
+
+
+def generate():
+    out = []
+    for name, pairs, counts in CASES:
+        reply = results(pairs)
+        demux = Demuxer(Operation.create_transfers, reply)
+        offset = 0
+        slices = []
+        for count in counts:
+            slices.append(demux.decode(offset, count).hex())
+            offset += count
+        out.append(
+            {
+                "name": name,
+                "reply_hex": reply.hex(),
+                "event_counts": counts,
+                "slices_hex": slices,
+            }
+        )
+    return out
+
+
+if __name__ == "__main__":
+    dest = os.path.join(os.path.dirname(os.path.abspath(__file__)), "demux.json")
+    with open(dest, "w") as fh:
+        json.dump(generate(), fh, indent=1)
+    print(f"wrote {dest}")

@@ -1,0 +1,250 @@
+// Asynchronous pipelined client: the packet-queue model of the
+// reference's async API (reference:
+// src/clients/java/src/main/java/com/tigerbeetle/AsyncRequest.java,
+// src/clients/c/tb_client/packet.zig) over the pure-TCP session.
+//
+// Submissions enqueue PACKETS and return CompletableFutures
+// immediately; a worker thread drains the queue, COALESCING adjacent
+// packets of the same batchable operation (create_accounts /
+// create_transfers — the server's logical-batching surface,
+// tigerbeetle_tpu/state_machine/demuxer.py) into one wire request up
+// to BATCH_MAX events, and on reply DEMUXES the result slices back to
+// each packet's future with indexes rebased to its sub-batch.  The VSR
+// session keeps its at-most-once guarantee: one wire request in
+// flight, any number of packets queued — exactly the reference's
+// client pipeline.
+package com.tigerbeetle;
+
+import java.io.IOException;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.util.ArrayDeque;
+import java.util.ArrayList;
+import java.util.concurrent.CompletableFuture;
+
+public final class AsyncClient implements AutoCloseable {
+    private static final int EVENT_SIZE = 128;
+
+    private final Client client;
+    private final ArrayDeque<Packet> queue = new ArrayDeque<>();
+    private final Thread worker;
+    private volatile boolean closed;
+
+    private static final class Packet {
+        final int operation;
+        final byte[] body;
+        final CompletableFuture<byte[]> future = new CompletableFuture<>();
+
+        Packet(int operation, byte[] body) {
+            this.operation = operation;
+            this.body = body;
+        }
+
+        int eventCount() {
+            return body.length / EVENT_SIZE;
+        }
+    }
+
+    public AsyncClient(String host, int port, long cluster)
+            throws IOException {
+        this.client = new Client(host, port, cluster);
+        this.worker = new Thread(this::drainLoop, "tb-async-client");
+        this.worker.setDaemon(true);
+        this.worker.start();
+    }
+
+    @Override
+    public void close() throws IOException {
+        closed = true;
+        synchronized (queue) {
+            queue.notifyAll();
+        }
+        try {
+            worker.join(5_000);
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();
+        }
+        failPending("client closed");
+        client.close();
+    }
+
+    private void failPending(String why) {
+        synchronized (queue) {
+            for (Packet p : queue) {
+                p.future.completeExceptionally(new IOException(why));
+            }
+            queue.clear();
+        }
+    }
+
+    public CompletableFuture<CreateResultBatch> createAccounts(
+            AccountBatch batch) {
+        return submit(Client.OP_CREATE_ACCOUNTS, batch.toArray())
+            .thenApply(b -> new CreateResultBatch(wrap(b)));
+    }
+
+    public CompletableFuture<CreateResultBatch> createTransfers(
+            TransferBatch batch) {
+        return submit(Client.OP_CREATE_TRANSFERS, batch.toArray())
+            .thenApply(b -> new CreateResultBatch(wrap(b)));
+    }
+
+    public CompletableFuture<AccountBatch> lookupAccounts(IdBatch ids) {
+        return submit(Client.OP_LOOKUP_ACCOUNTS, ids.toArray())
+            .thenApply(b -> new AccountBatch(wrap(b)));
+    }
+
+    public CompletableFuture<TransferBatch> lookupTransfers(IdBatch ids) {
+        return submit(Client.OP_LOOKUP_TRANSFERS, ids.toArray())
+            .thenApply(b -> new TransferBatch(wrap(b)));
+    }
+
+    private static ByteBuffer wrap(byte[] body) {
+        return ByteBuffer.wrap(body).order(ByteOrder.LITTLE_ENDIAN);
+    }
+
+    /** Enqueue one packet; the future completes when its (possibly
+     * coalesced) wire request's reply is demuxed. */
+    public CompletableFuture<byte[]> submit(int operation, byte[] body) {
+        Packet p = new Packet(operation, body);
+        synchronized (queue) {
+            // Re-check under the lock: a concurrent close() may have
+            // already drained the queue and stopped the worker.
+            if (closed) {
+                p.future.completeExceptionally(
+                    new IOException("client closed"));
+                return p.future;
+            }
+            queue.addLast(p);
+            queue.notifyAll();
+        }
+        return p.future;
+    }
+
+    private static boolean batchable(int operation) {
+        return operation == Client.OP_CREATE_ACCOUNTS
+            || operation == Client.OP_CREATE_TRANSFERS;
+    }
+
+    /** A packet whose FINAL event carries flags.linked has an open
+     * chain: coalescing another packet behind it would splice that
+     * packet's first events into the chain (cross-packet
+     * contamination the per-packet API forbids). Both event types
+     * keep flags as a u16 at byte 118 of the 128-byte record. */
+    private static boolean endsWithOpenChain(byte[] body) {
+        if (body.length < EVENT_SIZE) {
+            return false;
+        }
+        int off = body.length - EVENT_SIZE + 118;
+        int flags = (body[off] & 0xFF) | ((body[off + 1] & 0xFF) << 8);
+        return (flags & 1) != 0; // TransferFlags.linked / AccountFlags bit 0
+    }
+
+    private void drainLoop() {
+        while (true) {
+            ArrayList<Packet> group = new ArrayList<>();
+            synchronized (queue) {
+                while (queue.isEmpty() && !closed) {
+                    try {
+                        queue.wait();
+                    } catch (InterruptedException e) {
+                        failPending("worker interrupted");
+                        return;
+                    }
+                }
+                if (queue.isEmpty()) {
+                    return; // closed and drained
+                }
+                Packet head = queue.removeFirst();
+                group.add(head);
+                // Coalesce adjacent same-operation batchable packets
+                // while the combined batch stays within BATCH_MAX and
+                // no packet in the group leaves a linked chain open.
+                if (batchable(head.operation)) {
+                    int events = head.eventCount();
+                    while (!queue.isEmpty()
+                            && queue.peekFirst().operation == head.operation
+                            && !endsWithOpenChain(
+                                group.get(group.size() - 1).body)
+                            && events + queue.peekFirst().eventCount()
+                                <= Client.BATCH_MAX) {
+                        Packet next = queue.removeFirst();
+                        events += next.eventCount();
+                        group.add(next);
+                    }
+                }
+            }
+            runGroup(group);
+        }
+    }
+
+    private void runGroup(ArrayList<Packet> group) {
+        int total = 0;
+        for (Packet p : group) {
+            total += p.body.length;
+        }
+        byte[] events = new byte[total];
+        int at = 0;
+        for (Packet p : group) {
+            System.arraycopy(p.body, 0, events, at, p.body.length);
+            at += p.body.length;
+        }
+        byte[] reply;
+        try {
+            reply = client.request(group.get(0).operation, events);
+        } catch (IOException e) {
+            for (Packet p : group) {
+                p.future.completeExceptionally(e);
+            }
+            return;
+        }
+        if (group.size() == 1) {
+            group.get(0).future.complete(reply);
+            return;
+        }
+        demux(group, reply);
+    }
+
+    private static void demux(ArrayList<Packet> group, byte[] reply) {
+        int[] counts = new int[group.size()];
+        for (int i = 0; i < group.size(); i++) {
+            counts[i] = group.get(i).eventCount();
+        }
+        byte[][] slices = demuxSlices(counts, reply);
+        for (int i = 0; i < group.size(); i++) {
+            group.get(i).future.complete(slices[i]);
+        }
+    }
+
+    /** Split a coalesced create_* reply ({index u32, result u32} pairs
+     * sorted by index) into per-packet slices with rebased indexes —
+     * the client-side mirror of the server demuxer (reference:
+     * src/state_machine.zig:133-176 DemuxerType).  Pure function:
+     * asserted against clients/fixtures/demux.json. */
+    static byte[][] demuxSlices(int[] eventCounts, byte[] reply) {
+        ByteBuffer results = wrap(reply);
+        int n = reply.length / 8;
+        byte[][] out = new byte[eventCounts.length][];
+        int cursor = 0;      // next unread result pair
+        int offset = 0;      // first event index of the current packet
+        for (int k = 0; k < eventCounts.length; k++) {
+            int count = eventCounts[k];
+            int start = cursor;
+            while (cursor < n
+                    && (results.getInt(cursor * 8) & 0xFFFFFFFFL)
+                        < offset + count) {
+                cursor++;
+            }
+            byte[] slice = new byte[(cursor - start) * 8];
+            for (int i = start; i < cursor; i++) {
+                ByteBuffer sb = ByteBuffer.wrap(slice, (i - start) * 8, 8)
+                    .order(ByteOrder.LITTLE_ENDIAN);
+                sb.putInt(results.getInt(i * 8) - offset);
+                sb.putInt(results.getInt(i * 8 + 4));
+            }
+            offset += count;
+            out[k] = slice;
+        }
+        return out;
+    }
+}

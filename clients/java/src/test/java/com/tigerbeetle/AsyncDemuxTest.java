@@ -1,0 +1,60 @@
+// Fixture-driven demux test (plain main, no framework — driven by
+// tests/test_foreign_clients.py, which streams the
+// clients/fixtures/demux.json vectors on stdin as lines of
+//   reply_hex|count,count,...|slice_hex,slice_hex,...
+// with empty hex spelled "-").  Verifies AsyncClient.demuxSlices
+// splits a coalesced create_* reply into per-packet rebased slices
+// exactly as the server's demuxer does.
+package com.tigerbeetle;
+
+import java.io.BufferedReader;
+import java.io.InputStreamReader;
+
+public final class AsyncDemuxTest {
+    public static void main(String[] args) throws Exception {
+        BufferedReader in =
+            new BufferedReader(new InputStreamReader(System.in));
+        String line;
+        int cases = 0;
+        while ((line = in.readLine()) != null) {
+            if (line.isEmpty()) {
+                continue;
+            }
+            String[] parts = line.split("\\|", -1);
+            byte[] reply = unhex(parts[0]);
+            String[] countStrs = parts[1].split(",");
+            String[] slices = parts[2].split(",", -1);
+            int[] counts = new int[countStrs.length];
+            for (int i = 0; i < counts.length; i++) {
+                counts[i] = Integer.parseInt(countStrs[i]);
+            }
+            byte[][] got = AsyncClient.demuxSlices(counts, reply);
+            for (int i = 0; i < counts.length; i++) {
+                byte[] want = unhex(slices[i]);
+                if (!java.util.Arrays.equals(got[i], want)) {
+                    System.err.println(
+                        "case " + cases + " packet " + i + " demux mismatch");
+                    System.exit(1);
+                }
+            }
+            cases++;
+        }
+        if (cases == 0) {
+            System.err.println("no demux cases on stdin");
+            System.exit(1);
+        }
+        System.out.println("demux ok (" + cases + " cases)");
+    }
+
+    private static byte[] unhex(String s) {
+        if (s.equals("-")) {
+            return new byte[0];
+        }
+        byte[] out = new byte[s.length() / 2];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = (byte) Integer.parseInt(
+                s.substring(2 * i, 2 * i + 2), 16);
+        }
+        return out;
+    }
+}

@@ -1,0 +1,78 @@
+// Pipelined async e2e against a live server (spawned by
+// tests/test_foreign_clients.py): N create_transfers batches and
+// interleaved lookups submitted WITHOUT awaiting — the worker
+// coalesces adjacent create batches into one wire request and demuxes
+// the reply back per-packet — then every future is awaited and
+// checked.  Plain main(); prints "async e2e ok" on success.
+package com.tigerbeetle;
+
+import java.util.ArrayList;
+import java.util.concurrent.CompletableFuture;
+
+public final class AsyncE2ETest {
+    public static void main(String[] args) throws Exception {
+        String addr = System.getenv("TB_ADDRESS");
+        long cluster = Long.parseLong(System.getenv("TB_CLUSTER"));
+        String[] parts = addr.split(":");
+        try (AsyncClient client = new AsyncClient(
+                parts[0], Integer.parseInt(parts[1]), cluster)) {
+            AccountBatch accounts = new AccountBatch(4);
+            for (int id = 1; id <= 4; id++) {
+                accounts.add();
+                accounts.setId(id, 0);
+                accounts.setLedger(1);
+                accounts.setCode(1);
+            }
+            expect(client.createAccounts(accounts).get().getLength() == 0,
+                   "create_accounts failures");
+
+            // 8 single-transfer batches in flight at once; batch k uses
+            // amount 10+k, and every odd batch is invalid (same debit
+            // and credit account) so the demuxed failures interleave.
+            ArrayList<CompletableFuture<CreateResultBatch>> futs =
+                new ArrayList<>();
+            for (int k = 0; k < 8; k++) {
+                TransferBatch batch = new TransferBatch(1);
+                batch.add();
+                batch.setId(100 + k, 0);
+                batch.setDebitAccountId(1, 0);
+                batch.setCreditAccountId(k % 2 == 1 ? 1 : 2, 0);
+                batch.setAmount(10 + k, 0);
+                batch.setLedger(1);
+                batch.setCode(1);
+                futs.add(client.createTransfers(batch));
+            }
+            IdBatch ids = new IdBatch(2);
+            ids.add(1, 0);
+            ids.add(2, 0);
+            CompletableFuture<AccountBatch> lookup = client.lookupAccounts(ids);
+            for (int k = 0; k < 8; k++) {
+                CreateResultBatch r = futs.get(k).get();
+                if (k % 2 == 1) {
+                    expect(r.getLength() == 1, "odd batch " + k + " must fail");
+                    r.next();
+                    expect(r.getIndex() == 0, "rebased index");
+                    expect(r.getResult()
+                               == Types.CreateTransferResult
+                                     .AccountsMustBeDifferent.value,
+                           "odd batch " + k + " result " + r.getResult());
+                } else {
+                    expect(r.getLength() == 0, "even batch " + k + " failed");
+                }
+            }
+            AccountBatch rows = lookup.get();
+            expect(rows.getLength() == 2, "lookup rows");
+            // Debits on account 1: amounts 10+0,10+2,10+4,10+6 = 52.
+            rows.next();
+            expect(rows.getDebitsPostedLo() == 52, "debits_posted");
+        }
+        System.out.println("async e2e ok");
+    }
+
+    static void expect(boolean ok, String what) {
+        if (!ok) {
+            System.err.println("FAIL: " + what);
+            System.exit(1);
+        }
+    }
+}

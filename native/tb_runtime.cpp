@@ -450,6 +450,8 @@ static int64_t client_roundtrip(tb_client* c, uint8_t operation,
             if (!c->extra_addrs.empty())
                 c->target = (c->target + 1) % (c->extra_addrs.size() + 1);
             int conn = client_conn_for_target(c);
+            if (conn < 0 && c->extra_addrs.empty())
+                return -4;  // single address, reconnect refused: fail fast
             tb_bus_send(c->bus, conn, msg.data(), uint32_t(msg.size()));
         }
         tb_event ev;

@@ -83,13 +83,16 @@ def _load():
             return _lib
         if os.environ.get("TB_FASTPATH_DISABLE"):
             return None
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR], check=True,
-                    capture_output=True, timeout=120,
-                )
-            except (OSError, subprocess.SubprocessError):
+        # Always invoke make: a no-op when fresh, and it rebuilds a
+        # stale prebuilt .so whose missing symbols would fail the
+        # argtypes registration below.
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR], check=True,
+                capture_output=True, timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            if not os.path.exists(_LIB_PATH):
                 return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)

@@ -42,13 +42,17 @@ def _load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR], check=True,
-                    capture_output=True, timeout=120,
-                )
-            except (OSError, subprocess.SubprocessError):
+        # Always invoke make: the Makefile's dependency tracking makes
+        # this a no-op when the library is fresh, and it REBUILDS a
+        # stale prebuilt .so whose symbols would otherwise fail the
+        # argtypes registration below with an AttributeError.
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR], check=True,
+                capture_output=True, timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            if not os.path.exists(_LIB_PATH):
                 return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
