@@ -44,9 +44,9 @@ import jax.numpy as jnp
 
 from tigerbeetle_tpu.state_machine import device_kernels as dk
 
-_FETCH_EVERY = int(os.environ.get("TB_DEV_FETCH", "48"))
+_FETCH_EVERY = int(os.environ.get("TB_DEV_FETCH", "96"))
 _RING = int(os.environ.get("TB_DEV_RING", "256"))
-_STAGE = int(os.environ.get("TB_DEV_STAGE", "8"))
+_STAGE = int(os.environ.get("TB_DEV_STAGE", "16"))
 
 
 class ReplyFuture:
