@@ -472,3 +472,52 @@ def test_two_phase_cross_batch_durable_targets():
         (Operation.lookup_transfers, hz.ids_bytes([30, 31, 32, 33, 34]))
     )
     replay_both(h_d, h_c, ops)
+
+
+def test_hot_tail_store_equivalence():
+    """The C wire->store decode tail in _finish_native_fast must write
+    EXACTLY the columns the shared _post_process_transfers path does —
+    the two implementations are pinned together here so a bookkeeping
+    change landing in only one fails loudly (per _finish_fast's
+    one-implementation invariant)."""
+    from tigerbeetle_tpu.runtime import fastpath
+    from tigerbeetle_tpu.state_machine.tpu import _STORE_FIELDS
+
+    if fastpath._load() is None:
+        pytest.skip("native library unavailable")
+
+    results = {}
+    for hot in (True, False):
+        rng = np.random.default_rng(11)  # same stream both runs
+        sm = TpuStateMachine(account_capacity=1 << 12)
+        if sm._native is None:
+            pytest.skip("native fastpath unavailable")
+        if not hot:
+            # Disabling the native fast path routes the same batch
+            # through the Python fast path + the SHARED bookkeeping
+            # (_finish_fast -> _post_process_transfers).
+            sm._native = None
+        h = hz.SingleNodeHarness(sm)
+        h.submit(Operation.create_accounts, accounts(range(1, 51)))
+        rows = []
+        for i in range(400):
+            dr = int(rng.integers(1, 51))
+            cr = dr % 50 + 1
+            flags = int(TF.pending) if i % 5 == 0 else 0
+            rows.append(
+                dict(id=1000 + i, debit_account_id=dr,
+                     credit_account_id=cr,
+                     amount=int(rng.integers(1, 90)), flags=flags)
+            )
+        h.submit(Operation.create_transfers, transfers(rows))
+        store = sm._store
+        results[hot] = {
+            name: np.asarray(store.col(name)).copy()
+            for name in _STORE_FIELDS
+        }
+
+    for name in results[True]:
+        assert (results[True][name] == results[False][name]).all(), (
+            f"store column {name} diverges between the hot tail and "
+            "the shared bookkeeping path"
+        )

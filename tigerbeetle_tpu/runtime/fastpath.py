@@ -157,8 +157,45 @@ def _load():
             ctypes.POINTER(_U8P), _I64P, ctypes.c_int32,
             _U8P, _U8P, _U8P,
         ]
+        lib.tb_fp_decode_store.argtypes = [
+            _U8P, ctypes.c_uint32, ctypes.c_uint64,
+            _U64P, _U64P, _U64P, _U64P, _U64P, _U64P,
+            _U64P, _U64P, _U64P,
+            _U32P, _U32P, _U32P, _U32P, _U32P, _U64P, _U8P,
+        ]
         _lib = lib
         return _lib
+
+
+def decode_store(events: np.ndarray, n: int, ts_base: int,
+                 cols: dict, lo: int) -> None:
+    """One C pass: wire Transfer records -> contiguous store columns
+    written in place at cols[name][lo:lo+n] (tpu.py _STORE_FIELDS
+    minus dr/cr slots).  PRECONDITION: every event applied — callers
+    with failures take the shared slow path.  `events` is the
+    contiguous wire-record array (read-only frombuffer views are fine
+    — the C side only reads)."""
+    lib = _load()
+    assert lib is not None
+    assert events.flags["C_CONTIGUOUS"]
+
+    def at(name, ptype):
+        arr = cols[name]
+        return ctypes.cast(
+            arr.ctypes.data + lo * arr.dtype.itemsize, ptype
+        )
+
+    lib.tb_fp_decode_store(
+        ctypes.cast(events.__array_interface__["data"][0], _U8P),
+        n, ts_base,
+        at("id_lo", _U64P), at("id_hi", _U64P),
+        at("amount_lo", _U64P), at("amount_hi", _U64P),
+        at("pending_lo", _U64P), at("pending_hi", _U64P),
+        at("ud128_lo", _U64P), at("ud128_hi", _U64P), at("ud64", _U64P),
+        at("ud32", _U32P), at("timeout", _U32P), at("ledger", _U32P),
+        at("code", _U32P), at("flags", _U32P), at("timestamp", _U64P),
+        at("status", _U8P),
+    )
 
 
 def _p(arr: np.ndarray, ptype):
