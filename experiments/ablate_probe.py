@@ -1,0 +1,85 @@
+"""r5: ablation of _orderfree cost: ladder / accum / summary."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+A = 1 << 12
+B = dk.B
+rng = np.random.default_rng(0)
+n = B
+dr = rng.integers(0, 1000, n)
+pk = dk.pack_base(
+    n,
+    id_lo=np.arange(1, n + 1, dtype=np.uint64), id_hi=np.zeros(n, np.uint64),
+    dr_lo=dr.astype(np.uint64) + 1, dr_hi=np.zeros(n, np.uint64),
+    cr_lo=(dr.astype(np.uint64) % 1000) + 2, cr_hi=np.zeros(n, np.uint64),
+    pend_lo=np.zeros(n, np.uint64), pend_hi=np.zeros(n, np.uint64),
+    amount_lo=rng.integers(1, 100, n).astype(np.uint64),
+    amount_hi=np.zeros(n, np.uint64),
+    flags=np.zeros(n, np.uint32), ledger=np.ones(n, np.uint32),
+    code=np.ones(n, np.uint32), timeout=np.zeros(n, np.uint32),
+    ts_nonzero=np.zeros(n, bool),
+    dr_slot=dr.astype(np.int64), cr_slot=((dr + 1) % 1000).astype(np.int64),
+    e_found=np.zeros(n, bool),
+)
+pkj = jax.device_put(pk)
+meta = jnp.ones((A, 2), jnp.uint32)
+table0 = jnp.zeros((A, 8), jnp.uint64)
+ring0 = jnp.zeros((256, dk.SUMMARY_WORDS), jnp.uint64)
+
+
+def variant(which):
+    def f(table, ring, ring_at, pk, n, ts_base):
+        ev = dk._unpack(pk)
+        iota = jnp.arange(B, dtype=jnp.int64)
+        active = iota < n
+        if which in ("full", "noaccum", "nosummary", "ladder_only"):
+            r = dk._static_ladder_normal(ev, meta, active)
+        else:
+            r = jnp.where(active, jnp.uint32(0), jnp.uint32(1))
+        ts_i = ts_base + iota.astype(jnp.uint64)
+        expires = ts_i + ev["timeout"] * dk.NS_PER_S
+        ov_timeout = (ev["timeout"] != 0) & (expires < ts_i)
+        r = jnp.where((r == 0) & ov_timeout, jnp.uint32(62), r)
+        ok = active & (r == 0)
+        if which in ("full", "nosummary", "accum_only"):
+            is_pending = (ev["flags"] & dk.F_PENDING) != 0
+            dcol = jnp.where(is_pending, 0, 1)
+            ccol = jnp.where(is_pending, 2, 3)
+            slot_rows = jnp.concatenate([ev["dr_slot"], ev["cr_slot"]])
+            col_rows = jnp.concatenate([dcol, ccol])
+            amt_lo2 = jnp.concatenate([ev["amt_lo"]] * 2)
+            amt_hi2 = jnp.concatenate([ev["amt_hi"]] * 2)
+            valid = jnp.concatenate([ok, ok])
+            d_lo, d_hi, limb_ov = dk._accum_cols(
+                slot_rows, col_rows, amt_lo2, amt_hi2, valid, A, lo_only=True
+            )
+            table, ov = dk._admit_apply(table, d_lo, d_hi, limb_ov)
+        else:
+            ov = jnp.bool_(False)
+        if which in ("full", "noaccum", "ladder_only"):
+            applied_idx = jnp.where(ok, iota, -1)
+            last_applied = applied_idx.max()
+            fw = jnp.where(ov, jnp.uint64(dk.FLAG_OVERFLOW), jnp.uint64(0))
+            s = dk._summary(r, active, fw, last_applied)
+            ring = jax.lax.dynamic_update_slice(ring, s[None, :], (ring_at, 0))
+        return table, ring
+
+    return jax.jit(f)
+
+
+for which in ("full", "noaccum", "nosummary", "ladder_only", "accum_only"):
+    fn = variant(which)
+    t, r = fn(table0, ring0, 0, pkj, n, jnp.uint64(1))
+    jax.block_until_ready((t, r))
+    K = 32
+    t0 = time.perf_counter()
+    t2, r2 = table0, ring0
+    for k in range(K):
+        t2, r2 = fn(t2, r2, k % 256, pkj, n, jnp.uint64(1))
+    jax.block_until_ready((t2, r2))
+    dt = time.perf_counter() - t0
+    print(f"{which:12s}: {dt/K*1e3:6.2f} ms/batch")

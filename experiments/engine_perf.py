@@ -43,6 +43,10 @@ def main():
     for op, body in setup + warm:
         h.submit(op, body)
     sm.sync()
+    eng0 = sm._dev
+    eng0.stat_t_h2d = eng0.stat_t_dispatch = 0.0
+    eng0.stat_t_fetch = eng0.stat_t_finish = 0.0
+    eng0.stat_fetches = 0
 
     t0 = time.perf_counter()
     futs = [h.submit_async(op, body) for op, body in timed]
@@ -54,10 +58,13 @@ def main():
     failed = sum(len(r) // 8 for r in replies)
     eng = sm._dev
     print(
-        f"STAGE={os.environ.get('TB_DEV_STAGE', '8')} "
-        f"FETCH={os.environ.get('TB_DEV_FETCH', '48')}: "
+        f"WINDOW={os.environ.get('TB_DEV_WINDOW', '96')}: "
         f"{N/dt:,.0f} ev/s  ({dt:.2f}s, failed={failed}, "
         f"fetches={eng.stat_fetches}, semantic={eng.stat_semantic_events})"
+    )
+    print(
+        f"  split: h2d={eng.stat_t_h2d:.2f}s dispatch={eng.stat_t_dispatch:.2f}s "
+        f"fetch={eng.stat_t_fetch:.2f}s finish={eng.stat_t_finish:.2f}s"
     )
 
 

@@ -1,0 +1,68 @@
+"""Device time per production semantic kernel (r5): dispatch K kernels
+with device-resident inputs, block at the end; per-kernel ms."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+A = 1 << 12
+rng = np.random.default_rng(0)
+n = dk.B
+dr = rng.integers(0, 1000, n)
+pk = dk.pack_base(
+    n,
+    id_lo=np.arange(1, n + 1, dtype=np.uint64), id_hi=np.zeros(n, np.uint64),
+    dr_lo=dr.astype(np.uint64) + 1, dr_hi=np.zeros(n, np.uint64),
+    cr_lo=(dr.astype(np.uint64) % 1000) + 2, cr_hi=np.zeros(n, np.uint64),
+    pend_lo=np.zeros(n, np.uint64), pend_hi=np.zeros(n, np.uint64),
+    amount_lo=rng.integers(1, 100, n).astype(np.uint64),
+    amount_hi=np.zeros(n, np.uint64),
+    flags=np.zeros(n, np.uint32), ledger=np.ones(n, np.uint32),
+    code=np.ones(n, np.uint32), timeout=np.zeros(n, np.uint32),
+    ts_nonzero=np.zeros(n, bool),
+    dr_slot=dr.astype(np.int64), cr_slot=((dr + 1) % 1000).astype(np.int64),
+    e_found=np.zeros(n, bool),
+)
+G = 8
+buf = np.tile(pk, (G, 1))
+sup = jax.device_put(buf)
+balances = jnp.zeros((A, 8), jnp.uint64)
+meta = jnp.ones((A, 2), jnp.uint32)
+ring = jnp.zeros((256, dk.SUMMARY_WORDS), jnp.uint64)
+
+for name in ("orderfree_lo_staged", "orderfree_staged", "linked_staged",
+             "two_phase_lo_staged"):
+    kern = getattr(dk, name)
+    ncols = sup.shape[1]
+    s = sup
+    if name.startswith("two_phase"):
+        pk_tp = dk.pack_base(
+            n,
+            id_lo=np.arange(1, n + 1, dtype=np.uint64), id_hi=np.zeros(n, np.uint64),
+            dr_lo=dr.astype(np.uint64) + 1, dr_hi=np.zeros(n, np.uint64),
+            cr_lo=(dr.astype(np.uint64) % 1000) + 2, cr_hi=np.zeros(n, np.uint64),
+            pend_lo=np.zeros(n, np.uint64), pend_hi=np.zeros(n, np.uint64),
+            amount_lo=rng.integers(1, 100, n).astype(np.uint64),
+            amount_hi=np.zeros(n, np.uint64),
+            flags=np.zeros(n, np.uint32), ledger=np.ones(n, np.uint32),
+            code=np.ones(n, np.uint32), timeout=np.zeros(n, np.uint32),
+            ts_nonzero=np.zeros(n, bool),
+            dr_slot=dr.astype(np.int64), cr_slot=((dr + 1) % 1000).astype(np.int64),
+            e_found=np.zeros(n, bool),
+            p_found=np.zeros(n, bool), p_tgt=np.full(n, -1, np.int64),
+            n_cols=dk.N_COLS_TP,
+        )
+        s = jax.device_put(np.tile(pk_tp, (G, 1)))
+    # warm
+    b, r = kern(balances, meta, ring, 0, s, 0, n, jnp.uint64(1))
+    jax.block_until_ready(r)
+    K = 32
+    t0 = time.perf_counter()
+    b2, r2 = balances, ring
+    for k in range(K):
+        b2, r2 = kern(b2, meta, r2, k % 256, s, k % G, n, jnp.uint64(1))
+    jax.block_until_ready(r2)
+    dt = time.perf_counter() - t0
+    print(f"{name}: {dt/K*1e3:.2f} ms/batch -> {n/(dt/K):,.0f} ev/s")

@@ -1,0 +1,84 @@
+"""r5: (a) engine-pattern dispatch (fresh h2d per G batches) with the
+production kernel; (b) lax.scan over G batches in one call."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+A = 1 << 12
+rng = np.random.default_rng(0)
+n = dk.B
+dr = rng.integers(0, 1000, n)
+pk = dk.pack_base(
+    n,
+    id_lo=np.arange(1, n + 1, dtype=np.uint64), id_hi=np.zeros(n, np.uint64),
+    dr_lo=dr.astype(np.uint64) + 1, dr_hi=np.zeros(n, np.uint64),
+    cr_lo=(dr.astype(np.uint64) % 1000) + 2, cr_hi=np.zeros(n, np.uint64),
+    pend_lo=np.zeros(n, np.uint64), pend_hi=np.zeros(n, np.uint64),
+    amount_lo=rng.integers(1, 100, n).astype(np.uint64),
+    amount_hi=np.zeros(n, np.uint64),
+    flags=np.zeros(n, np.uint32), ledger=np.ones(n, np.uint32),
+    code=np.ones(n, np.uint32), timeout=np.zeros(n, np.uint32),
+    ts_nonzero=np.zeros(n, bool),
+    dr_slot=dr.astype(np.int64), cr_slot=((dr + 1) % 1000).astype(np.int64),
+    e_found=np.zeros(n, bool),
+)
+G = 8
+buf = np.tile(pk, (G, 1))
+balances = jnp.zeros((A, 8), jnp.uint64)
+meta = jnp.ones((A, 2), jnp.uint32)
+ring = jnp.zeros((256, dk.SUMMARY_WORDS), jnp.uint64)
+
+# (a) engine pattern: fresh device_put per G dispatches.
+kern = dk.orderfree_lo_staged
+sup = jax.device_put(buf)
+b, r = kern(balances, meta, ring, 0, sup, 0, n, jnp.uint64(1))
+jax.block_until_ready(r)
+K = 64
+t0 = time.perf_counter()
+b2, r2 = balances, ring
+for k in range(K):
+    if k % G == 0:
+        sup = jax.device_put(buf)
+    b2, r2 = kern(b2, meta, r2, k % 256, sup, k % G, n, jnp.uint64(1))
+np.asarray(r2)
+dt = time.perf_counter() - t0
+print(f"engine-pattern: {dt/K*1e3:.2f} ms/batch -> {n/(dt/K):,.0f} ev/s")
+
+# (b) scan over G batches in one jitted call.
+from functools import partial
+
+def scan_g(table, ring, ring_at0, sup, ns, ts_bases):
+    def step(carry, xs):
+        table, ring = carry
+        g, nn, tsb = xs
+        pk_g = jax.lax.dynamic_slice(
+            sup, (g * dk.B, 0), (dk.B, dk.N_COLS)
+        )
+        table, ring = dk._orderfree(
+            table, meta, ring, ring_at0 + g, pk_g, nn, tsb, lo_only=True
+        )
+        return (table, ring), None
+
+    (table, ring), _ = jax.lax.scan(
+        step, (table, ring),
+        (jnp.arange(G), ns, ts_bases),
+    )
+    return table, ring
+
+jscan = jax.jit(scan_g)
+ns = jnp.full(G, n)
+tsb = jnp.arange(G, dtype=jnp.uint64)
+sup = jax.device_put(buf)
+b, r = jscan(balances, ring, 0, sup, ns, tsb)
+jax.block_until_ready(r)
+t0 = time.perf_counter()
+b2, r2 = balances, ring
+for k in range(K // G):
+    sup = jax.device_put(buf)
+    b2, r2 = jscan(b2, r2, (k * G) % 128, sup, ns, tsb)
+np.asarray(r2)
+dt = time.perf_counter() - t0
+print(f"scan-G={G}:      {dt/K*1e3:.2f} ms/batch -> {n/(dt/K):,.0f} ev/s")

@@ -1,0 +1,54 @@
+"""r5: sweep superbatch depth G (one h2d per G batches) x fetch cadence
+R with the production orderfree_lo kernel."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+A = 1 << 12
+rng = np.random.default_rng(0)
+n = dk.B
+dr = rng.integers(0, 1000, n)
+pk = dk.pack_base(
+    n,
+    id_lo=np.arange(1, n + 1, dtype=np.uint64), id_hi=np.zeros(n, np.uint64),
+    dr_lo=dr.astype(np.uint64) + 1, dr_hi=np.zeros(n, np.uint64),
+    cr_lo=(dr.astype(np.uint64) % 1000) + 2, cr_hi=np.zeros(n, np.uint64),
+    pend_lo=np.zeros(n, np.uint64), pend_hi=np.zeros(n, np.uint64),
+    amount_lo=rng.integers(1, 100, n).astype(np.uint64),
+    amount_hi=np.zeros(n, np.uint64),
+    flags=np.zeros(n, np.uint32), ledger=np.ones(n, np.uint32),
+    code=np.ones(n, np.uint32), timeout=np.zeros(n, np.uint32),
+    ts_nonzero=np.zeros(n, bool),
+    dr_slot=dr.astype(np.int64), cr_slot=((dr + 1) % 1000).astype(np.int64),
+    e_found=np.zeros(n, bool),
+)
+meta = jnp.ones((A, 2), jnp.uint32)
+kern = dk.orderfree_lo_staged
+
+for G, R in ((8, 128), (16, 128), (32, 128), (64, 128), (32, 64), (64, 64)):
+    buf = np.tile(pk, (G, 1))
+    balances = jnp.zeros((A, 8), jnp.uint64)
+    ring = jnp.zeros((256, dk.SUMMARY_WORDS), jnp.uint64)
+    sup = jax.device_put(buf)
+    b, r = kern(balances, meta, ring, 0, sup, 0, n, jnp.uint64(1))
+    jax.block_until_ready(r)
+    K = 2 * R
+    t0 = time.perf_counter()
+    b2, r2 = balances, ring
+    k = 0
+    for i in range(K):
+        if i % G == 0:
+            sup = jax.device_put(buf)
+        b2, r2 = kern(b2, meta, r2, k, sup, i % G, n, jnp.uint64(1))
+        k += 1
+        if k == R:
+            np.asarray(r2)
+            k = 0
+    if k:
+        np.asarray(r2)
+    dt = time.perf_counter() - t0
+    print(f"G={G:2d} R={R:3d}: {dt/K*1e3:6.2f} ms/batch -> "
+          f"{n/(dt/K):,.0f} ev/s")

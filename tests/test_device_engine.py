@@ -180,7 +180,7 @@ def test_fallback_recovery_redispatches_inflight(monkeypatch):
     against the corrected table."""
     import tigerbeetle_tpu.state_machine.device_engine as de
 
-    monkeypatch.setattr(de, "_FETCH_EVERY", 64)
+    monkeypatch.setattr(de, "_WINDOW", 64)
     h_d, h_c = mk_pair()
     big = (1 << 127) + 5
     ops = [(Operation.create_accounts, accounts([1, 2, 3]))]
@@ -378,7 +378,7 @@ def test_lookup_accounts_sees_inflight_batches(monkeypatch):
     materialized yet (no drain)."""
     import tigerbeetle_tpu.state_machine.device_engine as de
 
-    monkeypatch.setattr(de, "_FETCH_EVERY", 1000)
+    monkeypatch.setattr(de, "_WINDOW", 1000)
     sm = TpuStateMachine(engine="device")
     h = hz.SingleNodeHarness(sm)
     h.submit(Operation.create_accounts, accounts([1, 2]))
@@ -403,7 +403,7 @@ def test_pipelined_double_finalize_same_pending(monkeypatch):
     already_posted — the code-review repro for the id_keys hazard."""
     import tigerbeetle_tpu.state_machine.device_engine as de
 
-    monkeypatch.setattr(de, "_FETCH_EVERY", 64)
+    monkeypatch.setattr(de, "_WINDOW", 64)
     h_d, h_c = mk_pair()
     ops = [(Operation.create_accounts, accounts([1, 2]))]
     ops.append(

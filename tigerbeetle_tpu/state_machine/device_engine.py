@@ -4,27 +4,36 @@ Owns the authoritative HBM balance table + account-meta table and a
 stream of semantic-kernel dispatches (device_kernels.py).  The host
 submits packed batches and gets back *reply futures*; result codes are
 computed on device, ride the failure-sparse summary ring, and
-materialize when the host fetches the ring — once per burst, because
-the tunneled link's downlink costs ~105 ms per fetch regardless of
-size (experiments/README.md).
+materialize once per execution window.
 
-Execution model
----------------
-- ``submit(kind, pk, n, ts_base, finish, fallback)`` dispatches one
-  kernel against the current table/ring and appends an in-flight
-  record.  Dispatches are asynchronous; the device executes them in
-  stream order, so every kernel sees exactly the committed-so-far
-  state (serial consistency without host round trips).
-- When the in-flight window reaches ``fetch_every`` (or on
-  ``drain()``), the host fetches the ring snapshot ONCE and
-  materializes every covered batch in order: the ``finish`` callback
-  turns device codes into bookkeeping + reply bytes.
-- A batch whose summary carries a fallback flag (balance overflow in
-  play, failure-cap exceeded, precondition violated) triggers exact
-  recovery: the host re-executes that batch through the host engine
-  (``fallback`` callback, which updates the mirror), re-uploads the
-  corrected table, and re-dispatches every later in-flight batch.
-  Replies stay exact for ANY input; the flags only cost latency.
+Execution model (r5: phase-separated windows)
+---------------------------------------------
+The tunneled link's physics (experiments/README.md) dictate the shape:
+a d2h fetch costs ~105 ms regardless of size, and ANY h2d issued while
+kernels are in flight stalls the stream for tens of milliseconds —
+measured end-to-end, interleaving per-G-batch uploads with dispatches
+runs 4x slower than the kernels themselves (experiments/stage_sweep.py).
+So the engine never touches the link while the device is busy:
+
+  submit()  appends the packed batch to a host-side window; NOTHING
+            is dispatched until the window fills (TB_DEV_WINDOW).
+  rotate    at the window boundary: (1) fetch the summary ring for the
+            PREVIOUS window — the fetch drains the stream, leaving the
+            device idle; (2) while idle, upload the new window's
+            superbatches in one h2d per column layout and pull any
+            lookup-gather handles; (3) dispatch every kernel of the new
+            window back-to-back — zero in-stream transfers; (4) only
+            then run the previous window's host bookkeeping (finish
+            callbacks), overlapped with the device crunching the new
+            window.
+
+A batch whose summary carries a fallback flag (balance overflow in
+play, failure-cap exceeded, precondition violated) triggers exact
+recovery BEFORE the next window launches: the host re-executes that
+batch through the host engine (``fallback`` callback, which updates
+the mirror), re-uploads the corrected table, and re-dispatches every
+later in-flight record.  Replies stay exact for ANY input; the flags
+only cost latency.
 
 The pipeline also carries the write-behind lane the host exact path
 uses (``enqueue``/``flush``, same contract as kernel_fast.DeviceTable)
@@ -36,6 +45,7 @@ balances from the authoritative table (not the host mirror).
 from __future__ import annotations
 
 import os
+import time as _time
 
 import numpy as np
 
@@ -44,13 +54,13 @@ import jax.numpy as jnp
 
 from tigerbeetle_tpu.state_machine import device_kernels as dk
 
-_FETCH_EVERY = int(os.environ.get("TB_DEV_FETCH", "96"))
+_WINDOW = int(os.environ.get("TB_DEV_WINDOW", "96"))
 _RING = int(os.environ.get("TB_DEV_RING", "256"))
-_STAGE = int(os.environ.get("TB_DEV_STAGE", "16"))
+assert 2 * _WINDOW <= _RING, "ring must hold two windows of summaries"
 
 
 class ReplyFuture:
-    """Reply bytes that materialize at the batch's ring fetch."""
+    """Reply bytes that materialize at the batch's window rotation."""
 
     __slots__ = ("_value", "_engine")
 
@@ -72,18 +82,18 @@ class ReplyFuture:
 
 
 class _InFlight:
-    """One stream entry: a dispatched semantic batch or a lookup
-    gather, in submission order (ordering matters for exact fallback
-    recovery)."""
+    """One stream entry, in submission order (ordering matters for
+    exact fallback recovery): a semantic batch, a lookup gather, or an
+    account-meta update."""
 
     __slots__ = (
         "kind", "pk", "n", "ts_base", "finish", "fallback", "future",
-        "ring_at", "id_keys", "handle", "slots",
+        "ring_at", "id_keys", "handle", "slots", "rows", "meta_args",
     )
 
     def __init__(self, kind, future, finish, *, pk=None, n=0, ts_base=0,
                  fallback=None, ring_at=-1, id_keys=None, handle=None,
-                 slots=None):
+                 slots=None, meta_args=None):
         self.kind = kind
         self.pk = pk
         self.n = n
@@ -95,14 +105,22 @@ class _InFlight:
         self.id_keys = id_keys  # sorted u128-packed ids (hazard probes)
         self.handle = handle    # lookup gather output handle
         self.slots = slots      # lookup slots (for re-gather)
+        self.rows = None        # lookup rows fetched at rotation
+        self.meta_args = meta_args  # (slots, flags, ledger) for "meta"
+
+
+_SEMANTIC_KINDS = (
+    "orderfree", "orderfree_lo", "linked", "two_phase", "two_phase_lo",
+)
 
 
 class DeviceEngine:
-    """Authoritative device tables + semantic dispatch pipeline."""
+    """Authoritative device tables + windowed semantic dispatch."""
 
     def __init__(self, capacity: int, mirror) -> None:
         self.capacity = capacity
         self.mirror = mirror  # host bookkeeping copy (recovery + parity)
+        self.window = _WINDOW
         # Multi-device: the authoritative tables shard ROW-WISE across
         # every visible device (NamedSharding over a 1-D "shard" mesh);
         # the semantic kernels then run SPMD with XLA-inserted
@@ -122,12 +140,11 @@ class DeviceEngine:
         self._meta_host = np.zeros((capacity, 2), np.uint32)
         self.ring = jnp.zeros((_RING, dk.SUMMARY_WORDS), jnp.uint64)
         self._ring_at = 0
-        self._stream: list[_InFlight] = []
-        self._n_batches = 0
-        # Staging: batches accumulate host-side and ship in ONE
-        # superbatch h2d per _STAGE batches (in-stream transfers cost
-        # ~25 ms each on this link; amortize them).
-        self._stage: list[_InFlight] = []
+        # Window pipeline: _pending accumulates host-side; _launched is
+        # the window currently executing on device.
+        self._pending: list[_InFlight] = []
+        self._pending_semantic = 0
+        self._launched: list[_InFlight] = []
         # Write-behind lane for host-resolved batches (exact path).
         self._q: list[tuple] = []
         self._queued = 0
@@ -136,6 +153,11 @@ class DeviceEngine:
         self.stat_semantic_events = 0
         self.stat_fallback_batches = 0
         self.stat_fetches = 0
+        # Wall-time split (seconds) for perf forensics.
+        self.stat_t_h2d = 0.0
+        self.stat_t_dispatch = 0.0
+        self.stat_t_fetch = 0.0
+        self.stat_t_finish = 0.0
 
     def _place(self, table):
         if self.sharding is None:
@@ -143,17 +165,23 @@ class DeviceEngine:
         return jax.device_put(table, self.sharding)
 
     # ------------------------------------------------------------------
-    # Account meta maintenance (create_accounts path).
+    # Account meta maintenance (create_accounts path).  Rides the
+    # record stream so updates sequence between the batches around
+    # them without forcing a drain.
 
     def add_accounts(self, slots, acct_flags, acct_ledger) -> None:
         slots = np.asarray(slots, np.int64)
         self._meta_host[slots, 0] = acct_flags
         self._meta_host[slots, 1] = acct_ledger
-        self.meta = dk.meta_update(
-            self.meta,
-            jnp.asarray(slots),
-            jnp.asarray(np.asarray(acct_flags, np.uint32)),
-            jnp.asarray(np.asarray(acct_ledger, np.uint32)),
+        self._pending.append(
+            _InFlight(
+                "meta", None, None,
+                meta_args=(
+                    slots,
+                    np.asarray(acct_flags, np.uint32),
+                    np.asarray(acct_ledger, np.uint32),
+                ),
+            )
         )
 
     def remove_accounts(self, slots) -> None:
@@ -161,8 +189,8 @@ class DeviceEngine:
         slots = np.asarray(slots, np.int64)
         self._meta_host[slots] = 0
         z = np.zeros(len(slots), np.uint32)
-        self.meta = dk.meta_update(
-            self.meta, jnp.asarray(slots), jnp.asarray(z), jnp.asarray(z)
+        self._pending.append(
+            _InFlight("meta", None, None, meta_args=(slots, z, z))
         )
 
     def grow(self, capacity: int) -> None:
@@ -196,7 +224,7 @@ class DeviceEngine:
 
     def submit(self, kind, pk, n, ts_base, finish, fallback,
                id_keys=None) -> ReplyFuture:
-        """Dispatch one semantic batch; returns its reply future.
+        """Queue one semantic batch; returns its reply future.
 
         `finish(summary) -> bytes` runs at materialization (device codes
         -> bookkeeping + reply).  `fallback() -> bytes` re-executes the
@@ -208,50 +236,72 @@ class DeviceEngine:
             kind, fut, finish, pk=pk, n=n, ts_base=ts_base,
             fallback=fallback, id_keys=id_keys,
         )
-        self._stage.append(rec)
-        self._stream.append(rec)
-        self._n_batches += 1
-        if len(self._stage) >= _STAGE:
-            self._flush_stage()
-        if self._n_batches >= _FETCH_EVERY:
-            self._materialize()
+        self._pending.append(rec)
+        self._pending_semantic += 1
+        if self._pending_semantic >= self.window:
+            self._rotate()
         return fut
 
-    def _flush_stage(self) -> None:
-        """Ship the staged batches' inputs in one superbatch h2d per
-        column layout, then dispatch their kernels in stream order."""
-        stage, self._stage = self._stage, []
-        if not stage:
+    def lookup(self, slots, finish) -> ReplyFuture:
+        """Device-side balance gather for lookup_accounts: rides the
+        record stream, so it sees every earlier batch's effects.
+        `finish(rows)` builds the reply from the fetched (k, 8) rows
+        at materialization."""
+        fut = ReplyFuture(self)
+        slots = np.asarray(slots, np.int64)
+        rec = _InFlight("lookup", fut, finish, slots=slots)
+        self._pending.append(rec)
+        return fut
+
+    def _gather(self, slots):
+        pad = ((len(slots) + 255) & ~255) or 256
+        sl = np.full(pad, -1, np.int64)
+        sl[: len(slots)] = slots
+        return dk.lookup(self.balances, jnp.asarray(sl))
+
+    # ------------------------------------------------------------------
+    # Window launch: one h2d per column layout (device idle at call
+    # time), then back-to-back dispatches with no in-stream transfers.
+
+    def _launch(self, recs: list[_InFlight]) -> None:
+        """Upload every batch's inputs first (device idle: small h2ds
+        are sub-ms, experiments/xfer_probe.py), then dispatch the
+        kernels back-to-back — zero in-stream transfers.  Single-batch
+        (B, C) input shapes keep XLA at one compile per kernel."""
+        if not recs:
             return
-        # One superbatch transfer per column layout; dispatch then
-        # follows STAGE order (cross-layout batches may depend on each
-        # other's balance effects).
-        supers = {}
-        slot_of = {}
-        for ncols in (dk.N_COLS, dk.N_COLS_TP):
-            group = [r for r in stage if r.pk.shape[1] == ncols]
-            if not group:
+        t0 = _time.perf_counter()
+        dev_pk = {}
+        for rec in recs:
+            if rec.kind in _SEMANTIC_KINDS:
+                dev_pk[id(rec)] = jax.device_put(rec.pk)
+        t1 = _time.perf_counter()
+        self.stat_t_h2d += t1 - t0
+        for rec in recs:
+            if rec.kind == "meta":
+                slots, flags, ledger = rec.meta_args
+                self.meta = dk.meta_update(
+                    self.meta, jnp.asarray(slots), jnp.asarray(flags),
+                    jnp.asarray(ledger),
+                )
                 continue
-            buf = np.zeros((_STAGE * dk.B, ncols), np.uint64)
-            for g, rec in enumerate(group):
-                buf[g * dk.B : (g + 1) * dk.B] = rec.pk
-                slot_of[id(rec)] = g
-            supers[ncols] = jax.device_put(buf)
-        for rec in stage:
+            if rec.kind == "lookup":
+                rec.handle = self._gather(rec.slots)
+                continue
             kernel = {
-                "orderfree": dk.orderfree_staged,
-                "orderfree_lo": dk.orderfree_lo_staged,
-                "linked": dk.linked_staged,
-                "two_phase": dk.two_phase_staged,
-                "two_phase_lo": dk.two_phase_lo_staged,
+                "orderfree": dk.orderfree,
+                "orderfree_lo": dk.orderfree_lo,
+                "linked": dk.linked,
+                "two_phase": dk.two_phase,
+                "two_phase_lo": dk.two_phase_lo,
             }[rec.kind]
             self.balances, self.ring = kernel(
                 self.balances, self.meta, self.ring, self._ring_at,
-                supers[rec.pk.shape[1]], slot_of[id(rec)], rec.n,
-                jnp.uint64(rec.ts_base),
+                dev_pk[id(rec)], rec.n, jnp.uint64(rec.ts_base),
             )
             rec.ring_at = self._ring_at
             self._ring_at = (self._ring_at + 1) % _RING
+        self.stat_t_dispatch += _time.perf_counter() - t1
 
     def _dispatch(self, rec: _InFlight) -> None:
         """Immediate single-batch dispatch (fallback re-dispatch path)."""
@@ -269,38 +319,20 @@ class DeviceEngine:
         rec.ring_at = self._ring_at
         self._ring_at = (self._ring_at + 1) % _RING
 
-    def lookup(self, slots, finish) -> ReplyFuture:
-        """Device-side balance gather for lookup_accounts: rides the
-        dispatch stream, so it sees every in-flight batch's effects.
-        `finish(rows)` builds the reply from the fetched (k, 8) rows
-        at materialization."""
-        self._flush_stage()  # gather must sequence after staged batches
-        fut = ReplyFuture(self)
-        slots = np.asarray(slots, np.int64)
-        rec = _InFlight("lookup", fut, finish, slots=slots)
-        rec.handle = self._gather(slots)
-        self._stream.append(rec)
-        return fut
-
-    def _gather(self, slots):
-        pad = ((len(slots) + 255) & ~255) or 256
-        sl = np.full(pad, -1, np.int64)
-        sl[: len(slots)] = slots
-        return dk.lookup(self.balances, jnp.asarray(sl))
-
     # ------------------------------------------------------------------
     # Hazard probe: does any probe id match an in-flight batch's ids?
 
     def inflight_ids_hit(self, keys: np.ndarray) -> bool:
         """keys: u128-packed (V16) id probes, any order."""
-        if not self._stream or len(keys) == 0:
+        stream = self._launched + self._pending
+        if not stream or len(keys) == 0:
             return False
         keys = np.sort(keys)
         # V16 keys order numerically by their bytes; scalar compares go
         # through .tobytes() (numpy void scalars lack ufunc ordering).
         lo = keys[0].tobytes()
         hi = keys[-1].tobytes()
-        for rec in self._stream:
+        for rec in stream:
             ik = rec.id_keys
             if ik is None or len(ik) == 0:
                 continue
@@ -313,32 +345,83 @@ class DeviceEngine:
         return False
 
     def has_inflight(self) -> bool:
-        return bool(self._stream)
+        return bool(self._launched or self._pending)
 
     # ------------------------------------------------------------------
-    # Materialization.
+    # Rotation + materialization.
 
-    def _materialize(self) -> None:
-        """Fetch the ring once; resolve the stream in order.
+    def _fetch_ring(self, recs):
+        """Ring snapshot + lookup-row pulls for a launched window; the
+        fetch drains the device stream (idle on return)."""
+        ring_np = None
+        t0 = _time.perf_counter()
+        if any(r.kind in _SEMANTIC_KINDS for r in recs):
+            self.stat_fetches += 1
+            ring_np = np.asarray(self.ring)  # THE burst fetch
+        for rec in recs:
+            if rec.kind == "lookup" and rec.handle is not None:
+                rec.rows = np.asarray(rec.handle)
+                rec.handle = None
+        self.stat_t_fetch += _time.perf_counter() - t0
+        return ring_np
 
-        On a fallback flag: the host re-executes that batch exactly
-        (updating the mirror), the table is rebuilt from the mirror,
-        and the REST of the stream — later batches and lookup gathers,
-        whose device snapshots included wrong state — is re-dispatched
-        in order against the corrected table.  Repeats until the
-        stream drains."""
-        while self._stream:
-            self._flush_stage()
-            covered = self._stream
-            self._stream = []
-            self._n_batches = 0
-            if any(rec.kind != "lookup" for rec in covered):
-                self.stat_fetches += 1
-                ring_np = np.asarray(self.ring)  # THE burst fetch
+    def _window_clean(self, recs, ring_np) -> bool:
+        for rec in recs:
+            if rec.kind not in _SEMANTIC_KINDS:
+                continue
+            s = ring_np[rec.ring_at]
+            if int(s[1]) & (dk.FLAG_OVERFLOW | dk.FLAG_CAP | dk.FLAG_PRECOND):
+                return False
+        return True
+
+    def _resolve_clean(self, recs, ring_np) -> None:
+        t0 = _time.perf_counter()
+        for rec in recs:
+            if rec.kind == "meta":
+                continue
+            if rec.kind == "lookup":
+                rec.future.resolve(rec.finish(rec.rows))
+                continue
+            s = dk.unpack_summary(ring_np[rec.ring_at])
+            self.stat_semantic_events += rec.n
+            rec.future.resolve(rec.finish(s))
+        self.stat_t_finish += _time.perf_counter() - t0
+
+    def _rotate(self) -> None:
+        """Window boundary: fetch the launched window's ring, and —
+        when it is clean — launch the pending window while the host
+        still holds the fetched results, then finish the old window's
+        bookkeeping overlapped with the new window's device work."""
+        prev, self._launched = self._launched, []
+        ring_np = self._fetch_ring(prev) if prev else None
+        if prev and (ring_np is None or self._window_clean(prev, ring_np)):
+            nxt, self._pending = self._pending, []
+            self._pending_semantic = 0
+            self._launch(nxt)
+            self._launched = nxt
+            self._resolve_clean(prev, ring_np)
+            return
+        if prev:
+            # Fallback in the window: serial exact recovery first.
+            self._resolve_recovery(prev, ring_np)
+        nxt, self._pending = self._pending, []
+        self._pending_semantic = 0
+        self._launch(nxt)
+        self._launched = nxt
+
+    def _resolve_recovery(self, covered, ring_np) -> None:
+        """Exact recovery: resolve in order until the flagged batch,
+        host re-execute it (mirror becomes current), rebuild the device
+        table, re-dispatch everything after it, repeat until done."""
+        while covered:
+            if ring_np is None:
+                ring_np = self._fetch_ring(covered)
             failed_at = None
             for i, rec in enumerate(covered):
+                if rec.kind == "meta":
+                    continue
                 if rec.kind == "lookup":
-                    rec.future.resolve(rec.finish(np.asarray(rec.handle)))
+                    rec.future.resolve(rec.finish(rec.rows))
                     continue
                 s = dk.unpack_summary(ring_np[rec.ring_at])
                 if s["overflow"] or s["cap_exceeded"] or s["precond"]:
@@ -349,18 +432,24 @@ class DeviceEngine:
                 self.stat_semantic_events += rec.n
                 rec.future.resolve(rec.finish(s))
             if failed_at is None:
-                continue
-            # Recovery: mirror reflects every batch up to and including
-            # the fallback; rebuild the device table from it and replay
-            # the rest of the stream in order.
+                return
+            # Mirror reflects every batch up to and including the
+            # fallback; rebuild the device table from it and replay
+            # the rest in order.
             self._upload_from_mirror()
-            for rec in covered[failed_at + 1 :]:
-                if rec.kind == "lookup":
+            covered = covered[failed_at + 1 :]
+            for rec in covered:
+                if rec.kind == "meta":
+                    slots, flags, ledger = rec.meta_args
+                    self.meta = dk.meta_update(
+                        self.meta, jnp.asarray(slots), jnp.asarray(flags),
+                        jnp.asarray(ledger),
+                    )
+                elif rec.kind == "lookup":
                     rec.handle = self._gather(rec.slots)
                 else:
                     self._dispatch(rec)
-                    self._n_batches += 1
-                self._stream.append(rec)
+            ring_np = None
 
     def _upload_from_mirror(self) -> None:
         table = np.zeros((self.capacity, 8), np.uint64)
@@ -370,7 +459,8 @@ class DeviceEngine:
         self.balances = self._place(jnp.asarray(table))
 
     def drain(self) -> None:
-        self._materialize()
+        while self._launched or self._pending:
+            self._rotate()
 
     # ------------------------------------------------------------------
     # Write-behind lane (host exact path) — kernel_fast.DeviceTable API.
@@ -379,9 +469,11 @@ class DeviceEngine:
         if self._suppress_enqueue or len(slots) == 0:
             return
         # Exact-path deltas only arrive after a drain (the host path
-        # drains before running), so they can never overtake staged
+        # drains before running), so they can never overtake queued
         # semantic batches.
-        assert not self._stage, "write-behind enqueue with staged batches"
+        assert self._pending_semantic == 0 and not self._launched, (
+            "write-behind enqueue with in-flight semantic batches"
+        )
         self._q.append(
             (
                 np.asarray(slots, np.int64),
@@ -434,9 +526,12 @@ class DeviceEngine:
             packed[3, take:] = 0
             self.balances = dk.apply_deltas(self.balances, jnp.asarray(packed))
             at += take
+        # Flushed deltas must land before any later queued meta/lookup
+        # records are dispatched — but those only dispatch at the next
+        # launch, which follows this flush in program order.
 
     def read(self):
-        """Flush barrier + device handle (DeviceTable API compat)."""
+        """Drain barrier + device handle (DeviceTable API compat)."""
         self.drain()
         self.flush()
         return self.balances

@@ -397,6 +397,12 @@ class TpuStateMachine:
         self.stat_linked_batches = 0
         self.stat_two_phase_batches = 0
         self.stat_resolve_iters = 0
+        # Which bookkeeping tail ran (VERDICT r4 #4): the all-success
+        # one-C-pass hot tail is ~2x the general tail, so bench output
+        # must show its engagement, not leave a bimodal headline
+        # unexplained.
+        self.stat_hot_tail_batches = 0
+        self.stat_slow_tail_batches = 0
 
     @property
     def stat_device_semantic_events(self) -> int:
@@ -2162,6 +2168,7 @@ class TpuStateMachine:
             not (results != 0).any()
             and not np.asarray(events["timeout"]).any()
         ):
+            self.stat_hot_tail_batches += 1
             st = self._store
             st.ram._ensure(n)
             lo = st.ram.count
@@ -2180,6 +2187,7 @@ class TpuStateMachine:
             self.commit_timestamp = ts_base + n - 1
             return b""
 
+        self.stat_slow_tail_batches += 1
         flags = events["flags"].astype(np.uint32)
         timeout = np.asarray(events["timeout"]).astype(np.uint64)
         created = {
