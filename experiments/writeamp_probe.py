@@ -1,0 +1,33 @@
+"""r5: per-tree grid byte attribution for the durable config."""
+import os, sys
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault("BENCH_SMALL", "1")
+import numpy as np
+import bench
+from tigerbeetle_tpu.lsm import tree as tree_mod
+
+by_tree = {}
+orig_write_run = tree_mod.Tree._write_run
+orig_write_one = tree_mod.Tree._write_one_block
+
+def patch(name, orig):
+    def wrapped(self, keys, flags, vals):
+        out = orig(self, keys, flags, vals)
+        entry = keys.dtype.itemsize + flags.dtype.itemsize + (
+            vals.dtype.itemsize if vals.ndim == 1 else vals.shape[1]
+        )
+        key = (getattr(self, "name", None) or f"tree{self.tree_id}", name)
+        by_tree[key] = by_tree.get(key, 0) + len(keys) * entry
+        return out
+    return wrapped
+
+tree_mod.Tree._write_run = patch("seal", orig_write_run)
+tree_mod.Tree._write_one_block = patch("compact", orig_write_one)
+
+N = int(os.environ.get("WA_N", "200000"))
+out = bench.run_durable(N)
+print({k: v for k, v in out.items() if "bytes" in k or k in ("events_per_sec",)})
+total = sum(by_tree.values())
+for (tname, phase), b in sorted(by_tree.items(), key=lambda kv: -kv[1]):
+    print(f"{tname:24s} {phase:8s} {b/N:8.1f} B/ev  {b/1e6:8.1f} MB")
+print(f"{'TOTAL tree writes':33s} {total/N:8.1f} B/ev")

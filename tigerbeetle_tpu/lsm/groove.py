@@ -31,9 +31,12 @@ class Groove:
         self.id_tree = Tree(
             grid, f"{name}.id", value_size=8, memtable_max=memtable_max
         )
+        # Objects are mostly-zero wire images (reserved user_data,
+        # zeroed reconstructible fields, high u128 limbs): sparse-value
+        # blocks halve the dominant seal/merge write volume.
         self.object_tree = Tree(
             grid, f"{name}.object", value_size=object_size,
-            memtable_max=memtable_max,
+            memtable_max=memtable_max, sparse_values=object_size % 8 == 0,
         )
         # index_value_size=8 stores a row/object pointer per index entry
         # (the state machine's spill tier scans indexes straight to

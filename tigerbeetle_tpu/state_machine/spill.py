@@ -73,9 +73,17 @@ class TransferSpill:
     """Spilled (immutable) transfer rows in a groove; `base` rows
     [0, base) live here, the store's RAM tail holds [base, count)."""
 
-    def __init__(self, groove) -> None:
+    def __init__(self, groove, attrs_fn=None) -> None:
         self.groove = groove
         self.base = 0
+        # Account attrs accessor for id reconstruction at gather:
+        # dr/cr ACCOUNT IDS are derivable from the stored slots (slots
+        # are append-only and an account's id is immutable), so the
+        # spilled image zeroes those 32 bytes — the sparse block codec
+        # then writes nothing for them (write-amp lever, VERDICT r4
+        # #5).  Falls back to storing the ids when no accessor is
+        # wired (standalone groove tests).
+        self._attrs_fn = attrs_fn
 
     # -- write (checkpoint path) ---------------------------------------
 
@@ -96,10 +104,13 @@ class TransferSpill:
             )
         dr = cols["dr_slot"].astype(np.int64)
         cr = cols["cr_slot"].astype(np.int64)
-        obj[:, 16:24] = attrs["id_lo"][dr].view(np.uint8).reshape(n, 8)
-        obj[:, 24:32] = attrs["id_hi"][dr].view(np.uint8).reshape(n, 8)
-        obj[:, 32:40] = attrs["id_lo"][cr].view(np.uint8).reshape(n, 8)
-        obj[:, 40:48] = attrs["id_hi"][cr].view(np.uint8).reshape(n, 8)
+        if self._attrs_fn is None:
+            obj[:, 16:24] = attrs["id_lo"][dr].view(np.uint8).reshape(n, 8)
+            obj[:, 24:32] = attrs["id_hi"][dr].view(np.uint8).reshape(n, 8)
+            obj[:, 32:40] = attrs["id_lo"][cr].view(np.uint8).reshape(n, 8)
+            obj[:, 40:48] = attrs["id_hi"][cr].view(np.uint8).reshape(n, 8)
+        # else: bytes 16..48 stay zero on disk; gather() reconstructs
+        # them from the slots + account attrs.
         obj[:, 128:132] = (
             cols["dr_slot"].astype(np.int32).view(np.uint8).reshape(n, 4)
         )
@@ -136,26 +147,50 @@ class TransferSpill:
         """Global rows (< base) -> (n, TRANSFER_OBJECT_SIZE) u8."""
         found, vals = self.groove.object_tree.lookup_batch(_row_keys(rows))
         assert found.all(), "spilled row missing from object tree"
+        if self._attrs_fn is not None:
+            vals = self._reconstruct_ids(np.ascontiguousarray(vals))
         return vals
+
+    def _reconstruct_ids(self, obj: np.ndarray) -> np.ndarray:
+        n = len(obj)
+        attrs = self._attrs_fn()
+        dr = np.ascontiguousarray(obj[:, 128:132]).view(np.int32).reshape(n)
+        cr = np.ascontiguousarray(obj[:, 132:136]).view(np.int32).reshape(n)
+        dr = dr.astype(np.int64)
+        cr = cr.astype(np.int64)
+        obj[:, 16:24] = attrs["id_lo"][dr].view(np.uint8).reshape(n, 8)
+        obj[:, 24:32] = attrs["id_hi"][dr].view(np.uint8).reshape(n, 8)
+        obj[:, 32:40] = attrs["id_lo"][cr].view(np.uint8).reshape(n, 8)
+        obj[:, 40:48] = attrs["id_hi"][cr].view(np.uint8).reshape(n, 8)
+        return obj
 
     def update_status(self, rows: np.ndarray, statuses: np.ndarray) -> None:
         """Finalize spilled pendings: rewrite their objects with the
         new status (LSM overwrite; newest version wins on read).  The
         only mutable byte of a spilled object — everything else is
         immutable after spill."""
-        obj = self.gather(rows).copy()
+        found, obj = self.groove.object_tree.lookup_batch(_row_keys(rows))
+        assert found.all(), "spilled row missing from object tree"
+        obj = np.ascontiguousarray(obj)
         obj[:, 136] = np.asarray(statuses, np.uint8)
         self.groove.object_tree.put_batch(_row_keys(rows), obj)
 
 
     def iter_objects(self, batch: int = 8192):
         """Yield (rows, objects) over all spilled rows ascending —
-        restore uses this to rebuild the RAM id directories."""
+        restore uses this to rebuild the RAM id directories, which
+        read only the transfer id (bytes 0..16), so the account-id
+        reconstruction is skipped (it would be pure per-row waste on
+        every crash recovery / state sync)."""
         at = 0
         while at < self.base:
             n = min(batch, self.base - at)
             rows = np.arange(at, at + n, dtype=np.int64)
-            yield rows, self.gather(rows)
+            found, vals = self.groove.object_tree.lookup_batch(
+                _row_keys(rows)
+            )
+            assert found.all(), "spilled row missing from object tree"
+            yield rows, vals
             at += n
 
 

@@ -485,7 +485,9 @@ class TpuStateMachine:
             object_size=spill_mod.HISTORY_OBJECT_SIZE,
             index_fields=[],
         )
-        self._store.spill = spill_mod.TransferSpill(transfers)
+        self._store.spill = spill_mod.TransferSpill(
+            transfers, attrs_fn=lambda: self._attrs
+        )
         self._hspill = spill_mod.HistorySpill(history)
 
     def spill_beat(
@@ -3082,7 +3084,8 @@ def _tpu_restore(self, data: bytes) -> None:
         # spill handles at the restored grooves.
         self._forest.open(state["forest"])
         self._store.spill = spill_mod.TransferSpill(
-            self._forest.grooves["transfers"]
+            self._forest.grooves["transfers"],
+            attrs_fn=lambda: self._attrs,
         )
         self._store.spill.base = base
         self._store.base = base

@@ -115,13 +115,19 @@ class Grid:
             c = wire.checksum(payload)
             h["checksum_lo"] = c & 0xFFFFFFFFFFFFFFFF
             h["checksum_hi"] = c >> 64
-            block = (h.tobytes() + payload).ljust(self.block_size, b"\x00")
+            # Trim the physical write to the sector-rounded frame: the
+            # reader takes the payload length from the header and
+            # checksums only that, so stale bytes from a previous
+            # tenant of this address past the frame are never
+            # interpreted (write-amplification lever — a half-full
+            # block costs half the disk bandwidth).
+            frame = h.tobytes() + payload
+            size = (len(frame) + 4095) & ~4095
+            block = frame.ljust(size, b"\x00")
             self.storage.write(self._offset(address), block)
             # Kick async writeback now so the next checkpoint's full
             # sync finds these pages already clean.
-            self.storage.writeback_hint(
-                self._offset(address), self.block_size
-            )
+            self.storage.writeback_hint(self._offset(address), size)
         finally:
             if self._writer is not None:
                 with self._pending_lock:

@@ -180,6 +180,17 @@ class FileStorage(Storage):
         self._grid_off = layout.grid_offset
         self._grid_dirty = False
         self._wal_dirty = False
+        # Write-amplification accounting (bench durable config reports
+        # bytes/event; reference analog: devhub's datafile-size metric,
+        # src/scripts/devhub.zig:36-41).  WAL counts only the journal
+        # rings; superblock/client-reply traffic is "control" —
+        # lumping checkpoint control writes into WAL framing would
+        # misdirect the exact investigation this counter serves.
+        self.stat_bytes_wal = 0
+        self.stat_bytes_grid = 0
+        self.stat_bytes_control = 0
+        self._wal_lo = layout.wal_headers_offset
+        self._wal_hi = layout.wal_prepares_offset + layout.wal_prepares_size
 
     def _at(self, offset: int) -> tuple[int, int]:
         if offset >= self._grid_off:
@@ -201,8 +212,13 @@ class FileStorage(Storage):
         assert written == len(data)
         if fd == self._fd_grid:
             self._grid_dirty = True
+            self.stat_bytes_grid += written
         else:
             self._wal_dirty = True
+            if self._wal_lo <= offset < self._wal_hi:
+                self.stat_bytes_wal += written
+            else:
+                self.stat_bytes_control += written
 
     def sync(self) -> None:
         # Clear-then-sync ordering: a concurrent write landing after
