@@ -554,6 +554,10 @@ def run_durable(n_events: int) -> dict:
         for op, body in setup:
             r.on_request(int(op), body)
         sm.sync()
+        # Counter reset (and the final read below) must see a drained
+        # grid write-behind queue — pending SerialWorker block writes
+        # increment the counters only when they execute.
+        sm._forest.grid.flush_writes()
         storage.stat_bytes_wal = 0
         storage.stat_bytes_grid = 0
         storage.stat_bytes_control = 0
@@ -573,6 +577,7 @@ def run_durable(n_events: int) -> dict:
             lat.append(time.perf_counter() - b0)
             failed += len(reply) // 8
         sm.sync()
+        sm._forest.grid.flush_writes()
         elapsed = time.perf_counter() - t0
         assert failed == 0, f"durable: {failed} transfers failed"
         n_timed = n_events_of(timed)

@@ -1,0 +1,92 @@
+// Builder for the 64-byte AccountFilter wire record driving
+// GetAccountTransfers / GetAccountBalances (tigerbeetle_tpu/types.py
+// ACCOUNT_FILTER_DTYPE; reference: src/tigerbeetle.zig:288-322 and
+// the generated dotnet AccountFilter).
+using System;
+using System.Buffers.Binary;
+
+namespace TigerBeetle;
+
+public sealed class AccountFilter
+{
+    internal const int Size = 64;
+
+    private readonly byte[] _buffer = new byte[Size];
+
+    public AccountFilter()
+    {
+        Limit = Client.BatchMax;
+        Debits = true;
+        Credits = true;
+    }
+
+    public void SetAccountId(ulong lo, ulong hi)
+    {
+        BinaryPrimitives.WriteUInt64LittleEndian(_buffer.AsSpan(0), lo);
+        BinaryPrimitives.WriteUInt64LittleEndian(_buffer.AsSpan(8), hi);
+    }
+
+    public ulong AccountIdLo =>
+        BinaryPrimitives.ReadUInt64LittleEndian(_buffer.AsSpan(0));
+    public ulong AccountIdHi =>
+        BinaryPrimitives.ReadUInt64LittleEndian(_buffer.AsSpan(8));
+
+    /// Inclusive minimum server timestamp; 0 = no bound.
+    public ulong TimestampMin
+    {
+        get => BinaryPrimitives.ReadUInt64LittleEndian(_buffer.AsSpan(16));
+        set => BinaryPrimitives.WriteUInt64LittleEndian(
+            _buffer.AsSpan(16), value);
+    }
+
+    /// Inclusive maximum server timestamp; 0 = no bound.
+    public ulong TimestampMax
+    {
+        get => BinaryPrimitives.ReadUInt64LittleEndian(_buffer.AsSpan(24));
+        set => BinaryPrimitives.WriteUInt64LittleEndian(
+            _buffer.AsSpan(24), value);
+    }
+
+    /// Maximum result rows (capped by the 1 MiB reply).
+    public uint Limit
+    {
+        get => BinaryPrimitives.ReadUInt32LittleEndian(_buffer.AsSpan(32));
+        set => BinaryPrimitives.WriteUInt32LittleEndian(
+            _buffer.AsSpan(32), value);
+    }
+
+    private bool GetFlag(uint bit) =>
+        (BinaryPrimitives.ReadUInt32LittleEndian(_buffer.AsSpan(36)) & bit)
+        != 0;
+
+    private void SetFlag(uint bit, bool on)
+    {
+        uint flags =
+            BinaryPrimitives.ReadUInt32LittleEndian(_buffer.AsSpan(36));
+        flags = on ? flags | bit : flags & ~bit;
+        BinaryPrimitives.WriteUInt32LittleEndian(_buffer.AsSpan(36), flags);
+    }
+
+    /// Include rows where the account is the debit side.
+    public bool Debits
+    {
+        get => GetFlag((uint)AccountFilterFlags.Debits);
+        set => SetFlag((uint)AccountFilterFlags.Debits, value);
+    }
+
+    /// Include rows where the account is the credit side.
+    public bool Credits
+    {
+        get => GetFlag((uint)AccountFilterFlags.Credits);
+        set => SetFlag((uint)AccountFilterFlags.Credits, value);
+    }
+
+    /// Newest-first results.
+    public bool Reversed
+    {
+        get => GetFlag((uint)AccountFilterFlags.Reversed);
+        set => SetFlag((uint)AccountFilterFlags.Reversed, value);
+    }
+
+    internal byte[] ToArray() => (byte[])_buffer.Clone();
+}

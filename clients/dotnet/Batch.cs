@@ -160,3 +160,25 @@ public sealed class CreateResultBatch : Batch
     public uint Index => GetU32(0);
     public uint Result => GetU32(4);
 }
+
+/// 128-byte AccountBalance reply rows (tigerbeetle_tpu/types.py
+/// ACCOUNT_BALANCE_DTYPE; reference: src/tigerbeetle.zig:65-78).
+public sealed class AccountBalanceBatch : Batch
+{
+    internal const int ElementSize = 128;
+
+    internal AccountBalanceBatch(byte[] wrapped)
+        : base(wrapped, ElementSize) { }
+
+    public ulong DebitsPendingLo => GetU64(0);
+    public ulong DebitsPendingHi => GetU64(8);
+    public ulong DebitsPostedLo => GetU64(16);
+    public ulong DebitsPostedHi => GetU64(24);
+    public ulong CreditsPendingLo => GetU64(32);
+    public ulong CreditsPendingHi => GetU64(40);
+    public ulong CreditsPostedLo => GetU64(48);
+    public ulong CreditsPostedHi => GetU64(56);
+
+    /// Server timestamp of the transfer that produced this snapshot.
+    public ulong Timestamp => GetU64(64);
+}

@@ -23,6 +23,10 @@ public sealed class Client : IDisposable
     internal const byte OpCreateTransfers = (byte)Operation.CreateTransfers;
     internal const byte OpLookupAccounts = (byte)Operation.LookupAccounts;
     internal const byte OpLookupTransfers = (byte)Operation.LookupTransfers;
+    internal const byte OpGetAccountTransfers =
+        (byte)Operation.GetAccountTransfers;
+    internal const byte OpGetAccountBalances =
+        (byte)Operation.GetAccountBalances;
 
     private readonly TcpClient _socket;
     private readonly NetworkStream _stream;
@@ -54,7 +58,13 @@ public sealed class Client : IDisposable
         _clientHi = clientHi;
     }
 
-    public void Dispose() => _socket.Dispose();
+    private bool _closed;
+
+    public void Dispose()
+    {
+        _closed = true;
+        _socket.Dispose();
+    }
 
     public CreateResultBatch CreateAccounts(AccountBatch batch) =>
         new(Request(OpCreateAccounts, batch.ToArray()));
@@ -67,6 +77,16 @@ public sealed class Client : IDisposable
 
     public TransferBatch LookupTransfers(IdBatch ids) =>
         new(Request(OpLookupTransfers, ids.ToArray()));
+
+    /// Transfers touching the filter's account, timestamp-ordered
+    /// (reference: src/state_machine.zig:786-1008).
+    public TransferBatch GetAccountTransfers(AccountFilter filter) =>
+        new(Request(OpGetAccountTransfers, filter.ToArray()));
+
+    /// Historical balance snapshots (requires the account's history
+    /// flag).
+    public AccountBalanceBatch GetAccountBalances(AccountFilter filter) =>
+        new(Request(OpGetAccountBalances, filter.ToArray()));
 
     /// Raw request: registers on first use, returns the reply body.
     public byte[] Request(byte operation, byte[] body)
@@ -85,7 +105,8 @@ public sealed class Client : IDisposable
 
     private byte[] Roundtrip(byte operation, uint requestNumber, byte[] body)
     {
-        if (_evicted) throw new IOException("session evicted");
+        if (_closed) throw new ClientClosedException("client is closed");
+        if (_evicted) throw new ClientEvictedException("session evicted");
         var msg = Wire.BuildRequest(
             _cluster, _clientLo, _clientHi, requestNumber, operation, body);
         long deadline = Environment.TickCount64 + TimeoutMillis;
@@ -93,7 +114,9 @@ public sealed class Client : IDisposable
         {
             long now = Environment.TickCount64;
             if (now > deadline)
-                throw new IOException($"request {requestNumber} timed out");
+                throw new RequestTimeoutException(
+                    $"request {requestNumber} timed out after "
+                    + $"{TimeoutMillis}ms");
             // Clamp >= 1: a 0 ReceiveTimeout means INFINITE in .NET.
             _socket.ReceiveTimeout =
                 (int)Math.Max(1, Math.Min(RetransmitMillis, deadline - now));
@@ -115,7 +138,7 @@ public sealed class Client : IDisposable
                 if (command == Wire.CmdEviction)
                 {
                     _evicted = true;
-                    throw new IOException("session evicted");
+                    throw new ClientEvictedException("session evicted");
                 }
                 if (command != Wire.CmdReply) continue;
                 uint got = BinaryPrimitives.ReadUInt32LittleEndian(
@@ -136,7 +159,7 @@ public sealed class Client : IDisposable
                     _recv.AsSpan(Wire.OffSize));
                 if (size < Wire.HeaderSize
                     || size > Wire.MessageSizeMax + Wire.HeaderSize)
-                    throw new IOException($"bad frame size {size}");
+                    throw new InvalidFrameException($"bad frame size {size}");
                 if (_recvLen >= size)
                 {
                     var msg = _recv.AsSpan(0, size).ToArray();

@@ -1,0 +1,52 @@
+// In-process test double with the Client's API shape: every request
+// ECHOES its event payload back as the reply body instead of touching
+// a cluster (the reference's echo client —
+// src/clients/c/tb_client/echo_client.zig:1-20).  CreateAccounts /
+// CreateTransfers therefore report zero failures, and the typed echo
+// helpers hand the submitted batch back through the reply-side
+// decoder for marshaling round-trip tests.
+using System;
+
+namespace TigerBeetle;
+
+public sealed class EchoClient : IDisposable
+{
+    private bool _closed;
+
+    public void Dispose() => _closed = true;
+
+    /// Echo: the reply body IS the request body.
+    public byte[] Request(byte operation, byte[] body)
+    {
+        lock (this)
+        {
+            if (_closed)
+                throw new ClientClosedException("client is closed");
+            if (body.Length > Wire.MessageSizeMax - Wire.HeaderSize)
+                throw new InvalidFrameException("body exceeds message size");
+            return (byte[])body.Clone();
+        }
+    }
+
+    /// create_accounts double: no failures (reply decodes empty).
+    public CreateResultBatch CreateAccounts(AccountBatch batch)
+    {
+        Request(Client.OpCreateAccounts, batch.ToArray());
+        return new CreateResultBatch(Array.Empty<byte>());
+    }
+
+    /// create_transfers double: no failures (reply decodes empty).
+    public CreateResultBatch CreateTransfers(TransferBatch batch)
+    {
+        Request(Client.OpCreateTransfers, batch.ToArray());
+        return new CreateResultBatch(Array.Empty<byte>());
+    }
+
+    /// Marshaling round-trip: encode, echo, decode as accounts.
+    public AccountBatch EchoAccounts(AccountBatch batch) =>
+        new(Request(Client.OpLookupAccounts, batch.ToArray()));
+
+    /// Marshaling round-trip: encode, echo, decode as transfers.
+    public TransferBatch EchoTransfers(TransferBatch batch) =>
+        new(Request(Client.OpLookupTransfers, batch.ToArray()));
+}

@@ -1,0 +1,99 @@
+// 128-bit id/amount helpers (the reference's dotnet UInt128
+// extensions — src/clients/dotnet/TigerBeetle/UInt128Extensions.cs):
+// conversions between (lo, hi) ulong limbs, 16-byte little-endian
+// arrays, System.Numerics.BigInteger, and Guid, plus a monotonic
+// time-based Id() generator (ULID-shaped, strictly increasing within
+// the process — reference ID() semantics).
+using System;
+using System.Numerics;
+using System.Security.Cryptography;
+
+namespace TigerBeetle;
+
+public static class UInt128Helpers
+{
+    public const int Size = 16;
+
+    /// (lo, hi) limbs -> 16 little-endian bytes.
+    public static byte[] AsBytes(ulong lo, ulong hi)
+    {
+        var bytes = new byte[Size];
+        BitConverter.TryWriteBytes(bytes.AsSpan(0, 8), lo);
+        BitConverter.TryWriteBytes(bytes.AsSpan(8, 8), hi);
+        return bytes;
+    }
+
+    public static ulong BytesToLo(ReadOnlySpan<byte> bytes)
+    {
+        CheckLength(bytes);
+        return BitConverter.ToUInt64(bytes[..8]);
+    }
+
+    public static ulong BytesToHi(ReadOnlySpan<byte> bytes)
+    {
+        CheckLength(bytes);
+        return BitConverter.ToUInt64(bytes[8..16]);
+    }
+
+    /// Non-negative BigInteger (must fit 128 bits) -> (lo, hi) limbs.
+    public static (ulong Lo, ulong Hi) FromBigInteger(BigInteger value)
+    {
+        if (value.Sign < 0 || value.GetBitLength() > 128)
+            throw new ArgumentOutOfRangeException(
+                nameof(value), "must be a non-negative 128-bit integer");
+        ulong lo = (ulong)(value & ulong.MaxValue);
+        ulong hi = (ulong)((value >> 64) & ulong.MaxValue);
+        return (lo, hi);
+    }
+
+    public static BigInteger AsBigInteger(ulong lo, ulong hi) =>
+        (new BigInteger(hi) << 64) | new BigInteger(lo);
+
+    /// Guid (RFC byte order) <-> limbs via the 16-byte wire image.
+    public static (ulong Lo, ulong Hi) FromGuid(Guid guid)
+    {
+        var bytes = guid.ToByteArray();
+        return (BytesToLo(bytes), BytesToHi(bytes));
+    }
+
+    public static Guid AsGuid(ulong lo, ulong hi) =>
+        new(AsBytes(lo, hi));
+
+    private static readonly object IdLock = new();
+    private static long _idLastMillis;
+    private static ulong _idLastLo;
+    private static ulong _idLastHi;
+
+    /// Time-ordered unique 128-bit id as (lo, hi) limbs: 48-bit
+    /// millisecond timestamp in the topmost bits, random bits below,
+    /// strictly monotonic within the process (same-millisecond calls
+    /// increment — reference UInt128.ID()).
+    public static (ulong Lo, ulong Hi) Id()
+    {
+        lock (IdLock)
+        {
+            long now = DateTimeOffset.UtcNow.ToUnixTimeMilliseconds();
+            if (now > _idLastMillis)
+            {
+                _idLastMillis = now;
+                Span<byte> rand = stackalloc byte[10];
+                RandomNumberGenerator.Fill(rand);
+                _idLastHi = ((ulong)now << 16)
+                    | ((ulong)rand[0] << 8) | rand[1];
+                _idLastLo = BitConverter.ToUInt64(rand[2..10]);
+            }
+            else
+            {
+                _idLastLo++;
+                if (_idLastLo == 0) _idLastHi++;
+            }
+            return (_idLastLo, _idLastHi);
+        }
+    }
+
+    private static void CheckLength(ReadOnlySpan<byte> bytes)
+    {
+        if (bytes.Length != Size)
+            throw new ArgumentException("expected 16 bytes");
+    }
+}

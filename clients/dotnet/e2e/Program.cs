@@ -55,6 +55,32 @@ t.Next();
 if (t.AmountLo != 40 || t.PendingIdLo != 10)
     throw new Exception("t11 fields");
 
+// r5 surface: filter-driven queries, UInt128 helpers, EchoClient.
+var filter = new AccountFilter();
+filter.SetAccountId(1, 0);
+filter.Limit = 10;
+var qt = client.GetAccountTransfers(filter);
+if (qt.Length != 2) throw new Exception($"query rows {qt.Length}");
+qt.Next();
+if (qt.IdLo != 10) throw new Exception("query order");
+
+var (idLo, idHi) = UInt128Helpers.Id();
+var (idLo2, idHi2) = UInt128Helpers.Id();
+if (UInt128Helpers.AsBigInteger(idLo2, idHi2)
+    <= UInt128Helpers.AsBigInteger(idLo, idHi))
+    throw new Exception("UInt128 ids must be monotonic");
+
+using (var echo = new EchoClient())
+{
+    var back = echo.EchoTransfers(transfers);
+    if (back.Length != transfers.Length) throw new Exception("echo length");
+    back.Next();
+    if (back.IdLo != 10 || back.AmountLo != 40)
+        throw new Exception("echo fields");
+    if (echo.CreateTransfers(transfers).Length != 0)
+        throw new Exception("echo create must report no failures");
+}
+
 Console.WriteLine("e2e ok");
 
 // ---------------------------------------------------------------------

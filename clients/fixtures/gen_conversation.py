@@ -126,6 +126,50 @@ def build_frames():
             False,
         )
     )
+
+    # History-flagged account + a transfer into it, then the
+    # get_account_balances query — the filter-builder/balance-decode
+    # surface every language client ships (VERDICT r4 #8).  Appended
+    # AFTER the original steps so their recorded frames stay stable.
+    ah = np.zeros(1, types.ACCOUNT_DTYPE)
+    ah["id_lo"] = 9003
+    ah["ledger"] = 1
+    ah["code"] = 1
+    ah["flags"] = int(types.AccountFlags.history)
+    steps.append(
+        (
+            "create_accounts_history",
+            frame(6, int(types.Operation.create_accounts), ah.tobytes()),
+            False,
+        )
+    )
+    th = np.zeros(1, types.TRANSFER_DTYPE)
+    th["id_lo"] = 504
+    th["debit_account_id_lo"] = 9001
+    th["credit_account_id_lo"] = 9003
+    th["amount_lo"] = 7
+    th["ledger"] = 1
+    th["code"] = 1
+    steps.append(
+        (
+            "create_transfers_history",
+            frame(7, int(types.Operation.create_transfers), th.tobytes()),
+            False,
+        )
+    )
+    fb = np.zeros(1, types.ACCOUNT_FILTER_DTYPE)
+    fb[0]["account_id_lo"] = 9003
+    fb[0]["limit"] = 10
+    fb[0]["flags"] = int(
+        types.AccountFilterFlags.debits | types.AccountFilterFlags.credits
+    )
+    steps.append(
+        (
+            "get_account_balances",
+            frame(8, int(types.Operation.get_account_balances), fb.tobytes()),
+            False,
+        )
+    )
     return steps
 
 

@@ -1,0 +1,29 @@
+// Typed cursor over 128-byte AccountBalance reply rows
+// (tigerbeetle_tpu/types.py ACCOUNT_BALANCE_DTYPE; reference:
+// src/tigerbeetle.zig:65-78 and the generated AccountBalanceBatch).
+package com.tigerbeetle;
+
+import java.nio.ByteBuffer;
+
+public final class AccountBalanceBatch extends Batch {
+    static final int ELEMENT_SIZE = 128;
+
+    AccountBalanceBatch(ByteBuffer wrapped) {
+        super(wrapped, ELEMENT_SIZE);
+    }
+
+    public long getDebitsPendingLo() { return getU64(0); }
+    public long getDebitsPendingHi() { return getU64(8); }
+
+    public long getDebitsPostedLo() { return getU64(16); }
+    public long getDebitsPostedHi() { return getU64(24); }
+
+    public long getCreditsPendingLo() { return getU64(32); }
+    public long getCreditsPendingHi() { return getU64(40); }
+
+    public long getCreditsPostedLo() { return getU64(48); }
+    public long getCreditsPostedHi() { return getU64(56); }
+
+    /** Server timestamp of the transfer that produced this snapshot. */
+    public long getTimestamp() { return getU64(64); }
+}

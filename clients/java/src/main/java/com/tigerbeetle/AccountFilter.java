@@ -1,0 +1,82 @@
+// Builder for the 64-byte AccountFilter wire record driving
+// get_account_transfers / get_account_balances
+// (tigerbeetle_tpu/types.py ACCOUNT_FILTER_DTYPE; reference:
+// src/tigerbeetle.zig:288-322 and the generated AccountFilterBatch —
+// src/clients/java/src/main/java/com/tigerbeetle/AccountFilterBatch.java).
+package com.tigerbeetle;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+public final class AccountFilter {
+    static final int SIZE = 64;
+
+    private final ByteBuffer buffer =
+        ByteBuffer.allocate(SIZE).order(ByteOrder.LITTLE_ENDIAN);
+
+    public AccountFilter() {
+        // limit defaults to the max reply batch; debits+credits on.
+        setLimit(Client.BATCH_MAX);
+        setDebits(true);
+        setCredits(true);
+    }
+
+    public void setAccountId(long lo, long hi) {
+        buffer.putLong(0, lo).putLong(8, hi);
+    }
+
+    public long getAccountIdLo() { return buffer.getLong(0); }
+    public long getAccountIdHi() { return buffer.getLong(8); }
+
+    /** Inclusive minimum server timestamp; 0 = no bound. */
+    public void setTimestampMin(long ns) { buffer.putLong(16, ns); }
+    public long getTimestampMin() { return buffer.getLong(16); }
+
+    /** Inclusive maximum server timestamp; 0 = no bound. */
+    public void setTimestampMax(long ns) { buffer.putLong(24, ns); }
+    public long getTimestampMax() { return buffer.getLong(24); }
+
+    /** Maximum result rows (capped by the 1 MiB reply). */
+    public void setLimit(int limit) { buffer.putInt(32, limit); }
+    public int getLimit() { return buffer.getInt(32); }
+
+    private void setFlag(int bit, boolean on) {
+        int flags = buffer.getInt(36);
+        buffer.putInt(36, on ? flags | bit : flags & ~bit);
+    }
+
+    private boolean getFlag(int bit) {
+        return (buffer.getInt(36) & bit) != 0;
+    }
+
+    /** Include rows where the account is the debit side. */
+    public void setDebits(boolean on) {
+        setFlag(Types.AccountFilterFlags.Debits, on);
+    }
+
+    public boolean getDebits() {
+        return getFlag(Types.AccountFilterFlags.Debits);
+    }
+
+    /** Include rows where the account is the credit side. */
+    public void setCredits(boolean on) {
+        setFlag(Types.AccountFilterFlags.Credits, on);
+    }
+
+    public boolean getCredits() {
+        return getFlag(Types.AccountFilterFlags.Credits);
+    }
+
+    /** Newest-first results. */
+    public void setReversed(boolean on) {
+        setFlag(Types.AccountFilterFlags.Reversed, on);
+    }
+
+    public boolean getReversed() {
+        return getFlag(Types.AccountFilterFlags.Reversed);
+    }
+
+    byte[] toArray() {
+        return buffer.array().clone();
+    }
+}

@@ -33,6 +33,10 @@ public final class Client implements AutoCloseable {
         Types.Operation.LookupAccounts.value;
     static final int OP_LOOKUP_TRANSFERS =
         Types.Operation.LookupTransfers.value;
+    static final int OP_GET_ACCOUNT_TRANSFERS =
+        Types.Operation.GetAccountTransfers.value;
+    static final int OP_GET_ACCOUNT_BALANCES =
+        Types.Operation.GetAccountBalances.value;
 
     private final Socket socket;
     private final InputStream in;
@@ -67,8 +71,11 @@ public final class Client implements AutoCloseable {
         this.clientHi = clientHi;
     }
 
+    private boolean closed;
+
     @Override
     public void close() throws IOException {
+        closed = true;
         socket.close();
     }
 
@@ -98,6 +105,22 @@ public final class Client implements AutoCloseable {
             wrap(request(OP_LOOKUP_TRANSFERS, ids.toArray())));
     }
 
+    /** get_account_transfers: transfers touching the filter's account,
+     * timestamp-ordered (reference: src/state_machine.zig:786-1008). */
+    public TransferBatch getAccountTransfers(AccountFilter filter)
+            throws IOException {
+        return new TransferBatch(
+            wrap(request(OP_GET_ACCOUNT_TRANSFERS, filter.toArray())));
+    }
+
+    /** get_account_balances: historical balance snapshots (requires
+     * the account's history flag). */
+    public AccountBalanceBatch getAccountBalances(AccountFilter filter)
+            throws IOException {
+        return new AccountBalanceBatch(
+            wrap(request(OP_GET_ACCOUNT_BALANCES, filter.toArray())));
+    }
+
     private static ByteBuffer wrap(byte[] body) {
         return ByteBuffer.wrap(body).order(ByteOrder.LITTLE_ENDIAN);
     }
@@ -115,8 +138,11 @@ public final class Client implements AutoCloseable {
 
     private byte[] roundtrip(int operation, int reqNumber, byte[] body)
             throws IOException {
+        if (closed) {
+            throw new ClientClosedException("client is closed");
+        }
         if (evicted) {
-            throw new IOException("session evicted");
+            throw new ClientEvictedException("session evicted");
         }
         byte[] msg = Wire.buildRequest(
             cluster, clientLo, clientHi, reqNumber, operation, body);
@@ -124,7 +150,9 @@ public final class Client implements AutoCloseable {
         while (true) {
             long now = System.currentTimeMillis();
             if (now > deadline) {
-                throw new IOException("request " + reqNumber + " timed out");
+                throw new RequestTimeoutException(
+                    "request " + reqNumber + " timed out after "
+                    + timeoutMillis + "ms");
             }
             // Clamp >= 1: a 0 soTimeout means INFINITE in Java.
             socket.setSoTimeout(
@@ -146,7 +174,7 @@ public final class Client implements AutoCloseable {
                 int command = reply[Wire.OFF_COMMAND] & 0xFF;
                 if (command == Wire.CMD_EVICTION) {
                     evicted = true;
-                    throw new IOException("session evicted");
+                    throw new ClientEvictedException("session evicted");
                 }
                 if (command != Wire.CMD_REPLY) {
                     continue;
@@ -170,7 +198,7 @@ public final class Client implements AutoCloseable {
                 int size = h.getInt(Wire.OFF_SIZE);
                 if (size < Wire.HEADER_SIZE
                     || size > Wire.MESSAGE_SIZE_MAX + Wire.HEADER_SIZE) {
-                    throw new IOException("bad frame size " + size);
+                    throw new InvalidFrameException("bad frame size " + size);
                 }
                 if (recvLen >= size) {
                     byte[] msg = new byte[size];

@@ -1,0 +1,9 @@
+// Request submitted after close() — programming error, not a
+// cluster condition.
+package com.tigerbeetle;
+
+public final class ClientClosedException extends ClientException {
+    public ClientClosedException(String message) {
+        super(message);
+    }
+}

@@ -1,0 +1,10 @@
+// The cluster evicted this session (too many live clients,
+// reference: src/vsr.zig Command.eviction).  The session is dead;
+// callers must build a NEW Client (new client id) to continue.
+package com.tigerbeetle;
+
+public final class ClientEvictedException extends ClientException {
+    public ClientEvictedException(String message) {
+        super(message);
+    }
+}

@@ -1,0 +1,67 @@
+// In-process test double with the Client's API shape: every request
+// ECHOES its event payload back as the reply body instead of touching
+// a cluster (the reference's echo client —
+// src/clients/c/tb_client/echo_client.zig:1-20 — swaps the real
+// request path for a body copy so binding marshaling round-trips are
+// testable without a server).  createAccounts/createTransfers
+// therefore report zero failures, and the typed echo helpers hand the
+// submitted batch back through the reply-side decoder.
+package com.tigerbeetle;
+
+import java.io.IOException;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+public final class EchoClient implements AutoCloseable {
+    private boolean closed;
+
+    public EchoClient() {}
+
+    @Override
+    public void close() {
+        closed = true;
+    }
+
+    /** Echo: the reply body IS the request body. */
+    public synchronized byte[] request(int operation, byte[] body)
+            throws IOException {
+        if (closed) {
+            throw new ClientClosedException("client is closed");
+        }
+        if (body.length > Wire.MESSAGE_SIZE_MAX - Wire.HEADER_SIZE) {
+            throw new InvalidFrameException("body exceeds message size");
+        }
+        return body.clone();
+    }
+
+    /** create_accounts double: no failures (reply decodes empty). */
+    public CreateResultBatch createAccounts(AccountBatch batch)
+            throws IOException {
+        request(Client.OP_CREATE_ACCOUNTS, batch.toArray());
+        return new CreateResultBatch(wrap(new byte[0]));
+    }
+
+    /** create_transfers double: no failures (reply decodes empty). */
+    public CreateResultBatch createTransfers(TransferBatch batch)
+            throws IOException {
+        request(Client.OP_CREATE_TRANSFERS, batch.toArray());
+        return new CreateResultBatch(wrap(new byte[0]));
+    }
+
+    /** Marshaling round-trip: encode, echo, decode as accounts. */
+    public AccountBatch echoAccounts(AccountBatch batch) throws IOException {
+        return new AccountBatch(
+            wrap(request(Client.OP_LOOKUP_ACCOUNTS, batch.toArray())));
+    }
+
+    /** Marshaling round-trip: encode, echo, decode as transfers. */
+    public TransferBatch echoTransfers(TransferBatch batch)
+            throws IOException {
+        return new TransferBatch(
+            wrap(request(Client.OP_LOOKUP_TRANSFERS, batch.toArray())));
+    }
+
+    private static ByteBuffer wrap(byte[] body) {
+        return ByteBuffer.wrap(body).order(ByteOrder.LITTLE_ENDIAN);
+    }
+}

@@ -1,0 +1,9 @@
+// The peer sent a malformed frame (bad size word or checksum) —
+// indicates a protocol bug or corrupted transport, never retried.
+package com.tigerbeetle;
+
+public final class InvalidFrameException extends ClientException {
+    public InvalidFrameException(String message) {
+        super(message);
+    }
+}

@@ -1,0 +1,11 @@
+// The per-request deadline elapsed before a reply arrived.  The
+// request may still commit server-side; a new client session (or the
+// same session retrying under the same request number) observes the
+// stored reply via at-most-once dedupe.  Retryable.
+package com.tigerbeetle;
+
+public final class RequestTimeoutException extends ClientException {
+    public RequestTimeoutException(String message) {
+        super(message);
+    }
+}

@@ -1,0 +1,131 @@
+// 128-bit id/amount helpers (the reference's
+// src/clients/java/src/main/java/com/tigerbeetle/UInt128.java):
+// conversions between (lo, hi) long limbs, 16-byte little-endian
+// arrays, BigInteger, and java.util.UUID, plus a monotonic
+// time-based id() generator (ULID-shaped: millisecond timestamp in
+// the high bits, random low bits, strictly increasing within a
+// process — reference id() semantics).
+package com.tigerbeetle;
+
+import java.math.BigInteger;
+import java.security.SecureRandom;
+import java.util.UUID;
+
+public final class UInt128 {
+    public static final int SIZE = 16;
+
+    private UInt128() {}
+
+    /** (lo, hi) limbs -> 16 little-endian bytes. */
+    public static byte[] asBytes(long lo, long hi) {
+        byte[] out = new byte[SIZE];
+        for (int i = 0; i < 8; i++) {
+            out[i] = (byte) (lo >>> (8 * i));
+            out[8 + i] = (byte) (hi >>> (8 * i));
+        }
+        return out;
+    }
+
+    /** 16 little-endian bytes -> low limb. */
+    public static long bytesToLo(byte[] bytes) {
+        checkLength(bytes);
+        long v = 0;
+        for (int i = 7; i >= 0; i--) {
+            v = (v << 8) | (bytes[i] & 0xFFL);
+        }
+        return v;
+    }
+
+    /** 16 little-endian bytes -> high limb. */
+    public static long bytesToHi(byte[] bytes) {
+        checkLength(bytes);
+        long v = 0;
+        for (int i = 15; i >= 8; i--) {
+            v = (v << 8) | (bytes[i] & 0xFFL);
+        }
+        return v;
+    }
+
+    /** Non-negative BigInteger (must fit 128 bits) -> low limb. */
+    public static long bigIntegerToLo(BigInteger value) {
+        return limbs(value)[0];
+    }
+
+    /** Non-negative BigInteger (must fit 128 bits) -> high limb. */
+    public static long bigIntegerToHi(BigInteger value) {
+        return limbs(value)[1];
+    }
+
+    /** (lo, hi) limbs -> non-negative BigInteger. */
+    public static BigInteger asBigInteger(long lo, long hi) {
+        BigInteger l = BigInteger.valueOf(lo & Long.MAX_VALUE);
+        if (lo < 0) {
+            l = l.setBit(63);
+        }
+        BigInteger h = BigInteger.valueOf(hi & Long.MAX_VALUE);
+        if (hi < 0) {
+            h = h.setBit(63);
+        }
+        return h.shiftLeft(64).or(l);
+    }
+
+    /** UUID (its canonical msb/lsb halves) -> (lo, hi): lsb is the
+     * low limb, msb the high limb. */
+    public static long uuidToLo(UUID uuid) {
+        return uuid.getLeastSignificantBits();
+    }
+
+    public static long uuidToHi(UUID uuid) {
+        return uuid.getMostSignificantBits();
+    }
+
+    public static UUID asUuid(long lo, long hi) {
+        return new UUID(hi, lo);
+    }
+
+    private static final SecureRandom RANDOM = new SecureRandom();
+    private static final Object ID_LOCK = new Object();
+    private static long idLastMillis = 0;
+    private static long idLastLo = 0;
+    private static long idLastHi = 0;
+
+    /** Time-ordered unique 128-bit id as (lo, hi) limbs packed into a
+     * two-element array {lo, hi}: 48-bit millisecond timestamp in the
+     * topmost bits, 80 random bits below, strictly monotonic within
+     * the process (same-millisecond calls increment the random part —
+     * reference UInt128.id()). */
+    public static long[] id() {
+        synchronized (ID_LOCK) {
+            long now = System.currentTimeMillis();
+            if (now > idLastMillis) {
+                idLastMillis = now;
+                // hi = timestamp(48) | random(16); lo = random(64).
+                idLastHi = (now << 16) | (RANDOM.nextInt(1 << 16) & 0xFFFFL);
+                idLastLo = RANDOM.nextLong();
+            } else {
+                // Same or regressed millisecond: increment as u128.
+                idLastLo++;
+                if (idLastLo == 0) {
+                    idLastHi++;
+                }
+            }
+            return new long[] {idLastLo, idLastHi};
+        }
+    }
+
+    private static long[] limbs(BigInteger value) {
+        if (value.signum() < 0 || value.bitLength() > 128) {
+            throw new IllegalArgumentException(
+                "value must be a non-negative 128-bit integer");
+        }
+        long lo = value.longValue();
+        long hi = value.shiftRight(64).longValue();
+        return new long[] {lo, hi};
+    }
+
+    private static void checkLength(byte[] bytes) {
+        if (bytes == null || bytes.length != SIZE) {
+            throw new IllegalArgumentException("expected 16 bytes");
+        }
+    }
+}

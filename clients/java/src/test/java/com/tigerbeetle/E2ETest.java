@@ -79,6 +79,15 @@ public final class E2ETest {
             t12.next();
             expect(t12.getAmountLo() == 40, "t12 inherited amount");
             expect(t12.getPendingIdLo() == 11, "t12 pending id");
+
+            // r5 surface: filter-driven query through the builder.
+            AccountFilter filter = new AccountFilter();
+            filter.setAccountId(2, 0);
+            filter.setLimit(10);
+            TransferBatch qt = client.getAccountTransfers(filter);
+            expect(qt.getLength() >= 2, "query rows " + qt.getLength());
+            qt.next();
+            expect(qt.getIdLo() == 10, "query order " + qt.getIdLo());
         }
         System.out.println("e2e ok");
     }

@@ -1,0 +1,95 @@
+// Unit tests for the r5 client surface: UInt128 helpers, the
+// AccountFilter builder's wire layout, and the EchoClient marshaling
+// double (reference test shape: src/clients/java/src/test/java/com/
+// tigerbeetle/UInt128Test.java, EchoTest.java).  Runs under any JUnit4
+// runner when a JVM toolchain is present; the wire layouts themselves
+// are pinned toolchain-free by tests/test_client_conversations.py.
+package com.tigerbeetle;
+
+import static org.junit.Assert.assertArrayEquals;
+import static org.junit.Assert.assertEquals;
+import static org.junit.Assert.assertTrue;
+
+import java.math.BigInteger;
+import java.util.UUID;
+import org.junit.Test;
+
+public class UInt128AndEchoTest {
+
+    @Test
+    public void uint128RoundTrips() {
+        long lo = 0xDEAD_BEEF_CAFE_F00DL;
+        long hi = 0x0123_4567_89AB_CDEFL;
+        byte[] bytes = UInt128.asBytes(lo, hi);
+        assertEquals(16, bytes.length);
+        assertEquals(lo, UInt128.bytesToLo(bytes));
+        assertEquals(hi, UInt128.bytesToHi(bytes));
+        BigInteger big = UInt128.asBigInteger(lo, hi);
+        assertEquals(lo, UInt128.bigIntegerToLo(big));
+        assertEquals(hi, UInt128.bigIntegerToHi(big));
+        UUID uuid = UInt128.asUuid(lo, hi);
+        assertEquals(lo, UInt128.uuidToLo(uuid));
+        assertEquals(hi, UInt128.uuidToHi(uuid));
+    }
+
+    @Test
+    public void idsAreMonotonic() {
+        long[] prev = UInt128.id();
+        for (int i = 0; i < 10_000; i++) {
+            long[] next = UInt128.id();
+            BigInteger a = UInt128.asBigInteger(prev[0], prev[1]);
+            BigInteger b = UInt128.asBigInteger(next[0], next[1]);
+            assertTrue("ids must be strictly increasing", b.compareTo(a) > 0);
+            prev = next;
+        }
+    }
+
+    @Test
+    public void accountFilterLayout() {
+        AccountFilter f = new AccountFilter();
+        f.setAccountId(9003L, 0L);
+        f.setTimestampMin(5L);
+        f.setTimestampMax(99L);
+        f.setLimit(10);
+        f.setReversed(true);
+        byte[] wire = f.toArray();
+        assertEquals(64, wire.length);
+        assertEquals(9003L, UInt128.bytesToLo(java.util.Arrays.copyOf(wire, 16)));
+        assertTrue(f.getDebits());
+        assertTrue(f.getCredits());
+        assertTrue(f.getReversed());
+        assertEquals(10, f.getLimit());
+    }
+
+    @Test
+    public void echoClientRoundTripsTransfers() throws Exception {
+        try (EchoClient echo = new EchoClient()) {
+            TransferBatch batch = new TransferBatch(2);
+            batch.add();
+            batch.setId(501, 0);
+            batch.setDebitAccountId(9001, 0);
+            batch.setCreditAccountId(9002, 0);
+            batch.setAmount(100, 0);
+            batch.setLedger(1);
+            batch.setCode(1);
+            batch.add();
+            batch.setId(502, 0);
+            batch.setDebitAccountId(9002, 0);
+            batch.setCreditAccountId(9001, 0);
+            batch.setAmount(40, 0);
+            batch.setLedger(1);
+            batch.setCode(1);
+
+            assertEquals(0, echo.createTransfers(batch).getLength());
+            TransferBatch back = echo.echoTransfers(batch);
+            assertEquals(2, back.getLength());
+            back.next();
+            assertEquals(501, back.getIdLo());
+            assertEquals(100, back.getAmountLo());
+            back.next();
+            assertEquals(502, back.getIdLo());
+            assertEquals(40, back.getAmountLo());
+            assertArrayEquals(batch.toArray(), back.toArray());
+        }
+    }
+}

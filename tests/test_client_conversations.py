@@ -138,3 +138,14 @@ def test_conversation_reply_bodies_decode(pinned_time):
     assert [int(x["id_lo"]) for x in xfers] == [501, 503]  # 502 failed
     q = np.frombuffer(body("get_account_transfers"), types.TRANSFER_DTYPE)
     assert [int(x["id_lo"]) for x in q] == [501, 503]
+    # r5 filter/balance surface (VERDICT r4 #8): the history account's
+    # balance snapshots decode as 128-byte AccountBalance rows — the
+    # bytes every client's AccountBalanceBatch decoder parses.
+    assert body("create_accounts_history") == b""
+    assert body("create_transfers_history") == b""
+    bal = np.frombuffer(
+        body("get_account_balances"), types.ACCOUNT_BALANCE_DTYPE
+    )
+    assert len(bal) == 1
+    assert int(bal[0]["credits_posted_lo"]) == 7
+    assert int(bal[0]["timestamp"]) != 0

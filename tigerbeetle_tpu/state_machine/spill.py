@@ -143,12 +143,17 @@ class TransferSpill:
 
     # -- read ----------------------------------------------------------
 
-    def gather(self, rows: np.ndarray) -> np.ndarray:
-        """Global rows (< base) -> (n, TRANSFER_OBJECT_SIZE) u8."""
+    def _lookup_raw(self, rows: np.ndarray) -> np.ndarray:
+        """Raw on-disk objects (ids NOT reconstructed) for rows < base."""
         found, vals = self.groove.object_tree.lookup_batch(_row_keys(rows))
         assert found.all(), "spilled row missing from object tree"
+        return np.ascontiguousarray(vals)
+
+    def gather(self, rows: np.ndarray) -> np.ndarray:
+        """Global rows (< base) -> (n, TRANSFER_OBJECT_SIZE) u8."""
+        vals = self._lookup_raw(rows)
         if self._attrs_fn is not None:
-            vals = self._reconstruct_ids(np.ascontiguousarray(vals))
+            vals = self._reconstruct_ids(vals)
         return vals
 
     def _reconstruct_ids(self, obj: np.ndarray) -> np.ndarray:
@@ -169,9 +174,7 @@ class TransferSpill:
         new status (LSM overwrite; newest version wins on read).  The
         only mutable byte of a spilled object — everything else is
         immutable after spill."""
-        found, obj = self.groove.object_tree.lookup_batch(_row_keys(rows))
-        assert found.all(), "spilled row missing from object tree"
-        obj = np.ascontiguousarray(obj)
+        obj = self._lookup_raw(rows)
         obj[:, 136] = np.asarray(statuses, np.uint8)
         self.groove.object_tree.put_batch(_row_keys(rows), obj)
 
@@ -186,11 +189,7 @@ class TransferSpill:
         while at < self.base:
             n = min(batch, self.base - at)
             rows = np.arange(at, at + n, dtype=np.int64)
-            found, vals = self.groove.object_tree.lookup_batch(
-                _row_keys(rows)
-            )
-            assert found.all(), "spilled row missing from object tree"
-            yield rows, vals
+            yield rows, self._lookup_raw(rows)
             at += n
 
 
