@@ -1,0 +1,59 @@
+"""r5: device time per NON-staged production kernel (engine dispatch
+shape) after the shared-one-hot + linked_small refactor."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+A = 1 << 12
+rng = np.random.default_rng(0)
+n = dk.B
+
+def mk_pk(flags=None, tp=False):
+    dr = rng.integers(0, 1000, n)
+    kw = dict(
+        id_lo=np.arange(1, n + 1, dtype=np.uint64), id_hi=np.zeros(n, np.uint64),
+        dr_lo=dr.astype(np.uint64) + 1, dr_hi=np.zeros(n, np.uint64),
+        cr_lo=(dr.astype(np.uint64) % 1000) + 2, cr_hi=np.zeros(n, np.uint64),
+        pend_lo=np.zeros(n, np.uint64), pend_hi=np.zeros(n, np.uint64),
+        amount_lo=rng.integers(1, 100, n).astype(np.uint64),
+        amount_hi=np.zeros(n, np.uint64),
+        flags=flags if flags is not None else np.zeros(n, np.uint32),
+        ledger=np.ones(n, np.uint32),
+        code=np.ones(n, np.uint32), timeout=np.zeros(n, np.uint32),
+        ts_nonzero=np.zeros(n, bool),
+        dr_slot=dr.astype(np.int64), cr_slot=((dr + 1) % 1000).astype(np.int64),
+        e_found=np.zeros(n, bool),
+    )
+    if tp:
+        kw.update(p_found=np.zeros(n, bool), p_tgt=np.full(n, -1, np.int64),
+                  n_cols=dk.N_COLS_TP)
+    return dk.pack_base(n, **kw)
+
+lf = np.zeros(n, np.uint32)
+lf[:] = 1  # linked
+lf[3::4] = 0  # chains of 4
+
+cases = [
+    ("orderfree_lo", dk.orderfree_lo, mk_pk()),
+    ("linked", dk.linked, mk_pk(lf)),
+    ("linked_small", dk.linked_small, mk_pk(lf)),
+    ("two_phase_lo", dk.two_phase_lo, mk_pk(tp=True)),
+]
+meta = jnp.ones((A, 2), jnp.uint32)
+for name, kern, pk in cases:
+    pkj = jax.device_put(pk)
+    balances = jnp.zeros((A, 8), jnp.uint64)
+    ring = jnp.zeros((256, dk.SUMMARY_WORDS), jnp.uint64)
+    b, r = kern(balances, meta, ring, 0, pkj, n, jnp.uint64(1))
+    jax.block_until_ready(r)
+    K = 32
+    t0 = time.perf_counter()
+    b2, r2 = balances, ring
+    for k in range(K):
+        b2, r2 = kern(b2, meta, r2, k % 256, pkj, n, jnp.uint64(1))
+    jax.block_until_ready(r2)
+    dt = time.perf_counter() - t0
+    print(f"{name:14s}: {dt/K*1e3:6.2f} ms/batch -> {n/(dt/K):,.0f} ev/s")

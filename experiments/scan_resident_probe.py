@@ -1,0 +1,61 @@
+"""r5: launch-overhead hypothesis — scan G batches per launch with
+RESIDENT inputs; compare per-batch wall vs solo dispatches."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+A = 1 << 12
+rng = np.random.default_rng(0)
+n = dk.B
+dr = rng.integers(0, 1000, n)
+pk = dk.pack_base(
+    n,
+    id_lo=np.arange(1, n + 1, dtype=np.uint64), id_hi=np.zeros(n, np.uint64),
+    dr_lo=dr.astype(np.uint64) + 1, dr_hi=np.zeros(n, np.uint64),
+    cr_lo=(dr.astype(np.uint64) % 1000) + 2, cr_hi=np.zeros(n, np.uint64),
+    pend_lo=np.zeros(n, np.uint64), pend_hi=np.zeros(n, np.uint64),
+    amount_lo=rng.integers(1, 100, n).astype(np.uint64),
+    amount_hi=np.zeros(n, np.uint64),
+    flags=np.zeros(n, np.uint32), ledger=np.ones(n, np.uint32),
+    code=np.ones(n, np.uint32), timeout=np.zeros(n, np.uint32),
+    ts_nonzero=np.zeros(n, bool),
+    dr_slot=dr.astype(np.int64), cr_slot=((dr + 1) % 1000).astype(np.int64),
+    e_found=np.zeros(n, bool),
+)
+meta = jnp.ones((A, 2), jnp.uint32)
+
+for G in (8, 16, 32):
+    stack = jax.device_put(np.broadcast_to(pk, (G,) + pk.shape).copy())
+    ns = jnp.full(G, n, jnp.int32)
+    tsb = jnp.arange(G, dtype=jnp.uint64) * jnp.uint64(n)
+
+    def scan_g(table, ring, ring_at0, stack, ns, tsb):
+        def step(carry, xs):
+            table, ring = carry
+            g, nn, t = xs
+            table, ring = dk._orderfree(
+                table, meta, ring, ring_at0 + g, stack[g], nn, t,
+                lo_only=True,
+            )
+            return (table, ring), None
+        (table, ring), _ = jax.lax.scan(
+            step, (table, ring), (jnp.arange(G), ns, tsb))
+        return table, ring
+
+    jscan = jax.jit(scan_g)
+    table = jnp.zeros((A, 8), jnp.uint64)
+    ring = jnp.zeros((256, dk.SUMMARY_WORDS), jnp.uint64)
+    t, r = jscan(table, ring, 0, stack, ns, tsb)
+    jax.block_until_ready(r)
+    K = max(2, 64 // G)
+    t0 = time.perf_counter()
+    t2, r2 = table, ring
+    for k in range(K):
+        t2, r2 = jscan(t2, r2, (k * G) % 128, stack, ns, tsb)
+    jax.block_until_ready(r2)
+    dt = time.perf_counter() - t0
+    per = dt / (K * G)
+    print(f"scan G={G:2d}: {per*1e3:6.2f} ms/batch -> {n/per:,.0f} ev/s")

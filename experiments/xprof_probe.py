@@ -1,0 +1,40 @@
+"""r5: op-level TPU profile of orderfree_lo and linked kernels."""
+import glob, gzip, sys
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+A = 1 << 12
+rng = np.random.default_rng(0)
+n = dk.B
+dr = rng.integers(0, 1000, n)
+pk = dk.pack_base(
+    n,
+    id_lo=np.arange(1, n + 1, dtype=np.uint64), id_hi=np.zeros(n, np.uint64),
+    dr_lo=dr.astype(np.uint64) + 1, dr_hi=np.zeros(n, np.uint64),
+    cr_lo=(dr.astype(np.uint64) % 1000) + 2, cr_hi=np.zeros(n, np.uint64),
+    pend_lo=np.zeros(n, np.uint64), pend_hi=np.zeros(n, np.uint64),
+    amount_lo=rng.integers(1, 100, n).astype(np.uint64),
+    amount_hi=np.zeros(n, np.uint64),
+    flags=np.zeros(n, np.uint32), ledger=np.ones(n, np.uint32),
+    code=np.ones(n, np.uint32), timeout=np.zeros(n, np.uint32),
+    ts_nonzero=np.zeros(n, bool),
+    dr_slot=dr.astype(np.int64), cr_slot=((dr + 1) % 1000).astype(np.int64),
+    e_found=np.zeros(n, bool),
+)
+pkj = jax.device_put(pk)
+meta = jnp.ones((A, 2), jnp.uint32)
+balances = jnp.zeros((A, 8), jnp.uint64)
+ring = jnp.zeros((256, dk.SUMMARY_WORDS), jnp.uint64)
+kern = dk.orderfree_lo
+b, r = kern(balances, meta, ring, 0, pkj, n, jnp.uint64(1))
+jax.block_until_ready(r)
+
+with jax.profiler.trace("/tmp/xprof"):
+    b2, r2 = balances, ring
+    for k in range(8):
+        b2, r2 = kern(b2, meta, r2, k, pkj, n, jnp.uint64(1))
+    jax.block_until_ready(r2)
+print("trace done")

@@ -109,9 +109,15 @@ class _InFlight:
         self.meta_args = meta_args  # (slots, flags, ledger) for "meta"
 
 
-_SEMANTIC_KINDS = (
-    "orderfree", "orderfree_lo", "linked", "two_phase", "two_phase_lo",
-)
+_KERNELS = {
+    "orderfree": dk.orderfree,
+    "orderfree_lo": dk.orderfree_lo,
+    "linked": dk.linked,
+    "linked_small": dk.linked_small,
+    "two_phase": dk.two_phase,
+    "two_phase_lo": dk.two_phase_lo,
+}
+_SEMANTIC_KINDS = tuple(_KERNELS)
 
 
 class DeviceEngine:
@@ -163,6 +169,49 @@ class DeviceEngine:
         if self.sharding is None:
             return table
         return jax.device_put(table, self.sharding)
+
+    def prewarm(self, kinds) -> None:
+        """Pay the one-time per-process costs OFF the hot path: the
+        tunnel compiles a transfer plan per h2d SHAPE (~1 s each,
+        engine trace) and XLA compiles each scan kernel on first call.
+        Callers that know their workload (bench configs) name the
+        kinds; engine construction happens during untimed setup."""
+        kinds = [k for k in kinds if k in _KERNELS]
+        if not kinds:
+            return
+        ncols_set = {
+            dk.N_COLS_TP if k.startswith("two_phase") else dk.N_COLS
+            for k in kinds
+        }
+        for ncols in ncols_set:
+            jax.device_put(np.zeros((dk.B, ncols), np.uint64))
+            for G in dk.SCAN_SIZES:
+                jax.device_put(np.zeros((G, dk.B, ncols), np.uint64))
+        for G in dk.SCAN_SIZES:
+            # The per-step (G,) arrays transfer from host at launch —
+            # their transfer plans need warming like the stacks'.
+            jax.device_put(np.zeros(G, np.int64))
+            jax.device_put(np.zeros(G, np.uint64))
+        table = jnp.zeros_like(self.balances)
+        meta = jnp.zeros_like(self.meta)
+        ring = jnp.zeros_like(self.ring)
+        outs = []
+        for k in kinds:
+            ncols = dk.N_COLS_TP if k.startswith("two_phase") else dk.N_COLS
+            pk = jnp.zeros((dk.B, ncols), jnp.uint64)
+            outs.append(
+                _KERNELS[k](table, meta, ring, 0, pk, 0, jnp.uint64(1))
+            )
+            for G in dk.SCAN_SIZES:
+                stack = jnp.zeros((G, dk.B, ncols), jnp.uint64)
+                outs.append(
+                    dk.scan_kernels[k][G](
+                        table, meta, ring, 0, stack,
+                        jnp.zeros(G, jnp.int64),
+                        jnp.zeros(G, jnp.uint64),
+                    )
+                )
+        jax.block_until_ready(outs)
 
     # ------------------------------------------------------------------
     # Account meta maintenance (create_accounts path).  Rides the
@@ -263,55 +312,107 @@ class DeviceEngine:
     # Window launch: one h2d per column layout (device idle at call
     # time), then back-to-back dispatches with no in-stream transfers.
 
+    def _plan_chunks(self, recs):
+        """Group records into dispatch units: maximal same-kind
+        semantic runs split into scan chunks (largest SCAN_SIZES
+        first, exact decomposition — no padding, no wasted ring
+        rows), with meta/lookup records as unit boundaries."""
+        units = []
+        run = []
+        for rec in recs:
+            if rec.kind in _SEMANTIC_KINDS and (
+                not run or run[-1].kind == rec.kind
+            ):
+                run.append(rec)
+                continue
+            if run:
+                units.extend(self._split_run(run))
+                run = []
+            if rec.kind in _SEMANTIC_KINDS:
+                run.append(rec)
+            else:
+                units.append((rec.kind, [rec]))
+        if run:
+            units.extend(self._split_run(run))
+        return units
+
+    @staticmethod
+    def _split_run(run):
+        out = []
+        at = 0
+        for G in dk.SCAN_SIZES:
+            while len(run) - at >= G:
+                out.append(("scan", run[at : at + G]))
+                at += G
+        for rec in run[at:]:
+            out.append(("solo", [rec]))
+        return out
+
     def _launch(self, recs: list[_InFlight]) -> None:
-        """Upload every batch's inputs first (device idle: small h2ds
-        are sub-ms, experiments/xfer_probe.py), then dispatch the
-        kernels back-to-back — zero in-stream transfers.  Single-batch
-        (B, C) input shapes keep XLA at one compile per kernel."""
+        """Upload every dispatch unit's inputs first (device idle:
+        h2ds are cheap only while nothing is in flight,
+        experiments/xfer_probe.py), then dispatch back-to-back — zero
+        in-stream transfers.  Same-kind runs go G batches per LAUNCH
+        via lax.scan: the tunnel charges ~10 ms launch overhead per
+        dispatch against ~0.8 ms of device compute, so scanned
+        dispatch is worth ~5x (experiments/scan_resident_probe.py)."""
         if not recs:
             return
         t0 = _time.perf_counter()
-        dev_pk = {}
-        for rec in recs:
-            if rec.kind in _SEMANTIC_KINDS:
-                dev_pk[id(rec)] = jax.device_put(rec.pk)
+        units = self._plan_chunks(recs)
+        dev_in = {}
+        for i, (ukind, urecs) in enumerate(units):
+            if ukind == "scan":
+                # device_put (NOT jnp.asarray, whose trace-and-convert
+                # path costs ~1s on this tunnel) for the per-step
+                # arrays too.
+                dev_in[i] = (
+                    jax.device_put(np.stack([r.pk for r in urecs])),
+                    jax.device_put(
+                        np.array([r.n for r in urecs], np.int64)
+                    ),
+                    jax.device_put(
+                        np.array([r.ts_base for r in urecs], np.uint64)
+                    ),
+                )
+            elif ukind == "solo":
+                dev_in[i] = jax.device_put(urecs[0].pk)
         t1 = _time.perf_counter()
         self.stat_t_h2d += t1 - t0
-        for rec in recs:
-            if rec.kind == "meta":
-                slots, flags, ledger = rec.meta_args
+        for i, (ukind, urecs) in enumerate(units):
+            if ukind == "meta":
+                slots, flags, ledger = urecs[0].meta_args
                 self.meta = dk.meta_update(
                     self.meta, jnp.asarray(slots), jnp.asarray(flags),
                     jnp.asarray(ledger),
                 )
                 continue
-            if rec.kind == "lookup":
-                rec.handle = self._gather(rec.slots)
+            if ukind == "lookup":
+                urecs[0].handle = self._gather(urecs[0].slots)
                 continue
-            kernel = {
-                "orderfree": dk.orderfree,
-                "orderfree_lo": dk.orderfree_lo,
-                "linked": dk.linked,
-                "two_phase": dk.two_phase,
-                "two_phase_lo": dk.two_phase_lo,
-            }[rec.kind]
-            self.balances, self.ring = kernel(
+            if ukind == "solo":
+                rec = urecs[0]
+                self.balances, self.ring = _KERNELS[rec.kind](
+                    self.balances, self.meta, self.ring, self._ring_at,
+                    dev_in[i], rec.n, jnp.uint64(rec.ts_base),
+                )
+                rec.ring_at = self._ring_at
+                self._ring_at = (self._ring_at + 1) % _RING
+                continue
+            stack, ns, tsb = dev_in[i]
+            scan_fn = dk.scan_kernels[urecs[0].kind][len(urecs)]
+            self.balances, self.ring = scan_fn(
                 self.balances, self.meta, self.ring, self._ring_at,
-                dev_pk[id(rec)], rec.n, jnp.uint64(rec.ts_base),
+                stack, ns, tsb,
             )
-            rec.ring_at = self._ring_at
-            self._ring_at = (self._ring_at + 1) % _RING
+            for g, rec in enumerate(urecs):
+                rec.ring_at = (self._ring_at + g) % _RING
+            self._ring_at = (self._ring_at + len(urecs)) % _RING
         self.stat_t_dispatch += _time.perf_counter() - t1
 
     def _dispatch(self, rec: _InFlight) -> None:
         """Immediate single-batch dispatch (fallback re-dispatch path)."""
-        kernel = {
-            "orderfree": dk.orderfree,
-            "orderfree_lo": dk.orderfree_lo,
-            "linked": dk.linked,
-            "two_phase": dk.two_phase,
-            "two_phase_lo": dk.two_phase_lo,
-        }[rec.kind]
+        kernel = _KERNELS[rec.kind]
         self.balances, self.ring = kernel(
             self.balances, self.meta, self.ring, self._ring_at,
             jnp.asarray(rec.pk), rec.n, jnp.uint64(rec.ts_base),

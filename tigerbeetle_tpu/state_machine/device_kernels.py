@@ -43,6 +43,18 @@ import numpy as np
 import jax
 
 jax.config.update("jax_enable_x64", True)
+# Persistent XLA compilation cache: the scanned dispatch kernels cost
+# minutes of one-time compile on the tunneled TPU; caching them on
+# disk makes that a once-per-machine cost instead of once-per-process
+# (bench runs six configs in separate engine instances).
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.expanduser("~/.cache/tigerbeetle_tpu_xla"),
+        )
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        pass
 
 import jax.numpy as jnp
 
@@ -201,60 +213,89 @@ def _static_ladder_normal(ev, meta, active):
     return jnp.where(active, r, jnp.uint32(CTR.linked_event_failed))
 
 
-def _accum_cols(slot_rows, col_rows, amt_lo_rows, amt_hi_rows, valid, A,
-                lo_only=False):
-    """Exact per-(slot, column) u128 sums via one-hot MXU matmul.
+def _accum_cols_multi(slot_rows, passes, A, lo_only=False):
+    """Exact per-(slot, column) u128 sums via ONE one-hot MXU matmul
+    shared across several accumulation passes.
+
+    `passes` is a list of (col_rows, amt_lo_rows, amt_hi_rows, valid)
+    over the SAME slot rows; their 8-bit-piece payloads concatenate
+    along the feature axis, so the (rows, A) one-hot — the dominant
+    HBM traffic of these kernels — is materialized once however many
+    sums a kernel needs (linked: superset admission + final apply;
+    two_phase: adds + releases).
 
     Amounts decompose into 8-bit pieces (each < 2^8); the one-hot
-    (rows, A) bf16 matmul accumulates them in f32 — sums stay below
+    bf16 matmul accumulates them in f32 — sums stay below
     rows * 255 < 2^24, so every partial is exact — and a base-256
-    carry recombination rebuilds exact u128 column deltas.
+    carry recombination rebuilds exact u128 column deltas.  Invalid
+    rows contribute ZERO payload (their slot may be clip-garbage; a
+    zero contribution to any slot is harmless).
 
     `lo_only` halves the payload (8 pieces) when every amount's high
-    limb is zero — a trace-time specialization the host router selects
-    (the high-limb sum is then just the carry chain's overflow).
+    limb is zero — a trace-time specialization the host router
+    selects (the high-limb sum is then just the carry chain's
+    overflow).
 
-    Returns (d_lo, d_hi, limb_ov) of shape (A, 4).
+    Returns one (d_lo, d_hi, limb_ov) of shape (A, 4) per pass.
     """
     rows = slot_rows.shape[0]
     zero = jnp.uint64(0)
-    lo = jnp.where(valid, amt_lo_rows, zero)
-    pieces = [((lo >> jnp.uint64(s)) & _MASK8).astype(jnp.float32)
-              for s in range(0, 64, 8)]
-    if not lo_only:
-        hi = jnp.where(valid, amt_hi_rows, zero)
-        pieces += [((hi >> jnp.uint64(s)) & _MASK8).astype(jnp.float32)
-                   for s in range(0, 64, 8)]
-    npieces = len(pieces)
-    P = jnp.stack(pieces, axis=-1)  # (rows, npieces)
-    colmask = jax.nn.one_hot(col_rows, 4, dtype=jnp.float32)  # (rows, 4)
-    payload = (colmask[:, :, None] * P[:, None, :]).reshape(
-        rows, 4 * npieces
+    npieces = 8 if lo_only else 16
+    payloads = []
+    for col_rows, amt_lo_rows, amt_hi_rows, valid in passes:
+        lo = jnp.where(valid, amt_lo_rows, zero)
+        pieces = [((lo >> jnp.uint64(s)) & _MASK8).astype(jnp.float32)
+                  for s in range(0, 64, 8)]
+        if not lo_only:
+            hi = jnp.where(valid, amt_hi_rows, zero)
+            pieces += [((hi >> jnp.uint64(s)) & _MASK8).astype(jnp.float32)
+                       for s in range(0, 64, 8)]
+        P = jnp.stack(pieces, axis=-1)  # (rows, npieces)
+        colmask = jax.nn.one_hot(col_rows, 4, dtype=jnp.float32)
+        payloads.append(
+            (colmask[:, :, None] * P[:, None, :]).reshape(rows, 4 * npieces)
+        )
+    payload = jnp.concatenate(payloads, axis=-1)
+    onehot = jax.nn.one_hot(
+        jnp.clip(slot_rows, 0, A - 1), A, dtype=jnp.bfloat16
     )
-    safe_slots = jnp.where(valid, slot_rows, A)  # A = dropped lane
-    onehot = jax.nn.one_hot(safe_slots, A, dtype=jnp.bfloat16)
-    acc = jax.lax.dot_general(
+    acc_all = jax.lax.dot_general(
         onehot.T, payload.astype(jnp.bfloat16),
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ).reshape(A, 4, npieces).astype(jnp.uint64)
-    c = acc[:, :, 0]
-    d_lo = c & _MASK8
-    carry = c >> jnp.uint64(8)
-    for k in range(1, 8):
-        c = acc[:, :, k] + carry
-        d_lo = d_lo | ((c & _MASK8) << jnp.uint64(8 * k))
+    ).reshape(A, len(passes), 4, npieces).astype(jnp.uint64)
+
+    out = []
+    for p in range(len(passes)):
+        acc = acc_all[:, p]
+        c = acc[:, :, 0]
+        d_lo = c & _MASK8
         carry = c >> jnp.uint64(8)
-    if lo_only:
-        return d_lo, carry, jnp.zeros((A, 4), bool)
-    c = acc[:, :, 8] + carry
-    d_hi = c & _MASK8
-    carry = c >> jnp.uint64(8)
-    for k in range(1, 8):
-        c = acc[:, :, 8 + k] + carry
-        d_hi = d_hi | ((c & _MASK8) << jnp.uint64(8 * k))
+        for k in range(1, 8):
+            c = acc[:, :, k] + carry
+            d_lo = d_lo | ((c & _MASK8) << jnp.uint64(8 * k))
+            carry = c >> jnp.uint64(8)
+        if lo_only:
+            out.append((d_lo, carry, jnp.zeros((A, 4), bool)))
+            continue
+        c = acc[:, :, 8] + carry
+        d_hi = c & _MASK8
         carry = c >> jnp.uint64(8)
-    return d_lo, d_hi, carry != 0
+        for k in range(1, 8):
+            c = acc[:, :, 8 + k] + carry
+            d_hi = d_hi | ((c & _MASK8) << jnp.uint64(8 * k))
+            carry = c >> jnp.uint64(8)
+        out.append((d_lo, d_hi, carry != 0))
+    return out
+
+
+def _accum_cols(slot_rows, col_rows, amt_lo_rows, amt_hi_rows, valid, A,
+                lo_only=False):
+    """Single-pass convenience wrapper over _accum_cols_multi."""
+    return _accum_cols_multi(
+        slot_rows, [(col_rows, amt_lo_rows, amt_hi_rows, valid)], A,
+        lo_only=lo_only,
+    )[0]
 
 
 def _admit_apply(table, d_lo, d_hi, limb_ov):
@@ -371,12 +412,19 @@ def _orderfree(table, meta, ring, ring_at, pk, n, ts_base, lo_only=False):
 # Linked-chain kernel (port of resolve.linked_resolve to device).
 
 
-def _linked(table, meta, ring, ring_at, pk, n, ts_base):
+def _linked(table, meta, ring, ring_at, pk, n, ts_base, small=False):
     """Linked-chain batch of plain posted transfers; limit-flag
     accounts allowed.  Jacobi fixpoint over per-account segmented
     prefix sums converges to the exact sequential verdicts (see
     resolve.py for the correctness argument; reference:
-    src/state_machine.zig:1220-1306, src/tigerbeetle.zig:31-39)."""
+    src/state_machine.zig:1220-1306, src/tigerbeetle.zig:31-39).
+
+    `small` is a trace-time specialization the host router selects
+    when the batch's total amount contribution fits i32: each
+    fixpoint prefix is then ONE i32 cumsum instead of four 16-bit
+    pieces (the fixpoint's dominant per-iteration cost).  The device
+    still verifies the bound and raises the precondition flag (exact
+    host fallback) if the router's pick was wrong."""
     ev = _unpack(pk)
     A = table.shape[0]
     iota = jnp.arange(B, dtype=jnp.int64)
@@ -427,11 +475,14 @@ def _linked(table, meta, ring, ring_at, pk, n, ts_base):
         lim_touch[:, None] & (lo_cols >= jnp.uint64(_U64_SAFE))
     ).any()
     contrib = jnp.where(static_ok, ev["amt_lo"], jnp.uint64(0))
+    sum_bound = jnp.float64((1 << 31) - 1) if small else jnp.float64(_U64_SAFE)
     precond_bad = precond_bad | (
-        contrib.astype(jnp.float64).sum() >= jnp.float64(_U64_SAFE)
+        contrib.astype(jnp.float64).sum() >= sum_bound
     )
 
-    # ---- superset overflow admission (static_ok events, posted cols).
+    # ---- superset overflow admission rows (static_ok events, posted
+    # cols); the sums themselves ride the SAME one-hot matmul as the
+    # final apply below (one materialization of the (2B, A) one-hot).
     slot_rows = jnp.concatenate([ev["dr_slot"], ev["cr_slot"]])
     col_rows = jnp.concatenate(
         [jnp.ones(B, jnp.int32), jnp.full(B, 3, jnp.int32)]
@@ -439,10 +490,6 @@ def _linked(table, meta, ring, ring_at, pk, n, ts_base):
     amt_lo2 = jnp.concatenate([ev["amt_lo"]] * 2)
     amt_hi2 = jnp.concatenate([ev["amt_hi"]] * 2)
     sup_valid = jnp.concatenate([static_ok, static_ok])
-    d_lo_s, d_hi_s, limb_ov_s = _accum_cols(
-        slot_rows, col_rows, amt_lo2, amt_hi2, sup_valid, A, lo_only=True
-    )
-    _, sup_ov = _admit_apply(table, d_lo_s, d_hi_s, limb_ov_s)
 
     # ---- fixpoint over (slot, event)-sorted limit entries.
     # Entries: 2B rows (dr side then cr side); invalid rows get
@@ -499,11 +546,17 @@ def _linked(table, meta, ring, ring_at, pk, n, ts_base):
         return applied_prefix, chain_ok
 
     def excl_prefix(v):
-        # Exact u64 inclusive cumsum via four 16-bit-piece i32 cumsums
-        # (totals < 2^61 by the precondition; piece sums < M * 2^16
-        # < 2^31).  A direct u64 cumsum lowers to a variadic (u32, u32)
-        # reduce-window that blows XLA:TPU's scoped vmem inside
-        # while_loop bodies — see experiments/tpu_compile_check.py.
+        # Exact u64 inclusive cumsum.  A direct u64 cumsum lowers to a
+        # variadic (u32, u32) reduce-window that blows XLA:TPU's
+        # scoped vmem inside while_loop bodies — see
+        # experiments/tpu_compile_check.py.  small: the verified
+        # < 2^31 total makes one i32 cumsum exact.  General: four
+        # 16-bit-piece i32 cumsums (totals < 2^61 by the
+        # precondition; piece sums < M * 2^16 < 2^31).
+        if small:
+            return (
+                jnp.cumsum(v.astype(jnp.int32)).astype(jnp.uint64) - v
+            )
         cs = jnp.uint64(0)
         for k in range(4):
             p = ((v >> jnp.uint64(16 * k)) & _MASK16).astype(jnp.int32)
@@ -597,13 +650,20 @@ def _linked(table, meta, ring, ring_at, pk, n, ts_base):
         results,
     )
 
-    # ---- apply (events with results == 0 are exactly the members of
+    # ---- superset admission + apply in ONE shared-one-hot matmul
+    # (events with results == 0 are exactly the members of
     # fully-passing chains).
     okev = active & (results == 0)
     ap_valid = jnp.concatenate([okev, okev])
-    d_lo, d_hi, limb_ov = _accum_cols(
-        slot_rows, col_rows, amt_lo2, amt_hi2, ap_valid, A, lo_only=True
+    (d_lo_s, d_hi_s, limb_ov_s), (d_lo, d_hi, limb_ov) = _accum_cols_multi(
+        slot_rows,
+        [
+            (col_rows, amt_lo2, amt_hi2, sup_valid),
+            (col_rows, amt_lo2, amt_hi2, ap_valid),
+        ],
+        A, lo_only=True,
     )
+    _, sup_ov = _admit_apply(table, d_lo_s, d_hi_s, limb_ov_s)
     fallback = sup_ov | precond_bad | fix_failed
     new_table, _ov2 = _admit_apply(table, d_lo, d_hi, limb_ov)
     new_table = jnp.where(fallback, table, new_table)
@@ -801,25 +861,31 @@ def _two_phase(table, meta, ring, ring_at, pk, n, ts_base, lo_only=False):
     add_valid = jnp.concatenate(
         [pend_ok | plain_ok, pend_ok | plain_ok, post_win, post_win]
     )
-    d_lo, d_hi, limb_ov = _accum_cols(
-        add_slots, add_cols, add_amt_lo, add_amt_hi, add_valid, A,
-        lo_only=lo_only,
-    )
-    mid_table, ov = _admit_apply(table, d_lo, d_hi, limb_ov)
-
     # Releases: winners subtract the pending amount from dp/cp (cannot
     # underflow: each live pending's amount is contained by invariant).
-    sub_slots = jnp.concatenate([p_drs, p_crs])
+    # They ride the SAME 4B-row one-hot as the adds — the release rows
+    # are the [p_drs, p_crs] halves with their own columns and
+    # validity; the [dr, cr] halves contribute zero.
+    falseB = jnp.zeros(B, bool)
+    win = ok & winner
     sub_cols = jnp.concatenate(
-        [jnp.zeros(B, jnp.int32), jnp.full(B, 2, jnp.int32)]
+        [
+            jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+            jnp.zeros(B, jnp.int32), jnp.full(B, 2, jnp.int32),
+        ]
     )
-    sub_amt_lo = jnp.concatenate([p_amt_lo] * 2)
-    sub_amt_hi = jnp.concatenate([p_amt_hi] * 2)
-    win2 = jnp.concatenate([ok & winner, ok & winner])
-    s_lo, s_hi, s_limb = _accum_cols(
-        sub_slots, sub_cols, sub_amt_lo, sub_amt_hi, win2, A,
-        lo_only=lo_only,
+    sub_amt_lo = jnp.concatenate([p_amt_lo] * 4)
+    sub_amt_hi = jnp.concatenate([p_amt_hi] * 4)
+    sub_valid = jnp.concatenate([falseB, falseB, win, win])
+    (d_lo, d_hi, limb_ov), (s_lo, s_hi, s_limb) = _accum_cols_multi(
+        add_slots,
+        [
+            (add_cols, add_amt_lo, add_amt_hi, add_valid),
+            (sub_cols, sub_amt_lo, sub_amt_hi, sub_valid),
+        ],
+        A, lo_only=lo_only,
     )
+    mid_table, ov = _admit_apply(table, d_lo, d_hi, limb_ov)
     old_lo = mid_table[:, 0::2]
     old_hi = mid_table[:, 1::2]
     n_lo = old_lo - s_lo
@@ -901,8 +967,54 @@ import functools as _ft
 orderfree = jax.jit(_orderfree)
 orderfree_lo = jax.jit(_ft.partial(_orderfree, lo_only=True))
 linked = jax.jit(_linked)
+linked_small = jax.jit(_ft.partial(_linked, small=True))
 two_phase = jax.jit(_two_phase)
 two_phase_lo = jax.jit(_ft.partial(_two_phase, lo_only=True))
+
+
+# Scanned dispatch: G same-kind batches per device LAUNCH.  The
+# tunneled link charges ~10 ms of launch overhead per dispatch even
+# with resident inputs (experiments/scan_resident_probe.py: solo
+# 11 ms/batch vs scan-16 2.0 ms/batch; the op-level trace puts actual
+# device compute at ~0.8 ms) — lax.scan amortizes that overhead over
+# the chunk.  Ring rows are addressed (ring_at0 + g) % ring_rows per
+# step, so chunks may wrap the ring freely.
+
+def _scan_of(fn, G):
+    def run(table, meta, ring, ring_at0, stack, ns, tsb):
+        R = ring.shape[0]
+
+        def step(carry, xs):
+            table, ring = carry
+            g, nn, t = xs
+            table, ring = fn(
+                table, meta, ring, (ring_at0 + g) % R, stack[g], nn, t
+            )
+            return (table, ring), None
+
+        (table, ring), _ = jax.lax.scan(
+            step, (table, ring),
+            (jnp.arange(G), ns, tsb),
+        )
+        return table, ring
+
+    return jax.jit(run)
+
+
+_BASE_FNS = {
+    "orderfree": _orderfree,
+    "orderfree_lo": _ft.partial(_orderfree, lo_only=True),
+    "linked": _linked,
+    "linked_small": _ft.partial(_linked, small=True),
+    "two_phase": _two_phase,
+    "two_phase_lo": _ft.partial(_two_phase, lo_only=True),
+}
+SCAN_SIZES = (16, 4)
+# kind -> {G: jitted scan}; compiled lazily per (kind, G) actually used.
+scan_kernels = {
+    kind: {G: _scan_of(fn, G) for G in SCAN_SIZES}
+    for kind, fn in _BASE_FNS.items()
+}
 
 
 def _staged(fn, ncols):
