@@ -155,11 +155,54 @@ def test_grid_scrubber_finds_corruption():
     bad = addrs[3]
     storage.corrupt_sector(grid._offset(bad))
 
-    scrubber = GridScrubber(grid, blocks_per_tick=4)
+    scrubber = GridScrubber(grid, cycle_ticks=2, blocks_per_tick_max=4)
     found = []
     while scrubber.cycles == 0:
         found += scrubber.tick()
     assert set(found) == {bad}
+
+
+def test_grid_scrubber_tour_semantics():
+    """Tour machinery (reference: src/vsr/grid_scrubber.zig): a cycle
+    walks a STABLE snapshot paced across cycle_ticks, skips blocks
+    freed mid-tour instead of flagging their stale frames, and picks
+    up new allocations on the next tour."""
+    storage = MemoryStorage(ZoneLayout(config=cfg.TEST_MIN, grid_size=1 << 22))
+    grid = Grid(storage, block_size=4096, block_count=64)
+    fs = grid.free_set
+    res = fs.reserve(16)
+    addrs = [fs.acquire(res) for _ in range(16)]
+    fs.forfeit(res)
+    for a in addrs:
+        grid.write_block(a, bytes([a]) * 64)
+
+    scrubber = GridScrubber(grid, cycle_ticks=4, blocks_per_tick_max=8)
+    # Pacing: 16 blocks over 4 ticks -> 4 per tick, progress advances.
+    assert scrubber.tick() == []
+    assert 0.0 < scrubber.progress < 1.0
+    # Release a not-yet-scrubbed block and stale its frame: the tour
+    # must SKIP it — the block is leaving the live set and peers may
+    # no longer serve it for repair.
+    victim = addrs[-1]
+    fs.release(victim)
+    storage.corrupt_sector(grid._offset(victim))
+    while scrubber.cycles == 0:
+        assert scrubber.tick() == []
+    assert scrubber.faults_found == 0
+
+    # A block allocated after the first snapshot joins the NEXT tour:
+    # corrupt it and the scrubber must find it on the following cycle.
+    res = fs.reserve(1)
+    fresh = fs.acquire(res)
+    fs.forfeit(res)
+    grid.write_block(fresh, b"fresh")
+    storage.corrupt_sector(grid._offset(fresh))  # verify_block reads disk
+    found = []
+    start_cycles = scrubber.cycles
+    while scrubber.cycles < start_cycles + 2:
+        found += scrubber.tick()
+    assert fresh in found
+    assert victim not in found
 
 
 # ---------------------------------------------------------------------------

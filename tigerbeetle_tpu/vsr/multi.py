@@ -170,8 +170,10 @@ class VsrReplica(Replica):
         if self.forest is not None:
             from tigerbeetle_tpu.vsr.scrubber import GridScrubber
 
+            # Pace a full tour across ~4096 scrub ticks, one small
+            # read burst each (reference cycle pacing).
             self.scrubber = GridScrubber(
-                self.forest.grid, blocks_per_tick=1
+                self.forest.grid, cycle_ticks=4096, blocks_per_tick_max=8
             )
         self._blocks_missing: set[int] = set()
         self._block_repair_last = -10**9
@@ -1482,10 +1484,14 @@ class VsrReplica(Replica):
         """Ask a peer for our corrupt blocks (round-robin over peers,
         bounded batch per request)."""
         self._block_repair_last = self._ticks
-        # Blocks freed since they were flagged no longer need repair.
-        free = self.forest.grid.free_set.free
+        # Blocks freed — or staged for release — since they were
+        # flagged no longer need repair (a peer that already
+        # checkpointed holds them free and would silently drop the
+        # request; same invariant as the scrubber's skip).
+        fs = self.forest.grid.free_set
         self._blocks_missing = {
-            a for a in self._blocks_missing if not free[a - 1]
+            a for a in self._blocks_missing
+            if not (fs.free[a - 1] or fs.staging[a - 1])
         }
         if not self._blocks_missing:
             return

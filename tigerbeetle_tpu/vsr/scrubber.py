@@ -1,8 +1,29 @@
 """Grid scrubber: background read-verify of allocated grid blocks.
 
-reference: src/vsr/grid_scrubber.zig:1-21 — cycles through every
+reference: src/vsr/grid_scrubber.zig — cycles ("tours") through every
 allocated block proactively so latent sector errors are found (and
-repaired from peers) before the data is needed.
+repaired from peers) before the data is needed.  Design carried over
+from the reference's tour machinery:
+
+- A tour SNAPSHOTS the allocated set once per cycle and walks that
+  snapshot to completion; blocks allocated mid-cycle are picked up by
+  the next tour (a moving target would skip or double-scrub blocks as
+  the free set churns — the old per-tick re-listing did exactly that,
+  and cost O(grid) work per tick).
+- Blocks freed after the snapshot are skipped at probe time: a
+  released block's frame legitimately goes stale the moment the free
+  set forfeits it (reference: grid_scrubber cancels reads for freed
+  blocks at checkpoint).
+- Pacing targets a TOUR DURATION rather than a fixed per-tick count:
+  each tick probes just enough blocks to finish the snapshot within
+  ``cycle_ticks``, bounded by ``blocks_per_tick_max`` so a huge grid
+  never turns one tick into an I/O storm (reference:
+  grid_scrubber.zig cycle pacing against constants.grid_scrubber_*).
+- Stats (`blocks_verified`, `faults_found`, `cycles`, `progress`)
+  feed the replica's StatsD/tracer surfacing.
+
+Corrupt addresses route into the replica's block-repair machinery
+(`request_blocks`/`block`, vsr/multi.py) one block at a time.
 """
 
 from __future__ import annotations
@@ -13,27 +34,63 @@ from tigerbeetle_tpu.vsr.grid import Grid
 
 
 class GridScrubber:
-    def __init__(self, grid: Grid, blocks_per_tick: int = 4) -> None:
+    def __init__(self, grid: Grid, *, cycle_ticks: int = 1024,
+                 blocks_per_tick_max: int = 32) -> None:
         self.grid = grid
-        self.blocks_per_tick = blocks_per_tick
+        self.cycle_ticks = max(1, cycle_ticks)
+        self.blocks_per_tick_max = max(1, blocks_per_tick_max)
+        # Current tour: a stable snapshot of allocated addresses and a
+        # cursor into it; ticks remaining drive the pacing.
+        self._tour: np.ndarray = np.zeros(0, np.int64)
         self._cursor = 0
+        self._ticks_left = 0
         self.corrupt: list[int] = []
         self.cycles = 0
+        self.blocks_verified = 0
+        self.faults_found = 0
+
+    @property
+    def progress(self) -> float:
+        """Fraction of the current tour completed (0..1)."""
+        if len(self._tour) == 0:
+            return 1.0
+        return self._cursor / len(self._tour)
+
+    def _begin_tour(self) -> None:
+        self._tour = np.flatnonzero(~self.grid.free_set.free) + 1
+        self._cursor = 0
+        self._ticks_left = self.cycle_ticks
 
     def tick(self) -> list[int]:
-        """Verify the next few allocated blocks; returns newly-found
+        """Verify the next paced chunk of the tour; returns newly-found
         corrupt addresses."""
-        found: list[int] = []
-        allocated = np.flatnonzero(~self.grid.free_set.free)
-        if len(allocated) == 0:
-            return found
-        for _ in range(self.blocks_per_tick):
-            if self._cursor >= len(allocated):
-                self._cursor = 0
+        if self._cursor >= len(self._tour):
+            if len(self._tour):
                 self.cycles += 1
-            address = int(allocated[self._cursor]) + 1
-            self._cursor += 1
+            self._begin_tour()
+            if len(self._tour) == 0:
+                return []
+        remaining = len(self._tour) - self._cursor
+        quota = -(-remaining // max(1, self._ticks_left))  # ceil
+        quota = min(quota, self.blocks_per_tick_max, remaining)
+        self._ticks_left = max(1, self._ticks_left - 1)
+        found: list[int] = []
+        fs = self.grid.free_set
+        chunk = self._tour[self._cursor : self._cursor + quota]
+        # Freed — or staged for release — since the snapshot: the
+        # block is leaving the live set, and a peer that already
+        # checkpointed may not serve it for repair anymore.  Skip
+        # rather than flag (reference: grid_scrubber cancels reads of
+        # released blocks).  Indexed per chunk, not a full-grid mask.
+        dead = fs.free[chunk - 1] | fs.staging[chunk - 1]
+        for address, is_dead in zip(chunk, dead):
+            if is_dead:
+                continue
+            address = int(address)
+            self.blocks_verified += 1
             if not self.grid.verify_block(address):
                 found.append(address)
+        self._cursor += quota
+        self.faults_found += len(found)
         self.corrupt.extend(found)
         return found
