@@ -1,0 +1,55 @@
+"""r5: scan-G16 per-batch device+launch time for each kernel kind."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+from tigerbeetle_tpu.state_machine import device_kernels as dk
+
+A = 1 << 12
+rng = np.random.default_rng(0)
+n = dk.B
+dr = rng.integers(0, 1000, n)
+
+def mk_pk(flags=None, tp=False):
+    kw = dict(
+        id_lo=np.arange(1, n + 1, dtype=np.uint64), id_hi=np.zeros(n, np.uint64),
+        dr_lo=dr.astype(np.uint64) + 1, dr_hi=np.zeros(n, np.uint64),
+        cr_lo=(dr.astype(np.uint64) % 1000) + 2, cr_hi=np.zeros(n, np.uint64),
+        pend_lo=np.zeros(n, np.uint64), pend_hi=np.zeros(n, np.uint64),
+        amount_lo=rng.integers(1, 100, n).astype(np.uint64),
+        amount_hi=np.zeros(n, np.uint64),
+        flags=flags if flags is not None else np.zeros(n, np.uint32),
+        ledger=np.ones(n, np.uint32),
+        code=np.ones(n, np.uint32), timeout=np.zeros(n, np.uint32),
+        ts_nonzero=np.zeros(n, bool),
+        dr_slot=dr.astype(np.int64), cr_slot=((dr + 1) % 1000).astype(np.int64),
+        e_found=np.zeros(n, bool),
+    )
+    if tp:
+        kw.update(p_found=np.zeros(n, bool), p_tgt=np.full(n, -1, np.int64),
+                  n_cols=dk.N_COLS_TP)
+    return dk.pack_base(n, **kw)
+
+lf = np.zeros(n, np.uint32); lf[:] = 1; lf[3::4] = 0
+meta = jnp.ones((A, 2), jnp.uint32)
+G = 16
+for kind, pk in (("orderfree_lo", mk_pk()), ("linked_small", mk_pk(lf)),
+                 ("two_phase_lo", mk_pk(tp=True))):
+    scan = dk.scan_kernels[kind][G]
+    stack = jax.device_put(np.broadcast_to(pk, (G,) + pk.shape).copy())
+    ns = jax.device_put(np.full(G, n, np.int64))
+    tsb = jax.device_put(np.arange(G, dtype=np.uint64))
+    table = jnp.zeros((A, 8), jnp.uint64)
+    ring = jnp.zeros((256, dk.SUMMARY_WORDS), jnp.uint64)
+    t, r = scan(table, meta, ring, 0, stack, ns, tsb)
+    jax.block_until_ready(r)
+    K = 4
+    t0 = time.perf_counter()
+    t2, r2 = table, ring
+    for k in range(K):
+        t2, r2 = scan(t2, meta, r2, (k * G) % 128, stack, ns, tsb)
+    jax.block_until_ready(r2)
+    dt = time.perf_counter() - t0
+    per = dt / (K * G)
+    print(f"{kind:14s} scan16: {per*1e3:6.2f} ms/batch -> {n/per:,.0f} ev/s")

@@ -70,6 +70,9 @@ B = int(os.environ.get("TB_DEV_B", "8192"))
 # _accum_cols exactness bound: f32 partial sums of 8-bit pieces over at
 # most 4B rows (the two_phase add matmul) must stay below 2^24.
 assert 4 * B * 255 < (1 << 24), "TB_DEV_B too large for exact f32 sums"
+# The linked kernel packs (event << 1 | side) into 14 key bits and
+# masks events with B-1 (see _linked's single-operand sort).
+assert B <= 8192 and B & (B - 1) == 0, "TB_DEV_B must be a power of 2 <= 8192"
 SUMMARY_WORDS = 64
 FAIL_CAP = SUMMARY_WORDS - 4   # failure entries per batch summary
 
@@ -493,23 +496,42 @@ def _linked(table, meta, ring, ring_at, pk, n, ts_base, small=False):
 
     # ---- fixpoint over (slot, event)-sorted limit entries.
     # Entries: 2B rows (dr side then cr side); invalid rows get
-    # sentinel keys that sort to the end.
-    evs2 = jnp.concatenate([iota, iota])
+    # sentinel keys that sort to the end.  The TPU sort's cost scales
+    # with operand count, so everything is PACKED into one u64 key —
+    # slot << 14 | event << 1 | side — and the per-entry columns are
+    # recovered arithmetically from the sorted keys (events are
+    # distinct within a slot because dr != cr, so the side bit never
+    # affects the required event order).
     eslot2 = jnp.concatenate([ev["dr_slot"], ev["cr_slot"]])
-    eamt2 = jnp.concatenate([ev["amt_lo"]] * 2)
-    edeb2 = jnp.concatenate([jnp.ones(B, bool), jnp.zeros(B, bool)])
     entv = jnp.concatenate([ent_d, ent_c])
-    key = jnp.where(
-        entv,
-        (eslot2.astype(jnp.uint64) << jnp.uint64(32))
-        | evs2.astype(jnp.uint64),
-        jnp.uint64(0xFFFFFFFFFFFFFFFF),
+    side2 = jnp.concatenate([jnp.zeros(B, jnp.uint64), jnp.ones(B, jnp.uint64)])
+    evs2 = jnp.concatenate([iota, iota]).astype(jnp.uint64)
+    key64 = (
+        (eslot2.astype(jnp.uint64) << jnp.uint64(14))
+        | (evs2 << jnp.uint64(1)) | side2
     )
-    key_s, evs_s, eslot_s, eamt_s, edeb_s, valid_s = jax.lax.sort(
-        [key, evs2.astype(jnp.int32), eslot2.astype(jnp.int32), eamt2,
-         edeb2, entv],
-        num_keys=1,
-    )
+    # u64 sorts as a variadic (u32, u32) pair on TPU — twice the
+    # compare/swap traffic.  The packed key needs log2(A) + 14 bits,
+    # so any table up to 2^17 rows sorts in native u32.
+    if A <= (1 << 17):
+        key = jnp.where(
+            entv, key64.astype(jnp.uint32), jnp.uint32(0xFFFFFFFF)
+        )
+        sentinel = jnp.uint32(0xFFFFFFFF)
+    else:
+        key = jnp.where(entv, key64, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        sentinel = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    (key_s,) = jax.lax.sort([key], num_keys=1)
+    valid_s = key_s != sentinel
+    key_su = key_s.astype(jnp.uint64)
+    evs_s = jnp.where(
+        valid_s, (key_su >> jnp.uint64(1)) & jnp.uint64(B - 1), jnp.uint64(0)
+    ).astype(jnp.int32)
+    eslot_s = jnp.where(
+        valid_s, key_su >> jnp.uint64(14), jnp.uint64(0x7FFFFFFF)
+    ).astype(jnp.int32)
+    edeb_s = valid_s & ((key_su & jnp.uint64(1)) == 0)
+    eamt_s = ev["amt_lo"][evs_s]
     M = 2 * B
     jpos = jnp.arange(M)
     seg_new = jnp.concatenate(
@@ -518,12 +540,17 @@ def _linked(table, meta, ring, ring_at, pk, n, ts_base, small=False):
     seg_first = jax.lax.associative_scan(
         jnp.maximum, jnp.where(seg_new, jpos, 0)
     )
-    bkey = jnp.where(
-        valid_s,
-        (eslot_s.astype(jnp.uint64) << jnp.uint64(32))
-        | start_of_ev[jnp.clip(evs_s, 0, B - 1)].astype(jnp.uint64),
-        jnp.uint64(0xFFFFFFFFFFFFFFFF),
+    # Chain-start boundary per entry, in the SAME packed-key encoding
+    # and dtype (side bit 0 sorts before either side of the start
+    # event).
+    bkey64 = (
+        (eslot_s.astype(jnp.uint64) << jnp.uint64(14))
+        | (
+            start_of_ev[jnp.clip(evs_s, 0, B - 1)].astype(jnp.uint64)
+            << jnp.uint64(1)
+        )
     )
+    bkey = jnp.where(valid_s, bkey64.astype(key_s.dtype), sentinel)
     bpos = jnp.searchsorted(key_s, bkey, side="left")
 
     esl = jnp.clip(eslot_s, 0, A - 1)

@@ -76,6 +76,16 @@ class FreeSet:
         assert not self.free[address - 1]
         self.staging[address - 1] = True
 
+    def leaving_live_set(self, addresses):
+        """Free OR staged-for-release, vectorized over addresses: such
+        blocks' frames may legitimately go stale and peers that
+        already checkpointed no longer serve them — the shared
+        predicate behind the scrubber's skip and the repair filter."""
+        import numpy as np
+
+        idx = np.asarray(addresses, np.int64) - 1
+        return self.free[idx] | self.staging[idx]
+
     def checkpoint(self) -> None:
         """The previous checkpoint is durable: staged releases become
         actually free."""

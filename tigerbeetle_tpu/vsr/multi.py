@@ -1491,7 +1491,7 @@ class VsrReplica(Replica):
         fs = self.forest.grid.free_set
         self._blocks_missing = {
             a for a in self._blocks_missing
-            if not (fs.free[a - 1] or fs.staging[a - 1])
+            if not fs.leaving_live_set([a])[0]
         }
         if not self._blocks_missing:
             return
@@ -1568,6 +1568,8 @@ class VsrReplica(Replica):
         self.storage.write(grid._offset(addr), body)
         grid._cache.remove(addr)
         self._blocks_missing.discard(addr)
+        if self.scrubber is not None:
+            self.scrubber.repaired(addr)  # a relapse is a new fault
         self._block_repair_attempt = 0
         self.stat_blocks_repaired += 1
         self.tracer.instant("block_repair", address=addr)

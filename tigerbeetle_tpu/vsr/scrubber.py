@@ -44,7 +44,9 @@ class GridScrubber:
         self._tour: np.ndarray = np.zeros(0, np.int64)
         self._cursor = 0
         self._ticks_left = 0
-        self.corrupt: list[int] = []
+        # Known-corrupt addresses (a set: a block that stays corrupt
+        # across tours is ONE fault, reported once until repaired).
+        self.corrupt: set[int] = set()
         self.cycles = 0
         self.blocks_verified = 0
         self.faults_found = 0
@@ -56,10 +58,22 @@ class GridScrubber:
             return 1.0
         return self._cursor / len(self._tour)
 
+    # Empty-grid snapshot retry cadence: bounded O(grid) rescans while
+    # still picking up the first allocations promptly.
+    EMPTY_RETRY_TICKS = 16
+
     def _begin_tour(self) -> None:
         self._tour = np.flatnonzero(~self.grid.free_set.free) + 1
         self._cursor = 0
-        self._ticks_left = self.cycle_ticks
+        self._ticks_left = (
+            self.cycle_ticks
+            if len(self._tour)
+            else min(self.EMPTY_RETRY_TICKS, self.cycle_ticks)
+        )
+
+    def repaired(self, address: int) -> None:
+        """Forget a healed block so a relapse counts as a new fault."""
+        self.corrupt.discard(address)
 
     def tick(self) -> list[int]:
         """Verify the next paced chunk of the tour; returns newly-found
@@ -67,6 +81,11 @@ class GridScrubber:
         if self._cursor >= len(self._tour):
             if len(self._tour):
                 self.cycles += 1
+            elif self._ticks_left > 1:
+                # Empty grid: retry the snapshot on the tour cadence,
+                # not every tick (the snapshot scan is O(grid)).
+                self._ticks_left -= 1
+                return []
             self._begin_tour()
             if len(self._tour) == 0:
                 return []
@@ -75,22 +94,23 @@ class GridScrubber:
         quota = min(quota, self.blocks_per_tick_max, remaining)
         self._ticks_left = max(1, self._ticks_left - 1)
         found: list[int] = []
-        fs = self.grid.free_set
         chunk = self._tour[self._cursor : self._cursor + quota]
-        # Freed — or staged for release — since the snapshot: the
-        # block is leaving the live set, and a peer that already
-        # checkpointed may not serve it for repair anymore.  Skip
-        # rather than flag (reference: grid_scrubber cancels reads of
-        # released blocks).  Indexed per chunk, not a full-grid mask.
-        dead = fs.free[chunk - 1] | fs.staging[chunk - 1]
+        # Blocks leaving the live set since the snapshot are skipped
+        # rather than flagged (their frames may legitimately go stale,
+        # and peers that checkpointed no longer serve them — the same
+        # predicate the repair filter uses).  Indexed per chunk, not a
+        # full-grid mask.
+        dead = self.grid.free_set.leaving_live_set(chunk)
         for address, is_dead in zip(chunk, dead):
             if is_dead:
                 continue
             address = int(address)
             self.blocks_verified += 1
-            if not self.grid.verify_block(address):
+            if not self.grid.verify_block(address) and (
+                address not in self.corrupt
+            ):
                 found.append(address)
         self._cursor += quota
         self.faults_found += len(found)
-        self.corrupt.extend(found)
+        self.corrupt.update(found)
         return found
