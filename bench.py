@@ -447,11 +447,11 @@ CONFIG_ENGINE = {
 # setup (one-time ~1s/shape + XLA compile costs otherwise land inside
 # the first timed window).
 CONFIG_PREWARM = {
-    "simple_device": "orderfree_lo",
+    "simple_device": "orderfree_tight,orderfree_lo",
     "linked": "linked_small,linked",
     "two_phase": "two_phase_lo",
-    "zipf": "orderfree_lo",
-    "mixed": "orderfree_lo",
+    "zipf": "orderfree_tight,orderfree_lo",
+    "mixed": "orderfree_tight,orderfree_lo",
 }
 
 

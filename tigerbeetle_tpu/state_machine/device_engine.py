@@ -112,6 +112,7 @@ class _InFlight:
 _KERNELS = {
     "orderfree": dk.orderfree,
     "orderfree_lo": dk.orderfree_lo,
+    "orderfree_tight": dk.orderfree_tight,
     "linked": dk.linked,
     "linked_small": dk.linked_small,
     "two_phase": dk.two_phase,
@@ -179,38 +180,38 @@ class DeviceEngine:
         kinds = [k for k in kinds if k in _KERNELS]
         if not kinds:
             return
-        ncols_set = {
-            dk.N_COLS_TP if k.startswith("two_phase") else dk.N_COLS
-            for k in kinds
-        }
-        for ncols in ncols_set:
-            jax.device_put(np.zeros((dk.B, ncols), np.uint64))
-            for G in dk.SCAN_SIZES:
-                jax.device_put(np.zeros((G, dk.B, ncols), np.uint64))
-        for G in dk.SCAN_SIZES:
-            # The per-step (G,) arrays transfer from host at launch —
-            # their transfer plans need warming like the stacks'.
-            jax.device_put(np.zeros(G, np.int64))
-            jax.device_put(np.zeros(G, np.uint64))
+        tiers = sorted({self._tier(1), self._tier(self.window)})
+        for ncols, dtype in {dk.PK_SPEC[k] for k in kinds}:
+            jax.device_put(np.zeros((dk.B, ncols), dtype))
+            for W in tiers:
+                jax.device_put(np.zeros((W, dk.B, ncols), dtype))
+        # The per-window ns/tsb arrays transfer from host at launch —
+        # their transfer plans need warming like the buffers'.
+        for W in tiers:
+            jax.device_put(np.zeros(W, np.int64))
+            jax.device_put(np.zeros(W, np.uint64))
         table = jnp.zeros_like(self.balances)
         meta = jnp.zeros_like(self.meta)
         ring = jnp.zeros_like(self.ring)
         outs = []
         for k in kinds:
-            ncols = dk.N_COLS_TP if k.startswith("two_phase") else dk.N_COLS
-            pk = jnp.zeros((dk.B, ncols), jnp.uint64)
+            ncols, dtype = dk.PK_SPEC[k]
+            pk = jnp.zeros((dk.B, ncols), dtype)
             outs.append(
                 _KERNELS[k](table, meta, ring, 0, pk, 0, jnp.uint64(1))
             )
-            for G in dk.SCAN_SIZES:
-                stack = jnp.zeros((G, dk.B, ncols), jnp.uint64)
-                outs.append(
-                    dk.scan_kernels[k][G](
-                        table, meta, ring, 0, stack,
-                        jnp.zeros(G, jnp.int64),
-                        jnp.zeros(G, jnp.uint64),
+            for W in tiers:
+                big = jnp.zeros((W, dk.B, ncols), dtype)
+                ns = jnp.zeros(W, jnp.int64)
+                tsb = jnp.zeros(W, jnp.uint64)
+                for G in dk.SCAN_SIZES:
+                    if G > W:
+                        continue
+                    outs.append(
+                        dk.scan_win_kernels[k][G](
+                            table, meta, ring, 0, big, 0, ns, tsb
+                        )
                     )
-                )
         jax.block_until_ready(outs)
 
     # ------------------------------------------------------------------
@@ -336,6 +337,10 @@ class DeviceEngine:
             units.extend(self._split_run(run))
         return units
 
+    def _tier(self, rows: int) -> int:
+        small = max(1, self.window // 3)
+        return small if rows <= small else self.window
+
     @staticmethod
     def _split_run(run):
         out = []
@@ -349,34 +354,67 @@ class DeviceEngine:
         return out
 
     def _launch(self, recs: list[_InFlight]) -> None:
-        """Upload every dispatch unit's inputs first (device idle:
-        h2ds are cheap only while nothing is in flight,
-        experiments/xfer_probe.py), then dispatch back-to-back — zero
-        in-stream transfers.  Same-kind runs go G batches per LAUNCH
-        via lax.scan: the tunnel charges ~10 ms launch overhead per
-        dispatch against ~0.8 ms of device compute, so scanned
-        dispatch is worth ~5x (experiments/scan_resident_probe.py)."""
+        """Upload the window's inputs in as FEW transfers as possible
+        (after the first kernel runs, every h2d on this tunnel pays a
+        large fixed cost — transfer count dominates, r5 measurements),
+        block until they land (an in-flight transfer behind queued
+        kernels crawls at the serialized in-stream rate), then
+        dispatch back-to-back with zero in-stream transfers.
+        Same-kind runs go G batches per LAUNCH via lax.scan reading
+        from a per-spec window buffer at a row offset (~10 ms launch
+        overhead per dispatch vs ~0.8 ms device compute)."""
         if not recs:
             return
         t0 = _time.perf_counter()
         units = self._plan_chunks(recs)
-        dev_in = {}
-        for i, (ukind, urecs) in enumerate(units):
+        # One (tier, B, C) buffer + (tier,) ns/tsb per input spec; scan
+        # chunks claim contiguous row ranges in plan order.  The tier
+        # (buffer row count) rounds the spec's claimed rows up to
+        # window/3 or window, so a minority spec in a mixed window does
+        # not ship a full window of padding (the link is bytes-bound).
+        rows_of: dict[tuple, int] = {}
+        for ukind, urecs in units:
             if ukind == "scan":
-                # device_put (NOT jnp.asarray, whose trace-and-convert
-                # path costs ~1s on this tunnel) for the per-step
-                # arrays too.
-                dev_in[i] = (
-                    jax.device_put(np.stack([r.pk for r in urecs])),
-                    jax.device_put(
-                        np.array([r.n for r in urecs], np.int64)
-                    ),
-                    jax.device_put(
-                        np.array([r.ts_base for r in urecs], np.uint64)
-                    ),
-                )
-            elif ukind == "solo":
-                dev_in[i] = jax.device_put(urecs[0].pk)
+                spec = dk.PK_SPEC[urecs[0].kind]
+                rows_of[spec] = rows_of.get(spec, 0) + len(urecs)
+        bufs: dict[tuple, list] = {}  # spec -> [big, ns, tsb, cursor]
+        offsets: dict[int, int] = {}
+        for i, (ukind, urecs) in enumerate(units):
+            if ukind != "scan":
+                continue
+            spec = dk.PK_SPEC[urecs[0].kind]
+            if spec not in bufs:
+                ncols, dtype = spec
+                tier = self._tier(rows_of[spec])
+                bufs[spec] = [
+                    np.zeros((tier, dk.B, ncols), dtype),
+                    np.zeros(tier, np.int64),
+                    np.zeros(tier, np.uint64),
+                    0,
+                ]
+            big, ns, tsb, cur = bufs[spec]
+            for g, rec in enumerate(urecs):
+                big[cur + g] = rec.pk
+                ns[cur + g] = rec.n
+                tsb[cur + g] = rec.ts_base
+            offsets[i] = cur
+            bufs[spec][3] = cur + len(urecs)
+        dev_bufs = {
+            spec: (
+                jax.device_put(big),
+                jax.device_put(ns),
+                jax.device_put(tsb),
+            )
+            for spec, (big, ns, tsb, _cur) in bufs.items()
+        }
+        dev_solo = {
+            i: jax.device_put(urecs[0].pk)
+            for i, (ukind, urecs) in enumerate(units)
+            if ukind == "solo"
+        }
+        # ONE blocking sync (each blocking call costs a ~100 ms tunnel
+        # round trip).
+        jax.block_until_ready([list(dev_bufs.values()), list(dev_solo.values())])
         t1 = _time.perf_counter()
         self.stat_t_h2d += t1 - t0
         for i, (ukind, urecs) in enumerate(units):
@@ -394,16 +432,16 @@ class DeviceEngine:
                 rec = urecs[0]
                 self.balances, self.ring = _KERNELS[rec.kind](
                     self.balances, self.meta, self.ring, self._ring_at,
-                    dev_in[i], rec.n, jnp.uint64(rec.ts_base),
+                    dev_solo[i], rec.n, jnp.uint64(rec.ts_base),
                 )
                 rec.ring_at = self._ring_at
                 self._ring_at = (self._ring_at + 1) % _RING
                 continue
-            stack, ns, tsb = dev_in[i]
-            scan_fn = dk.scan_kernels[urecs[0].kind][len(urecs)]
+            big, ns, tsb = dev_bufs[dk.PK_SPEC[urecs[0].kind]]
+            scan_fn = dk.scan_win_kernels[urecs[0].kind][len(urecs)]
             self.balances, self.ring = scan_fn(
                 self.balances, self.meta, self.ring, self._ring_at,
-                stack, ns, tsb,
+                big, offsets[i], ns, tsb,
             )
             for g, rec in enumerate(urecs):
                 rec.ring_at = (self._ring_at + g) % _RING

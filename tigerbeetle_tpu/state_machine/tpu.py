@@ -1167,14 +1167,38 @@ class TpuStateMachine:
         self, events, n, ts_base, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
         flags, timeout, dr_slot, cr_slot, keys_sorted, timestamp, input_bytes,
     ):
-        pk = self._device_pack_base(
-            n, events, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
-            flags, timeout, dr_slot, cr_slot,
-        )
-        if timeout.any():
-            self._inflight_timeouts = True
+        from tigerbeetle_tpu.state_machine import device_kernels as dk
+
         amount_lo = np.asarray(events["amount_lo"])
         amount_hi = np.asarray(events["amount_hi"])
+        has_timeout = bool(timeout.any())
+        has_hi = bool(amount_hi.any())
+        # Tight 20-byte/event input when the batch's exact facts allow
+        # (h2d bytes are the device engine's ceiling on this link).
+        tight = (
+            not has_timeout
+            and not has_hi
+            and (n == 0 or int(amount_lo.max()) < (1 << 32))
+        )
+        if tight:
+            pk = dk.pack_tight(
+                n, id_lo=id_lo, id_hi=id_hi, dr_lo=dr_lo, dr_hi=dr_hi,
+                cr_lo=cr_lo, cr_hi=cr_hi,
+                pend_lo=np.asarray(events["pending_id_lo"]),
+                pend_hi=np.asarray(events["pending_id_hi"]),
+                amount_lo=amount_lo, flags=flags,
+                ledger=np.asarray(events["ledger"]),
+                code=events["code"].astype(np.uint32),
+                ts_nonzero=np.asarray(events["timestamp"] != 0),
+                dr_slot=dr_slot, cr_slot=cr_slot,
+            )
+        else:
+            pk = self._device_pack_base(
+                n, events, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
+                flags, timeout, dr_slot, cr_slot,
+            )
+        if has_timeout:
+            self._inflight_timeouts = True
         created = {
             "flags": flags,
             "dr_slot": dr_slot.astype(np.int32),
@@ -1208,7 +1232,10 @@ class TpuStateMachine:
                 last_applied=summary["last_applied"],
             )
 
-        kind = "orderfree" if amount_hi.any() else "orderfree_lo"
+        if tight:
+            kind = "orderfree_tight"
+        else:
+            kind = "orderfree" if has_hi else "orderfree_lo"
         return self._dev.submit(
             kind, pk, n, ts_base, finish,
             self._device_fallback(timestamp, input_bytes),
