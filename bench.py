@@ -1050,6 +1050,11 @@ def main() -> None:
         "device_semantic_pct_overall": round(100.0 * dev_tot / max(1, tot), 1),
         "parity": parity_ok if PARITY else None,
     }
+    if os.environ.get("TB_BENCH_DEVICE_CHECKED") == "cpu":
+        # The accelerator was unresponsive at start: every "device"
+        # number below ran on CPU-backed JAX.  Honest marker, not a
+        # silent hang past the driver's timeout.
+        out["tpu_unreachable"] = True
     if PARITY:
         out["parity_detail"] = parity_detail
     try:
@@ -1130,10 +1135,67 @@ def trend_tripwire(configs_out: dict) -> list[str]:
     return warnings
 
 
+def ensure_device_responsive() -> None:
+    """The tunneled TPU can wedge so hard that even jnp.zeros() hangs
+    (observed r5: jax.devices() itself blocked for over an hour).  A
+    graded bench must degrade to CPU-backed JAX with an honest marker
+    instead of hanging past the driver's timeout — the r4 lesson
+    generalized: the measurement apparatus must always produce a
+    record.  Probes in a SUBPROCESS (a hang cannot infect this
+    process) and re-execs with JAX_PLATFORMS=cpu on failure."""
+    import subprocess
+
+    if os.environ.get("TB_BENCH_DEVICE_CHECKED"):
+        return
+    probe_ok = False
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "import jax, jax.numpy as jnp;"
+            "jax.block_until_ready(jnp.zeros(4)); print('ok')",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(
+            timeout=int(os.environ.get("BENCH_DEVICE_PROBE_S", 180))
+        )
+        probe_ok = "ok" in (out or "")
+    except subprocess.TimeoutExpired:
+        # A wedged driver can leave the child unkillable (D-state);
+        # kill, wait briefly, and proceed to the CPU fallback rather
+        # than block forever in communicate() reaping it.
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+    if probe_ok:
+        os.environ["TB_BENCH_DEVICE_CHECKED"] = "tpu"
+        return
+    print(
+        "bench: accelerator unresponsive; re-exec on CPU-backed JAX",
+        file=sys.stderr,
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TB_BENCH_DEVICE_CHECKED"] = "cpu"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _mark_device_fallback(out: dict) -> dict:
+    """Stamp the honesty marker on single-config JSON outputs too —
+    the CPU re-exec must be visible whichever entry point printed."""
+    if os.environ.get("TB_BENCH_DEVICE_CHECKED") == "cpu":
+        out["tpu_unreachable"] = True
+    return out
+
+
 if __name__ == "__main__":
+    ensure_device_responsive()
     if "--durable-only" in sys.argv:
-        print(json.dumps(run_durable(N_OTHER)))
+        print(json.dumps(_mark_device_fallback(run_durable(N_OTHER))))
     elif "--replicated-only" in sys.argv:
-        print(json.dumps(run_replicated(N_OTHER)))
+        print(json.dumps(_mark_device_fallback(run_replicated(N_OTHER))))
     else:
         main()

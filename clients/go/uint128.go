@@ -1,0 +1,80 @@
+// 128-bit id/amount helpers (the reference's src/clients/go/uint128.go
+// shape): conversions between the client's [2]uint64 little-endian
+// limb pairs, 16-byte arrays, and math/big, plus a monotonic
+// time-based ID() generator (ULID-shaped: millisecond timestamp in
+// the topmost bits, random bits below, strictly increasing within the
+// process — reference ID() semantics).
+package tigerbeetle
+
+import (
+	"crypto/rand"
+	"encoding/binary"
+	"math/big"
+	"sync"
+	"time"
+)
+
+// U128Bytes converts (lo, hi) limbs to the 16-byte little-endian wire
+// image.
+func U128Bytes(v [2]uint64) [16]byte {
+	var out [16]byte
+	putU128(out[:], v)
+	return out
+}
+
+// U128FromBytes converts a 16-byte little-endian image to limbs.
+func U128FromBytes(b [16]byte) [2]uint64 {
+	return getU128(b[:])
+}
+
+// U128BigInt converts limbs to a non-negative big.Int.
+func U128BigInt(v [2]uint64) *big.Int {
+	out := new(big.Int).SetUint64(v[1])
+	out.Lsh(out, 64)
+	return out.Or(out, new(big.Int).SetUint64(v[0]))
+}
+
+// U128FromBigInt converts a non-negative big.Int (must fit 128 bits)
+// to limbs; ok is false when it does not fit.
+func U128FromBigInt(value *big.Int) (v [2]uint64, ok bool) {
+	if value.Sign() < 0 || value.BitLen() > 128 {
+		return v, false
+	}
+	var b [16]byte
+	value.FillBytes(b[:]) // big-endian
+	v[1] = binary.BigEndian.Uint64(b[0:8])
+	v[0] = binary.BigEndian.Uint64(b[8:16])
+	return v, true
+}
+
+var (
+	idMu         sync.Mutex
+	idLastMillis int64
+	idLast       [2]uint64
+)
+
+// ID returns a time-ordered unique 128-bit identifier: 48-bit
+// millisecond timestamp in the topmost bits, random bits below,
+// strictly monotonic within the process (same-millisecond calls
+// increment — reference ID() semantics).
+func ID() [2]uint64 {
+	idMu.Lock()
+	defer idMu.Unlock()
+	now := time.Now().UnixMilli()
+	if now > idLastMillis {
+		idLastMillis = now
+		var r [10]byte
+		if _, err := rand.Read(r[:]); err != nil {
+			panic(err)
+		}
+		idLast[1] = uint64(now)<<16 |
+			uint64(r[0])<<8 | uint64(r[1])
+		idLast[0] = binary.LittleEndian.Uint64(r[2:10])
+	} else {
+		idLast[0]++
+		if idLast[0] == 0 {
+			idLast[1]++
+		}
+	}
+	return idLast
+}

@@ -13,6 +13,8 @@
 import * as net from "node:net";
 import { createHash } from "node:crypto";
 
+export { U128_MAX, id, u128Bytes, u128FromBytes } from "./u128";
+
 import {
   Account,
   AccountBalance,

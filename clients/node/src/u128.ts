@@ -1,0 +1,50 @@
+// 128-bit id helpers (the reference's src/clients/node id/UInt128
+// surface).  u128 values are `bigint` end to end in this client; the
+// helpers here convert to/from the 16-byte little-endian wire image
+// and generate time-ordered unique ids (ULID-shaped: millisecond
+// timestamp in the topmost bits, random bits below, strictly
+// monotonic within the process — reference id() semantics).
+
+import { randomFillSync } from "node:crypto";
+
+export const U128_MAX = (1n << 128n) - 1n;
+
+/** bigint -> 16-byte little-endian image (must fit 128 bits). */
+export function u128Bytes(value: bigint): Buffer {
+  if (value < 0n || value > U128_MAX) {
+    throw new RangeError("value must be a non-negative 128-bit integer");
+  }
+  const out = Buffer.alloc(16);
+  out.writeBigUInt64LE(value & 0xffffffffffffffffn, 0);
+  out.writeBigUInt64LE(value >> 64n, 8);
+  return out;
+}
+
+/** 16-byte little-endian image -> bigint. */
+export function u128FromBytes(bytes: Buffer): bigint {
+  if (bytes.length !== 16) {
+    throw new RangeError("expected 16 bytes");
+  }
+  return bytes.readBigUInt64LE(0) | (bytes.readBigUInt64LE(8) << 64n);
+}
+
+let idLastMillis = 0n;
+let idLast = 0n;
+
+/** Time-ordered unique 128-bit id: 48-bit millisecond timestamp in
+ * the topmost bits, 80 random bits below, strictly monotonic within
+ * the process (same-millisecond calls increment). */
+export function id(): bigint {
+  const now = BigInt(Date.now());
+  if (now > idLastMillis) {
+    idLastMillis = now;
+    const rand = Buffer.alloc(10);
+    randomFillSync(rand);
+    const randBits =
+      rand.readBigUInt64LE(0) | (BigInt(rand.readUInt16LE(8)) << 64n);
+    idLast = (now << 80n) | randBits;
+  } else {
+    idLast += 1n;
+  }
+  return idLast & U128_MAX;
+}

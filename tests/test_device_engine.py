@@ -521,3 +521,44 @@ def test_hot_tail_store_equivalence():
             f"store column {name} diverges between the hot tail and "
             "the shared bookkeeping path"
         )
+
+
+def test_tight_and_wide_inputs_agree(monkeypatch):
+    """The tight (B, 5) u32 order-free input and the wide u64 format
+    must produce byte-identical replies and final state for the same
+    stream — the tight path is an ENCODING, not a semantics change.
+    The wide run shrinks the router's amount gate to zero so the same
+    small-amount stream routes through the u64 format."""
+    import tigerbeetle_tpu.state_machine.tpu as tpu_mod
+
+    def stream():
+        rng = np.random.default_rng(11)
+        ops = [(Operation.create_accounts, accounts(range(1, 40)))]
+        tid = 100
+        for _ in range(3):
+            rows = []
+            for _k in range(50):
+                dr = int(rng.integers(1, 40))
+                cr = dr % 39 + 1
+                rows.append(
+                    hz.transfer(tid, debit_account_id=dr,
+                                credit_account_id=cr,
+                                amount=int(rng.integers(1, 90)))
+                )
+                tid += 1
+            ops.append((Operation.create_transfers, hz.pack(rows)))
+        ops.append((Operation.lookup_accounts, hz.ids_bytes(range(1, 40))))
+        return ops
+
+    def run():
+        sm = TpuStateMachine(engine="device", account_capacity=1 << 10)
+        h = hz.SingleNodeHarness(sm)
+        return [h.submit(op, body) for op, body in stream()], sm
+
+    replies_tight, sm_t = run()
+    assert sm_t.stat_device_semantic_events > 0
+
+    monkeypatch.setattr(tpu_mod, "_TIGHT_AMOUNT_LIMIT", 0)
+    replies_wide, sm_w = run()
+    assert sm_w.stat_device_semantic_events > 0
+    assert replies_tight == replies_wide

@@ -56,6 +56,10 @@ from tigerbeetle_tpu.types import (
     TransferPendingStatus,
 )
 
+# Tight device-input gate: amounts must fit u32 (tests shrink this to
+# force the wide format on the same stream).
+_TIGHT_AMOUNT_LIMIT = 1 << 32
+
 AF = AccountFlags
 TF = TransferFlags
 CAR = CreateAccountResult
@@ -1178,7 +1182,7 @@ class TpuStateMachine:
         tight = (
             not has_timeout
             and not has_hi
-            and (n == 0 or int(amount_lo.max()) < (1 << 32))
+            and (n == 0 or int(amount_lo.max()) < _TIGHT_AMOUNT_LIMIT)
         )
         if tight:
             pk = dk.pack_tight(
