@@ -1178,8 +1178,21 @@ def ensure_device_responsive() -> None:
         file=sys.stderr,
     )
     env = dict(os.environ)
+    # JAX_PLATFORMS alone is NOT enough: the ambient sitecustomize
+    # sets jax_platforms programmatically and its axon PJRT plugin
+    # discovery blocks while the tunnel is wedged.  TB_FORCE_CPU_JAX
+    # makes tigerbeetle_tpu/__init__.py cut both routes in every
+    # child process (config subprocesses, servers) before any backend
+    # initializes (tigerbeetle_tpu/jaxenv.py).
     env["JAX_PLATFORMS"] = "cpu"
+    env["TB_FORCE_CPU_JAX"] = "1"
     env["TB_BENCH_DEVICE_CHECKED"] = "cpu"
+    # The device-authoritative configs' production-size one-hot
+    # matmuls take hours on the CPU backend; with the accelerator
+    # gone their numbers are meaningless anyway, so run every config
+    # on the host engine (overriding any exported TB_ENGINE=device)
+    # and let tpu_unreachable=true tell the story.
+    env["TB_ENGINE"] = "host"
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 

@@ -18,16 +18,9 @@ os.environ["XLA_FLAGS"] = (
 # backend.  Production size is exercised by the tpu-marked tests.
 os.environ.setdefault("TB_DEV_B", "512")
 
-import jax
+from tigerbeetle_tpu.jaxenv import pin_cpu_backend
 
-jax.config.update("jax_platforms", "cpu")
-
-try:
-    from jax._src import xla_bridge
-
-    xla_bridge._backend_factories.pop("axon", None)
-except (ImportError, AttributeError):  # private API; config above suffices
-    pass
+pin_cpu_backend()
 
 import pytest
 

@@ -6,6 +6,13 @@ machine runs as a JAX/XLA kernel against an HBM-resident account table,
 surrounded by a host runtime (WAL, consensus, message bus, clients).
 """
 
+from tigerbeetle_tpu.jaxenv import force_cpu_jax_if_requested
+
+# Must run before anything can initialize a JAX backend: a wedged
+# accelerator tunnel blocks even jnp.zeros(), and the ambient
+# sitecustomize overrides the JAX_PLATFORMS env var (see jaxenv.py).
+force_cpu_jax_if_requested()
+
 from tigerbeetle_tpu import constants, types
 
 __all__ = ["constants", "types"]

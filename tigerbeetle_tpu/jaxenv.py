@@ -1,0 +1,45 @@
+"""Process-level JAX platform override.
+
+The ambient environment routes JAX at the axon TPU tunnel through a
+sitecustomize hook that BOTH sets the ``jax_platforms`` config
+programmatically (so the ``JAX_PLATFORMS`` env var alone does not
+win) AND registers a PJRT plugin whose discovery blocks while the
+tunnel is wedged — observed hard enough that ``jnp.zeros(4)`` hangs
+forever.  When a parent process has decided this process must not
+touch the device (``TB_FORCE_CPU_JAX=1`` — set by bench.py's
+``ensure_device_responsive`` fallback), both routes have to be cut
+before the first backend initializes: override the config AND
+unregister the plugin factory, exactly as tests/conftest.py does for
+the test suite.
+
+Called from ``tigerbeetle_tpu/__init__.py`` so every entry point that
+imports the package (server, clients, bench subprocesses) honors the
+marker without its own boilerplate.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu_backend() -> None:
+    """Pin this process's JAX to the CPU backend, unconditionally.
+    Must run before the first backend initializes.  The single home
+    of the private-API plugin unregistration (tests/conftest.py uses
+    this too)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except (ImportError, AttributeError):  # private API best-effort
+        pass
+
+
+def force_cpu_jax_if_requested() -> None:
+    """If TB_FORCE_CPU_JAX=1, pin this process's JAX to the CPU
+    backend before any device backend can initialize."""
+    if os.environ.get("TB_FORCE_CPU_JAX") == "1":
+        pin_cpu_backend()
