@@ -146,6 +146,143 @@ def test_journal_ring_wrap():
     assert rec.op_head == last
 
 
+def _recover_both_ways(storage, commit_min, window=3):
+    """Run recover() with the windowed prepares scan and with the full
+    scan on identical storage; return both (Recovery, headers-ring,
+    prepare-reads) triples."""
+    out = []
+    for probe_all in (False, True):
+        j = Journal(storage, CLUSTER)
+        j.RECOVER_HEAD_WINDOW = window
+        j.RECOVER_PROBE_ALL = probe_all
+        reads0 = storage.reads
+        rec = j.recover(commit_min=commit_min)
+        out.append((rec, j.headers.tobytes(), storage.reads - reads0))
+    return out
+
+
+def _assert_equivalent(windowed, full):
+    (rec_w, ring_w, _), (rec_f, ring_f, _) = windowed, full
+    assert rec_w.op_head == rec_f.op_head
+    assert rec_w.faulty_ops == rec_f.faulty_ops
+    assert rec_w.truncated_ops == rec_f.truncated_ops
+    assert sorted(rec_w.headers) == sorted(rec_f.headers)
+    for op in rec_w.headers:
+        assert rec_w.headers[op].tobytes() == rec_f.headers[op].tobytes()
+    assert ring_w == ring_f
+
+
+def test_journal_windowed_recover_equivalence():
+    """The windowed prepares scan (skip slots settled by the redundant
+    ring) must classify every adversarial state exactly like the full
+    scan — wraps, corruption below/above the checkpoint, an unsynced
+    crash tail, and a stale wrapped redundant header — while reading
+    fewer prepare slots."""
+    slots = cfg.TEST_MIN.journal_slot_count
+
+    def build(n_ops):
+        storage = MemoryStorage(layout())
+        j = Journal(storage, CLUSTER)
+        root = wire.root_prepare(CLUSTER)
+        j.write_prepare(root, b"")
+        parent = wire.u128(root, "checksum")
+        for op in range(1, n_ops + 1):
+            h = make_prepare(op, parent, body=bytes([op & 0xFF]) * 64)
+            j.write_prepare(h, bytes([op & 0xFF]) * 64)
+            parent = wire.u128(h, "checksum")
+        return storage
+
+    # Clean wrapped ring: equivalence AND strictly fewer prepare reads.
+    storage = build(slots + 12)
+    w, f = _recover_both_ways(storage, commit_min=slots + 4)
+    _assert_equivalent(w, f)
+    assert w[2] < f[2]
+
+    # Latent corruption below the checkpoint (settled region): both
+    # scans must ignore it.
+    storage = build(slots + 12)
+    storage.corrupt_sector(storage.layout.prepare_slot_offset(
+        (slots + 12 - 20) % slots))
+    w, f = _recover_both_ways(storage, commit_min=slots + 4)
+    _assert_equivalent(w, f)
+
+    # Corruption above the checkpoint: both must report it faulty.
+    storage = build(slots + 12)
+    storage.corrupt_sector(storage.layout.prepare_slot_offset(
+        (slots + 6) % slots))
+    w, f = _recover_both_ways(storage, commit_min=slots + 4)
+    _assert_equivalent(w, f)
+    assert slots + 6 in w[0].faulty_ops
+
+    # Crash with an unsynced tail.
+    storage = build(slots + 8)
+    j = Journal(storage, CLUSTER)
+    rec = j.recover(commit_min=slots)  # fills j.headers
+    parent = wire.u128(rec.headers[rec.op_head], "checksum")
+    for op in range(slots + 9, slots + 12):
+        h = make_prepare(op, parent, body=b"t" * 32)
+        j.write_prepare(h, b"t" * 32, sync=(op < slots + 11))
+        parent = wire.u128(h, "checksum")
+    storage.crash()
+    w, f = _recover_both_ways(storage, commit_min=slots + 2)
+    _assert_equivalent(w, f)
+
+    # Stale wrapped redundant: the prepare holds a NEW op but the
+    # redundant sector still shows the old wrapped op (crash landed
+    # between the two writes).  The slot sits below max_op, inside the
+    # backward head window.
+    storage = build(slots + 12)
+    j = Journal(storage, CLUSTER)
+    j.recover(commit_min=slots + 4)
+    new_op = slots + 13
+    stale_slot = new_op % slots
+    h = make_prepare(
+        new_op,
+        wire.u128(j.headers[(slots + 12) % slots], "checksum"),
+        body=b"n" * 48,
+    )
+    from tigerbeetle_tpu.vsr.storage import _sectors
+
+    msg = h.tobytes() + b"n" * 48
+    storage.write(
+        storage.layout.prepare_slot_offset(stale_slot),
+        msg.ljust(_sectors(len(msg)), b"\x00"),
+    )
+    storage.sync()  # prepare persisted, redundant sector NOT updated
+    w, f = _recover_both_ways(storage, commit_min=slots + 5)
+    _assert_equivalent(w, f)
+    assert w[0].op_head == new_op
+
+    # BACKWARD window: a LATER op's redundant persisted across the
+    # crash while this op's did not, so the stale-redundant slot sits
+    # BELOW max_op — only the backward branch of the head window
+    # rescues it from being settled as its old wrapped op.
+    storage = build(slots + 12)
+    j = Journal(storage, CLUSTER)
+    j.recover(commit_min=slots + 4)
+    parent = wire.u128(j.headers[(slots + 12) % slots], "checksum")
+    h13 = make_prepare(slots + 13, parent, body=b"a" * 48)
+    msg = h13.tobytes() + b"a" * 48
+    storage.write(
+        storage.layout.prepare_slot_offset((slots + 13) % slots),
+        msg.ljust(_sectors(len(msg)), b"\x00"),
+    )
+    h14 = make_prepare(
+        slots + 14, wire.u128(h13, "checksum"), body=b"b" * 48
+    )
+    msg = h14.tobytes() + b"b" * 48
+    storage.write(
+        storage.layout.prepare_slot_offset((slots + 14) % slots),
+        msg.ljust(_sectors(len(msg)), b"\x00"),
+    )
+    j.headers[(slots + 14) % slots] = h14
+    j._write_header_sector((slots + 14) % slots)
+    storage.sync()
+    w, f = _recover_both_ways(storage, commit_min=slots + 5)
+    _assert_equivalent(w, f)
+    assert w[0].op_head == slots + 14
+
+
 # ----------------------------------------------------------------------
 # SuperBlock.
 

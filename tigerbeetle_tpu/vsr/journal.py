@@ -142,10 +142,34 @@ class Journal:
 
     # ------------------------------------------------------------------
 
+    # Only ops this close to the newest redundant-ring op can have a
+    # prepare newer than (or present without) their redundant header:
+    # write_prepare issues prepare -> redundant -> fdatasync in order
+    # and an op is acked only after the sync joins, so un-persisted
+    # redundant headers are confined to the in-flight tail (pipeline
+    # <= 8 prepares; 64 is a generous margin for crash-reordering of
+    # unsynced sectors).
+    RECOVER_HEAD_WINDOW = 64
+    # Test hook: force the full prepares-ring scan so differential
+    # tests can check the windowed scan classifies identically.
+    RECOVER_PROBE_ALL = False
+
     def recover(self, commit_min: int) -> Recovery:
         """Scan both rings and reconstruct the log above `commit_min`
-        (the checkpoint op)."""
-        # Load the redundant ring.
+        (the checkpoint op).
+
+        The prepares ring is NOT read in full: a slot whose redundant
+        header is intact with op < commit_min is settled (its op was
+        fdatasynced before the checkpoint and recovery skips it), and
+        an all-zero redundant sector outside the head window means the
+        slot was never written (prepares persist in issue order).
+        Only slots that can still influence the result — op >=
+        commit_min, op == 0, garbage redundant bytes, or within
+        RECOVER_HEAD_WINDOW of the newest op — pay a prepare read.
+        On this container's ~5 ms-per-IO disk that turns a 1024-slot
+        x 1 MiB ring scan (~5.6 s, measured) into a few dozen reads.
+        """
+        # Load the redundant ring (one sequential read).
         raw = self.storage.read(
             self.layout.wal_headers_offset, self.layout.wal_headers_size
         )
@@ -153,13 +177,60 @@ class Journal:
             raw[: self.slot_count * HEADER_SIZE], HEADER_DTYPE
         ).copy()
 
-        slot_header: dict[int, np.ndarray] = {}
-        slot_state: dict[int, str] = {}
+        zero_header = bytes(HEADER_SIZE)
+        r_valid_all: list[bool] = []
+        settled: list[bool] = []  # classified from the redundant ring alone
+        max_op = 0
         for slot in range(self.slot_count):
             redundant = disk_headers[slot]
             r_valid = wire.verify_header(redundant) and int(
                 redundant["command"]
             ) == Command.prepare and wire.u128(redundant, "cluster") == self.cluster
+            r_valid_all.append(r_valid)
+            if r_valid:
+                op = int(redundant["op"])
+                max_op = max(max_op, op)
+                settled.append(
+                    op < commit_min
+                    and op != 0
+                    and self.slot_for_op(op) == slot
+                )
+            else:
+                virgin = (
+                    raw[slot * HEADER_SIZE : (slot + 1) * HEADER_SIZE]
+                    == zero_header
+                )
+                settled.append(virgin)
+        # Slots that may hold an op newer than their redundant header.
+        # Both directions around max_op: un-fdatasynced sectors persist
+        # in arbitrary order across a crash, so a slot in the in-flight
+        # tail can expose a stale WRAPPED redundant (old op, valid
+        # checksum) while its prepare already holds the new op — such a
+        # slot sits below max_op, not above it.
+        for op in range(
+            max(0, max_op - self.RECOVER_HEAD_WINDOW),
+            max_op + 1 + self.RECOVER_HEAD_WINDOW,
+        ):
+            settled[self.slot_for_op(op)] = False
+        if self.RECOVER_PROBE_ALL:
+            settled = [False] * self.slot_count
+
+        slot_header: dict[int, np.ndarray] = {}
+        slot_state: dict[int, str] = {}
+        for slot in range(self.slot_count):
+            redundant = disk_headers[slot]
+            r_valid = r_valid_all[slot]
+            if settled[slot]:
+                if r_valid:
+                    # Redundant header is byte-identical to the intact
+                    # prepare's own header; recovery skips the op
+                    # either way (op < commit_min).
+                    slot_state[slot] = "ok"
+                    slot_header[slot] = redundant
+                    self.headers[slot] = redundant
+                else:
+                    slot_state[slot] = "unwritten"
+                continue
 
             p = self._read_slot_prepare(slot)
             if p is not None:
@@ -195,12 +266,10 @@ class Journal:
             else:
                 faulty_headers[op] = h
 
-        # Walk the hash chain upward from the checkpoint.
-        if commit_min not in headers:
-            if commit_min in faulty_headers or commit_min > 0:
-                # The checkpoint op itself must be recoverable from the
-                # checkpoint snapshot; chain starts just above it.
-                pass
+        # Walk the hash chain upward from the checkpoint.  When the
+        # checkpoint op's own slot is gone (overwritten/faulty), its
+        # state lives in the checkpoint snapshot; the chain then
+        # starts unanchored just above it (parent None).
         op_head = commit_min
         chain_parent = (
             wire.u128(headers[commit_min], "checksum") if commit_min in headers else None
