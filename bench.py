@@ -860,7 +860,7 @@ def run_replicated(n_events: int) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def _run_subprocess_config(flag: str) -> dict:
+def _run_subprocess_config(flag: str, timeout_s: int | None = None) -> dict:
     """One config in a fresh subprocess; ANY failure (non-zero exit,
     timeout, unparseable output) yields an error dict, never an
     exception — the graded JSON line must print regardless (r4 lesson:
@@ -869,29 +869,38 @@ def _run_subprocess_config(flag: str) -> dict:
     unconditional per-merge record, src/scripts/devhub.zig:36-41)."""
     import subprocess
 
+    if timeout_s is None:
+        timeout_s = int(os.environ.get("BENCH_CONFIG_TIMEOUT_S", 3600))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), flag],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), flag],
-            capture_output=True, text=True,
-            timeout=int(os.environ.get("BENCH_CONFIG_TIMEOUT_S", 3600)),
-        )
-    except subprocess.TimeoutExpired as exc:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # A wedged accelerator can leave the child unkillable
+        # (D-state); kill, wait briefly, and record the timeout
+        # rather than block forever reaping it.
+        proc.kill()
+        try:
+            _, stderr = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            stderr = ""
         return {
-            "error": f"config subprocess exceeded {exc.timeout}s",
-            "tail": ((exc.stderr or b"").decode("utf-8", "replace")
-                     if isinstance(exc.stderr, bytes) else exc.stderr or "")[-2000:],
+            "error": f"config subprocess exceeded {timeout_s}s",
+            "tail": (stderr or "")[-2000:],
         }
     if proc.returncode != 0:
         return {
             "error": f"config subprocess rc={proc.returncode}",
-            "tail": (proc.stderr or "")[-2000:],
+            "tail": (stderr or "")[-2000:],
         }
     try:
-        return json.loads(proc.stdout.strip().splitlines()[-1])
+        return json.loads(stdout.strip().splitlines()[-1])
     except (ValueError, IndexError) as exc:
         return {
             "error": f"unparseable config output: {exc}",
-            "tail": (proc.stdout or "")[-1000:] + (proc.stderr or "")[-1000:],
+            "tail": (stdout or "")[-1000:] + (stderr or "")[-1000:],
         }
 
 
@@ -996,40 +1005,90 @@ def _run_parity(name, gen) -> str:
     return mismatch or ("ok(full)" if full else "ok(truncated)")
 
 
+def run_memory_only(name: str) -> dict:
+    """One in-memory config (+ its parity replay) for the
+    --memory-only=NAME subprocess entry.  Parity rides along under
+    __parity__ so the parent can split it out."""
+    import traceback
+
+    if name not in CONFIGS:
+        return {"error": f"unknown config {name!r}"}
+    gen = CONFIGS[name]
+    try:
+        out = _run_memory_config(name, gen)
+    except Exception:  # noqa: BLE001
+        out = {
+            "error": "config raised",
+            "tail": traceback.format_exc()[-2000:],
+        }
+    if PARITY:
+        try:
+            out["__parity__"] = _run_parity(name, gen)
+        except Exception:  # noqa: BLE001
+            out["__parity__"] = (
+                "parity raised: " + traceback.format_exc()[-500:]
+            )
+    return out
+
+
 def main() -> None:
     configs_out = {}
+    started_on_cpu = os.environ.get("TB_BENCH_DEVICE_CHECKED") == "cpu"
 
-    # Durable + replicated configs in FRESH subprocesses: they are
-    # disk/page-cache sensitive and the in-memory 1M replays are
-    # heap-sensitive — sharing a process squeezes whichever runs
-    # second.  Errors are recorded, never raised.
-    configs_out["durable"] = _run_subprocess_config("--durable-only")
-    configs_out["replicated"] = _run_subprocess_config("--replicated-only")
+    # EVERY config runs in a fresh subprocess with a timeout: durable/
+    # replicated are disk/page-cache sensitive, the in-memory 1M
+    # replays are heap-sensitive, and — decisive after this round's
+    # wedge events — a mid-run accelerator hang inside ANY config must
+    # cost that config its timeout, not the whole graded record (a
+    # stuck JAX call cannot be interrupted in-process).  Per-config
+    # engine prewarm is untimed and XLA compiles come from the
+    # persistent cache, so isolation costs only setup seconds.
+    # Errors are recorded, never raised.
+    def run_isolated(flag: str, timeout_s: int | None = None) -> dict:
+        res = _run_subprocess_config(flag, timeout_s=timeout_s)
+        if (
+            "error" in res
+            and "exceeded" in res.get("error", "")
+            and os.environ.get("TB_BENCH_DEVICE_CHECKED") != "cpu"
+            and not _device_alive()
+        ):
+            # The accelerator wedged AFTER the startup probe passed.
+            # Without this, every remaining device-touching config
+            # would burn its full subprocess timeout on the same hang;
+            # degrade the rest of the run in place instead (children
+            # inherit the parent's env at spawn).
+            print(
+                "bench: accelerator wedged mid-run; remaining configs"
+                " degrade to CPU-backed host engine",
+                file=sys.stderr,
+            )
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ["TB_FORCE_CPU_JAX"] = "1"
+            os.environ["TB_BENCH_DEVICE_CHECKED"] = "cpu"
+            os.environ["TB_ENGINE"] = "host"
+            res["tpu_wedged_mid_run"] = True
+        return res
 
-    for name, gen in CONFIGS.items():
-        try:
-            configs_out[name] = _run_memory_config(name, gen)
-        except Exception:  # noqa: BLE001
-            import traceback
-
-            configs_out[name] = {
-                "error": "config raised",
-                "tail": traceback.format_exc()[-2000:],
-            }
+    configs_out["durable"] = run_isolated("--durable-only")
+    configs_out["replicated"] = run_isolated("--replicated-only")
 
     parity_ok = True
     parity_detail = {}
-    if PARITY:
-        for name, gen in CONFIGS.items():
-            try:
-                parity_detail[name] = _run_parity(name, gen)
-            except Exception:  # noqa: BLE001
-                import traceback
-
-                parity_detail[name] = (
-                    "parity raised: " + traceback.format_exc()[-500:]
+    # The memory-only subprocess runs the config AND its full-stream
+    # parity replay (the ~17k tx/s Python oracle), so it gets twice
+    # the per-config budget.
+    memory_timeout = 2 * int(os.environ.get("BENCH_CONFIG_TIMEOUT_S", 3600))
+    for name in CONFIGS:
+        res = run_isolated(f"--memory-only={name}", timeout_s=memory_timeout)
+        detail = res.pop("__parity__", None)
+        configs_out[name] = res
+        if PARITY:
+            if detail is None:
+                detail = "not run (config error: %s)" % res.get(
+                    "error", "missing"
                 )
-            if not parity_detail[name].startswith("ok"):
+            parity_detail[name] = detail
+            if not detail.startswith("ok"):
                 parity_ok = False
 
     simple = configs_out.get("simple", {})
@@ -1050,11 +1109,16 @@ def main() -> None:
         "device_semantic_pct_overall": round(100.0 * dev_tot / max(1, tot), 1),
         "parity": parity_ok if PARITY else None,
     }
-    if os.environ.get("TB_BENCH_DEVICE_CHECKED") == "cpu":
+    if started_on_cpu:
         # The accelerator was unresponsive at start: every "device"
         # number below ran on CPU-backed JAX.  Honest marker, not a
         # silent hang past the driver's timeout.
         out["tpu_unreachable"] = True
+    elif os.environ.get("TB_BENCH_DEVICE_CHECKED") == "cpu":
+        # Wedged PARTWAY through: configs recorded before the wedge
+        # are real device numbers; the per-config tpu_unreachable /
+        # tpu_wedged_mid_run keys say which side each row is on.
+        out["tpu_wedged_mid_run"] = True
     if PARITY:
         out["parity_detail"] = parity_detail
     try:
@@ -1135,6 +1199,37 @@ def trend_tripwire(configs_out: dict) -> list[str]:
     return warnings
 
 
+def _device_alive(timeout_s: int | None = None) -> bool:
+    """Probe the accelerator from a SUBPROCESS (a hang cannot infect
+    this process).  A wedged driver can leave the child unkillable
+    (D-state): kill, wait briefly, and report dead rather than block
+    forever reaping it."""
+    import subprocess
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "import jax, jax.numpy as jnp;"
+            "jax.block_until_ready(jnp.zeros(4)); print('ok')",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(
+            timeout=timeout_s
+            if timeout_s is not None
+            else int(os.environ.get("BENCH_DEVICE_PROBE_S", 180))
+        )
+        return "ok" in (out or "")
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        return False
+
+
 def ensure_device_responsive() -> None:
     """The tunneled TPU can wedge so hard that even jnp.zeros() hangs
     (observed r5: jax.devices() itself blocked for over an hour).  A
@@ -1147,30 +1242,7 @@ def ensure_device_responsive() -> None:
 
     if os.environ.get("TB_BENCH_DEVICE_CHECKED"):
         return
-    probe_ok = False
-    proc = subprocess.Popen(
-        [
-            sys.executable, "-c",
-            "import jax, jax.numpy as jnp;"
-            "jax.block_until_ready(jnp.zeros(4)); print('ok')",
-        ],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-    )
-    try:
-        out, _ = proc.communicate(
-            timeout=int(os.environ.get("BENCH_DEVICE_PROBE_S", 180))
-        )
-        probe_ok = "ok" in (out or "")
-    except subprocess.TimeoutExpired:
-        # A wedged driver can leave the child unkillable (D-state);
-        # kill, wait briefly, and proceed to the CPU fallback rather
-        # than block forever in communicate() reaping it.
-        proc.kill()
-        try:
-            proc.wait(timeout=5)
-        except subprocess.TimeoutExpired:
-            pass
-    if probe_ok:
+    if _device_alive():
         os.environ["TB_BENCH_DEVICE_CHECKED"] = "tpu"
         return
     print(
@@ -1206,9 +1278,14 @@ def _mark_device_fallback(out: dict) -> dict:
 
 if __name__ == "__main__":
     ensure_device_responsive()
+    memory_only = [
+        a.split("=", 1)[1] for a in sys.argv if a.startswith("--memory-only=")
+    ]
     if "--durable-only" in sys.argv:
         print(json.dumps(_mark_device_fallback(run_durable(N_OTHER))))
     elif "--replicated-only" in sys.argv:
         print(json.dumps(_mark_device_fallback(run_replicated(N_OTHER))))
+    elif memory_only:
+        print(json.dumps(_mark_device_fallback(run_memory_only(memory_only[0]))))
     else:
         main()
