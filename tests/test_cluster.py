@@ -280,6 +280,44 @@ def test_wal_corruption_on_backup_repaired_from_peers():
     assert c.replicas[1].sm.transfer_timestamp(305) is not None
 
 
+def test_wal_corruption_on_primary_repaired_from_backups():
+    """The PRIMARY's corrupt WAL slot heals from a backup too: scrub
+    repair replies arrive as current-view prepares, which the primary
+    used to drop on its ring-wrap guard before the repair path could
+    see them — leaving the slot unhealable forever (VOPR seed
+    99911308)."""
+    c = Cluster(replica_count=3, seed=34)
+    client = c.client(1001)
+    client.register()
+    c.run_until(lambda: client.registered)
+    c.run_request(client, types.Operation.create_accounts,
+                  pack([account(1), account(2)]))
+    for k in range(6):
+        c.run_request(client, types.Operation.create_transfers,
+                      pack([transfer(400 + k, debit_account_id=1,
+                                     credit_account_id=2, amount=2)]))
+    primary = next(i for i, r in enumerate(c.replicas) if r.is_primary)
+    victim = c.replicas[primary]
+    target_op = victim.commit_min - 2
+    slot = target_op % victim.config.journal_slot_count
+    c.storages[primary].corrupt_sector(
+        c.storages[primary].layout.prepare_slot_offset(slot)
+    )
+    assert victim.journal.read_prepare(target_op) is None
+    assert victim.is_primary  # the point of this test: no restart
+    for _ in range(6):
+        victim.wal_scrub_window()
+        for _ in range(24):
+            c.step()
+        if not victim._wal_scrub_wanted:
+            break
+    assert victim.journal.read_prepare(target_op) is not None
+    assert victim.stat_wal_scrub_repaired >= 1
+    c.settle(max_steps=10000)
+    c.check_linearized()
+    c.check_convergence()
+
+
 def test_sync_install_preserves_journal_tail_above_checkpoint():
     """State sync supersedes WAL repair only BELOW the installed
     checkpoint: a replica holding a journal tail above it (e.g. a new

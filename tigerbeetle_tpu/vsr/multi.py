@@ -870,7 +870,14 @@ class VsrReplica(Replica):
         if self.status != "normal":
             return
         if self.is_primary:
-            return  # ring wrapped all the way around
+            # Ring wrapped all the way around — EXCEPT a repair reply
+            # for a slot we pinned: the PRIMARY's scrubber must be able
+            # to heal its own WAL from a backup, and those replies
+            # carry the current view (found by VOPR seed 99911308: the
+            # primary dropped every scrub-repair reply for a
+            # current-view op, leaving the corrupt slot unhealable).
+            self._try_wal_scrub_repair(header, body)
+            return
 
         if op <= self.op:
             self._repair_fill(header, body)
@@ -1170,22 +1177,11 @@ class VsrReplica(Replica):
                     self._accept_prepare(h, b)
                 self._advance_commit(self.commit_max)
             return
+        if self._try_wal_scrub_repair(header, body):
+            return
         want = self._repair_wanted.get(op)
         have = self.journal.read_prepare(op)
         checksum = wire.u128(header, "checksum")
-        if (
-            have is None
-            and checksum != 0
-            and self._wal_scrub_wanted.get(op) == checksum
-        ):
-            # WAL-scrub repair of a committed slot: the pin came from
-            # OUR in-memory redundant header, so this content is the
-            # committed canonical prepare — rewrite both rings.
-            self.journal.write_prepare(header, body)
-            del self._wal_scrub_wanted[op]
-            self.stat_wal_scrub_repaired += 1
-            self.tracer.instant("wal_scrub", op=op)
-            return
         if have is not None and wire.u128(have[0], "checksum") == checksum:
             if want == checksum:
                 # The local copy already IS the pinned canonical one:
@@ -1329,6 +1325,33 @@ class VsrReplica(Replica):
         if target == self.replica:
             target = (self.replica + 1) % self.replica_count
         self.bus.send(target, h, b"")
+
+    def _try_wal_scrub_repair(self, header: np.ndarray, body: bytes) -> bool:
+        """WAL-scrub repair of a committed slot: the pin came from OUR
+        in-memory redundant header, so a checksum-matching prepare is
+        the committed canonical content — rewrite both rings."""
+        op = int(header["op"])
+        checksum = wire.u128(header, "checksum")
+        slot = self.journal.slot_for_op(op)
+        if (
+            checksum != 0
+            and self._wal_scrub_wanted.get(op) == checksum
+            # Slot-recycle guard: a checkpoint may have advanced past
+            # the pinned op and the ring wrapped — a late repair reply
+            # must not clobber the NEWER prepare now in the slot.  The
+            # in-memory ring is authoritative for what the slot holds;
+            # <= (not ==) so a pin resolved via request_headers after
+            # DOUBLE corruption (in-memory header lost, slot shows op
+            # 0) still repairs.
+            and int(self.journal.headers[slot]["op"]) <= op
+            and self.journal.read_prepare(op) is None
+        ):
+            self.journal.write_prepare(header, body)
+            del self._wal_scrub_wanted[op]
+            self.stat_wal_scrub_repaired += 1
+            self.tracer.instant("wal_scrub", op=op)
+            return True
+        return False
 
     def _on_request_prepare(self, header: np.ndarray, body: bytes) -> None:
         op = int(header["op"])
