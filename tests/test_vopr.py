@@ -30,6 +30,27 @@ def test_vopr_heavy_faults():
     Vopr(31337, requests=50, packet_loss=0.05, crash_probability=0.02).run()
 
 
+def test_vopr_primary_scrub_repair_seed():
+    """Seed 99911308: a latent WAL fault on the PRIMARY for a
+    current-view committed op — scrub repair replies were dropped by
+    the primary's ring-wrap guard, leaving the slot unhealable."""
+    Vopr(99911308, requests=60, packet_loss=0.069,
+         crash_probability=0.027, corruption_probability=0.005).run()
+
+
+def test_vopr_unknown_anchor_seed():
+    """Seed 170611267: upgrade restarts truncated recovering journals
+    below committed ops, the DVC merge then lacked the head's header
+    (commit_floor above every merged op), and the new primary prepared
+    fresh ops against a stale parent_checksum — baking a chain break
+    into the committed log that later recoveries truncated, erasing
+    acked creates.  The primary must hold new prepares until the
+    canonical head checksum is resolved and repaired."""
+    Vopr(170611267, requests=60, packet_loss=0.060985872622017885,
+         crash_probability=0.026099500507950336,
+         corruption_probability=0.0, upgrade_nemesis=True).run()
+
+
 def test_vopr_tpu_state_machine():
     from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
 

@@ -203,6 +203,18 @@ class VsrReplica(Replica):
         # Pending canonical-log install after passively entering a view
         # (commits gated until start_view arrives).
         self._canon_pending = False
+        # True when we are primary but the canonical head's checksum is
+        # unknown (the DVC merge proved ops through op_head committed
+        # yet no header for op_head survived into it): preparing new
+        # ops against a stale parent_checksum would bake a chain break
+        # into the committed log (VOPR seed 170611267), so every
+        # prepare path holds until the head is resolved + repaired.
+        self._anchor_pending = False
+        # View of the header that currently resolves the anchor pin:
+        # replies are collected from ALL peers and a higher-view
+        # header re-pins, so a single stale peer cannot fix the anchor
+        # to a superseded sibling.
+        self._anchor_pin_view = -1
         # True while the journal chain between commit_min and the head
         # is not fully verified (stale siblings possible): commits wait.
         self._chain_suspect = False
@@ -360,6 +372,8 @@ class VsrReplica(Replica):
             return
         if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
             return
+        if self._anchor_pending:
+            return  # canonical head checksum still being repaired
         self._upgrade_proposed = True
         req = wire.make_header(
             command=Command.request, operation=VsrOperation.upgrade,
@@ -376,6 +390,8 @@ class VsrReplica(Replica):
             return
         if self.replica_count > 1 and not self.clock.synchronized:
             return  # same clock gate as client requests
+        if self._anchor_pending:
+            return  # canonical head checksum still being repaired
         self._advance_prepare_timestamp()
         if not self.sm.pulse_needed():
             return
@@ -474,11 +490,13 @@ class VsrReplica(Replica):
         if (
             len(self.pipeline) >= self.config.pipeline_prepare_queue_max
             or (self.replica_count > 1 and not self.clock.synchronized)
+            or self._anchor_pending
         ):
-            # Pipeline full, or no timestamps yet because the cluster
+            # Pipeline full, no timestamps yet because the cluster
             # clock window doesn't exist (reference: src/vsr/replica.zig
-            # on_request gates on realtime_synchronized): queue and
-            # drain from tick()/commit.
+            # on_request gates on realtime_synchronized), or the
+            # canonical head checksum is still being repaired: queue
+            # and drain from tick()/commit.
             self._enqueue_request(header, body)
             return
         self._primary_prepare(header, body)
@@ -739,6 +757,8 @@ class VsrReplica(Replica):
         cutting per-request consensus overhead under load."""
         if self.replica_count > 1 and not self.clock.synchronized:
             return
+        if self._anchor_pending:
+            return  # canonical head checksum still being repaired
         requeue: list[tuple[np.ndarray, bytes]] = []
         while self.request_queue and (
             len(self.pipeline) < self.config.pipeline_prepare_queue_max
@@ -1015,8 +1035,29 @@ class VsrReplica(Replica):
                 self._vouched[k - 1] = wire.u128(mem, "parent")
                 k -= 1
 
+    def _maybe_resolve_anchor(self) -> None:
+        """Re-anchor parent_checksum once the pinned canonical head
+        prepare has been repaired into our journal."""
+        if not self._anchor_pending:
+            return
+        read = self.journal.read_prepare(self.op)
+        if read is None:
+            return
+        pin = self._repair_wanted.get(self.op)
+        if pin == 0:
+            return  # canonical checksum not yet resolved: a local
+            # prepare could be the stale sibling — keep waiting
+        h = read[0]
+        want = pin or self._vouched.get(self.op)
+        if want and wire.u128(h, "checksum") != want:
+            return
+        self.parent_checksum = wire.u128(h, "checksum")
+        self._anchor_pending = False
+        self._verify_chain_down()
+
     def _advance_commit(self, commit_max: int) -> None:
         self.commit_max = max(self.commit_max, commit_max)
+        self._maybe_resolve_anchor()
         if self._canon_pending:
             return  # tail not yet confirmed canonical (start_view pending)
         if self._chain_suspect:
@@ -1246,14 +1287,23 @@ class VsrReplica(Replica):
         # unpinned ops first learn their canonical checksum via
         # request_headers, pinned ops fetch the prepare by checksum.
         unpinned = [op for op, cs in self._repair_wanted.items() if cs == 0]
-        if unpinned:
+        if unpinned or (self._anchor_pending and self.op in self._repair_wanted):
+            lo = min(unpinned) if unpinned else self.op
+            hi = max(unpinned) if unpinned else self.op
             h = wire.make_header(
                 command=Command.request_headers, cluster=self.cluster,
                 view=self.view, replica=self.replica,
-                op=min(unpinned), commit=max(unpinned),
+                op=lo, commit=hi,
             )
             wire.finalize_header(h, b"")
-            self.bus.send(target, h, b"")
+            if self._anchor_pending:
+                # Anchor resolution must see every peer's sibling for
+                # the head op, not one possibly-stale target's.
+                for r in range(self.replica_count):
+                    if r != self.replica:
+                        self.bus.send(r, h, b"")
+            else:
+                self.bus.send(target, h, b"")
         pinned = [
             (op, cs) for op, cs in self._repair_wanted.items() if cs != 0
         ]
@@ -1293,7 +1343,20 @@ class VsrReplica(Replica):
             if not wire.verify_header(h):
                 continue
             op = int(h["op"])
-            if self._repair_wanted.get(op) == 0:
+            if (
+                self._anchor_pending
+                and op == self.op
+                and op in self._repair_wanted
+                and int(h["view"]) > self._anchor_pin_view
+            ):
+                # Anchor resolution collects from every peer and keeps
+                # the highest-view sibling: the committed content for
+                # an op is the one prepared in the latest view, and a
+                # single partitioned peer's stale header must not win.
+                self._repair_wanted[op] = wire.u128(h, "checksum")
+                self._anchor_pin_view = int(h["view"])
+                pinned_any = True
+            elif self._repair_wanted.get(op) == 0:
                 self._repair_wanted[op] = wire.u128(h, "checksum")
                 pinned_any = True
             if self._wal_scrub_wanted.get(op) == 0 and op <= self.commit_min:
@@ -1699,6 +1762,11 @@ class VsrReplica(Replica):
         if self.op <= checkpoint_op:
             self.op = checkpoint_op
             self.parent_checksum = commit_min_checksum
+            # The checkpoint's commit_min_checksum IS the authoritative
+            # head anchor now — without this, a sync during anchor
+            # resolution leaves every prepare path gated forever (the
+            # pin it was waiting on is cleared below).
+            self._anchor_pending = False
             self._repair_wanted.clear()
             self._stash.clear()
         else:
@@ -1947,6 +2015,7 @@ class VsrReplica(Replica):
         head = next(
             (h for h in canonical if int(h["op"]) == op_head), None
         )
+        self._anchor_pending = False
         if head is not None:
             self.parent_checksum = wire.u128(head, "checksum")
         elif head_checksum is not None and op_head == op_claimed:
@@ -1957,7 +2026,17 @@ class VsrReplica(Replica):
             self.parent_checksum = head_checksum
         else:
             # Unknown anchor: do not run the chain walk against a
-            # possibly-stale parent_checksum.
+            # possibly-stale parent_checksum — and do NOT prepare new
+            # ops on it either.  Pin the head for header resolution
+            # (want=0 resolves to a checksum via request_headers, then
+            # the prepare repairs by checksum); _maybe_resolve_anchor
+            # re-anchors once the head prepare is local.
+            if op_head > 0:
+                self._anchor_pending = True
+                # Force 0 (re-resolve): a leftover nonzero pin from an
+                # older view could name a superseded sibling.
+                self._repair_wanted[op_head] = 0
+                self._anchor_pin_view = -1
             if self._repair_wanted:
                 self._send_repair_requests(force=True)
             return
@@ -1988,7 +2067,13 @@ class VsrReplica(Replica):
         body = _encode_dvc({
             "log_view": self.log_view, "op": self.op,
             "commit_min": self.commit_min, "headers": self._tail_headers(),
-            "head_checksum": self.parent_checksum,
+            # While the canonical head is unresolved, parent_checksum
+            # is a stale pre-install value: advertising it would make
+            # backups adopt it as their anchor (head_checksum=0
+            # decodes to None — receivers run their own unknown-anchor
+            # resolution instead).
+            "head_checksum": 0 if self._anchor_pending
+            else self.parent_checksum,
         })
         h = wire.make_header(
             command=Command.start_view, cluster=self.cluster, view=self.view,
