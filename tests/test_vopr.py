@@ -38,6 +38,18 @@ def test_vopr_primary_scrub_repair_seed():
          crash_probability=0.027, corruption_probability=0.005).run()
 
 
+def test_vopr_duplicate_start_view_seed():
+    """Seed 377174739: a delayed duplicate start_view (same view,
+    shorter claimed op) regressed a backup's head while its anchor was
+    stale; a chain walk from that anchor derived an unserviceable pin
+    that gated commits forever (cluster livelock).  Reinstalls must
+    keep the same-view head (min_head) and chain walks must not run
+    from an unresolved anchor."""
+    Vopr(377174739, requests=60, packet_loss=0.078286280370049,
+         crash_probability=0.02088690985851417,
+         upgrade_nemesis=True).run()
+
+
 def test_vopr_unknown_anchor_seed():
     """Seed 170611267: upgrade restarts truncated recovering journals
     below committed ops, the DVC merge then lacked the head's header

@@ -1978,7 +1978,8 @@ class VsrReplica(Replica):
 
     def _install_log(self, canonical: list[np.ndarray], op_claimed: int,
                      commit_floor: int,
-                     head_checksum: int | None = None) -> None:
+                     head_checksum: int | None = None,
+                     min_head: int = 0) -> None:
         """Make our journal match the canonical tail, requesting any
         prepares we don't hold.
 
@@ -1986,11 +1987,23 @@ class VsrReplica(Replica):
         of it (journal holes skip headers), in which case only the ops
         we have headers for are adopted — anything above is uncommitted
         (committed ops always reach a quorum's journals) and truncates.
+
+        `min_head` (same-view reinstalls): a delayed duplicate
+        start_view must still install its canonical headers (repair
+        pins for stale siblings) but must NOT regress our head below
+        the same-view tail we already hold — our vouches and anchor
+        above its coverage stand.
         """
         self._canon_pending = False  # the canonical tail is now known
+        was_anchor_pending = self._anchor_pending
+        covered = max([int(h["op"]) for h in canonical] + [op_claimed])
         # The canonical headers vouch their checksums for the commit
-        # gate; anything above commit_min not re-vouched here is stale.
-        for k in [k for k in self._vouched if k > self.commit_min]:
+        # gate; anything above commit_min not re-vouched here is stale
+        # — except same-view tail ops beyond a duplicate's coverage.
+        for k in [
+            k for k in self._vouched
+            if k > self.commit_min and (not min_head or k <= covered)
+        ]:
             del self._vouched[k]
         for h in canonical:
             if int(h["op"]) > self.commit_min:
@@ -1999,7 +2012,8 @@ class VsrReplica(Replica):
         # Never regress below our own commit frontier: committed ops
         # are immutable.
         op_head = max(
-            max(have_ops) if have_ops else 0, commit_floor, self.commit_min
+            max(have_ops) if have_ops else 0, commit_floor,
+            self.commit_min, min_head,
         )
         for h in canonical:
             op = int(h["op"])
@@ -2018,6 +2032,16 @@ class VsrReplica(Replica):
         self._anchor_pending = False
         if head is not None:
             self.parent_checksum = wire.u128(head, "checksum")
+        elif min_head and op_head == min_head:
+            # Same-view reinstall kept our head: the current anchor
+            # (and its pending-resolution state, if any) stands.
+            # Deliberately NOT adopting a sender-supplied checksum for
+            # this op even when op_claimed matches: a delayed
+            # duplicate's head claim can name a superseded sibling of
+            # the tail we already vouch (empirically diverges state —
+            # VOPR deep-slice seed 8000); the pin-resolution round
+            # trip is the safe path for a genuinely pending anchor.
+            self._anchor_pending = was_anchor_pending
         elif head_checksum is not None and op_head == op_claimed:
             # No header covers op_head (e.g. the sender state-synced and
             # its checkpoint op is not journaled): anchor on the
@@ -2052,6 +2076,14 @@ class VsrReplica(Replica):
         repair.  While the walk cannot reach commit_min, the whole
         uncommitted range is SUSPECT (deeper siblings may hide below
         the unverified op) and commits are gated (_advance_commit)."""
+        if self._anchor_pending:
+            # parent_checksum is stale while the canonical head is
+            # unresolved: a walk from it derives GARBAGE pins (seed
+            # 377174739: a pin for op N naming another op's checksum
+            # gated commits forever).  Stay suspect; the walk re-runs
+            # from the true anchor once it resolves.
+            self._chain_suspect = True
+            return
         expect = self.parent_checksum
         for op in range(self.op, self.commit_min, -1):
             read = self.journal.read_prepare(op)
@@ -2060,6 +2092,11 @@ class VsrReplica(Replica):
                 self._chain_suspect = True
                 self._send_repair_requests()
                 return
+            # Verified against the canonical chain: any pin for this
+            # op is obsolete (a different-sibling pin is stale garbage
+            # that would gate commits forever; a matching pin is
+            # simply satisfied) — drop it.
+            self._repair_wanted.pop(op, None)
             expect = wire.u128(read[0], "parent")
         self._chain_suspect = False
 
@@ -2097,6 +2134,15 @@ class VsrReplica(Replica):
             # it would regress op below our commit frontier.
             return
         payload = _decode_dvc(body)
+        # Within an installed view the primary's log only grows, so a
+        # same-view start_view claiming less than our op is a delayed
+        # duplicate (lossy-network reordering).  Its HEADERS still
+        # carry canonical knowledge worth installing (pins for stale
+        # siblings below the claim — dropping the message outright
+        # regressed repairs, seed 8000), but our head must not regress
+        # to its stale claim (a regressed head with a stale anchor
+        # derived garbage pins, seed 377174739).
+        same_view_reinstall = view == self.view and self.log_view == view
         self.view = view
         self.status = "normal"
         self.log_view = view
@@ -2104,6 +2150,7 @@ class VsrReplica(Replica):
         self._install_log(
             canonical, payload["op"], int(header["commit"]),
             head_checksum=payload.get("head_checksum"),
+            min_head=self.op if same_view_reinstall else 0,
         )
         self.superblock.view_change(self.view, self.log_view, self.commit_max)
         self._svc_votes.clear()
