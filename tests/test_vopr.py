@@ -38,6 +38,19 @@ def test_vopr_primary_scrub_repair_seed():
          crash_probability=0.027, corruption_probability=0.005).run()
 
 
+def test_vopr_understating_dvc_seed():
+    """Seed 1064614514: a replica installed a view's canonical claim
+    (op N) but crashed before repairing the prepares; restart forgot
+    the claim, its understating DVC won the next view's merge as the
+    highest-log_view cohort, and committed ops above its headers were
+    truncated then re-prepared with new content.  The canonical claim
+    is now durable in the superblock, and DVC merges gap-fill holes
+    from lower-log_view members' headers."""
+    Vopr(1064614514, requests=70, packet_loss=0.06103258542385661,
+         crash_probability=0.033260095782756224,
+         corruption_probability=0.005, upgrade_nemesis=True).run()
+
+
 def test_vopr_duplicate_start_view_seed():
     """Seed 377174739: a delayed duplicate start_view (same view,
     shorter claimed op) regressed a backup's head while its anchor was

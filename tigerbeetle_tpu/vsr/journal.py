@@ -280,7 +280,23 @@ class Journal:
             if op in headers:
                 h = headers[op]
                 if chain_parent is not None and wire.u128(h, "parent") != chain_parent:
-                    break  # chain break: ops above were never prepared
+                    above = [o for o in headers if o > op]
+                    if above:
+                        # Chain break BELOW valid ops: one side of the
+                        # break is a superseded sibling from an older
+                        # view, and recovery alone cannot tell which.
+                        # Keep everything and report the break op
+                        # faulty — the VSR layer rejoins through a
+                        # view change and resolves the true sibling by
+                        # vouched checksum.  Truncating here erased
+                        # COMMITTED durable ops whose headers then
+                        # vanished from the DVC merge (VOPR seeds
+                        # 170611267, 1064614514).
+                        faulty_ops.append(op)
+                        chain_parent = None
+                        op += 1
+                        continue
+                    break  # chain break at the top: stale head, truncated
                 chain_parent = wire.u128(h, "checksum")
                 op_head = op
                 op += 1

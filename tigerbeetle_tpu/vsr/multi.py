@@ -266,6 +266,21 @@ class VsrReplica(Replica):
         self.log_view = int(self.superblock.working["log_view"])
         self.status = "normal"
         self.commit_max = self.commit_min
+        # Restore the durable canonical-log claim: journal recovery
+        # can understate it (prepares never fetched before the crash),
+        # and an understating DVC let a view-change quorum truncate
+        # committed ops (VOPR seed 1064614514).  Missing bodies repair
+        # through the rejoin below.
+        recovered_head = self.op
+        self.op = max(self.op, int(self.superblock.working["op_claimed"]))
+        if self.op > recovered_head:
+            # The claimed head's prepare is not in our journal: the
+            # anchor is unknown, and a chain walk from the recovered
+            # head's checksum would derive garbage pins.  Hold until
+            # the head resolves (pin 0 -> request_headers -> repair).
+            self._anchor_pending = True
+            self._repair_wanted[self.op] = 0
+            self._anchor_pin_view = -1
         # An unexecuted journal tail above the checkpoint must be
         # confirmed by the cluster before this replica may commit or
         # serve: rejoin through a view change, whose DVC quorum
@@ -1795,7 +1810,15 @@ class VsrReplica(Replica):
         self.view = view
         self.status = "normal"
         self.log_view = view
-        self.superblock.view_change(self.view, self.log_view, self.commit_max)
+        # Passive entry: the new view's canonical is NOT installed, so
+        # our tail above commit_min is unconfirmed — persisting it as
+        # this log_view's claim would make a superseded-sibling tail
+        # durable and top-cohort.  Claim only the committed prefix
+        # (always within the recovered journal, so restart-neutral).
+        self.superblock.view_change(
+            self.view, self.log_view, self.commit_max,
+            op_claimed=self.commit_min,
+        )
         self.pipeline.clear()
         self.request_queue.clear()
         self._queued_keys.clear()
@@ -1871,7 +1894,7 @@ class VsrReplica(Replica):
         if self.standby:
             return
         # Persist before participating (reference: superblock view_change).
-        self.superblock.view_change(self.view, self.log_view, self.commit_max)
+        self.superblock.view_change(self.view, self.log_view, self.commit_max, op_claimed=self.op)
         payload = {
             "log_view": self.log_view,
             "op": self.op,
@@ -1930,7 +1953,7 @@ class VsrReplica(Replica):
             return
         self._dvc[int(header["replica"])] = _decode_dvc(body)
         if self.replica not in self._dvc:
-            self.superblock.view_change(self.view, self.log_view, self.commit_max)
+            self.superblock.view_change(self.view, self.log_view, self.commit_max, op_claimed=self.op)
             self._dvc[self.replica] = {
                 "log_view": self.log_view, "op": self.op,
                 "commit_min": self.commit_min, "headers": self._tail_headers(),
@@ -1962,14 +1985,43 @@ class VsrReplica(Replica):
                 have = merged.get(op)
                 if have is None or int(h["view"]) > int(have["view"]):
                     merged[op] = h
-        canonical = [merged[op] for op in sorted(merged)]
         op_claimed = max(d["op"] for d in cohort)
+        # Gap-fill from lower-log_view DVCs: an op with no header in
+        # the top cohort is NOT thereby uncommitted — a cohort member
+        # can claim a canonical tail whose prepares it never finished
+        # repairing (its header list has holes), while an older-view
+        # replica still holds the committed headers.  Truncating at
+        # the hole re-prepared NEW ops at committed numbers and erased
+        # acked state (VOPR seed 1064614514).  Fillers only populate
+        # ops the top cohort left empty, within its claimed range;
+        # same-op conflicts keep the top cohort's header, and among
+        # fillers the later view wins.  (The reference closes the
+        # residual uncertainty — a filled op that a newer view
+        # replaced without any cohort member holding the replacement
+        # header — with its DVC nack quorum, src/vsr/replica.zig; the
+        # commit-vouch chain walk catches such a stale filler when any
+        # header above it survives.)
+        cohort_ops = set(merged)
+        for d in self._dvc.values():
+            if d["log_view"] == best_log_view:
+                continue
+            for raw in d["headers"]:
+                h = wire.header_from_bytes(raw)
+                if not wire.verify_header(h):
+                    continue
+                op = int(h["op"])
+                if op > op_claimed or op in cohort_ops:
+                    continue  # stale tail / top cohort already covers
+                have = merged.get(op)
+                if have is None or int(h["view"]) > int(have["view"]):
+                    merged[op] = h
+        canonical = [merged[op] for op in sorted(merged)]
         commit_floor = max(d["commit_min"] for d in self._dvc.values())
         self._install_log(canonical, op_claimed, commit_floor)
 
         self.status = "normal"
         self.log_view = self.view
-        self.superblock.view_change(self.view, self.log_view, self.commit_max)
+        self.superblock.view_change(self.view, self.log_view, self.commit_max, op_claimed=self.op)
         self._svc_votes.clear()
         self._dvc.clear()
         self._send_start_view()
@@ -2152,7 +2204,7 @@ class VsrReplica(Replica):
             head_checksum=payload.get("head_checksum"),
             min_head=self.op if same_view_reinstall else 0,
         )
-        self.superblock.view_change(self.view, self.log_view, self.commit_max)
+        self.superblock.view_change(self.view, self.log_view, self.commit_max, op_claimed=self.op)
         self._svc_votes.clear()
         self._dvc.clear()
         self._last_primary_seen = self._ticks

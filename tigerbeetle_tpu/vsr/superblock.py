@@ -46,7 +46,15 @@ SUPERBLOCK_DTYPE = np.dtype(
         ("epoch", "<u8"),
         ("member_count", "<u2"),
         ("members", "V64"),
-        ("reserved", f"V{SUPERBLOCK_COPY_SIZE - 194}"),
+        # Canonical log claim of the installed log_view: the highest
+        # op the view's canonical said exists.  Restart must not
+        # forget it — a recovering replica whose journal understates
+        # the claim would send understating DVCs, and a view-change
+        # quorum of understating DVCs truncated committed ops (VOPR
+        # seed 1064614514; reference durably keeps its vsr_headers in
+        # the superblock for the same reason).
+        ("op_claimed", "<u8"),
+        ("reserved", f"V{SUPERBLOCK_COPY_SIZE - 202}"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == SUPERBLOCK_COPY_SIZE
@@ -115,14 +123,19 @@ class SuperBlock:
             h["log_view"] = log_view
         self._write(h)
 
-    def view_change(self, view: int, log_view: int, commit_max: int) -> None:
+    def view_change(self, view: int, log_view: int, commit_max: int,
+                    op_claimed: int | None = None) -> None:
         """Durably record a view change (required before participating
-        in the new view — reference: superblock view_change trigger)."""
+        in the new view — reference: superblock view_change trigger).
+        `op_claimed` records the installed canonical log claim of
+        log_view (overwrites — it belongs to that log_view)."""
         h = self.working.copy()
         h["sequence"] = int(h["sequence"]) + 1
         h["view"] = view
         h["log_view"] = log_view
         h["commit_max"] = max(int(h["commit_max"]), commit_max)
+        if op_claimed is not None:
+            h["op_claimed"] = op_claimed
         self._write(h)
 
     def _write(self, h: np.ndarray) -> None:
