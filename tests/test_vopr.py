@@ -38,6 +38,16 @@ def test_vopr_primary_scrub_repair_seed():
          crash_probability=0.027, corruption_probability=0.005).run()
 
 
+def test_vopr_unapplied_suffix_eviction_seed():
+    """Seed 666677761: a replica holding a recovered-but-unapplied
+    journal suffix (commit_max lagging self.op right after open)
+    evicted a registered client whose register op sat in that suffix.
+    Requests must queue while ANY known suffix is unapplied."""
+    Vopr(666677761, requests=70, packet_loss=0.02435230291464637,
+         crash_probability=0.008999239897508116,
+         corruption_probability=0.005, upgrade_nemesis=True).run()
+
+
 def test_vopr_understating_dvc_seed():
     """Seed 1064614514: a replica installed a view's canonical claim
     (op N) but crashed before repairing the prepares; restart forgot

@@ -239,6 +239,11 @@ class Vopr:
                must_succeed: bool) -> None:
         """Auditor (reference: src/state_machine/auditor.zig): requests
         constructed to be valid must report zero failures."""
+        # A registered client must never be evicted mid-run (sessions
+        # are durable state): surface it as the finding, not as a
+        # TypeError on the absent reply — for every request, not just
+        # must-succeed ones.
+        assert not client.evicted, "registered client wrongly evicted"
         if not must_succeed:
             return
         if operation in (types.Operation.create_accounts,

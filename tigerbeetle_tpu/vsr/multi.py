@@ -566,9 +566,22 @@ class VsrReplica(Replica):
             # retransmitted register whose original is still in flight
             # must not be prepared twice.
         elif entry is None:
-            if self.commit_min < self.commit_max:
-                # Still re-committing: the session may live in the
-                # unapplied suffix.
+            if (
+                self.commit_min < self.commit_max
+                or self._canon_pending
+                or self._anchor_pending
+                or self._chain_suspect
+                or self._repair_wanted
+                or self._recovering_tail
+            ):
+                # Still re-committing, or holding a recovered/claimed
+                # journal suffix not yet re-applied: the session may
+                # live in that suffix — evicting here killed a
+                # registered client whose register op sat in the
+                # unapplied tail (VOPR seed 666677761).  Gated on the
+                # recovery/repair states (all bounded), NOT on
+                # commit_min < self.op, which is true under steady
+                # load and would defer legitimate evictions forever.
                 return "queue"
             if not peek:
                 self._send_eviction(client)
