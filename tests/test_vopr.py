@@ -38,6 +38,23 @@ def test_vopr_primary_scrub_repair_seed():
          crash_probability=0.027, corruption_probability=0.005).run()
 
 
+@pytest.mark.xfail(
+    reason="Known limitation (documented in multi.py): without the "
+    "reference's DVC nack quorum / persisted view headers, a replica "
+    "whose ring lags its vouched canonical (repairs pending across "
+    "crash-restarts in 6 consecutive views) can carry stale headers "
+    "at the freshest log_view, and the merge adopts a superseded "
+    "sibling whose replacement no ring still holds — commits on the "
+    "lagging backups gate forever on an unserviceable pin.",
+    strict=False,
+)
+def test_vopr_stale_carrier_merge_seed():
+    """Seed 925761995: the residual nack-shaped hole — kept visible,
+    not silently skipped, so a future fix is measured against it."""
+    Vopr(925761995, requests=70, packet_loss=0.039035675104828776,
+         crash_probability=0.02793538190863725).run()
+
+
 def test_vopr_unapplied_suffix_eviction_seed():
     """Seed 666677761: a replica holding a recovered-but-unapplied
     journal suffix (commit_max lagging self.op right after open)

@@ -1948,7 +1948,12 @@ class VsrReplica(Replica):
             # 8005); everything within (commit_min, op] is our
             # knowledge of the current history — including ops whose
             # prepares are damaged, which the redundant header still
-            # vouches (VOPR seeds 8006/8018).
+            # vouches (VOPR seeds 8006/8018).  Sub-commit_min ops are
+            # deliberately absent: for them "later view wins" is
+            # unsound (a dead-view sibling can outrank the committed
+            # one — widening this bound to the checkpoint broke
+            # deep-slice seeds 8000/8003); their immutability is
+            # enforced receiver-side in _install_log instead.
             if not self.commit_min < op <= self.op:
                 continue
             if not wire.verify_header(h):
@@ -1988,48 +1993,42 @@ class VsrReplica(Replica):
             d for d in self._dvc.values()
             if d["log_view"] == best_log_view
         ]
-        merged: dict[int, np.ndarray] = {}
-        for d in cohort:
-            for raw in d["headers"]:
-                h = wire.header_from_bytes(raw)
-                if not wire.verify_header(h):
-                    continue
-                op = int(h["op"])
-                have = merged.get(op)
-                if have is None or int(h["view"]) > int(have["view"]):
-                    merged[op] = h
         op_claimed = max(d["op"] for d in cohort)
-        # Gap-fill from lower-log_view DVCs: an op with no header in
-        # the top cohort is NOT thereby uncommitted — a cohort member
-        # can claim a canonical tail whose prepares it never finished
-        # repairing (its header list has holes), while an older-view
-        # replica still holds the committed headers.  Truncating at
-        # the hole re-prepared NEW ops at committed numbers and erased
-        # acked state (VOPR seed 1064614514).  Fillers only populate
-        # ops the top cohort left empty, within its claimed range;
-        # same-op conflicts keep the top cohort's header, and among
-        # fillers the later view wins.  (The reference closes the
-        # residual uncertainty — a filled op that a newer view
-        # replaced without any cohort member holding the replacement
-        # header — with its DVC nack quorum, src/vsr/replica.zig; the
-        # commit-vouch chain walk catches such a stale filler when any
-        # header above it survives.)
-        cohort_ops = set(merged)
+        commit_floor = max(d["commit_min"] for d in self._dvc.values())
+        # Merge headers from EVERY DVC (not only the top cohort: a
+        # cohort member can claim a canonical tail whose prepares it
+        # never finished repairing, while an older-view replica still
+        # holds the committed headers — truncating at the hole
+        # re-prepared NEW ops at committed numbers, VOPR seed
+        # 1064614514).  Same-op conflicts resolve by the CARRIER's
+        # log_view (VRR): the copy carried by the DVC with the
+        # freshest installed canonical wins; the header's own
+        # prepare-view only tie-breaks equal carriers.  Resolving by
+        # prepare-view alone let a dead higher-view sibling held by a
+        # stale replica beat the committed lower-view copy, rewriting
+        # committed slots and chain-breaking every journal (VOPR seed
+        # 925761995).  A stale carrier additionally cannot nominate
+        # content at or below the quorum's commit floor.  (The
+        # reference closes the residual uncertainty with its DVC nack
+        # quorum, src/vsr/replica.zig.)
+        best: dict[int, tuple[int, np.ndarray]] = {}
         for d in self._dvc.values():
-            if d["log_view"] == best_log_view:
-                continue
             for raw in d["headers"]:
                 h = wire.header_from_bytes(raw)
                 if not wire.verify_header(h):
                     continue
                 op = int(h["op"])
-                if op > op_claimed or op in cohort_ops:
-                    continue  # stale tail / top cohort already covers
-                have = merged.get(op)
-                if have is None or int(h["view"]) > int(have["view"]):
-                    merged[op] = h
-        canonical = [merged[op] for op in sorted(merged)]
-        commit_floor = max(d["commit_min"] for d in self._dvc.values())
+                if op > op_claimed:
+                    continue  # beyond the canonical claim: stale tail
+                if d["log_view"] < best_log_view and op <= commit_floor:
+                    continue
+                cur = best.get(op)
+                if cur is None or d["log_view"] > cur[0] or (
+                    d["log_view"] == cur[0]
+                    and int(h["view"]) > int(cur[1]["view"])
+                ):
+                    best[op] = (d["log_view"], h)
+        canonical = [best[op][1] for op in sorted(best)]
         self._install_log(canonical, op_claimed, commit_floor)
 
         self.status = "normal"
@@ -2061,6 +2060,25 @@ class VsrReplica(Replica):
         """
         self._canon_pending = False  # the canonical tail is now known
         was_anchor_pending = self._anchor_pending
+        # Sanitize: within a canonical chain the highest header is
+        # authoritative downward via parent links.  An entry whose
+        # checksum contradicts the entry above it is a provably stale
+        # sibling that leaked into a merge (a committed op can be
+        # invisible to every DVC, bounded by commit_min, while an old
+        # sibling in someone's ring is not).  Adopting such an entry
+        # rewrote the committed slot while KEEPING the op above that
+        # vouches its replacement — permanently chain-breaking every
+        # journal in the cluster (VOPR seed 925761995).  Dropping it
+        # leaves a hole; receivers pin the true checksum from the op
+        # above via the chain walk and repair from whoever holds it.
+        by_op = {int(h["op"]): h for h in canonical}
+        for op in sorted(by_op, reverse=True):
+            above = by_op.get(op + 1)
+            if above is not None and wire.u128(above, "parent") != wire.u128(
+                by_op[op], "checksum"
+            ):
+                del by_op[op]
+        canonical = [by_op[op] for op in sorted(by_op)]
         covered = max([int(h["op"]) for h in canonical] + [op_claimed])
         # The canonical headers vouch their checksums for the commit
         # gate; anything above commit_min not re-vouched here is stale
@@ -2083,6 +2101,16 @@ class VsrReplica(Replica):
         for h in canonical:
             op = int(h["op"])
             if op > op_head:
+                continue
+            if op <= self.commit_min:
+                # WE committed this op: its journal slot is immutable.
+                # A canonical header that disagrees is a stale sibling
+                # that leaked into the merge (a committed op can fall
+                # out of its holder's DVC, bounded by commit_min) —
+                # adopting it rewrote committed slots and left an
+                # unserviceable chain break (VOPR seed 925761995).
+                # Peers missing the op repair by the exact checksum
+                # the op above vouches.
                 continue
             checksum = wire.u128(h, "checksum")
             have = self.journal.read_prepare(op)
