@@ -1907,7 +1907,10 @@ class VsrReplica(Replica):
         if self.standby:
             return
         # Persist before participating (reference: superblock view_change).
-        self.superblock.view_change(self.view, self.log_view, self.commit_max, op_claimed=self.op)
+        self.superblock.view_change(
+            self.view, self.log_view, self.commit_max,
+            op_claimed=self.op,
+        )
         payload = {
             "log_view": self.log_view,
             "op": self.op,
@@ -1971,7 +1974,10 @@ class VsrReplica(Replica):
             return
         self._dvc[int(header["replica"])] = _decode_dvc(body)
         if self.replica not in self._dvc:
-            self.superblock.view_change(self.view, self.log_view, self.commit_max, op_claimed=self.op)
+            self.superblock.view_change(
+                self.view, self.log_view, self.commit_max,
+                op_claimed=self.op,
+            )
             self._dvc[self.replica] = {
                 "log_view": self.log_view, "op": self.op,
                 "commit_min": self.commit_min, "headers": self._tail_headers(),
@@ -2033,7 +2039,10 @@ class VsrReplica(Replica):
 
         self.status = "normal"
         self.log_view = self.view
-        self.superblock.view_change(self.view, self.log_view, self.commit_max, op_claimed=self.op)
+        self.superblock.view_change(
+            self.view, self.log_view, self.commit_max,
+            op_claimed=self.op,
+        )
         self._svc_votes.clear()
         self._dvc.clear()
         self._send_start_view()
@@ -2245,7 +2254,10 @@ class VsrReplica(Replica):
             head_checksum=payload.get("head_checksum"),
             min_head=self.op if same_view_reinstall else 0,
         )
-        self.superblock.view_change(self.view, self.log_view, self.commit_max, op_claimed=self.op)
+        self.superblock.view_change(
+            self.view, self.log_view, self.commit_max,
+            op_claimed=self.op,
+        )
         self._svc_votes.clear()
         self._dvc.clear()
         self._last_primary_seen = self._ticks
