@@ -42,17 +42,37 @@ def test_vopr_primary_scrub_repair_seed():
     reason="Known limitation (documented in multi.py): without the "
     "reference's DVC nack quorum / persisted view headers, a replica "
     "whose ring lags its vouched canonical (repairs pending across "
-    "crash-restarts in 6 consecutive views) can carry stale headers "
-    "at the freshest log_view, and the merge adopts a superseded "
-    "sibling whose replacement no ring still holds — commits on the "
-    "lagging backups gate forever on an unserviceable pin.",
+    "many crash-restart view changes) can carry stale headers at the "
+    "freshest log_view, and the merge adopts a superseded sibling "
+    "whose replacement no ring still holds — surfacing as a commit "
+    "livelock, a stale-sibling execution divergence, or acked-state "
+    "loss.  ~0.6% of heavy-nemesis soak seeds hit this class.",
     strict=False,
 )
-def test_vopr_stale_carrier_merge_seed():
-    """Seed 925761995: the residual nack-shaped hole — kept visible,
-    not silently skipped, so a future fix is measured against it."""
-    Vopr(925761995, requests=70, packet_loss=0.039035675104828776,
-         crash_probability=0.02793538190863725).run()
+@pytest.mark.parametrize(
+    "seed,pl,cp,co,up",
+    [
+        (925761995, 0.039035675104828776, 0.02793538190863725, 0.0, False),
+        (941686528, 0.03065367688868138, 0.010939315579479669, 0.005, True),
+        (199800160, 0.04844306222485367, 0.026223549036723696, 0.001, True),
+    ],
+)
+def test_vopr_stale_carrier_merge_seed(seed, pl, cp, co, up):
+    """The residual nack-shaped hole — kept visible, not silently
+    skipped, so a future fix is measured against these seeds."""
+    Vopr(seed, requests=70, packet_loss=pl, crash_probability=cp,
+         corruption_probability=co, upgrade_nemesis=up).run()
+
+
+def test_vopr_pipelined_register_eviction_seed():
+    """Seed 653186412: a new primary re-replicating an adopted tail
+    (acks lost) held the client's register in its PIPELINE — none of
+    the recovery-state gates covered it and the client was evicted.
+    The eviction gate now scans the pipeline for the client's
+    register."""
+    Vopr(653186412, requests=70, packet_loss=0.07044680383270262,
+         crash_probability=0.01897982395119349,
+         corruption_probability=0.005, upgrade_nemesis=True).run()
 
 
 def test_vopr_unapplied_suffix_eviction_seed():

@@ -573,6 +573,16 @@ class VsrReplica(Replica):
                 or self._chain_suspect
                 or self._repair_wanted
                 or self._recovering_tail
+                # A requeued-uncommitted register can sit in the
+                # pipeline awaiting quorum (new primary re-replicating
+                # an adopted tail, acks lost — VOPR seed 653186412);
+                # bounded scan of <= pipeline_max entries, so no
+                # eviction starvation under steady load.
+                or any(
+                    int(e.header["operation"]) == int(VsrOperation.register)
+                    and wire.u128(e.header, "client") == client
+                    for e in self.pipeline.values()
+                )
             ):
                 # Still re-committing, or holding a recovered/claimed
                 # journal suffix not yet re-applied: the session may
