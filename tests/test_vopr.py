@@ -38,17 +38,6 @@ def test_vopr_primary_scrub_repair_seed():
          crash_probability=0.027, corruption_probability=0.005).run()
 
 
-@pytest.mark.xfail(
-    reason="Known limitation (documented in multi.py): without the "
-    "reference's DVC nack quorum / persisted view headers, a replica "
-    "whose ring lags its vouched canonical (repairs pending across "
-    "many crash-restart view changes) can carry stale headers at the "
-    "freshest log_view, and the merge adopts a superseded sibling "
-    "whose replacement no ring still holds — surfacing as a commit "
-    "livelock, a stale-sibling execution divergence, or acked-state "
-    "loss.  ~0.6% of heavy-nemesis soak seeds hit this class.",
-    strict=False,
-)
 @pytest.mark.parametrize(
     "seed,pl,cp,co,up",
     [
@@ -58,8 +47,14 @@ def test_vopr_primary_scrub_repair_seed():
     ],
 )
 def test_vopr_stale_carrier_merge_seed(seed, pl, cp, co, up):
-    """The residual nack-shaped hole — kept visible, not silently
-    skipped, so a future fix is measured against these seeds."""
+    """The stale-carrier merge class: a replica whose ring lagged its
+    installed canonical (repairs pending across crash-restart view
+    changes) restarted vouching pre-merge siblings at the freshest
+    log_view, and the merge adopted a superseded sibling whose
+    replacement no ring still held.  Fixed by persisting the installed
+    canonical suffix in the superblock atomically with log_view
+    (superblock view_headers — the reference's durable vsr_headers)
+    and letting it override older-view ring entries in _tail_headers."""
     Vopr(seed, requests=70, packet_loss=pl, crash_probability=cp,
          corruption_probability=co, upgrade_nemesis=up).run()
 

@@ -37,6 +37,7 @@ import numpy as np
 
 from tigerbeetle_tpu import constants, types
 from tigerbeetle_tpu.state_machine import demuxer
+from tigerbeetle_tpu.vsr import superblock as superblock_mod
 from tigerbeetle_tpu.vsr import wire
 from tigerbeetle_tpu.vsr.clock import Clock
 from tigerbeetle_tpu.vsr.replica import Replica, Session
@@ -198,6 +199,14 @@ class VsrReplica(Replica):
         # canonical headers, checksum-pinned repairs.  View transitions
         # clear vouches above commit_min.
         self._vouched: dict[int, int] = {}
+        self._installed_canonical: list[np.ndarray] = []
+        # The superblock's persisted canonical suffix must cover the
+        # whole uncommitted range or its overflow truncation reopens
+        # the stale-carrier class it exists to close.
+        assert (
+            self.config.pipeline_prepare_queue_max
+            < superblock_mod.VIEW_HEADERS_MAX
+        ), "view_headers suffix must exceed the pipeline depth"
         self._last_retransmit = 0
 
         # Pending canonical-log install after passively entering a view
@@ -1841,6 +1850,10 @@ class VsrReplica(Replica):
         self.superblock.view_change(
             self.view, self.log_view, self.commit_max,
             op_claimed=self.commit_min,
+            # The new view's canonical is not installed: the previous
+            # log_view's persisted suffix must not masquerade as this
+            # one's (same reasoning as the commit_min claim above).
+            view_headers=[],
         )
         self.pipeline.clear()
         self.request_queue.clear()
@@ -1948,8 +1961,15 @@ class VsrReplica(Replica):
         the new primary pins their checksums and repairs the bodies
         from peers instead of silently truncating them (the reference
         gets the same property from DVC headers + nacks; understating
-        DVCs lost committed ops — VOPR seed 8018)."""
-        out = []
+        DVCs lost committed ops — VOPR seed 8018).
+
+        The superblock's persisted canonical suffix overrides ring
+        entries prepared BEFORE the installed log_view: those are
+        pre-merge siblings the install superseded (durable in our ring
+        only because the crash beat the repair).  Ring entries
+        prepared AT log_view or later postdate the install (the new
+        view's own prepares) and win."""
+        by_op: dict[int, np.ndarray] = {}
         for slot in range(self.journal.slot_count):
             h = self.journal.headers[slot]
             if int(h["command"]) != int(Command.prepare):
@@ -1971,8 +1991,18 @@ class VsrReplica(Replica):
                 continue
             if not wire.verify_header(h):
                 continue
-            out.append(h.tobytes())
-        return out
+            by_op[op] = h
+        for raw in self.superblock.view_headers():
+            h = wire.header_from_bytes(raw)
+            if not wire.verify_header(h):
+                continue
+            op = int(h["op"])
+            if not self.commit_min < op <= self.op:
+                continue
+            cur = by_op.get(op)
+            if cur is None or int(cur["view"]) < self.log_view:
+                by_op[op] = h
+        return [by_op[op].tobytes() for op in sorted(by_op)]
 
     def _on_do_view_change(self, header: np.ndarray, body: bytes) -> None:
         view = int(header["view"])
@@ -2052,6 +2082,10 @@ class VsrReplica(Replica):
         self.superblock.view_change(
             self.view, self.log_view, self.commit_max,
             op_claimed=self.op,
+            view_headers=[
+                h.tobytes() for h in self._installed_canonical
+                if int(h["op"]) > self.commit_min
+            ],
         )
         self._svc_votes.clear()
         self._dvc.clear()
@@ -2098,6 +2132,12 @@ class VsrReplica(Replica):
             ):
                 del by_op[op]
         canonical = [by_op[op] for op in sorted(by_op)]
+        # Stash the sanitized canonical for durable persistence: the
+        # caller records its suffix in the superblock atomically with
+        # log_view (see superblock.view_headers) so a crash between
+        # install and journal repair cannot resurrect pre-merge
+        # siblings into our next DVC.
+        self._installed_canonical = list(canonical)
         covered = max([int(h["op"]) for h in canonical] + [op_claimed])
         # The canonical headers vouch their checksums for the commit
         # gate; anything above commit_min not re-vouched here is stale
@@ -2264,9 +2304,24 @@ class VsrReplica(Replica):
             head_checksum=payload.get("head_checksum"),
             min_head=self.op if same_view_reinstall else 0,
         )
+        # Persist the installed canonical suffix with log_view.  A
+        # same-view reinstall merges with the already-persisted set:
+        # a delayed duplicate's shorter coverage must not shed the
+        # durable vouch for tail ops we already installed.
+        vh: dict[int, bytes] = {}
+        if same_view_reinstall:
+            for raw in self.superblock.view_headers():
+                prev = wire.header_from_bytes(raw)
+                if wire.verify_header(prev):
+                    vh[int(prev["op"])] = raw
+        for ch in self._installed_canonical:
+            vh[int(ch["op"])] = ch.tobytes()
         self.superblock.view_change(
             self.view, self.log_view, self.commit_max,
             op_claimed=self.op,
+            view_headers=[
+                vh[op] for op in sorted(vh) if op > self.commit_min
+            ],
         )
         self._svc_votes.clear()
         self._dvc.clear()

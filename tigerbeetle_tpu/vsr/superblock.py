@@ -19,12 +19,15 @@ from __future__ import annotations
 
 import numpy as np
 
+from tigerbeetle_tpu.constants import HEADER_SIZE
 from tigerbeetle_tpu.vsr import wire
 from tigerbeetle_tpu.vsr.storage import (
     SUPERBLOCK_COPIES,
     SUPERBLOCK_COPY_SIZE,
     Storage,
 )
+
+VIEW_HEADERS_MAX = 14  # canonical-suffix headers the superblock holds
 
 SUPERBLOCK_DTYPE = np.dtype(
     [
@@ -54,7 +57,20 @@ SUPERBLOCK_DTYPE = np.dtype(
         # seed 1064614514; reference durably keeps its vsr_headers in
         # the superblock for the same reason).
         ("op_claimed", "<u8"),
-        ("reserved", f"V{SUPERBLOCK_COPY_SIZE - 202}"),
+        # Canonical suffix headers of the installed log_view (the
+        # reference durably keeps `vsr_headers` in its superblock,
+        # src/vsr/superblock.zig).  A replica that installed a
+        # canonical tail but crashed before its journal ring durably
+        # absorbed it would otherwise restart vouching the PRE-merge
+        # siblings its ring still holds — at the freshest log_view,
+        # where the merge trusts it most (the stale-carrier class,
+        # VOPR seeds 925761995/941686528/199800160).  Persisting the
+        # installed suffix atomically with log_view closes the gap:
+        # restart re-vouches the canonical copies.
+        ("vh_count", "<u2"),
+        ("view_headers", f"V{VIEW_HEADERS_MAX * HEADER_SIZE}"),
+        ("reserved",
+         f"V{SUPERBLOCK_COPY_SIZE - 204 - VIEW_HEADERS_MAX * HEADER_SIZE}"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == SUPERBLOCK_COPY_SIZE
@@ -124,11 +140,15 @@ class SuperBlock:
         self._write(h)
 
     def view_change(self, view: int, log_view: int, commit_max: int,
-                    op_claimed: int | None = None) -> None:
+                    op_claimed: int | None = None,
+                    view_headers: list[bytes] | None = None) -> None:
         """Durably record a view change (required before participating
         in the new view — reference: superblock view_change trigger).
         `op_claimed` records the installed canonical log claim of
-        log_view (overwrites — it belongs to that log_view)."""
+        log_view (overwrites — it belongs to that log_view).
+        `view_headers` (raw 256-byte wire headers, ascending op)
+        overwrites the persisted canonical suffix; None keeps the
+        previous set (it still belongs to the unchanged log_view)."""
         h = self.working.copy()
         h["sequence"] = int(h["sequence"]) + 1
         h["view"] = view
@@ -136,7 +156,28 @@ class SuperBlock:
         h["commit_max"] = max(int(h["commit_max"]), commit_max)
         if op_claimed is not None:
             h["op_claimed"] = op_claimed
+        if view_headers is not None:
+            # Keep the HIGHEST ops when the suffix overflows: stale
+            # siblings that no chain link can pin live only in the
+            # uncommitted range above the merge's commit floor, which
+            # the pipeline bounds at 8 ops (< VIEW_HEADERS_MAX).  Ops
+            # further down are committed cluster-wide — a stale ring
+            # sibling there is caught by the canonical chain walk and
+            # repaired by the exact checksum the op above vouches.
+            suffix = view_headers[-VIEW_HEADERS_MAX:]
+            h["vh_count"] = len(suffix)
+            h["view_headers"] = b"".join(suffix).ljust(
+                VIEW_HEADERS_MAX * HEADER_SIZE, b"\x00"
+            )
         self._write(h)
+
+    def view_headers(self) -> list[bytes]:
+        """The persisted canonical suffix of the current log_view."""
+        n = int(self.working["vh_count"])
+        raw = bytes(self.working["view_headers"])
+        return [
+            raw[i * HEADER_SIZE:(i + 1) * HEADER_SIZE] for i in range(n)
+        ]
 
     def _write(self, h: np.ndarray) -> None:
         payload = h.tobytes()[16:]
