@@ -59,6 +59,33 @@ def test_vopr_stale_carrier_merge_seed(seed, pl, cp, co, up):
          corruption_probability=co, upgrade_nemesis=up).run()
 
 
+def test_vopr_repair_target_rotation_seed():
+    """Seed 803272239: the view-4 primary and one backup both lost op
+    72's prepare; primary-asks-successor / backup-asks-primary meant
+    the lone holder (the other backup) was never asked and the cluster
+    wedged with commits gated forever.  Pinned (checksum-addressed)
+    repair retries now rotate across all peers."""
+    Vopr(803272239, requests=60, packet_loss=0.035301351406234624,
+         crash_probability=0.029253284284020395,
+         corruption_probability=0.005).run()
+
+
+def test_vopr_stale_pin_overwrite_seed():
+    """Seed 460991023: checksum pins left over from a dead view
+    survived the canonical install AND the primary's own fresh
+    prepares; a delayed old-view prepare matching such a pin then
+    overwrote the newly-prepared canonical slot, hijacked the head
+    anchor, and let the out-of-order stash extend the head with stale
+    content (its linkage guard silently passed while the head's WAL
+    write was in flight).  Fixed by clearing superseded pins at
+    install (anchor pin excepted), popping the pin when preparing new
+    content at an op, and making the stash-drain linkage check
+    positive against parent_checksum."""
+    Vopr(460991023, requests=60, packet_loss=0.05448703242272319,
+         crash_probability=0.02540533516142603,
+         corruption_probability=0.001).run()
+
+
 def test_vopr_pipelined_register_eviction_seed():
     """Seed 653186412: a new primary re-replicating an adopted tail
     (acks lost) held the client's register in its PIPELINE — none of

@@ -208,6 +208,7 @@ class VsrReplica(Replica):
             < superblock_mod.VIEW_HEADERS_MAX
         ), "view_headers suffix must exceed the pipeline depth"
         self._last_retransmit = 0
+        self._repair_round = 0
 
         # Pending canonical-log install after passively entering a view
         # (commits gated until start_view arrives).
@@ -704,6 +705,10 @@ class VsrReplica(Replica):
         self.op = op
         self.parent_checksum = wire.u128(prepare, "checksum")
         self._vouched[op] = self.parent_checksum  # we ARE the canon
+        # A leftover pin for this op named dead-view content; the new
+        # prepare supersedes it (a matching stale fill would otherwise
+        # overwrite this slot — seed 460991023).
+        self._repair_wanted.pop(op, None)
         self.pipeline[op] = PipelineEntry(prepare, body, {self.replica}, subs)
         self._replicate(prepare, body)
         self._maybe_commit_pipeline()
@@ -973,22 +978,28 @@ class VsrReplica(Replica):
                 return
             self._accept_prepare(header, body)
             self._flag_stale_predecessor(header)
-            while self.op + 1 in self._stash:
-                h, b = self._stash.pop(self.op + 1)
-                if wire.u128(h, "parent") != self.parent_checksum:
-                    break
-                self._accept_prepare(h, b)
+            self._drain_stash()
             self._advance_commit(int(header["commit"]))
             return
 
         self._accept_prepare(header, body)
-        # Drain any stashed successors.
-        while self.op + 1 in self._stash:
+        self._drain_stash()
+        self._advance_commit(int(header["commit"]))
+
+    def _drain_stash(self) -> None:
+        """Extend the head with stashed successors that POSITIVELY
+        link to the verified head anchor.  The check must be against
+        parent_checksum, not a journal read-back — the read is
+        transiently None while the head's WAL write is in flight, and
+        failing open let a delayed prior-view prepare extend a
+        just-prepared head with stale content (seed 460991023).  No
+        draining while the anchor itself is unresolved:
+        parent_checksum is stale then."""
+        while not self._anchor_pending and self.op + 1 in self._stash:
             h, b = self._stash.pop(self.op + 1)
             if wire.u128(h, "parent") != self.parent_checksum:
                 break
             self._accept_prepare(h, b)
-        self._advance_commit(int(header["commit"]))
 
     def _accept_prepare(self, header: np.ndarray, body: bytes) -> None:
         op = int(header["op"])
@@ -1258,11 +1269,7 @@ class VsrReplica(Replica):
             ):
                 self._accept_prepare(header, body)
                 self._flag_stale_predecessor(header)
-                while self.op + 1 in self._stash:
-                    h, b = self._stash.pop(self.op + 1)
-                    if wire.u128(h, "parent") != self.parent_checksum:
-                        break
-                    self._accept_prepare(h, b)
+                self._drain_stash()
                 self._advance_commit(self.commit_max)
             return
         if self._try_wal_scrub_repair(header, body):
@@ -1299,14 +1306,7 @@ class VsrReplica(Replica):
         if self.is_primary:
             self._primary_requeue_uncommitted()
         # Try draining stash / committing past the filled hole.
-        while self.op + 1 in self._stash:
-            h, b = self._stash.pop(self.op + 1)
-            prev = self.journal.read_prepare(self.op)
-            if prev is not None and wire.u128(h, "parent") != wire.u128(
-                prev[0], "checksum"
-            ):
-                break
-            self._accept_prepare(h, b)
+        self._drain_stash()
         self._advance_commit(self.commit_max)
 
     def _send_repair_requests(self, force: bool = False) -> None:
@@ -1354,6 +1354,21 @@ class VsrReplica(Replica):
         pinned = [
             (op, cs) for op, cs in self._repair_wanted.items() if cs != 0
         ]
+        if pinned:
+            # The primary is the preferred source but not guaranteed
+            # to HOLD every pinned body: with the primary and one
+            # backup both missing an op, primary-asks-successor and
+            # successor-asks-primary never reaches the lone holder
+            # (VOPR seed 803272239 wedged exactly so).  Checksum-
+            # addressed fetches are safe from ANY peer, so retries
+            # rotate across all of them.
+            peers = [
+                r for r in range(self.replica_count) if r != self.replica
+            ]
+            if peers:
+                base = peers.index(target)
+                target = peers[(base + self._repair_round) % len(peers)]
+                self._repair_round += 1
         for op, checksum in pinned[:8]:
             h = wire.make_header(
                 command=Command.request_prepare, cluster=self.cluster,
@@ -1850,10 +1865,16 @@ class VsrReplica(Replica):
         self.superblock.view_change(
             self.view, self.log_view, self.commit_max,
             op_claimed=self.commit_min,
-            # The new view's canonical is not installed: the previous
-            # log_view's persisted suffix must not masquerade as this
-            # one's (same reasoning as the commit_min claim above).
-            view_headers=[],
+            # The previously-installed canonical suffix is KEPT (not
+            # cleared): it is still our best durable knowledge of the
+            # uncommitted range, and clearing it would reopen the
+            # stale-carrier crash window right here (crash after
+            # passive entry, before this view's start_view arrives,
+            # restarts vouching raw ring siblings at the freshest
+            # log_view).  If this view's canonical replaced any of
+            # those ops, its copies carry a higher prepare-view and
+            # win the merge tie-break; ring entries prepared in this
+            # view likewise outrank the kept suffix in _tail_headers.
         )
         self.pipeline.clear()
         self.request_queue.clear()
@@ -1964,11 +1985,12 @@ class VsrReplica(Replica):
         DVCs lost committed ops — VOPR seed 8018).
 
         The superblock's persisted canonical suffix overrides ring
-        entries prepared BEFORE the installed log_view: those are
-        pre-merge siblings the install superseded (durable in our ring
-        only because the crash beat the repair).  Ring entries
-        prepared AT log_view or later postdate the install (the new
-        view's own prepares) and win."""
+        entries prepared BEFORE the log_view that installed it
+        (vh_log_view): those are pre-merge siblings the install
+        superseded (durable in our ring only because the crash beat
+        the repair).  Ring entries prepared at the install point or
+        later postdate it (that view's — or, after passive entries, a
+        newer view's — own prepares) and win."""
         by_op: dict[int, np.ndarray] = {}
         for slot in range(self.journal.slot_count):
             h = self.journal.headers[slot]
@@ -1992,6 +2014,7 @@ class VsrReplica(Replica):
             if not wire.verify_header(h):
                 continue
             by_op[op] = h
+        vh_log_view = int(self.superblock.working["vh_log_view"])
         for raw in self.superblock.view_headers():
             h = wire.header_from_bytes(raw)
             if not wire.verify_header(h):
@@ -2000,7 +2023,7 @@ class VsrReplica(Replica):
             if not self.commit_min < op <= self.op:
                 continue
             cur = by_op.get(op)
-            if cur is None or int(cur["view"]) < self.log_view:
+            if cur is None or int(cur["view"]) < vh_log_view:
                 by_op[op] = h
         return [by_op[op].tobytes() for op in sorted(by_op)]
 
@@ -2147,6 +2170,21 @@ class VsrReplica(Replica):
             if k > self.commit_min and (not min_head or k <= covered)
         ]:
             del self._vouched[k]
+        # Checksum pins from the previous view are equally stale in
+        # the covered range: a surviving pin is a standing order to
+        # OVERWRITE its slot the moment a matching (dead-view) prepare
+        # arrives — which clobbered a newly-prepared canonical op and
+        # hijacked the head anchor (seed 460991023).  The install
+        # re-pins below exactly what it still wants; the same-view-
+        # reinstall branch below re-arms the pending-anchor pin it
+        # depends on (the pin must not simply be EXEMPTED here — a
+        # resolved-but-stale anchor pin surviving into a head-found
+        # install would recreate the standing-overwrite hazard).
+        for k in [
+            k for k in self._repair_wanted
+            if k > self.commit_min and (not min_head or k <= covered)
+        ]:
+            del self._repair_wanted[k]
         for h in canonical:
             if int(h["op"]) > self.commit_min:
                 self._vouched[int(h["op"])] = wire.u128(h, "checksum")
@@ -2194,6 +2232,13 @@ class VsrReplica(Replica):
             # VOPR deep-slice seed 8000); the pin-resolution round
             # trip is the safe path for a genuinely pending anchor.
             self._anchor_pending = was_anchor_pending
+            if was_anchor_pending and op_head not in self._repair_wanted:
+                # The pin sweep above dropped the pending anchor's
+                # pin; without it nothing requests anything and the
+                # resolution round trip dies (the deep-lag state-sync
+                # wedge).  Re-arm from 0 (re-resolve).
+                self._repair_wanted[op_head] = 0
+                self._anchor_pin_view = -1
         elif head_checksum is not None and op_head == op_claimed:
             # No header covers op_head (e.g. the sender state-synced and
             # its checkpoint op is not journaled): anchor on the
@@ -2307,9 +2352,17 @@ class VsrReplica(Replica):
         # Persist the installed canonical suffix with log_view.  A
         # same-view reinstall merges with the already-persisted set:
         # a delayed duplicate's shorter coverage must not shed the
-        # durable vouch for tail ops we already installed.
+        # durable vouch for tail ops we already installed.  Merge ONLY
+        # when the persisted suffix was installed at THIS log_view —
+        # after a passive entry (which keeps the older suffix) the
+        # first start_view also matches same_view_reinstall, and
+        # merging would re-stamp the older view's headers at the
+        # current vh_log_view, elevating them above intermediate-view
+        # ring entries in _tail_headers.
         vh: dict[int, bytes] = {}
-        if same_view_reinstall:
+        if same_view_reinstall and (
+            int(self.superblock.working["vh_log_view"]) == self.log_view
+        ):
             for raw in self.superblock.view_headers():
                 prev = wire.header_from_bytes(raw)
                 if wire.verify_header(prev):

@@ -68,9 +68,15 @@ SUPERBLOCK_DTYPE = np.dtype(
         # installed suffix atomically with log_view closes the gap:
         # restart re-vouches the canonical copies.
         ("vh_count", "<u2"),
+        # The log_view at which the suffix was installed: passive view
+        # entries advance log_view while KEEPING the suffix, so the
+        # precedence rule in _tail_headers (ring entries prepared at
+        # or after the install outrank the suffix) must compare
+        # against the install point, not the current log_view.
+        ("vh_log_view", "<u4"),
         ("view_headers", f"V{VIEW_HEADERS_MAX * HEADER_SIZE}"),
         ("reserved",
-         f"V{SUPERBLOCK_COPY_SIZE - 204 - VIEW_HEADERS_MAX * HEADER_SIZE}"),
+         f"V{SUPERBLOCK_COPY_SIZE - 208 - VIEW_HEADERS_MAX * HEADER_SIZE}"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == SUPERBLOCK_COPY_SIZE
@@ -166,6 +172,7 @@ class SuperBlock:
             # repaired by the exact checksum the op above vouches.
             suffix = view_headers[-VIEW_HEADERS_MAX:]
             h["vh_count"] = len(suffix)
+            h["vh_log_view"] = log_view
             h["view_headers"] = b"".join(suffix).ljust(
                 VIEW_HEADERS_MAX * HEADER_SIZE, b"\x00"
             )
