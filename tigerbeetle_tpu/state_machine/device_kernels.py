@@ -1097,7 +1097,25 @@ PK_SPEC = {
     "two_phase": (N_COLS_TP, np.uint64),
     "two_phase_lo": (N_COLS_TP, np.uint64),
 }
-SCAN_SIZES = (16, 4)
+# Batches per scan launch, largest first (exact decomposition in the
+# engine's chunk planner).  Larger tiers amortize the per-launch
+# tunnel overhead (~10 ms quiet, 100x worse under contention) over
+# more batches; lax.scan compile time is length-independent, so the
+# only cost of a big tier is its staged input buffer.
+def _scan_sizes() -> tuple[int, ...]:
+    raw = os.environ.get("TB_DEV_SCAN_SIZES", "16,4")
+    try:
+        sizes = {int(x) for x in raw.split(",") if x.strip()}
+    except ValueError:
+        sizes = set()
+    sizes = {g for g in sizes if g > 0}
+    # Greedy exact decomposition needs descending tiers; an empty or
+    # invalid override falls back to the default rather than hanging
+    # the chunk planner (G=0) or crashing import (trailing comma).
+    return tuple(sorted(sizes, reverse=True)) if sizes else (16, 4)
+
+
+SCAN_SIZES = _scan_sizes()
 # kind -> {G: jitted scan}; compiled lazily per (kind, G) actually used.
 scan_kernels = {
     kind: {G: _scan_of(fn, G) for G in SCAN_SIZES}

@@ -317,8 +317,12 @@ def fuzz_manifest_log(seed: int, rounds: int) -> None:
 
     rng = np.random.default_rng(seed)
     for case in range(max(1, rounds // 40)):
-        storage = MemoryStorage(_layout(grid_size=1 << 22), seed=seed + case)
-        grid = Grid(storage, block_size=4096, block_count=1 << 10)
+        # Grid sized for the workload's compaction peak: live state
+        # alone can reach ~350 blocks, and a compacting checkpoint
+        # holds the old log blocks (still staged for release) plus the
+        # fresh snapshot concurrently.
+        storage = MemoryStorage(_layout(grid_size=1 << 24), seed=seed + case)
+        grid = Grid(storage, block_size=4096, block_count=1 << 12)
         mlog = ManifestLog(grid)
         model: dict[tuple, list] = {}
         next_run = 0
@@ -343,6 +347,11 @@ def fuzz_manifest_log(seed: int, rounds: int) -> None:
                 del model[key]
             else:
                 addresses = mlog.checkpoint()
+                # The durable-checkpoint ack that makes staged block
+                # releases reusable (production: forest.py:150).
+                # Without it every log compaction leaks its released
+                # blocks into staging and long runs exhaust the grid.
+                grid.free_set.checkpoint()
         addresses = mlog.checkpoint()
         tail = mlog.tail_bytes()
         replayed = ManifestLog(grid).open(addresses, tail)
