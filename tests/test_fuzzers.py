@@ -24,3 +24,22 @@ def test_fuzz_cli_entry_point():
     )
     assert proc.returncode == 0, proc.stderr
     assert "fuzz ewah: ok" in proc.stdout
+
+
+def test_soak_cli_entry_point():
+    """The CFO-fleet analog (testing/soak.py) runs end-to-end: a tiny
+    all-kinds wave, JSONL records, zero failures expected."""
+    import json
+    import os
+    import tempfile
+
+    out = os.path.join(tempfile.mkdtemp(), "soak.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tigerbeetle_tpu.testing.soak", "all",
+         "--n", "2", "--seed-base", "5", "--out", out],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "TB_FORCE_CPU_JAX": "1"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    records = [json.loads(line) for line in open(out)]
+    assert len(records) == 2 and all(r["ok"] for r in records)

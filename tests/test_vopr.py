@@ -26,6 +26,17 @@ def test_vopr_no_faults_longer():
     Vopr(99, requests=200, packet_loss=0.0, crash_probability=0.0).run()
 
 
+@pytest.mark.parametrize("seed", [5, 812])
+def test_vopr_query_workload(seed):
+    """The v2 workload profile: lookup_transfers, AccountFilter scans
+    (get_account_transfers / get_account_balances over history
+    accounts), and balancing transfers ride the replicated commit
+    path under faults — cross-replica determinism of scan replies is
+    enforced by the convergence + restart-equivalence checkers."""
+    Vopr(seed, requests=80, queries=True, packet_loss=0.03,
+         crash_probability=0.015, corruption_probability=0.001).run()
+
+
 def test_vopr_heavy_faults():
     Vopr(31337, requests=50, packet_loss=0.05, crash_probability=0.02).run()
 

@@ -25,45 +25,143 @@ from tigerbeetle_tpu import constants as cfg
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.state_machine import CpuStateMachine
 from tigerbeetle_tpu.testing.cluster import Cluster, PacketOptions
-from tigerbeetle_tpu.testing.harness import pack, account, transfer
+from tigerbeetle_tpu.testing.harness import account, ids_bytes, pack, transfer
 from tigerbeetle_tpu.vsr.multi import VsrReplica
 
 
 class Workload:
-    def __init__(self, seed: int) -> None:
+    def __init__(self, seed: int, queries: bool = False) -> None:
+        """queries=False is the frozen v1 stream: regression seed
+        tests reproduce their original fault interleavings only if
+        the RNG consumption stays byte-identical.  queries=True (the
+        v2 profile, used by soaks and its own tests) widens the op
+        surface with lookup_transfers, AccountFilter queries over the
+        committed scan engine, history balances, and balancing
+        transfers — cross-replica determinism of every reply is
+        enforced by the cluster's hash-log convergence checker."""
         self.rng = np.random.default_rng(seed)
+        self.queries = queries
         self.account_ids: list[int] = []
+        self.history_ids: list[int] = []
         self.pending_ids: list[int] = []
+        self.transfer_ids: list[int] = []
         self.next_account = 1
         self.next_transfer = 1_000_000
 
     def next_request(self) -> tuple[types.Operation, bytes, bool]:
         """-> (operation, body, must_succeed)."""
         roll = self.rng.random()
+        if not self.queries:
+            if len(self.account_ids) < 4 or roll < 0.08:
+                return self._create_accounts()
+            if roll < 0.70:
+                return self._create_transfers()
+            if roll < 0.80 and self.pending_ids:
+                return self._post_or_void()
+            if roll < 0.90:
+                return self._lookup_accounts()
+            return self._create_transfers()
         if len(self.account_ids) < 4 or roll < 0.08:
             return self._create_accounts()
-        if roll < 0.70:
+        if roll < 0.58:
             return self._create_transfers()
-        if roll < 0.80 and self.pending_ids:
+        if roll < 0.68 and self.pending_ids:
             return self._post_or_void()
-        if roll < 0.90:
-            ids = [
-                int(v) for v in
-                self.rng.choice(self.account_ids, size=min(4, len(self.account_ids)))
-            ]
-            from tigerbeetle_tpu.testing.harness import ids_bytes
-
-            return types.Operation.lookup_accounts, ids_bytes(ids), True
-        return self._create_transfers()
+        if roll < 0.74:
+            return self._lookup_accounts()
+        if roll < 0.80 and self.transfer_ids:
+            return self._lookup_transfers()
+        if roll < 0.88:
+            return self._get_account_transfers()
+        if roll < 0.94:
+            return self._get_account_balances()
+        return self._balancing_transfer()
 
     def _create_accounts(self):
         n = int(self.rng.integers(1, 5))
         rows = []
         for _ in range(n):
-            rows.append(account(self.next_account, ledger=1, code=1))
+            flags = 0
+            if self.queries and self.rng.random() < 0.4:
+                flags |= types.AccountFlags.history
+                self.history_ids.append(self.next_account)
+            rows.append(
+                account(self.next_account, ledger=1, code=1, flags=flags)
+            )
             self.account_ids.append(self.next_account)
             self.next_account += 1
         return types.Operation.create_accounts, pack(rows), True
+
+    def _lookup_accounts(self):
+        ids = [
+            int(v) for v in
+            self.rng.choice(self.account_ids, size=min(4, len(self.account_ids)))
+        ]
+        return types.Operation.lookup_accounts, ids_bytes(ids), True
+
+    def _lookup_transfers(self):
+        ids = [
+            int(v) for v in
+            self.rng.choice(self.transfer_ids,
+                            size=min(4, len(self.transfer_ids)))
+        ]
+        return types.Operation.lookup_transfers, ids_bytes(ids), True
+
+    def _account_filter(self, account_id: int) -> bytes:
+        row = np.zeros(1, dtype=types.ACCOUNT_FILTER_DTYPE)[0]
+        types.u128_set(row, "account_id", account_id)
+        flags = 0
+        if self.rng.random() < 0.8:
+            flags |= types.AccountFilterFlags.debits
+        if self.rng.random() < 0.8:
+            flags |= types.AccountFilterFlags.credits
+        if not flags:
+            flags = (types.AccountFilterFlags.debits
+                     | types.AccountFilterFlags.credits)
+        if self.rng.random() < 0.3:
+            flags |= types.AccountFilterFlags.reversed
+        row["flags"] = flags
+        row["limit"] = int(self.rng.choice([1, 3, 50, 8190]))
+        return row.tobytes()
+
+    def _get_account_transfers(self):
+        aid = int(self.rng.choice(self.account_ids))
+        return (
+            types.Operation.get_account_transfers,
+            self._account_filter(aid),
+            True,
+        )
+
+    def _get_account_balances(self):
+        # Prefer a history-flagged account (rows exist only for
+        # those); a non-history target legitimately returns empty and
+        # still exercises the committed scan path.
+        pool = self.history_ids or self.account_ids
+        aid = int(self.rng.choice(pool))
+        return (
+            types.Operation.get_account_balances,
+            self._account_filter(aid),
+            True,
+        )
+
+    def _balancing_transfer(self):
+        dr, cr = self._pick_pair()
+        tid = self.next_transfer
+        self.next_transfer += 1
+        flags = (
+            types.TransferFlags.balancing_debit
+            if self.rng.random() < 0.5
+            else types.TransferFlags.balancing_credit
+        )
+        # Legitimately fails with exceeds_credits/debits when nothing
+        # is transferable — exercised for determinism, not audited.
+        return (
+            types.Operation.create_transfers,
+            pack([transfer(tid, debit_account_id=dr, credit_account_id=cr,
+                           amount=int(self.rng.integers(0, 50)),
+                           flags=flags)]),
+            False,
+        )
 
     def _pick_pair(self) -> tuple[int, int]:
         dr, cr = self.rng.choice(self.account_ids, size=2, replace=False)
@@ -95,6 +193,8 @@ class Workload:
             )
             if is_pending and timeout == 0:
                 self.pending_ids.append(tid)
+            self.transfer_ids.append(tid)
+        del self.transfer_ids[:-512]  # bound lookup pool memory
         assert not linked_open
         return types.Operation.create_transfers, pack(rows), True
 
@@ -139,6 +239,7 @@ class Vopr:
                  crash_probability: float = 0.01,
                  corruption_probability: float = 0.0,
                  upgrade_nemesis: bool = False,
+                 queries: bool = False,
                  state_machine_factory=None) -> None:
         self.seed = seed
         self.rng = np.random.default_rng(seed + 1)
@@ -148,7 +249,7 @@ class Vopr:
             options=PacketOptions(packet_loss_probability=packet_loss),
             state_machine_factory=state_machine_factory,
         )
-        self.workload = Workload(seed + 2)
+        self.workload = Workload(seed + 2, queries=queries)
         self.requests = requests
         self.crash_probability = crash_probability
         self.corruption_probability = corruption_probability
