@@ -17,7 +17,7 @@ BANNED = [
 ]
 # Modules where process I/O or wall time is the point.
 EXEMPT = {"cli.py", "repl.py", "benchmark.py", "server.py", "native.py",
-          "fastpath.py", "flags.py", "fuzz.py"}
+          "fastpath.py", "flags.py", "fuzz.py", "soak.py"}
 
 
 def _py_files():

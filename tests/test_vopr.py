@@ -26,6 +26,54 @@ def test_vopr_no_faults_longer():
     Vopr(99, requests=200, packet_loss=0.0, crash_probability=0.0).run()
 
 
+@pytest.mark.parametrize("seed", [44, 71])
+def test_vopr_reconfigure_nemesis(seed):
+    """Standby-promotion reconfigures ride the op stream under the
+    full nemesis suite.  Seed 44 caught reply divergence on replay: a
+    replica that heartbeat-adopted epoch N replied "stale" to the
+    intermediate epochs it later replayed while live replicas had
+    replied "ok" — fixed by splitting committed epoch/members (pure
+    function of the op stream, validates replies) from the adopted
+    runtime role (may run ahead via heartbeats)."""
+    Vopr(seed, requests=80, standby_count=1, reconfigure_nemesis=True,
+         queries=True).run()
+
+
+@pytest.mark.parametrize(
+    "seed,pl,cp,co,up,q,req",
+    [
+        (300661417, 0.07704366683116852, 0.00454365485120272, 0.005,
+         False, False, 120),
+        (399484635, 0.04704768808915133, 0.034975506481705096, 0.005,
+         True, True, 60),
+    ],
+)
+def test_vopr_sync_membership_seed(seed, pl, cp, co, up, q, req):
+    """Soak-found class: a state-synced replica jumped commit_min past
+    the reconfigure ops without adopting their committed epoch, then
+    rejected every later epoch as stale — committed epochs diverged
+    cluster-wide (0/4/5/6 at the same prefix) and the cluster wedged
+    with three processes believing they filled the standby slot.  The
+    checkpoint blob now carries committed epoch+members, and the sync
+    install persists them."""
+    Vopr(seed, requests=req, packet_loss=pl, crash_probability=cp,
+         corruption_probability=co, upgrade_nemesis=up, queries=q,
+         standby_count=1, reconfigure_nemesis=True).run()
+
+
+def test_vopr_reconfigure_superseded_identity_seed():
+    """Soak seed 420704875: a process restarted into view_change under
+    a superseded identity (its old slot reassigned by a reconfigure it
+    missed) dropped the heartbeat membership advertisement at the
+    status gate — its DVCs then came from a slot someone else fills,
+    start_view replies routed to the new holder, and it never
+    rejoined.  Membership adoption now runs before the status gate."""
+    Vopr(420704875, requests=120, packet_loss=0.013541258428352805,
+         crash_probability=0.025638242944772172,
+         corruption_probability=0.0, standby_count=1,
+         reconfigure_nemesis=True).run()
+
+
 @pytest.mark.parametrize("seed", [5, 812])
 def test_vopr_query_workload(seed):
     """The v2 workload profile: lookup_transfers, AccountFilter scans

@@ -250,12 +250,21 @@ class Cluster:
 
     def process_of_slot(self, slot: int) -> int:
         """Current process filling a protocol slot (reconfiguration
-        moves slots between processes; any live replica's membership
-        view serves — they agree at commit boundaries)."""
+        moves slots between processes).  Routing follows the freshest
+        ADOPTED membership — which process answers for a slot NOW —
+        not the committed one: a replica that heartbeat-adopted a
+        newer epoch but hasn't replayed its ops yet would otherwise
+        steer requests at the stale mapping."""
+        best_epoch, best = -1, None
         for r in self.replicas:
-            if r.status == "normal" and r.members is not None:
-                if slot < len(r.members):
-                    return r.members[slot]
+            if r.status != "normal":
+                continue
+            members = r.members_adopted or r.members
+            epoch = max(r.epoch_adopted, r.epoch)
+            if members is not None and epoch > best_epoch:
+                best_epoch, best = epoch, members
+        if best is not None and slot < len(best):
+            return best[slot]
         return slot
 
     def client(self, client_id: int) -> SimClient:

@@ -33,6 +33,7 @@ def _vopr_case(rng: random.Random) -> dict:
         "queries": rng.random() < 0.6,
         "replica_count": rng.choice([3, 3, 3, 5]),
         "standby_count": rng.choice([0, 0, 1]),
+        "reconfigure_nemesis": rng.random() < 0.5,
         "requests": rng.choice([60, 120]),
     }
 

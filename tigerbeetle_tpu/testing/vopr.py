@@ -27,6 +27,7 @@ from tigerbeetle_tpu.state_machine import CpuStateMachine
 from tigerbeetle_tpu.testing.cluster import Cluster, PacketOptions
 from tigerbeetle_tpu.testing.harness import account, ids_bytes, pack, transfer
 from tigerbeetle_tpu.vsr.multi import VsrReplica
+from tigerbeetle_tpu.vsr.wire import VsrOperation
 
 
 class Workload:
@@ -240,6 +241,7 @@ class Vopr:
                  corruption_probability: float = 0.0,
                  upgrade_nemesis: bool = False,
                  queries: bool = False,
+                 reconfigure_nemesis: bool = False,
                  state_machine_factory=None) -> None:
         self.seed = seed
         self.rng = np.random.default_rng(seed + 1)
@@ -254,6 +256,7 @@ class Vopr:
         self.crash_probability = crash_probability
         self.corruption_probability = corruption_probability
         self.upgrade_nemesis = upgrade_nemesis
+        self.reconfigure_nemesis = reconfigure_nemesis and standby_count > 0
         self.atlas = FaultAtlas(seed + 3, replica_count)
         self.crashed: set[int] = set()
         self.restart_check_skipped = False
@@ -278,9 +281,24 @@ class Vopr:
                     self._audit(client, *pending_audit)
                     pending_audit = None
                 if sent < self.requests:
-                    operation, body, must_succeed = self.workload.next_request()
-                    client.request(operation, body)
-                    pending_audit = (operation, must_succeed)
+                    reconf = (
+                        self._propose_reconfigure()
+                        if self.reconfigure_nemesis
+                        and self.rng.random() < 0.04
+                        else None
+                    )
+                    if reconf is not None:
+                        # Membership change rides the normal request
+                        # path; a stale-epoch rejection is a legal
+                        # outcome under concurrent proposals.
+                        client.request(VsrOperation.reconfigure, reconf)
+                        pending_audit = (VsrOperation.reconfigure, False)
+                    else:
+                        operation, body, must_succeed = (
+                            self.workload.next_request()
+                        )
+                        client.request(operation, body)
+                        pending_audit = (operation, must_succeed)
                     sent += 1
             c.step()
         if pending_audit is not None:
@@ -354,6 +372,22 @@ class Vopr:
             # retransmissions by replaying the stored reply, so even
             # `exists` would signal a double execution.
             assert len(results) == 0, (operation, results[:6])
+
+    def _propose_reconfigure(self) -> bytes | None:
+        """Propose swapping a random active slot with a random standby
+        (epoch + 1 over the freshest known membership) — standby
+        promotion under the full nemesis suite.  reference:
+        src/vsr.zig:273-311 (reconfiguration epochs)."""
+        c = self.cluster
+        total = c.replica_count + c.standby_count
+        best = max(c.replicas, key=lambda r: r.epoch)
+        members = list(best.members) if best.members else list(range(total))
+        if len(members) != total:
+            return None
+        a = int(self.rng.integers(c.replica_count))
+        s = int(self.rng.integers(c.replica_count, total))
+        members[a], members[s] = members[s], members[a]
+        return VsrReplica.encode_reconfigure(best.epoch + 1, members)
 
     # -- nemesis --
 

@@ -256,12 +256,12 @@ class VsrReplica(Replica):
         return self.total_count
 
     def _apply_membership(self, members: list[int]) -> None:
-        self.members = list(members)
-        slot = self.members.index(self.process_index)
+        members = list(members)
+        slot = members.index(self.process_index)
         self.replica = slot
         self.standby = slot >= self.replica_count
         if hasattr(self.bus, "set_slot_map"):
-            self.bus.set_slot_map(self.members)
+            self.bus.set_slot_map(members)
         # Clock samples are slot-keyed; restart sampling under the new
         # identity (commits gate on resynchronization, briefly).
         self.clock = Clock(slot, self.replica_count)
@@ -434,10 +434,11 @@ class VsrReplica(Replica):
 
     def _send_heartbeat(self) -> None:
         self._last_ping_sent = self._ticks
-        # Body: committed membership advertisement (see _on_commit).
+        # Body: freshest ADOPTED membership advertisement (see
+        # _on_commit — committed epoch moves only via the op stream).
         body = (
-            self.encode_reconfigure(self.epoch, self.members)
-            if self.epoch
+            self.encode_reconfigure(self.epoch_adopted, self.members_adopted)
+            if self.epoch_adopted
             else b""
         )
         h = wire.make_header(
@@ -1046,25 +1047,29 @@ class VsrReplica(Replica):
         self.bus.send(self.primary_index(), ok, b"")
 
     def _on_commit(self, header: np.ndarray, body: bytes) -> None:
-        if int(header["view"]) < self.view or self.status != "normal":
-            return
-        # Heartbeats advertise committed membership: a process that
-        # crashed before a reconfigure committed re-learns its role
-        # here (epoch is monotonic committed state, so adopting a
-        # NEWER one out-of-band is safe; the replicated op later
-        # replays idempotently).  Without this the stale process is
+        # Heartbeats advertise the freshest adopted membership: a
+        # process that crashed before a reconfigure committed
+        # re-learns the ROLE it fills here (without this it is
         # unreachable — its repair requests carry the old slot, so
-        # responses route to whoever fills that slot now.
+        # responses route to whoever fills that slot now).  Adoption
+        # runs BEFORE the status/view gate: a restarted process stuck
+        # in view_change under a superseded identity would otherwise
+        # drop the very advertisement it needs — its DVCs then came
+        # from a slot someone else fills, replies routed to the new
+        # holder, and it never rejoined (soak seed 420704875).  Only
+        # the adopted identity moves; the committed epoch/members
+        # advance exclusively through the replicated op so
+        # reconfigure replies stay deterministic across replicas.
         if body:
             decoded = self.decode_reconfigure(body)
             if decoded is not None:
                 epoch, members = decoded
-                if epoch > self.epoch and sorted(members) == list(
+                if epoch > self.epoch_adopted and sorted(members) == list(
                     range(self.total_count)
                 ):
-                    self.epoch = epoch
-                    self._reconfig_history[epoch] = list(members)
-                    self._apply_membership(members)
+                    self._adopt_roles(epoch, members)
+        if int(header["view"]) < self.view or self.status != "normal":
+            return
         if int(header["view"]) > self.view:
             self._enter_view(int(header["view"]))
         self._last_primary_seen = self._ticks
@@ -1808,6 +1813,12 @@ class VsrReplica(Replica):
             checkpoint_size=len(blob),
             checkpoint_checksum=wire.checksum(blob),
             view=self.view,
+            # The shipped blob restored the source's committed
+            # membership (_restore_snapshot); carrying the OLD fields
+            # forward here would resurrect the pre-sync epoch on
+            # restart.
+            epoch=self.epoch,
+            members=self.members,
         )
         self.checkpoint_op = checkpoint_op
         self.commit_min = checkpoint_op

@@ -100,8 +100,21 @@ class Replica:
         # the protocol slot (`self.replica`) the process currently
         # fills.  `members[slot] = process`; epoch bumps per change.
         self.process_index = replica
+        # COMMITTED epoch/membership: advanced only by executing the
+        # replicated reconfigure op (or restoring a checkpoint), so
+        # reconfigure replies are a pure function of the op stream.
         self.epoch = 0
         self.members: list[int] | None = None
+        # ADOPTED epoch/roles: may run AHEAD of committed via the
+        # heartbeat advertisement (a crashed process must re-learn the
+        # slot it fills to be reachable at all), but never influences
+        # the committed validation — conflating them made a replica
+        # that heartbeat-adopted epoch N reply "stale" to the
+        # intermediate epochs it later replayed, while live replicas
+        # had replied "ok": reply divergence (VOPR reconfigure
+        # nemesis, seed 44).
+        self.epoch_adopted = 0
+        self.members_adopted: list[int] | None = None
         # epoch -> members actually applied (replay idempotency).
         self._reconfig_history: dict[int, list[int]] = {}
 
@@ -167,12 +180,10 @@ class Replica:
             self.cluster = self.superblock.cluster
             self.journal.cluster = self.cluster
         if int(sb["member_count"]):
-            self.epoch = int(sb["epoch"])
             members = list(
                 bytes(sb["members"])[: int(sb["member_count"])]
             )
-            self._reconfig_history[self.epoch] = list(members)
-            self._apply_membership(members)
+            self._install_committed(int(sb["epoch"]), members)
         self.view = int(sb["view"])
         self.checkpoint_op = int(sb["commit_min"])
 
@@ -488,27 +499,46 @@ class Replica:
             return (2).to_bytes(4, "little")
         epoch, members = decoded
         if self._reconfig_history.get(epoch) == members:
-            # Idempotent replay: a process that adopted membership
-            # out-of-band (heartbeat advertisement) replays the op with
-            # the same success code every live replica recorded.  (A
-            # process crashed across SEVERAL reconfigures learns only
-            # the latest via heartbeats; replies for the intermediate
-            # ops would need the full history — acceptable residual:
-            # clients retry reconfigure against the session reply only
-            # within one epoch.)
+            # Idempotent replay: a replica whose committed install of
+            # this epoch came from a checkpoint (open/state sync)
+            # rather than live execution replays the op with the same
+            # success code every live replica recorded.  (History
+            # covers only the restored epoch, not intermediates — an
+            # acceptable residual: clients retry reconfigure against
+            # the session reply only within one epoch.)
             return (0).to_bytes(4, "little")
         code = self.validate_reconfigure(epoch, members, view)
         if code == 0:
-            self.epoch = epoch
-            self._reconfig_history[epoch] = list(members)
-            self._apply_membership(members)
+            self._install_committed(epoch, members)
         return code.to_bytes(4, "little")
+
+    def _install_committed(self, epoch: int, members: list[int]) -> None:
+        """Install a committed membership: the single sequence the
+        op-stream execution, superblock restore, and state-sync
+        restore must all share — divergence between these paths is
+        exactly the reply-nondeterminism class of seeds 44 and
+        300661417."""
+        self.epoch = epoch
+        self.members = list(members)
+        self._reconfig_history[epoch] = list(members)
+        self._adopt_roles(epoch, members)
+
+    def _adopt_roles(self, epoch: int, members: list[int]) -> None:
+        """Adopt the runtime identity for `members` unless a NEWER
+        membership was already adopted out-of-band (heartbeat): roles
+        follow the freshest known epoch, while self.epoch/self.members
+        stay the committed-prefix state that deterministic reconfigure
+        replies validate against."""
+        if epoch < self.epoch_adopted:
+            return
+        self.epoch_adopted = epoch
+        self.members_adopted = list(members)
+        self._apply_membership(members)
 
     def _apply_membership(self, members: list[int]) -> None:
         """Adopt the slot this process fills under `members`
         (single-replica base: bookkeeping only; multi.py re-derives
         roles, ring, and clock)."""
-        self.members = members
         self.replica = members.index(self.process_index)
 
     def _compact_beat(self) -> None:
@@ -701,6 +731,14 @@ class Replica:
                 "session_meta": meta,
                 "reply_headers": b"".join(headers),
                 "next_reply_slot": self._next_reply_slot,
+                # Committed membership is part of the checkpoint state:
+                # a state-synced replica jumps commit_min past the
+                # reconfigure ops themselves, and without the epoch it
+                # would reject every later epoch as stale — diverging
+                # reconfigure replies cluster-wide (VOPR reconfigure
+                # nemesis, seed 300661417).
+                "epoch": self.epoch,
+                "members": bytes(self.members or []),
             }
         )
 
@@ -726,6 +764,10 @@ class Replica:
                 slot=int(state["session_meta"][i, 2]),
             )
         self._next_reply_slot = state["next_reply_slot"]
+        epoch = int(state.get("epoch", 0))
+        members = list(state.get("members", b""))
+        if epoch and members:
+            self._install_committed(epoch, members)
 
     def _write_grid(self, offset: int, blob: bytes) -> None:
         self.storage.write(offset, blob.ljust(_sectors(len(blob)), b"\x00"))
