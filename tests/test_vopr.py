@@ -89,6 +89,16 @@ def test_vopr_heavy_faults():
     Vopr(31337, requests=50, packet_loss=0.05, crash_probability=0.02).run()
 
 
+@pytest.mark.parametrize("seed", [9, 310])
+def test_vopr_partition_nemesis(seed):
+    """Hard partitions (a process cut off but RUNNING — state intact,
+    clock advancing, rejoining live-but-stale) layered over crashes,
+    corruption, queries, and reconfiguration."""
+    Vopr(seed, requests=80, partition_probability=0.02, queries=True,
+         standby_count=1, reconfigure_nemesis=True,
+         corruption_probability=0.005).run()
+
+
 def test_vopr_primary_scrub_repair_seed():
     """Seed 99911308: a latent WAL fault on the PRIMARY for a
     current-view committed op — scrub repair replies were dropped by

@@ -34,6 +34,7 @@ def _vopr_case(rng: random.Random) -> dict:
         "replica_count": rng.choice([3, 3, 3, 5]),
         "standby_count": rng.choice([0, 0, 1]),
         "reconfigure_nemesis": rng.random() < 0.5,
+        "partition_probability": rng.choice([0.0, 0.01, 0.02]),
         "requests": rng.choice([60, 120]),
     }
 

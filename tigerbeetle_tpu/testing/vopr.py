@@ -242,6 +242,7 @@ class Vopr:
                  upgrade_nemesis: bool = False,
                  queries: bool = False,
                  reconfigure_nemesis: bool = False,
+                 partition_probability: float = 0.0,
                  state_machine_factory=None) -> None:
         self.seed = seed
         self.rng = np.random.default_rng(seed + 1)
@@ -257,6 +258,12 @@ class Vopr:
         self.corruption_probability = corruption_probability
         self.upgrade_nemesis = upgrade_nemesis
         self.reconfigure_nemesis = reconfigure_nemesis and standby_count > 0
+        # Opt-in (0.0 keeps pinned seeds' RNG streams byte-identical):
+        # unlike a crash, a partitioned process keeps RUNNING — state
+        # intact, clock advancing — and rejoins live-but-stale,
+        # exercising view-change rejoin paths crashes cannot.
+        self.partition_probability = partition_probability
+        self._partitioned: set[int] = set()
         self.atlas = FaultAtlas(seed + 3, replica_count)
         self.crashed: set[int] = set()
         self.restart_check_skipped = False
@@ -405,6 +412,17 @@ class Vopr:
             self._corrupt_random_sector()
         if self.upgrade_nemesis:
             self._upgrade_tick()
+        if self.partition_probability:
+            if self._partitioned:
+                # Heal with ~4%/tick so isolation windows are short.
+                if self.rng.random() < 0.04:
+                    c.network.heal(*self._partitioned)
+                    self._partitioned.clear()
+            elif self.rng.random() < self.partition_probability:
+                i = int(self.rng.integers(len(c.replicas)))
+                if i not in self.crashed:
+                    c.network.partition(i)
+                    self._partitioned.add(i)
         if self.crashed:
             # Restart with probability ~5%/tick so outages are short.
             if self.rng.random() < 0.05:
