@@ -74,6 +74,62 @@ def test_vopr_reconfigure_superseded_identity_seed():
          reconfigure_nemesis=True).run()
 
 
+def test_vopr_membership_gossip_seed():
+    """Soak seed 421977104 (upgrade + reconfigure): the only process
+    holding a committed-but-locally-unreplayed epoch sat in
+    view_change as a standby — heartbeats (primary -> normal peers
+    only) could never spread it, every process answered to a
+    different slot mapping, and no view change could elect anyone.
+    The adopted-membership advertisement now also rides pings/pongs,
+    which flow between ALL processes in ANY status."""
+    Vopr(421977104, requests=60, packet_loss=0.04996161937441321,
+         crash_probability=0.03123750376134976,
+         corruption_probability=0.005, upgrade_nemesis=True,
+         standby_count=1, reconfigure_nemesis=True).run()
+
+
+def test_vopr_uncovered_tail_eviction_seed():
+    """Soak seed 460103075 (reconfigure): a freshly-restarted primary
+    with an adopted-but-unapplied tail (commit_max still 0, repairs
+    pending) requeued only the prepares it HELD; a client whose
+    register sat in the repair holes was evicted.  The eviction gate
+    now queues while the pipeline does not cover the whole
+    uncommitted range."""
+    Vopr(460103075, requests=120, packet_loss=0.07999176030219339,
+         crash_probability=0.022697472687653826,
+         corruption_probability=0.001, standby_count=1,
+         reconfigure_nemesis=True).run()
+
+
+def test_vopr_ring_wrap_headroom_seed():
+    """Soak seed 202019721 (upgrade + reconfigure + partition): with
+    commits stalled, every view change cleared the pipeline and let
+    the new primary accept another pipeline's worth of requests — op
+    ran 67 past the stuck commit point and the WAL ring wrap
+    DESTROYED the only copies of two uncommitted ops cluster-wide,
+    wedging repair forever.  Prepares now stop at
+    checkpoint_op + journal_slot_count (_prepare_headroom)."""
+    Vopr(202019721, requests=120, packet_loss=0.020119223364905816,
+         crash_probability=0.011281813826024015,
+         corruption_probability=0.005, upgrade_nemesis=True,
+         standby_count=1, reconfigure_nemesis=True,
+         partition_probability=0.02).run()
+
+
+def test_vopr_vouch_chain_hole_seed():
+    """Soak seed 157503236 (upgrade + partition): a standby held every
+    prepare below the commit frontier EXCEPT a mid-suffix hole; the
+    vouch chain walk broke at the hole without pinning it, and since
+    commits were gated BELOW the hole, _advance_commit never reached
+    it to request repair — the standby wedged at its vouch gate
+    forever.  _extend_vouches_down now pins the exact canonical
+    checksum when the walk cannot cross a slot."""
+    Vopr(157503236, requests=60, packet_loss=0.0035477406232641505,
+         crash_probability=0.027937796807999706,
+         corruption_probability=0.001, upgrade_nemesis=True,
+         standby_count=1, partition_probability=0.01).run()
+
+
 @pytest.mark.parametrize("seed", [5, 812])
 def test_vopr_query_workload(seed):
     """The v2 workload profile: lookup_transfers, AccountFilter scans

@@ -20,12 +20,18 @@ from tigerbeetle_tpu import constants as cfg
 
 VERSION = "0.1.0"
 
+# Reference Start.cache_accounts/cache_transfers default analog: one
+# value, used by the flag spec, the factory defaults, and the --cpu
+# warning alike.
+CACHE_DEFAULT = 1 << 16
+
 USAGE = """usage: tigerbeetle-tpu <command> [flags]
 
 commands:
   format     --cluster=<int> --replica=<i> --replica-count=<n> <path>
   start      --addresses=<host:port,...> --replica=<i> [--cpu]
              [--aof=<path>] [--trace=<path>] [--standby-count=<n>]
+             [--cache-accounts=<n>] [--cache-transfers=<n>]
              <path>...
   version
   repl       --addresses=<host:port> [--cluster=<int>] [--command=<stmts>]
@@ -35,14 +41,30 @@ commands:
 """
 
 
-def _sm_factory(use_cpu: bool):
+def _sm_factory(use_cpu: bool, cache_accounts: int = CACHE_DEFAULT,
+                cache_transfers: int = CACHE_DEFAULT):
+    """Capacities follow the reference's static-allocation design:
+    operator-configured cache sizes pre-size every large buffer
+    (reference: src/tigerbeetle/cli.zig Start.cache_accounts /
+    cache_transfers)."""
     if use_cpu:
         from tigerbeetle_tpu.state_machine import CpuStateMachine
 
+        if (cache_accounts, cache_transfers) != (CACHE_DEFAULT, CACHE_DEFAULT):
+            print(
+                "warning: --cache-accounts/--cache-transfers have no "
+                "effect with --cpu (the CPU engine is dict-backed and "
+                "unbounded)",
+                file=sys.stderr,
+            )
         return lambda: CpuStateMachine(cfg.PRODUCTION)
     from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
 
-    return lambda: TpuStateMachine(cfg.PRODUCTION)
+    return lambda: TpuStateMachine(
+        cfg.PRODUCTION,
+        account_capacity=cache_accounts,
+        transfer_capacity=cache_transfers,
+    )
 
 
 def cmd_format(args: list[str]) -> None:
@@ -65,7 +87,8 @@ def cmd_start(args: list[str]) -> None:
     opts, paths = flags.parse(
         args,
         {"addresses": None, "replica": 0, "cluster": "", "cpu": False,
-         "aof": "", "trace": "", "standby_count": 0},
+         "aof": "", "trace": "", "standby_count": 0,
+         "cache_accounts": CACHE_DEFAULT, "cache_transfers": CACHE_DEFAULT},
     )
     if len(paths) != 1:
         flags.fatal("start requires exactly one data-file path")
@@ -83,7 +106,10 @@ def cmd_start(args: list[str]) -> None:
     server = ReplicaServer(
         paths[0], cluster=cluster,
         addresses=opts["addresses"].split(","), replica_index=opts["replica"],
-        state_machine_factory=_sm_factory(opts["cpu"]),
+        state_machine_factory=_sm_factory(
+            opts["cpu"], cache_accounts=opts["cache_accounts"],
+            cache_transfers=opts["cache_transfers"],
+        ),
         aof_path=opts["aof"] or None,
         trace_path=opts["trace"] or None,
         standby_count=opts["standby_count"],

@@ -381,6 +381,20 @@ class VsrReplica(Replica):
             if r != self.replica and r not in entry.ok_replicas:
                 self.bus.send(r, entry.header, entry.body)
 
+    def _prepare_headroom(self) -> bool:
+        """True while the NEXT prepare's ring slot would not overwrite
+        an op above the checkpoint.  Replay and repair need every op in
+        (checkpoint_op, op]; without this bound a commit stall plus
+        repeated view changes (each clears the pipeline, letting a new
+        primary accept another pipeline's worth of requests) pushed op
+        67 past the stuck commit point and the ring wrap destroyed the
+        only copies of two uncommitted ops cluster-wide (VOPR seed
+        202019721)."""
+        return (
+            self.op + 1
+            <= self.checkpoint_op + self.config.journal_slot_count
+        )
+
     def _maybe_propose_upgrade(self) -> None:
         """Replicate Operation.upgrade once EVERY replica advertises a
         release newer than the one we run (reference: the primary
@@ -399,6 +413,8 @@ class VsrReplica(Replica):
             return
         if self._anchor_pending:
             return  # canonical head checksum still being repaired
+        if not self._prepare_headroom():
+            return
         self._upgrade_proposed = True
         req = wire.make_header(
             command=Command.request, operation=VsrOperation.upgrade,
@@ -417,6 +433,8 @@ class VsrReplica(Replica):
             return  # same clock gate as client requests
         if self._anchor_pending:
             return  # canonical head checksum still being repaired
+        if not self._prepare_headroom():
+            return
         self._advance_prepare_timestamp()
         if not self.sm.pulse_needed():
             return
@@ -436,11 +454,7 @@ class VsrReplica(Replica):
         self._last_ping_sent = self._ticks
         # Body: freshest ADOPTED membership advertisement (see
         # _on_commit — committed epoch moves only via the op stream).
-        body = (
-            self.encode_reconfigure(self.epoch_adopted, self.members_adopted)
-            if self.epoch_adopted
-            else b""
-        )
+        body = self._membership_advert()
         h = wire.make_header(
             command=Command.commit, cluster=self.cluster, view=self.view,
             replica=self.replica, commit=self.commit_min,
@@ -517,6 +531,7 @@ class VsrReplica(Replica):
             len(self.pipeline) >= self.config.pipeline_prepare_queue_max
             or (self.replica_count > 1 and not self.clock.synchronized)
             or self._anchor_pending
+            or not self._prepare_headroom()
         ):
             # Pipeline full, no timestamps yet because the cluster
             # clock window doesn't exist (reference: src/vsr/replica.zig
@@ -593,6 +608,19 @@ class VsrReplica(Replica):
                     int(e.header["operation"]) == int(VsrOperation.register)
                     and wire.u128(e.header, "client") == client
                     for e in self.pipeline.values()
+                )
+                # An adopted-but-unapplied tail not yet covered by the
+                # pipeline: a fresh primary with commit_max still 0
+                # and repairs pending requeued only the prepares it
+                # HELD — the register can sit in the holes (VOPR
+                # reconfigure seed 460103075).  Exact membership, not
+                # a count: committed entries linger in the pipeline
+                # until lazily purged and would mask a hole.  Under
+                # steady load the range is <= pipeline depth and fully
+                # covered, so this defers nothing then.
+                or any(
+                    o not in self.pipeline
+                    for o in range(self.commit_min + 1, self.op + 1)
                 )
             ):
                 # Still re-committing, or holding a recovered/claimed
@@ -815,6 +843,7 @@ class VsrReplica(Replica):
         requeue: list[tuple[np.ndarray, bytes]] = []
         while self.request_queue and (
             len(self.pipeline) < self.config.pipeline_prepare_queue_max
+            and self._prepare_headroom()
         ):
             h, b = self._pop_request()
             # Queued requests re-run the at-most-once gate: their
@@ -1060,14 +1089,7 @@ class VsrReplica(Replica):
         # the adopted identity moves; the committed epoch/members
         # advance exclusively through the replicated op so
         # reconfigure replies stay deterministic across replicas.
-        if body:
-            decoded = self.decode_reconfigure(body)
-            if decoded is not None:
-                epoch, members = decoded
-                if epoch > self.epoch_adopted and sorted(members) == list(
-                    range(self.total_count)
-                ):
-                    self._adopt_roles(epoch, members)
+        self._maybe_adopt_advert(body)
         if int(header["view"]) < self.view or self.status != "normal":
             return
         if int(header["view"]) > self.view:
@@ -1094,7 +1116,15 @@ class VsrReplica(Replica):
                     or int(mem["command"]) != int(Command.prepare)
                     or wire.u128(mem, "checksum") != self._vouched[k]
                 ):
-                    break  # cannot derive through missing/divergent slot
+                    # Cannot derive through a missing/divergent slot —
+                    # and nothing else repairs it when commits are
+                    # already gated BELOW the hole (_advance_commit
+                    # never reaches it): a standby with a mid-suffix
+                    # hole wedged at the vouch gate forever (soak seed
+                    # 157503236).  Pin the exact canonical checksum.
+                    self._repair_wanted.setdefault(k, self._vouched[k])
+                    self._send_repair_requests()
+                    break
                 self._vouched[k - 1] = wire.u128(mem, "parent")
                 k -= 1
 
@@ -1203,6 +1233,25 @@ class VsrReplica(Replica):
             self._repair_wanted.setdefault(op, 0)
         self._send_repair_requests()
 
+    def _membership_advert(self) -> bytes:
+        return (
+            self.encode_reconfigure(self.epoch_adopted, self.members_adopted)
+            if self.epoch_adopted
+            else b""
+        )
+
+    def _maybe_adopt_advert(self, body: bytes) -> None:
+        if not body:
+            return
+        decoded = self.decode_reconfigure(body)
+        if decoded is None:
+            return
+        epoch, members = decoded
+        if epoch > self.epoch_adopted and sorted(members) == list(
+            range(self.total_count)
+        ):
+            self._adopt_roles(epoch, members)
+
     def _send_clock_pings(self) -> None:
         """Sample every peer's wall clock: ping carries our monotonic
         send time m0; the pong echoes it alongside the peer's wall
@@ -1213,18 +1262,31 @@ class VsrReplica(Replica):
             replica=self.replica, timestamp=self.monotonic,
             release=max(self.releases_available),
         )
-        wire.finalize_header(ping, b"")
+        # Pings gossip the freshest adopted membership: heartbeats
+        # only flow primary->normal-status peers, so a process whose
+        # adopted epoch ran ahead and then got isolated in
+        # view_change-as-standby could be the ONLY holder of a
+        # committed membership the rest of the cluster needs to even
+        # agree who the next primary is (soak seed 421977104 wedged
+        # exactly so).  Pings flow between ALL processes in ANY
+        # status.
+        adv = self._membership_advert()
+        wire.finalize_header(ping, adv)
         # Standbys are pinged too: their pong advertises their release,
         # so an upgrade never commits while the hot spare would be left
         # behind unable to execute the new release's prepares.
         for r in range(self.total_count):
             if r != self.replica:
-                self.bus.send(r, ping, b"")
+                self.bus.send(r, ping, adv)
 
     def _on_ping(self, header: np.ndarray, body: bytes) -> None:
         # Echo m0 in `timestamp`; our wall clock rides in `op` (clamped
         # at 0 — the wire field is u64 and a skewed simulated clock can
         # sit before the epoch at startup).
+        # Adopt BEFORE learning the release: adoption resets the
+        # slot-keyed peer_release map, which would wipe the sample
+        # this same message carries.
+        self._maybe_adopt_advert(body)
         self._learn_peer_release(header)
         pong = wire.make_header(
             command=Command.pong, cluster=self.cluster, view=self.view,
@@ -1232,8 +1294,9 @@ class VsrReplica(Replica):
             op=max(0, self.realtime),
             release=max(self.releases_available),
         )
-        wire.finalize_header(pong, b"")
-        self.bus.send(int(header["replica"]), pong, b"")
+        adv = self._membership_advert()
+        wire.finalize_header(pong, adv)
+        self.bus.send(int(header["replica"]), pong, adv)
 
     def _learn_peer_release(self, header: np.ndarray) -> None:
         rel = int(header["release"])
@@ -1242,6 +1305,7 @@ class VsrReplica(Replica):
             self.peer_release[peer] = max(self.peer_release.get(peer, 0), rel)
 
     def _on_pong(self, header: np.ndarray, body: bytes) -> None:
+        self._maybe_adopt_advert(body)
         self._learn_peer_release(header)
         if int(header["replica"]) >= self.replica_count:
             return  # standby pongs advertise releases, not clock samples
