@@ -1027,6 +1027,15 @@ class VsrReplica(Replica):
         parent_checksum is stale then."""
         while not self._anchor_pending and self.op + 1 in self._stash:
             h, b = self._stash.pop(self.op + 1)
+            if int(h["view"]) != self.view:
+                # Stashed before a view change: a later view may have
+                # replaced this op with a sibling CHAINING FROM THE
+                # SAME PARENT, which the linkage check cannot tell
+                # apart — draining one committed a dead view-2 copy
+                # where peers committed its view-3 replacement (soak
+                # seed 323928758).  Superseded candidates re-enter
+                # only via checksum-pinned repair.
+                continue
             if wire.u128(h, "parent") != self.parent_checksum:
                 break
             self._accept_prepare(h, b)
@@ -2090,6 +2099,7 @@ class VsrReplica(Replica):
                 continue
             by_op[op] = h
         vh_log_view = int(self.superblock.working["vh_log_view"])
+        vh_top = 0
         for raw in self.superblock.view_headers():
             h = wire.header_from_bytes(raw)
             if not wire.verify_header(h):
@@ -2100,6 +2110,40 @@ class VsrReplica(Replica):
             cur = by_op.get(op)
             if cur is None or int(cur["view"]) < vh_log_view:
                 by_op[op] = h
+            vh_top = max(vh_top, op)
+        # Chain-consistency above the vouched canonical suffix: an
+        # install truncates the old tail only IN MEMORY — the ring
+        # still physically holds it, and a crash-restart resurrects it
+        # into the recovered head.  A dead leftover both PREDATING the
+        # install (view < vh_log_view) and NOT chaining from the
+        # canonical would ship a MIXED chain; the receiving merge's
+        # sanitize resolves the contradiction by dropping the TRUE
+        # canonical op below it, and the dead suffix gets installed
+        # and committed — replica divergence (soak seed 323928758).
+        if vh_top and vh_top in by_op:
+            expect = wire.u128(by_op[vh_top], "checksum")
+            prev = vh_top
+            for o in sorted(k for k in by_op if k > vh_top):
+                h = by_op[o]
+                if o != prev + 1:
+                    expect = None  # gap: linkage unverifiable above it
+                prev = o
+                verified = expect is not None and (
+                    wire.u128(h, "parent") == expect
+                )
+                if verified or int(h["view"]) >= vh_log_view:
+                    # Chains from the canonical, or postdates the
+                    # install (the new view's own prepare): keep, and
+                    # it defines the verified frontier upward.
+                    expect = wire.u128(h, "checksum")
+                    continue
+                # Predates the install and cannot be positively linked
+                # (contradicts the frontier, or sits above a gap that
+                # makes linkage unverifiable): dead leftover — do NOT
+                # stop at the first one, later ring entries above a
+                # gap are equally suspect.
+                del by_op[o]
+                expect = None
         return [by_op[op].tobytes() for op in sorted(by_op)]
 
     def _on_do_view_change(self, header: np.ndarray, body: bytes) -> None:
