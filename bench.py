@@ -918,6 +918,10 @@ def _run_memory_config(name, gen) -> dict:
     sm.stat_host_semantic_events = 0
     sm.stat_hot_tail_batches = 0
     sm.stat_slow_tail_batches = 0
+    sm.stat_wave_batches = 0
+    sm.stat_wave_steps = 0
+    sm.stat_wave_events = 0
+    sm.stat_wave_parallel_events = 0
     if sm.engine == "device":
         sm._dev.stat_semantic_events = 0
     failed = 0
@@ -962,6 +966,20 @@ def _run_memory_config(name, gen) -> dict:
     if sm.stat_hot_tail_batches or sm.stat_slow_tail_batches:
         out["hot_tail_batches"] = sm.stat_hot_tail_batches
         out["slow_tail_batches"] = sm.stat_slow_tail_batches
+    # Conflict-aware wave execution (waves.py): how many batches the
+    # JAX exact path ran as wave plans, the device-step equivalents
+    # per batch (1 per wave + length per conflict group), and the
+    # share of events that executed in parallel waves.
+    if sm.stat_wave_batches:
+        out["wave_batches"] = sm.stat_wave_batches
+        out["waves_per_batch"] = round(
+            sm.stat_wave_steps / sm.stat_wave_batches, 2
+        )
+        out["wave_parallelism_pct"] = round(
+            100.0 * sm.stat_wave_parallel_events
+            / max(1, sm.stat_wave_events),
+            1,
+        )
     del sm, h
     return out
 
@@ -1005,6 +1023,113 @@ def _run_parity(name, gen) -> str:
     return mismatch or ("ok(full)" if full else "ok(truncated)")
 
 
+def run_waves_compare() -> dict:
+    """Conflict-aware wave execution vs the B-step scan: same session,
+    same JAX backend, identical op streams.
+
+    Each bench config's stream runs twice through the JAX exact path
+    with the native engine disabled — TB_WAVES=exact (wave scheduler
+    with its normal profitability/admission gates) and TB_WAVES=scan
+    (identical routing, pure sequential lax.scan) — so the comparison
+    isolates the kernel SHAPE (one step per wave vs one step per
+    event) from link tenancy and host bookkeeping, which are shared.
+    A config whose plans the scheduler declines (e.g. linked, where
+    chains serialize nearly every event) honestly shows speedup ~1 and
+    no waves_per_batch.  Replies and final wire state must be bit-identical
+    (graded under `parity`); `speedup` is the wave path's throughput
+    over the scan's on this hour's backend, and `waves_per_batch` the
+    device-step-equivalent collapse the partitioner achieved."""
+    waves_n = int(os.environ.get("BENCH_WAVES_N", 16_380 if SMALL else 65_520))
+    out = {"events_per_config": waves_n}
+    saved = os.environ.get("TB_WAVES")
+    try:
+        for name in ("simple", "linked", "two_phase", "zipf", "mixed"):
+            setup, timed, sizing = CONFIGS[name](waves_n)
+            n_timed = n_events_of(timed)
+            runs = {}
+            for mode, env_val in (("wave", "exact"), ("scan", "scan")):
+                os.environ["TB_WAVES"] = env_val
+                # NOT _make_tpu: a TB_ENGINE=device override would
+                # silently put BOTH arms on the device engine (which
+                # TB_WAVES does not bypass) and grade a meaningless
+                # speedup — this comparison is host-engine by design.
+                from tigerbeetle_tpu.state_machine.tpu import (
+                    TpuStateMachine,
+                )
+
+                sm = TpuStateMachine(
+                    account_capacity=sizing[0],
+                    transfer_capacity=sizing[1],
+                    engine="host",
+                )
+                sm._native = None  # isolate the JAX exact path
+                if mode == "wave":
+                    # Untimed compile of every (batch, segment) bucket
+                    # pair: the setup warmup only hits simple-shaped
+                    # full-batch waves, and e.g. two_phase's ~B/2-event
+                    # waves (bucket 4096) would otherwise first-compile
+                    # inside the timed window, deflating the speedup.
+                    from tigerbeetle_tpu.state_machine import waves
+
+                    waves.prewarm(sizing[0])
+                _, _, h = replay(sm, setup)
+                sm.stat_wave_batches = 0
+                sm.stat_wave_steps = 0
+                sm.stat_wave_events = 0
+                sm.stat_wave_parallel_events = 0
+                t0 = time.perf_counter()
+                futs = [(op, h.submit_async(op, body)) for op, body in timed]
+                replies = [f.result() for _op, f in futs]
+                elapsed = time.perf_counter() - t0
+                digest = state_digest(
+                    h, config_account_ids(name),
+                    np.arange(TID0, TID0 + waves_n, dtype=np.uint64),
+                )
+                runs[mode] = {
+                    "elapsed": elapsed,
+                    "replies": replies,
+                    "digest": digest,
+                    "wave_batches": sm.stat_wave_batches,
+                    "wave_steps": sm.stat_wave_steps,
+                    "wave_events": sm.stat_wave_events,
+                    "wave_parallel": sm.stat_wave_parallel_events,
+                }
+                del sm, h
+            parity = "ok"
+            for i, (a, b) in enumerate(
+                zip(runs["wave"]["replies"], runs["scan"]["replies"])
+            ):
+                if a != b:
+                    parity = f"reply[{i}] differs"
+                    break
+            if parity == "ok" and (
+                runs["wave"]["digest"] != runs["scan"]["digest"]
+            ):
+                parity = "state digest differs"
+            w, s = runs["wave"], runs["scan"]
+            row = {
+                "events": n_timed,
+                "scan_events_per_sec": round(n_timed / s["elapsed"], 1),
+                "wave_events_per_sec": round(n_timed / w["elapsed"], 1),
+                "speedup": round(s["elapsed"] / w["elapsed"], 2),
+                "parity": parity,
+            }
+            if w["wave_batches"]:
+                row["waves_per_batch"] = round(
+                    w["wave_steps"] / w["wave_batches"], 2
+                )
+                row["wave_parallelism_pct"] = round(
+                    100.0 * w["wave_parallel"] / max(1, w["wave_events"]), 1
+                )
+            out[name] = row
+    finally:
+        if saved is None:
+            os.environ.pop("TB_WAVES", None)
+        else:
+            os.environ["TB_WAVES"] = saved
+    return out
+
+
 def run_memory_only(name: str) -> dict:
     """One in-memory config (+ its parity replay) for the
     --memory-only=NAME subprocess entry.  Parity rides along under
@@ -1045,7 +1170,8 @@ def main() -> None:
     # honest row and the graded JSON line still prints in time.
     t_run0 = time.time()
     budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 5400))
-    n_configs_left = [len(CONFIGS) + 2]  # memory configs + durable + replicated
+    # memory configs + waves compare + durable + replicated
+    n_configs_left = [len(CONFIGS) + 3]
 
     def next_timeout(cap_s: float) -> int | None:
         remaining = budget_s - (time.time() - t_run0)
@@ -1123,6 +1249,14 @@ def main() -> None:
             if not detail.startswith("ok"):
                 parity_ok = False
 
+    # Wave-vs-scan same-session comparison (waves.py): both paths on
+    # this hour's backend, bit-identical parity graded alongside.
+    t = next_timeout(per_config_cap)
+    waves_out = (
+        dict(_SKIP_ROW) if t is None
+        else run_isolated("--waves-only", timeout_s=t)
+    )
+
     for cname, flag in (("durable", "--durable-only"),
                         ("replicated", "--replicated-only")):
         t = next_timeout(per_config_cap)
@@ -1146,9 +1280,15 @@ def main() -> None:
         "unit": "transfers/s",
         "vs_baseline": simple.get("vs_baseline"),
         "configs": configs_out,
+        "waves": waves_out,
         "device_semantic_pct_overall": round(100.0 * dev_tot / max(1, tot), 1),
         "parity": parity_ok if PARITY else None,
     }
+    if PARITY and isinstance(waves_out, dict):
+        for row in waves_out.values():
+            if isinstance(row, dict) and row.get("parity", "ok") != "ok":
+                parity_ok = False
+                out["parity"] = False
     try:
         # The hour's measured downlink round trip (~105 ms quiet, ~1 s
         # contended on this shared tunnel) — context for the device-
@@ -1263,7 +1403,14 @@ def _device_alive(timeout_s: int | None = None) -> bool:
             # contended (experiments/README.md), and the graded
             # throughput tracks it — record the hour's link health
             # alongside the numbers it explains.
+            # "Alive" requires a NON-CPU backend: a vanished tunnel can
+            # leave PJRT discovery silently falling back to CpuDevice,
+            # and a responsive CPU must not count as a reachable
+            # accelerator (the device-authoritative configs' one-hot
+            # matmuls take hours there; r6 observed exactly this).
             "import time, jax, jax.numpy as jnp;"
+            "assert any(d.platform != 'cpu' for d in jax.devices()),"
+            " 'cpu-only backend';"
             "y = jax.jit(lambda a: a * 3 + 1)(jnp.zeros((256, 256)));"
             "jax.block_until_ready(y);"
             "t0 = time.perf_counter();"
@@ -1347,7 +1494,9 @@ if __name__ == "__main__":
     memory_only = [
         a.split("=", 1)[1] for a in sys.argv if a.startswith("--memory-only=")
     ]
-    if "--durable-only" in sys.argv:
+    if "--waves-only" in sys.argv:
+        print(json.dumps(_mark_device_fallback(run_waves_compare())))
+    elif "--durable-only" in sys.argv:
         print(json.dumps(_mark_device_fallback(run_durable(N_OTHER))))
     elif "--replicated-only" in sys.argv:
         print(json.dumps(_mark_device_fallback(run_replicated(N_OTHER))))

@@ -1,0 +1,491 @@
+"""Conflict-aware wave execution (state_machine/waves.py).
+
+Three layers:
+
+1. Partitioner unit tests: the topological-level scheduler's plans —
+   coverage, step bounds, independence inside each wave, chain runs in
+   exact scan segments.
+2. Wave-vs-scan differential fuzz: random batches mixing linked
+   chains, two-phase post/void of in-batch pendings, Zipf hot
+   accounts, balancing flags and clock jumps replay through the wave
+   path (TB_WAVES=1) and the pure-scan path (TB_WAVES=0), native
+   engine disabled on both; replies, balance tables, and
+   created-transfer records must be bit-identical.
+3. CI smoke benchmark: 10k events through both paths; fails if the
+   partitioner ever emits more device-step equivalents than events
+   (waves must never be WORSE than the scan) or if any reply/state
+   byte diverges — tier-1 catches scheduler regressions without the
+   TPU link.
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import resolve, waves
+from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+from tigerbeetle_tpu.testing.harness import (
+    SingleNodeHarness,
+    account,
+    pack,
+    transfer,
+)
+
+TF = types.TransferFlags
+AF = types.AccountFlags
+
+
+# ---------------------------------------------------------------------------
+# Partitioner.
+
+
+def _meta(
+    n,
+    flags=None,
+    dr_slot=None,
+    cr_slot=None,
+    dr_flags=None,
+    cr_flags=None,
+    id_group=None,
+    p_group=None,
+    p_tgt=None,
+    p_found=None,
+):
+    z32 = np.zeros(n, np.uint32)
+    return resolve.wave_dependency_metadata(
+        n,
+        z32 if flags is None else np.asarray(flags, np.uint32),
+        np.arange(n, dtype=np.int64) if dr_slot is None else np.asarray(dr_slot, np.int64),
+        np.arange(n, n + n, dtype=np.int64) if cr_slot is None else np.asarray(cr_slot, np.int64),
+        z32 if dr_flags is None else np.asarray(dr_flags, np.uint32),
+        z32 if cr_flags is None else np.asarray(cr_flags, np.uint32),
+        np.arange(n) if id_group is None else np.asarray(id_group),
+        np.full(n, -1, np.int32) if p_group is None else np.asarray(p_group, np.int32),
+        np.full(n, -1, np.int32) if p_tgt is None else np.asarray(p_tgt, np.int32),
+        np.zeros(n, bool) if p_found is None else np.asarray(p_found, bool),
+        np.full(n, -1, np.int64),
+        np.full(n, -1, np.int64),
+    )
+
+
+def _check_plan_invariants(plan, meta, n):
+    """Structural soundness of any plan: exact cover, step bound,
+    chain events only in scan segments, per-wave independence."""
+    seen = np.zeros(n, bool)
+    for kind, idx in plan.segments:
+        idx = np.asarray(idx)
+        assert not seen[idx].any(), "segments overlap"
+        seen[idx] = True
+        assert (np.diff(idx) >= 1).all(), "segment indices not ascending"
+        if kind == "scan":
+            assert (np.diff(idx) == 1).all(), "scan segment not contiguous"
+            continue
+        assert not meta["chain_member"][idx].any(), "chain event in a wave"
+        # Independence inside the wave (cross-EVENT only: one event
+        # claiming both its id and an equal pending ref is one event):
+        # no token claimed by two different wave-mates.
+        claimed_groups: set = set()
+        claimed_tgts: set = set()
+        for e in idx:
+            mine = {int(meta["id_group"][e])}
+            if meta["p_group"][e] >= 0:
+                mine.add(int(meta["p_group"][e]))
+            assert not (mine & claimed_groups), "id-group claimed twice"
+            claimed_groups |= mine
+            if meta["p_tgt"][e] >= 0:
+                t = int(meta["p_tgt"][e])
+                assert t not in claimed_tgts, "durable target claimed twice"
+                claimed_tgts.add(t)
+        # Cross-event only: an event reading a slot that a DIFFERENT
+        # wave-mate writes (its own read->apply is fine).
+        per_ev = []
+        for e in idx:
+            rr = {int(s) for s in (meta["reads0"][e], meta["reads1"][e]) if s >= 0}
+            ww = {int(s) for s in (meta["writes0"][e], meta["writes1"][e]) if s >= 0}
+            per_ev.append((rr, ww))
+        for a, (rr_a, _) in enumerate(per_ev):
+            for b, (_, ww_b) in enumerate(per_ev):
+                if a != b:
+                    assert not (rr_a & ww_b), (
+                        "wave-mate writes a slot another member reads"
+                    )
+    assert seen.all(), "plan does not cover the batch"
+    assert plan.n_steps <= n, "plan worse than the scan"
+
+
+def test_fresh_batch_is_one_wave():
+    n = 64
+    plan = waves.plan_waves(n, _meta(n))
+    assert plan.n_waves == 1 and plan.n_steps == 1
+    assert plan.parallel_events == n
+    assert plan.wave_mask.all()
+
+
+def test_two_phase_pairs_collapse_to_two_waves():
+    """(pending, post) pairs: every finalizer references the in-batch
+    id right before it — levels put all creators in wave 0 and all
+    finalizers in wave 1."""
+    n = 32
+    flags = np.zeros(n, np.uint32)
+    flags[0::2] = int(TF.pending)
+    flags[1::2] = int(TF.post_pending_transfer)
+    p_group = np.full(n, -1, np.int32)
+    p_group[1::2] = np.arange(0, n, 2, dtype=np.int32)
+    meta = _meta(n, flags=flags, p_group=p_group)
+    plan = waves.plan_waves(n, meta)
+    _check_plan_invariants(plan, meta, n)
+    assert plan.n_waves == 2 and plan.n_steps == 2
+    assert plan.parallel_events == n
+
+
+def test_chains_run_in_scan_segments():
+    n = 12
+    flags = np.zeros(n, np.uint32)
+    flags[4:7] = int(TF.linked)  # chain covering events 4..7 inclusive
+    meta = _meta(n, flags=flags)
+    plan = waves.plan_waves(n, meta)
+    _check_plan_invariants(plan, meta, n)
+    scans = [idx for k, idx in plan.segments if k == "scan"]
+    assert len(scans) == 1 and list(scans[0]) == [4, 5, 6, 7]
+    assert not plan.wave_mask[4:8].any()
+    assert plan.wave_mask[:4].all() and plan.wave_mask[8:].all()
+
+
+def test_balance_readers_serialize_against_writers():
+    """A balancing event reads its account's row: it must not share a
+    wave with any earlier or later writer of that slot."""
+    n = 8
+    flags = np.zeros(n, np.uint32)
+    flags[4] = int(TF.balancing_debit)
+    dr = np.zeros(n, np.int64)  # everyone debits slot 0
+    cr = np.ones(n, np.int64)
+    meta = _meta(n, flags=flags, dr_slot=dr, cr_slot=cr)
+    plan = waves.plan_waves(n, meta)
+    _check_plan_invariants(plan, meta, n)
+    # Three levels: adders before the reader, the reader, adders after.
+    lvl_of = {}
+    for w, (kind, idx) in enumerate(plan.segments):
+        for e in idx:
+            lvl_of[int(e)] = w
+    assert all(lvl_of[e] < lvl_of[4] for e in range(4))
+    assert all(lvl_of[e] > lvl_of[4] for e in range(5, n))
+
+
+def test_plan_invariants_random_meta():
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        n = int(rng.integers(1, 80))
+        flags = np.zeros(n, np.uint32)
+        flags[rng.random(n) < 0.2] |= int(TF.linked)
+        flags[rng.random(n) < 0.1] |= int(TF.balancing_debit)
+        pv = rng.random(n) < 0.25
+        flags[pv] |= int(TF.post_pending_transfer)
+        id_group = rng.integers(0, max(1, n // 2), n).astype(np.int64)
+        p_group = np.where(
+            pv & (rng.random(n) < 0.7),
+            rng.integers(0, max(1, n // 2), n),
+            -1,
+        ).astype(np.int32)
+        p_found = pv & (p_group < 0) & (rng.random(n) < 0.5)
+        p_tgt = np.where(
+            p_found, rng.integers(0, max(1, n // 3), n), -1
+        ).astype(np.int32)
+        meta = _meta(
+            n,
+            flags=flags,
+            dr_slot=rng.integers(0, 6, n).astype(np.int64),
+            cr_slot=rng.integers(6, 12, n).astype(np.int64),
+            id_group=id_group,
+            p_group=p_group,
+            p_tgt=p_tgt,
+            p_found=p_found,
+        )
+        plan = waves.plan_waves(n, meta)
+        _check_plan_invariants(plan, meta, n)
+
+
+# ---------------------------------------------------------------------------
+# Wave-vs-scan differential fuzz (state-machine level).
+
+
+def _zipf_accounts(rng, account_ids, n):
+    ranks = np.arange(1, len(account_ids) + 1, dtype=np.float64)
+    p = (1.0 / ranks) / (1.0 / ranks).sum()
+    return rng.choice(account_ids, size=n, p=p)
+
+
+def _random_batch(rng, ids, account_ids, t0):
+    """A batch biased toward wave-scheduler hard cases: linked chains,
+    two-phase post/void of in-batch pendings, Zipf-hot accounts,
+    balancing flags, id reuse."""
+    rows = []
+    pending_in_batch = []
+    t = t0
+    n = int(rng.integers(4, 40))
+    while len(rows) < n:
+        r = rng.random()
+        accts = _zipf_accounts(rng, account_ids, 2)
+        if r < 0.2 and len(rows) + 3 <= n + 4:
+            # Linked chain of 2-4 events.
+            clen = int(rng.integers(2, 5))
+            for k in range(clen):
+                f = int(TF.linked) if k < clen - 1 else 0
+                if rng.random() < 0.25:
+                    f |= int(TF.pending)
+                a2 = _zipf_accounts(rng, account_ids, 2)
+                rows.append(
+                    transfer(
+                        t + 100,
+                        debit_account_id=int(a2[0]),
+                        credit_account_id=int(a2[1]),
+                        amount=int(rng.integers(1, 40)),
+                        flags=f,
+                    )
+                )
+                ids.append(t + 100)
+                t += 1
+            continue
+        flags = 0
+        amount = int(rng.integers(0, 40))
+        pending_id = 0
+        timeout = 0
+        if r < 0.5:
+            # Post/void: usually an in-batch pending, sometimes durable
+            # or dangling.
+            flags |= (
+                int(TF.post_pending_transfer)
+                if rng.random() < 0.6
+                else int(TF.void_pending_transfer)
+            )
+            if pending_in_batch and rng.random() < 0.6:
+                pending_id = int(rng.choice(pending_in_batch))
+            elif len(ids) and rng.random() < 0.7:
+                pending_id = int(rng.choice(ids))
+            else:
+                pending_id = int(rng.integers(1, 50))
+            if rng.random() < 0.5:
+                amount = 0  # inherit
+        else:
+            if rng.random() < 0.4:
+                flags |= int(TF.pending)
+                if rng.random() < 0.4:
+                    timeout = int(rng.integers(1, 4))
+            if rng.random() < 0.3:
+                flags |= (
+                    int(TF.balancing_debit)
+                    if rng.random() < 0.5
+                    else int(TF.balancing_credit)
+                )
+        new_id = (
+            int(rng.choice(ids))
+            if len(ids) and rng.random() < 0.2
+            else t + 100
+        )
+        rows.append(
+            transfer(
+                new_id,
+                debit_account_id=int(accts[0]),
+                credit_account_id=int(accts[1]),
+                amount=amount,
+                pending_id=pending_id,
+                timeout=timeout,
+                flags=flags,
+            )
+        )
+        if flags & int(TF.pending) and new_id == t + 100:
+            pending_in_batch.append(new_id)
+        ids.append(new_id)
+        t += 1
+    # Never leave the batch's chain open on purpose-free runs; keep it
+    # open occasionally to exercise linked_event_chain_open.
+    if rng.random() < 0.8:
+        last = rows[-1].copy()
+        last["flags"] = int(last["flags"]) & ~int(TF.linked)
+        rows[-1] = last
+    return rows, t
+
+
+def _make_machines(monkeypatch):
+    """(wave-forced, scan-forced) machines, native disabled on both so
+    the comparison isolates the JAX exact path."""
+    sm_w = TpuStateMachine()
+    sm_w._native = None
+    sm_s = TpuStateMachine()
+    sm_s._native = None
+    return SingleNodeHarness(sm_w), SingleNodeHarness(sm_s)
+
+
+def _submit_both(monkeypatch, hw, hs, op, body, realtime=0):
+    # "1" forces wave plans even when unprofitable (maximal executor
+    # coverage); "scan" routes identically but runs the pure B-step
+    # scan — the differential isolates the wave executor.
+    monkeypatch.setenv("TB_WAVES", "1")
+    out_w = hw.submit(op, body, realtime=realtime)
+    monkeypatch.setenv("TB_WAVES", "scan")
+    out_s = hs.submit(op, body, realtime=realtime)
+    return out_w, out_s
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13, 14, 15, 16])
+def test_wave_vs_scan_differential(monkeypatch, seed):
+    rng = np.random.default_rng(seed)
+    hw, hs = _make_machines(monkeypatch)
+
+    account_ids = list(range(1, 15))
+    account_rows = []
+    for aid in account_ids:
+        flags = 0
+        r = rng.random()
+        if r < 0.2:
+            flags |= AF.debits_must_not_exceed_credits
+        elif r < 0.35:
+            flags |= AF.credits_must_not_exceed_debits
+        if rng.random() < 0.25:
+            flags |= AF.history
+        account_rows.append(account(aid, flags=flags))
+    a_bytes = pack(account_rows)
+    out_w, out_s = _submit_both(
+        monkeypatch, hw, hs, types.Operation.create_accounts, a_bytes
+    )
+    assert out_w == out_s
+
+    ids: list[int] = []
+    t = 0
+    realtime = 0
+    for batch_no in range(10):
+        rows, t = _random_batch(rng, ids, account_ids, t)
+        if rng.random() < 0.3:
+            realtime += int(rng.integers(1, 4)) * 10**9
+        out_w, out_s = _submit_both(
+            monkeypatch,
+            hw,
+            hs,
+            types.Operation.create_transfers,
+            pack(rows),
+            realtime=realtime,
+        )
+        assert out_w == out_s, f"batch {batch_no} replies diverge"
+        assert (
+            hw.sm.pulse_next_timestamp == hs.sm.pulse_next_timestamp
+        ), f"batch {batch_no} pulse schedule diverges"
+
+    # The wave path must have actually engaged (not silently declined
+    # every batch) or the fuzz is vacuous.
+    assert hw.sm.stat_wave_batches > 0
+    assert hs.sm.stat_wave_batches == 0
+
+    # Final wire state: balances + created-transfer records.
+    out_w = hw.lookup_accounts(account_ids)
+    out_s = hs.lookup_accounts(account_ids)
+    assert out_w.tobytes() == out_s.tobytes()
+    probe = sorted(set(ids))
+    out_w = hw.lookup_transfers(probe)
+    out_s = hs.lookup_transfers(probe)
+    assert out_w.tobytes() == out_s.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# CI smoke benchmark: 10k events, both paths, no TPU link needed.
+
+
+def test_smoke_10k_wave_vs_scan(monkeypatch):
+    """10k mixed events through wave and scan paths on CPU: identical
+    replies/state, and the partitioner never emits more device-step
+    equivalents than events (a plan worse than the scan is a scheduler
+    regression even when outputs stay correct)."""
+    rng = np.random.default_rng(99)
+    hw, hs = _make_machines(monkeypatch)
+    # Limit flags ride the COLD Zipf tail: a limit check reads the
+    # account's balance, which is a true serial dependency — putting
+    # limits on the hot head would (correctly) serialize most of the
+    # stream and measure semantics, not the scheduler.
+    n_acct = 64
+    a_bytes = pack(
+        [
+            account(
+                i,
+                flags=(
+                    AF.debits_must_not_exceed_credits
+                    if i > 3 * n_acct // 4
+                    else 0
+                ),
+            )
+            for i in range(1, n_acct + 1)
+        ]
+    )
+    out_w, out_s = _submit_both(
+        monkeypatch, hw, hs, types.Operation.create_accounts, a_bytes
+    )
+    assert out_w == out_s
+
+    account_ids = np.arange(1, n_acct + 1, dtype=np.uint64)
+    total = 0
+    tid = 1000
+    batch_events = 1024
+    while total < 10_000:
+        n = min(batch_events, 10_000 - total)
+        n_pairs = n // 4
+        rows = []
+        # Half plain Zipf transfers, a quarter (pending, post) pairs.
+        dr = _zipf_accounts(rng, account_ids, n)
+        cr = _zipf_accounts(rng, account_ids, n)
+        for k in range(n - 2 * n_pairs):
+            d = int(dr[k])
+            c = int(cr[k]) if int(cr[k]) != d else (d % n_acct) + 1
+            rows.append(
+                transfer(
+                    tid,
+                    debit_account_id=d,
+                    credit_account_id=c,
+                    amount=int(rng.integers(1, 30)),
+                    flags=(
+                        int(TF.balancing_debit)
+                        if rng.random() < 0.02
+                        else 0
+                    ),
+                )
+            )
+            tid += 1
+        for k in range(n_pairs):
+            d = int(dr[n - 1 - k])
+            c = (d % n_acct) + 1
+            rows.append(
+                transfer(
+                    tid,
+                    debit_account_id=d,
+                    credit_account_id=c,
+                    amount=int(rng.integers(1, 30)),
+                    flags=int(TF.pending),
+                )
+            )
+            rows.append(
+                transfer(
+                    tid + 1,
+                    amount=0,
+                    pending_id=tid,
+                    flags=int(TF.post_pending_transfer),
+                )
+            )
+            tid += 2
+        out_w, out_s = _submit_both(
+            monkeypatch, hw, hs, types.Operation.create_transfers, pack(rows)
+        )
+        assert out_w == out_s
+        total += n
+
+    sm = hw.sm
+    assert sm.stat_wave_batches > 0, "wave path never engaged"
+    assert sm.stat_wave_steps <= sm.stat_wave_events, (
+        f"partitioner emitted {sm.stat_wave_steps} steps for "
+        f"{sm.stat_wave_events} events — worse than the scan"
+    )
+    # The mixed stream above is wave-friendly (2% balancing readers,
+    # whose hot-slot chains serialize by true data dependency): expect
+    # a real collapse, not a degenerate per-event partition.
+    assert sm.stat_wave_steps * 5 <= sm.stat_wave_events, (
+        "step-count collapse lost: "
+        f"{sm.stat_wave_steps} steps / {sm.stat_wave_events} events"
+    )
+    out_w = hw.lookup_accounts(list(range(1, n_acct + 1)))
+    out_s = hs.lookup_accounts(list(range(1, n_acct + 1)))
+    assert out_w.tobytes() == out_s.tobytes()

@@ -176,7 +176,18 @@ class DeviceEngine:
         tunnel compiles a transfer plan per h2d SHAPE (~1 s each,
         engine trace) and XLA compiles each scan kernel on first call.
         Callers that know their workload (bench configs) name the
-        kinds; engine construction happens during untimed setup."""
+        kinds; engine construction happens during untimed setup.
+
+        The pseudo-kind "waves" warms the HOST-fallback wave executor
+        (waves.py) against this engine's table geometry: a batch the
+        router punts to the host path re-executes there, and with no
+        native engine built that means wave/scan kernels whose first
+        compile must not land inside a timed window."""
+        kinds = list(kinds)
+        if "waves" in kinds:
+            from tigerbeetle_tpu.state_machine import waves as _waves
+
+            _waves.prewarm(self.capacity)
         kinds = [k for k in kinds if k in _KERNELS]
         if not kinds:
             return

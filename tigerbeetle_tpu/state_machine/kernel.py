@@ -123,6 +123,11 @@ _CREATED_DTYPES = {
     "code": jnp.uint32,
 }
 
+# Batch shape buckets (jit compile cache keys): the host pads every
+# exact-path batch up to the next bucket.  Shared by the scan path
+# (tpu.py routing) and the wave executor's prewarm (waves.py).
+BATCH_BUCKETS = (32, 256, 2048, 8192)
+
 # Per-event input arrays the host must provide (all shape (B,)).
 EVENT_FIELDS = (
     ("i", jnp.int32),
@@ -272,13 +277,11 @@ def _noop(x):
     return x
 
 
-def _run_impl(balances, events, dstat_init, n, ts_base):
-    B = events["flags"].shape[0]
-    A = balances.shape[0]
-    arange_b = jnp.arange(B, dtype=jnp.int32)
-    id_group_full = events["id_group"]
-
-    carry = {
+def make_carry(balances, dstat_init, B):
+    """Initial scan carry for a B-event batch (also the segment-resume
+    state the wave executor threads between wave steps and scan
+    segments — see waves.py)."""
+    return {
         "balances": balances,
         "results": jnp.zeros(B, jnp.uint32),
         "created_mask": jnp.zeros(B, jnp.bool_),
@@ -318,6 +321,16 @@ def _run_impl(balances, events, dstat_init, n, ts_base):
         "pulse_create": jnp.zeros(B, jnp.uint64),
         "pulse_remove": jnp.zeros(B, jnp.uint64),
     }
+
+
+def make_body(n, ts_base, B, A, id_group_full, arange_b):
+    """The per-event scan body, parameterized by batch globals.
+
+    Shared between the full-batch scan (`_run_impl`) and the wave
+    executor's conflict-group segments (`scan_segment`): events carry
+    their GLOBAL index `i`, so the body works identically over any
+    contiguous sub-range of the batch.
+    """
 
     def body(carry, ev):
         i = ev["i"]
@@ -701,7 +714,11 @@ def _run_impl(balances, events, dstat_init, n, ts_base):
         }
         return new_carry, ()
 
-    final, _ = lax.scan(body, carry, events)
+    return body
+
+
+def finalize_outputs(final):
+    """(final carry) -> (balances, packed output matrix)."""
     out = {
         "balances": final["balances"],
         "results": final["results"],
@@ -716,6 +733,32 @@ def _run_impl(balances, events, dstat_init, n, ts_base):
         "pulse_remove": final["pulse_remove"],
     }
     return out["balances"], _pack_outputs(out)
+
+
+def _run_impl(balances, events, dstat_init, n, ts_base):
+    B = events["flags"].shape[0]
+    A = balances.shape[0]
+    arange_b = jnp.arange(B, dtype=jnp.int32)
+    carry = make_carry(balances, dstat_init, B)
+    body = make_body(n, ts_base, B, A, events["id_group"], arange_b)
+    final, _ = lax.scan(body, carry, events)
+    return finalize_outputs(final)
+
+
+def _scan_segment_impl(carry, events_seg, id_group_full, n, ts_base):
+    """Run the exact scan over a contiguous batch sub-range, resuming
+    from (and returning) a segment carry.  Events keep their global
+    `i`; padded lanes use i == B, which is inactive (i >= n) and whose
+    per-event writes fall out of bounds and drop."""
+    B = id_group_full.shape[0]
+    A = carry["balances"].shape[0]
+    arange_b = jnp.arange(B, dtype=jnp.int32)
+    body = make_body(n, ts_base, B, A, id_group_full, arange_b)
+    final, _ = lax.scan(body, carry, events_seg)
+    return final
+
+
+scan_segment = jax.jit(_scan_segment_impl, donate_argnums=(0,))
 
 
 # Packed-output column layout: the device link is high-latency, so all

@@ -478,3 +478,94 @@ def two_phase_resolve(
 
 def _apply(code_out: np.ndarray, cond: np.ndarray, code) -> None:
     np.copyto(code_out, np.uint32(code), where=(code_out == 0) & cond)
+
+
+def wave_dependency_metadata(
+    n: int,
+    flags: np.ndarray,
+    dr_slot: np.ndarray,
+    cr_slot: np.ndarray,
+    dr_flags: np.ndarray,
+    cr_flags: np.ndarray,
+    id_group: np.ndarray,
+    p_group: np.ndarray,
+    p_tgt: np.ndarray,
+    p_found: np.ndarray,
+    p_dr_slot: np.ndarray,
+    p_cr_slot: np.ndarray,
+    pv_serial: bool = False,
+) -> dict:
+    """Per-event dependency metadata for the wave partitioner
+    (waves.plan_waves).  Field contract:
+
+    - ``chain_member``: event must run in an exact scan segment — a
+      linked-chain member (rollback couples the chain, including the
+      closing non-linked event), an event on a history-flag account
+      (its balance snapshot feeds the history groove and must be
+      per-event sequential, while wave snapshots are rewritten to
+      batch finals), or any shape the wave step does not model
+      (``pv_serial`` forces every post/void there, used when a pending
+      target could sit on a history account).
+    - ``id_group`` / ``p_group`` / ``p_tgt``: the exact-path compact
+      reference tokens (tpu.py grouping); two events conflict when one
+      claims a token the wave already holds.
+    - ``writes0/1``: account slots whose balance columns the event's
+      apply ADDS to (normal: its dr/cr; post/void: the durable
+      target's accounts), -1 for none.  Commuting adds only conflict
+      with READERS.
+    - ``reads0/1``: slots whose current balance value the event's
+      verdict or applied amount depends on (balancing clamps, limit
+      checks, history snapshots), -1 for none.
+    - ``inb_pv``: post/void naming an in-batch id — its write set
+      statically widens to that id-group's slot union (``ev_dr`` /
+      ``ev_cr`` feed the union).
+    """
+    TFv = np.uint32
+    linked = (flags & TFv(TF.linked)) != 0
+    is_pv = (
+        flags & TFv(TF.post_pending_transfer | TF.void_pending_transfer)
+    ) != 0
+    chain_member = linked.copy()
+    if n > 1:
+        chain_member[1:] |= linked[:-1]
+    if pv_serial:
+        chain_member |= is_pv
+    hist = ((dr_flags | cr_flags) & TFv(AF.history)) != 0
+    chain_member |= hist & ~is_pv
+
+    bal_dr = (flags & TFv(TF.balancing_debit)) != 0
+    bal_cr = (flags & TFv(TF.balancing_credit)) != 0
+    # A balancing clamp reads the flagged side's whole row; a limit
+    # flag makes the verdict read that account's row.
+    read_dr = (
+        (bal_dr | ((dr_flags & TFv(AF.debits_must_not_exceed_credits)) != 0))
+        & (dr_slot >= 0) & ~is_pv
+    )
+    read_cr = (
+        (bal_cr | ((cr_flags & TFv(AF.credits_must_not_exceed_debits)) != 0))
+        & (cr_slot >= 0) & ~is_pv
+    )
+
+    neg = np.int64(-1)
+    dr64 = dr_slot.astype(np.int64)
+    cr64 = cr_slot.astype(np.int64)
+    pdr64 = np.where(p_found, p_dr_slot.astype(np.int64), neg)
+    pcr64 = np.where(p_found, p_cr_slot.astype(np.int64), neg)
+    writes0 = np.where(is_pv, pdr64, np.where(dr_slot >= 0, dr64, neg))
+    writes1 = np.where(is_pv, pcr64, np.where(cr_slot >= 0, cr64, neg))
+    reads0 = np.where(read_dr, dr64, neg)
+    reads1 = np.where(read_cr, cr64, neg)
+
+    return {
+        "chain_member": chain_member,
+        "id_group": np.asarray(id_group, np.int64),
+        "p_group": np.asarray(p_group, np.int64),
+        "p_tgt": np.asarray(p_tgt, np.int64),
+        "writes0": writes0,
+        "writes1": writes1,
+        "reads0": reads0,
+        "reads1": reads1,
+        "inb_pv": is_pv & (np.asarray(p_group) >= 0),
+        "ev_dr": np.where(dr_slot >= 0, dr64, neg),
+        "ev_cr": np.where(cr_slot >= 0, cr64, neg),
+    }

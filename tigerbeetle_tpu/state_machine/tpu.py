@@ -33,7 +33,7 @@ from tigerbeetle_tpu import constants as cfg
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.lsm import pack_u128
 from tigerbeetle_tpu.utils import HashIndex, RunIndex
-from tigerbeetle_tpu.state_machine import kernel, kernel_fast, resolve
+from tigerbeetle_tpu.state_machine import kernel, kernel_fast, resolve, waves
 from tigerbeetle_tpu.state_machine.mirror import BalanceMirror, _sub_u128
 from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
 from tigerbeetle_tpu.types import (
@@ -65,7 +65,7 @@ TF = TransferFlags
 CAR = CreateAccountResult
 CTR = CreateTransferResult
 
-_BATCH_BUCKETS = (32, 256, 2048, 8192)
+_BATCH_BUCKETS = kernel.BATCH_BUCKETS
 
 # Columnar transfer-store fields.
 _STORE_FIELDS = {
@@ -418,6 +418,15 @@ class TpuStateMachine:
         # unexplained.
         self.stat_hot_tail_batches = 0
         self.stat_slow_tail_batches = 0
+        # Conflict-aware wave execution (waves.py): batches the JAX
+        # exact path ran as wave plans instead of the B-step scan, the
+        # device-step equivalents those plans executed (1 per wave +
+        # segment length per conflict group), and the event split
+        # (bench reports waves_per_batch / wave_parallelism_pct).
+        self.stat_wave_batches = 0
+        self.stat_wave_steps = 0
+        self.stat_wave_events = 0
+        self.stat_wave_parallel_events = 0
 
     @property
     def stat_device_semantic_events(self) -> int:
@@ -1645,7 +1654,12 @@ class TpuStateMachine:
         # account resolution, duplicate checks, and overflow admission
         # (native/tb_fastpath.cpp); Python only does the bookkeeping.
         # A None return means fallback — nothing was mutated.
-        if self._native is not None:
+        # TB_WAVES=1/exact/scan bypasses every native/host fast path so
+        # the JAX exact path (wave executor or B-step scan) sees the
+        # full stream (differential-test + benchmark routing).
+        if self._native is not None and waves.mode() not in (
+            "1", "exact", "scan"
+        ):
             native_out = self._native.commit_transfers(input_bytes, n, ts_base)
             if native_out is not None:
                 self.stat_device_events += n
@@ -1807,9 +1821,14 @@ class TpuStateMachine:
     ) -> bytes:
         """Fast-path routing + exact kernel dispatch, after account
         resolution and the static ladder."""
+        wave_mode = waves.mode()
+        # "1"/"exact"/"scan" all route the batch to the JAX exact
+        # dispatch below (skipping the host fast paths); "1" further
+        # forces the wave plan past its profitability gate.
+        wave_force = wave_mode in ("1", "exact", "scan")
         # The JAX kernel needs shape buckets (compile cache); the native
         # exact engine takes any length — skip the ~50-array padding.
-        if self._native is not None:
+        if self._native is not None and not wave_force:
             B = n
         else:
             B = next(b for b in _BATCH_BUCKETS if b >= n)
@@ -1856,7 +1875,7 @@ class TpuStateMachine:
                 ids_unique = len(np.unique(id_mix)) == n
         else:
             ids_unique = False
-        if order_free and ids_unique and not e_found.any():
+        if order_free and ids_unique and not e_found.any() and not wave_force:
             acct_flags = dr_flags | cr_flags
             if not (
                 acct_flags
@@ -1883,6 +1902,7 @@ class TpuStateMachine:
         # (the order-free path above took them).
         if (
             ids_unique
+            and not wave_force
             and not (
                 flags
                 & np.uint32(
@@ -1987,7 +2007,7 @@ class TpuStateMachine:
         # Two-phase resolution (resolve.py): post/void batches whose
         # verdicts are balance-independent resolve in one vectorized
         # pass — pendings, first-wins finalization, scatter-add apply.
-        if is_pv.any() and ids_unique and not e_found.any():
+        if is_pv.any() and ids_unique and not e_found.any() and not wave_force:
             reply = self._try_two_phase_fast(
                 n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi, flags,
                 timeout, dr_slot, cr_slot, amount_lo, amount_hi, ledger,
@@ -2050,7 +2070,7 @@ class TpuStateMachine:
         }
 
         self.stat_exact_events += n
-        if self._native is not None:
+        if self._native is not None and not wave_force:
             # Serial exact engine in C++ (native/tb_exact.inc): same
             # inputs and packed-output contract as the scan kernel.
             # Sequential semantics are inherently serial (the reference
@@ -2064,10 +2084,35 @@ class TpuStateMachine:
             out = kernel.unpack_outputs(packed_np)
             mirror_from_hist = False  # C++ already updated the mirror
         else:
-            new_balances, packed = kernel.run_create_transfers(
-                self._balances, {k: jnp.asarray(v) for k, v in ev.items()},
-                dstat_init, n, ts_base,
-            )
+            # Conflict-aware wave execution (waves.py): when the batch
+            # partitions into few mutually-independent waves, run one
+            # vectorized device step per wave — and the exact scan only
+            # over true conflict groups — instead of the full B-step
+            # scan.  Bit-identical outputs (tests/test_waves.py).
+            wave_plan = None
+            if wave_mode not in ("0", "scan"):
+                wave_plan = self._plan_wave_execution(
+                    n, flags, dr_slot, cr_slot, dr_flags, cr_flags,
+                    id_group, p_group, p_tgt, p_found, gather_p, is_pv,
+                    amount_lo, amount_hi, force=(wave_mode == "1"),
+                )
+            if wave_plan is not None:
+                # Wave events' snapshots are rewritten to batch finals
+                # at finalize (history events never ride waves).
+                new_balances, packed = waves.run_create_transfers_waves(
+                    self._balances, ev, dstat_init, n, ts_base,
+                    wave_plan, _pad(wave_plan.wave_mask, B),
+                )
+                self.stat_wave_batches += 1
+                self.stat_wave_steps += wave_plan.n_steps
+                self.stat_wave_events += n
+                self.stat_wave_parallel_events += wave_plan.parallel_events
+            else:
+                new_balances, packed = kernel.run_create_transfers(
+                    self._balances,
+                    {k: jnp.asarray(v) for k, v in ev.items()},
+                    dstat_init, n, ts_base,
+                )
             self._balances = new_balances
 
             # ONE device->host transfer for every output: the kernel
@@ -2116,6 +2161,92 @@ class TpuStateMachine:
         reply["index"] = fail_idx.astype(np.uint32)
         reply["result"] = results[fail_idx]
         return reply.tobytes()
+
+    def _plan_wave_execution(
+        self, n, flags, dr_slot, cr_slot, dr_flags, cr_flags,
+        id_group, p_group, p_tgt, p_found, gather_p, is_pv,
+        amount_lo, amount_hi, force: bool = False,
+    ):
+        """Wave routing decision for one exact-path batch: dependency
+        metadata (resolve.py) -> whole-batch overflow admission
+        against the mirror -> level partition (waves.plan_waves) ->
+        profitability.  Returns the plan or None — the scan path —
+        and is always safe to decline (never a wrong answer, only a
+        slower one)."""
+        p_drs = gather_p("dr_slot").astype(np.int64)
+        p_crs = gather_p("cr_slot").astype(np.int64)
+
+        # History accounts force per-event-sequential snapshots: their
+        # events read their own rows (wave_dependency_metadata), and a
+        # post/void whose target could sit on one goes to the scan.
+        hist_ev = ((dr_flags | cr_flags) & np.uint32(AF.history)) != 0
+        pv_hist = False
+        if p_found.any():
+            pj = np.unique(
+                np.concatenate([p_drs[p_found], p_crs[p_found]])
+            )
+            pj = pj[pj >= 0]
+            pv_hist = bool(
+                (self._attrs["flags"][pj] & np.uint32(AF.history)).any()
+            )
+        meta = resolve.wave_dependency_metadata(
+            n, flags, dr_slot, cr_slot, dr_flags, cr_flags,
+            id_group, p_group, p_tgt, p_found, p_drs, p_crs,
+            pv_serial=bool(hist_ev.any() or pv_hist),
+        )
+        # Chain members each cost one exact step, so n/chain_members
+        # bounds the achievable ratio: decline chain-dominated batches
+        # (the linked config) BEFORE the per-event partition walk.
+        n_chain = int(meta["chain_member"].sum())
+        if not force and n_chain and n < waves.min_ratio() * n_chain:
+            return None
+
+        # Whole-batch overflow admission (waves.admission_ok): per-event
+        # amount upper bounds — balancing zero-amount means maxInt u64,
+        # post/void apply at most max(t.amount, pending.amount), and an
+        # in-batch inherit is bounded by the largest create bound.
+        is_balancing = (
+            flags & np.uint32(TF.balancing_debit | TF.balancing_credit)
+        ) != 0
+        amount_zero = (amount_lo == 0) & (amount_hi == 0)
+        bound_lo = np.where(
+            is_balancing & amount_zero, np.uint64(U64_MAX), amount_lo
+        )
+        bound_hi = np.where(is_balancing & amount_zero, np.uint64(0), amount_hi)
+        p_amt_lo = gather_p("amount_lo").astype(np.uint64)
+        p_amt_hi = gather_p("amount_hi").astype(np.uint64)
+        p_bigger = is_pv & (
+            (p_amt_hi > bound_hi)
+            | ((p_amt_hi == bound_hi) & (p_amt_lo > bound_lo))
+        )
+        bound_lo = np.where(p_bigger, p_amt_lo, bound_lo)
+        bound_hi = np.where(p_bigger, p_amt_hi, bound_hi)
+        inb_inherit = is_pv & amount_zero & ~p_found
+        if inb_inherit.any():
+            nm = ~is_pv
+            if nm.any():
+                mx_hi = bound_hi[nm].max()
+                at = bound_hi[nm] == mx_hi
+                mx_lo = bound_lo[nm][at].max()
+                bound_lo = np.where(inb_inherit, mx_lo, bound_lo)
+                bound_hi = np.where(inb_inherit, mx_hi, bound_hi)
+        touched = np.concatenate(
+            [dr_slot.astype(np.int64), cr_slot.astype(np.int64),
+             p_drs[p_found], p_crs[p_found]]
+        )
+        # Admission runs BEFORE the per-event partition walk: the
+        # bound arrays are vectorized numpy, so a persistently
+        # declining deployment (u128-scale balances) never pays the
+        # ~1 ms/8k-event plan cost.
+        if not waves.admission_ok(
+            self._mirror.lo, self._mirror.hi, touched, bound_lo, bound_hi
+        ):
+            return None
+
+        plan = waves.plan_waves(n, meta)
+        if not (force or plan.profitable()):
+            return None
+        return plan
 
     def _try_native_two_phase(
         self, input_bytes, events, n, ts_base

@@ -1,0 +1,857 @@
+"""Conflict-aware wave execution: parallel apply for independent
+transfers, exact scan only for true dependencies.
+
+The sequential scan kernel (kernel.py) pays one device step per EVENT
+— B steps per batch — even when almost every event touches disjoint
+accounts.  This module collapses that to one step per *wave*: a
+host-side partitioner (`plan_waves`) builds the batch's conflict graph
+and assigns each event a topological LEVEL (one more than the highest
+level among earlier events it conflicts with); each level executes as
+ONE vectorized device step over its — possibly non-contiguous — index
+set (`_wave_step_impl`, the scan body re-expressed over a (K,) event
+axis with balance deltas combined by an exact u128 segment-sum
+scatter, like kernel_fast._flush_impl), while true serial dependencies
+— linked chains — run through the unchanged exact scan at their batch
+position (kernel.scan_segment).  A two_phase batch of (pending,
+finalize) pairs is exactly TWO waves; a fresh-ids batch is ONE.  The
+segment kinds thread one carry, so outputs are bit-identical to the
+full scan (enforced by tests/test_waves.py differential fuzz).
+
+What makes two events DEPENDENT (same model as parallel-EVM conflict
+graphs — arXiv:2503.04595 — specialized to the reference semantics):
+
+- **id/pending references.**  A second event with the same transfer-id
+  value must observe the first's create (exists ladder); a post/void
+  whose pending_id names an in-batch id must observe that create and
+  its status.  Tracked as compact id-group tokens (tpu.py's exact-path
+  grouping): two events conflict when either's id_group or p_group was
+  already claimed by the wave.
+- **durable two-phase targets.**  Two finalizers of the same durable
+  pending race first-wins; the second's verdict depends on the first.
+  Tracked by p_tgt (the deduped durable-target index).
+- **balance READS.**  Most transfers only *add* to balance columns —
+  addition commutes and their result codes read no mutable state, so
+  they share a wave even on the same hot account (the deltas sum).
+  But balancing_debit/credit clamps and debits/credits_must_not_exceed
+  limit checks *read* account balances: such an event conflicts with
+  any wave-mate that writes one of its read slots (and its own writes
+  conflict with wave-mates' reads).
+- **linked chains & history accounts.**  Rollback couples every chain
+  member (including the closing event), and an AF.history account's
+  per-event snapshot must be sequential-exact (it feeds the history
+  groove, while wave snapshots are rewritten to batch finals): both
+  run in exact scan segments.
+
+Overflow codes are the one read everyone performs implicitly: whether
+`amount + dp` overflows u128 depends on prior events.  The executor
+keeps them exact with the same superset admission the order-free fast
+path uses (mirror.try_apply_adds): amounts are non-negative, so if the
+ALL-APPLIED total of the batch cannot overflow any touched column (or
+column pair), no sequential prefix can either, and every ov_* term is
+identically false in both orders.  `admission_ok` proves that bound on
+the host mirror; a batch that fails it (astronomical balances) routes
+to the scan path — never a wrong answer, only a slower one.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tigerbeetle_tpu.ops import u128 as w
+from tigerbeetle_tpu.state_machine import kernel
+from tigerbeetle_tpu.state_machine.kernel import (
+    CREATED_FIELDS,
+    F_BAL_CR,
+    F_BAL_DR,
+    F_LINKED,
+    F_PENDING,
+    F_POST,
+    F_VOID,
+    NS_PER_S,
+    R_ALREADY_POSTED,
+    R_ALREADY_VOIDED,
+    R_EXCEEDS_CREDITS,
+    R_EXCEEDS_DEBITS,
+    R_EXCEEDS_PENDING_AMOUNT,
+    R_OVERFLOWS_CP,
+    R_OVERFLOWS_CPO,
+    R_OVERFLOWS_CREDITS,
+    R_OVERFLOWS_DEBITS,
+    R_OVERFLOWS_DP,
+    R_OVERFLOWS_DPO,
+    R_OVERFLOWS_TIMEOUT,
+    R_PENDING_DIFF_AMOUNT,
+    R_PENDING_DIFF_CODE,
+    R_PENDING_DIFF_CR,
+    R_PENDING_DIFF_DR,
+    R_PENDING_DIFF_LEDGER,
+    R_PENDING_EXPIRED,
+    R_PENDING_NOT_FOUND,
+    R_PENDING_NOT_PENDING,
+    R_TIMESTAMP_MUST_BE_ZERO,
+    S_PENDING,
+    S_POSTED,
+    S_VOIDED,
+    U64_MAX,
+    _E_FIELD_MAP,
+    _EXISTS_SENTINEL,
+    _P_FIELD_MAP,
+    _exists_ladder_normal,
+    _exists_ladder_post_void,
+    _first_nonzero,
+    _gather_created,
+    _merge,
+    AF_CR_LIMIT,
+    AF_DR_LIMIT,
+    CP_LO, CP_HI, CPO_LO, CPO_HI, DP_LO, DP_HI, DPO_LO, DPO_HI,
+)
+
+_MASK32 = jnp.uint64(0xFFFFFFFF)
+
+# Wave/scan segment shape buckets (jit compile cache keys).
+_SEG_BUCKETS = (16, 64, 256, 1024, 4096, 8192)
+
+def min_ratio() -> float:
+    """Minimum step-count reduction (batch length / executed steps)
+    before the wave path beats the plain scan; below it the partition
+    degrades toward per-event waves and the scan's single fused
+    dispatch wins.  Read live (like mode()) so tests and bench arms
+    can toggle TB_WAVES_MIN_RATIO after import."""
+    return float(os.environ.get("TB_WAVES_MIN_RATIO", "2.0"))
+
+
+def mode() -> str:
+    """TB_WAVES routing mode:
+
+    - unset/"auto": wave plans considered whenever the JAX exact scan
+      would otherwise run (native absent), profitability + admission
+      gates apply.
+    - "0": off — the exact path always runs the B-step scan.
+    - "1": force — route every batch to the JAX exact path (bypassing
+      the native engine and the order-free/linked/two-phase fast
+      paths) and execute the wave plan even when unprofitable.
+      Differential-test routing: maximizes wave-executor coverage.
+    - "exact": route to the JAX exact path like "1", but keep the
+      normal profitability/admission decision (what the scheduler
+      would really do there).
+    - "scan": route to the JAX exact path, never plan waves — the
+      pure sequential scan on identical routing, the honest control
+      for wave-vs-scan benchmarks."""
+    return os.environ.get("TB_WAVES", "auto")
+
+
+# ---------------------------------------------------------------------------
+# Partitioner.
+
+
+@dataclass
+class WavePlan:
+    """Execution plan: ordered segments whose index sets cover [0, n).
+
+    Segment order is the EXECUTION order; a "wave" segment's indices
+    need not be contiguous (topological-level scheduling), while a
+    "scan" segment is always a contiguous chain run executed at its
+    batch position.
+    """
+
+    n: int
+    # (kind, idx): kind "wave" = one parallel step over idx (int
+    # array, ascending), kind "scan" = len(idx) exact sequential
+    # steps over a contiguous run.
+    segments: list = field(default_factory=list)
+    wave_mask: np.ndarray | None = None  # events executed in wave steps
+
+    @property
+    def n_waves(self) -> int:
+        return sum(1 for k, _ in self.segments if k == "wave")
+
+    @property
+    def parallel_events(self) -> int:
+        return sum(len(ix) for k, ix in self.segments if k == "wave")
+
+    @property
+    def n_steps(self) -> int:
+        """Device-step equivalents: 1 per wave, length per scan run."""
+        return sum(
+            1 if k == "wave" else len(ix) for k, ix in self.segments
+        )
+
+    @property
+    def ratio(self) -> float:
+        return self.n / max(1, self.n_steps)
+
+    def profitable(self, ratio_floor: float | None = None) -> bool:
+        return self.ratio >= (
+            min_ratio() if ratio_floor is None else ratio_floor
+        )
+
+
+def plan_waves(n: int, meta: dict) -> WavePlan:
+    """Partition a batch into wave/scan segments by topological level.
+
+    Chain runs (contiguous spans of ``chain_member`` events) are
+    barriers executed by the exact scan at their batch position.  The
+    chain-free REGIONS between them schedule like a parallel-EVM
+    conflict graph (arXiv:2503.04595): each event's *level* is one
+    more than the highest level of any earlier in-region event it
+    conflicts with (shared id/pending token, first-wins target, or a
+    read-write balance-slot overlap), and each level executes as ONE
+    wave — commuting adds never conflict, so a two_phase batch of
+    (pending, finalize) pairs collapses to exactly two waves.  Level
+    order preserves sequential semantics for every conflicting pair;
+    non-conflicting events commute, so any interleaving of levels is
+    bit-identical to the scan.
+
+    `meta` comes from resolve.wave_dependency_metadata — see there for
+    the field contract.  O(n) with small-constant dict operations;
+    runs once per batch on the host, only when the wave path is a
+    routing candidate.
+    """
+    chain_member = meta["chain_member"]
+    id_group = meta["id_group"]
+    p_group = meta["p_group"]
+    p_tgt = meta["p_tgt"]
+    writes0 = meta["writes0"]
+    writes1 = meta["writes1"]
+    reads0 = meta["reads0"]
+    reads1 = meta["reads1"]
+    inb_pv = meta["inb_pv"]
+    ev_dr = meta["ev_dr"]
+    ev_cr = meta["ev_cr"]
+
+    # Fast path for the dominant shape (fresh unique ids, no chains, no
+    # finalizers, no balance readers): the whole batch is ONE wave —
+    # skip the per-event Python walk entirely.
+    if (
+        not chain_member.any()
+        and not inb_pv.any()
+        and (reads0 < 0).all()
+        and (reads1 < 0).all()
+        and (p_tgt < 0).all()
+        and (p_group < 0).all()
+        and len(np.unique(id_group)) == n
+    ):
+        plan = WavePlan(n, segments=[("wave", np.arange(n))])
+        plan.wave_mask = np.ones(n, bool)
+        return plan
+
+    # In-batch pending references resolve to the creating event at run
+    # time; statically, the finalizer may write the slots of ANY event
+    # sharing that id-group (the creator is whichever applied), so its
+    # write set is the group's slot union.
+    group_slots: dict[int, set] = {}
+    for e in range(n):
+        g = int(id_group[e])
+        s = group_slots.setdefault(g, set())
+        if ev_dr[e] >= 0:
+            s.add(int(ev_dr[e]))
+        if ev_cr[e] >= 0:
+            s.add(int(ev_cr[e]))
+
+    plan = WavePlan(n)
+    wave_mask = np.zeros(n, bool)
+    segments = plan.segments
+
+    def level_region(lo: int, hi: int) -> None:
+        """Assign conflict-graph levels to [lo, hi) (no chain members)
+        and emit one wave segment per level, in level order."""
+        group_level: dict[int, int] = {}
+        ptgt_level: dict[int, int] = {}
+        write_level: dict[int, int] = {}
+        read_level: dict[int, int] = {}
+        levels = np.zeros(hi - lo, np.int32)
+        for e in range(lo, hi):
+            g = int(id_group[e])
+            pg = int(p_group[e])
+            pt = int(p_tgt[e])
+            ww = []
+            if writes0[e] >= 0:
+                ww.append(int(writes0[e]))
+            if writes1[e] >= 0:
+                ww.append(int(writes1[e]))
+            if inb_pv[e]:
+                ww.extend(group_slots.get(pg, ()))
+            rr = []
+            if reads0[e] >= 0:
+                rr.append(int(reads0[e]))
+            if reads1[e] >= 0:
+                rr.append(int(reads1[e]))
+
+            # Level = 1 + max level of every earlier conflicting
+            # event: same-id claims (exists ladder), pending refs,
+            # first-wins finalize targets, then balance-slot RAW/WAR
+            # (a reader must see exactly the earlier writers' adds;
+            # later writers must apply after it reads).  Reads also
+            # serialize against earlier reads — a balancing/limit
+            # reader's own writes are data-dependent, and the greedy
+            # rule this generalizes kept reader pairs ordered.
+            lvl = group_level.get(g, -1) + 1
+            if pg >= 0:
+                lvl = max(lvl, group_level.get(pg, -1) + 1)
+            if pt >= 0:
+                lvl = max(lvl, ptgt_level.get(pt, -1) + 1)
+            for s in rr:
+                lvl = max(
+                    lvl,
+                    write_level.get(s, -1) + 1,
+                    read_level.get(s, -1) + 1,
+                )
+            for s in ww:
+                lvl = max(lvl, read_level.get(s, -1) + 1)
+
+            levels[e - lo] = lvl
+            if lvl > group_level.get(g, -1):
+                group_level[g] = lvl
+            if pg >= 0 and lvl > group_level.get(pg, -1):
+                group_level[pg] = lvl
+            if pt >= 0 and lvl > ptgt_level.get(pt, -1):
+                ptgt_level[pt] = lvl
+            for s in ww:
+                if lvl > write_level.get(s, -1):
+                    write_level[s] = lvl
+            for s in rr:
+                if lvl > read_level.get(s, -1):
+                    read_level[s] = lvl
+        for lvl in range(int(levels.max()) + 1 if hi > lo else 0):
+            idx = lo + np.flatnonzero(levels == lvl)
+            segments.append(("wave", idx))
+            wave_mask[idx] = True
+
+    i = 0
+    while i < n:
+        if chain_member[i]:
+            j = i
+            while j < n and chain_member[j]:
+                j += 1
+            segments.append(("scan", np.arange(i, j)))
+            i = j
+            continue
+        j = i
+        while j < n and not chain_member[j]:
+            j += 1
+        level_region(i, j)
+        i = j
+
+    plan.wave_mask = wave_mask
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Overflow admission (host, against the balance mirror).
+
+
+def admission_ok(
+    mirror_lo: np.ndarray,
+    mirror_hi: np.ndarray,
+    touched: np.ndarray,
+    bound_lo: np.ndarray,
+    bound_hi: np.ndarray,
+) -> bool:
+    """Superset overflow admission for the whole batch.
+
+    True when (pre-state + all-applied additions) provably cannot
+    overflow any touched u128 column or dp+dpo / cp+cpo pair — then
+    every per-event ov_* term is false in ANY execution order (amounts
+    are non-negative, so each sequential prefix is bounded by the
+    all-applied total).  Conservative: `bound_*` are per-event amount
+    upper bounds (balancing zero-amount -> maxInt u64), each charged to
+    all four lanes an event can add through.
+    """
+    touched = touched[touched >= 0]
+    if len(touched) and mirror_hi[touched].any():
+        return False
+    m32 = np.uint64(0xFFFFFFFF)
+    s_ll = int((bound_lo & m32).sum(dtype=np.uint64))
+    s_lh = int((bound_lo >> np.uint64(32)).sum(dtype=np.uint64))
+    s_hl = int((bound_hi & m32).sum(dtype=np.uint64))
+    s_hh = int((bound_hi >> np.uint64(32)).sum(dtype=np.uint64))
+    total = s_ll + (s_lh << 32) + (s_hl << 64) + (s_hh << 96)
+    # x4: dr+cr lanes for the create plus dr+cr for a post's add.
+    # Touched cols start < 2^64 (hi limbs all zero), so column and
+    # pair sums stay < 2^64 + 2^127 < 2^128.
+    return 4 * total < (1 << 126)
+
+
+# ---------------------------------------------------------------------------
+# The wave step: the scan body over a (K,) event axis.
+
+
+def _accum_u128(slots_c, cols, amt_lo, amt_hi, valid, A):
+    """Exact per-(slot, column) u128 sums via 32-bit-piece scatter-adds
+    (duplicate slots accumulate — the segment-sum analogue of
+    kernel_fast._flush_impl's unique-scatter).  Piece sums stay below
+    lanes * 2^32 < 2^64, so recombination with base-2^32 carries is
+    exact.  Invalid lanes contribute zero (their slot may be clip
+    garbage; zero is harmless anywhere)."""
+    zero = jnp.uint64(0)
+    lo = jnp.where(valid, amt_lo, zero)
+    hi = jnp.where(valid, amt_hi, zero)
+    pieces = [
+        lo & _MASK32, lo >> jnp.uint64(32),
+        hi & _MASK32, hi >> jnp.uint64(32),
+    ]
+    acc = [
+        jnp.zeros((A, 4), jnp.uint64).at[slots_c, cols].add(p)
+        for p in pieces
+    ]
+    c0, c1, c2, c3 = acc
+    c1 = c1 + (c0 >> jnp.uint64(32))
+    c2 = c2 + (c1 >> jnp.uint64(32))
+    c3 = c3 + (c2 >> jnp.uint64(32))
+    d_lo = (c0 & _MASK32) | ((c1 & _MASK32) << jnp.uint64(32))
+    d_hi = (c2 & _MASK32) | ((c3 & _MASK32) << jnp.uint64(32))
+    return d_lo, d_hi
+
+
+def _wave_step_impl(carry, ev, n, ts_base):
+    """Apply one wave — K mutually independent events — as a single
+    vectorized step against the segment carry.
+
+    Line-for-line port of kernel.make_body's event body with the
+    (K,) axis vectorized and chain/rollback logic dropped (the
+    partitioner never places chain members in waves).  Independence
+    guarantees every gather sees pre-wave state equal to its
+    sequential value, and the admission precondition makes every ov_*
+    term false, so results and records are bit-identical to the scan.
+    """
+    table = carry["balances"]
+    created = carry["created"]
+    group_creator = carry["group_creator"]
+    B = carry["results"].shape[0]
+    A = table.shape[0]
+
+    i = ev["i"]  # (K,) global indices; padding lanes carry i == B
+    active = i < n
+    flags = ev["flags"]
+    is_pv = (flags & (F_POST | F_VOID)) != 0
+    ts_i = ts_base + i.astype(jnp.uint64)
+
+    # No chain terms: wave events are never chain members, so the
+    # scan's chain_open/chain_broken preconditions are identically 0.
+    pre = _first_nonzero((ev["ts_nonzero"], R_TIMESTAMP_MUST_BE_ZERO))
+    pre = jnp.where(pre == 0, ev["static_result"], pre)
+
+    # -- Exists resolution via the in-batch id directory.
+    e_creator = group_creator[jnp.clip(ev["id_group"], 0, B - 1)]
+    e_inb = e_creator >= 0
+    e_dur = ev["e_found"]
+    e_any = e_inb | e_dur
+    e = _merge(~e_inb, _gather_created(created, e_creator, B), ev, _E_FIELD_MAP)
+
+    # ==================== normal create_transfer ====================
+    dr_row = table[jnp.clip(ev["dr_slot"], 0, A - 1)]
+    cr_row = table[jnp.clip(ev["cr_slot"], 0, A - 1)]
+    dr_dp = (dr_row[:, DP_LO], dr_row[:, DP_HI])
+    dr_dpo = (dr_row[:, DPO_LO], dr_row[:, DPO_HI])
+    dr_cpo = (dr_row[:, CPO_LO], dr_row[:, CPO_HI])
+    cr_dpo = (cr_row[:, DPO_LO], cr_row[:, DPO_HI])
+    cr_cp = (cr_row[:, CP_LO], cr_row[:, CP_HI])
+    cr_cpo = (cr_row[:, CPO_LO], cr_row[:, CPO_HI])
+
+    exists_rn = _exists_ladder_normal(ev, e)
+
+    is_balancing = (flags & (F_BAL_DR | F_BAL_CR)) != 0
+    amount = (ev["amount_lo"], ev["amount_hi"])
+    amount = w.select(
+        is_balancing & w.is_zero(amount),
+        (jnp.full_like(amount[0], U64_MAX), jnp.zeros_like(amount[1])),
+        amount,
+    )
+    dr_balance, _ = w.add(dr_dpo, dr_dp)
+    bd_avail = w.sub_sat(dr_cpo, dr_balance)
+    amount = w.select((flags & F_BAL_DR) != 0, w.minimum(amount, bd_avail), amount)
+    bd_fail = ((flags & F_BAL_DR) != 0) & w.is_zero(amount)
+
+    cr_balance, _ = w.add(cr_cpo, cr_cp)
+    bc_avail = w.sub_sat(cr_dpo, cr_balance)
+    amount_bc = w.minimum(amount, bc_avail)
+    amount = w.select(((flags & F_BAL_CR) != 0) & ~bd_fail, amount_bc, amount)
+    bc_fail = ((flags & F_BAL_CR) != 0) & w.is_zero(amount) & ~bd_fail
+
+    is_pending = (flags & F_PENDING) != 0
+    _, ov_dp = w.add(amount, dr_dp)
+    _, ov_cp = w.add(amount, cr_cp)
+    _, ov_dpo = w.add(amount, dr_dpo)
+    _, ov_cpo = w.add(amount, cr_cpo)
+    dr_total, _ = w.add(dr_dp, dr_dpo)
+    _, ov_debits = w.add(amount, dr_total)
+    cr_total, _ = w.add(cr_cp, cr_cpo)
+    _, ov_credits = w.add(amount, cr_total)
+
+    timeout_ns = ev["timeout"] * NS_PER_S
+    ts_plus = ts_i + timeout_ns
+    ov_timeout = ts_plus < ts_i
+
+    dr_lhs, _ = w.add(dr_total, amount)
+    exceeds_cr = ((ev["dr_flags"] & AF_DR_LIMIT) != 0) & w.gt(dr_lhs, dr_cpo)
+    cr_lhs, _ = w.add(cr_total, amount)
+    exceeds_dr = ((ev["cr_flags"] & AF_CR_LIMIT) != 0) & w.gt(cr_lhs, cr_dpo)
+
+    rn = _first_nonzero(
+        (e_any, _EXISTS_SENTINEL),
+        (bd_fail, R_EXCEEDS_CREDITS),
+        (bc_fail, R_EXCEEDS_DEBITS),
+        (is_pending & ov_dp, R_OVERFLOWS_DP),
+        (is_pending & ov_cp, R_OVERFLOWS_CP),
+        (ov_dpo, R_OVERFLOWS_DPO),
+        (ov_cpo, R_OVERFLOWS_CPO),
+        (ov_debits, R_OVERFLOWS_DEBITS),
+        (ov_credits, R_OVERFLOWS_CREDITS),
+        (ov_timeout, R_OVERFLOWS_TIMEOUT),
+        (exceeds_cr, R_EXCEEDS_CREDITS),
+        (exceeds_dr, R_EXCEEDS_DEBITS),
+    )
+    rn = jnp.where(rn == _EXISTS_SENTINEL, exists_rn, rn)
+
+    # ==================== post/void pending transfer ====================
+    p_creator = group_creator[jnp.clip(ev["p_group"], 0, B - 1)]
+    p_inb = (ev["p_group"] >= 0) & (p_creator >= 0)
+    p_dur = ev["p_found"]
+    p_any = p_dur | p_inb
+    p = _merge(p_dur, _gather_created(created, p_creator, B), ev, _P_FIELD_MAP)
+    p_timestamp = jnp.where(
+        p_dur,
+        ev["p_timestamp"],
+        ts_base + jnp.clip(p_creator, 0, B - 1).astype(jnp.uint64),
+    )
+    p_amount = (p["amount_lo"], p["amount_hi"])
+
+    pv_amount_raw = (ev["amount_lo"], ev["amount_hi"])
+    pv_amount = w.select(w.is_zero(pv_amount_raw), p_amount, pv_amount_raw)
+    is_void = (flags & F_VOID) != 0
+
+    exists_rp = _exists_ladder_post_void(ev, e, p)
+
+    st = jnp.where(
+        p_dur,
+        carry["dstat"][jnp.clip(ev["p_tgt"], 0, B - 1)],
+        carry["inb_status"][jnp.clip(p_creator, 0, B - 1)],
+    )
+
+    rp_pre_insert = _first_nonzero(
+        (~p_any, R_PENDING_NOT_FOUND),
+        ((p["flags"] & F_PENDING) == 0, R_PENDING_NOT_PENDING),
+        (~ev["dr_id_zero"] & (ev["dr_slot"] != p["dr_slot"]), R_PENDING_DIFF_DR),
+        (~ev["cr_id_zero"] & (ev["cr_slot"] != p["cr_slot"]), R_PENDING_DIFF_CR),
+        ((ev["ledger"] > 0) & (ev["ledger"] != p["ledger"]), R_PENDING_DIFF_LEDGER),
+        ((ev["code"] > 0) & (ev["code"] != p["code"]), R_PENDING_DIFF_CODE),
+        (w.gt(pv_amount, p_amount), R_EXCEEDS_PENDING_AMOUNT),
+        (is_void & w.lt(pv_amount, p_amount), R_PENDING_DIFF_AMOUNT),
+        (e_any, _EXISTS_SENTINEL),
+        (st == S_POSTED, R_ALREADY_POSTED),
+        (st == S_VOIDED, R_ALREADY_VOIDED),
+        (st == kernel.S_EXPIRED, R_PENDING_EXPIRED),
+    )
+    rp_pre_insert = jnp.where(
+        rp_pre_insert == _EXISTS_SENTINEL, exists_rp, rp_pre_insert
+    )
+
+    p_expires = p_timestamp + p["timeout"] * NS_PER_S
+    overdue = (p["timeout"] > 0) & (p_expires <= ts_i)
+    rp = jnp.where((rp_pre_insert == 0) & overdue, R_PENDING_EXPIRED, rp_pre_insert)
+
+    # ==================== merge & apply ====================
+    dyn_r = jnp.where(is_pv, rp, rn)
+    gate = active & (pre == 0)
+    r = jnp.where(gate, dyn_r, jnp.where(active, pre, 0))
+
+    pv_inserted = gate & is_pv & (rp_pre_insert == 0)
+    normal_applied = gate & ~is_pv & (rn == 0)
+    pv_applied = gate & is_pv & (rp == 0)
+    inserted = pv_inserted | normal_applied
+    applied = pv_applied | normal_applied
+
+    ud128_inherit = is_pv & (ev["ud128_lo"] == 0) & (ev["ud128_hi"] == 0)
+    rec = {
+        "flags": flags,
+        "dr_slot": jnp.where(is_pv, p["dr_slot"], ev["dr_slot"]),
+        "cr_slot": jnp.where(is_pv, p["cr_slot"], ev["cr_slot"]),
+        "amount_lo": jnp.where(is_pv, pv_amount[0], amount[0]),
+        "amount_hi": jnp.where(is_pv, pv_amount[1], amount[1]),
+        "pending_lo": ev["pending_lo"],
+        "pending_hi": ev["pending_hi"],
+        "ud128_lo": jnp.where(ud128_inherit, p["ud128_lo"], ev["ud128_lo"]),
+        "ud128_hi": jnp.where(ud128_inherit, p["ud128_hi"], ev["ud128_hi"]),
+        "ud64": jnp.where(is_pv & (ev["ud64"] == 0), p["ud64"], ev["ud64"]),
+        "ud32": jnp.where(is_pv & (ev["ud32"] == 0), p["ud32"], ev["ud32"]),
+        "timeout": jnp.where(is_pv, jnp.uint64(0), ev["timeout"]),
+        "ledger": jnp.where(is_pv, p["ledger"], ev["ledger"]),
+        "code": jnp.where(is_pv, p["code"], ev["code"]),
+    }
+
+    # -- Balance effects as commuting u128 deltas, segment-summed.
+    up_dr_slot = jnp.where(is_pv, p["dr_slot"], ev["dr_slot"])
+    up_cr_slot = jnp.where(is_pv, p["cr_slot"], ev["cr_slot"])
+    safe_dr = jnp.clip(up_dr_slot, 0, A - 1)
+    safe_cr = jnp.clip(up_cr_slot, 0, A - 1)
+
+    is_post = (flags & F_POST) != 0
+    zi = jnp.zeros_like(i)
+    # Add lanes: normal dr (dp|dpo), normal cr (cp|cpo), post dr dpo,
+    # post cr cpo.  Sub lanes: pv release dr dp, pv release cr cp.
+    add_slots = jnp.concatenate([safe_dr, safe_cr, safe_dr, safe_cr])
+    add_cols = jnp.concatenate(
+        [
+            jnp.where(is_pending, zi, zi + 1),
+            jnp.where(is_pending, zi + 2, zi + 3),
+            zi + 1,
+            zi + 3,
+        ]
+    )
+    add_lo = jnp.concatenate([amount[0], amount[0], pv_amount[0], pv_amount[0]])
+    add_hi = jnp.concatenate([amount[1], amount[1], pv_amount[1], pv_amount[1]])
+    post_ap = pv_applied & is_post
+    add_valid = jnp.concatenate(
+        [normal_applied, normal_applied, post_ap, post_ap]
+    )
+    sub_slots = jnp.concatenate([safe_dr, safe_cr])
+    sub_cols = jnp.concatenate([zi, zi + 2])
+    sub_lo = jnp.concatenate([p_amount[0], p_amount[0]])
+    sub_hi = jnp.concatenate([p_amount[1], p_amount[1]])
+    sub_valid = jnp.concatenate([pv_applied, pv_applied])
+
+    d_lo, d_hi = _accum_u128(add_slots, add_cols, add_lo, add_hi, add_valid, A)
+    s_lo, s_hi = _accum_u128(sub_slots, sub_cols, sub_lo, sub_hi, sub_valid, A)
+
+    old_lo = table[:, 0::2]
+    old_hi = table[:, 1::2]
+    t_lo = old_lo + d_lo
+    cy = (t_lo < old_lo).astype(jnp.uint64)
+    t_hi = old_hi + d_hi + cy
+    n_lo = t_lo - s_lo
+    bw = (t_lo < s_lo).astype(jnp.uint64)
+    n_hi = t_hi - s_hi - bw
+    table = jnp.stack(
+        [n_lo[:, 0], n_hi[:, 0], n_lo[:, 1], n_hi[:, 1],
+         n_lo[:, 2], n_hi[:, 2], n_lo[:, 3], n_hi[:, 3]],
+        axis=-1,
+    )
+
+    # -- Per-event post-apply snapshots (pre-wave row + own deltas).
+    # They may miss wave-mates' commuting deltas to the same slot, but
+    # wave events' snapshots only feed the mirror and are rewritten
+    # with batch finals at finalize (history-account events, whose
+    # snapshots are semantically read, never ride waves).
+    o_dr = carry["balances"][safe_dr]
+    o_cr = carry["balances"][safe_cr]
+    o_dr_dp = (o_dr[:, DP_LO], o_dr[:, DP_HI])
+    o_dr_dpo = (o_dr[:, DPO_LO], o_dr[:, DPO_HI])
+    o_cr_cp = (o_cr[:, CP_LO], o_cr[:, CP_HI])
+    o_cr_cpo = (o_cr[:, CPO_LO], o_cr[:, CPO_HI])
+    n_dr_dp = w.select(
+        is_pv,
+        w.sub(o_dr_dp, p_amount)[0],
+        w.select(is_pending, w.add(o_dr_dp, amount)[0], o_dr_dp),
+    )
+    n_dr_dpo = w.select(
+        is_pv,
+        w.select(is_post, w.add(o_dr_dpo, pv_amount)[0], o_dr_dpo),
+        w.select(is_pending, o_dr_dpo, w.add(o_dr_dpo, amount)[0]),
+    )
+    n_cr_cp = w.select(
+        is_pv,
+        w.sub(o_cr_cp, p_amount)[0],
+        w.select(is_pending, w.add(o_cr_cp, amount)[0], o_cr_cp),
+    )
+    n_cr_cpo = w.select(
+        is_pv,
+        w.select(is_post, w.add(o_cr_cpo, pv_amount)[0], o_cr_cpo),
+        w.select(is_pending, o_cr_cpo, w.add(o_cr_cpo, amount)[0]),
+    )
+    new_dr_row = jnp.stack(
+        [n_dr_dp[0], n_dr_dp[1], n_dr_dpo[0], n_dr_dpo[1],
+         o_dr[:, CP_LO], o_dr[:, CP_HI], o_dr[:, CPO_LO], o_dr[:, CPO_HI]],
+        axis=-1,
+    )
+    new_cr_row = jnp.stack(
+        [o_cr[:, DP_LO], o_cr[:, DP_HI], o_cr[:, DPO_LO], o_cr[:, DPO_HI],
+         n_cr_cp[0], n_cr_cp[1], n_cr_cpo[0], n_cr_cpo[1]],
+        axis=-1,
+    )
+
+    # -- Scatter per-event state at own (unique) global indices; OOB
+    # padding lanes drop.
+    idx_i = jnp.where(active, i, B)
+    idx_ins = jnp.where(inserted, i, B)
+    created = {
+        f: created[f]
+        .at[idx_ins]
+        .set(rec[f].astype(created[f].dtype), mode="drop")
+        for f in CREATED_FIELDS
+    }
+    created_mask = carry["created_mask"].at[idx_i].set(inserted, mode="drop")
+    gidx = jnp.where(inserted, jnp.clip(ev["id_group"], 0, B - 1), B)
+    group_creator = group_creator.at[gidx].set(i, mode="drop")
+
+    inb_status = carry["inb_status"].at[idx_i].set(
+        jnp.where(normal_applied & is_pending, jnp.uint32(S_PENDING), 0),
+        mode="drop",
+    )
+    new_status = jnp.where(is_post, jnp.uint32(S_POSTED), jnp.uint32(S_VOIDED))
+    idx_t = jnp.where(pv_applied & p_dur, jnp.clip(ev["p_tgt"], 0, B - 1), B)
+    dstat = carry["dstat"].at[idx_t].set(new_status, mode="drop")
+    idx_pc = jnp.where(pv_applied & ~p_dur, jnp.clip(p_creator, 0, B - 1), B)
+    inb_status = inb_status.at[idx_pc].set(new_status, mode="drop")
+
+    hist_dr = carry["hist_dr"].at[idx_i].set(new_dr_row, mode="drop")
+    hist_cr = carry["hist_cr"].at[idx_i].set(new_cr_row, mode="drop")
+    results = carry["results"].at[idx_i].set(r, mode="drop")
+
+    last_applied = jnp.maximum(
+        carry["last_applied"], jnp.where(applied, i, -1).max()
+    )
+    pulse_create = carry["pulse_create"].at[idx_i].set(
+        jnp.where(
+            normal_applied & is_pending & (ev["timeout"] > 0),
+            ts_i + timeout_ns,
+            jnp.uint64(0),
+        ),
+        mode="drop",
+    )
+    pulse_remove = carry["pulse_remove"].at[idx_i].set(
+        jnp.where(pv_applied & (p["timeout"] > 0), p_expires, jnp.uint64(0)),
+        mode="drop",
+    )
+
+    return dict(
+        carry,
+        balances=table,
+        results=results,
+        created_mask=created_mask,
+        created=created,
+        group_creator=group_creator,
+        inb_status=inb_status,
+        dstat=dstat,
+        hist_dr=hist_dr,
+        hist_cr=hist_cr,
+        last_applied=last_applied,
+        pulse_create=pulse_create,
+        pulse_remove=pulse_remove,
+    )
+
+
+_wave_step = jax.jit(_wave_step_impl, donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _init_carry(balances, dstat_init):
+    return kernel.make_carry(balances, dstat_init, dstat_init.shape[0])
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _finalize_impl(carry, hist_fix):
+    """Pack outputs; rewrite wave events' balance snapshots with the
+    BATCH-FINAL rows of their touched slots so the host's last-write-
+    wins mirror reconstruction lands on exact finals (a wave event's
+    own snapshot misses wave-mates' commuting deltas to the same slot).
+    `hist_fix` is the wave mask: scan-segment events keep their
+    sequential snapshots — history-account events always run there, so
+    the history groove only ever sees sequential-exact rows."""
+    table = carry["balances"]
+    A = table.shape[0]
+    fix = hist_fix & (carry["results"] == 0)
+    dr = jnp.clip(carry["created"]["dr_slot"], 0, A - 1)
+    cr = jnp.clip(carry["created"]["cr_slot"], 0, A - 1)
+    hist_dr = jnp.where(fix[:, None], table[dr], carry["hist_dr"])
+    hist_cr = jnp.where(fix[:, None], table[cr], carry["hist_cr"])
+    return kernel.finalize_outputs(
+        dict(carry, hist_dr=hist_dr, hist_cr=hist_cr)
+    )
+
+
+def _bucket(k: int) -> int:
+    for b in _SEG_BUCKETS:
+        if b >= k:
+            return b
+    return k
+
+
+def _gather_events(ev: dict, idx: np.ndarray, K: int, B: int) -> dict:
+    """Padded (K,) device gather of the host event arrays at batch
+    indices `idx` (ascending, possibly non-contiguous for waves);
+    padding lanes get i == B (inactive, and every per-event scatter
+    drops OOB)."""
+    k = len(idx)
+    out = {}
+    for name, arr in ev.items():
+        buf = np.zeros(K, arr.dtype)
+        buf[:k] = arr[idx]
+        if name == "i":
+            buf[k:] = B
+        out[name] = jnp.asarray(buf)
+    return out
+
+
+def run_create_transfers_waves(
+    balances, ev: dict, dstat_init, n: int, ts_base: int, plan: WavePlan,
+    hist_fix: np.ndarray,
+):
+    """Execute a batch by the wave plan; same contract and bit-exact
+    same outputs as kernel.run_create_transfers.
+
+    `ev` is the HOST-side dict of (B,) numpy arrays per
+    kernel.EVENT_FIELDS; `hist_fix` is a (B,) bool mask of events whose
+    snapshots should be rewritten with batch finals (wave events off
+    history accounts).
+    """
+    B = ev["flags"].shape[0]
+    carry = _init_carry(
+        balances, jnp.asarray(np.asarray(dstat_init), jnp.uint32)
+    )
+    id_group_full = jnp.asarray(ev["id_group"])
+    n_j = jnp.int32(n)
+    ts_j = jnp.uint64(ts_base)
+    for seg_kind, idx in plan.segments:
+        K = _bucket(len(idx))
+        ev_seg = _gather_events(ev, idx, K, B)
+        if seg_kind == "wave":
+            carry = _wave_step(carry, ev_seg, n_j, ts_j)
+        else:
+            carry = kernel.scan_segment(carry, ev_seg, id_group_full, n_j, ts_j)
+    return _finalize_impl(carry, jnp.asarray(hist_fix))
+
+
+def prewarm(
+    A: int, B_buckets=kernel.BATCH_BUCKETS, buckets=_SEG_BUCKETS
+) -> None:
+    """Compile the wave step (and the paired scan segment) for the
+    given table geometry OFF the hot path: on the tunneled TPU each
+    kernel costs minutes of one-time XLA compile, which must not land
+    inside a timed window (device_engine.prewarm forwards its "waves"
+    kind here; TB_DEV_PREWARM=waves,... opts in).  The jits are
+    shape-keyed on BOTH the carry's batch bucket B and the segment
+    bucket K, so the default warms every (B, K <= B) pair the router
+    can produce — warming only the extremes would leave mid-size
+    first-compiles (e.g. two_phase's ~B/2-event waves, bucket 4096)
+    inside timed windows."""
+    outs = []
+    for B in B_buckets:
+        ev = {
+            name: np.zeros(B, np.dtype(dtype))
+            for name, dtype in kernel.EVENT_FIELDS
+        }
+        ev["i"] = np.arange(B, dtype=np.int32)
+        for K in buckets:
+            if K > max(_SEG_BUCKETS) or _bucket(min(K, B)) != K:
+                continue
+            carry = kernel.make_carry(
+                jnp.zeros((A, 8), jnp.uint64), jnp.zeros(B, jnp.uint32), B
+            )
+            idx = np.arange(min(K, B))
+            carry = _wave_step(
+                carry, _gather_events(ev, idx, K, B),
+                jnp.int32(0), jnp.uint64(1),
+            )
+            carry = kernel.scan_segment(
+                carry, _gather_events(ev, idx, K, B),
+                jnp.asarray(ev["id_group"]), jnp.int32(0), jnp.uint64(1),
+            )
+            outs.append(_finalize_impl(carry, jnp.zeros(B, bool)))
+    jax.block_until_ready(outs)

@@ -256,8 +256,15 @@ class Cluster:
         newer epoch but hasn't replayed its ops yet would otherwise
         steer requests at the stale mapping."""
         best_epoch, best = -1, None
-        for r in self.replicas:
+        for i, r in enumerate(self.replicas):
             if r.status != "normal":
+                continue
+            # A nemesis-partitioned replica may hold the freshest
+            # adopted membership, but no client can reach it (or any
+            # process its mapping names through it): routing by its
+            # view would steer requests at a mapping no reachable
+            # replica answers.  Skip it; heal/failover restores it.
+            if i in self.network.partitioned:
                 continue
             members = r.members_adopted or r.members
             epoch = max(r.epoch_adopted, r.epoch)

@@ -200,13 +200,24 @@ class VsrReplica(Replica):
         # clear vouches above commit_min.
         self._vouched: dict[int, int] = {}
         self._installed_canonical: list[np.ndarray] = []
-        # The superblock's persisted canonical suffix must cover the
-        # whole uncommitted range or its overflow truncation reopens
-        # the stale-carrier class it exists to close.
+        # The superblock's persisted canonical suffix covers the
+        # pipeline-deep HEAD of the uncommitted range, not all of it:
+        # under stalled commits (commit_min, op] can grow to
+        # journal_slot_count >> the suffix, and overflow truncation
+        # then drops coverage of the deeper ops — those are protected
+        # by the DVC merge sanitize + canonical-vouch chain walk, as
+        # in the reference.  Mirror the reference's invariant family
+        # (constants.zig: view_change_headers_max >= pipeline + 3 and
+        # <= journal_slot_count) so a config change can't silently
+        # shrink the suffix below what the head-anchoring needs.
         assert (
-            self.config.pipeline_prepare_queue_max
-            < superblock_mod.VIEW_HEADERS_MAX
-        ), "view_headers suffix must exceed the pipeline depth"
+            superblock_mod.VIEW_HEADERS_MAX
+            >= self.config.pipeline_prepare_queue_max + 3
+        ), "view_headers suffix must cover the pipeline-deep head (+3)"
+        assert (
+            superblock_mod.VIEW_HEADERS_MAX
+            <= self.config.journal_slot_count
+        ), "view_headers suffix cannot exceed the journal"
         self._last_retransmit = 0
         self._repair_round = 0
 
@@ -1438,13 +1449,15 @@ class VsrReplica(Replica):
             # backup both missing an op, primary-asks-successor and
             # successor-asks-primary never reaches the lone holder
             # (VOPR seed 803272239 wedged exactly so).  Checksum-
-            # addressed fetches are safe from ANY peer, so retries
-            # rotate across all of them.
+            # addressed fetches are safe from ANY peer — including
+            # standbys, which replicate the log and can be the lone
+            # surviving holder after actives corrupt — so retries
+            # rotate across the full membership.
             peers = [
-                r for r in range(self.replica_count) if r != self.replica
+                r for r in range(self.total_count) if r != self.replica
             ]
             if peers:
-                base = peers.index(target)
+                base = peers.index(target) if target in peers else 0
                 target = peers[(base + self._repair_round) % len(peers)]
                 self._repair_round += 1
         for op, checksum in pinned[:8]:
