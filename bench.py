@@ -980,6 +980,28 @@ def _run_memory_config(name, gen) -> dict:
             / max(1, sm.stat_wave_events),
             1,
         )
+    # Link-robustness forensics (device_engine degraded-mode
+    # lifecycle): retries, demotions/re-promotions, events served by
+    # the degraded host path, and checksum scrubs.  Only reported when
+    # something happened — an all-zero block would just be noise on a
+    # healthy link.
+    if sm.engine == "device":
+        d = sm._dev
+        health = {
+            "state": d.state.value,
+            "link_retries": d.stat_retries,
+            "link_errors": d.stat_link_errors,
+            "demotions": d.stat_demotions,
+            "repromotions": d.stat_repromotions,
+            "probe_failures": d.stat_probe_failures,
+            "degraded_events": d.stat_degraded_events,
+            "scrubs": d.stat_scrubs,
+            "scrub_heals": d.stat_scrub_heals,
+        }
+        if health["state"] != "healthy" or any(
+            v for k, v in health.items() if k != "state"
+        ):
+            out["engine_health"] = health
     del sm, h
     return out
 
@@ -1212,6 +1234,14 @@ def main() -> None:
             # would burn its full subprocess timeout on the same hang;
             # degrade the rest of the run in place instead (children
             # inherit the parent's env at spawn).
+            if os.environ.get("TB_REQUIRE_DEVICE") == "1":
+                print(
+                    "bench: accelerator wedged mid-run and "
+                    "TB_REQUIRE_DEVICE=1: refusing to degrade to "
+                    "CPU-backed numbers",
+                    file=sys.stderr,
+                )
+                raise SystemExit(2)
             print(
                 "bench: accelerator wedged mid-run; remaining configs"
                 " degrade to CPU-backed host engine",
@@ -1458,6 +1488,16 @@ def ensure_device_responsive() -> None:
     if _device_alive():
         os.environ["TB_BENCH_DEVICE_CHECKED"] = "tpu"
         return
+    if os.environ.get("TB_REQUIRE_DEVICE") == "1":
+        # Strict mode: complement of the tpu_unreachable honesty
+        # marker — refuse to record CPU-backed numbers at all rather
+        # than degrade, for runs whose whole point is the device.
+        print(
+            "bench: accelerator unresponsive and TB_REQUIRE_DEVICE=1: "
+            "refusing to record CPU-backed numbers",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
     print(
         "bench: accelerator unresponsive; re-exec on CPU-backed JAX",
         file=sys.stderr,

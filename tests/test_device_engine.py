@@ -212,6 +212,65 @@ def test_fallback_recovery_redispatches_inflight(monkeypatch):
     assert h_d.sm._dev.stat_fallback_batches >= 1
 
 
+def test_fallback_recovery_reentrant_drain(monkeypatch):
+    """Recovery's host fallback re-enters drain() via table reads
+    (JAX host path, no native fastpath); the recovering window must
+    not be visible as launched to the nested rotate, or its futures
+    double-resolve and mirror bookkeeping double-applies — the
+    code-review repro for the _recovering detach."""
+    import tigerbeetle_tpu.state_machine.device_engine as de
+
+    monkeypatch.setattr(de, "_WINDOW", 64)
+    big = (1 << 127) + 5
+    h_d, h_c = mk_pair()
+    h_d.sm._native = None  # fallbacks take the JAX host path -> read()
+    ops = [(Operation.create_accounts, accounts([1, 2, 3]))]
+    for k in range(3):
+        ops.append(
+            (
+                Operation.create_transfers,
+                transfers(
+                    [dict(id=10 + k, debit_account_id=1,
+                          credit_account_id=2, amount=big)]
+                ),
+            )
+        )
+    ops.append((Operation.lookup_accounts, hz.ids_bytes([1, 2, 3])))
+    replay_both(h_d, h_c, ops)
+    assert h_d.sm._dev.stat_fallback_batches >= 1
+
+
+def test_recovery_with_pending_window_stays_ordered(monkeypatch):
+    """A full PENDING window queued behind a dirty one must not be
+    launched by the recovery fallback's re-entrant drain — it would
+    execute out of submission order against a table recovery is about
+    to rebuild (and a nested dirty rotation would clobber the
+    recovery slot)."""
+    import tigerbeetle_tpu.state_machine.device_engine as de
+
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    big = (1 << 127) + 5
+    h_d, h_c = mk_pair()
+    h_d.sm._native = None  # fallbacks take the JAX host path -> read()
+    ops = [(Operation.create_accounts, accounts([1, 2, 3]))]
+    amounts = [big, big] + [3 + k for k in range(9)]
+    for k, amount in enumerate(amounts):
+        ops.append(
+            (
+                Operation.create_transfers,
+                transfers(
+                    [dict(id=10 + k, debit_account_id=1,
+                          credit_account_id=2 + k % 2, amount=amount)]
+                ),
+            )
+        )
+    ops.append((Operation.lookup_accounts, hz.ids_bytes([1, 2, 3])))
+    replay_both(h_d, h_c, ops)
+    assert h_d.sm._dev.stat_fallback_batches >= 1
+    assert h_d.sm._dev.stat_demotions == 0
+    h_d.sm.verify_device_mirror()
+
+
 def test_fallback_cap_exceeded():
     """More failures than the summary cap -> host re-execution with
     full failure list."""
@@ -521,6 +580,124 @@ def test_hot_tail_store_equivalence():
             f"store column {name} diverges between the hot tail and "
             "the shared bookkeeping path"
         )
+
+
+def test_grow_with_window_in_flight(monkeypatch):
+    """Capacity growth triggered by create_accounts while a transfer
+    window is still in flight: grow() must drain the stream, widen the
+    tables, and every reply (before and after) must stay exact."""
+    import tigerbeetle_tpu.state_machine.device_engine as de
+
+    monkeypatch.setattr(de, "_WINDOW", 64)
+    sm_d = TpuStateMachine(engine="device", account_capacity=64)
+    h_d = hz.SingleNodeHarness(sm_d)
+    h_c = hz.SingleNodeHarness(CpuStateMachine())
+    ops = [(Operation.create_accounts, accounts(range(1, 41)))]
+    # In-flight transfers against the small table...
+    for k in range(6):
+        ops.append(
+            (
+                Operation.create_transfers,
+                transfers(
+                    [dict(id=100 + k, debit_account_id=1 + k % 40,
+                          credit_account_id=1 + (k + 1) % 40,
+                          amount=5 + k)]
+                ),
+            )
+        )
+    futs = [h_d.submit_async(op, body) for op, body in ops]
+    cap_before = sm_d._dev.capacity
+    assert sm_d._dev.has_inflight()
+    # ...then an account burst that forces _ensure_balance_capacity ->
+    # DeviceEngine.grow() mid-stream.
+    grow_ops = [(Operation.create_accounts, accounts(range(41, 101)))]
+    for k in range(4):
+        grow_ops.append(
+            (
+                Operation.create_transfers,
+                transfers(
+                    [dict(id=200 + k, debit_account_id=90 + k,
+                          credit_account_id=1 + k, amount=7 + k)]
+                ),
+            )
+        )
+    grow_ops.append(
+        (Operation.lookup_accounts, hz.ids_bytes(list(range(1, 101))))
+    )
+    futs += [h_d.submit_async(op, body) for op, body in grow_ops]
+    replies_d = [f.result() for f in futs]
+    replies_c = [h_c.submit(op, body) for op, body in ops + grow_ops]
+    assert replies_d == replies_c
+    assert sm_d._dev.capacity > cap_before
+    # The point is DEVICE-path coverage: a regression that demotes the
+    # engine would still reply exactly (host fallback) — fail loudly
+    # instead of passing vacuously.
+    assert sm_d._dev.stat_demotions == 0
+    assert sm_d._dev.state is types.EngineState.healthy
+    sm_d.verify_device_mirror()
+
+
+def test_remove_accounts_with_window_in_flight(monkeypatch):
+    """A linked create_accounts chain that fails mid-chain rolls back
+    its slots (DeviceEngine.remove_accounts) while transfer batches
+    are still in flight; the meta zeroing must sequence with the
+    stream and later replies stay exact."""
+    import tigerbeetle_tpu.state_machine.device_engine as de
+
+    monkeypatch.setattr(de, "_WINDOW", 64)
+    h_d, h_c = mk_pair()
+    setup = (Operation.create_accounts, accounts([1, 2]))
+    h_d.submit(*setup)
+    h_c.submit(*setup)
+    futs = []
+    ops = []
+    for k in range(3):
+        op = (
+            Operation.create_transfers,
+            transfers(
+                [dict(id=10 + k, debit_account_id=1, credit_account_id=2,
+                      amount=3 + k)]
+            ),
+        )
+        ops.append(op)
+        futs.append(h_d.submit_async(*op))
+    assert h_d.sm._dev.has_inflight()
+    # Linked chain: second member duplicates id 1 -> whole chain fails
+    # -> rollback removes the chain's already-allocated slots while
+    # the transfer window above is still in flight.
+    chain = (
+        Operation.create_accounts,
+        hz.pack(
+            [
+                hz.account(50, flags=int(AF.linked)),
+                hz.account(1),
+            ]
+        ),
+    )
+    ops.append(chain)
+    futs.append(h_d.submit_async(*chain))
+    # Transfers naming the rolled-back account must fail identically.
+    post = (
+        Operation.create_transfers,
+        transfers(
+            [dict(id=20, debit_account_id=50, credit_account_id=2,
+                  amount=9),
+             dict(id=21, debit_account_id=1, credit_account_id=2,
+                  amount=11)]
+        ),
+    )
+    ops.append(post)
+    futs.append(h_d.submit_async(*post))
+    look = (Operation.lookup_accounts, hz.ids_bytes([1, 2, 50]))
+    ops.append(look)
+    futs.append(h_d.submit_async(*look))
+    replies_d = [f.result() for f in futs]
+    replies_c = [h_c.submit(op, body) for op, body in ops]
+    assert replies_d == replies_c
+    # Device-path coverage must be real, not a silent host fallback.
+    assert h_d.sm._dev.stat_demotions == 0
+    assert h_d.sm._dev.state is types.EngineState.healthy
+    h_d.sm.verify_device_mirror()
 
 
 def test_tight_and_wide_inputs_agree(monkeypatch):

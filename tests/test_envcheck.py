@@ -1,0 +1,71 @@
+"""Env-var validation: bad tuning values fail fast with errors that
+name the variable and the violated constraint, instead of dying in a
+bare int() traceback or assert deep inside the consumer module."""
+
+import pytest
+
+from tigerbeetle_tpu import envcheck
+from tigerbeetle_tpu.state_machine import waves
+from tigerbeetle_tpu.state_machine.device_engine import (
+    _validate_window_ring,
+)
+
+
+def test_env_int_rejects_garbage(monkeypatch):
+    monkeypatch.setenv("TB_DEV_WINDOW", "ninety-six")
+    with pytest.raises(envcheck.EnvVarError, match="TB_DEV_WINDOW"):
+        envcheck.env_int("TB_DEV_WINDOW", 96, minimum=1)
+
+
+def test_env_int_bounds(monkeypatch):
+    monkeypatch.setenv("TB_DEV_RING", "0")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 2"):
+        envcheck.env_int("TB_DEV_RING", 256, minimum=2)
+    monkeypatch.setenv("TB_DEV_RING", "512")
+    assert envcheck.env_int("TB_DEV_RING", 256, minimum=2) == 512
+
+
+def test_env_int_default_when_unset(monkeypatch):
+    monkeypatch.delenv("TB_DEV_WINDOW", raising=False)
+    assert envcheck.env_int("TB_DEV_WINDOW", 96, minimum=1) == 96
+
+
+def test_window_ring_constraint_named():
+    with pytest.raises(envcheck.EnvVarError) as err:
+        _validate_window_ring(200, 256)
+    message = str(err.value)
+    assert "TB_DEV_WINDOW" in message
+    assert "TB_DEV_RING" in message
+    assert "2*TB_DEV_WINDOW" in message
+    _validate_window_ring(128, 256)  # boundary is legal
+
+
+def test_tb_waves_mode_validated(monkeypatch):
+    monkeypatch.setenv("TB_WAVES", "fast")
+    with pytest.raises(envcheck.EnvVarError, match="TB_WAVES"):
+        waves.mode()
+    for legal in ("auto", "0", "1", "exact", "scan"):
+        monkeypatch.setenv("TB_WAVES", legal)
+        assert waves.mode() == legal
+
+
+def test_tb_waves_min_ratio_validated(monkeypatch):
+    monkeypatch.setenv("TB_WAVES_MIN_RATIO", "two")
+    with pytest.raises(envcheck.EnvVarError, match="TB_WAVES_MIN_RATIO"):
+        waves.min_ratio()
+    monkeypatch.setenv("TB_WAVES_MIN_RATIO", "1.5")
+    assert waves.min_ratio() == 1.5
+
+
+def test_env_float_minimum(monkeypatch):
+    monkeypatch.setenv("TB_DEV_BACKOFF_MS", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="TB_DEV_BACKOFF_MS"):
+        envcheck.env_float("TB_DEV_BACKOFF_MS", 5.0, minimum=0.0)
+
+
+def test_env_choice(monkeypatch):
+    monkeypatch.delenv("TB_WAVES", raising=False)
+    assert envcheck.env_choice("TB_WAVES", "auto", ("auto", "0")) == "auto"
+    monkeypatch.setenv("TB_WAVES", "nope")
+    with pytest.raises(envcheck.EnvVarError, match="expected one of"):
+        envcheck.env_choice("TB_WAVES", "auto", ("auto", "0"))

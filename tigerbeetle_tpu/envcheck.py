@@ -1,0 +1,68 @@
+"""Validated environment-variable parsing.
+
+Tuning knobs (TB_DEV_WINDOW, TB_WAVES, ...) are read from the
+environment at import or call time; a typo used to surface as a bare
+``int()`` traceback or a failed ``assert`` deep inside the module that
+consumed it.  These helpers fail fast with an error that names the
+variable, the offending value, and the constraint it violated.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class EnvVarError(ValueError):
+    """An environment variable holds an unusable value."""
+
+
+def _fail(name: str, raw: str, why: str) -> "NoReturn":  # noqa: F821
+    raise EnvVarError(f"{name}={raw!r} invalid: {why}")
+
+
+def env_int(
+    name: str,
+    default: int,
+    *,
+    minimum: int | None = None,
+    maximum: int | None = None,
+) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        _fail(name, raw, "expected an integer")
+    if minimum is not None and value < minimum:
+        _fail(name, raw, f"must be >= {minimum}")
+    if maximum is not None and value > maximum:
+        _fail(name, raw, f"must be <= {maximum}")
+    return value
+
+
+def env_float(
+    name: str,
+    default: float,
+    *,
+    minimum: float | None = None,
+) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        _fail(name, raw, "expected a number")
+    if minimum is not None and value < minimum:
+        _fail(name, raw, f"must be >= {minimum}")
+    return value
+
+
+def env_choice(name: str, default: str, choices: tuple[str, ...]) -> str:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    if raw not in choices:
+        _fail(name, raw, "expected one of " + "/".join(choices))
+    return raw

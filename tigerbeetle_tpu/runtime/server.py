@@ -253,6 +253,13 @@ class ReplicaServer:
             self.poll_once()
 
     def close(self) -> None:
+        # Device-engine end-of-life barrier first: every outstanding
+        # reply future must resolve (host replay if the link is gone)
+        # or fail typed before the process tears down its I/O.
+        sm = getattr(self.replica, "sm", None)
+        dev = getattr(sm, "_dev", None)
+        if dev is not None and hasattr(dev, "close"):
+            dev.close()
         if self.replica.aof is not None:
             self.replica.aof.close()
         if self._trace_path:

@@ -44,7 +44,6 @@ balances from the authoritative table (not the host mirror).
 
 from __future__ import annotations
 
-import os
 import time as _time
 
 import numpy as np
@@ -52,32 +51,156 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from tigerbeetle_tpu import envcheck
 from tigerbeetle_tpu.state_machine import device_kernels as dk
+from tigerbeetle_tpu.types import EngineState
 
-_WINDOW = int(os.environ.get("TB_DEV_WINDOW", "96"))
-_RING = int(os.environ.get("TB_DEV_RING", "256"))
-assert 2 * _WINDOW <= _RING, "ring must hold two windows of summaries"
+_WINDOW = envcheck.env_int("TB_DEV_WINDOW", 96, minimum=1)
+_RING = envcheck.env_int("TB_DEV_RING", 256, minimum=2)
+
+
+def _validate_window_ring(window: int, ring: int) -> None:
+    if 2 * window > ring:
+        raise envcheck.EnvVarError(
+            f"TB_DEV_WINDOW={window} / TB_DEV_RING={ring} invalid: the "
+            "summary ring must hold two windows (2*TB_DEV_WINDOW <= "
+            "TB_DEV_RING)"
+        )
+
+
+_validate_window_ring(_WINDOW, _RING)
+
+# Link-robustness knobs: bounded retry with exponential backoff on
+# every link crossing, a health-probe cadence for re-promotion out of
+# degraded mode, and a checksum-scrub cadence during healthy operation
+# (0 disables the scrub).  All read at call time so tests can tighten
+# them per engine.
+_RETRIES = envcheck.env_int("TB_DEV_RETRIES", 3, minimum=0)
+_BACKOFF_MS = envcheck.env_float("TB_DEV_BACKOFF_MS", 5.0, minimum=0.0)
+_BACKOFF_CAP_MS = envcheck.env_float(
+    "TB_DEV_BACKOFF_CAP_MS", 200.0, minimum=0.0
+)
+_PROBE_EVERY = envcheck.env_int("TB_DEV_PROBE_EVERY", 8, minimum=1)
+_SCRUB_EVERY = envcheck.env_int("TB_DEV_SCRUB_EVERY", 256, minimum=0)
+
+
+class LinkError(RuntimeError):
+    """A device-link crossing failed (base for injected faults)."""
+
+
+class TransientLinkError(LinkError):
+    """Retryable: the crossing may succeed if reissued."""
+
+
+class FatalLinkError(LinkError):
+    """Not retryable: the link (or device state behind it) is gone."""
+
+
+class DeviceLostError(RuntimeError):
+    """The device link is lost: a crossing failed fatally or exhausted
+    its retry budget.  Raised to callers only when no exact host
+    answer exists (a stranded future after ``close()``); everywhere
+    else the engine catches it and demotes to the host path."""
+
+    def __init__(self, stage: str, cause: object = None) -> None:
+        self.stage = stage
+        self.cause = cause
+        detail = f": {cause}" if cause is not None else ""
+        super().__init__(f"device lost at {stage}{detail}")
+
+
+# Substrings that mark a runtime error as transient on this link
+# (JAX/PJRT surface gRPC-style status names in their messages).
+_TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "CANCELLED",
+    "temporarily",
+)
+
+
+def classify_link_error(exc: BaseException) -> str:
+    """-> "transient" (retry may succeed) or "fatal" (demote)."""
+    if isinstance(exc, TransientLinkError):
+        return "transient"
+    if isinstance(exc, (FatalLinkError, DeviceLostError)):
+        return "fatal"
+    msg = str(exc)
+    if any(marker in msg for marker in _TRANSIENT_MARKERS):
+        return "transient"
+    return "fatal"
+
+
+class DeviceLink:
+    """Every host<->device crossing the engine makes, behind one seam.
+
+    The engine never calls jax transfer/dispatch APIs directly; it
+    goes through this object so the chaos harness (testing/chaos.py)
+    can interpose a seeded fault-injecting shim, and so retry/
+    classification lives in exactly one place (DeviceEngine._retry).
+    Stages: "h2d" (uploads), "dispatch" (kernel launches), "fetch"
+    (d2h reads), "probe" (health check).
+    """
+
+    def device_put(self, array, sharding=None):
+        if sharding is not None:
+            return jax.device_put(array, sharding)
+        return jax.device_put(array)
+
+    def block_until_ready(self, arrays):
+        return jax.block_until_ready(arrays)
+
+    def fetch(self, array) -> np.ndarray:
+        return np.asarray(array)
+
+    def dispatch(self, fn, *args):
+        return fn(*args)
+
+    def probe(self) -> None:
+        """Tiny h2d + d2h round trip; raises if the link is dead."""
+        echo = self.fetch(self.device_put(np.arange(4, dtype=np.uint64)))
+        if int(echo[3]) != 3:
+            raise FatalLinkError("probe round trip corrupted")
 
 
 class ReplyFuture:
-    """Reply bytes that materialize at the batch's window rotation."""
+    """Reply bytes that materialize at the batch's window rotation.
 
-    __slots__ = ("_value", "_engine")
+    A future always terminates: it resolves with exact reply bytes
+    (device summary, or host replay after a demotion) or fails with a
+    typed error — ``result()`` never strands the caller in an assert
+    when the link dies mid-window.
+    """
+
+    __slots__ = ("_value", "_engine", "_exc")
 
     def __init__(self, engine=None, value: bytes | None = None) -> None:
         self._value = value
         self._engine = engine
+        self._exc: BaseException | None = None
 
     def done(self) -> bool:
-        return self._value is not None
+        return self._value is not None or self._exc is not None
 
     def resolve(self, value: bytes) -> None:
         self._value = value
 
+    def fail(self, exc: BaseException) -> None:
+        self._exc = exc
+
     def result(self) -> bytes:
-        if self._value is None:
+        if self._value is None and self._exc is None and (
+            self._engine is not None
+        ):
             self._engine.drain()
-            assert self._value is not None, "drain did not materialize reply"
+        if self._exc is not None:
+            raise self._exc
+        if self._value is None:
+            raise DeviceLostError(
+                "drain", "reply never materialized and no host replay ran"
+            )
         return self._value
 
 
@@ -124,10 +247,25 @@ _SEMANTIC_KINDS = tuple(_KERNELS)
 class DeviceEngine:
     """Authoritative device tables + windowed semantic dispatch."""
 
-    def __init__(self, capacity: int, mirror) -> None:
+    def __init__(self, capacity: int, mirror, link: DeviceLink | None = None) -> None:
         self.capacity = capacity
         self.mirror = mirror  # host bookkeeping copy (recovery + parity)
         self.window = _WINDOW
+        self.link = link if link is not None else DeviceLink()
+        # Lifecycle (types.EngineState): healthy -> degraded on fatal
+        # link loss (host mirror becomes authoritative, every
+        # outstanding future is replayed exactly on the host) ->
+        # repromoting (probe + table re-upload + checksum handshake)
+        # -> healthy.
+        self.state = EngineState.healthy
+        self.last_demotion: str | None = None
+        self.last_probe_failure: str | None = None
+        self._degraded_submits = 0
+        self._last_scrub_fetch = 0
+        self._closed = False
+        # Initialized before the first _place below can retry.
+        self.stat_retries = 0
+        self.stat_link_errors = 0
         # Multi-device: the authoritative tables shard ROW-WISE across
         # every visible device (NamedSharding over a 1-D "shard" mesh);
         # the semantic kernels then run SPMD with XLA-inserted
@@ -142,16 +280,31 @@ class DeviceEngine:
 
             mesh = Mesh(np.array(devices), ("shard",))
             self.sharding = NamedSharding(mesh, P("shard", None))
-        self.balances = self._place(jnp.zeros((capacity, 8), jnp.uint64))
-        self.meta = self._place(jnp.zeros((capacity, 2), jnp.uint32))
         self._meta_host = np.zeros((capacity, 2), np.uint32)
         self.ring = jnp.zeros((_RING, dk.SUMMARY_WORDS), jnp.uint64)
         self._ring_at = 0
+        try:
+            self.balances = self._place(jnp.zeros((capacity, 8), jnp.uint64))
+            self.meta = self._place(jnp.zeros((capacity, 2), jnp.uint32))
+        except DeviceLostError as exc:
+            # Born degraded: the link was already dead at construction.
+            # Placeholders come from plain jnp (default backend, not the
+            # link) so degraded-mode accessors have well-typed handles;
+            # re-promotion replaces them from the mirror.
+            self.state = EngineState.degraded
+            self.last_demotion = repr(exc)
+            self.balances = jnp.zeros((capacity, 8), jnp.uint64)
+            self.meta = jnp.zeros((capacity, 2), jnp.uint32)
         # Window pipeline: _pending accumulates host-side; _launched is
-        # the window currently executing on device.
+        # the window currently executing on device; _recovering holds a
+        # window mid-exact-recovery — detached from _launched so a
+        # re-entrant drain (host fallbacks read the table, which
+        # drains) cannot re-rotate it, but still owned so a demotion
+        # mid-recovery replays its unresolved futures in order.
         self._pending: list[_InFlight] = []
         self._pending_semantic = 0
         self._launched: list[_InFlight] = []
+        self._recovering: list[_InFlight] = []
         # Write-behind lane for host-resolved batches (exact path).
         self._q: list[tuple] = []
         self._queued = 0
@@ -160,16 +313,61 @@ class DeviceEngine:
         self.stat_semantic_events = 0
         self.stat_fallback_batches = 0
         self.stat_fetches = 0
+        # Link-robustness counters (bench.py reports them per config;
+        # retry/error counters live above, before the first upload).
+        self.stat_demotions = 0
+        self.stat_repromotions = 0
+        self.stat_probe_failures = 0
+        self.stat_degraded_events = 0
+        self.stat_scrubs = 0
+        self.stat_scrub_heals = 0
         # Wall-time split (seconds) for perf forensics.
         self.stat_t_h2d = 0.0
         self.stat_t_dispatch = 0.0
         self.stat_t_fetch = 0.0
         self.stat_t_finish = 0.0
 
+    # ------------------------------------------------------------------
+    # Link crossings: bounded retry + transient/fatal classification.
+    # Every h2d upload, kernel dispatch, and d2h fetch funnels through
+    # _retry, so a flaky link costs backoff, and a dead one raises ONE
+    # typed error (DeviceLostError) that the lifecycle guards catch.
+
+    def _retry(self, fn, stage: str):
+        delay_s = _BACKOFF_MS / 1e3
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as exc:  # noqa: BLE001
+                if isinstance(exc, DeviceLostError):
+                    raise
+                self.stat_link_errors += 1
+                if (
+                    classify_link_error(exc) != "transient"
+                    or attempt >= _RETRIES
+                ):
+                    raise DeviceLostError(stage, exc) from exc
+                attempt += 1
+                self.stat_retries += 1
+                if delay_s > 0:
+                    _time.sleep(delay_s)
+                delay_s = min(delay_s * 2, _BACKOFF_CAP_MS / 1e3)
+
+    def _put(self, array):
+        return self._retry(lambda: self.link.device_put(array), "h2d")
+
+    def _run(self, fn, *args):
+        return self._retry(lambda: self.link.dispatch(fn, *args), "dispatch")
+
     def _place(self, table):
         if self.sharding is None:
-            return table
-        return jax.device_put(table, self.sharding)
+            sharding = None
+        else:
+            sharding = self.sharding
+        return self._retry(
+            lambda: self.link.device_put(table, sharding), "h2d"
+        )
 
     def prewarm(self, kinds) -> None:
         """Pay the one-time per-process costs OFF the hot path: the
@@ -183,6 +381,16 @@ class DeviceEngine:
         router punts to the host path re-executes there, and with no
         native engine built that means wave/scan kernels whose first
         compile must not land inside a timed window."""
+        if self.state is not EngineState.healthy:
+            return
+        try:
+            self._prewarm_inner(kinds)
+        except Exception as exc:  # noqa: BLE001
+            # A device that cannot even warm up cannot serve the
+            # window pipeline: degrade instead of dying at setup.
+            self._demote(DeviceLostError("prewarm", exc))
+
+    def _prewarm_inner(self, kinds) -> None:
         kinds = list(kinds)
         if "waves" in kinds:
             from tigerbeetle_tpu.state_machine import waves as _waves
@@ -193,14 +401,14 @@ class DeviceEngine:
             return
         tiers = sorted({self._tier(1), self._tier(self.window)})
         for ncols, dtype in {dk.PK_SPEC[k] for k in kinds}:
-            jax.device_put(np.zeros((dk.B, ncols), dtype))
+            self._put(np.zeros((dk.B, ncols), dtype))
             for W in tiers:
-                jax.device_put(np.zeros((W, dk.B, ncols), dtype))
+                self._put(np.zeros((W, dk.B, ncols), dtype))
         # The per-window ns/tsb arrays transfer from host at launch —
         # their transfer plans need warming like the buffers'.
         for W in tiers:
-            jax.device_put(np.zeros(W, np.int64))
-            jax.device_put(np.zeros(W, np.uint64))
+            self._put(np.zeros(W, np.int64))
+            self._put(np.zeros(W, np.uint64))
         table = jnp.zeros_like(self.balances)
         meta = jnp.zeros_like(self.meta)
         ring = jnp.zeros_like(self.ring)
@@ -223,7 +431,7 @@ class DeviceEngine:
                             table, meta, ring, 0, big, 0, ns, tsb
                         )
                     )
-        jax.block_until_ready(outs)
+        self._retry(lambda: self.link.block_until_ready(outs), "h2d")
 
     # ------------------------------------------------------------------
     # Account meta maintenance (create_accounts path).  Rides the
@@ -234,6 +442,11 @@ class DeviceEngine:
         slots = np.asarray(slots, np.int64)
         self._meta_host[slots, 0] = acct_flags
         self._meta_host[slots, 1] = acct_ledger
+        if self.state is not EngineState.healthy:
+            # The host copy above is authoritative while degraded;
+            # re-promotion re-uploads the whole meta table from it.  A
+            # queued record would force a doomed launch at next drain.
+            return
         self._pending.append(
             _InFlight(
                 "meta", None, None,
@@ -249,6 +462,8 @@ class DeviceEngine:
         """Linked create_accounts rollback support."""
         slots = np.asarray(slots, np.int64)
         self._meta_host[slots] = 0
+        if self.state is not EngineState.healthy:
+            return  # see add_accounts
         z = np.zeros(len(slots), np.uint32)
         self._pending.append(
             _InFlight("meta", None, None, meta_args=(slots, z, z))
@@ -263,22 +478,37 @@ class DeviceEngine:
         if was_sharded and capacity % self.sharding.mesh.devices.size != 0:
             self.sharding = None  # re-place replicated from here on
         extra = capacity - self.capacity
+        old_capacity = self.capacity
+        mh = np.zeros((capacity, 2), np.uint32)
+        mh[:old_capacity] = self._meta_host
+        self._meta_host = mh
+        # Capacity is committed before any link work: a demotion mid-
+        # widen serves from the mirror at the NEW capacity, and
+        # re-promotion rebuilds both tables from the mirror at it.
+        self.capacity = capacity
+        if self.state is not EngineState.healthy:
+            return
 
         def widen(table, width, dtype):
             # Previously-sharded tables come back through the host (row
             # boundaries move between devices on grow, and a dropped
             # sharding must not leave a committed sharded base behind).
-            base = jax.device_get(table) if was_sharded else table
+            base = (
+                self._retry(lambda: self.link.fetch(table), "fetch")
+                if was_sharded
+                else table
+            )
             return self._place(
-                jnp.concatenate([base, jnp.zeros((extra, width), dtype)])
+                self._run(
+                    jnp.concatenate, [base, jnp.zeros((extra, width), dtype)]
+                )
             )
 
-        self.balances = widen(self.balances, 8, jnp.uint64)
-        self.meta = widen(self.meta, 2, jnp.uint32)
-        mh = np.zeros((capacity, 2), np.uint32)
-        mh[: self.capacity] = self._meta_host
-        self._meta_host = mh
-        self.capacity = capacity
+        try:
+            self.balances = widen(self.balances, 8, jnp.uint64)
+            self.meta = widen(self.meta, 2, jnp.uint32)
+        except DeviceLostError as exc:
+            self._demote(exc)
 
     # ------------------------------------------------------------------
     # Semantic dispatch.
@@ -290,8 +520,23 @@ class DeviceEngine:
         `finish(summary) -> bytes` runs at materialization (device codes
         -> bookkeeping + reply).  `fallback() -> bytes` re-executes the
         batch exactly on the host engine against the mirror.
+
+        In degraded mode the batch never touches the link: it resolves
+        immediately through the exact host path (bit-identical reply).
         """
+        if self.state is not EngineState.healthy:
+            fut = ReplyFuture(self)
+            self.stat_degraded_events += n
+            self._resolve_host_now(fut, fallback)
+            return fut
         self.flush()  # earlier exact-path deltas must precede us
+        if self.state is not EngineState.healthy:
+            # The flush itself lost the link: don't queue onto a
+            # stream whose next launch is doomed — serve host-side.
+            fut = ReplyFuture(self)
+            self.stat_degraded_events += n
+            self._resolve_host_now(fut, fallback)
+            return fut
         fut = ReplyFuture(self)
         rec = _InFlight(
             kind, fut, finish, pk=pk, n=n, ts_base=ts_base,
@@ -300,7 +545,10 @@ class DeviceEngine:
         self._pending.append(rec)
         self._pending_semantic += 1
         if self._pending_semantic >= self.window:
-            self._rotate()
+            try:
+                self._rotate()
+            except DeviceLostError as exc:
+                self._demote(exc)
         return fut
 
     def lookup(self, slots, finish) -> ReplyFuture:
@@ -308,17 +556,33 @@ class DeviceEngine:
         record stream, so it sees every earlier batch's effects.
         `finish(rows)` builds the reply from the fetched (k, 8) rows
         at materialization."""
-        fut = ReplyFuture(self)
         slots = np.asarray(slots, np.int64)
+        if self.state is not EngineState.healthy:
+            fut = ReplyFuture(self)
+            self._resolve_host_now(
+                fut, lambda: finish(self.mirror.rows8(slots))
+            )
+            return fut
+        fut = ReplyFuture(self)
         rec = _InFlight("lookup", fut, finish, slots=slots)
         self._pending.append(rec)
         return fut
+
+    @staticmethod
+    def _resolve_host_now(fut: ReplyFuture, produce) -> None:
+        try:
+            fut.resolve(produce())
+        except Exception as exc:  # noqa: BLE001
+            # A host-path failure must still terminate the future; the
+            # caller sees the real error at result().
+            fut.fail(exc)
+            raise
 
     def _gather(self, slots):
         pad = ((len(slots) + 255) & ~255) or 256
         sl = np.full(pad, -1, np.int64)
         sl[: len(slots)] = slots
-        return dk.lookup(self.balances, jnp.asarray(sl))
+        return self._run(dk.lookup, self.balances, jnp.asarray(sl))
 
     # ------------------------------------------------------------------
     # Window launch: one h2d per column layout (device idle at call
@@ -412,26 +676,32 @@ class DeviceEngine:
             bufs[spec][3] = cur + len(urecs)
         dev_bufs = {
             spec: (
-                jax.device_put(big),
-                jax.device_put(ns),
-                jax.device_put(tsb),
+                self._put(big),
+                self._put(ns),
+                self._put(tsb),
             )
             for spec, (big, ns, tsb, _cur) in bufs.items()
         }
         dev_solo = {
-            i: jax.device_put(urecs[0].pk)
+            i: self._put(urecs[0].pk)
             for i, (ukind, urecs) in enumerate(units)
             if ukind == "solo"
         }
         # ONE blocking sync (each blocking call costs a ~100 ms tunnel
         # round trip).
-        jax.block_until_ready([list(dev_bufs.values()), list(dev_solo.values())])
+        self._retry(
+            lambda: self.link.block_until_ready(
+                [list(dev_bufs.values()), list(dev_solo.values())]
+            ),
+            "h2d",
+        )
         t1 = _time.perf_counter()
         self.stat_t_h2d += t1 - t0
         for i, (ukind, urecs) in enumerate(units):
             if ukind == "meta":
                 slots, flags, ledger = urecs[0].meta_args
-                self.meta = dk.meta_update(
+                self.meta = self._run(
+                    dk.meta_update,
                     self.meta, jnp.asarray(slots), jnp.asarray(flags),
                     jnp.asarray(ledger),
                 )
@@ -441,7 +711,8 @@ class DeviceEngine:
                 continue
             if ukind == "solo":
                 rec = urecs[0]
-                self.balances, self.ring = _KERNELS[rec.kind](
+                self.balances, self.ring = self._run(
+                    _KERNELS[rec.kind],
                     self.balances, self.meta, self.ring, self._ring_at,
                     dev_solo[i], rec.n, jnp.uint64(rec.ts_base),
                 )
@@ -450,7 +721,8 @@ class DeviceEngine:
                 continue
             big, ns, tsb = dev_bufs[dk.PK_SPEC[urecs[0].kind]]
             scan_fn = dk.scan_win_kernels[urecs[0].kind][len(urecs)]
-            self.balances, self.ring = scan_fn(
+            self.balances, self.ring = self._run(
+                scan_fn,
                 self.balances, self.meta, self.ring, self._ring_at,
                 big, offsets[i], ns, tsb,
             )
@@ -461,8 +733,8 @@ class DeviceEngine:
 
     def _dispatch(self, rec: _InFlight) -> None:
         """Immediate single-batch dispatch (fallback re-dispatch path)."""
-        kernel = _KERNELS[rec.kind]
-        self.balances, self.ring = kernel(
+        self.balances, self.ring = self._run(
+            _KERNELS[rec.kind],
             self.balances, self.meta, self.ring, self._ring_at,
             jnp.asarray(rec.pk), rec.n, jnp.uint64(rec.ts_base),
         )
@@ -507,10 +779,13 @@ class DeviceEngine:
         t0 = _time.perf_counter()
         if any(r.kind in _SEMANTIC_KINDS for r in recs):
             self.stat_fetches += 1
-            ring_np = np.asarray(self.ring)  # THE burst fetch
+            # THE burst fetch.
+            ring_np = self._retry(lambda: self.link.fetch(self.ring), "fetch")
         for rec in recs:
             if rec.kind == "lookup" and rec.handle is not None:
-                rec.rows = np.asarray(rec.handle)
+                rec.rows = self._retry(
+                    lambda h=rec.handle: self.link.fetch(h), "fetch"
+                )
                 rec.handle = None
         self.stat_t_fetch += _time.perf_counter() - t0
         return ring_np
@@ -541,23 +816,41 @@ class DeviceEngine:
         """Window boundary: fetch the launched window's ring, and —
         when it is clean — launch the pending window while the host
         still holds the fetched results, then finish the old window's
-        bookkeeping overlapped with the new window's device work."""
-        prev, self._launched = self._launched, []
+        bookkeeping overlapped with the new window's device work.
+
+        Raises DeviceLostError on unrecoverable link loss; records are
+        reassigned between _launched/_pending only AFTER the crossing
+        that covers them succeeded, so the _demote caller always sees
+        every unresolved record still in the stream lists, in order.
+        """
+        prev = self._launched
         ring_np = self._fetch_ring(prev) if prev else None
         if prev and (ring_np is None or self._window_clean(prev, ring_np)):
-            nxt, self._pending = self._pending, []
-            self._pending_semantic = 0
-            self._launch(nxt)
+            nxt = self._pending
+            self._launch(nxt)  # may raise: prev + nxt stay tracked
             self._launched = nxt
-            self._resolve_clean(prev, ring_np)
+            self._pending = []
+            self._pending_semantic = 0
+            self._resolve_clean(prev, ring_np)  # host-only, cannot lose
             return
         if prev:
             # Fallback in the window: serial exact recovery first.
+            # Detach prev into the recovery slot: the host fallbacks it
+            # runs re-enter drain() via table reads, and a nested
+            # rotate must NOT see this window as launched (it would
+            # re-resolve it).  On device loss mid-recovery the records
+            # stay in _recovering for _demote; on success the slot
+            # clears.
+            self._launched = []
+            self._recovering = prev
             self._resolve_recovery(prev, ring_np)
-        nxt, self._pending = self._pending, []
-        self._pending_semantic = 0
-        self._launch(nxt)
+            self._recovering = []
+        self._launched = []
+        nxt = self._pending
+        self._launch(nxt)  # may raise: nxt still in _pending
         self._launched = nxt
+        self._pending = []
+        self._pending_semantic = 0
 
     def _resolve_recovery(self, covered, ring_np) -> None:
         """Exact recovery: resolve in order until the flagged batch,
@@ -591,7 +884,8 @@ class DeviceEngine:
             for rec in covered:
                 if rec.kind == "meta":
                     slots, flags, ledger = rec.meta_args
-                    self.meta = dk.meta_update(
+                    self.meta = self._run(
+                        dk.meta_update,
                         self.meta, jnp.asarray(slots), jnp.asarray(flags),
                         jnp.asarray(ledger),
                     )
@@ -601,22 +895,215 @@ class DeviceEngine:
                     self._dispatch(rec)
             ring_np = None
 
+    def _mirror_table_np(self) -> np.ndarray:
+        """Device-layout (capacity, 8) snapshot of the host mirror."""
+        return self.mirror.table8(self.capacity)
+
+    def _device_checksum(self) -> np.ndarray:
+        """Round-trip the device-side balance-table digest (the ONE
+        checksum crossing verify paths and the health digest share)."""
+        return self._retry(
+            lambda: self.link.fetch(
+                self.link.dispatch(dk.checksum, self.balances)
+            ),
+            "fetch",
+        )
+
+    @staticmethod
+    def _meta_digest(meta):
+        """4-word digest of the (capacity, 2) account-meta table —
+        the shared digest formula (mirror.digest_columns), so it can
+        never drift from the balance-table compare."""
+        from tigerbeetle_tpu.state_machine.mirror import digest_columns
+
+        return digest_columns(meta)
+
+    def _device_health_digest(self) -> np.ndarray:
+        """Balances digest + meta digest from the DEVICE tables — what
+        the scrub and the re-promotion handshake compare against the
+        host's copy (meta corruption must be as detectable as balance
+        corruption: the kernels' ladder verdicts read it)."""
+        bal = self._device_checksum()
+        meta = self._retry(
+            lambda: self.link.fetch(
+                self.link.dispatch(self._meta_digest, self.meta)
+            ),
+            "fetch",
+        )
+        return np.concatenate([bal, meta])
+
+    def _host_health_digest(self) -> np.ndarray:
+        return np.concatenate(
+            [
+                self.mirror.checksum8(self.capacity),
+                self._meta_digest(self._meta_host),
+            ]
+        )
+
     def _upload_from_mirror(self) -> None:
-        table = np.zeros((self.capacity, 8), np.uint64)
-        n = min(len(self.mirror.lo), self.capacity)
-        table[:n, 0::2] = self.mirror.lo[:n]
-        table[:n, 1::2] = self.mirror.hi[:n]
-        self.balances = self._place(jnp.asarray(table))
+        self.balances = self._place(jnp.asarray(self._mirror_table_np()))
 
     def drain(self) -> None:
-        while self._launched or self._pending:
-            self._rotate()
+        # A drain nested inside exact recovery (host fallbacks read the
+        # table, which drains) must NOT touch the stream: launching the
+        # pending window mid-recovery would execute it out of
+        # submission order against a table recovery is about to
+        # rebuild, and a nested dirty rotation would clobber the
+        # _recovering slot.  The outer recovery finishes the stream.
+        while (self._launched or self._pending) and not self._recovering:
+            try:
+                self._rotate()
+            except DeviceLostError as exc:
+                self._demote(exc)
+
+    def close(self) -> None:
+        """End-of-life barrier: every outstanding future resolves (via
+        drain, demoting to exact host replay if the link dies) or
+        fails with a typed DeviceLostError — a caller blocked in
+        result() is never stranded."""
+        try:
+            self.drain()
+            self.flush()
+        except Exception as exc:  # noqa: BLE001 — host replay failed too
+            for rec in self._recovering + self._launched + self._pending:
+                if rec.future is not None and not rec.future.done():
+                    rec.future.fail(DeviceLostError("close", exc))
+            self._recovering = []
+            self._launched = []
+            self._pending = []
+            self._pending_semantic = 0
+            self._q.clear()
+            self._queued = 0
+        self._closed = True
+
+    # ------------------------------------------------------------------
+    # Degraded-mode lifecycle: demote on fatal link loss, serve exact
+    # replies from the host engine against the mirror, probe + re-upload
+    # + checksum handshake to re-promote, and a periodic checksum scrub
+    # while healthy.
+
+    def _demote(self, exc: BaseException) -> None:
+        """Fatal link loss: the host mirror becomes authoritative.
+        Every outstanding future resolves IN SUBMISSION ORDER through
+        the exact host path — bit-identical to what the device would
+        have replied — and later submits route host-side until a
+        re-promotion handshake passes."""
+        self.state = EngineState.degraded
+        self.stat_demotions += 1
+        self.last_demotion = repr(exc)
+        self._degraded_submits = 0
+        outstanding = self._recovering + self._launched + self._pending
+        # Clear BEFORE replaying: the host path may drain/read this
+        # engine re-entrantly, and must see an empty stream.
+        self._recovering = []
+        self._launched = []
+        self._pending = []
+        self._pending_semantic = 0
+        # Write-behind deltas exist on the mirror already; the device
+        # copy is abandoned (re-promotion re-uploads the whole table).
+        self._q.clear()
+        self._queued = 0
+        for rec in outstanding:
+            self._replay_record_on_host(rec)
+
+    def _replay_record_on_host(self, rec: _InFlight) -> None:
+        fut = rec.future
+        if fut is None or fut.done():
+            return
+        try:
+            if rec.kind == "lookup":
+                fut.resolve(rec.finish(self.mirror.rows8(rec.slots)))
+            else:
+                self.stat_degraded_events += rec.n
+                fut.resolve(rec.fallback())
+        except Exception as exc:  # noqa: BLE001
+            # The host replay itself failed: fail THIS future with the
+            # real error and keep terminating the rest of the stream.
+            fut.fail(exc)
+
+    def tick(self) -> None:
+        """Periodic lifecycle work, called once per committed
+        operation by the state machine (tpu.commit_async): in degraded
+        mode, a health probe + re-promotion attempt every _PROBE_EVERY
+        operations; while healthy, the checksum scrub every
+        _SCRUB_EVERY ring fetches."""
+        if self.state is EngineState.degraded:
+            self._degraded_submits += 1
+            if self._degraded_submits >= _PROBE_EVERY:
+                self._degraded_submits = 0
+                self.try_repromote()
+            return
+        if (
+            _SCRUB_EVERY
+            and self.state is EngineState.healthy
+            and self.stat_fetches >= self._last_scrub_fetch + _SCRUB_EVERY
+        ):
+            try:
+                self.scrub()
+            except DeviceLostError as exc:
+                self._demote(exc)
+
+    def try_repromote(self) -> bool:
+        """Health probe -> table re-upload from the mirror -> checksum
+        handshake.  The device becomes authoritative again ONLY if the
+        round-tripped digest matches the mirror's; any failure leaves
+        the engine degraded (and counted), never half-promoted."""
+        if self.state is EngineState.healthy:
+            return True
+        if self._closed:
+            return False
+        self.state = EngineState.repromoting
+        try:
+            self._retry(self.link.probe, "probe")
+            self._upload_from_mirror()
+            self.meta = self._place(jnp.asarray(self._meta_host))
+            self.ring = jnp.zeros((_RING, dk.SUMMARY_WORDS), jnp.uint64)
+            self._ring_at = 0
+            dev_sum = self._device_health_digest()
+            host_sum = self._host_health_digest()
+            if not (dev_sum == host_sum).all():
+                raise FatalLinkError(
+                    "re-promotion checksum handshake mismatch: "
+                    f"device={dev_sum.tolist()} host={host_sum.tolist()}"
+                )
+        except Exception as exc:  # noqa: BLE001
+            self.state = EngineState.degraded
+            self.stat_probe_failures += 1
+            self.last_probe_failure = repr(exc)
+            return False
+        self.state = EngineState.healthy
+        self.stat_repromotions += 1
+        return True
+
+    def scrub(self) -> bool:
+        """Checksum-compare the device table against the mirror while
+        idle; heal divergence by re-uploading from the mirror.  Returns
+        True when the tables already matched.  Raises DeviceLostError
+        if the link dies mid-scrub (caller demotes)."""
+        if (
+            self.state is not EngineState.healthy
+            or self.has_inflight()
+            or self._queued
+        ):
+            return True
+        self._last_scrub_fetch = self.stat_fetches
+        self.stat_scrubs += 1
+        if (self._device_health_digest() == self._host_health_digest()).all():
+            return True
+        self.stat_scrub_heals += 1
+        self._upload_from_mirror()
+        self.meta = self._place(jnp.asarray(self._meta_host))
+        return False
 
     # ------------------------------------------------------------------
     # Write-behind lane (host exact path) — kernel_fast.DeviceTable API.
 
     def enqueue(self, slots, cols, add_lo, add_hi) -> None:
         if self._suppress_enqueue or len(slots) == 0:
+            return
+        if self.state is not EngineState.healthy:
+            # Degraded: the mirror (already updated by the host path)
+            # is authoritative; re-promotion re-uploads the full table.
             return
         # Exact-path deltas only arrive after a drain (the host path
         # drains before running), so they can never overtake queued
@@ -637,6 +1124,16 @@ class DeviceEngine:
     def flush(self) -> None:
         if not self._queued:
             return
+        if self.state is not EngineState.healthy:
+            self._q.clear()
+            self._queued = 0
+            return
+        try:
+            self._flush_inner()
+        except DeviceLostError as exc:
+            self._demote(exc)
+
+    def _flush_inner(self) -> None:
         from tigerbeetle_tpu.state_machine.mirror import compact_deltas
 
         slots = np.concatenate([e[0] for e in self._q])
@@ -674,18 +1171,40 @@ class DeviceEngine:
             packed[2, take:] = 0
             packed[3, :take] = d_hi[at : at + take]
             packed[3, take:] = 0
-            self.balances = dk.apply_deltas(self.balances, jnp.asarray(packed))
+            self.balances = self._run(
+                dk.apply_deltas, self.balances, jnp.asarray(packed)
+            )
             at += take
         # Flushed deltas must land before any later queued meta/lookup
         # records are dispatched — but those only dispatch at the next
         # launch, which follows this flush in program order.
 
     def read(self):
-        """Drain barrier + device handle (DeviceTable API compat)."""
+        """Drain barrier + table handle (DeviceTable API compat).  In
+        degraded mode the authoritative bytes live in the host mirror;
+        callers get a default-backend array built from it (NOT routed
+        through the possibly-dead link).  During exact recovery the
+        mirror is likewise the truth — it reflects exactly the stream
+        prefix before the batch being re-executed, while the device
+        table still holds the whole window's kernel effects."""
+        if self._recovering:
+            return jnp.asarray(self._mirror_table_np())
         self.drain()
         self.flush()
+        if self.state is not EngineState.healthy:
+            return jnp.asarray(self._mirror_table_np())
         return self.balances
 
     def checksum(self) -> np.ndarray:
-        """Device-side table digest (drained + flushed first)."""
-        return np.asarray(dk.checksum(self.read()))
+        """Authoritative-table digest (drained + flushed first): the
+        device table while healthy, the mirror (computed host-side,
+        no device work at all) while degraded."""
+        self.drain()
+        self.flush()
+        if self.state is not EngineState.healthy:
+            return self.mirror.checksum8(self.capacity)
+        try:
+            return self._device_checksum()
+        except DeviceLostError as exc:
+            self._demote(exc)
+            return self.mirror.checksum8(self.capacity)

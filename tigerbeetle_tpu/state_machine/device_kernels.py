@@ -66,13 +66,17 @@ from tigerbeetle_tpu.types import CreateTransferResult as CTR
 # Fixed event bucket (batches pad up to this; larger batches take the
 # host path).  Tests shrink it via TB_DEV_B — CPU-backend matmuls at
 # the production size would dominate the suite's runtime.
-B = int(os.environ.get("TB_DEV_B", "8192"))
-# _accum_cols exactness bound: f32 partial sums of 8-bit pieces over at
-# most 4B rows (the two_phase add matmul) must stay below 2^24.
+from tigerbeetle_tpu import envcheck as _envcheck
+
+# Upper bound 8192: the linked kernel packs (event << 1 | side) into 14
+# key bits and masks events with B-1, and f32 partial sums of 8-bit
+# pieces over 4B rows (the two_phase add matmul) must stay below 2^24.
+B = _envcheck.env_int("TB_DEV_B", 8192, minimum=1, maximum=8192)
+if B & (B - 1) != 0:
+    raise _envcheck.EnvVarError(
+        f"TB_DEV_B={B} invalid: must be a power of 2 <= 8192"
+    )
 assert 4 * B * 255 < (1 << 24), "TB_DEV_B too large for exact f32 sums"
-# The linked kernel packs (event << 1 | side) into 14 key bits and
-# masks events with B-1 (see _linked's single-operand sort).
-assert B <= 8192 and B & (B - 1) == 0, "TB_DEV_B must be a power of 2 <= 8192"
 SUMMARY_WORDS = 64
 FAIL_CAP = SUMMARY_WORDS - 4   # failure entries per batch summary
 

@@ -46,6 +46,28 @@ def _sub_u128(a_lo, a_hi, b_lo, b_hi):
     return lo, hi, under
 
 
+def digest_columns(table):
+    """Order-sensitive digest of a (rows, C) unsigned table: per-column
+    u64 sums plus golden-ratio row-mixed sums, 2C words total — the
+    same family as device_kernels.checksum.  ONE implementation feeds
+    every integrity compare (checkpoint parity, healthy-mode scrub,
+    re-promotion handshake, account-meta digest) so the formula cannot
+    drift between the host and device sides.  Works on numpy and jnp
+    arrays alike (the latter lets the device compute its own digest so
+    only 2C words cross the link)."""
+    if isinstance(table, np.ndarray):
+        xp = np
+    else:
+        import jax.numpy as xp
+    m = table.astype(xp.uint64)
+    col_sums = m.sum(axis=0, dtype=xp.uint64)
+    rows = xp.arange(m.shape[0], dtype=xp.uint64)[:, None]
+    mixed = (
+        m * (rows * xp.uint64(0x9E3779B97F4A7C15) + xp.uint64(1))
+    ).sum(axis=0, dtype=xp.uint64)
+    return xp.concatenate([col_sums, mixed])
+
+
 def compact_deltas(slots, cols, amt_lo, amt_hi):
     """Group (slot, col, amount) contributions into exact u128 sums.
 
@@ -99,6 +121,22 @@ class BalanceMirror:
         out[:, 0::2] = self.lo[slots]
         out[:, 1::2] = self.hi[slots]
         return out
+
+    def table8(self, capacity: int) -> np.ndarray:
+        """Full (capacity, 8) device-layout table (zero-padded past the
+        mirror's rows) — the re-upload image for demoted engines."""
+        table = np.zeros((capacity, 8), np.uint64)
+        n = min(len(self.lo), capacity)
+        table[:n, 0::2] = self.lo[:n]
+        table[:n, 1::2] = self.hi[:n]
+        return table
+
+    def checksum8(self, capacity: int) -> np.ndarray:
+        """Host-side digest of the first `capacity` rows in device
+        layout, matching device_kernels.checksum word-for-word.  Used
+        by the checkpoint parity tripwire, the healthy-mode scrub, and
+        the re-promotion handshake."""
+        return digest_columns(self.table8(capacity))
 
     def set_rows8(self, slots: np.ndarray, rows: np.ndarray) -> None:
         """Overwrite rows from (k, 8) device-layout snapshots.

@@ -124,7 +124,9 @@ def min_ratio() -> float:
     degrades toward per-event waves and the scan's single fused
     dispatch wins.  Read live (like mode()) so tests and bench arms
     can toggle TB_WAVES_MIN_RATIO after import."""
-    return float(os.environ.get("TB_WAVES_MIN_RATIO", "2.0"))
+    from tigerbeetle_tpu import envcheck
+
+    return envcheck.env_float("TB_WAVES_MIN_RATIO", 2.0, minimum=0.0)
 
 
 def mode() -> str:
@@ -144,7 +146,11 @@ def mode() -> str:
     - "scan": route to the JAX exact path, never plan waves — the
       pure sequential scan on identical routing, the honest control
       for wave-vs-scan benchmarks."""
-    return os.environ.get("TB_WAVES", "auto")
+    from tigerbeetle_tpu import envcheck
+
+    return envcheck.env_choice(
+        "TB_WAVES", "auto", ("auto", "0", "1", "exact", "scan")
+    )
 
 
 # ---------------------------------------------------------------------------

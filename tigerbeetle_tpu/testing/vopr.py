@@ -243,9 +243,33 @@ class Vopr:
                  queries: bool = False,
                  reconfigure_nemesis: bool = False,
                  partition_probability: float = 0.0,
+                 device_loss_probability: float = 0.0,
                  state_machine_factory=None) -> None:
         self.seed = seed
         self.rng = np.random.default_rng(seed + 1)
+        # Device-loss nemesis (opt-in, like partitions): replicas run
+        # the device-authoritative engine behind seeded ChaosLinks
+        # (testing/chaos.py), and the nemesis kills/heals those links
+        # mid-run.  The degraded-mode lifecycle must keep replies
+        # bit-identical across replicas losing their device at
+        # DIFFERENT times — enforced by the existing hash-log
+        # convergence checker.
+        self.device_loss_probability = device_loss_probability
+        self._chaos_links: list = []
+        if device_loss_probability > 0.0:
+            if state_machine_factory is not None:
+                # The nemesis can only target links it owns; silently
+                # dropping the knob would fake device-loss coverage.
+                raise ValueError(
+                    "device_loss_probability requires the built-in "
+                    "chaos factory; do not also pass "
+                    "state_machine_factory"
+                )
+            from tigerbeetle_tpu.testing.chaos import device_chaos_factory
+
+            state_machine_factory, self._chaos_links = device_chaos_factory(
+                seed + 4
+            )
         self.cluster = Cluster(
             replica_count=replica_count, seed=seed,
             standby_count=standby_count,
@@ -312,6 +336,8 @@ class Vopr:
             self._audit(client, *pending_audit)
 
         # Heal everything, restart the dead, settle, check.
+        for link in self._chaos_links:
+            link.heal()
         c.network.heal()
         for i in sorted(self.crashed):
             c.restart_replica(i)
@@ -412,6 +438,17 @@ class Vopr:
             self._corrupt_random_sector()
         if self.upgrade_nemesis:
             self._upgrade_tick()
+        if self.device_loss_probability and self._chaos_links:
+            downed = [link for link in self._chaos_links if link.down]
+            if downed:
+                # Heal with ~10%/tick so device outages stay short
+                # enough for re-promotion to happen within the run.
+                if self.rng.random() < 0.10:
+                    for link in downed:
+                        link.heal()
+            elif self.rng.random() < self.device_loss_probability:
+                pick = int(self.rng.integers(len(self._chaos_links)))
+                self._chaos_links[pick].kill()
         if self.partition_probability:
             if self._partitioned:
                 # Heal with ~4%/tick so isolation windows are short.

@@ -131,6 +131,25 @@ assert TRANSFER_PENDING_DTYPE.itemsize == 16
 assert ACCOUNT_BALANCES_GROOVE_DTYPE.itemsize == 256
 
 
+class EngineState(enum.Enum):
+    """Device-authoritative engine lifecycle
+    (state_machine/device_engine.py):
+
+    - ``healthy``: the HBM table is authoritative; semantic kernels
+      compute result codes on device.
+    - ``degraded``: the device link was lost (fatal error or retry
+      budget exhausted); the host mirror is authoritative and every
+      request is served by the exact host engine, bit-identically.
+    - ``repromoting``: a health probe succeeded and the engine is
+      re-uploading the table from the mirror; it becomes healthy only
+      after the checksum handshake passes.
+    """
+
+    healthy = "healthy"
+    degraded = "degraded"
+    repromoting = "repromoting"
+
+
 class AccountFlags(enum.IntFlag):
     """reference: src/tigerbeetle.zig:42-63"""
 
