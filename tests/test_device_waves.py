@@ -1,0 +1,538 @@
+"""Wave dispatch inside the device engine's window launch.
+
+Three layers:
+
+1. Partitioner microtests: the vectorized wavefront level assigner
+   (waves._levels_wavefront) against the Python-walk oracle
+   (plan_waves(use_walk=True)) over fuzzed metadata, and the
+   <100 µs planning budget for an 8k fresh-ids batch.
+2. Window acceptance shapes: a two_phase pending/finalize stream that
+   previously drained to the host executes inside the device window
+   as <=2 wave steps per batch, and a chain-dominated linked batch of
+   independent chains executes in ~max_chain_len device steps (not
+   ~B) — both with replies byte-identical to the CPU oracle.
+3. Forced-on vs forced-off differential fuzz: full device-engine
+   windows (mixed kinds, two-phase, chains, duplicate ids, timeouts,
+   grow/remove interleavings) run with TB_DEV_WAVES=1 and
+   TB_DEV_WAVES=0; replies, final wire state, and the authoritative
+   device table must be byte-identical.  Plus a chaos smoke with wave
+   dispatch forced on (the degraded-mode lifecycle must keep working).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import tigerbeetle_tpu.state_machine.device_engine as de
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import resolve, waves
+from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
+from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+from tigerbeetle_tpu.testing import harness as hz
+from tigerbeetle_tpu.testing.chaos import ChaosLink
+from tigerbeetle_tpu.types import EngineState, Operation, TransferFlags
+
+TF = TransferFlags
+AF = types.AccountFlags
+
+
+# ---------------------------------------------------------------------------
+# Partitioner: vectorized wavefront vs the Python-walk oracle.
+
+
+def _random_meta(rng, n):
+    flags = np.zeros(n, np.uint32)
+    flags[rng.random(n) < 0.2] |= int(TF.linked)
+    flags[rng.random(n) < 0.1] |= int(TF.balancing_debit)
+    pv = rng.random(n) < 0.25
+    flags[pv] |= int(TF.post_pending_transfer)
+    id_group = rng.integers(0, max(1, n // 2), n).astype(np.int64)
+    p_group = np.where(
+        pv & (rng.random(n) < 0.7), rng.integers(0, max(1, n // 2), n), -1
+    ).astype(np.int32)
+    p_found = pv & (p_group < 0) & (rng.random(n) < 0.5)
+    p_tgt = np.where(
+        p_found, rng.integers(0, max(1, n // 3), n), -1
+    ).astype(np.int32)
+    dr_flags = np.where(
+        rng.random(n) < 0.15,
+        np.uint32(AF.debits_must_not_exceed_credits),
+        np.uint32(0),
+    )
+    return resolve.wave_dependency_metadata(
+        n,
+        flags,
+        rng.integers(0, 6, n).astype(np.int64),
+        rng.integers(6, 12, n).astype(np.int64),
+        dr_flags,
+        np.zeros(n, np.uint32),
+        id_group,
+        p_group,
+        p_tgt,
+        p_found,
+        np.where(p_found, rng.integers(0, 6, n), -1).astype(np.int64),
+        np.where(p_found, rng.integers(6, 12, n), -1).astype(np.int64),
+    )
+
+
+def _plans_equal(a, b):
+    assert len(a.segments) == len(b.segments)
+    for (ka, ia), (kb, ib) in zip(a.segments, b.segments):
+        assert ka == kb
+        assert np.array_equal(np.asarray(ia), np.asarray(ib))
+    assert a.chain_steps == b.chain_steps
+    assert np.array_equal(a.wave_mask, b.wave_mask)
+    assert a.n_steps == b.n_steps
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_vectorized_partitioner_matches_walk_oracle(seed):
+    """The wavefront level assigner and the per-event Python walk must
+    emit IDENTICAL plans (segment kinds, index sets, step counts) for
+    arbitrary dependency metadata."""
+    rng = np.random.default_rng(1000 + seed)
+    for _ in range(8):
+        n = int(rng.integers(2, 120))
+        meta = _random_meta(rng, n)
+        _plans_equal(
+            waves.plan_waves(n, meta),
+            waves.plan_waves(n, meta, use_walk=True),
+        )
+
+
+def test_wavefront_cap_falls_back_to_walk():
+    """A fully serial region (every event reads+writes one hot slot via
+    balancing) exceeds the wavefront round cap; the fallback walk must
+    yield the same (degenerate, one-event-per-wave) plan."""
+    n = 80
+    flags = np.full(n, int(TF.balancing_debit), np.uint32)
+    meta = resolve.wave_dependency_metadata(
+        n, flags,
+        np.zeros(n, np.int64), np.ones(n, np.int64),
+        np.zeros(n, np.uint32), np.zeros(n, np.uint32),
+        np.arange(n), np.full(n, -1, np.int32), np.full(n, -1, np.int32),
+        np.zeros(n, bool), np.full(n, -1, np.int64),
+        np.full(n, -1, np.int64),
+    )
+    fast = waves.plan_waves(n, meta)
+    walk = waves.plan_waves(n, meta, use_walk=True)
+    _plans_equal(fast, walk)
+    assert fast.n_steps == n  # true serial dependency chain
+
+
+def test_plan_waves_8k_fresh_under_100us():
+    """Planning an 8k fresh-ids batch (the dominant shape) must cost
+    <100 µs — it runs inside every window launch."""
+    n = 8192
+    meta = resolve.wave_dependency_metadata(
+        n, np.zeros(n, np.uint32),
+        np.arange(n, dtype=np.int64),
+        np.arange(n, 2 * n, dtype=np.int64),
+        np.zeros(n, np.uint32), np.zeros(n, np.uint32),
+        np.arange(n), np.full(n, -1, np.int32), np.full(n, -1, np.int32),
+        np.zeros(n, bool), np.full(n, -1, np.int64),
+        np.full(n, -1, np.int64),
+    )
+    waves.plan_waves(n, meta)  # warm any lazy imports
+    best = float("inf")
+    for _ in range(20):
+        t0 = time.perf_counter()
+        waves.plan_waves(n, meta)
+        best = min(best, time.perf_counter() - t0)
+    assert best < 100e-6, f"plan_waves took {best * 1e6:.0f} µs"
+
+
+# ---------------------------------------------------------------------------
+# Window acceptance shapes.
+
+
+def accounts(ids, flags=0):
+    return hz.pack([hz.account(i, flags=flags) for i in ids])
+
+
+def mk_pair(**tpu_kw):
+    # Odd capacity: the test mesh exposes 8 virtual CPU devices, and a
+    # device-divisible capacity would shard the engine — wave dispatch
+    # (single-chip scope this round) declines sharded engines.
+    sm_d = TpuStateMachine(
+        engine="device",
+        account_capacity=tpu_kw.pop("account_capacity", (1 << 12) + 1),
+        **tpu_kw,
+    )
+    assert sm_d._dev.sharding is None
+    return hz.SingleNodeHarness(sm_d), hz.SingleNodeHarness(CpuStateMachine())
+
+
+def replay_both(h_d, h_c, ops):
+    futs = [h_d.submit_async(op, body) for op, body in ops]
+    replies_d = [f.result() for f in futs]
+    replies_c = [h_c.submit(op, body) for op, body in ops]
+    for i, (a, b) in enumerate(zip(replies_d, replies_c)):
+        assert a == b, f"reply {i} differs: {ops[i][0]!r}"
+    return replies_d
+
+
+def _pv_balancing_batch(tid, accs, rng, bal_accs=None):
+    """(pending, post) pairs plus balancing singles: has_bal falls off
+    every semantic kernel, previously draining the whole batch to the
+    host.  `bal_accs`: dedicated per-event account pairs for the
+    balancing riders (disjoint slots keep their reads independent of
+    the pairs' writes — the acceptance-shape variant); default samples
+    from the shared pool (overlap allowed, fuzz variant)."""
+    rows = []
+    for _ in range(6):
+        a, b = rng.choice(accs, 2, replace=False)
+        rows.append(
+            hz.transfer(tid, debit_account_id=int(a),
+                        credit_account_id=int(b),
+                        amount=int(rng.integers(1, 50)),
+                        flags=int(TF.pending))
+        )
+        rows.append(
+            hz.transfer(tid + 1, amount=0, pending_id=tid,
+                        flags=int(TF.post_pending_transfer))
+        )
+        tid += 2
+    for k in range(3):
+        if bal_accs is not None:
+            a, b = bal_accs[2 * k], bal_accs[2 * k + 1]
+        else:
+            a, b = rng.choice(accs, 2, replace=False)
+        rows.append(
+            hz.transfer(tid, debit_account_id=int(a),
+                        credit_account_id=int(b),
+                        amount=int(rng.integers(1, 20)),
+                        flags=int(TF.balancing_debit))
+        )
+        tid += 1
+    return rows, tid
+
+
+def test_two_phase_stream_waves_in_window(monkeypatch):
+    """Acceptance: a pending/finalize stream the semantic kernels
+    cannot express executes INSIDE the device window as <=2 wave steps
+    per batch — no host drain — with oracle-identical replies."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    rng = np.random.default_rng(7)
+    h_d, h_c = mk_pair()
+    setup = (Operation.create_accounts, accounts(range(1, 47)))
+    ops = [setup]
+    accs = np.arange(1, 41)
+    tid = 100
+    for _ in range(6):
+        rows, tid = _pv_balancing_batch(
+            tid, accs, rng, bal_accs=list(range(41, 47))
+        )
+        ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 47)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    assert sm.stat_dev_wave_batches == 6, "wave dispatch did not engage"
+    assert sm.stat_host_semantic_events == 0, "batch drained to the host"
+    assert sm.stat_dev_wave_steps <= 2 * sm.stat_dev_wave_batches, (
+        f"{sm.stat_dev_wave_steps} steps for {sm.stat_dev_wave_batches} "
+        "batches — two_phase pairs must collapse to <=2 waves"
+    )
+    sm.verify_device_mirror()
+
+
+def test_chain_batch_waves_in_window(monkeypatch):
+    """Acceptance: a chain-dominated linked batch of independent
+    chains (with pending members, so the device `linked` kernel
+    declines it) executes in ~max_chain_len device steps, not ~B."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts(range(1, 101)))]
+    tid = 100
+    for _b in range(3):
+        rows = []
+        for c in range(16):  # 16 independent chains x 3 members
+            for j in range(3):
+                f = int(TF.linked) if j < 2 else 0
+                if j == 0:
+                    f |= int(TF.pending)
+                rows.append(
+                    hz.transfer(
+                        tid, debit_account_id=1 + 2 * c,
+                        credit_account_id=2 + 2 * c,
+                        amount=3 + j, flags=f,
+                    )
+                )
+                tid += 1
+        ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 101)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    assert sm.stat_dev_wave_batches == 3
+    assert sm.stat_host_semantic_events == 0
+    # 48 members/batch; the position-stepped executor pays the padded
+    # max_chain_len bucket (8), nowhere near one step per member.
+    assert sm.stat_dev_wave_steps == 3 * 8, (
+        f"{sm.stat_dev_wave_steps} steps for 3 chain batches"
+    )
+    sm.verify_device_mirror()
+
+
+def test_dev_waves_off_drains_to_host(monkeypatch):
+    """TB_DEV_WAVES=0 keeps the r7 behavior: off-kernel batches drain
+    and run host-side (the differential fuzz's control arm really is
+    the old path)."""
+    monkeypatch.setenv("TB_DEV_WAVES", "0")
+    rng = np.random.default_rng(8)
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts(range(1, 41)))]
+    rows, _ = _pv_balancing_batch(100, np.arange(1, 41), rng)
+    ops.append((Operation.create_transfers, hz.pack(rows)))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    assert sm.stat_dev_wave_batches == 0
+    assert sm.stat_host_semantic_events > 0
+
+
+def test_degraded_admission_counts_inflight_bound(monkeypatch):
+    """Near-overflow balances: a second wave batch planned while the
+    first is still in flight must count the first's amount bound on
+    top of the (lagging) mirror and decline — serving exactly via the
+    host instead of executing an unsound plan."""
+    monkeypatch.setattr(de, "_WINDOW", 64)
+    h_d, h_c = mk_pair()
+    big = (1 << 127) + 5
+    ops = [(Operation.create_accounts, accounts([1, 2, 3, 4]))]
+    # Two off-kernel batches (balancing rider) pushing the same column
+    # toward 2^128 while pipelined in one window.
+    for k, tid in ((0, 100), (1, 200)):
+        ops.append(
+            (
+                Operation.create_transfers,
+                hz.pack(
+                    [
+                        hz.transfer(tid, debit_account_id=1,
+                                    credit_account_id=2, amount=big),
+                        hz.transfer(tid + 1, debit_account_id=3,
+                                    credit_account_id=4, amount=5,
+                                    flags=int(TF.balancing_debit)),
+                    ]
+                ),
+            )
+        )
+    ops.append((Operation.lookup_accounts, hz.ids_bytes([1, 2, 3, 4])))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    # First batch may wave (headroom exists); the second must decline
+    # (mirror + in-flight bound exceeds u128 headroom).
+    assert sm.stat_dev_wave_batches <= 1
+    assert sm.stat_dev_wave_declined >= 1
+    sm.verify_device_mirror()
+
+
+def test_wave_records_across_exact_recovery(monkeypatch):
+    """A window holding [wave batch, cap-exceeded semantic batch, wave
+    batch]: recovery must resolve the first wave record from its
+    already-computed output, host-re-execute the flagged batch, and
+    RE-EXECUTE the second wave record against the rebuilt table — all
+    replies oracle-identical, no bound leaked."""
+    monkeypatch.setattr(de, "_WINDOW", 8)
+    rng = np.random.default_rng(9)
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts(range(1, 47)))]
+    accs = np.arange(1, 41)
+    rows1, tid = _pv_balancing_batch(100, accs, rng, bal_accs=list(range(41, 47)))
+    ops.append((Operation.create_transfers, hz.pack(rows1)))
+    # accounts_must_be_different x100 > FAIL_CAP -> summary flag ->
+    # exact recovery (small amount bound: later admissions unaffected).
+    ops.append(
+        (
+            Operation.create_transfers,
+            hz.pack(
+                [
+                    hz.transfer(500 + i, debit_account_id=1,
+                                credit_account_id=1, amount=1)
+                    for i in range(100)
+                ]
+            ),
+        )
+    )
+    rows3, _ = _pv_balancing_batch(700, accs, rng, bal_accs=list(range(41, 47)))
+    ops.append((Operation.create_transfers, hz.pack(rows3)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 47)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    assert sm._dev.stat_fallback_batches >= 1, "recovery never ran"
+    assert sm.stat_dev_wave_batches == 2, "wave records missing"
+    assert sm._dev.inflight_bound() == 0, "in-flight bound leaked"
+    sm.verify_device_mirror()
+
+
+# ---------------------------------------------------------------------------
+# Forced-on vs forced-off differential fuzz over full windows.
+
+
+def _fuzz_stream(rng, n_accts=60):
+    """Ops mixing every routing class: semantic-kernel batches, wave
+    batches (pv pairs + balancing, chains with pendings, duplicate
+    ids, timeouts), account creation mid-stream (grow), a failing
+    linked account chain (remove), and lookups."""
+    ops = [(Operation.create_accounts, accounts(range(1, n_accts + 1)))]
+    accs = np.arange(1, n_accts + 1)
+    tid = 1000
+    ids = []
+    for k in range(14):
+        r = rng.random()
+        rows = []
+        if r < 0.2:
+            # Plain fresh batch -> orderfree semantic kernel.
+            for _ in range(8):
+                a, b = rng.choice(accs, 2, replace=False)
+                rows.append(
+                    hz.transfer(tid, debit_account_id=int(a),
+                                credit_account_id=int(b),
+                                amount=int(rng.integers(1, 90)))
+                )
+                ids.append(tid)
+                tid += 1
+        elif r < 0.45:
+            rows, tid0 = _pv_balancing_batch(tid, accs, rng)
+            ids.extend(range(tid, tid0))
+            tid = tid0
+            if rng.random() < 0.4 and ids:
+                # Duplicate id rider: ids_unique fails -> off-kernel.
+                rows.append(
+                    hz.transfer(int(rng.choice(ids)),
+                                debit_account_id=1, credit_account_id=2,
+                                amount=1)
+                )
+        elif r < 0.7:
+            # Independent chains, some pending members, some timeouts.
+            for c in range(6):
+                clen = int(rng.integers(2, 5))
+                for j in range(clen):
+                    f = int(TF.linked) if j < clen - 1 else 0
+                    timeout = 0
+                    if rng.random() < 0.3:
+                        f |= int(TF.pending)
+                        if rng.random() < 0.3:
+                            timeout = int(rng.integers(1, 4))
+                    a, b = rng.choice(accs, 2, replace=False)
+                    rows.append(
+                        hz.transfer(tid, debit_account_id=int(a),
+                                    credit_account_id=int(b),
+                                    amount=int(rng.integers(1, 40)),
+                                    timeout=timeout, flags=f)
+                    )
+                    ids.append(tid)
+                    tid += 1
+        elif r < 0.8:
+            # Account burst (meta records + possible grow) and a
+            # failing linked account chain (rollback -> remove).
+            base = n_accts + 1 + k * 40
+            ops.append(
+                (Operation.create_accounts,
+                 accounts(range(base, base + 30)))
+            )
+            ops.append(
+                (
+                    Operation.create_accounts,
+                    hz.pack(
+                        [
+                            hz.account(base + 30, flags=int(AF.linked)),
+                            hz.account(1),  # duplicate -> chain fails
+                        ]
+                    ),
+                )
+            )
+            continue
+        else:
+            ops.append(
+                (
+                    Operation.lookup_accounts,
+                    hz.ids_bytes(
+                        [int(x) for x in rng.choice(accs, 10, replace=False)]
+                    ),
+                )
+            )
+            continue
+        ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append(
+        (Operation.lookup_accounts, hz.ids_bytes([int(x) for x in accs]))
+    )
+    if ids:
+        ops.append(
+            (Operation.lookup_transfers,
+             hz.ids_bytes([int(x) for x in sorted(set(ids))]))
+        )
+    return ops
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_device_waves_forced_on_off_differential(monkeypatch, seed):
+    """Full device-engine windows with wave dispatch forced ON vs
+    forced OFF: every reply, the final wire state, and the
+    authoritative device table must be byte-identical — the wave plan
+    is an execution strategy, never a semantics change."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    replies = {}
+    tables = {}
+    for mode in ("1", "0"):
+        monkeypatch.setenv("TB_DEV_WAVES", mode)
+        rng = np.random.default_rng(seed)
+        sm = TpuStateMachine(engine="device", account_capacity=65)
+        h = hz.SingleNodeHarness(sm)
+        ops = _fuzz_stream(rng)
+        futs = [h.submit_async(op, body) for op, body in ops]
+        replies[mode] = [f.result() for f in futs]
+        sm.verify_device_mirror()
+        tables[mode] = np.asarray(sm._dev.checksum())
+        if mode == "1":
+            assert sm.stat_dev_wave_batches > 0, "fuzz never waved: vacuous"
+        else:
+            assert sm.stat_dev_wave_batches == 0
+        del sm, h
+    for i, (a, b) in enumerate(zip(replies["1"], replies["0"])):
+        assert a == b, f"seed {seed}: reply {i} diverges (waves on vs off)"
+    assert (tables["1"] == tables["0"]).all(), (
+        "authoritative table diverges between wave-on and wave-off"
+    )
+
+
+def test_chaos_smoke_with_waves_on(monkeypatch):
+    """Probabilistic link chaos with wave dispatch forced on: demote /
+    degraded-serve / re-promote must keep every reply oracle-identical
+    — wave records replay through their exact host fallback like any
+    other in-flight record."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    monkeypatch.setattr(de, "_BACKOFF_MS", 0.0)
+    monkeypatch.setattr(de, "_PROBE_EVERY", 2)
+    monkeypatch.setenv("TB_DEV_WAVES", "1")
+    rng = np.random.default_rng(5)
+    link = ChaosLink(
+        seed=17, p_transient=0.05, p_fatal=0.0, p_kill=0.0
+    )
+    sm_d = TpuStateMachine(
+        engine="device", account_capacity=(1 << 10) + 1, device_link=link
+    )
+    h_d = hz.SingleNodeHarness(sm_d)
+    h_c = hz.SingleNodeHarness(CpuStateMachine())
+    ops = _fuzz_stream(rng, n_accts=40)
+    futs = []
+    for k, (op, body) in enumerate(ops):
+        if k in (len(ops) // 3, 2 * len(ops) // 3):
+            # Deterministic mid-stream losses: wave records must be in
+            # flight when the link dies, replaying via host fallback.
+            link.fail_next(kind="fatal")
+        futs.append(h_d.submit_async(op, body))
+    replies_d = [f.result() for f in futs]
+    for f in futs:
+        assert f.done()
+    replies_c = [h_c.submit(op, body) for op, body in ops]
+    mismatches = [
+        i for i, (a, b) in enumerate(zip(replies_d, replies_c)) if a != b
+    ]
+    assert not mismatches, f"replies diverge at {mismatches[:5]}"
+    dev = sm_d.sm._dev if hasattr(sm_d, "sm") else sm_d._dev
+    assert dev.stat_demotions >= 1, "chaos never demoted: weak smoke"
+    link.heal()
+    link.p_transient = link.p_fatal = link.p_kill = 0.0
+    assert dev.try_repromote()
+    assert dev.state is EngineState.healthy
+    sm_d.verify_device_mirror()

@@ -57,6 +57,33 @@ def test_tb_waves_min_ratio_validated(monkeypatch):
     assert waves.min_ratio() == 1.5
 
 
+def test_tb_dev_waves_mode_validated(monkeypatch):
+    monkeypatch.setenv("TB_DEV_WAVES", "fast")
+    with pytest.raises(envcheck.EnvVarError, match="TB_DEV_WAVES"):
+        waves.dev_mode()
+    for legal in ("auto", "0", "1"):
+        monkeypatch.setenv("TB_DEV_WAVES", legal)
+        assert waves.dev_mode() == legal
+    monkeypatch.delenv("TB_DEV_WAVES")
+    assert waves.dev_mode() == "auto"
+
+
+def test_tb_waves_chain_max_validated(monkeypatch):
+    monkeypatch.setenv("TB_WAVES_CHAIN_MAX", "many")
+    with pytest.raises(envcheck.EnvVarError, match="TB_WAVES_CHAIN_MAX"):
+        waves.chain_max()
+    monkeypatch.setenv("TB_WAVES_CHAIN_MAX", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        waves.chain_max()
+    monkeypatch.setenv("TB_WAVES_CHAIN_MAX", "5000")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 4096"):
+        waves.chain_max()
+    monkeypatch.setenv("TB_WAVES_CHAIN_MAX", "0")  # 0 = chain waves off
+    assert waves.chain_max() == 0
+    monkeypatch.delenv("TB_WAVES_CHAIN_MAX")
+    assert waves.chain_max() == 64
+
+
 def test_env_float_minimum(monkeypatch):
     monkeypatch.setenv("TB_DEV_BACKOFF_MS", "-1")
     with pytest.raises(envcheck.EnvVarError, match="TB_DEV_BACKOFF_MS"):

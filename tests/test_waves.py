@@ -70,15 +70,31 @@ def _meta(
 
 def _check_plan_invariants(plan, meta, n):
     """Structural soundness of any plan: exact cover, step bound,
-    chain events only in scan segments, per-wave independence."""
+    chain events only in scan/chain-wave segments, per-wave
+    independence."""
     seen = np.zeros(n, bool)
-    for kind, idx in plan.segments:
+    for k, (kind, idx) in enumerate(plan.segments):
         idx = np.asarray(idx)
         assert not seen[idx].any(), "segments overlap"
         seen[idx] = True
         assert (np.diff(idx) >= 1).all(), "segment indices not ascending"
         if kind == "scan":
             assert (np.diff(idx) == 1).all(), "scan segment not contiguous"
+            continue
+        if kind == "chains":
+            assert (np.diff(idx) == 1).all(), "chain run not contiguous"
+            assert meta["chain_member"][idx].all(), (
+                "non-chain event in a chain-wave run"
+            )
+            assert not meta["chain_serial"][idx].any(), (
+                "must-scan event in a chain-wave run"
+            )
+            assert not meta["is_pv"][idx].any(), (
+                "post/void in a chain-wave run"
+            )
+            assert plan.chain_steps[k] < len(idx), (
+                "chain-wave run no better than the scan"
+            )
             continue
         assert not meta["chain_member"][idx].any(), "chain event in a wave"
         # Independence inside the wave (cross-EVENT only: one event
@@ -169,6 +185,69 @@ def test_balance_readers_serialize_against_writers():
             lvl_of[int(e)] = w
     assert all(lvl_of[e] < lvl_of[4] for e in range(4))
     assert all(lvl_of[e] > lvl_of[4] for e in range(5, n))
+
+
+def test_independent_chains_become_chain_wave():
+    """A run of independent 3-member chains on disjoint accounts
+    collapses to one position-stepped segment of ~max_chain_len
+    (bucketed) device steps instead of one step per member."""
+    n = 30  # 10 chains x 3 members
+    flags = np.zeros(n, np.uint32)
+    for c in range(10):
+        flags[3 * c : 3 * c + 2] = int(TF.linked)
+    dr = np.arange(n, dtype=np.int64)
+    cr = np.arange(n, 2 * n, dtype=np.int64)
+    meta = _meta(n, flags=flags, dr_slot=dr, cr_slot=cr)
+    plan = waves.plan_waves(n, meta)
+    _check_plan_invariants(plan, meta, n)
+    kinds = [k for k, _ in plan.segments]
+    assert kinds == ["chains"]
+    assert plan.n_steps == 8  # bucketed max_chain_len, not 30
+    assert plan.wave_mask.all()
+
+
+def test_chain_wave_declines_cross_chain_reader():
+    """Two chains coupled by a limit-account read keep the exact scan
+    (a read tied to another chain's writes — or their rollback —
+    would diverge from sequential order)."""
+    n = 30
+    flags = np.zeros(n, np.uint32)
+    for c in range(10):
+        flags[3 * c : 3 * c + 2] = int(TF.linked)
+    dr = np.arange(n, dtype=np.int64)
+    cr = np.arange(n, 2 * n, dtype=np.int64)
+    dr_flags = np.zeros(n, np.uint32)
+    # Chain 0's member reads its dr slot; chain 1 writes the same slot.
+    dr_flags[0] = int(AF.debits_must_not_exceed_credits)
+    dr[3] = dr[0]
+    meta = _meta(n, flags=flags, dr_slot=dr, cr_slot=cr, dr_flags=dr_flags)
+    plan = waves.plan_waves(n, meta)
+    _check_plan_invariants(plan, meta, n)
+    assert [k for k, _ in plan.segments] == ["scan"]
+
+
+def test_chain_wave_declines_referenced_ids_and_pv(monkeypatch):
+    """A chain whose member id is referenced by another event (shared
+    id-group / pending ref) or that carries a post/void keeps the
+    exact scan; TB_WAVES_CHAIN_MAX=0 disables chain waves entirely."""
+    n = 30
+    flags = np.zeros(n, np.uint32)
+    for c in range(10):
+        flags[3 * c : 3 * c + 2] = int(TF.linked)
+    # Duplicate id-group between two chains -> decline.
+    id_group = np.arange(n)
+    id_group[5] = id_group[2]
+    meta = _meta(n, flags=flags, id_group=id_group)
+    assert [k for k, _ in waves.plan_waves(n, meta).segments] == ["scan"]
+    # A pv member -> decline.
+    flags2 = flags.copy()
+    flags2[4] |= int(TF.post_pending_transfer)
+    meta = _meta(n, flags=flags2)
+    assert [k for k, _ in waves.plan_waves(n, meta).segments] == ["scan"]
+    # Knob off -> decline even for a clean run.
+    monkeypatch.setenv("TB_WAVES_CHAIN_MAX", "0")
+    meta = _meta(n, flags=flags)
+    assert [k for k, _ in waves.plan_waves(n, meta).segments] == ["scan"]
 
 
 def test_plan_invariants_random_meta():

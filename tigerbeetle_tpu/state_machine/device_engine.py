@@ -206,17 +206,18 @@ class ReplyFuture:
 
 class _InFlight:
     """One stream entry, in submission order (ordering matters for
-    exact fallback recovery): a semantic batch, a lookup gather, or an
-    account-meta update."""
+    exact fallback recovery): a semantic batch, a wave-dispatched
+    batch, a lookup gather, or an account-meta update."""
 
     __slots__ = (
         "kind", "pk", "n", "ts_base", "finish", "fallback", "future",
         "ring_at", "id_keys", "handle", "slots", "rows", "meta_args",
+        "wave_args", "bound",
     )
 
     def __init__(self, kind, future, finish, *, pk=None, n=0, ts_base=0,
                  fallback=None, ring_at=-1, id_keys=None, handle=None,
-                 slots=None, meta_args=None):
+                 slots=None, meta_args=None, wave_args=None, bound=0):
         self.kind = kind
         self.pk = pk
         self.n = n
@@ -226,10 +227,15 @@ class _InFlight:
         self.future = future
         self.ring_at = ring_at
         self.id_keys = id_keys  # sorted u128-packed ids (hazard probes)
-        self.handle = handle    # lookup gather output handle
+        self.handle = handle    # lookup gather / wave packed-output handle
         self.slots = slots      # lookup slots (for re-gather)
-        self.rows = None        # lookup rows fetched at rotation
+        self.rows = None        # lookup rows / wave outputs fetched at rotation
         self.meta_args = meta_args  # (slots, flags, ledger) for "meta"
+        self.wave_args = wave_args  # (ev, dstat_init, plan, hist_fix)
+        # Host-integer bound on the balance additions this record can
+        # still contribute (wave admission's in-flight term); released
+        # when the record's bookkeeping lands on the mirror.
+        self.bound = bound
 
 
 _KERNELS = {
@@ -309,6 +315,12 @@ class DeviceEngine:
         self._q: list[tuple] = []
         self._queued = 0
         self._suppress_enqueue = False
+        # Sum of in-flight records' contribution bounds (wave admission
+        # accounts for batches the mirror has not materialized yet).
+        self._inflight_bound = 0
+        # Degraded-mode read() cache: (mirror version, capacity) ->
+        # CPU-placed (capacity, 8) table handle.
+        self._degraded_cache = None
         # Stats.
         self.stat_semantic_events = 0
         self.stat_fallback_batches = 0
@@ -396,6 +408,10 @@ class DeviceEngine:
             from tigerbeetle_tpu.state_machine import waves as _waves
 
             _waves.prewarm(self.capacity)
+            # The window launch dispatches the NON-DONATING twins
+            # (separate XLA executables) — warm those too so wave
+            # dispatch never first-compiles inside a timed window.
+            _waves.prewarm(self.capacity, engine=True)
         kinds = [k for k in kinds if k in _KERNELS]
         if not kinds:
             return
@@ -514,36 +530,74 @@ class DeviceEngine:
     # Semantic dispatch.
 
     def submit(self, kind, pk, n, ts_base, finish, fallback,
-               id_keys=None) -> ReplyFuture:
+               id_keys=None, bound=0) -> ReplyFuture:
         """Queue one semantic batch; returns its reply future.
 
         `finish(summary) -> bytes` runs at materialization (device codes
         -> bookkeeping + reply).  `fallback() -> bytes` re-executes the
-        batch exactly on the host engine against the mirror.
+        batch exactly on the host engine against the mirror.  `bound`
+        upper-bounds the balance additions the batch can make (the
+        wave path's in-flight admission term).
 
         In degraded mode the batch never touches the link: it resolves
         immediately through the exact host path (bit-identical reply).
         """
+        return self._submit_record(
+            n, fallback,
+            lambda fut: _InFlight(
+                kind, fut, finish, pk=pk, n=n, ts_base=ts_base,
+                fallback=fallback, id_keys=id_keys, bound=bound,
+            ),
+        )
+
+    def submit_waves(self, ev, dstat_init, n, ts_base, plan, hist_fix,
+                     finish, fallback, id_keys=None, bound=0) -> ReplyFuture:
+        """Queue one WAVE-DISPATCHED batch: a batch the semantic
+        kernels cannot express, executed inside the window as the wave
+        plan's segments (one device step per wave / chain position —
+        waves.run_plan_engine) against the authoritative HBM table
+        instead of draining to the host mirror.
+
+        `ev` is the host-side (B,)-array event dict (kernel.py
+        contract), `plan` the admitted WavePlan, `hist_fix` the
+        snapshot-rewrite mask; `finish(packed_np) -> bytes` runs the
+        exact-path bookkeeping from the fetched packed output at
+        materialization, `fallback()` the drained host re-execution.
+        The caller PROVED admission against mirror + the engine's
+        in-flight bound, so the plan is never wrong — a wave record
+        has no failure flag and never triggers exact recovery itself.
+        """
+        return self._submit_record(
+            n, fallback,
+            lambda fut: _InFlight(
+                "waves", fut, finish, n=n, ts_base=ts_base,
+                fallback=fallback, id_keys=id_keys, bound=bound,
+                wave_args=(ev, dstat_init, plan, hist_fix),
+            ),
+        )
+
+    def _submit_record(self, n, fallback, make_rec) -> ReplyFuture:
+        """The ONE stream-entry protocol for semantic and wave batches:
+        degraded check -> flush (earlier exact-path deltas must
+        precede) -> degraded re-check (the flush itself may lose the
+        link; a queued record would force a doomed launch) -> enqueue
+        + window-rotation trigger."""
         if self.state is not EngineState.healthy:
             fut = ReplyFuture(self)
             self.stat_degraded_events += n
             self._resolve_host_now(fut, fallback)
             return fut
-        self.flush()  # earlier exact-path deltas must precede us
+        self.flush()
         if self.state is not EngineState.healthy:
-            # The flush itself lost the link: don't queue onto a
-            # stream whose next launch is doomed — serve host-side.
             fut = ReplyFuture(self)
             self.stat_degraded_events += n
             self._resolve_host_now(fut, fallback)
             return fut
         fut = ReplyFuture(self)
-        rec = _InFlight(
-            kind, fut, finish, pk=pk, n=n, ts_base=ts_base,
-            fallback=fallback, id_keys=id_keys,
-        )
+        rec = make_rec(fut)
         self._pending.append(rec)
         self._pending_semantic += 1
+        self._inflight_bound += rec.bound
         if self._pending_semantic >= self.window:
             try:
                 self._rotate()
@@ -551,12 +605,37 @@ class DeviceEngine:
                 self._demote(exc)
         return fut
 
+    def inflight_bound(self) -> int:
+        """Upper bound on balance additions submitted but not yet
+        reflected in the mirror — the wave admission's `extra` term."""
+        return self._inflight_bound
+
+    def _release_bound(self, rec: _InFlight) -> None:
+        """The record's bookkeeping reached the mirror (finish ran, or
+        its host fallback/replay did): its contributions are no longer
+        'in flight'.  Idempotent — bound zeroes on first release."""
+        if rec.bound:
+            self._inflight_bound -= rec.bound
+            rec.bound = 0
+
     def lookup(self, slots, finish) -> ReplyFuture:
         """Device-side balance gather for lookup_accounts: rides the
         record stream, so it sees every earlier batch's effects.
         `finish(rows)` builds the reply from the fetched (k, 8) rows
         at materialization."""
         slots = np.asarray(slots, np.int64)
+        if self.state is not EngineState.healthy:
+            fut = ReplyFuture(self)
+            self._resolve_host_now(
+                fut, lambda: finish(self.mirror.rows8(slots))
+            )
+            return fut
+        # Earlier host-resolved batches' write-behind deltas must be
+        # visible to the gather (found by the wave-dispatch fuzz: a
+        # lookup queued behind only meta records — no semantic submit,
+        # whose flush would have covered this — read the table without
+        # the still-queued exact-path deltas).
+        self.flush()
         if self.state is not EngineState.healthy:
             fut = ReplyFuture(self)
             self._resolve_host_now(
@@ -709,6 +788,9 @@ class DeviceEngine:
             if ukind == "lookup":
                 urecs[0].handle = self._gather(urecs[0].slots)
                 continue
+            if ukind == "waves":
+                self._exec_waves(urecs[0])
+                continue
             if ukind == "solo":
                 rec = urecs[0]
                 self.balances, self.ring = self._run(
@@ -740,6 +822,28 @@ class DeviceEngine:
         )
         rec.ring_at = self._ring_at
         self._ring_at = (self._ring_at + 1) % _RING
+
+    def _exec_waves(self, rec: _InFlight) -> None:
+        """Execute a wave record's plan against the authoritative
+        table.  The WHOLE batch rides one "dispatch" link crossing and
+        the executor never donates the engine's table handle
+        (waves.run_plan_engine), so a transient fault mid-plan retries
+        the entire batch idempotently from the same `self.balances`.
+        The packed per-event output handle is fetched at rotation like
+        a lookup gather."""
+        from tigerbeetle_tpu.state_machine import waves as _waves
+
+        ev, dstat_init, plan, hist_fix = rec.wave_args
+
+        def run():
+            return self.link.dispatch(
+                _waves.run_plan_engine, self.balances, ev, dstat_init,
+                rec.n, rec.ts_base, plan, hist_fix,
+            )
+
+        new_balances, packed = self._retry(run, "dispatch")
+        self.balances = new_balances
+        rec.handle = packed
 
     # ------------------------------------------------------------------
     # Hazard probe: does any probe id match an in-flight batch's ids?
@@ -782,7 +886,7 @@ class DeviceEngine:
             # THE burst fetch.
             ring_np = self._retry(lambda: self.link.fetch(self.ring), "fetch")
         for rec in recs:
-            if rec.kind == "lookup" and rec.handle is not None:
+            if rec.kind in ("lookup", "waves") and rec.handle is not None:
                 rec.rows = self._retry(
                     lambda h=rec.handle: self.link.fetch(h), "fetch"
                 )
@@ -807,9 +911,15 @@ class DeviceEngine:
             if rec.kind == "lookup":
                 rec.future.resolve(rec.finish(rec.rows))
                 continue
+            if rec.kind == "waves":
+                self.stat_semantic_events += rec.n
+                rec.future.resolve(rec.finish(rec.rows))
+                self._release_bound(rec)
+                continue
             s = dk.unpack_summary(ring_np[rec.ring_at])
             self.stat_semantic_events += rec.n
             rec.future.resolve(rec.finish(s))
+            self._release_bound(rec)
         self.stat_t_finish += _time.perf_counter() - t0
 
     def _rotate(self) -> None:
@@ -866,14 +976,25 @@ class DeviceEngine:
                 if rec.kind == "lookup":
                     rec.future.resolve(rec.finish(rec.rows))
                     continue
+                if rec.kind == "waves":
+                    # Wave records carry no failure flag: admission
+                    # proved the plan exact, so the fetched packed
+                    # output (computed against the stream prefix
+                    # before any LATER batch's fallback) resolves.
+                    self.stat_semantic_events += rec.n
+                    rec.future.resolve(rec.finish(rec.rows))
+                    self._release_bound(rec)
+                    continue
                 s = dk.unpack_summary(ring_np[rec.ring_at])
                 if s["overflow"] or s["cap_exceeded"] or s["precond"]:
                     failed_at = i
                     self.stat_fallback_batches += 1
                     rec.future.resolve(rec.fallback())
+                    self._release_bound(rec)
                     break
                 self.stat_semantic_events += rec.n
                 rec.future.resolve(rec.finish(s))
+                self._release_bound(rec)
             if failed_at is None:
                 return
             # Mirror reflects every batch up to and including the
@@ -891,6 +1012,8 @@ class DeviceEngine:
                     )
                 elif rec.kind == "lookup":
                     rec.handle = self._gather(rec.slots)
+                elif rec.kind == "waves":
+                    self._exec_waves(rec)
                 else:
                     self._dispatch(rec)
             ring_np = None
@@ -898,6 +1021,37 @@ class DeviceEngine:
     def _mirror_table_np(self) -> np.ndarray:
         """Device-layout (capacity, 8) snapshot of the host mirror."""
         return self.mirror.table8(self.capacity)
+
+    @staticmethod
+    def _cpu_device():
+        try:
+            return jax.devices("cpu")[0]
+        except RuntimeError:
+            return None
+
+    def _degraded_table(self):
+        """Mirror-built table handle for degraded/recovering reads,
+        pinned to the CPU backend — a deployment whose DEFAULT JAX
+        backend is the dead tunneled TPU must not re-dispatch degraded
+        work at it — and cached behind the mirror's version stamp so
+        degraded reads stop rebuilding (capacity, 8) bytes per call
+        (ROADMAP "Pin degraded-mode host compute")."""
+        key = (self.mirror.version, self.capacity)
+        if self._degraded_cache is not None and self._degraded_cache[0] == key:
+            handle = self._degraded_cache[1]
+            # The host exact path DONATES the table it reads (scan /
+            # wave executors): a donated cache entry is dead — rebuild.
+            if not handle.is_deleted():
+                return handle
+        table_np = self._mirror_table_np()
+        cpu = self._cpu_device()
+        handle = (
+            jax.device_put(table_np, cpu)
+            if cpu is not None
+            else jnp.asarray(table_np)
+        )
+        self._degraded_cache = (key, handle)
+        return handle
 
     def _device_checksum(self) -> np.ndarray:
         """Round-trip the device-side balance-table digest (the ONE
@@ -972,6 +1126,7 @@ class DeviceEngine:
             self._launched = []
             self._pending = []
             self._pending_semantic = 0
+            self._inflight_bound = 0
             self._q.clear()
             self._queued = 0
         self._closed = True
@@ -1009,6 +1164,7 @@ class DeviceEngine:
     def _replay_record_on_host(self, rec: _InFlight) -> None:
         fut = rec.future
         if fut is None or fut.done():
+            self._release_bound(rec)
             return
         try:
             if rec.kind == "lookup":
@@ -1020,6 +1176,8 @@ class DeviceEngine:
             # The host replay itself failed: fail THIS future with the
             # real error and keep terminating the rest of the stream.
             fut.fail(exc)
+        finally:
+            self._release_bound(rec)
 
     def tick(self) -> None:
         """Periodic lifecycle work, called once per committed
@@ -1099,7 +1257,16 @@ class DeviceEngine:
     # Write-behind lane (host exact path) — kernel_fast.DeviceTable API.
 
     def enqueue(self, slots, cols, add_lo, add_hi) -> None:
-        if self._suppress_enqueue or len(slots) == 0:
+        if len(slots) == 0:
+            return
+        # The native fast path mutates the shared mirror arrays in
+        # place (its commits don't pass through BalanceMirror methods)
+        # but ALWAYS feeds its deltas through here — bump the mutation
+        # stamp so the degraded-read cache can never serve stale rows
+        # (including suppressed re-execution enqueues, whose mirror
+        # mutation already happened natively).
+        self.mirror.version += 1
+        if self._suppress_enqueue:
             return
         if self.state is not EngineState.healthy:
             # Degraded: the mirror (already updated by the host path)
@@ -1188,11 +1355,11 @@ class DeviceEngine:
         prefix before the batch being re-executed, while the device
         table still holds the whole window's kernel effects."""
         if self._recovering:
-            return jnp.asarray(self._mirror_table_np())
+            return self._degraded_table()
         self.drain()
         self.flush()
         if self.state is not EngineState.healthy:
-            return jnp.asarray(self._mirror_table_np())
+            return self._degraded_table()
         return self.balances
 
     def checksum(self) -> np.ndarray:

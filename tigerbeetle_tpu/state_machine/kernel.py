@@ -759,6 +759,10 @@ def _scan_segment_impl(carry, events_seg, id_group_full, n, ts_base):
 
 
 scan_segment = jax.jit(_scan_segment_impl, donate_argnums=(0,))
+# Non-donating twin for the device engine's wave dispatch (waves.py):
+# the engine's authoritative table handle must survive a mid-batch
+# retry, so no buffer it still references may be donated.
+scan_segment_keep = jax.jit(_scan_segment_impl)
 
 
 # Packed-output column layout: the device link is high-latency, so all

@@ -100,11 +100,20 @@ def compact_deltas(slots, cols, amt_lo, amt_hi):
 
 
 class BalanceMirror:
-    """Exact host copy of the (A, 4)-column u128 balance table."""
+    """Exact host copy of the (A, 4)-column u128 balance table.
+
+    ``version`` is a cheap monotonic mutation stamp: every mutating
+    method bumps it (the native fast path mutates lo/hi in place, so
+    DeviceEngine.enqueue — which every native commit feeds — bumps it
+    too).  Consumers use it as a cache key, e.g. the degraded-mode
+    read() table (device_engine.py) that would otherwise rebuild a
+    (capacity, 8) array per call.
+    """
 
     def __init__(self, capacity: int) -> None:
         self.lo = np.zeros((capacity, 4), np.uint64)
         self.hi = np.zeros((capacity, 4), np.uint64)
+        self.version = 0
 
     def grow(self, capacity: int) -> None:
         if capacity <= len(self.lo):
@@ -114,6 +123,7 @@ class BalanceMirror:
         lo[: len(self.lo)] = self.lo
         hi[: len(self.hi)] = self.hi
         self.lo, self.hi = lo, hi
+        self.version += 1
 
     def rows8(self, slots: np.ndarray) -> np.ndarray:
         """(k, 8) interleaved rows matching the device layout."""
@@ -148,6 +158,7 @@ class BalanceMirror:
         pick = len(slots) - 1 - first
         self.lo[uniq] = rows[pick][:, 0::2]
         self.hi[uniq] = rows[pick][:, 1::2]
+        self.version += 1
 
     def try_apply_adds(
         self, dr_slot, cr_slot, amt_lo, amt_hi, is_pending, mask,
@@ -237,6 +248,7 @@ class BalanceMirror:
         if commit:
             self.lo[u_slot, u_col] = new_lo
             self.hi[u_slot, u_col] = new_hi
+            self.version += 1
         return True
 
     def try_apply_deltas(self, slots, cols, amt_lo, amt_hi):
@@ -270,3 +282,4 @@ class BalanceMirror:
         assert not under.any(), "pending release underflow"
         self.lo[u_slot, u_col] = new_lo
         self.hi[u_slot, u_col] = new_hi
+        self.version += 1

@@ -498,14 +498,20 @@ def wave_dependency_metadata(
     """Per-event dependency metadata for the wave partitioner
     (waves.plan_waves).  Field contract:
 
-    - ``chain_member``: event must run in an exact scan segment — a
+    - ``chain_member``: event must run outside plain waves — a
       linked-chain member (rollback couples the chain, including the
       closing non-linked event), an event on a history-flag account
       (its balance snapshot feeds the history groove and must be
       per-event sequential, while wave snapshots are rewritten to
       batch finals), or any shape the wave step does not model
       (``pv_serial`` forces every post/void there, used when a pending
-      target could sit on a history account).
+      target could sit on a history account).  ``chain_linked`` is the
+      linked-run component alone and ``chain_serial`` the must-scan
+      component (history / pv_serial): a chain run with no serial
+      member is a CHAIN-WAVE candidate (waves.py runs its independent
+      chains position-stepped instead of member-by-member).
+    - ``is_pv``: post/void flag (the chain-wave admission declines
+      runs containing finalizers).
     - ``id_group`` / ``p_group`` / ``p_tgt``: the exact-path compact
       reference tokens (tpu.py grouping); two events conflict when one
       claims a token the wave already holds.
@@ -525,13 +531,19 @@ def wave_dependency_metadata(
     is_pv = (
         flags & TFv(TF.post_pending_transfer | TF.void_pending_transfer)
     ) != 0
-    chain_member = linked.copy()
+    # Linked-run membership alone: the chain-wave executor (waves.py)
+    # can run these position-stepped when the run is otherwise clean.
+    chain_linked = linked.copy()
     if n > 1:
-        chain_member[1:] |= linked[:-1]
-    if pv_serial:
-        chain_member |= is_pv
+        chain_linked[1:] |= linked[:-1]
+    # Events that must run in an exact scan segment REGARDLESS of chain
+    # structure: history-account snapshots are semantically read, and
+    # pv_serial post/voids may target a history account.
     hist = ((dr_flags | cr_flags) & TFv(AF.history)) != 0
-    chain_member |= hist & ~is_pv
+    chain_serial = hist & ~is_pv
+    if pv_serial:
+        chain_serial = chain_serial | is_pv
+    chain_member = chain_linked | chain_serial
 
     bal_dr = (flags & TFv(TF.balancing_debit)) != 0
     bal_cr = (flags & TFv(TF.balancing_credit)) != 0
@@ -558,6 +570,10 @@ def wave_dependency_metadata(
 
     return {
         "chain_member": chain_member,
+        "chain_linked": chain_linked,
+        "chain_serial": chain_serial,
+        "linked": linked,
+        "is_pv": is_pv,
         "id_group": np.asarray(id_group, np.int64),
         "p_group": np.asarray(p_group, np.int64),
         "p_tgt": np.asarray(p_tgt, np.int64),

@@ -24,6 +24,8 @@ post-processing ~ the groove inserts the reference does inline.
 
 from __future__ import annotations
 
+import time as _time
+
 import numpy as np
 
 import jax
@@ -94,6 +96,19 @@ _HISTORY_FIELDS = {
     "cr_id_lo": np.uint64, "cr_id_hi": np.uint64,
     "dr_bal": (np.uint64, 8), "cr_bal": (np.uint64, 8),
 }
+
+
+def _amount_bound_total(amount_lo: np.ndarray, amount_hi: np.ndarray) -> int:
+    """Exact host-integer sum of (lo, hi) u128 amount bounds via 32-bit
+    limb sums (each limb sum < 2^21 * 2^32 < 2^64) — the in-flight
+    admission bookkeeping the device engine's wave dispatch keeps."""
+    m32 = np.uint64(0xFFFFFFFF)
+    return (
+        int((amount_lo & m32).sum(dtype=np.uint64))
+        + (int((amount_lo >> np.uint64(32)).sum(dtype=np.uint64)) << 32)
+        + (int((amount_hi & m32).sum(dtype=np.uint64)) << 64)
+        + (int((amount_hi >> np.uint64(32)).sum(dtype=np.uint64)) << 96)
+    )
 
 
 def _zeros_touched(shape, dtype) -> np.ndarray:
@@ -436,6 +451,17 @@ class TpuStateMachine:
         self.stat_wave_steps = 0
         self.stat_wave_events = 0
         self.stat_wave_parallel_events = 0
+        # Device-engine wave dispatch (TB_DEV_WAVES): window batches
+        # that executed as wave plans against the authoritative HBM
+        # table instead of draining to the host, batches that declined
+        # (admission/profitability), their device-step equivalents,
+        # and the cumulative plan+admission wall time (bench.py's
+        # device_waves section reports all of these).
+        self.stat_dev_wave_batches = 0
+        self.stat_dev_wave_declined = 0
+        self.stat_dev_wave_steps = 0
+        self.stat_dev_wave_events = 0
+        self.stat_dev_wave_plan_s = 0.0
 
     @property
     def stat_device_semantic_events(self) -> int:
@@ -1048,9 +1074,24 @@ class TpuStateMachine:
         ts_base = timestamp - n + 1
 
         def host_path() -> ReplyFuture:
+            # Batches the semantic kernels cannot express first try
+            # WAVE DISPATCH inside the device window (TB_DEV_WAVES):
+            # the wave plan executes against the authoritative HBM
+            # table instead of draining the stream to the host mirror.
+            # On decline the decode/ladder work is handed to the host
+            # path (it is drain-stale-proof: wire bytes + the
+            # synchronously-maintained account attrs only), so a
+            # persistently declining deployment does not pay it twice.
+            fut, decoded = self._try_submit_device_waves(
+                events, n, timestamp, input_bytes
+            )
+            if fut is not None:
+                return fut
             self._engine_drain()
             return ReplyFuture(
-                value=self._commit_create_transfers(timestamp, input_bytes)
+                value=self._commit_create_transfers(
+                    timestamp, input_bytes, decoded=decoded
+                )
             )
 
         # A degraded engine serves every batch through the exact host
@@ -1207,6 +1248,109 @@ class TpuStateMachine:
 
         return run
 
+    def _try_submit_device_waves(
+        self, events, n, timestamp, input_bytes
+    ):
+        """Wave-dispatch one window batch that fell off the semantic
+        kernels (mixed kinds, conflicting/duplicate ids, balancing,
+        timeouts, two-phase edge shapes): host joins + wave plan at
+        submit time, segment execution against the authoritative HBM
+        table at window launch, exact-path bookkeeping from the
+        fetched packed outputs at materialization.  Returns
+        (reply_future, None), or (None, decoded) on decline
+        (admission, profitability, TB_DEV_WAVES=0, degraded/sharded
+        engine, oversize batch) — the caller drains to the host
+        exactly as before, reusing the decode dict: the plan is never
+        wrong, only occasionally slower.
+
+        Soundness of planning against a LAGGING mirror: the hazard
+        probe drains on any id/pending-reference overlap with
+        in-flight records (so the host joins here equal their
+        post-drain values), and the overflow admission charges every
+        in-flight record's amount bound on top of the mirror state
+        (DeviceEngine.inflight_bound), so no execution order of the
+        window can surface an ov_* code the plan assumed away."""
+        dev = self._dev
+        dm = waves.dev_mode()
+        if dm == "0" or n == 0 or n > _BATCH_BUCKETS[-1]:
+            return None, None
+        if (
+            dev.state is not types.EngineState.healthy
+            or dev.sharding is not None
+        ):
+            return None, None
+        t0 = _time.perf_counter()
+        d = self._decode_static(events, n)
+        ts_base = timestamp - n + 1
+
+        # In-flight hazards: this batch's ids (duplicate/exists joins)
+        # and real pending references must not collide with records
+        # whose bookkeeping hasn't materialized yet.
+        keys = pack_u128(d["id_lo"], d["id_hi"])
+        probe = keys
+        if d["is_pv"].any():
+            ref = (d["pend_lo"] != 0) | (d["pend_hi"] != 0)
+            probe = np.concatenate(
+                [probe, pack_u128(d["pend_lo"][ref], d["pend_hi"][ref])]
+            )
+        if dev.inflight_ids_hit(probe):
+            self._engine_drain()
+            if dev.state is not types.EngineState.healthy:
+                return None, d
+
+        e_found, e_row = self._tdir.lookup(d["id_lo"], d["id_hi"])
+        id_lo, id_hi = d["id_lo"], d["id_hi"]
+        ascending = n == 1 or bool(
+            (
+                (id_hi[1:] > id_hi[:-1])
+                | ((id_hi[1:] == id_hi[:-1]) & (id_lo[1:] > id_lo[:-1]))
+            ).all()
+        )
+        B = next(b for b in _BATCH_BUCKETS if b >= n)
+        j = self._exact_joins(
+            n, B, id_lo, id_hi, d["pend_lo"], d["pend_hi"], d["is_pv"],
+            ascending, e_found, e_row,
+        )
+        plan = self._plan_wave_execution(
+            n, d["flags"], d["dr_slot"], d["cr_slot"], d["dr_flags"],
+            d["cr_flags"], j["id_group"], j["p_group"], j["p_tgt"],
+            j["p_found"], j["gather_p"], d["is_pv"],
+            d["amount_lo"], d["amount_hi"], force=(dm == "1"),
+            extra_bound=dev.inflight_bound(),
+        )
+        self.stat_dev_wave_plan_s += _time.perf_counter() - t0
+        if plan is None:
+            self.stat_dev_wave_declined += 1
+            return None, d
+
+        ev = self._build_scan_events(
+            n, B, events, d["flags"], d["static"], d["amount_lo"],
+            d["amount_hi"], d["pend_lo"], d["pend_hi"], d["timeout"],
+            d["ledger"], d["code"], d["dr_slot"], d["cr_slot"],
+            d["dr_flags"], d["cr_flags"], d["dr_zero"], d["cr_zero"],
+            e_found, j,
+        )
+        if d["timeout"].any():
+            self._inflight_timeouts = True
+        flags, timeout = d["flags"], d["timeout"]
+        uniq_rows, dstat_init = j["uniq_rows"], j["dstat_init"]
+
+        def finish(packed_np) -> bytes:
+            out = kernel.unpack_outputs(packed_np)
+            return self._finish_exact_outputs(
+                out, n, ts_base, id_lo, id_hi, flags, timeout,
+                uniq_rows, dstat_init, True,
+            )
+
+        self.stat_dev_wave_batches += 1
+        self.stat_dev_wave_steps += plan.n_steps
+        self.stat_dev_wave_events += n
+        return dev.submit_waves(
+            ev, dstat_init, n, ts_base, plan, _pad(plan.wave_mask, B),
+            finish, self._device_fallback(timestamp, input_bytes),
+            id_keys=np.sort(probe), bound=plan.batch_bound,
+        ), None
+
     def _submit_device_orderfree(
         self, events, n, ts_base, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
         flags, timeout, dr_slot, cr_slot, keys_sorted, timestamp, input_bytes,
@@ -1284,6 +1428,7 @@ class TpuStateMachine:
             kind, pk, n, ts_base, finish,
             self._device_fallback(timestamp, input_bytes),
             id_keys=keys_sorted,
+            bound=_amount_bound_total(amount_lo, amount_hi),
         )
 
     def _submit_device_linked(
@@ -1339,6 +1484,7 @@ class TpuStateMachine:
             kind, pk, n, ts_base, finish,
             self._device_fallback(timestamp, input_bytes),
             id_keys=keys_sorted,
+            bound=_amount_bound_total(amount_lo, amount_hi),
         )
 
     def _submit_device_two_phase(
@@ -1510,10 +1656,18 @@ class TpuStateMachine:
             if not (amount_hi.any() or p_amt_hi.any())
             else "two_phase"
         )
+        # In-flight bound: creates add their amount through two slots
+        # (counted once per slot by the wave admission), finalizers at
+        # most max(t.amount, pending.amount) — 2x amounts + the joined
+        # pending amounts over-covers both.
+        bound = 2 * _amount_bound_total(
+            amount_lo, amount_hi
+        ) + _amount_bound_total(p_amt_lo, p_amt_hi)
         return self._dev.submit(
             kind, pk, n, ts_base, finish,
             self._device_fallback(timestamp, input_bytes),
             id_keys=keys_sorted,
+            bound=bound,
         )
 
     def _finish_device_two_phase(
@@ -1673,7 +1827,13 @@ class TpuStateMachine:
 
         return self._dev.lookup(slots_hit, finish)
 
-    def _commit_create_transfers(self, timestamp: int, input_bytes: bytes) -> bytes:
+    def _commit_create_transfers(
+        self, timestamp: int, input_bytes: bytes, decoded: dict | None = None
+    ) -> bytes:
+        """`decoded`: an already-computed _decode_static dict (the
+        wave-dispatch decline path hands its work over; safe to reuse
+        across the drain — decode + ladder depend only on the wire
+        bytes and the synchronously-maintained account attrs)."""
         events = np.frombuffer(input_bytes, dtype=TRANSFER_DTYPE)
         n = len(events)
         if n == 0:
@@ -1715,6 +1875,21 @@ class TpuStateMachine:
                 self.stat_two_phase_batches += 1
                 return reply
 
+        d = decoded if decoded is not None else self._decode_static(events, n)
+        return self._commit_transfers_resolved(
+            n, ts_base, events, d["id_lo"], d["id_hi"], d["pend_lo"],
+            d["pend_hi"], d["flags"], d["timeout"], d["dr_slot"],
+            d["cr_slot"], d["amount_lo"], d["amount_hi"], d["ledger"],
+            d["code"], d["static"], d["is_pv"], d["dr_flags"],
+            d["cr_flags"], d["dr_zero"], d["cr_zero"],
+        )
+
+    def _decode_static(self, events: np.ndarray, n: int) -> dict:
+        """Column decode + account resolution + the static precedence
+        ladder — everything about a create_transfers batch that is
+        independent of balances and durable joins.  Shared by the host
+        exact path and the device engine's wave submission
+        (_try_submit_device_waves), which must agree byte-for-byte."""
         # Same-width fields stay strided views into the 1 MiB wire
         # buffer (it lives in L2 after the first pass, so elementwise
         # ops on views beat paying a contiguous copy per column);
@@ -1768,6 +1943,17 @@ class TpuStateMachine:
         not_balancing = (flags & (kernel.F_BAL_DR | kernel.F_BAL_CR)) == 0
         amount_zero = (amount_lo == 0) & (amount_hi == 0)
 
+        def pack(static):
+            return dict(
+                id_lo=id_lo, id_hi=id_hi, dr_lo=dr_lo, dr_hi=dr_hi,
+                cr_lo=cr_lo, cr_hi=cr_hi, pend_lo=pend_lo,
+                pend_hi=pend_hi, amount_lo=amount_lo,
+                amount_hi=amount_hi, flags=flags, timeout=timeout,
+                ledger=ledger, code=code, is_pv=is_pv, dr_slot=dr_slot,
+                cr_slot=cr_slot, dr_flags=dr_flags, cr_flags=cr_flags,
+                dr_zero=dr_zero, cr_zero=cr_zero, static=static,
+            )
+
         # Short circuit: the hot path (well-formed plain transfers) hits
         # ZERO ladder codes — one OR-reduction detects that and skips
         # the ~25 masked-copyto cascade entirely.
@@ -1781,13 +1967,7 @@ class TpuStateMachine:
                 | (dr_ledger != cr_ledger) | (ledger != dr_ledger)
             ).any()
             if not any_invalid:
-                static = _first_code(n)
-                return self._commit_transfers_resolved(
-                    n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi,
-                    flags, timeout, dr_slot, cr_slot, amount_lo, amount_hi,
-                    ledger, code, static, is_pv, dr_flags, cr_flags,
-                    dr_zero, cr_zero,
-                )
+                return pack(_first_code(n))
 
         # Static precedence ladder (reference: src/state_machine.zig:
         # 1465-1504 normal, :1614-1624 post/void prefix).
@@ -1838,12 +2018,7 @@ class TpuStateMachine:
             CTR.transfer_must_have_the_same_ledger_as_accounts,
         )
 
-        return self._commit_transfers_resolved(
-            n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi,
-            flags, timeout, dr_slot, cr_slot, amount_lo, amount_hi,
-            ledger, code, static, is_pv, dr_flags, cr_flags,
-            dr_zero, cr_zero,
-        )
+        return pack(static)
 
     def _commit_transfers_resolved(
         self, n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi,
@@ -1957,6 +2132,182 @@ class TpuStateMachine:
                 self.stat_linked_batches += 1
                 return reply
 
+        j = self._exact_joins(
+            n, B, id_lo, id_hi, pend_lo, pend_hi, is_pv, ascending,
+            e_found, e_row,
+        )
+        unique_ids = j["unique_ids"]
+        id_group = j["id_group"]
+        p_group = j["p_group"]
+        p_found = j["p_found"]
+        gather_e = j["gather_e"]
+        gather_p = j["gather_p"]
+        uniq_rows = j["uniq_rows"]
+        uniq_status = j["uniq_status"]
+        p_tgt = j["p_tgt"]
+        dstat_init = j["dstat_init"]
+
+        # Two-phase resolution (resolve.py): post/void batches whose
+        # verdicts are balance-independent resolve in one vectorized
+        # pass — pendings, first-wins finalization, scatter-add apply.
+        if is_pv.any() and ids_unique and not e_found.any() and not wave_force:
+            reply = self._try_two_phase_fast(
+                n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi, flags,
+                timeout, dr_slot, cr_slot, amount_lo, amount_hi, ledger,
+                code, static, is_pv, dr_flags, cr_flags,
+                unique_ids, id_group, p_group, p_found, gather_p,
+                uniq_rows, p_tgt, uniq_status,
+            )
+            if reply is not None:
+                self.stat_device_events += n
+                self.stat_two_phase_batches += 1
+                return reply
+
+        ev = self._build_scan_events(
+            n, B, events, flags, static, amount_lo, amount_hi,
+            pend_lo, pend_hi, timeout, ledger, code, dr_slot, cr_slot,
+            dr_flags, cr_flags, dr_zero, cr_zero, e_found, j,
+        )
+
+        self.stat_exact_events += n
+        if self._native is not None and not wave_force:
+            # Serial exact engine in C++ (native/tb_exact.inc): same
+            # inputs and packed-output contract as the scan kernel.
+            # Sequential semantics are inherently serial (the reference
+            # loop is single-core), so the host runs them at memory
+            # speed; the shared mirror is mutated in place and the
+            # deltas ride the async device queue.
+            packed_np, deltas = self._native.commit_exact(
+                ev, kernel.EVENT_FIELDS, dstat_init, B, n, ts_base
+            )
+            self._dev.enqueue(*[d.copy() for d in deltas])
+            out = kernel.unpack_outputs(packed_np)
+            mirror_from_hist = False  # C++ already updated the mirror
+        else:
+            # Conflict-aware wave execution (waves.py): when the batch
+            # partitions into few mutually-independent waves, run one
+            # vectorized device step per wave — chain waves for clean
+            # linked runs, and the exact scan only over true conflict
+            # groups — instead of the full B-step scan.  Bit-identical
+            # outputs (tests/test_waves.py).  A degraded device engine
+            # pins this JAX work at the CPU backend: the default
+            # backend may be the dead tunneled TPU.
+            wave_plan = None
+            if wave_mode not in ("0", "scan"):
+                wave_plan = self._plan_wave_execution(
+                    n, flags, dr_slot, cr_slot, dr_flags, cr_flags,
+                    id_group, p_group, p_tgt, p_found, gather_p, is_pv,
+                    amount_lo, amount_hi, force=(wave_mode == "1"),
+                )
+            with self._host_jax_scope():
+                if wave_plan is not None:
+                    # Wave events' snapshots are rewritten to batch
+                    # finals at finalize (history events never ride
+                    # waves).
+                    new_balances, packed = waves.run_create_transfers_waves(
+                        self._balances, ev, dstat_init, n, ts_base,
+                        wave_plan, _pad(wave_plan.wave_mask, B),
+                    )
+                    self.stat_wave_batches += 1
+                    self.stat_wave_steps += wave_plan.n_steps
+                    self.stat_wave_events += n
+                    self.stat_wave_parallel_events += wave_plan.parallel_events
+                else:
+                    new_balances, packed = kernel.run_create_transfers(
+                        self._balances,
+                        {k: jnp.asarray(v) for k, v in ev.items()},
+                        dstat_init, n, ts_base,
+                    )
+                self._balances = new_balances
+
+                # ONE device->host transfer for every output: the
+                # kernel packs them into a single u64 matrix because
+                # the device link is high-latency and per-leaf fetches
+                # each pay a full round trip (20x slower on a tunneled
+                # TPU).
+                out = kernel.unpack_outputs(np.asarray(packed))
+            mirror_from_hist = True
+
+        return self._finish_exact_outputs(
+            out, n, ts_base, id_lo, id_hi, flags, timeout,
+            uniq_rows, dstat_init, mirror_from_hist,
+        )
+
+    def _host_jax_scope(self):
+        """JAX placement scope for host exact-path execution: pins the
+        work at the CPU backend while the device engine is degraded or
+        recovering (ROADMAP "Pin degraded-mode host compute") — the
+        process default backend may be the dead tunneled TPU, and
+        jnp.asarray/jit dispatch would otherwise route there.  A no-op
+        (null scope) in host-engine mode and on a healthy engine."""
+        import contextlib
+
+        dev = self._dev
+        if self.engine == "device" and (
+            getattr(dev, "state", None) is not types.EngineState.healthy
+            or dev._recovering
+        ):
+            cpu = dev._cpu_device()
+            if cpu is not None:
+                return jax.default_device(cpu)
+        return contextlib.nullcontext()
+
+    def _finish_exact_outputs(
+        self, out, n, ts_base, id_lo, id_hi, flags, timeout,
+        uniq_rows, dstat_init, mirror_from_hist,
+    ) -> bytes:
+        """Exact-path bookkeeping tail from unpacked kernel outputs —
+        shared by the synchronous host path and the device engine's
+        wave-record finish (which runs it at materialization from the
+        fetched packed matrix)."""
+        results = out["results"][:n]
+        created_mask = out["created_mask"][:n]
+        created = {f: out["created"][f][:n] for f in kernel.CREATED_FIELDS}
+        inb_status = out["inb_status"][:n]
+        dstat = out["dstat"]
+        hist_dr = out["hist_dr"][:n]
+        hist_cr = out["hist_cr"][:n]
+
+        # Mirror reconstruction: events whose effects persisted
+        # (results == 0; rollback rewrote failed-chain members) carry
+        # post-apply snapshots of both touched rows. Interleaved in
+        # event order, last write wins -> final balances of every
+        # touched slot (rolled-back-only slots net to no change).
+        ok_idx = np.flatnonzero(results == 0)
+        if mirror_from_hist and len(ok_idx):
+            slots2 = np.empty(2 * len(ok_idx), np.int64)
+            slots2[0::2] = created["dr_slot"][ok_idx]
+            slots2[1::2] = created["cr_slot"][ok_idx]
+            rows2 = np.empty((2 * len(ok_idx), 8), np.uint64)
+            rows2[0::2] = hist_dr[ok_idx]
+            rows2[1::2] = hist_cr[ok_idx]
+            self._mirror.set_rows8(slots2, rows2)
+
+        self._post_process_transfers(
+            n, ts_base, id_lo, id_hi, flags, timeout,
+            results, created_mask, created, inb_status,
+            dstat_init, dstat, uniq_rows,
+            hist_dr, hist_cr,
+            int(out["last_applied"]),
+            out["pulse_create"][:n],
+            out["pulse_remove"][:n],
+        )
+
+        # Reply: failures only, in event order.
+        fail_idx = np.flatnonzero(results != 0)
+        reply = np.zeros(len(fail_idx), dtype=CREATE_RESULT_DTYPE)
+        reply["index"] = fail_idx.astype(np.uint32)
+        reply["result"] = results[fail_idx]
+        return reply.tobytes()
+
+    def _exact_joins(
+        self, n, B, id_lo, id_hi, pend_lo, pend_hi, is_pv, ascending,
+        e_found, e_row,
+    ) -> dict:
+        """Exact-path join bundle: compact id groups, in-batch pending
+        reference groups, durable duplicate/pending-target gathers and
+        the deduped durable-status seed — shared by the host exact
+        path and the device engine's wave submission."""
         # Exact-path id groups: one compact index per distinct id value.
         id_key = pack_u128(id_lo, id_hi)
         if ascending:
@@ -2034,24 +2385,24 @@ class TpuStateMachine:
         p_tgt[p_found] = tgt_inverse.astype(np.int32)
         dstat_init = np.zeros(B, np.uint32)
         dstat_init[: len(uniq_rows)] = uniq_status
+        return dict(
+            unique_ids=unique_ids, id_group=id_group, p_group=p_group,
+            p_found=p_found, p_row=p_row, gather_e=gather_e,
+            gather_p=gather_p, uniq_rows=uniq_rows,
+            uniq_status=uniq_status, p_tgt=p_tgt, dstat_init=dstat_init,
+        )
 
-        # Two-phase resolution (resolve.py): post/void batches whose
-        # verdicts are balance-independent resolve in one vectorized
-        # pass — pendings, first-wins finalization, scatter-add apply.
-        if is_pv.any() and ids_unique and not e_found.any() and not wave_force:
-            reply = self._try_two_phase_fast(
-                n, ts_base, events, id_lo, id_hi, pend_lo, pend_hi, flags,
-                timeout, dr_slot, cr_slot, amount_lo, amount_hi, ledger,
-                code, static, is_pv, dr_flags, cr_flags,
-                unique_ids, id_group, p_group, p_found, gather_p,
-                uniq_rows, p_tgt, uniq_status,
-            )
-            if reply is not None:
-                self.stat_device_events += n
-                self.stat_two_phase_batches += 1
-                return reply
-
-        ev = {
+    def _build_scan_events(
+        self, n, B, events, flags, static, amount_lo, amount_hi,
+        pend_lo, pend_hi, timeout, ledger, code, dr_slot, cr_slot,
+        dr_flags, cr_flags, dr_zero, cr_zero, e_found, j,
+    ) -> dict:
+        """The (B,)-padded host event-array dict per
+        kernel.EVENT_FIELDS — the scan/wave executors' input contract,
+        shared by the host exact path and the wave submission."""
+        gather_e = j["gather_e"]
+        gather_p = j["gather_p"]
+        return {
             "i": np.arange(B, dtype=np.int32),
             "flags": _pad(flags, B),
             "ts_nonzero": _pad(events["timestamp"] != 0, B),
@@ -2067,8 +2418,8 @@ class TpuStateMachine:
             "dr_slot": _pad(dr_slot, B), "cr_slot": _pad(cr_slot, B),
             "dr_flags": _pad(dr_flags, B), "cr_flags": _pad(cr_flags, B),
             "dr_id_zero": _pad(dr_zero, B), "cr_id_zero": _pad(cr_zero, B),
-            "id_group": _pad(id_group.astype(np.int32), B),
-            "p_group": _pad(p_group, B),
+            "id_group": _pad(j["id_group"].astype(np.int32), B),
+            "p_group": _pad(j["p_group"], B),
             "e_found": _pad(e_found, B),
             "e_flags": _pad(gather_e("flags").astype(np.uint32), B),
             "e_dr_slot": _pad(gather_e("dr_slot").astype(np.int32), B),
@@ -2083,7 +2434,7 @@ class TpuStateMachine:
             "e_ud32": _pad(gather_e("ud32").astype(np.uint32), B),
             "e_timeout": _pad(gather_e("timeout").astype(np.uint64), B),
             "e_code": _pad(gather_e("code").astype(np.uint32), B),
-            "p_found": _pad(p_found, B),
+            "p_found": _pad(j["p_found"], B),
             "p_flags": _pad(gather_p("flags").astype(np.uint32), B),
             "p_dr_slot": _pad(gather_p("dr_slot").astype(np.int32), B),
             "p_cr_slot": _pad(gather_p("cr_slot").astype(np.int32), B),
@@ -2097,113 +2448,22 @@ class TpuStateMachine:
             "p_ledger": _pad(gather_p("ledger").astype(np.uint32), B),
             "p_code": _pad(gather_p("code").astype(np.uint32), B),
             "p_timestamp": _pad(gather_p("timestamp").astype(np.uint64), B),
-            "p_tgt": _pad(p_tgt, B),
+            "p_tgt": _pad(j["p_tgt"], B),
         }
-
-        self.stat_exact_events += n
-        if self._native is not None and not wave_force:
-            # Serial exact engine in C++ (native/tb_exact.inc): same
-            # inputs and packed-output contract as the scan kernel.
-            # Sequential semantics are inherently serial (the reference
-            # loop is single-core), so the host runs them at memory
-            # speed; the shared mirror is mutated in place and the
-            # deltas ride the async device queue.
-            packed_np, deltas = self._native.commit_exact(
-                ev, kernel.EVENT_FIELDS, dstat_init, B, n, ts_base
-            )
-            self._dev.enqueue(*[d.copy() for d in deltas])
-            out = kernel.unpack_outputs(packed_np)
-            mirror_from_hist = False  # C++ already updated the mirror
-        else:
-            # Conflict-aware wave execution (waves.py): when the batch
-            # partitions into few mutually-independent waves, run one
-            # vectorized device step per wave — and the exact scan only
-            # over true conflict groups — instead of the full B-step
-            # scan.  Bit-identical outputs (tests/test_waves.py).
-            wave_plan = None
-            if wave_mode not in ("0", "scan"):
-                wave_plan = self._plan_wave_execution(
-                    n, flags, dr_slot, cr_slot, dr_flags, cr_flags,
-                    id_group, p_group, p_tgt, p_found, gather_p, is_pv,
-                    amount_lo, amount_hi, force=(wave_mode == "1"),
-                )
-            if wave_plan is not None:
-                # Wave events' snapshots are rewritten to batch finals
-                # at finalize (history events never ride waves).
-                new_balances, packed = waves.run_create_transfers_waves(
-                    self._balances, ev, dstat_init, n, ts_base,
-                    wave_plan, _pad(wave_plan.wave_mask, B),
-                )
-                self.stat_wave_batches += 1
-                self.stat_wave_steps += wave_plan.n_steps
-                self.stat_wave_events += n
-                self.stat_wave_parallel_events += wave_plan.parallel_events
-            else:
-                new_balances, packed = kernel.run_create_transfers(
-                    self._balances,
-                    {k: jnp.asarray(v) for k, v in ev.items()},
-                    dstat_init, n, ts_base,
-                )
-            self._balances = new_balances
-
-            # ONE device->host transfer for every output: the kernel
-            # packs them into a single u64 matrix because the device
-            # link is high-latency and per-leaf fetches each pay a full
-            # round trip (20x slower on a tunneled TPU).
-            out = kernel.unpack_outputs(np.asarray(packed))
-            mirror_from_hist = True
-
-        results = out["results"][:n]
-        created_mask = out["created_mask"][:n]
-        created = {f: out["created"][f][:n] for f in kernel.CREATED_FIELDS}
-        inb_status = out["inb_status"][:n]
-        dstat = out["dstat"]
-        hist_dr = out["hist_dr"][:n]
-        hist_cr = out["hist_cr"][:n]
-
-        # Mirror reconstruction: events whose effects persisted
-        # (results == 0; rollback rewrote failed-chain members) carry
-        # post-apply snapshots of both touched rows. Interleaved in
-        # event order, last write wins -> final balances of every
-        # touched slot (rolled-back-only slots net to no change).
-        ok_idx = np.flatnonzero(results == 0)
-        if mirror_from_hist and len(ok_idx):
-            slots2 = np.empty(2 * len(ok_idx), np.int64)
-            slots2[0::2] = created["dr_slot"][ok_idx]
-            slots2[1::2] = created["cr_slot"][ok_idx]
-            rows2 = np.empty((2 * len(ok_idx), 8), np.uint64)
-            rows2[0::2] = hist_dr[ok_idx]
-            rows2[1::2] = hist_cr[ok_idx]
-            self._mirror.set_rows8(slots2, rows2)
-
-        self._post_process_transfers(
-            n, ts_base, id_lo, id_hi, flags, timeout,
-            results, created_mask, created, inb_status,
-            dstat_init, dstat, uniq_rows,
-            hist_dr, hist_cr,
-            int(out["last_applied"]),
-            out["pulse_create"][:n],
-            out["pulse_remove"][:n],
-        )
-
-        # Reply: failures only, in event order.
-        fail_idx = np.flatnonzero(results != 0)
-        reply = np.zeros(len(fail_idx), dtype=CREATE_RESULT_DTYPE)
-        reply["index"] = fail_idx.astype(np.uint32)
-        reply["result"] = results[fail_idx]
-        return reply.tobytes()
 
     def _plan_wave_execution(
         self, n, flags, dr_slot, cr_slot, dr_flags, cr_flags,
         id_group, p_group, p_tgt, p_found, gather_p, is_pv,
-        amount_lo, amount_hi, force: bool = False,
+        amount_lo, amount_hi, force: bool = False, extra_bound: int = 0,
     ):
         """Wave routing decision for one exact-path batch: dependency
-        metadata (resolve.py) -> whole-batch overflow admission
-        against the mirror -> level partition (waves.plan_waves) ->
+        metadata (resolve.py) -> per-column overflow admission against
+        the mirror -> level partition (waves.plan_waves) ->
         profitability.  Returns the plan or None — the scan path —
         and is always safe to decline (never a wrong answer, only a
-        slower one)."""
+        slower one).  `extra_bound` is the device engine's in-flight
+        contribution bound when planning a window batch (the mirror
+        lags materialization there); zero on the drained host path."""
         p_drs = gather_p("dr_slot").astype(np.int64)
         p_crs = gather_p("cr_slot").astype(np.int64)
 
@@ -2225,14 +2485,25 @@ class TpuStateMachine:
             id_group, p_group, p_tgt, p_found, p_drs, p_crs,
             pv_serial=bool(hist_ev.any() or pv_hist),
         )
-        # Chain members each cost one exact step, so n/chain_members
-        # bounds the achievable ratio: decline chain-dominated batches
-        # (the linked config) BEFORE the per-event partition walk.
+        # Chain members cost one exact step each UNLESS they are
+        # chain-wave candidates (clean linked runs, waves.py): decline
+        # chain-dominated batches before paying the partition only
+        # when the chains could not ride position-stepped anyway.
         n_chain = int(meta["chain_member"].sum())
-        if not force and n_chain and n < waves.min_ratio() * n_chain:
+        chain_wave_possible = (
+            waves.chain_max() >= 2
+            and not meta["chain_serial"].any()
+            and not (meta["chain_linked"] & meta["is_pv"]).any()
+        )
+        if (
+            not force
+            and n_chain
+            and not chain_wave_possible
+            and n < waves.min_ratio() * n_chain
+        ):
             return None
 
-        # Whole-batch overflow admission (waves.admission_ok): per-event
+        # Per-column overflow admission (waves.admission_ok): per-event
         # amount upper bounds — balancing zero-amount means maxInt u64,
         # post/void apply at most max(t.amount, pending.amount), and an
         # in-batch inherit is bounded by the largest create bound.
@@ -2261,22 +2532,37 @@ class TpuStateMachine:
                 mx_lo = bound_lo[nm][at].max()
                 bound_lo = np.where(inb_inherit, mx_lo, bound_lo)
                 bound_hi = np.where(inb_inherit, mx_hi, bound_hi)
-        touched = np.concatenate(
+        # Per-contribution (slot, bound) pairs: each slot an event can
+        # add a balance column through, charged with that event's
+        # bound — dr/cr for creates, the durable target's accounts for
+        # found finalizers, and the referenced group's slot union for
+        # in-batch finalizers (the creator is whichever applied).
+        inb_ev, inb_slot = waves._inb_pv_write_pairs(n, meta)
+        slots = np.concatenate(
             [dr_slot.astype(np.int64), cr_slot.astype(np.int64),
-             p_drs[p_found], p_crs[p_found]]
+             p_drs[p_found], p_crs[p_found], inb_slot]
         )
-        # Admission runs BEFORE the per-event partition walk: the
-        # bound arrays are vectorized numpy, so a persistently
-        # declining deployment (u128-scale balances) never pays the
-        # ~1 ms/8k-event plan cost.
+        bounds_lo = np.concatenate(
+            [bound_lo, bound_lo, bound_lo[p_found], bound_lo[p_found],
+             bound_lo[inb_ev]]
+        )
+        bounds_hi = np.concatenate(
+            [bound_hi, bound_hi, bound_hi[p_found], bound_hi[p_found],
+             bound_hi[inb_ev]]
+        )
+        # Admission runs BEFORE the per-event partition: the bound
+        # arrays are vectorized numpy, so a persistently declining
+        # deployment (no u128 headroom left) never pays the plan cost.
         if not waves.admission_ok(
-            self._mirror.lo, self._mirror.hi, touched, bound_lo, bound_hi
+            self._mirror.lo, self._mirror.hi, slots, bounds_lo, bounds_hi,
+            extra=extra_bound,
         ):
             return None
 
-        plan = waves.plan_waves(n, meta)
+        plan = waves.plan_waves(n, meta, inb_pairs=(inb_ev, inb_slot))
         if not (force or plan.profitable()):
             return None
+        plan.batch_bound = _amount_bound_total(bound_lo, bound_hi)
         return plan
 
     def _try_native_two_phase(

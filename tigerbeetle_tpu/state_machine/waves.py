@@ -39,24 +39,37 @@ graphs — arXiv:2503.04595 — specialized to the reference semantics):
 - **linked chains & history accounts.**  Rollback couples every chain
   member (including the closing event), and an AF.history account's
   per-event snapshot must be sequential-exact (it feeds the history
-  groove, while wave snapshots are rewritten to batch finals): both
-  run in exact scan segments.
+  groove, while wave snapshots are rewritten to batch finals).
+  History events always run in exact scan segments; chain runs whose
+  chains are MUTUALLY INDEPENDENT (no pv/history members, ids claimed
+  once batch-wide, no slot both touched by two chains and read by
+  anyone) run position-stepped as CHAIN WAVES — one lax.scan over
+  chain position (`_chain_wave_impl`), ~max_chain_len steps instead
+  of one per member, with exact trailing-subtraction rollback —
+  and everything else keeps the scan.
 
 Overflow codes are the one read everyone performs implicitly: whether
 `amount + dp` overflows u128 depends on prior events.  The executor
 keeps them exact with the same superset admission the order-free fast
 path uses (mirror.try_apply_adds): amounts are non-negative, so if the
-ALL-APPLIED total of the batch cannot overflow any touched column (or
-column pair), no sequential prefix can either, and every ov_* term is
-identically false in both orders.  `admission_ok` proves that bound on
-the host mirror; a batch that fails it (astronomical balances) routes
-to the scan path — never a wrong answer, only a slower one.
+ALL-APPLIED additions to a slot cannot overflow its columns or its
+dp+dpo / cp+cpo pairs, no sequential prefix can either, and every
+ov_* term is identically false in both orders.  `admission_ok` proves
+that bound per touched slot on the host mirror (plus an `extra` term
+covering in-flight window batches when the device engine plans
+against its lagging mirror); a batch that fails it routes to the scan
+path — never a wrong answer, only a slower one.
+
+Two executors share the segment loop (`_execute_plan`): the host
+exact path donates its table (run_create_transfers_waves), while the
+device engine's window launch dispatches NON-DONATING twins
+(run_plan_engine) so its authoritative handle survives mid-batch
+retries (device_engine._exec_waves).
 """
 
 from __future__ import annotations
 
 import functools
-import os
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -153,6 +166,36 @@ def mode() -> str:
     )
 
 
+def dev_mode() -> str:
+    """TB_DEV_WAVES routing mode for the device engine's window launch
+    (independent of TB_WAVES, which governs the host exact path):
+
+    - unset/"auto": window batches that fall off the semantic kernels
+      (mixed kinds, conflicting ids, balancing, timeouts, two-phase
+      edge shapes) are wave-dispatched against the authoritative HBM
+      table when the plan is admitted and profitable; declines keep
+      the r7 behavior (drain + exact host path).
+    - "0": off — off-kernel batches always drain to the host.
+    - "1": force — execute every ADMITTED plan even when unprofitable
+      (differential-test routing; admission is never bypassed, it is
+      the correctness proof)."""
+    from tigerbeetle_tpu import envcheck
+
+    return envcheck.env_choice("TB_DEV_WAVES", "auto", ("auto", "0", "1"))
+
+
+def chain_max() -> int:
+    """TB_WAVES_CHAIN_MAX: longest chain (in positions) a chain-wave
+    segment may carry — longer chains keep the exact scan, whose cost
+    is one step per member.  0 disables chain waves entirely.  Read
+    live so tests and bench arms can toggle it after import."""
+    from tigerbeetle_tpu import envcheck
+
+    return envcheck.env_int(
+        "TB_WAVES_CHAIN_MAX", 64, minimum=0, maximum=4096
+    )
+
+
 # ---------------------------------------------------------------------------
 # Partitioner.
 
@@ -162,17 +205,27 @@ class WavePlan:
     """Execution plan: ordered segments whose index sets cover [0, n).
 
     Segment order is the EXECUTION order; a "wave" segment's indices
-    need not be contiguous (topological-level scheduling), while a
-    "scan" segment is always a contiguous chain run executed at its
-    batch position.
+    need not be contiguous (topological-level scheduling), a "scan"
+    segment is always a contiguous chain run executed at its batch
+    position, and a "chains" segment is a contiguous run of mutually
+    independent linked chains executed position-stepped (one device
+    step per chain POSITION — `chain_steps` holds the padded step
+    count per segment index).
     """
 
     n: int
     # (kind, idx): kind "wave" = one parallel step over idx (int
     # array, ascending), kind "scan" = len(idx) exact sequential
-    # steps over a contiguous run.
+    # steps over a contiguous run, kind "chains" = chain_steps[k]
+    # position steps over a contiguous run of independent chains.
     segments: list = field(default_factory=list)
-    wave_mask: np.ndarray | None = None  # events executed in wave steps
+    wave_mask: np.ndarray | None = None  # events whose snapshots are
+    # rewritten to batch finals (wave + chain-wave events)
+    chain_steps: dict = field(default_factory=dict)
+    # Host-integer sum of the batch's per-event amount bounds — the
+    # admission term a later window batch must count while this one is
+    # in flight (set by tpu._plan_wave_execution).
+    batch_bound: int = 0
 
     @property
     def n_waves(self) -> int:
@@ -184,10 +237,17 @@ class WavePlan:
 
     @property
     def n_steps(self) -> int:
-        """Device-step equivalents: 1 per wave, length per scan run."""
-        return sum(
-            1 if k == "wave" else len(ix) for k, ix in self.segments
-        )
+        """Device-step equivalents: 1 per wave, length per scan run,
+        padded position count per chain-wave run."""
+        total = 0
+        for k, (kind, ix) in enumerate(self.segments):
+            if kind == "wave":
+                total += 1
+            elif kind == "chains":
+                total += self.chain_steps[k]
+            else:
+                total += len(ix)
+        return total
 
     @property
     def ratio(self) -> float:
@@ -199,42 +259,343 @@ class WavePlan:
         )
 
 
-def plan_waves(n: int, meta: dict) -> WavePlan:
-    """Partition a batch into wave/scan segments by topological level.
+# How many wavefront rounds the vectorized level assigner runs before
+# handing the region to the Python-walk fallback: profitable plans
+# have FEW levels (the ratio gate needs n / steps >= min_ratio), so a
+# region still unassigned after this many rounds is serial enough that
+# the O(n) walk is the cheaper exact algorithm.
+_WAVEFRONT_CAP = 24
+
+
+def _inb_pv_write_pairs(n: int, meta: dict):
+    """(event, slot) pairs for in-batch post/voids: the slot union of
+    the id-group each finalizer's pending reference names (the creator
+    is whichever group member applied, so the finalizer's static write
+    set is the union).  Shared by the partitioner's conflict entries
+    and the per-column overflow admission (tpu.py)."""
+    inb = meta["inb_pv"]
+    empty = (np.zeros(0, np.int64), np.zeros(0, np.int64))
+    if not inb.any():
+        return empty
+    id_group = meta["id_group"]
+    ref = np.unique(meta["p_group"][inb])
+    member = np.isin(id_group, ref)
+    g2 = np.concatenate([id_group[member], id_group[member]])
+    s2 = np.concatenate([meta["ev_dr"][member], meta["ev_cr"][member]])
+    keep = s2 >= 0
+    g2, s2 = g2[keep], s2[keep]
+    if len(g2) == 0:
+        return empty
+    span = int(s2.max()) + 2
+    key = np.unique(g2 * span + s2)
+    pg, ps = key // span, key % span
+    evs = np.flatnonzero(inb)
+    lo = np.searchsorted(pg, meta["p_group"][evs], side="left")
+    hi = np.searchsorted(pg, meta["p_group"][evs], side="right")
+    cnt = hi - lo
+    total = int(cnt.sum())
+    if total == 0:
+        return empty
+    out_ev = np.repeat(evs, cnt)
+    within = np.arange(total) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+    out_slot = ps[np.repeat(lo, cnt) + within]
+    return out_ev.astype(np.int64), out_slot.astype(np.int64)
+
+
+def _levels_walk(lo: int, hi: int, meta: dict, group_slots) -> np.ndarray:
+    """Per-event Python walk over region [lo, hi) — the REFERENCE
+    level assignment (the vectorized wavefront must agree exactly;
+    tests/test_device_waves.py fuzzes the two against each other) and
+    the fallback for regions more serial than _WAVEFRONT_CAP levels.
+
+    Level = 1 + max level of every earlier conflicting event: same-id
+    claims (exists ladder), pending refs, first-wins finalize targets,
+    then balance-slot RAW/WAR (a reader must see exactly the earlier
+    writers' adds; later writers must apply after it reads).  Reads
+    also serialize against earlier reads — a balancing/limit reader's
+    own writes are data-dependent, and the greedy rule this
+    generalizes kept reader pairs ordered.
+    """
+    id_group = meta["id_group"]
+    p_group = meta["p_group"]
+    p_tgt = meta["p_tgt"]
+    writes0, writes1 = meta["writes0"], meta["writes1"]
+    reads0, reads1 = meta["reads0"], meta["reads1"]
+    inb_pv = meta["inb_pv"]
+    group_level: dict[int, int] = {}
+    ptgt_level: dict[int, int] = {}
+    write_level: dict[int, int] = {}
+    read_level: dict[int, int] = {}
+    levels = np.zeros(hi - lo, np.int32)
+    for e in range(lo, hi):
+        g = int(id_group[e])
+        pg = int(p_group[e])
+        pt = int(p_tgt[e])
+        ww = []
+        if writes0[e] >= 0:
+            ww.append(int(writes0[e]))
+        if writes1[e] >= 0:
+            ww.append(int(writes1[e]))
+        if inb_pv[e]:
+            ww.extend(group_slots.get(pg, ()))
+        rr = []
+        if reads0[e] >= 0:
+            rr.append(int(reads0[e]))
+        if reads1[e] >= 0:
+            rr.append(int(reads1[e]))
+
+        lvl = group_level.get(g, -1) + 1
+        if pg >= 0:
+            lvl = max(lvl, group_level.get(pg, -1) + 1)
+        if pt >= 0:
+            lvl = max(lvl, ptgt_level.get(pt, -1) + 1)
+        for s in rr:
+            lvl = max(
+                lvl,
+                write_level.get(s, -1) + 1,
+                read_level.get(s, -1) + 1,
+            )
+        for s in ww:
+            lvl = max(lvl, read_level.get(s, -1) + 1)
+
+        levels[e - lo] = lvl
+        if lvl > group_level.get(g, -1):
+            group_level[g] = lvl
+        if pg >= 0 and lvl > group_level.get(pg, -1):
+            group_level[pg] = lvl
+        if pt >= 0 and lvl > ptgt_level.get(pt, -1):
+            ptgt_level[pt] = lvl
+        for s in ww:
+            if lvl > write_level.get(s, -1):
+                write_level[s] = lvl
+        for s in rr:
+            if lvl > read_level.get(s, -1):
+                read_level[s] = lvl
+    return levels
+
+
+def _levels_wavefront(
+    lo: int, hi: int, meta: dict, inb_ev, inb_slot, cap: int = None
+) -> np.ndarray | None:
+    """Vectorized level assignment for region [lo, hi): Kahn's
+    algorithm by level over the conflict DAG.  At round k every
+    still-unassigned event with no unassigned predecessor takes level
+    k — which equals the walk's greedy level exactly (a predecessor's
+    level is strictly below its successors', so "all predecessors
+    assigned" first becomes true at round 1 + max pred level).
+
+    Per round the blocked test is a segmented min over sorted-by-token
+    entry arrays: for a serial token (id/pending-group claim,
+    first-wins target) only the minimum-index unassigned claimant is
+    unblocked; for a balance slot a reader is unblocked only as the
+    minimum-index unassigned toucher, a writer when no unassigned
+    reader precedes it (commuting writers share a round).  Rounds cost
+    O(entries) vectorized; plans worth executing have few levels, so a
+    region still unassigned after `cap` rounds returns None and the
+    caller uses the O(n) walk.
+    """
+    if cap is None:
+        cap = _WAVEFRONT_CAP
+    m = hi - lo
+    if m <= 1:
+        return np.zeros(m, np.int32)
+    rel = np.arange(m, dtype=np.int64)
+    # Serial tokens: even ids = id/pending groups, odd = durable
+    # first-wins targets (namespaces never collide).
+    id_group = meta["id_group"][lo:hi]
+    s_tok = [2 * id_group]
+    s_ev = [rel]
+    pg = meta["p_group"][lo:hi]
+    msk = pg >= 0
+    s_tok.append(2 * pg[msk])
+    s_ev.append(rel[msk])
+    pt = meta["p_tgt"][lo:hi]
+    msk = pt >= 0
+    s_tok.append(2 * pt[msk] + 1)
+    s_ev.append(rel[msk])
+    ser_tok = np.concatenate(s_tok)
+    ser_ev = np.concatenate(s_ev)
+    _, ser_tok = np.unique(ser_tok, return_inverse=True)
+    n_ser = int(ser_tok.max()) + 1
+
+    # Slot entries: (slot, event, role).
+    sl, se, sr = [], [], []
+    for name, is_read in (
+        ("reads0", True), ("reads1", True),
+        ("writes0", False), ("writes1", False),
+    ):
+        a = meta[name][lo:hi]
+        msk = a >= 0
+        sl.append(a[msk])
+        se.append(rel[msk])
+        sr.append(np.full(int(msk.sum()), is_read))
+    if len(inb_ev):
+        msk = (inb_ev >= lo) & (inb_ev < hi)
+        sl.append(inb_slot[msk])
+        se.append(inb_ev[msk] - lo)
+        sr.append(np.zeros(int(msk.sum()), bool))
+    slot = np.concatenate(sl)
+    sev = np.concatenate(se)
+    sread = np.concatenate(sr)
+    have_slots = len(slot) > 0
+    if have_slots:
+        _, slot = np.unique(slot, return_inverse=True)
+        n_slot = int(slot.max()) + 1
+
+    levels = np.full(m, -1, np.int32)
+    un = np.ones(m, bool)
+    big = np.int64(m)
+    for lvl in range(cap):
+        blk = np.zeros(m, bool)
+        act = un[ser_ev]
+        t_min = np.full(n_ser, big, np.int64)
+        np.minimum.at(t_min, ser_tok[act], ser_ev[act])
+        e_act = ser_ev[act]
+        np.logical_or.at(blk, e_act, e_act > t_min[ser_tok[act]])
+        if have_slots:
+            sact = un[sev]
+            a_min = np.full(n_slot, big, np.int64)
+            np.minimum.at(a_min, slot[sact], sev[sact])
+            r_min = np.full(n_slot, big, np.int64)
+            ract = sact & sread
+            np.minimum.at(r_min, slot[ract], sev[ract])
+            es = sev[sact]
+            lim = np.where(
+                sread[sact], a_min[slot[sact]], r_min[slot[sact]]
+            )
+            np.logical_or.at(blk, es, es > lim)
+        take = un & ~blk
+        if not take.any():
+            # The DAG is acyclic (edges point forward), so this is
+            # unreachable while events remain — guard anyway.
+            return None
+        levels[take] = lvl
+        un &= ~take
+        if not un.any():
+            return levels
+    return None
+
+
+def _chain_wave_steps(i: int, j: int, n: int, meta: dict, claims):
+    """Chain-wave admission for the chain run [i, j): the padded
+    position-step count when the run's chains may execute
+    position-stepped, else None (keep the exact scan).
+
+    Requirements — each guards a specific exactness argument:
+    - no must-scan members (history snapshots are semantically read)
+      and no post/void members (first-wins + rollback un-finalize
+      would couple chains);
+    - every member's id-group is claimed exactly once batch-wide
+      (fresh-or-durable-dup ids, never referenced by another event:
+      a rolled-back member's created-record registration can then
+      never feed a later exists/pending merge);
+    - chains are pairwise independent: a balance slot touched by two
+      different chains must have NO reader (commuting adds may share;
+      a read coupled to another chain's writes — or its rollback —
+      would diverge from the sequential order);
+    - the longest chain fits the TB_WAVES_CHAIN_MAX cap, and the
+      padded step count actually beats the scan's one step/member.
+    """
+    cap = chain_max()
+    if cap < 2:
+        return None
+    if meta["chain_serial"][i:j].any() or meta["is_pv"][i:j].any():
+        return None
+    if (claims[meta["id_group"][i:j]] != 1).any():
+        return None
+    linked = meta["linked"][i:j]
+    m = j - i
+    starts = np.empty(m, bool)
+    starts[0] = True
+    starts[1:] = ~linked[:-1]
+    chain_rel = np.cumsum(starts) - 1
+    n_chains = int(chain_rel[-1]) + 1
+    if n_chains < 2:
+        return None
+    max_len = int(np.bincount(chain_rel).max())
+    if max_len > cap:
+        return None
+    steps = _bucket_positions(max_len)
+    if steps >= m:
+        return None
+    # Pairwise chain independence over balance slots.
+    sl, ch, rd = [], [], []
+    for name, is_read in (
+        ("reads0", True), ("reads1", True),
+        ("writes0", False), ("writes1", False),
+    ):
+        a = meta[name][i:j]
+        msk = a >= 0
+        sl.append(a[msk])
+        ch.append(chain_rel[msk])
+        rd.append(np.full(int(msk.sum()), is_read))
+    slot = np.concatenate(sl)
+    if len(slot):
+        chain_of = np.concatenate(ch)
+        isr = np.concatenate(rd)
+        order = np.lexsort((chain_of, slot))
+        slot, chain_of, isr = slot[order], chain_of[order], isr[order]
+        seg_new = np.empty(len(slot), bool)
+        seg_new[0] = True
+        seg_new[1:] = slot[1:] != slot[:-1]
+        seg_id = np.cumsum(seg_new) - 1
+        n_seg = int(seg_id[-1]) + 1
+        first_chain = chain_of[seg_new][seg_id]
+        multi = np.zeros(n_seg, bool)
+        np.logical_or.at(multi, seg_id, chain_of != first_chain)
+        has_read = np.zeros(n_seg, bool)
+        np.logical_or.at(has_read, seg_id, isr)
+        if (multi & has_read).any():
+            return None
+    return steps
+
+
+def plan_waves(
+    n: int, meta: dict, use_walk: bool = False, inb_pairs=None
+) -> WavePlan:
+    """Partition a batch into wave/chain-wave/scan segments.
 
     Chain runs (contiguous spans of ``chain_member`` events) are
-    barriers executed by the exact scan at their batch position.  The
-    chain-free REGIONS between them schedule like a parallel-EVM
-    conflict graph (arXiv:2503.04595): each event's *level* is one
-    more than the highest level of any earlier in-region event it
-    conflicts with (shared id/pending token, first-wins target, or a
-    read-write balance-slot overlap), and each level executes as ONE
-    wave — commuting adds never conflict, so a two_phase batch of
-    (pending, finalize) pairs collapses to exactly two waves.  Level
-    order preserves sequential semantics for every conflicting pair;
+    barriers at their batch position: runs of mutually independent
+    linked chains execute position-stepped as a "chains" segment
+    (~max_chain_len device steps — see _chain_wave_steps for the
+    admission), everything else stays an exact scan.  The chain-free
+    REGIONS between them schedule like a parallel-EVM conflict graph
+    (arXiv:2503.04595): each event's *level* is one more than the
+    highest level of any earlier in-region event it conflicts with
+    (shared id/pending token, first-wins target, or a read-write
+    balance-slot overlap), and each level executes as ONE wave —
+    commuting adds never conflict, so a two_phase batch of (pending,
+    finalize) pairs collapses to exactly two waves.  Level order
+    preserves sequential semantics for every conflicting pair;
     non-conflicting events commute, so any interleaving of levels is
     bit-identical to the scan.
 
+    Levels come from the vectorized wavefront (_levels_wavefront,
+    sorted-token segmented mins — <100 µs for bench-shaped batches) and
+    fall back to the per-event Python walk for regions more serial
+    than _WAVEFRONT_CAP levels; ``use_walk=True`` forces the walk —
+    the reference algorithm the fuzz pins the wavefront against.
+
     `meta` comes from resolve.wave_dependency_metadata — see there for
-    the field contract.  O(n) with small-constant dict operations;
-    runs once per batch on the host, only when the wave path is a
-    routing candidate.
+    the field contract; `inb_pairs` lets a caller that already built
+    the in-batch finalizer write pairs (_inb_pv_write_pairs — the
+    admission in tpu._plan_wave_execution needs them too) pass them
+    in instead of recomputing.  Runs once per batch on the host, only
+    when the wave path is a routing candidate.
     """
     chain_member = meta["chain_member"]
     id_group = meta["id_group"]
     p_group = meta["p_group"]
     p_tgt = meta["p_tgt"]
-    writes0 = meta["writes0"]
-    writes1 = meta["writes1"]
-    reads0 = meta["reads0"]
-    reads1 = meta["reads1"]
+    reads0, reads1 = meta["reads0"], meta["reads1"]
     inb_pv = meta["inb_pv"]
-    ev_dr = meta["ev_dr"]
-    ev_cr = meta["ev_cr"]
 
     # Fast path for the dominant shape (fresh unique ids, no chains, no
     # finalizers, no balance readers): the whole batch is ONE wave —
-    # skip the per-event Python walk entirely.
+    # skip level assignment entirely.  The arange test covers the
+    # ascending-id encoding (tpu.py's identity grouping) without the
+    # O(n log n) unique().
     if (
         not chain_member.any()
         and not inb_pv.any()
@@ -242,89 +603,51 @@ def plan_waves(n: int, meta: dict) -> WavePlan:
         and (reads1 < 0).all()
         and (p_tgt < 0).all()
         and (p_group < 0).all()
-        and len(np.unique(id_group)) == n
+        and (
+            (len(id_group) == n and id_group[0] == 0
+             and bool((np.diff(id_group) == 1).all()))
+            or len(np.unique(id_group)) == n
+        )
     ):
         plan = WavePlan(n, segments=[("wave", np.arange(n))])
         plan.wave_mask = np.ones(n, bool)
         return plan
 
-    # In-batch pending references resolve to the creating event at run
-    # time; statically, the finalizer may write the slots of ANY event
-    # sharing that id-group (the creator is whichever applied), so its
-    # write set is the group's slot union.
-    group_slots: dict[int, set] = {}
-    for e in range(n):
-        g = int(id_group[e])
-        s = group_slots.setdefault(g, set())
-        if ev_dr[e] >= 0:
-            s.add(int(ev_dr[e]))
-        if ev_cr[e] >= 0:
-            s.add(int(ev_cr[e]))
+    inb_ev, inb_slot = (
+        inb_pairs if inb_pairs is not None else _inb_pv_write_pairs(n, meta)
+    )
+    group_slots = None  # walk-fallback slot unions, built lazily
+    claims = None  # batch-wide id-group claim counts, built lazily
 
     plan = WavePlan(n)
     wave_mask = np.zeros(n, bool)
     segments = plan.segments
 
+    def walk_group_slots():
+        # In-batch pending references resolve to the creating event at
+        # run time; statically, the finalizer may write the slots of
+        # ANY event sharing that id-group, so its write set is the
+        # group's slot union.
+        nonlocal group_slots
+        if group_slots is None:
+            group_slots = {}
+            if inb_pv.any():
+                ev_dr, ev_cr = meta["ev_dr"], meta["ev_cr"]
+                for e in range(n):
+                    g = int(id_group[e])
+                    s = group_slots.setdefault(g, set())
+                    if ev_dr[e] >= 0:
+                        s.add(int(ev_dr[e]))
+                    if ev_cr[e] >= 0:
+                        s.add(int(ev_cr[e]))
+        return group_slots
+
     def level_region(lo: int, hi: int) -> None:
-        """Assign conflict-graph levels to [lo, hi) (no chain members)
-        and emit one wave segment per level, in level order."""
-        group_level: dict[int, int] = {}
-        ptgt_level: dict[int, int] = {}
-        write_level: dict[int, int] = {}
-        read_level: dict[int, int] = {}
-        levels = np.zeros(hi - lo, np.int32)
-        for e in range(lo, hi):
-            g = int(id_group[e])
-            pg = int(p_group[e])
-            pt = int(p_tgt[e])
-            ww = []
-            if writes0[e] >= 0:
-                ww.append(int(writes0[e]))
-            if writes1[e] >= 0:
-                ww.append(int(writes1[e]))
-            if inb_pv[e]:
-                ww.extend(group_slots.get(pg, ()))
-            rr = []
-            if reads0[e] >= 0:
-                rr.append(int(reads0[e]))
-            if reads1[e] >= 0:
-                rr.append(int(reads1[e]))
-
-            # Level = 1 + max level of every earlier conflicting
-            # event: same-id claims (exists ladder), pending refs,
-            # first-wins finalize targets, then balance-slot RAW/WAR
-            # (a reader must see exactly the earlier writers' adds;
-            # later writers must apply after it reads).  Reads also
-            # serialize against earlier reads — a balancing/limit
-            # reader's own writes are data-dependent, and the greedy
-            # rule this generalizes kept reader pairs ordered.
-            lvl = group_level.get(g, -1) + 1
-            if pg >= 0:
-                lvl = max(lvl, group_level.get(pg, -1) + 1)
-            if pt >= 0:
-                lvl = max(lvl, ptgt_level.get(pt, -1) + 1)
-            for s in rr:
-                lvl = max(
-                    lvl,
-                    write_level.get(s, -1) + 1,
-                    read_level.get(s, -1) + 1,
-                )
-            for s in ww:
-                lvl = max(lvl, read_level.get(s, -1) + 1)
-
-            levels[e - lo] = lvl
-            if lvl > group_level.get(g, -1):
-                group_level[g] = lvl
-            if pg >= 0 and lvl > group_level.get(pg, -1):
-                group_level[pg] = lvl
-            if pt >= 0 and lvl > ptgt_level.get(pt, -1):
-                ptgt_level[pt] = lvl
-            for s in ww:
-                if lvl > write_level.get(s, -1):
-                    write_level[s] = lvl
-            for s in rr:
-                if lvl > read_level.get(s, -1):
-                    read_level[s] = lvl
+        levels = None
+        if not use_walk:
+            levels = _levels_wavefront(lo, hi, meta, inb_ev, inb_slot)
+        if levels is None:
+            levels = _levels_walk(lo, hi, meta, walk_group_slots())
         for lvl in range(int(levels.max()) + 1 if hi > lo else 0):
             idx = lo + np.flatnonzero(levels == lvl)
             segments.append(("wave", idx))
@@ -336,7 +659,19 @@ def plan_waves(n: int, meta: dict) -> WavePlan:
             j = i
             while j < n and chain_member[j]:
                 j += 1
-            segments.append(("scan", np.arange(i, j)))
+            if claims is None:
+                span = int(max(id_group.max(), p_group.max())) + 1
+                claims = np.bincount(id_group, minlength=span)
+                pgv = p_group[p_group >= 0]
+                if len(pgv):
+                    claims = claims + np.bincount(pgv, minlength=span)
+            steps = _chain_wave_steps(i, j, n, meta, claims)
+            if steps is not None:
+                segments.append(("chains", np.arange(i, j)))
+                plan.chain_steps[len(segments) - 1] = steps
+                wave_mask[i:j] = True
+            else:
+                segments.append(("scan", np.arange(i, j)))
             i = j
             continue
         j = i
@@ -356,33 +691,95 @@ def plan_waves(n: int, meta: dict) -> WavePlan:
 def admission_ok(
     mirror_lo: np.ndarray,
     mirror_hi: np.ndarray,
-    touched: np.ndarray,
+    slots: np.ndarray,
     bound_lo: np.ndarray,
     bound_hi: np.ndarray,
+    extra: int = 0,
 ) -> bool:
-    """Superset overflow admission for the whole batch.
+    """Per-column superset overflow admission for the whole batch.
 
-    True when (pre-state + all-applied additions) provably cannot
-    overflow any touched u128 column or dp+dpo / cp+cpo pair — then
-    every per-event ov_* term is false in ANY execution order (amounts
-    are non-negative, so each sequential prefix is bounded by the
-    all-applied total).  Conservative: `bound_*` are per-event amount
-    upper bounds (balancing zero-amount -> maxInt u64), each charged to
-    all four lanes an event can add through.
+    `slots` / `bound_lo` / `bound_hi` are aligned per-CONTRIBUTION
+    arrays: each (slot, bound) entry upper-bounds one balance-column
+    addition the batch can make at that slot (slot < 0 entries are
+    ignored; an event appears once per slot it can add through —
+    dr/cr for a create, the target's slot union for a finalizer).
+
+    True when, for every touched slot, (pre dp+dpo) + T and
+    (pre cp+cpo) + T provably fit u128, where T = the slot's bound sum
+    plus `extra` — a host-integer upper bound on contributions already
+    in flight but not yet reflected in the mirror (the device engine's
+    window pipelining; zero on the drained host path).  Then every
+    per-event ov_* term is false in ANY execution order: amounts are
+    non-negative, so each sequential prefix of any column (and either
+    pair) is bounded by pre + all-applied additions to that slot, and
+    releases only shrink it.  Per-column bounding (instead of the old
+    whole-table "any nonzero hi limb declines" rule) admits u128-scale
+    balances as long as their remaining headroom covers the batch —
+    ROADMAP "Wave-path admission breadth".
     """
-    touched = touched[touched >= 0]
-    if len(touched) and mirror_hi[touched].any():
-        return False
+    valid = slots >= 0
+    if not valid.all():
+        slots = slots[valid]
+        bound_lo = bound_lo[valid]
+        bound_hi = bound_hi[valid]
+    if len(slots) == 0:
+        return True
+    # float64 limb bincounts are exact below 2^53: < 2^21 entries of
+    # 32-bit limbs (same bound compact_deltas relies on).
+    assert len(slots) < (1 << 21)
     m32 = np.uint64(0xFFFFFFFF)
-    s_ll = int((bound_lo & m32).sum(dtype=np.uint64))
-    s_lh = int((bound_lo >> np.uint64(32)).sum(dtype=np.uint64))
-    s_hl = int((bound_hi & m32).sum(dtype=np.uint64))
-    s_hh = int((bound_hi >> np.uint64(32)).sum(dtype=np.uint64))
-    total = s_ll + (s_lh << 32) + (s_hl << 64) + (s_hh << 96)
-    # x4: dr+cr lanes for the create plus dr+cr for a post's add.
-    # Touched cols start < 2^64 (hi limbs all zero), so column and
-    # pair sums stay < 2^64 + 2^127 < 2^128.
-    return 4 * total < (1 << 126)
+    top = int(slots.max()) + 1
+    acc = [
+        np.bincount(slots, limb.astype(np.float64), top).astype(np.uint64)
+        for limb in (
+            bound_lo & m32, bound_lo >> np.uint64(32),
+            bound_hi & m32, bound_hi >> np.uint64(32),
+        )
+    ]
+    c0, c1, c2, c3 = acc
+    c1 = c1 + (c0 >> np.uint64(32))
+    c2 = c2 + (c1 >> np.uint64(32))
+    c3 = c3 + (c2 >> np.uint64(32))
+    if ((c3 >> np.uint64(32)) != 0).any():
+        return False  # one slot's bound sum alone exceeds u128
+    t_lo = (c0 & m32) | ((c1 & m32) << np.uint64(32))
+    t_hi = (c2 & m32) | ((c3 & m32) << np.uint64(32))
+    touched = np.unique(slots)
+    T_lo = t_lo[touched]
+    T_hi = t_hi[touched]
+    if extra:
+        if extra >> 128:
+            return False
+        e_lo = np.uint64(extra & ((1 << 64) - 1))
+        e_hi = np.uint64(extra >> 64)
+        nl = T_lo + e_lo
+        carry = (nl < T_lo).astype(np.uint64)
+        nh = T_hi + e_hi
+        ov = nh < T_hi
+        nh2 = nh + carry
+        if (ov | (nh2 < nh)).any():
+            return False
+        T_lo, T_hi = nl, nh2
+    for a, b in ((0, 1), (2, 3)):
+        # pre pair = column a + column b (cannot overflow u128: the
+        # engine's own overflow codes maintain the pair invariant —
+        # checked anyway, a corrupt mirror must decline, not admit).
+        pl = mirror_lo[touched, a] + mirror_lo[touched, b]
+        cy = (pl < mirror_lo[touched, a]).astype(np.uint64)
+        ph_p = mirror_hi[touched, a] + mirror_hi[touched, b]
+        p_ov = ph_p < mirror_hi[touched, a]
+        ph = ph_p + cy
+        p_ov = p_ov | (ph < ph_p)
+        if p_ov.any():
+            return False
+        sl = pl + T_lo
+        s_cy = (sl < pl).astype(np.uint64)
+        sh_p = ph + T_hi
+        s_ov = sh_p < ph
+        s_ov = s_ov | ((sh_p + s_cy) < sh_p)
+        if s_ov.any():
+            return False
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -744,6 +1141,313 @@ def _wave_step_impl(carry, ev, n, ts_base):
 
 
 _wave_step = jax.jit(_wave_step_impl, donate_argnums=(0,))
+# Non-donating twin for the device engine's window launch: the engine
+# passes its AUTHORITATIVE table handle into the executor and must be
+# able to retry the whole batch from that same handle after a
+# transient link fault — donation would invalidate it mid-flight.
+_wave_step_keep = jax.jit(_wave_step_impl)
+
+
+# ---------------------------------------------------------------------------
+# Chain-wave step: a contiguous run of mutually independent linked
+# chains executed as ONE lax.scan over chain POSITION — step p applies
+# the p-th member of every chain as a vectorized lane batch (the
+# device linked kernel's fixpoint shape), so a chain-dominated region
+# costs ~max_chain_len device steps instead of one per member.
+
+
+def _chain_wave_impl(carry, ev, n, ts_base):
+    """Execute one "chains" segment against the segment carry.
+
+    `ev` is a dict of (P, C) stacked event arrays — position-major,
+    one lane per chain, padding lanes carrying i == B — plus a
+    ``chain_open`` bool plane (linked flag on the batch's last event).
+    Admission (waves._chain_wave_steps) guarantees: plain creates only
+    (no post/void), no history accounts, id-groups claimed exactly
+    once batch-wide, and pairwise chain independence over balance
+    slots — so each lane's gathers see exactly its own chain's prior
+    effects plus commuting cross-chain adds to UNREAD slots, and the
+    per-position body below (the _wave_step normal-create path plus
+    the scan's chain machinery) reproduces the sequential scan's
+    results bit-for-bit.  Chain failure semantics match make_body:
+    the failing member keeps its own code, every other member reports
+    linked_event_failed (chain_open on an open tail), applied members'
+    balance effects are rolled back by an exact trailing subtraction,
+    and — like the reference's unscoped pulse bookkeeping —
+    pulse_create signals recorded at apply time survive the rollback
+    while created_mask/inb_status/group_creator registrations do not.
+    """
+    B = carry["results"].shape[0]
+    A = carry["balances"].shape[0]
+    C = ev["i"].shape[1]
+
+    def step(state, ev_p):
+        cr, alive = state
+        table = cr["balances"]
+        created = cr["created"]
+        group_creator = cr["group_creator"]
+        i = ev_p["i"]
+        active = i < n
+        flags = ev_p["flags"]
+        ts_i = ts_base + i.astype(jnp.uint64)
+
+        pre = _first_nonzero(
+            (ev_p["chain_open"], kernel.R_LINKED_EVENT_CHAIN_OPEN),
+            (~alive, kernel.R_LINKED_EVENT_FAILED),
+            (ev_p["ts_nonzero"], R_TIMESTAMP_MUST_BE_ZERO),
+        )
+        pre = jnp.where(pre == 0, ev_p["static_result"], pre)
+
+        # Exists: id-groups are claimed exactly once batch-wide, so
+        # only the durable duplicate can exist — no in-batch creator.
+        e_any = ev_p["e_found"]
+        e = {
+            f: ev_p[nm].astype(created[f].dtype)
+            for f, nm in _E_FIELD_MAP.items()
+        }
+        exists_rn = _exists_ladder_normal(ev_p, e)
+
+        dr_row = table[jnp.clip(ev_p["dr_slot"], 0, A - 1)]
+        cr_row = table[jnp.clip(ev_p["cr_slot"], 0, A - 1)]
+        dr_dp = (dr_row[:, DP_LO], dr_row[:, DP_HI])
+        dr_dpo = (dr_row[:, DPO_LO], dr_row[:, DPO_HI])
+        dr_cpo = (dr_row[:, CPO_LO], dr_row[:, CPO_HI])
+        cr_dpo = (cr_row[:, DPO_LO], cr_row[:, DPO_HI])
+        cr_cp = (cr_row[:, CP_LO], cr_row[:, CP_HI])
+        cr_cpo = (cr_row[:, CPO_LO], cr_row[:, CPO_HI])
+
+        is_balancing = (flags & (F_BAL_DR | F_BAL_CR)) != 0
+        amount = (ev_p["amount_lo"], ev_p["amount_hi"])
+        amount = w.select(
+            is_balancing & w.is_zero(amount),
+            (jnp.full_like(amount[0], U64_MAX), jnp.zeros_like(amount[1])),
+            amount,
+        )
+        dr_balance, _ = w.add(dr_dpo, dr_dp)
+        bd_avail = w.sub_sat(dr_cpo, dr_balance)
+        amount = w.select(
+            (flags & F_BAL_DR) != 0, w.minimum(amount, bd_avail), amount
+        )
+        bd_fail = ((flags & F_BAL_DR) != 0) & w.is_zero(amount)
+        cr_balance, _ = w.add(cr_cpo, cr_cp)
+        bc_avail = w.sub_sat(cr_dpo, cr_balance)
+        amount_bc = w.minimum(amount, bc_avail)
+        amount = w.select(
+            ((flags & F_BAL_CR) != 0) & ~bd_fail, amount_bc, amount
+        )
+        bc_fail = ((flags & F_BAL_CR) != 0) & w.is_zero(amount) & ~bd_fail
+
+        is_pending = (flags & F_PENDING) != 0
+        _, ov_dp = w.add(amount, dr_dp)
+        _, ov_cp = w.add(amount, cr_cp)
+        _, ov_dpo = w.add(amount, dr_dpo)
+        _, ov_cpo = w.add(amount, cr_cpo)
+        dr_total, _ = w.add(dr_dp, dr_dpo)
+        _, ov_debits = w.add(amount, dr_total)
+        cr_total, _ = w.add(cr_cp, cr_cpo)
+        _, ov_credits = w.add(amount, cr_total)
+        timeout_ns = ev_p["timeout"] * NS_PER_S
+        ts_plus = ts_i + timeout_ns
+        ov_timeout = ts_plus < ts_i
+        dr_lhs, _ = w.add(dr_total, amount)
+        exceeds_cr = ((ev_p["dr_flags"] & AF_DR_LIMIT) != 0) & w.gt(
+            dr_lhs, dr_cpo
+        )
+        cr_lhs, _ = w.add(cr_total, amount)
+        exceeds_dr = ((ev_p["cr_flags"] & AF_CR_LIMIT) != 0) & w.gt(
+            cr_lhs, cr_dpo
+        )
+
+        rn = _first_nonzero(
+            (e_any, _EXISTS_SENTINEL),
+            (bd_fail, R_EXCEEDS_CREDITS),
+            (bc_fail, R_EXCEEDS_DEBITS),
+            (is_pending & ov_dp, R_OVERFLOWS_DP),
+            (is_pending & ov_cp, R_OVERFLOWS_CP),
+            (ov_dpo, R_OVERFLOWS_DPO),
+            (ov_cpo, R_OVERFLOWS_CPO),
+            (ov_debits, R_OVERFLOWS_DEBITS),
+            (ov_credits, R_OVERFLOWS_CREDITS),
+            (ov_timeout, R_OVERFLOWS_TIMEOUT),
+            (exceeds_cr, R_EXCEEDS_CREDITS),
+            (exceeds_dr, R_EXCEEDS_DEBITS),
+        )
+        rn = jnp.where(rn == _EXISTS_SENTINEL, exists_rn, rn)
+
+        gate = active & (pre == 0)
+        r = jnp.where(gate, rn, jnp.where(active, pre, 0))
+        applied = gate & (rn == 0)
+        fail = active & alive & (r != 0)
+        alive = alive & ~fail
+
+        # -- Balance adds (segment-summed; pairwise independence makes
+        # same-slot duplicates commuting cross-chain adds).
+        safe_dr = jnp.clip(ev_p["dr_slot"], 0, A - 1)
+        safe_cr = jnp.clip(ev_p["cr_slot"], 0, A - 1)
+        zi = jnp.zeros_like(i)
+        add_slots = jnp.concatenate([safe_dr, safe_cr])
+        add_cols = jnp.concatenate(
+            [
+                jnp.where(is_pending, zi, zi + 1),
+                jnp.where(is_pending, zi + 2, zi + 3),
+            ]
+        )
+        add_lo = jnp.concatenate([amount[0]] * 2)
+        add_hi = jnp.concatenate([amount[1]] * 2)
+        valid = jnp.concatenate([applied, applied])
+        d_lo, d_hi = _accum_u128(add_slots, add_cols, add_lo, add_hi, valid, A)
+        old_lo = table[:, 0::2]
+        old_hi = table[:, 1::2]
+        t_lo = old_lo + d_lo
+        cy = (t_lo < old_lo).astype(jnp.uint64)
+        t_hi = old_hi + d_hi + cy
+        new_table = jnp.stack(
+            [t_lo[:, 0], t_hi[:, 0], t_lo[:, 1], t_hi[:, 1],
+             t_lo[:, 2], t_hi[:, 2], t_lo[:, 3], t_hi[:, 3]],
+            axis=-1,
+        )
+
+        # -- Snapshots (pre-row + own delta; rewritten to batch finals
+        # at finalize for surviving members, unused for failed ones).
+        n_dr_dp = w.select(is_pending, w.add(dr_dp, amount)[0], dr_dp)
+        n_dr_dpo = w.select(is_pending, dr_dpo, w.add(dr_dpo, amount)[0])
+        n_cr_cp = w.select(is_pending, w.add(cr_cp, amount)[0], cr_cp)
+        n_cr_cpo = w.select(is_pending, cr_cpo, w.add(cr_cpo, amount)[0])
+        new_dr_row = jnp.stack(
+            [n_dr_dp[0], n_dr_dp[1], n_dr_dpo[0], n_dr_dpo[1],
+             dr_row[:, CP_LO], dr_row[:, CP_HI],
+             dr_row[:, CPO_LO], dr_row[:, CPO_HI]],
+            axis=-1,
+        )
+        new_cr_row = jnp.stack(
+            [cr_row[:, DP_LO], cr_row[:, DP_HI],
+             cr_row[:, DPO_LO], cr_row[:, DPO_HI],
+             n_cr_cp[0], n_cr_cp[1], n_cr_cpo[0], n_cr_cpo[1]],
+            axis=-1,
+        )
+
+        rec = {
+            "flags": flags,
+            "dr_slot": ev_p["dr_slot"],
+            "cr_slot": ev_p["cr_slot"],
+            "amount_lo": amount[0],
+            "amount_hi": amount[1],
+            "pending_lo": ev_p["pending_lo"],
+            "pending_hi": ev_p["pending_hi"],
+            "ud128_lo": ev_p["ud128_lo"],
+            "ud128_hi": ev_p["ud128_hi"],
+            "ud64": ev_p["ud64"],
+            "ud32": ev_p["ud32"],
+            "timeout": ev_p["timeout"],
+            "ledger": ev_p["ledger"],
+            "code": ev_p["code"],
+        }
+        idx_i = jnp.where(active, i, B)
+        idx_ins = jnp.where(applied, i, B)
+        created = {
+            f: created[f]
+            .at[idx_ins]
+            .set(rec[f].astype(created[f].dtype), mode="drop")
+            for f in CREATED_FIELDS
+        }
+        created_mask = cr["created_mask"].at[idx_i].set(applied, mode="drop")
+        gidx = jnp.where(applied, jnp.clip(ev_p["id_group"], 0, B - 1), B)
+        group_creator = group_creator.at[gidx].set(i, mode="drop")
+        inb_status = cr["inb_status"].at[idx_i].set(
+            jnp.where(applied & is_pending, jnp.uint32(S_PENDING), 0),
+            mode="drop",
+        )
+        hist_dr = cr["hist_dr"].at[idx_i].set(new_dr_row, mode="drop")
+        hist_cr = cr["hist_cr"].at[idx_i].set(new_cr_row, mode="drop")
+        results = cr["results"].at[idx_i].set(r, mode="drop")
+        last_applied = jnp.maximum(
+            cr["last_applied"], jnp.where(applied, i, -1).max()
+        )
+        pulse_create = cr["pulse_create"].at[idx_i].set(
+            jnp.where(
+                applied & is_pending & (ev_p["timeout"] > 0),
+                ts_i + timeout_ns,
+                jnp.uint64(0),
+            ),
+            mode="drop",
+        )
+
+        cr = dict(
+            cr,
+            balances=new_table,
+            results=results,
+            created_mask=created_mask,
+            created=created,
+            group_creator=group_creator,
+            inb_status=inb_status,
+            hist_dr=hist_dr,
+            hist_cr=hist_cr,
+            last_applied=last_applied,
+            pulse_create=pulse_create,
+        )
+        ys = (
+            i, r, applied, safe_dr, safe_cr,
+            amount[0], amount[1], is_pending,
+            jnp.clip(ev_p["id_group"], 0, B - 1),
+        )
+        return (cr, alive), ys
+
+    alive0 = jnp.ones(C, bool)
+    (carry, alive), ys = jax.lax.scan(step, (carry, alive0), ev)
+    (ys_i, ys_r, ys_ap, ys_dr, ys_cr,
+     ys_alo, ys_ahi, ys_pend, ys_g) = ys
+
+    # -- Chain-failure repair: exact rollback subtraction of every
+    # applied member of a failed chain, result/registration rewrite.
+    dead = ~alive
+    rb = ys_ap & dead[None, :]
+    flat = lambda a: a.reshape(-1)  # noqa: E731
+    zi = jnp.zeros_like(flat(ys_i))
+    sub_slots = jnp.concatenate([flat(ys_dr), flat(ys_cr)])
+    pend_f = flat(ys_pend)
+    sub_cols = jnp.concatenate(
+        [jnp.where(pend_f, zi, zi + 1), jnp.where(pend_f, zi + 2, zi + 3)]
+    )
+    sub_lo = jnp.concatenate([flat(ys_alo)] * 2)
+    sub_hi = jnp.concatenate([flat(ys_ahi)] * 2)
+    sub_valid = jnp.concatenate([flat(rb)] * 2)
+    s_lo, s_hi = _accum_u128(sub_slots, sub_cols, sub_lo, sub_hi, sub_valid, A)
+    table = carry["balances"]
+    old_lo = table[:, 0::2]
+    old_hi = table[:, 1::2]
+    n_lo = old_lo - s_lo
+    bw = (old_lo < s_lo).astype(jnp.uint64)
+    n_hi = old_hi - s_hi - bw
+    table = jnp.stack(
+        [n_lo[:, 0], n_hi[:, 0], n_lo[:, 1], n_hi[:, 1],
+         n_lo[:, 2], n_hi[:, 2], n_lo[:, 3], n_hi[:, 3]],
+        axis=-1,
+    )
+    fix = (ys_r == 0) & dead[None, :] & (ys_i < n)
+    idxf = jnp.where(fix, ys_i, B).reshape(-1)
+    results = carry["results"].at[idxf].set(
+        jnp.uint32(kernel.R_LINKED_EVENT_FAILED), mode="drop"
+    )
+    created_mask = carry["created_mask"].at[idxf].set(False, mode="drop")
+    inb_status = carry["inb_status"].at[idxf].set(
+        jnp.uint32(0), mode="drop"
+    )
+    gidxf = jnp.where(fix, ys_g, B).reshape(-1)
+    group_creator = carry["group_creator"].at[gidxf].set(
+        jnp.int32(-1), mode="drop"
+    )
+    return dict(
+        carry,
+        balances=table,
+        results=results,
+        created_mask=created_mask,
+        inb_status=inb_status,
+        group_creator=group_creator,
+    )
+
+
+_chain_step = jax.jit(_chain_wave_impl, donate_argnums=(0,))
+_chain_step_keep = jax.jit(_chain_wave_impl)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -751,15 +1455,21 @@ def _init_carry(balances, dstat_init):
     return kernel.make_carry(balances, dstat_init, dstat_init.shape[0])
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _finalize_impl(carry, hist_fix):
+@jax.jit
+def _init_carry_keep(balances, dstat_init):
+    return kernel.make_carry(balances, dstat_init, dstat_init.shape[0])
+
+
+def _finalize_body(carry, hist_fix):
     """Pack outputs; rewrite wave events' balance snapshots with the
     BATCH-FINAL rows of their touched slots so the host's last-write-
     wins mirror reconstruction lands on exact finals (a wave event's
-    own snapshot misses wave-mates' commuting deltas to the same slot).
-    `hist_fix` is the wave mask: scan-segment events keep their
-    sequential snapshots — history-account events always run there, so
-    the history groove only ever sees sequential-exact rows."""
+    own snapshot misses wave-mates' commuting deltas to the same slot,
+    and a chain-wave member cross-chain commuting adds).  `hist_fix`
+    is the wave mask (wave + chain-wave events): scan-segment events
+    keep their sequential snapshots — history-account events always
+    run there, so the history groove only ever sees sequential-exact
+    rows."""
     table = carry["balances"]
     A = table.shape[0]
     fix = hist_fix & (carry["results"] == 0)
@@ -772,11 +1482,26 @@ def _finalize_impl(carry, hist_fix):
     )
 
 
+_finalize_impl = jax.jit(_finalize_body, donate_argnums=(0,))
+_finalize_keep = jax.jit(_finalize_body)
+
+
 def _bucket(k: int) -> int:
     for b in _SEG_BUCKETS:
         if b >= k:
             return b
     return k
+
+
+def _bucket_positions(p: int) -> int:
+    """Chain-wave position bucket (compile cache key): the next power
+    of two >= max chain length, floored at 8 — padding positions carry
+    inactive lanes, so a coarse bucket costs compute, not correctness,
+    and keeps the (P, C) compile-cache tractable."""
+    b = 8
+    while b < p:
+        b *= 2
+    return b
 
 
 def _gather_events(ev: dict, idx: np.ndarray, K: int, B: int) -> dict:
@@ -795,6 +1520,86 @@ def _gather_events(ev: dict, idx: np.ndarray, K: int, B: int) -> dict:
     return out
 
 
+# Event fields the chain-wave step consumes (the post/void join
+# columns never ride a "chains" segment — smaller stacked xs).
+_CHAIN_EV_FIELDS = (
+    "i", "flags", "ts_nonzero", "static_result",
+    "amount_lo", "amount_hi", "pending_lo", "pending_hi",
+    "ud128_lo", "ud128_hi", "ud64", "ud32", "timeout", "ledger", "code",
+    "dr_slot", "cr_slot", "dr_flags", "cr_flags", "id_group",
+    "e_found", "e_flags", "e_dr_slot", "e_cr_slot",
+    "e_amount_lo", "e_amount_hi", "e_pending_lo", "e_pending_hi",
+    "e_ud128_lo", "e_ud128_hi", "e_ud64", "e_ud32", "e_timeout",
+    "e_code",
+)
+
+
+def _gather_chain_events(
+    ev: dict, idx: np.ndarray, P: int, n: int, B: int
+) -> dict:
+    """Stack a chain run's events position-major: (P, C) planes, one
+    lane per chain, padding cells carrying i == B (inactive).  Chain
+    boundaries re-derive from the linked flags, so the executor and
+    the partitioner can never disagree on the layout."""
+    flags = ev["flags"][idx]
+    linked = (flags & F_LINKED) != 0
+    m = len(idx)
+    starts = np.empty(m, bool)
+    starts[0] = True
+    starts[1:] = ~linked[:-1]
+    chain_rel = np.cumsum(starts) - 1
+    pos = np.arange(m) - np.flatnonzero(starts)[chain_rel]
+    C = _bucket(int(chain_rel[-1]) + 1)
+    assert int(pos.max()) < P, "chain run exceeds its position bucket"
+    mat = np.full((P, C), B, np.int64)
+    mat[pos, chain_rel] = idx
+    out = {}
+    for name in _CHAIN_EV_FIELDS:
+        arr = ev[name]
+        if name == "i":
+            out[name] = jnp.asarray(mat.astype(np.int32))
+            continue
+        src = np.concatenate([arr, np.zeros(1, arr.dtype)])
+        out[name] = jnp.asarray(src[np.minimum(mat, len(arr))])
+    open_np = np.zeros((P, C), bool)
+    open_np[pos, chain_rel] = linked & (idx == n - 1)
+    out["chain_open"] = jnp.asarray(open_np)
+    return out
+
+
+def _execute_plan(
+    balances, ev: dict, dstat_init, n: int, ts_base: int, plan: WavePlan,
+    hist_fix: np.ndarray, donate: bool,
+):
+    """Run a batch by the plan's segments in order; returns
+    (new_balances, packed outputs) — identical contract to
+    kernel.run_create_transfers."""
+    B = ev["flags"].shape[0]
+    init = _init_carry if donate else _init_carry_keep
+    step = _wave_step if donate else _wave_step_keep
+    chain = _chain_step if donate else _chain_step_keep
+    scan = kernel.scan_segment if donate else kernel.scan_segment_keep
+    fin = _finalize_impl if donate else _finalize_keep
+    carry = init(balances, jnp.asarray(np.asarray(dstat_init), jnp.uint32))
+    id_group_full = jnp.asarray(ev["id_group"])
+    n_j = jnp.int32(n)
+    ts_j = jnp.uint64(ts_base)
+    for k, (seg_kind, idx) in enumerate(plan.segments):
+        if seg_kind == "chains":
+            ev_seg = _gather_chain_events(
+                ev, idx, plan.chain_steps[k], n, B
+            )
+            carry = chain(carry, ev_seg, n_j, ts_j)
+            continue
+        K = _bucket(len(idx))
+        ev_seg = _gather_events(ev, idx, K, B)
+        if seg_kind == "wave":
+            carry = step(carry, ev_seg, n_j, ts_j)
+        else:
+            carry = scan(carry, ev_seg, id_group_full, n_j, ts_j)
+    return fin(carry, jnp.asarray(hist_fix))
+
+
 def run_create_transfers_waves(
     balances, ev: dict, dstat_init, n: int, ts_base: int, plan: WavePlan,
     hist_fix: np.ndarray,
@@ -804,39 +1609,53 @@ def run_create_transfers_waves(
 
     `ev` is the HOST-side dict of (B,) numpy arrays per
     kernel.EVENT_FIELDS; `hist_fix` is a (B,) bool mask of events whose
-    snapshots should be rewritten with batch finals (wave events off
-    history accounts).
+    snapshots should be rewritten with batch finals (wave and
+    chain-wave events off history accounts).  The input `balances`
+    buffer is DONATED (host exact path: the caller replaces its
+    handle).
     """
-    B = ev["flags"].shape[0]
-    carry = _init_carry(
-        balances, jnp.asarray(np.asarray(dstat_init), jnp.uint32)
+    return _execute_plan(
+        balances, ev, dstat_init, n, ts_base, plan, hist_fix, donate=True
     )
-    id_group_full = jnp.asarray(ev["id_group"])
-    n_j = jnp.int32(n)
-    ts_j = jnp.uint64(ts_base)
-    for seg_kind, idx in plan.segments:
-        K = _bucket(len(idx))
-        ev_seg = _gather_events(ev, idx, K, B)
-        if seg_kind == "wave":
-            carry = _wave_step(carry, ev_seg, n_j, ts_j)
-        else:
-            carry = kernel.scan_segment(carry, ev_seg, id_group_full, n_j, ts_j)
-    return _finalize_impl(carry, jnp.asarray(hist_fix))
+
+
+def run_plan_engine(
+    balances, ev: dict, dstat_init, n: int, ts_base: int, plan: WavePlan,
+    hist_fix: np.ndarray,
+):
+    """Device-engine entry: execute a window batch's wave plan against
+    the AUTHORITATIVE table handle without donating any caller buffer
+    — the engine must be able to retry the whole batch from the same
+    handle after a transient link fault, and its `self.balances` stays
+    valid if execution dies partway (demotion re-uploads from the
+    mirror regardless).  Returns (new_balances, packed outputs)."""
+    return _execute_plan(
+        balances, ev, dstat_init, n, ts_base, plan, hist_fix, donate=False
+    )
 
 
 def prewarm(
-    A: int, B_buckets=kernel.BATCH_BUCKETS, buckets=_SEG_BUCKETS
+    A: int, B_buckets=kernel.BATCH_BUCKETS, buckets=_SEG_BUCKETS,
+    engine: bool = False,
 ) -> None:
-    """Compile the wave step (and the paired scan segment) for the
-    given table geometry OFF the hot path: on the tunneled TPU each
-    kernel costs minutes of one-time XLA compile, which must not land
-    inside a timed window (device_engine.prewarm forwards its "waves"
-    kind here; TB_DEV_PREWARM=waves,... opts in).  The jits are
-    shape-keyed on BOTH the carry's batch bucket B and the segment
-    bucket K, so the default warms every (B, K <= B) pair the router
-    can produce — warming only the extremes would leave mid-size
-    first-compiles (e.g. two_phase's ~B/2-event waves, bucket 4096)
-    inside timed windows."""
+    """Compile the wave step, the chain-wave step, and the paired scan
+    segment for the given table geometry OFF the hot path: on the
+    tunneled TPU each kernel costs minutes of one-time XLA compile,
+    which must not land inside a timed window (device_engine.prewarm
+    forwards its "waves" kind here; TB_DEV_PREWARM=waves,... opts in).
+    The jits are shape-keyed on BOTH the carry's batch bucket B and
+    the segment bucket K, so the default warms every (B, K <= B) pair
+    the router can produce — warming only the extremes would leave
+    mid-size first-compiles (e.g. two_phase's ~B/2-event waves, bucket
+    4096) inside timed windows.  `engine=True` additionally warms the
+    non-donating twins the device engine's window launch dispatches
+    (separate XLA executables); the chain-wave step warms at its
+    smallest position bucket (deeper chains recompile once, off the
+    common path)."""
+    step = _wave_step_keep if engine else _wave_step
+    chainf = _chain_step_keep if engine else _chain_step
+    scan = kernel.scan_segment_keep if engine else kernel.scan_segment
+    fin = _finalize_keep if engine else _finalize_impl
     outs = []
     for B in B_buckets:
         ev = {
@@ -851,13 +1670,23 @@ def prewarm(
                 jnp.zeros((A, 8), jnp.uint64), jnp.zeros(B, jnp.uint32), B
             )
             idx = np.arange(min(K, B))
-            carry = _wave_step(
+            carry = step(
                 carry, _gather_events(ev, idx, K, B),
                 jnp.int32(0), jnp.uint64(1),
             )
-            carry = kernel.scan_segment(
+            carry = scan(
                 carry, _gather_events(ev, idx, K, B),
                 jnp.asarray(ev["id_group"]), jnp.int32(0), jnp.uint64(1),
             )
-            outs.append(_finalize_impl(carry, jnp.zeros(B, bool)))
+            if chain_max() >= 2:
+                chain_ev = {
+                    name: jnp.zeros(
+                        (8, K), jnp.asarray(ev[name]).dtype
+                    )
+                    for name in _CHAIN_EV_FIELDS
+                }
+                chain_ev["i"] = jnp.full((8, K), B, jnp.int32)
+                chain_ev["chain_open"] = jnp.zeros((8, K), bool)
+                carry = chainf(carry, chain_ev, jnp.int32(0), jnp.uint64(1))
+            outs.append(fin(carry, jnp.zeros(B, bool)))
     jax.block_until_ready(outs)
