@@ -904,6 +904,23 @@ def _run_subprocess_config(flag: str, timeout_s: int | None = None) -> dict:
         }
 
 
+def _reset_dev_wave_stats(sm) -> None:
+    """Zero every wave-forensics counter before a timed window — the
+    ONE list, shared by the memory configs and the device_waves arms
+    (a counter added in one place but not the other would report
+    stale counts from the previous arm)."""
+    sm.stat_dev_wave_batches = 0
+    sm.stat_dev_wave_declined = 0
+    sm.stat_dev_wave_steps = 0
+    sm.stat_dev_wave_events = 0
+    sm.stat_dev_wave_plan_s = 0.0
+    sm.stat_dev_wave_decline_reasons = {}
+    if sm.engine == "device":
+        sm._dev.stat_wave_sharded = 0
+        sm._dev.stat_wave_window_bytes_peak = 0
+        sm._dev.stat_wave_window_padded_peak = 0
+
+
 def _run_memory_config(name, gen) -> dict:
     n_events = N_SIMPLE if name == "simple" else N_OTHER
     setup, timed, sizing = gen(n_events)
@@ -922,11 +939,7 @@ def _run_memory_config(name, gen) -> dict:
     sm.stat_wave_steps = 0
     sm.stat_wave_events = 0
     sm.stat_wave_parallel_events = 0
-    sm.stat_dev_wave_batches = 0
-    sm.stat_dev_wave_declined = 0
-    sm.stat_dev_wave_steps = 0
-    sm.stat_dev_wave_events = 0
-    sm.stat_dev_wave_plan_s = 0.0
+    _reset_dev_wave_stats(sm)
     if sm.engine == "device":
         sm._dev.stat_semantic_events = 0
     failed = 0
@@ -993,6 +1006,8 @@ def _run_memory_config(name, gen) -> dict:
         out["device_waves"] = {
             "batches": sm.stat_dev_wave_batches,
             "declined": sm.stat_dev_wave_declined,
+            "declined_by_reason": dict(sm.stat_dev_wave_decline_reasons),
+            "sharded": sm._dev.stat_wave_sharded,
             "steps_per_batch": round(
                 sm.stat_dev_wave_steps
                 / max(1, sm.stat_dev_wave_batches),
@@ -1000,6 +1015,10 @@ def _run_memory_config(name, gen) -> dict:
             ),
             "events": sm.stat_dev_wave_events,
             "plan_ms_total": round(1e3 * sm.stat_dev_wave_plan_s, 2),
+            "pending_window_bytes": sm._dev.stat_wave_window_bytes_peak,
+            "pending_window_bytes_padded": (
+                sm._dev.stat_wave_window_padded_peak
+            ),
         }
     # Link-robustness forensics (device_engine degraded-mode
     # lifecycle): retries, demotions/re-promotions, events served by
@@ -1186,9 +1205,10 @@ def gen_offkernel(n_events: int):
       the plan is one position-stepped chain segment).
     """
     rng = np.random.default_rng(46)
-    n_acct = 1_001  # odd: a device-divisible capacity would shard the
-    # engine on virtual meshes, and wave dispatch declines sharded
-    # engines (single-chip scope this round)
+    n_acct = 1_001  # odd: keeps the engine UNSHARDED on virtual
+    # meshes, so the single-chip configuration really grades the
+    # single-chip executors (the sharded configuration rounds the
+    # capacity up to a device multiple itself)
     bal0 = 801
     n_bal = 200
     setup = [(Operation.create_accounts, accounts_bytes(range(1, n_acct)))]
@@ -1267,18 +1287,17 @@ def gen_offkernel(n_events: int):
     return setup, timed, (n_acct + 1, (tid - TID0) + 4 * BATCH + 1024)
 
 
-def run_device_waves_compare() -> dict:
-    """Wave dispatch vs host drain for the device engine's off-kernel
-    batches: the SAME off-kernel stream runs same-session through the
-    device-authoritative engine with TB_DEV_WAVES=1 (wave plans
-    execute inside the window against the HBM table) and
-    TB_DEV_WAVES=0 (the r7 behavior: drain + exact host path per
-    batch).  Replies must be bit-identical (graded under `parity`);
-    `speedup` is the wave arm's throughput over the drain arm's on
-    this hour's backend, and `steps_per_batch` the collapse the
-    partitioner achieved (a two_phase-pair batch is ~3 steps, a chain
-    batch ~max_chain_len — vs one semantic drain per batch)."""
-    n = int(os.environ.get("BENCH_DEV_WAVES_N", 16_380 if SMALL else 65_520))
+def _run_device_waves_arms(n: int, sharded: bool) -> dict:
+    """The wave-vs-drain comparison body shared by the single-chip and
+    sharded device_waves configurations: the SAME off-kernel stream
+    runs TB_DEV_WAVES=1 (wave plans execute inside the window against
+    the HBM table) and TB_DEV_WAVES=0 (drain + exact host path per
+    batch); replies must be bit-identical.  `sharded=True` rounds the
+    account capacity up to a device multiple so the engine row-shards
+    its tables and the wave plans execute SPMD over the ("shard",)
+    mesh — and asserts the engine really sharded."""
+    import jax
+
     out = {"events": n}
     saved = os.environ.get("TB_DEV_WAVES")
     try:
@@ -1286,6 +1305,15 @@ def run_device_waves_compare() -> dict:
         for mode, env_val in (("wave", "1"), ("drain", "0")):
             os.environ["TB_DEV_WAVES"] = env_val
             setup, timed, sizing = gen_offkernel(n)
+            account_capacity = sizing[0]
+            if sharded:
+                nd = len(jax.devices())
+                if nd < 2:
+                    return {
+                        "error": "single-device backend: launcher "
+                        "should have forced a host-platform mesh"
+                    }
+                account_capacity = -(-account_capacity // nd) * nd
             # NOT _make_tpu: this comparison is device-engine BY
             # DESIGN (a TB_ENGINE=host override — including the CPU
             # re-exec fallback's — would grade a meaningless
@@ -1294,18 +1322,21 @@ def run_device_waves_compare() -> dict:
             from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
 
             sm = TpuStateMachine(
-                account_capacity=sizing[0], transfer_capacity=sizing[1],
+                account_capacity=account_capacity,
+                transfer_capacity=sizing[1],
                 engine="device",
                 prewarm="waves" if mode == "wave" else None,
             )
-            if sm._dev.sharding is not None:
-                return {"error": "sharded engine: wave dispatch out of scope"}
+            if sharded:
+                assert sm._dev.sharding is not None, "engine did not shard"
+                out["n_devices"] = len(jax.devices())
+            elif sm._dev.sharding is not None:
+                return {
+                    "error": "engine sharded under the single-chip "
+                    "configuration (capacity should be odd)"
+                }
             _, _, h = replay(sm, setup)
-            sm.stat_dev_wave_batches = 0
-            sm.stat_dev_wave_declined = 0
-            sm.stat_dev_wave_steps = 0
-            sm.stat_dev_wave_events = 0
-            sm.stat_dev_wave_plan_s = 0.0
+            _reset_dev_wave_stats(sm)
             sm.stat_host_semantic_events = 0
             t0 = time.perf_counter()
             futs = [(op, h.submit_async(op, body)) for op, body in timed]
@@ -1318,10 +1349,18 @@ def run_device_waves_compare() -> dict:
                 "replies": replies,
                 "wave_batches": sm.stat_dev_wave_batches,
                 "declined": sm.stat_dev_wave_declined,
+                "declined_by_reason": dict(
+                    sm.stat_dev_wave_decline_reasons
+                ),
                 "steps": sm.stat_dev_wave_steps,
                 "events": sm.stat_dev_wave_events,
                 "plan_s": sm.stat_dev_wave_plan_s,
                 "host_events": sm.stat_host_semantic_events,
+                "sharded_batches": sm._dev.stat_wave_sharded,
+                "window_bytes": sm._dev.stat_wave_window_bytes_peak,
+                "window_bytes_padded": (
+                    sm._dev.stat_wave_window_padded_peak
+                ),
             }
             del sm, h
         parity = "ok"
@@ -1342,21 +1381,97 @@ def run_device_waves_compare() -> dict:
                 "parity": parity,
                 "wave_batches": w["wave_batches"],
                 "wave_declined": w["declined"],
+                "declined_by_reason": w["declined_by_reason"],
                 "steps_per_batch": round(
                     w["steps"] / max(1, w["wave_batches"]), 2
                 ),
                 "plan_ms_total": round(1e3 * w["plan_s"], 2),
                 "wave_host_drained_events": w["host_events"],
+                "sharded_batches": w["sharded_batches"],
+                "pending_window_bytes": w["window_bytes"],
+                "pending_window_bytes_padded": w["window_bytes_padded"],
+                "pending_window_reduction": round(
+                    w["window_bytes_padded"] / max(1, w["window_bytes"]),
+                    1,
+                ),
             }
         )
         if w["wave_batches"] == 0:
             out["error"] = "wave dispatch never engaged"
+        elif sharded and w["sharded_batches"] != w["wave_batches"]:
+            out["error"] = "wave batches did not all execute SPMD"
     finally:
         if saved is None:
             os.environ.pop("TB_DEV_WAVES", None)
         else:
             os.environ["TB_DEV_WAVES"] = saved
     return out
+
+
+def run_device_waves_compare() -> dict:
+    """Wave dispatch vs host drain for the device engine's off-kernel
+    batches, single-chip AND row-sharded configurations.  `speedup` is
+    the wave arm's throughput over the drain arm's on this hour's
+    backend, `steps_per_batch` the collapse the partitioner achieved
+    (a two_phase-pair batch is ~3 steps, a chain batch ~max_chain_len
+    — vs one semantic drain per batch), and the `sharded` sub-record
+    runs the same comparison with the engine's tables row-sharded
+    (real multi-device backend when available, else a forced
+    host-platform mesh in a subprocess — honestly marked)."""
+    n = int(os.environ.get("BENCH_DEV_WAVES_N", 16_380 if SMALL else 65_520))
+    out = _run_device_waves_arms(n, sharded=False)
+    out["sharded"] = _run_device_waves_sharded()
+    return out
+
+
+def _run_device_waves_sharded() -> dict:
+    """The sharded device_waves configuration: inline when this
+    backend already exposes >= 2 devices (a real multi-chip link),
+    else in a subprocess with a forced 4-device host-platform CPU mesh
+    — the NamedSharding/shard_map code path is identical; only the
+    interconnect is fake, and `forced_host_platform` says so."""
+    import subprocess
+
+    import jax
+
+    n = int(os.environ.get("BENCH_DEV_WAVES_SHARDED_N", 16_380))
+    if len(jax.devices()) >= 2:
+        return _run_device_waves_arms(n, sharded=True)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TB_FORCE_CPU_JAX"] = "1"
+    # The child deliberately runs the forced CPU mesh: skip its
+    # accelerator probe/re-exec (forced_host_platform marks the row).
+    env["TB_BENCH_DEVICE_CHECKED"] = "cpu"
+    env.setdefault("TB_DEV_B", "512")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--device-waves-sharded-only"],
+            env=env, capture_output=True, text=True,
+            timeout=float(os.environ.get("BENCH_CONFIG_TIMEOUT_S", 3600)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "sharded subprocess timed out"}
+    if proc.returncode != 0:
+        return {
+            "error": f"sharded subprocess rc={proc.returncode}",
+            "tail": (proc.stderr or "")[-1000:],
+        }
+    try:
+        got = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as exc:
+        return {
+            "error": f"unparseable sharded output: {exc}",
+            "tail": (proc.stdout or "")[-500:]
+            + (proc.stderr or "")[-500:],
+        }
+    got["forced_host_platform"] = True
+    return got
 
 
 def run_memory_only(name: str) -> dict:
@@ -1538,6 +1653,13 @@ def main() -> None:
                 out["parity"] = False
     if PARITY and isinstance(device_waves_out, dict):
         if device_waves_out.get("parity", "ok") != "ok":
+            parity_ok = False
+            out["parity"] = False
+        sharded_row = device_waves_out.get("sharded")
+        if (
+            isinstance(sharded_row, dict)
+            and sharded_row.get("parity", "ok") != "ok"
+        ):
             parity_ok = False
             out["parity"] = False
     try:
@@ -1759,6 +1881,11 @@ if __name__ == "__main__":
         print(json.dumps(_mark_device_fallback(run_waves_compare())))
     elif "--device-waves-only" in sys.argv:
         print(json.dumps(_mark_device_fallback(run_device_waves_compare())))
+    elif "--device-waves-sharded-only" in sys.argv:
+        # Internal: the sharded configuration's forced-host-platform
+        # subprocess entry (the parent stamps forced_host_platform).
+        n = int(os.environ.get("BENCH_DEV_WAVES_SHARDED_N", 16_380))
+        print(json.dumps(_run_device_waves_arms(n, sharded=True)))
     elif "--durable-only" in sys.argv:
         print(json.dumps(_mark_device_fallback(run_durable(N_OTHER))))
     elif "--replicated-only" in sys.argv:

@@ -739,3 +739,127 @@ def test_tight_and_wide_inputs_agree(monkeypatch):
     replies_wide, sm_w = run()
     assert sm_w.stat_device_semantic_events > 0
     assert replies_tight == replies_wide
+
+
+# ---------------------------------------------------------------------------
+# Link-error taxonomy: classification is MEASURED against the
+# declarative marker table, not guessed (ROADMAP "Real-link error
+# taxonomy") — a new marker harvested from a real tunnel flake is one
+# table row plus one parametrized case here.
+
+
+def _pjrt_style_message(marker: str) -> str:
+    """A message shaped like what JAX/PJRT actually surfaces: gRPC
+    status name + detail, wrapped in the XlaRuntimeError prefix."""
+    return (
+        f"jaxlib.xla_extension.XlaRuntimeError: {marker}: stream "
+        "executor failure while transferring buffer d2h (axon tunnel)"
+    )
+
+
+from tigerbeetle_tpu.state_machine.device_engine import LINK_ERROR_MARKERS
+
+
+@pytest.mark.parametrize("marker,expected", list(LINK_ERROR_MARKERS))
+def test_link_error_marker_classification(marker, expected):
+    from tigerbeetle_tpu.state_machine import device_engine as de
+
+    exc = RuntimeError(_pjrt_style_message(marker))
+    assert de.classify_link_error(exc) == expected
+
+
+def test_link_error_first_match_wins_and_default_fatal():
+    from tigerbeetle_tpu.state_machine import device_engine as de
+
+    # Typed exceptions bypass the table entirely.
+    assert de.classify_link_error(de.TransientLinkError("x")) == "transient"
+    assert de.classify_link_error(de.FatalLinkError("x")) == "fatal"
+    # Unknown messages default to fatal (demote, never spin retrying).
+    assert de.classify_link_error(RuntimeError("segfault in plugin")) == "fatal"
+    # Declaration order arbitrates multi-marker messages: UNAVAILABLE
+    # precedes INTERNAL in the table, so the transient row wins.
+    both = RuntimeError(_pjrt_style_message("UNAVAILABLE") + " INTERNAL")
+    assert de.classify_link_error(both) == "transient"
+
+
+def test_link_error_taxonomy_is_declarative():
+    """The table stays the single source of truth: every row
+    classifies one way, and both classes are represented (a taxonomy
+    with one class is a boolean, not a taxonomy)."""
+    from tigerbeetle_tpu.state_machine import device_engine as de
+
+    kinds = {kind for _m, kind in de.LINK_ERROR_MARKERS}
+    assert kinds == {"transient", "fatal"}
+    markers = [m for m, _k in de.LINK_ERROR_MARKERS]
+    assert len(markers) == len(set(markers)), "duplicate marker rows"
+
+
+# ---------------------------------------------------------------------------
+# Healthy-mode scrub jitter: a deterministic per-engine offset keeps
+# TB_DEV_SCRUB_EVERY scrubs off the same fetch ordinal across engines
+# (each scrub costs a ~105 ms checksum fetch on the real link).
+
+
+def test_scrub_offset_deterministic_and_bounded(monkeypatch):
+    import tigerbeetle_tpu.state_machine.device_engine as de
+    from tigerbeetle_tpu.state_machine.mirror import BalanceMirror
+
+    monkeypatch.setattr(de, "_SCRUB_EVERY", 256)
+    monkeypatch.setattr(de, "_SCRUB_JITTER", -1)  # auto: every // 8
+
+    def offset(seed):
+        eng = de.DeviceEngine(64, BalanceMirror(64), seed=seed)
+        return eng._scrub_offset
+
+    a1, a2 = offset(7), offset(7)
+    assert a1 == a2, "same seed must give the same offset"
+    cap = de._scrub_jitter_cap(256, -1)
+    assert cap == 32
+    offsets = {offset(s) for s in range(40)}
+    assert all(0 <= o <= cap for o in offsets)
+    assert len(offsets) > 1, "offsets never vary: jitter is vacuous"
+    # Default seeds mix in a per-process construction ordinal: a fleet
+    # of SAME-capacity engines must not scrub in lockstep.
+    defaults = {
+        de.DeviceEngine(64, BalanceMirror(64))._scrub_offset
+        for _ in range(8)
+    }
+    assert len(defaults) > 1, "same-capacity engines share one offset"
+
+
+def test_scrub_jitter_shifts_first_scrub(monkeypatch):
+    """The first scrub fires TB_DEV_SCRUB_EVERY - offset fetches in
+    (phase-shifted), subsequent scrubs keep the full cadence."""
+    import tigerbeetle_tpu.state_machine.device_engine as de
+    from tigerbeetle_tpu.state_machine.mirror import BalanceMirror
+
+    monkeypatch.setattr(de, "_SCRUB_EVERY", 16)
+    monkeypatch.setattr(de, "_SCRUB_JITTER", 5)
+    eng = de.DeviceEngine(64, BalanceMirror(64), seed=3)
+    off = eng._scrub_offset
+    assert 0 <= off <= 5
+    scrubbed = []
+    real_scrub = eng.scrub
+
+    def counting_scrub():
+        scrubbed.append(eng.stat_fetches)
+        return real_scrub()
+
+    eng.scrub = counting_scrub
+    for fetch in range(1, 64):
+        eng.stat_fetches = fetch
+        eng.tick()
+    assert scrubbed, "scrub never fired"
+    assert scrubbed[0] == 16 - off
+    if len(scrubbed) > 1:
+        assert scrubbed[1] - scrubbed[0] == 16
+
+
+def test_scrub_jitter_disabled_when_zero(monkeypatch):
+    import tigerbeetle_tpu.state_machine.device_engine as de
+    from tigerbeetle_tpu.state_machine.mirror import BalanceMirror
+
+    monkeypatch.setattr(de, "_SCRUB_EVERY", 256)
+    monkeypatch.setattr(de, "_SCRUB_JITTER", 0)
+    eng = de.DeviceEngine(64, BalanceMirror(64), seed=12345)
+    assert eng._scrub_offset == 0

@@ -153,14 +153,29 @@ def accounts(ids, flags=0):
 
 def mk_pair(**tpu_kw):
     # Odd capacity: the test mesh exposes 8 virtual CPU devices, and a
-    # device-divisible capacity would shard the engine — wave dispatch
-    # (single-chip scope this round) declines sharded engines.
+    # device-divisible capacity would shard the engine — these tests
+    # pin the SINGLE-CHIP executors (the sharded tests below use
+    # mk_pair_sharded, whose capacity divides the mesh).
     sm_d = TpuStateMachine(
         engine="device",
         account_capacity=tpu_kw.pop("account_capacity", (1 << 12) + 1),
         **tpu_kw,
     )
     assert sm_d._dev.sharding is None
+    return hz.SingleNodeHarness(sm_d), hz.SingleNodeHarness(CpuStateMachine())
+
+
+def mk_pair_sharded(**tpu_kw):
+    # Device-divisible capacity on the 8-device test mesh: the engine
+    # row-shards its tables and wave plans execute SPMD (shard_map
+    # over the ("shard",) mesh).
+    sm_d = TpuStateMachine(
+        engine="device",
+        account_capacity=tpu_kw.pop("account_capacity", 1 << 12),
+        **tpu_kw,
+    )
+    assert sm_d._dev.sharding is not None
+    assert sm_d._dev.wave_mesh() is not None
     return hz.SingleNodeHarness(sm_d), hz.SingleNodeHarness(CpuStateMachine())
 
 
@@ -536,3 +551,321 @@ def test_chaos_smoke_with_waves_on(monkeypatch):
     assert dev.try_repromote()
     assert dev.state is EngineState.healthy
     sm_d.verify_device_mirror()
+
+
+# ---------------------------------------------------------------------------
+# SPMD wave dispatch on the row-sharded engine (the conftest mesh
+# exposes 8 virtual CPU devices; a device-divisible capacity shards
+# the engine's tables with NamedSharding over a ("shard",) mesh and
+# the wave plans execute through waves._execute_plan_sharded).
+
+
+def test_sharded_two_phase_stream_waves_in_window(monkeypatch):
+    """Acceptance: the off-kernel pending/finalize stream executes
+    INSIDE the window of a ROW-SHARDED engine — no decline, every plan
+    SPMD over the mesh, replies oracle-identical — and the pending
+    wave records hold compact columns, >= 10x smaller than the padded
+    event dicts they replace."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    rng = np.random.default_rng(7)
+    h_d, h_c = mk_pair_sharded()
+    setup = (Operation.create_accounts, accounts(range(1, 47)))
+    ops = [setup]
+    accs = np.arange(1, 41)
+    tid = 100
+    for _ in range(6):
+        rows, tid = _pv_balancing_batch(
+            tid, accs, rng, bal_accs=list(range(41, 47))
+        )
+        ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 47)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    assert sm.stat_dev_wave_batches == 6, "sharded engine declined waves"
+    assert sm.stat_dev_wave_declined == 0, (
+        sm.stat_dev_wave_decline_reasons
+    )
+    assert sm.stat_host_semantic_events == 0, "batch drained to the host"
+    assert sm._dev.stat_wave_sharded >= 6, "plans did not execute SPMD"
+    assert sm.stat_dev_wave_steps <= 2 * sm.stat_dev_wave_batches
+    assert sm._dev.stat_wave_window_bytes_peak > 0
+    reduction = (
+        sm._dev.stat_wave_window_padded_peak
+        / sm._dev.stat_wave_window_bytes_peak
+    )
+    assert reduction >= 10, (
+        f"pending wave records only {reduction:.1f}x smaller than the "
+        "padded event dicts"
+    )
+    sm.verify_device_mirror()
+
+
+def test_sharded_chain_batch_waves_in_window(monkeypatch):
+    """The chain-wave scan (one lax.scan over chain position) also
+    runs SPMD: per-position sharded row updates, ~max_chain_len steps,
+    oracle-identical replies."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    h_d, h_c = mk_pair_sharded()
+    ops = [(Operation.create_accounts, accounts(range(1, 101)))]
+    tid = 100
+    for _b in range(3):
+        rows = []
+        for c in range(16):
+            for j in range(3):
+                f = int(TF.linked) if j < 2 else 0
+                if j == 0:
+                    f |= int(TF.pending)
+                rows.append(
+                    hz.transfer(
+                        tid, debit_account_id=1 + 2 * c,
+                        credit_account_id=2 + 2 * c,
+                        amount=3 + j, flags=f,
+                    )
+                )
+                tid += 1
+        ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 101)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    assert sm.stat_dev_wave_batches == 3
+    assert sm.stat_dev_wave_declined == 0
+    assert sm._dev.stat_wave_sharded >= 3
+    assert sm.stat_dev_wave_steps == 3 * 8
+    sm.verify_device_mirror()
+
+
+def test_sharded_chain_rollback_in_window(monkeypatch):
+    """A failing chain member (debit == credit: static ladder) rolls
+    its whole chain back through the SPMD trailing-subtraction repair
+    while sibling chains apply — oracle-identical replies and mirror."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    h_d, h_c = mk_pair_sharded()
+    ops = [(Operation.create_accounts, accounts(range(1, 41)))]
+    rows = []
+    tid = 100
+    for c in range(8):
+        for j in range(3):
+            f = int(TF.linked) if j < 2 else 0
+            if j == 0:
+                f |= int(TF.pending)
+            dr, cr = 1 + 2 * c, 2 + 2 * c
+            if c == 3 and j == 1:
+                cr = dr  # accounts_must_be_different -> chain fails
+            rows.append(
+                hz.transfer(tid, debit_account_id=dr,
+                            credit_account_id=cr, amount=3 + j, flags=f)
+            )
+            tid += 1
+    ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 41)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    assert sm.stat_dev_wave_batches == 1, "chain batch did not wave"
+    assert sm._dev.stat_wave_sharded >= 1
+    sm.verify_device_mirror()
+
+
+def test_sharded_plan_with_scan_segment_declines(monkeypatch):
+    """Unsupported plan shapes DECLINE, never error: history-account
+    events force exact scan segments, which have no SPMD executor —
+    the sharded engine counts the decline by reason and drains to the
+    host, replies still oracle-identical."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    monkeypatch.setenv("TB_DEV_WAVES", "1")
+    rng = np.random.default_rng(13)
+    h_d, h_c = mk_pair_sharded()
+    ops = [
+        (
+            Operation.create_accounts,
+            hz.pack(
+                [hz.account(i) for i in range(1, 41)]
+                + [
+                    hz.account(41, flags=int(AF.history)),
+                    hz.account(42, flags=int(AF.history)),
+                ]
+            ),
+        )
+    ]
+    rows = []
+    tid = 100
+    for _ in range(20):
+        a, b = rng.choice(np.arange(1, 41), 2, replace=False)
+        rows.append(
+            hz.transfer(tid, debit_account_id=int(a),
+                        credit_account_id=int(b),
+                        amount=int(rng.integers(1, 40)),
+                        flags=int(TF.pending))  # off the orderfree route
+        )
+        tid += 1
+    rows.append(
+        hz.transfer(tid, debit_account_id=41, credit_account_id=42,
+                    amount=5, flags=int(TF.pending))
+    )
+    ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 43)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    assert sm.stat_dev_wave_batches == 0
+    assert sm.stat_dev_wave_decline_reasons.get("shard_plan", 0) >= 1, (
+        sm.stat_dev_wave_decline_reasons
+    )
+    assert sm.stat_host_semantic_events > 0, "decline must drain to host"
+    sm.verify_device_mirror()
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_sharded_waves_differential(monkeypatch, seed):
+    """Three arms over the SAME fuzz stream — sharded waves forced on,
+    sharded waves off (drain), unsharded waves forced on — must agree
+    byte-for-byte on every reply; the two sharded arms must also agree
+    on the authoritative table digest.  The SPMD executors are an
+    execution strategy, never a semantics change."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    replies = {}
+    tables = {}
+    arms = (
+        ("sharded_on", 1 << 10, "1"),
+        ("sharded_off", 1 << 10, "0"),
+        ("unsharded_on", (1 << 10) + 1, "1"),
+    )
+    for name, capacity, mode in arms:
+        monkeypatch.setenv("TB_DEV_WAVES", mode)
+        rng = np.random.default_rng(seed)
+        sm = TpuStateMachine(engine="device", account_capacity=capacity)
+        sharded = capacity % 8 == 0
+        assert (sm._dev.sharding is not None) == sharded
+        h = hz.SingleNodeHarness(sm)
+        ops = _fuzz_stream(rng)
+        futs = [h.submit_async(op, body) for op, body in ops]
+        replies[name] = [f.result() for f in futs]
+        sm.verify_device_mirror()
+        if sharded:
+            tables[name] = np.asarray(sm._dev.checksum())
+        if mode == "1":
+            assert sm.stat_dev_wave_batches > 0, f"{name}: never waved"
+            if sharded:
+                assert sm._dev.stat_wave_sharded > 0
+        else:
+            assert sm.stat_dev_wave_batches == 0
+        del sm, h
+    for arm in ("sharded_off", "unsharded_on"):
+        for i, (a, b) in enumerate(zip(replies["sharded_on"], replies[arm])):
+            assert a == b, (
+                f"seed {seed}: reply {i} diverges (sharded_on vs {arm})"
+            )
+    assert (tables["sharded_on"] == tables["sharded_off"]).all(), (
+        "authoritative table diverges between sharded wave-on and -off"
+    )
+
+
+def test_sharded_chaos_smoke_with_waves_on(monkeypatch):
+    """Link chaos on the ROW-SHARDED engine with wave dispatch forced
+    on: demote / degraded-serve / re-promote keep every reply
+    oracle-identical — sharded wave records replay through their exact
+    host fallback like any other in-flight record."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    monkeypatch.setattr(de, "_BACKOFF_MS", 0.0)
+    monkeypatch.setattr(de, "_PROBE_EVERY", 2)
+    monkeypatch.setenv("TB_DEV_WAVES", "1")
+    rng = np.random.default_rng(5)
+    link = ChaosLink(seed=23, p_transient=0.05, p_fatal=0.0, p_kill=0.0)
+    sm_d = TpuStateMachine(
+        engine="device", account_capacity=1 << 10, device_link=link
+    )
+    assert sm_d._dev.sharding is not None
+    h_d = hz.SingleNodeHarness(sm_d)
+    h_c = hz.SingleNodeHarness(CpuStateMachine())
+    ops = _fuzz_stream(rng, n_accts=40)
+    futs = []
+    for k, (op, body) in enumerate(ops):
+        if k in (len(ops) // 3, 2 * len(ops) // 3):
+            link.fail_next(kind="fatal")
+        futs.append(h_d.submit_async(op, body))
+    replies_d = [f.result() for f in futs]
+    for f in futs:
+        assert f.done()
+    replies_c = [h_c.submit(op, body) for op, body in ops]
+    mismatches = [
+        i for i, (a, b) in enumerate(zip(replies_d, replies_c)) if a != b
+    ]
+    assert not mismatches, f"replies diverge at {mismatches[:5]}"
+    dev = sm_d._dev
+    assert dev.stat_demotions >= 1, "chaos never demoted: weak smoke"
+    link.heal()
+    link.p_transient = link.p_fatal = link.p_kill = 0.0
+    assert dev.try_repromote()
+    assert dev.state is EngineState.healthy
+    sm_d.verify_device_mirror()
+
+
+# ---------------------------------------------------------------------------
+# Pending wave-record compaction (waves.pack_wave_record).
+
+
+def _random_event_dict(rng, n, B):
+    from tigerbeetle_tpu.state_machine import kernel
+
+    ev = {}
+    for name, dtype in kernel.EVENT_FIELDS:
+        dt = np.dtype(dtype)
+        if name == "i":
+            ev[name] = np.arange(B, dtype=dt)
+            continue
+        arr = np.zeros(B, dt)
+        style = rng.random()
+        if style < 0.25:
+            pass  # all-zero column
+        elif style < 0.45:
+            arr[:n] = np.asarray(7, dt)  # constant
+        elif dt.kind == "b":
+            arr[:n] = rng.random(n) < 0.3
+        elif dt.kind == "i":
+            arr[:n] = rng.integers(-1, 50, n)
+        else:
+            hi = int(rng.choice([40, 70_000, 1 << 40]))
+            arr[:n] = rng.integers(0, hi, n).astype(dt)
+        ev[name] = arr
+    return ev
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pending_wave_record_codec_roundtrip(seed):
+    """The columnar compaction is LOSSLESS for arbitrary event dicts:
+    unpack(pack(ev)) reproduces every column bit-for-bit, dtype and
+    padding included."""
+    rng = np.random.default_rng(400 + seed)
+    n = int(rng.integers(1, 200))
+    B = 256
+    ev = _random_event_dict(rng, n, B)
+    dstat = np.zeros(B, np.uint32)
+    dstat[: int(rng.integers(0, 5))] = 2
+    hist_fix = np.zeros(B, bool)
+    hist_fix[:n] = rng.random(n) < 0.8
+    pk = waves.pack_wave_record(ev, dstat, hist_fix, n)
+    ev2, dstat2, hist2 = waves.unpack_wave_record(pk)
+    assert set(ev2) == set(ev)
+    for name, arr in ev.items():
+        got = ev2[name]
+        assert got.dtype == arr.dtype, name
+        assert np.array_equal(got, arr), name
+    assert np.array_equal(dstat2, dstat) and dstat2.dtype == dstat.dtype
+    assert np.array_equal(hist2, hist_fix) and hist2.dtype == hist_fix.dtype
+    assert pk.nbytes < pk.padded_nbytes
+
+
+def test_pending_wave_record_nonzero_padding_is_lossless():
+    """A column with nonzero bytes PAST the batch length (not a shape
+    the router produces, but the codec must never corrupt) is stored
+    verbatim."""
+    from tigerbeetle_tpu.state_machine import kernel
+
+    rng = np.random.default_rng(9)
+    B = 64
+    ev = _random_event_dict(rng, 10, B)
+    ev["amount_lo"][B - 1] = 77  # poison the padding
+    pk = waves.pack_wave_record(ev, np.zeros(B, np.uint32),
+                                np.zeros(B, bool), 10)
+    ev2, _, _ = waves.unpack_wave_record(pk)
+    for name, arr in ev.items():
+        assert np.array_equal(ev2[name], arr), name
+    del kernel

@@ -96,3 +96,32 @@ def test_env_choice(monkeypatch):
     monkeypatch.setenv("TB_WAVES", "nope")
     with pytest.raises(envcheck.EnvVarError, match="expected one of"):
         envcheck.env_choice("TB_WAVES", "auto", ("auto", "0"))
+
+
+def test_scrub_jitter_constraint_named():
+    from tigerbeetle_tpu.state_machine.device_engine import (
+        _scrub_jitter_cap,
+        _validate_scrub_jitter,
+    )
+
+    with pytest.raises(envcheck.EnvVarError) as err:
+        _validate_scrub_jitter(256, 256)
+    message = str(err.value)
+    assert "TB_DEV_SCRUB_JITTER" in message
+    assert "TB_DEV_SCRUB_EVERY" in message
+    _validate_scrub_jitter(256, 255)  # boundary is legal
+    _validate_scrub_jitter(0, 1_000_000)  # scrub disabled: jitter moot
+    assert _scrub_jitter_cap(256, -1) == 32  # auto: an eighth
+    assert _scrub_jitter_cap(256, 5) == 5
+    assert _scrub_jitter_cap(0, -1) == 0
+
+
+def test_scrub_jitter_env_parses(monkeypatch):
+    monkeypatch.setenv("TB_DEV_SCRUB_JITTER", "sometimes")
+    with pytest.raises(envcheck.EnvVarError, match="TB_DEV_SCRUB_JITTER"):
+        envcheck.env_int("TB_DEV_SCRUB_JITTER", -1, minimum=-1)
+    monkeypatch.setenv("TB_DEV_SCRUB_JITTER", "-2")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= -1"):
+        envcheck.env_int("TB_DEV_SCRUB_JITTER", -1, minimum=-1)
+    monkeypatch.setenv("TB_DEV_SCRUB_JITTER", "17")
+    assert envcheck.env_int("TB_DEV_SCRUB_JITTER", -1, minimum=-1) == 17

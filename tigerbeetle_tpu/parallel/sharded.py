@@ -67,6 +67,55 @@ except ImportError:  # pragma: no cover
 COL_DP, COL_DPO, COL_CP, COL_CPO = range(4)
 
 
+def shard_map_kwargs() -> dict:
+    """Version-compat kwargs disabling shard_map's replication check
+    (its name moved check_rep -> check_vma across jax releases).  The
+    SPMD bodies here compute replicated outputs deterministically from
+    replicated inputs, which the checker cannot prove."""
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    if "check_vma" in params:
+        return {"check_vma": False}
+    if "check_rep" in params:
+        return {"check_rep": False}
+    return {}
+
+
+def make_row_mesh(devices=None) -> Mesh:
+    """1-D ("shard",) mesh over `devices` — the row-sharding axis the
+    device engine's authoritative tables (and the sharded wave
+    executors, waves.py) partition over."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), ("shard",))
+
+
+def own_rows(slots, local_rows: int, axis: str = "shard"):
+    """Row-ownership arithmetic INSIDE a shard_map body, the ONE
+    definition of the contiguous row layout: for global row indices
+    `slots`, returns (local, rel) — the mask of rows this shard owns
+    and their clipped shard-local indices.  Readers (gather_rows) and
+    writers (waves._ShardTableOps) both resolve ownership here, so
+    they can never disagree about the layout."""
+    row0 = (lax.axis_index(axis) * local_rows).astype(slots.dtype)
+    local = (slots >= row0) & (slots < row0 + local_rows)
+    rel = jnp.clip(slots - row0, 0, local_rows - 1)
+    return local, rel
+
+
+def gather_rows(local_table, slots, local_rows: int, axis: str = "shard"):
+    """Cross-shard row gather INSIDE a shard_map body: every device
+    gets the full (K, W) rows for global row indices `slots` (already
+    clipped to [0, total_rows)).  Each shard contributes the rows it
+    owns and zeros elsewhere; an all_gather + sum over `axis` (pure
+    data movement over ICI — u64 all-REDUCE doesn't lower on TPU)
+    recombines them exactly, since each row has exactly one owner."""
+    local, rel = own_rows(slots, local_rows, axis)
+    part = jnp.where(local[:, None], local_table[rel], 0)
+    return lax.all_gather(part, axis).sum(axis=0)
+
+
 def make_mesh(devices=None, dp: int | None = None) -> Mesh:
     """Mesh over `devices` shaped (dp, shard).
 
@@ -166,20 +215,12 @@ def build_apply_step(mesh: Mesh, table_rows: int):
         )
         return new_balances, admitted
 
-    import inspect
-
-    params = inspect.signature(shard_map).parameters
-    check_kw = (
-        {"check_vma": False} if "check_vma" in params
-        else {"check_rep": False} if "check_rep" in params
-        else {}
-    )
     step = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P("shard", None), P("dp"), P("dp"), P("dp"), P("dp"), P("dp")),
         out_specs=(P("shard", None), P("dp")),
-        **check_kw,
+        **shard_map_kwargs(),
     )
     return jax.jit(step, donate_argnums=(0,))
 

@@ -82,6 +82,38 @@ _BACKOFF_CAP_MS = envcheck.env_float(
 )
 _PROBE_EVERY = envcheck.env_int("TB_DEV_PROBE_EVERY", 8, minimum=1)
 _SCRUB_EVERY = envcheck.env_int("TB_DEV_SCRUB_EVERY", 256, minimum=0)
+# Maximum deterministic per-engine offset applied to the scrub cadence
+# so every engine's TB_DEV_SCRUB_EVERY-th fetch doesn't land on the
+# same ring rotation (each scrub costs a ~105 ms checksum fetch on the
+# tunneled link; ROADMAP "Scrub/probe cadence tuning").  -1 = auto
+# (an eighth of the cadence).
+_SCRUB_JITTER = envcheck.env_int("TB_DEV_SCRUB_JITTER", -1, minimum=-1)
+
+
+def _validate_scrub_jitter(every: int, jitter: int) -> None:
+    if every and jitter >= every:
+        raise envcheck.EnvVarError(
+            f"TB_DEV_SCRUB_JITTER={jitter} / TB_DEV_SCRUB_EVERY={every} "
+            "invalid: the jitter offset must stay below the scrub "
+            "cadence (TB_DEV_SCRUB_JITTER < TB_DEV_SCRUB_EVERY)"
+        )
+
+
+_validate_scrub_jitter(_SCRUB_EVERY, _SCRUB_JITTER)
+
+# Per-process engine construction ordinal: the default scrub-jitter
+# seed mixes it in so same-capacity engines sharing the link (the
+# normal fleet configuration) still derive DIFFERENT offsets —
+# deterministic for a fixed construction order, which is what replay
+# needs.
+_ENGINE_SEQ = 0
+
+
+def _scrub_jitter_cap(every: int, jitter: int) -> int:
+    """Effective jitter bound: the explicit knob, or auto = every//8."""
+    if jitter >= 0:
+        return jitter
+    return every // 8 if every else 0
 
 
 class LinkError(RuntimeError):
@@ -109,15 +141,28 @@ class DeviceLostError(RuntimeError):
         super().__init__(f"device lost at {stage}{detail}")
 
 
-# Substrings that mark a runtime error as transient on this link
-# (JAX/PJRT surface gRPC-style status names in their messages).
-_TRANSIENT_MARKERS = (
-    "RESOURCE_EXHAUSTED",
-    "UNAVAILABLE",
-    "DEADLINE_EXCEEDED",
-    "ABORTED",
-    "CANCELLED",
-    "temporarily",
+# Link-error taxonomy: message markers -> classification, FIRST MATCH
+# WINS in declaration order.  JAX/PJRT surface gRPC-style status names
+# in their messages; the transient rows are statuses a reissued
+# crossing can outlive (backpressure, tunnel flaps, deadline races),
+# the fatal rows are states no retry fixes (bad program, lost buffers,
+# corrupt device state).  The table is DECLARATIVE so future markers
+# harvested from real tunnel flakes are added as one measured row —
+# tests/test_device_engine.py asserts the classification of every
+# entry (ROADMAP "Real-link error taxonomy").
+LINK_ERROR_MARKERS = (
+    ("RESOURCE_EXHAUSTED", "transient"),
+    ("UNAVAILABLE", "transient"),
+    ("DEADLINE_EXCEEDED", "transient"),
+    ("ABORTED", "transient"),
+    ("CANCELLED", "transient"),
+    ("temporarily", "transient"),
+    ("INVALID_ARGUMENT", "fatal"),
+    ("FAILED_PRECONDITION", "fatal"),
+    ("NOT_FOUND", "fatal"),
+    ("UNIMPLEMENTED", "fatal"),
+    ("INTERNAL", "fatal"),
+    ("DATA_LOSS", "fatal"),
 )
 
 
@@ -128,8 +173,9 @@ def classify_link_error(exc: BaseException) -> str:
     if isinstance(exc, (FatalLinkError, DeviceLostError)):
         return "fatal"
     msg = str(exc)
-    if any(marker in msg for marker in _TRANSIENT_MARKERS):
-        return "transient"
+    for marker, kind in LINK_ERROR_MARKERS:
+        if marker in msg:
+            return kind
     return "fatal"
 
 
@@ -231,7 +277,9 @@ class _InFlight:
         self.slots = slots      # lookup slots (for re-gather)
         self.rows = None        # lookup rows / wave outputs fetched at rotation
         self.meta_args = meta_args  # (slots, flags, ledger) for "meta"
-        self.wave_args = wave_args  # (ev, dstat_init, plan, hist_fix)
+        # (waves.PackedColumns, plan): the compact columnar record —
+        # NOT the (B,)-padded event dict — rebuilt at launch.
+        self.wave_args = wave_args
         # Host-integer bound on the balance additions this record can
         # still contribute (wave admission's in-flight term); released
         # when the record's bookkeeping lands on the mirror.
@@ -253,7 +301,8 @@ _SEMANTIC_KINDS = tuple(_KERNELS)
 class DeviceEngine:
     """Authoritative device tables + windowed semantic dispatch."""
 
-    def __init__(self, capacity: int, mirror, link: DeviceLink | None = None) -> None:
+    def __init__(self, capacity: int, mirror, link: DeviceLink | None = None,
+                 seed: int | None = None) -> None:
         self.capacity = capacity
         self.mirror = mirror  # host bookkeeping copy (recovery + parity)
         self.window = _WINDOW
@@ -267,7 +316,19 @@ class DeviceEngine:
         self.last_demotion: str | None = None
         self.last_probe_failure: str | None = None
         self._degraded_submits = 0
-        self._last_scrub_fetch = 0
+        # Healthy-mode scrub cadence, jittered by a deterministic
+        # per-engine offset (seeded) so a fleet of engines sharing the
+        # link doesn't scrub on the same fetch ordinal — and so the
+        # scrub's own ~105 ms fetch doesn't ride the identical ring
+        # rotation every cycle.  The offset only ADVANCES the first
+        # scrub; the steady-state period stays TB_DEV_SCRUB_EVERY.
+        global _ENGINE_SEQ
+        _ENGINE_SEQ += 1
+        if seed is None:
+            seed = capacity + 0x85EBCA6B * _ENGINE_SEQ
+        cap = _scrub_jitter_cap(_SCRUB_EVERY, _SCRUB_JITTER)
+        self._scrub_offset = (seed * 0x9E3779B9) % (cap + 1) if cap else 0
+        self._last_scrub_fetch = -self._scrub_offset
         self._closed = False
         # Initialized before the first _place below can retry.
         self.stat_retries = 0
@@ -281,11 +342,14 @@ class DeviceEngine:
         self.sharding = None
         devices = jax.devices()
         if len(devices) > 1 and capacity % len(devices) == 0:
-            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
-            mesh = Mesh(np.array(devices), ("shard",))
-            self.sharding = NamedSharding(mesh, P("shard", None))
+            from tigerbeetle_tpu.parallel.sharded import make_row_mesh
+
+            self.sharding = NamedSharding(
+                make_row_mesh(devices), P("shard", None)
+            )
         self._meta_host = np.zeros((capacity, 2), np.uint32)
         self.ring = jnp.zeros((_RING, dk.SUMMARY_WORDS), jnp.uint64)
         self._ring_at = 0
@@ -333,6 +397,13 @@ class DeviceEngine:
         self.stat_degraded_events = 0
         self.stat_scrubs = 0
         self.stat_scrub_heals = 0
+        # Wave-record memory + sharded-execution forensics: peak bytes
+        # of compact pending wave records vs what the old padded event
+        # dicts would have held, and wave records executed SPMD over
+        # the row mesh (bench device_waves reports all three).
+        self.stat_wave_window_bytes_peak = 0
+        self.stat_wave_window_padded_peak = 0
+        self.stat_wave_sharded = 0
         # Wall-time split (seconds) for perf forensics.
         self.stat_t_h2d = 0.0
         self.stat_t_dispatch = 0.0
@@ -408,10 +479,18 @@ class DeviceEngine:
             from tigerbeetle_tpu.state_machine import waves as _waves
 
             _waves.prewarm(self.capacity)
-            # The window launch dispatches the NON-DONATING twins
-            # (separate XLA executables) — warm those too so wave
-            # dispatch never first-compiles inside a timed window.
-            _waves.prewarm(self.capacity, engine=True)
+            mesh = self.wave_mesh()
+            if mesh is not None:
+                # Row-sharded engine: the window launch dispatches the
+                # SPMD executors — warm those against this mesh so
+                # sharded wave dispatch never first-compiles inside a
+                # timed window.
+                _waves.prewarm(self.capacity, mesh=mesh)
+            else:
+                # The window launch dispatches the NON-DONATING twins
+                # (separate XLA executables) — warm those too so wave
+                # dispatch never first-compiles inside a timed window.
+                _waves.prewarm(self.capacity, engine=True)
         kinds = [k for k in kinds if k in _KERNELS]
         if not kinds:
             return
@@ -566,15 +645,59 @@ class DeviceEngine:
         The caller PROVED admission against mirror + the engine's
         in-flight bound, so the plan is never wrong — a wave record
         has no failure flag and never triggers exact recovery itself.
+
+        The record does NOT retain the (B,)-padded dict: it stores the
+        lossless columnar compaction (waves.pack_wave_record) and
+        rebuilds the padded arrays at launch — a full pending window
+        of wave records holds compact columns, not ~3 MB per batch
+        (pending_window_bytes / ROADMAP "Wave-dispatch batch memory").
         """
-        return self._submit_record(
+        from tigerbeetle_tpu.state_machine import waves as _waves
+
+        packed = _waves.pack_wave_record(ev, dstat_init, hist_fix, n)
+        fut = self._submit_record(
             n, fallback,
-            lambda fut: _InFlight(
-                "waves", fut, finish, n=n, ts_base=ts_base,
+            lambda f: _InFlight(
+                "waves", f, finish, n=n, ts_base=ts_base,
                 fallback=fallback, id_keys=id_keys, bound=bound,
-                wave_args=(ev, dstat_init, plan, hist_fix),
+                wave_args=(packed, plan),
             ),
         )
+        compact, padded = self.pending_window_bytes()
+        self.stat_wave_window_bytes_peak = max(
+            self.stat_wave_window_bytes_peak, compact
+        )
+        self.stat_wave_window_padded_peak = max(
+            self.stat_wave_window_padded_peak, padded
+        )
+        return fut
+
+    def pending_window_bytes(self) -> tuple:
+        """(compact, padded) host bytes retained by queued/in-flight
+        wave records — what the window actually holds vs what the old
+        padded event dicts would have held."""
+        compact = padded = 0
+        for rec in self._pending + self._launched + self._recovering:
+            if rec.kind == "waves" and rec.wave_args is not None:
+                pk = rec.wave_args[0]
+                compact += pk.nbytes
+                padded += pk.padded_nbytes
+        return compact, padded
+
+    def wave_mesh(self):
+        """Capability probe for SPMD wave dispatch: the row mesh when
+        this engine's sharded tables support it — a 1-D ("shard",)
+        mesh whose shard count divides the capacity — else None.  An
+        unsupported mesh makes the router DECLINE wave submission
+        (drain + host path, the r7 behavior), never error."""
+        if self.sharding is None:
+            return None
+        mesh = self.sharding.mesh
+        if tuple(mesh.axis_names) != ("shard",):
+            return None
+        if self.capacity % mesh.devices.size != 0:
+            return None
+        return mesh
 
     def _submit_record(self, n, fallback, make_rec) -> ReplyFuture:
         """The ONE stream-entry protocol for semantic and wave batches:
@@ -830,18 +953,28 @@ class DeviceEngine:
         (waves.run_plan_engine), so a transient fault mid-plan retries
         the entire batch idempotently from the same `self.balances`.
         The packed per-event output handle is fetched at rotation like
-        a lookup gather."""
+        a lookup gather.  On a row-sharded engine the plan runs SPMD
+        over the ("shard",) mesh (the router only admitted shardable
+        plans there), and the new table comes back under the same
+        NamedSharding row partition."""
         from tigerbeetle_tpu.state_machine import waves as _waves
 
-        ev, dstat_init, plan, hist_fix = rec.wave_args
+        packed_rec, plan = rec.wave_args
+        ev, dstat_init, hist_fix = _waves.unpack_wave_record(packed_rec)
+        mesh = self.wave_mesh()
 
         def run():
             return self.link.dispatch(
                 _waves.run_plan_engine, self.balances, ev, dstat_init,
-                rec.n, rec.ts_base, plan, hist_fix,
+                rec.n, rec.ts_base, plan, hist_fix, mesh,
             )
 
         new_balances, packed = self._retry(run, "dispatch")
+        # Counted only AFTER the dispatch succeeded: a fatally-failed
+        # SPMD launch that ends up served by host fallback must not
+        # report as sharded execution in the forensics.
+        if mesh is not None:
+            self.stat_wave_sharded += 1
         self.balances = new_balances
         rec.handle = packed
 

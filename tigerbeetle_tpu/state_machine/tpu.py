@@ -462,6 +462,11 @@ class TpuStateMachine:
         self.stat_dev_wave_steps = 0
         self.stat_dev_wave_events = 0
         self.stat_dev_wave_plan_s = 0.0
+        # Declines by reason ("plan" = admission/profitability, "mesh"
+        # = unsupported sharding geometry, "shard_plan" = plan shape
+        # the SPMD executors don't cover, "degraded" = engine lost the
+        # link mid-probe): measured, not guessed — bench reports it.
+        self.stat_dev_wave_decline_reasons: dict = {}
 
     @property
     def stat_device_semantic_events(self) -> int:
@@ -1248,6 +1253,11 @@ class TpuStateMachine:
 
         return run
 
+    def _dev_wave_decline(self, reason: str) -> None:
+        self.stat_dev_wave_declined += 1
+        reasons = self.stat_dev_wave_decline_reasons
+        reasons[reason] = reasons.get(reason, 0) + 1
+
     def _try_submit_device_waves(
         self, events, n, timestamp, input_bytes
     ):
@@ -1258,10 +1268,18 @@ class TpuStateMachine:
         table at window launch, exact-path bookkeeping from the
         fetched packed outputs at materialization.  Returns
         (reply_future, None), or (None, decoded) on decline
-        (admission, profitability, TB_DEV_WAVES=0, degraded/sharded
-        engine, oversize batch) — the caller drains to the host
+        (admission, profitability, TB_DEV_WAVES=0, degraded engine,
+        unsupported sharding geometry, plan shapes the SPMD executors
+        don't cover, oversize batch) — the caller drains to the host
         exactly as before, reusing the decode dict: the plan is never
         wrong, only occasionally slower.
+
+        ROW-SHARDED engines submit too: the plan executes SPMD over
+        the engine's ("shard",) mesh (waves._execute_plan_sharded) as
+        long as the capability probe (DeviceEngine.wave_mesh) accepts
+        the mesh and the plan carries only wave/chain segments
+        (waves.plan_shardable) — anything else declines gracefully,
+        counted by reason, never errors.
 
         Soundness of planning against a LAGGING mirror: the hazard
         probe drains on any id/pending-reference overlap with
@@ -1274,10 +1292,11 @@ class TpuStateMachine:
         dm = waves.dev_mode()
         if dm == "0" or n == 0 or n > _BATCH_BUCKETS[-1]:
             return None, None
-        if (
-            dev.state is not types.EngineState.healthy
-            or dev.sharding is not None
-        ):
+        if dev.state is not types.EngineState.healthy:
+            return None, None
+        sharded = dev.sharding is not None
+        if sharded and dev.wave_mesh() is None:
+            self._dev_wave_decline("mesh")
             return None, None
         t0 = _time.perf_counter()
         d = self._decode_static(events, n)
@@ -1296,6 +1315,7 @@ class TpuStateMachine:
         if dev.inflight_ids_hit(probe):
             self._engine_drain()
             if dev.state is not types.EngineState.healthy:
+                self._dev_wave_decline("degraded")
                 return None, d
 
         e_found, e_row = self._tdir.lookup(d["id_lo"], d["id_hi"])
@@ -1320,7 +1340,13 @@ class TpuStateMachine:
         )
         self.stat_dev_wave_plan_s += _time.perf_counter() - t0
         if plan is None:
-            self.stat_dev_wave_declined += 1
+            self._dev_wave_decline("plan")
+            return None, d
+        if sharded and not waves.plan_shardable(plan):
+            # The plan needs a scan segment (history accounts, serial
+            # conflict regions) — no SPMD executor covers those, so
+            # the sharded engine declines to the drained host path.
+            self._dev_wave_decline("shard_plan")
             return None, d
 
         ev = self._build_scan_events(

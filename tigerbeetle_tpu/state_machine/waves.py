@@ -784,6 +784,99 @@ def admission_ok(
 
 # ---------------------------------------------------------------------------
 # The wave step: the scan body over a (K,) event axis.
+#
+# Table access goes through a small ops seam so ONE step body serves
+# both executors: dense (single device owns the whole (A, 8) table)
+# and SPMD (each device owns a row slice of the NamedSharding-sharded
+# table inside shard_map — see _sharded_fns).  Everything else in the
+# step is event-axis work on replicated arrays, which every device
+# computes identically, so the sharded executor's outputs are
+# bit-identical to the dense one's by construction.
+
+
+def _apply_add_sub(table, adds, subs, localize=None):
+    """table + segment-summed adds - segment-summed subs, exact u128
+    per (row, column) — the ONE copy of the carry/borrow arithmetic
+    both table-ops share (the sharded executor's bit-identical
+    guarantee depends on it staying single-source).  Each spec is
+    (slots, cols, lo, hi, valid) with slots pre-clipped into the
+    GLOBAL row range; `localize` maps a spec onto this table's rows
+    (identity for the dense whole table)."""
+    if localize is None:
+        localize = lambda spec: spec  # noqa: E731
+    A = table.shape[0]
+    t_lo = table[:, 0::2]
+    t_hi = table[:, 1::2]
+    if adds is not None:
+        d_lo, d_hi = _accum_u128(*localize(adds), A)
+        n_lo = t_lo + d_lo
+        cy = (n_lo < t_lo).astype(jnp.uint64)
+        t_lo, t_hi = n_lo, t_hi + d_hi + cy
+    if subs is not None:
+        s_lo, s_hi = _accum_u128(*localize(subs), A)
+        n_lo = t_lo - s_lo
+        bw = (t_lo < s_lo).astype(jnp.uint64)
+        t_lo, t_hi = n_lo, t_hi - s_hi - bw
+    return jnp.stack(
+        [t_lo[:, 0], t_hi[:, 0], t_lo[:, 1], t_hi[:, 1],
+         t_lo[:, 2], t_hi[:, 2], t_lo[:, 3], t_hi[:, 3]],
+        axis=-1,
+    )
+
+
+class _DenseTableOps:
+    """Whole-table access: the single-device executor's row gathers
+    and u128 segment-sum applies (the pre-seam code verbatim)."""
+
+    @staticmethod
+    def nrows(table) -> int:
+        return table.shape[0]
+
+    @staticmethod
+    def rows(table, slots):
+        """(K,) pre-clipped global row indices -> (K, 8) rows."""
+        return table[slots]
+
+    @staticmethod
+    def apply(table, adds=None, subs=None):
+        return _apply_add_sub(table, adds, subs)
+
+
+class _ShardTableOps:
+    """Row-slice access inside a shard_map body over the 1-D ("shard",)
+    mesh: reads recombine each row from its single owner
+    (sharded.gather_rows — all_gather over ICI + exact sum), writes
+    scatter only onto locally-owned rows (no collective at all).  Both
+    resolve ownership through sharded.own_rows — the one definition of
+    the row layout — and reproduce the dense per-row arithmetic
+    exactly: a gathered row IS the owner's row, and a local segment
+    sum over the shard's slot range equals the dense sum restricted to
+    those rows."""
+
+    def __init__(self, total_rows: int, local_rows: int) -> None:
+        self.total_rows = total_rows
+        self.local_rows = local_rows
+
+    def nrows(self, table) -> int:
+        return self.total_rows
+
+    def rows(self, table, slots):
+        from tigerbeetle_tpu.parallel import sharded
+
+        return sharded.gather_rows(table, slots, self.local_rows)
+
+    def _localize(self, spec):
+        from tigerbeetle_tpu.parallel import sharded
+
+        slots, cols, lo, hi, valid = spec
+        local, rel = sharded.own_rows(slots, self.local_rows)
+        return rel, cols, lo, hi, valid & local
+
+    def apply(self, table, adds=None, subs=None):
+        return _apply_add_sub(table, adds, subs, localize=self._localize)
+
+
+_DENSE_OPS = _DenseTableOps()
 
 
 def _accum_u128(slots_c, cols, amt_lo, amt_hi, valid, A):
@@ -813,7 +906,7 @@ def _accum_u128(slots_c, cols, amt_lo, amt_hi, valid, A):
     return d_lo, d_hi
 
 
-def _wave_step_impl(carry, ev, n, ts_base):
+def _wave_step_impl(carry, ev, n, ts_base, ops=_DENSE_OPS):
     """Apply one wave — K mutually independent events — as a single
     vectorized step against the segment carry.
 
@@ -823,12 +916,16 @@ def _wave_step_impl(carry, ev, n, ts_base):
     guarantees every gather sees pre-wave state equal to its
     sequential value, and the admission precondition makes every ov_*
     term false, so results and records are bit-identical to the scan.
+
+    `ops` is the table-access seam: dense (whole table) by default,
+    shard-local inside the SPMD executor — the body itself never
+    indexes `carry["balances"]` directly.
     """
     table = carry["balances"]
     created = carry["created"]
     group_creator = carry["group_creator"]
     B = carry["results"].shape[0]
-    A = table.shape[0]
+    A = ops.nrows(table)
 
     i = ev["i"]  # (K,) global indices; padding lanes carry i == B
     active = i < n
@@ -849,8 +946,8 @@ def _wave_step_impl(carry, ev, n, ts_base):
     e = _merge(~e_inb, _gather_created(created, e_creator, B), ev, _E_FIELD_MAP)
 
     # ==================== normal create_transfer ====================
-    dr_row = table[jnp.clip(ev["dr_slot"], 0, A - 1)]
-    cr_row = table[jnp.clip(ev["cr_slot"], 0, A - 1)]
+    dr_row = ops.rows(table, jnp.clip(ev["dr_slot"], 0, A - 1))
+    cr_row = ops.rows(table, jnp.clip(ev["cr_slot"], 0, A - 1))
     dr_dp = (dr_row[:, DP_LO], dr_row[:, DP_HI])
     dr_dpo = (dr_row[:, DPO_LO], dr_row[:, DPO_HI])
     dr_cpo = (dr_row[:, CPO_LO], dr_row[:, CPO_HI])
@@ -1020,21 +1117,10 @@ def _wave_step_impl(carry, ev, n, ts_base):
     sub_hi = jnp.concatenate([p_amount[1], p_amount[1]])
     sub_valid = jnp.concatenate([pv_applied, pv_applied])
 
-    d_lo, d_hi = _accum_u128(add_slots, add_cols, add_lo, add_hi, add_valid, A)
-    s_lo, s_hi = _accum_u128(sub_slots, sub_cols, sub_lo, sub_hi, sub_valid, A)
-
-    old_lo = table[:, 0::2]
-    old_hi = table[:, 1::2]
-    t_lo = old_lo + d_lo
-    cy = (t_lo < old_lo).astype(jnp.uint64)
-    t_hi = old_hi + d_hi + cy
-    n_lo = t_lo - s_lo
-    bw = (t_lo < s_lo).astype(jnp.uint64)
-    n_hi = t_hi - s_hi - bw
-    table = jnp.stack(
-        [n_lo[:, 0], n_hi[:, 0], n_lo[:, 1], n_hi[:, 1],
-         n_lo[:, 2], n_hi[:, 2], n_lo[:, 3], n_hi[:, 3]],
-        axis=-1,
+    table = ops.apply(
+        table,
+        adds=(add_slots, add_cols, add_lo, add_hi, add_valid),
+        subs=(sub_slots, sub_cols, sub_lo, sub_hi, sub_valid),
     )
 
     # -- Per-event post-apply snapshots (pre-wave row + own deltas).
@@ -1042,8 +1128,8 @@ def _wave_step_impl(carry, ev, n, ts_base):
     # wave events' snapshots only feed the mirror and are rewritten
     # with batch finals at finalize (history-account events, whose
     # snapshots are semantically read, never ride waves).
-    o_dr = carry["balances"][safe_dr]
-    o_cr = carry["balances"][safe_cr]
+    o_dr = ops.rows(carry["balances"], safe_dr)
+    o_cr = ops.rows(carry["balances"], safe_cr)
     o_dr_dp = (o_dr[:, DP_LO], o_dr[:, DP_HI])
     o_dr_dpo = (o_dr[:, DPO_LO], o_dr[:, DPO_HI])
     o_cr_cp = (o_cr[:, CP_LO], o_cr[:, CP_HI])
@@ -1156,7 +1242,7 @@ _wave_step_keep = jax.jit(_wave_step_impl)
 # costs ~max_chain_len device steps instead of one per member.
 
 
-def _chain_wave_impl(carry, ev, n, ts_base):
+def _chain_wave_impl(carry, ev, n, ts_base, ops=_DENSE_OPS):
     """Execute one "chains" segment against the segment carry.
 
     `ev` is a dict of (P, C) stacked event arrays — position-major,
@@ -1178,7 +1264,7 @@ def _chain_wave_impl(carry, ev, n, ts_base):
     while created_mask/inb_status/group_creator registrations do not.
     """
     B = carry["results"].shape[0]
-    A = carry["balances"].shape[0]
+    A = ops.nrows(carry["balances"])
     C = ev["i"].shape[1]
 
     def step(state, ev_p):
@@ -1207,8 +1293,8 @@ def _chain_wave_impl(carry, ev, n, ts_base):
         }
         exists_rn = _exists_ladder_normal(ev_p, e)
 
-        dr_row = table[jnp.clip(ev_p["dr_slot"], 0, A - 1)]
-        cr_row = table[jnp.clip(ev_p["cr_slot"], 0, A - 1)]
+        dr_row = ops.rows(table, jnp.clip(ev_p["dr_slot"], 0, A - 1))
+        cr_row = ops.rows(table, jnp.clip(ev_p["cr_slot"], 0, A - 1))
         dr_dp = (dr_row[:, DP_LO], dr_row[:, DP_HI])
         dr_dpo = (dr_row[:, DPO_LO], dr_row[:, DPO_HI])
         dr_cpo = (dr_row[:, CPO_LO], dr_row[:, CPO_HI])
@@ -1295,16 +1381,8 @@ def _chain_wave_impl(carry, ev, n, ts_base):
         add_lo = jnp.concatenate([amount[0]] * 2)
         add_hi = jnp.concatenate([amount[1]] * 2)
         valid = jnp.concatenate([applied, applied])
-        d_lo, d_hi = _accum_u128(add_slots, add_cols, add_lo, add_hi, valid, A)
-        old_lo = table[:, 0::2]
-        old_hi = table[:, 1::2]
-        t_lo = old_lo + d_lo
-        cy = (t_lo < old_lo).astype(jnp.uint64)
-        t_hi = old_hi + d_hi + cy
-        new_table = jnp.stack(
-            [t_lo[:, 0], t_hi[:, 0], t_lo[:, 1], t_hi[:, 1],
-             t_lo[:, 2], t_hi[:, 2], t_lo[:, 3], t_hi[:, 3]],
-            axis=-1,
+        new_table = ops.apply(
+            table, adds=(add_slots, add_cols, add_lo, add_hi, valid)
         )
 
         # -- Snapshots (pre-row + own delta; rewritten to batch finals
@@ -1411,17 +1489,9 @@ def _chain_wave_impl(carry, ev, n, ts_base):
     sub_lo = jnp.concatenate([flat(ys_alo)] * 2)
     sub_hi = jnp.concatenate([flat(ys_ahi)] * 2)
     sub_valid = jnp.concatenate([flat(rb)] * 2)
-    s_lo, s_hi = _accum_u128(sub_slots, sub_cols, sub_lo, sub_hi, sub_valid, A)
-    table = carry["balances"]
-    old_lo = table[:, 0::2]
-    old_hi = table[:, 1::2]
-    n_lo = old_lo - s_lo
-    bw = (old_lo < s_lo).astype(jnp.uint64)
-    n_hi = old_hi - s_hi - bw
-    table = jnp.stack(
-        [n_lo[:, 0], n_hi[:, 0], n_lo[:, 1], n_hi[:, 1],
-         n_lo[:, 2], n_hi[:, 2], n_lo[:, 3], n_hi[:, 3]],
-        axis=-1,
+    table = ops.apply(
+        carry["balances"],
+        subs=(sub_slots, sub_cols, sub_lo, sub_hi, sub_valid),
     )
     fix = (ys_r == 0) & dead[None, :] & (ys_i < n)
     idxf = jnp.where(fix, ys_i, B).reshape(-1)
@@ -1460,7 +1530,7 @@ def _init_carry_keep(balances, dstat_init):
     return kernel.make_carry(balances, dstat_init, dstat_init.shape[0])
 
 
-def _finalize_body(carry, hist_fix):
+def _finalize_body(carry, hist_fix, ops=_DENSE_OPS):
     """Pack outputs; rewrite wave events' balance snapshots with the
     BATCH-FINAL rows of their touched slots so the host's last-write-
     wins mirror reconstruction lands on exact finals (a wave event's
@@ -1471,12 +1541,12 @@ def _finalize_body(carry, hist_fix):
     run there, so the history groove only ever sees sequential-exact
     rows."""
     table = carry["balances"]
-    A = table.shape[0]
+    A = ops.nrows(table)
     fix = hist_fix & (carry["results"] == 0)
     dr = jnp.clip(carry["created"]["dr_slot"], 0, A - 1)
     cr = jnp.clip(carry["created"]["cr_slot"], 0, A - 1)
-    hist_dr = jnp.where(fix[:, None], table[dr], carry["hist_dr"])
-    hist_cr = jnp.where(fix[:, None], table[cr], carry["hist_cr"])
+    hist_dr = jnp.where(fix[:, None], ops.rows(table, dr), carry["hist_dr"])
+    hist_cr = jnp.where(fix[:, None], ops.rows(table, cr), carry["hist_cr"])
     return kernel.finalize_outputs(
         dict(carry, hist_dr=hist_dr, hist_cr=hist_cr)
     )
@@ -1484,6 +1554,124 @@ def _finalize_body(carry, hist_fix):
 
 _finalize_impl = jax.jit(_finalize_body, donate_argnums=(0,))
 _finalize_keep = jax.jit(_finalize_body)
+
+
+# ---------------------------------------------------------------------------
+# SPMD executors: the SAME step bodies run inside shard_map over the
+# device engine's 1-D ("shard",) row mesh, so a row-sharded multi-chip
+# engine executes wave plans in place instead of declining to the host
+# drain.  The balance table stays a NamedSharding row slice per device
+# end to end; per-step cross-shard row reads recombine over ICI
+# (sharded.gather_rows), scatters land only on locally-owned rows, and
+# every event-axis output (results, records, snapshots, packed matrix)
+# is computed replicated — identically on every device — so admission
+# and packed outputs agree across the mesh by determinism, and the
+# whole pipeline is bit-identical to the dense executor (enforced by
+# the sharded differential fuzz in tests/test_device_waves.py).
+
+
+def plan_shardable(plan: WavePlan) -> bool:
+    """True when every segment has an SPMD executor: "wave" and
+    "chains" do; "scan" segments (kernel.make_body's sequential
+    machinery) keep single-device scope — a sharded engine declines
+    such plans gracefully and drains to the host instead."""
+    return all(kind in ("wave", "chains") for kind, _ in plan.segments)
+
+
+@jax.jit
+def _make_rest(dstat_init):
+    """The segment carry MINUS the balance table (which the sharded
+    executors thread separately, under its own partition spec)."""
+    carry = kernel.make_carry(
+        jnp.zeros((1, 8), jnp.uint64), dstat_init, dstat_init.shape[0]
+    )
+    carry.pop("balances")
+    return carry
+
+
+_SHARDED_FNS: dict = {}
+
+
+def _sharded_fns(mesh, total_rows: int):
+    """(wave, chain, finalize) shard_map-wrapped jits for one
+    (mesh, table geometry) — cached: the wrappers are shape-polymorphic
+    via jit retracing, but the mesh closure is fixed."""
+    key = (mesh, total_rows)
+    hit = _SHARDED_FNS.get(key)
+    if hit is not None:
+        return hit
+    from jax.sharding import PartitionSpec as P
+
+    from tigerbeetle_tpu.parallel import sharded
+    from tigerbeetle_tpu.parallel.sharded import shard_map
+
+    n_shard = mesh.shape["shard"]
+    assert total_rows % n_shard == 0, (total_rows, n_shard)
+    ops = _ShardTableOps(total_rows, total_rows // n_shard)
+    kw = sharded.shard_map_kwargs()
+    t_spec = P("shard", None)
+
+    def wave_body(table, rest, ev, n, ts_base):
+        out = _wave_step_impl(
+            dict(rest, balances=table), ev, n, ts_base, ops=ops
+        )
+        return out.pop("balances"), out
+
+    def chain_body(table, rest, ev, n, ts_base):
+        out = _chain_wave_impl(
+            dict(rest, balances=table), ev, n, ts_base, ops=ops
+        )
+        return out.pop("balances"), out
+
+    def fin_body(table, rest, hist_fix):
+        return _finalize_body(
+            dict(rest, balances=table), hist_fix, ops=ops
+        )
+
+    def wrap(body, n_rep_args):
+        return jax.jit(
+            shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(t_spec,) + (P(),) * n_rep_args,
+                out_specs=(t_spec, P()),
+                **kw,
+            )
+        )
+
+    fns = (wrap(wave_body, 4), wrap(chain_body, 4), wrap(fin_body, 2))
+    _SHARDED_FNS[key] = fns
+    return fns
+
+
+def _execute_plan_sharded(
+    balances, ev: dict, dstat_init, n: int, ts_base: int, plan: WavePlan,
+    hist_fix: np.ndarray, mesh,
+):
+    """Segment loop over the SPMD executors; the caller proved
+    plan_shardable(plan).  Never donates — the engine retries from the
+    same authoritative handle after transient link faults, exactly
+    like the dense engine path."""
+    B = ev["flags"].shape[0]
+    wave, chain, fin = _sharded_fns(mesh, balances.shape[0])
+    rest = _make_rest(jnp.asarray(np.asarray(dstat_init), jnp.uint32))
+    table = balances
+    n_j = jnp.int32(n)
+    ts_j = jnp.uint64(ts_base)
+    for k, (seg_kind, idx) in enumerate(plan.segments):
+        if seg_kind == "chains":
+            ev_seg = _gather_chain_events(
+                ev, idx, plan.chain_steps[k], n, B
+            )
+            table, rest = chain(table, rest, ev_seg, n_j, ts_j)
+            continue
+        assert seg_kind == "wave", (
+            "scan segments have no SPMD executor (plan_shardable)"
+        )
+        K = _bucket(len(idx))
+        ev_seg = _gather_events(ev, idx, K, B)
+        table, rest = wave(table, rest, ev_seg, n_j, ts_j)
+    return fin(table, rest, jnp.asarray(hist_fix))
 
 
 def _bucket(k: int) -> int:
@@ -1621,14 +1809,24 @@ def run_create_transfers_waves(
 
 def run_plan_engine(
     balances, ev: dict, dstat_init, n: int, ts_base: int, plan: WavePlan,
-    hist_fix: np.ndarray,
+    hist_fix: np.ndarray, mesh=None,
 ):
     """Device-engine entry: execute a window batch's wave plan against
     the AUTHORITATIVE table handle without donating any caller buffer
     — the engine must be able to retry the whole batch from the same
     handle after a transient link fault, and its `self.balances` stays
     valid if execution dies partway (demotion re-uploads from the
-    mirror regardless).  Returns (new_balances, packed outputs)."""
+    mirror regardless).  Returns (new_balances, packed outputs).
+
+    `mesh` routes a ROW-SHARDED engine's plan through the SPMD
+    executors (shard_map over the 1-D "shard" axis): the new balances
+    come back under the same NamedSharding row partition the engine
+    placed them with, and the packed outputs are replicated.  The
+    caller must have checked plan_shardable(plan) first."""
+    if mesh is not None:
+        return _execute_plan_sharded(
+            balances, ev, dstat_init, n, ts_base, plan, hist_fix, mesh
+        )
     return _execute_plan(
         balances, ev, dstat_init, n, ts_base, plan, hist_fix, donate=False
     )
@@ -1636,7 +1834,7 @@ def run_plan_engine(
 
 def prewarm(
     A: int, B_buckets=kernel.BATCH_BUCKETS, buckets=_SEG_BUCKETS,
-    engine: bool = False,
+    engine: bool = False, mesh=None,
 ) -> None:
     """Compile the wave step, the chain-wave step, and the paired scan
     segment for the given table geometry OFF the hot path: on the
@@ -1651,12 +1849,40 @@ def prewarm(
     non-donating twins the device engine's window launch dispatches
     (separate XLA executables); the chain-wave step warms at its
     smallest position bucket (deeper chains recompile once, off the
-    common path)."""
+    common path).  `mesh` warms the SPMD executors instead — the
+    row-sharded engine's wave dispatch path."""
+    if mesh is not None:
+        _prewarm_sharded(A, mesh, B_buckets, buckets)
+        return
     step = _wave_step_keep if engine else _wave_step
     chainf = _chain_step_keep if engine else _chain_step
     scan = kernel.scan_segment_keep if engine else kernel.scan_segment
     fin = _finalize_keep if engine else _finalize_impl
     outs = []
+    for B, K, ev, idx, chain_ev in _prewarm_shapes(B_buckets, buckets):
+        carry = kernel.make_carry(
+            jnp.zeros((A, 8), jnp.uint64), jnp.zeros(B, jnp.uint32), B
+        )
+        carry = step(
+            carry, _gather_events(ev, idx, K, B),
+            jnp.int32(0), jnp.uint64(1),
+        )
+        carry = scan(
+            carry, _gather_events(ev, idx, K, B),
+            jnp.asarray(ev["id_group"]), jnp.int32(0), jnp.uint64(1),
+        )
+        if chain_ev is not None:
+            carry = chainf(carry, chain_ev, jnp.int32(0), jnp.uint64(1))
+        outs.append(fin(carry, jnp.zeros(B, bool)))
+    jax.block_until_ready(outs)
+
+
+def _prewarm_shapes(B_buckets, buckets):
+    """Yield (B, K, ev, idx, chain_ev) for every (batch, segment)
+    bucket pair the router can produce — the ONE definition of the
+    synthetic warm-up shapes, so the dense and sharded prewarm loops
+    can never warm different geometries.  `chain_ev` is None when
+    chain waves are disabled."""
     for B in B_buckets:
         ev = {
             name: np.zeros(B, np.dtype(dtype))
@@ -1666,27 +1892,149 @@ def prewarm(
         for K in buckets:
             if K > max(_SEG_BUCKETS) or _bucket(min(K, B)) != K:
                 continue
-            carry = kernel.make_carry(
-                jnp.zeros((A, 8), jnp.uint64), jnp.zeros(B, jnp.uint32), B
-            )
             idx = np.arange(min(K, B))
-            carry = step(
-                carry, _gather_events(ev, idx, K, B),
-                jnp.int32(0), jnp.uint64(1),
-            )
-            carry = scan(
-                carry, _gather_events(ev, idx, K, B),
-                jnp.asarray(ev["id_group"]), jnp.int32(0), jnp.uint64(1),
-            )
+            chain_ev = None
             if chain_max() >= 2:
                 chain_ev = {
-                    name: jnp.zeros(
-                        (8, K), jnp.asarray(ev[name]).dtype
-                    )
+                    name: jnp.zeros((8, K), jnp.asarray(ev[name]).dtype)
                     for name in _CHAIN_EV_FIELDS
                 }
                 chain_ev["i"] = jnp.full((8, K), B, jnp.int32)
                 chain_ev["chain_open"] = jnp.zeros((8, K), bool)
-                carry = chainf(carry, chain_ev, jnp.int32(0), jnp.uint64(1))
-            outs.append(fin(carry, jnp.zeros(B, bool)))
+            yield B, K, ev, idx, chain_ev
+
+
+def _prewarm_sharded(A: int, mesh, B_buckets, buckets) -> None:
+    """Compile the SPMD wave/chain/finalize executors for every (B, K)
+    bucket pair the router can produce, with the table placed under
+    the engine's exact NamedSharding (compile cache keys include input
+    shardings) — first compiles must not land inside a timed window."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    wave, chain, fin = _sharded_fns(mesh, A)
+    sharding = NamedSharding(mesh, P("shard", None))
+    outs = []
+    for B, K, ev, idx, chain_ev in _prewarm_shapes(B_buckets, buckets):
+        table = jax.device_put(jnp.zeros((A, 8), jnp.uint64), sharding)
+        rest = _make_rest(jnp.zeros(B, jnp.uint32))
+        table, rest = wave(
+            table, rest, _gather_events(ev, idx, K, B),
+            jnp.int32(0), jnp.uint64(1),
+        )
+        if chain_ev is not None:
+            table, rest = chain(
+                table, rest, chain_ev, jnp.int32(0), jnp.uint64(1)
+            )
+        outs.append(fin(table, rest, jnp.zeros(B, bool)))
     jax.block_until_ready(outs)
+
+
+# ---------------------------------------------------------------------------
+# Pending wave-record compaction.  A queued "waves" record used to
+# retain its full (B,)-padded host event dict until launch (~3 MB at
+# B=8192; a 96-batch window ~300 MB of host RAM).  Most columns are
+# all-zero, constant, or narrow for common batches, and padding past
+# the batch length is zeros by construction — so pending records store
+# a lossless columnar encoding and rebuild the padded dict at launch
+# (DeviceEngine.submit_waves / _exec_waves).  The engine reports the
+# retained bytes as `pending_window_bytes` (bench `device_waves`).
+
+_PER_COLUMN_OVERHEAD = 8  # name/tag bookkeeping, counted honestly
+
+
+class PackedColumns:
+    """Lossless columnar encoding of a dict of (B,) numpy arrays whose
+    tails (beyond row `n`) are zeros — except full-length aranges
+    ("i"), which re-derive.  Per column: all-zero -> nothing, constant
+    -> one scalar, arange -> nothing, bool -> bit-packed, integers ->
+    the narrowest dtype that holds the value range."""
+
+    __slots__ = ("n", "B", "cols", "nbytes", "padded_nbytes")
+
+    def __init__(self, cols: dict, n: int) -> None:
+        self.n = n
+        self.cols = {}
+        self.nbytes = 0
+        self.padded_nbytes = 0
+        B = None
+        for name, arr in cols.items():
+            arr = np.asarray(arr)
+            B = arr.shape[0] if B is None else B
+            assert arr.shape == (B,), (name, arr.shape, B)
+            self.padded_nbytes += arr.nbytes
+            self.cols[name] = enc = self._encode(arr, n)
+            payload = enc[2]
+            self.nbytes += _PER_COLUMN_OVERHEAD + (
+                payload.nbytes if isinstance(payload, np.ndarray) else 8
+            )
+        self.B = B
+
+    @staticmethod
+    def _encode(arr: np.ndarray, n: int):
+        dt = arr.dtype
+        if dt.kind in "iu" and arr[0] == 0 and bool(
+            (np.diff(arr) == 1).all()
+        ):
+            return (dt, "arange", None)
+        head, tail = arr[:n], arr[n:]
+        if tail.any():
+            # Unexpectedly nonzero padding: store verbatim — the codec
+            # must be lossless for ANY input, compact for common ones.
+            return (dt, "full", arr.copy())
+        if not head.any():
+            return (dt, "zero", None)
+        if bool((head == head[0]).all()):
+            return (dt, "const", head[0])
+        if dt.kind == "b":
+            return (dt, "bits", np.packbits(head))
+        if dt.kind == "u":
+            vmax = int(head.max())
+            for nt in (np.uint8, np.uint16, np.uint32, np.uint64):
+                if vmax <= int(np.iinfo(nt).max):
+                    return (dt, "arr", head.astype(nt))
+        if dt.kind == "i":
+            vmin, vmax = int(head.min()), int(head.max())
+            for nt in (np.int8, np.int16, np.int32, np.int64):
+                ii = np.iinfo(nt)
+                if ii.min <= vmin and vmax <= ii.max:
+                    return (dt, "arr", head.astype(nt))
+        return (dt, "arr", head.copy())
+
+    def unpack(self) -> dict:
+        out = {}
+        for name, (dt, tag, payload) in self.cols.items():
+            if tag == "arange":
+                out[name] = np.arange(self.B, dtype=dt)
+                continue
+            if tag == "full":
+                out[name] = payload.copy()
+                continue
+            arr = np.zeros(self.B, dt)
+            if tag == "const":
+                arr[: self.n] = payload
+            elif tag == "bits":
+                arr[: self.n] = np.unpackbits(
+                    payload, count=self.n
+                ).astype(bool)
+            elif tag == "arr":
+                arr[: self.n] = payload.astype(dt)
+            out[name] = arr
+        return out
+
+
+def pack_wave_record(ev: dict, dstat_init, hist_fix, n: int) -> PackedColumns:
+    """One compact bundle for everything a pending "waves" record must
+    retain until launch: the event dict plus the dstat seed and the
+    snapshot-rewrite mask (all (B,) columns, same codec)."""
+    cols = dict(ev)
+    cols["__dstat_init__"] = np.asarray(dstat_init)
+    cols["__hist_fix__"] = np.asarray(hist_fix)
+    return PackedColumns(cols, n)
+
+
+def unpack_wave_record(pk: PackedColumns):
+    """-> (ev, dstat_init, hist_fix), bit-identical to what was packed."""
+    cols = pk.unpack()
+    dstat_init = cols.pop("__dstat_init__")
+    hist_fix = cols.pop("__hist_fix__")
+    return cols, dstat_init, hist_fix
