@@ -30,6 +30,57 @@ def test_env_int_default_when_unset(monkeypatch):
     assert envcheck.env_int("TB_DEV_WINDOW", 96, minimum=1) == 96
 
 
+def test_tb_group_commit_max_us_validated(monkeypatch):
+    monkeypatch.setenv("TB_GROUP_COMMIT_MAX_US", "soon")
+    with pytest.raises(envcheck.EnvVarError, match="TB_GROUP_COMMIT_MAX_US"):
+        envcheck.group_commit_max_us()
+    monkeypatch.setenv("TB_GROUP_COMMIT_MAX_US", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.group_commit_max_us()
+    monkeypatch.setenv("TB_GROUP_COMMIT_MAX_US", "0")  # 0 = disabled
+    assert envcheck.group_commit_max_us() == 0
+    monkeypatch.setenv("TB_GROUP_COMMIT_MAX_US", "5000")
+    assert envcheck.group_commit_max_us() == 5000
+    monkeypatch.delenv("TB_GROUP_COMMIT_MAX_US")
+    assert envcheck.group_commit_max_us() == 2000  # default on
+
+
+def test_tb_ckpt_async_validated(monkeypatch):
+    monkeypatch.setenv("TB_CKPT_ASYNC", "yes")
+    with pytest.raises(envcheck.EnvVarError, match="TB_CKPT_ASYNC"):
+        envcheck.ckpt_async()
+    monkeypatch.setenv("TB_CKPT_ASYNC", "2")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 1"):
+        envcheck.ckpt_async()
+    monkeypatch.setenv("TB_CKPT_ASYNC", "0")
+    assert envcheck.ckpt_async() == 0
+    monkeypatch.delenv("TB_CKPT_ASYNC")
+    assert envcheck.ckpt_async() == 1  # default on
+
+
+def test_tb_ckpt_async_disables_worker(monkeypatch, tmp_path):
+    """TB_CKPT_ASYNC=0 keeps the whole checkpoint on the commit path
+    (no checkpoint worker), even on FileStorage."""
+    from tigerbeetle_tpu import constants as cfg
+    from tigerbeetle_tpu.state_machine import CpuStateMachine
+    from tigerbeetle_tpu.vsr import replica as vsr_replica
+    from tigerbeetle_tpu.vsr.storage import FileStorage, ZoneLayout
+
+    layout = ZoneLayout(config=cfg.TEST_MIN, grid_size=1 << 20)
+    path = str(tmp_path / "data.tb")
+    storage = FileStorage(path, layout, create=True)
+    vsr_replica.format(storage, 5)
+    monkeypatch.setenv("TB_CKPT_ASYNC", "0")
+    r = vsr_replica.Replica(storage, 5, CpuStateMachine(cfg.TEST_MIN))
+    assert r._ckpt_worker is None
+    monkeypatch.setenv("TB_CKPT_ASYNC", "1")
+    r2 = vsr_replica.Replica(storage, 5, CpuStateMachine(cfg.TEST_MIN))
+    assert r2._ckpt_worker is not None
+    r.close()
+    r2.close()
+    storage.close()
+
+
 def test_window_ring_constraint_named():
     with pytest.raises(envcheck.EnvVarError) as err:
         _validate_window_ring(200, 256)

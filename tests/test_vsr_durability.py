@@ -418,6 +418,117 @@ def test_replica_two_phase_expiry_survives_restart(tmp_path):
     storage.close()
 
 
+def test_crash_at_fsync_request_never_acked():
+    """The crash-at-fsync fault point: an op whose WAL sync dies
+    mid-call is never acked (on_request raises instead of returning a
+    reply), and recovery shows no trace of it."""
+    from tigerbeetle_tpu.vsr.storage import FsyncCrash
+
+    storage, r = fresh_replica()
+    r.on_request(types.Operation.create_accounts, pack([account(1), account(2)]))
+    r.on_request(
+        types.Operation.create_transfers,
+        pack([transfer(10, debit_account_id=1, credit_account_id=2, amount=5)]),
+    )
+    op_before = r.op
+    storage.crash_at_fsync = 1
+    with pytest.raises(FsyncCrash):
+        r.on_request(
+            types.Operation.create_transfers,
+            pack([transfer(11, debit_account_id=1, credit_account_id=2,
+                           amount=900)]),
+        )
+    storage.crash()  # power loss: the unsynced op's sectors are lost
+
+    r2 = reopen(storage)
+    assert r2.op == op_before
+    out = r2.on_request(types.Operation.lookup_accounts, ids_bytes([1]))
+    assert types.u128_get(np.frombuffer(out, types.ACCOUNT_DTYPE)[0],
+                          "debits_posted") == 5
+
+
+def test_mid_async_checkpoint_crash_recovers_previous_superblock():
+    """Crash between an async checkpoint's FREEZE (spill + snapshot +
+    buffered blob write) and its background flip: the new superblock
+    never landed, so recovery must come up from the PREVIOUS one and
+    replay the WAL tail to the same state."""
+    from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+
+    storage = MemoryStorage(layout())
+    vsr_replica.format(storage, CLUSTER)
+    r = vsr_replica.Replica(storage, CLUSTER, TpuStateMachine(cfg.TEST_MIN))
+    r.open()
+    r.on_request(types.Operation.create_accounts, pack([account(1), account(2)]))
+    # Cross one full (synchronous) checkpoint so a durable previous
+    # superblock exists, then commit a tail beyond it.
+    n_ops = cfg.TEST_MIN.vsr_checkpoint_interval + 7
+    for i in range(n_ops):
+        r.on_request(
+            types.Operation.create_transfers,
+            pack([transfer(100 + i, debit_account_id=1, credit_account_id=2,
+                           amount=2)]),
+        )
+    assert r.checkpoint_op > 0
+    seq_before = int(r.superblock.working["sequence"])
+    commit_before = r.commit_min
+
+    # The async split's freeze half only: spill + snapshot + blob
+    # write land in the page cache (unsynced); the flip never runs —
+    # exactly the state a crash inside the background window leaves.
+    r._checkpoint_freeze()
+    storage.crash()
+
+    r2 = vsr_replica.Replica(storage, CLUSTER, TpuStateMachine(cfg.TEST_MIN))
+    r2.open()
+    assert int(r2.superblock.working["sequence"]) == seq_before
+    assert r2.checkpoint_op == r.checkpoint_op
+    assert r2.commit_min == commit_before  # WAL replay covers the tail
+    out = r2.on_request(types.Operation.lookup_accounts, ids_bytes([1, 2]))
+    rows = np.frombuffer(out, types.ACCOUNT_DTYPE)
+    assert types.u128_get(rows[0], "debits_posted") == 2 * n_ops
+    assert types.u128_get(rows[1], "credits_posted") == 2 * n_ops
+
+
+def test_free_set_quarantines_released_blocks_until_flip():
+    """Blocks released by a frozen checkpoint become free (the blob
+    encodes them free) but must not be REUSED while the previous
+    superblock — which may reference them — is still the durable
+    recovery root (async flip window)."""
+    from tigerbeetle_tpu.vsr.free_set import FreeSet
+
+    fs = FreeSet(8)
+    res = fs.reserve(3)
+    a, b, c = fs.acquire(res), fs.acquire(res), fs.acquire(res)
+    fs.forfeit(res)
+    fs.release(a)
+    fs.release(b)
+    fs.checkpoint()  # freeze: free again, but quarantined
+    assert fs.is_free(a) and fs.is_free(b)
+    res2 = fs.reserve(5)
+    got = {fs.acquire(res2) for _ in range(5)}
+    fs.forfeit(res2)
+    assert a not in got and b not in got, "reused a quarantined block"
+    # The blob must encode quarantined blocks as FREE (it is only read
+    # once its own flip is durable).
+    decoded = FreeSet.decode(fs.encode(), 8)
+    assert decoded.is_free(a) and decoded.is_free(b)
+    # The NEXT freeze releases the previous quarantine (deterministic
+    # in the commit stream; the replica's checkpoint join guarantees
+    # it postdates the durable flip).
+    fs.checkpoint()
+    res3 = fs.reserve(2)
+    got3 = {fs.acquire(res3) for _ in range(2)}
+    fs.forfeit(res3)
+    assert got3 == {a, b}
+    # Explicit early release stays available for standalone harnesses.
+    fs.release(c)
+    fs.checkpoint()
+    fs.release_quarantine()
+    res4 = fs.reserve(1)
+    assert fs.acquire(res4) == c
+    fs.forfeit(res4)
+
+
 def test_replica_tpu_state_machine_checkpoint_restart():
     from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
 

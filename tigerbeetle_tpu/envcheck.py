@@ -59,6 +59,22 @@ def env_float(
     return value
 
 
+def group_commit_max_us() -> int:
+    """TB_GROUP_COMMIT_MAX_US: longest a replicated ack may wait for
+    its covering WAL fdatasync, in microseconds.  0 disables group
+    commit (one fsync per prepare, the pre-r10 behavior)."""
+    return env_int(
+        "TB_GROUP_COMMIT_MAX_US", 2000, minimum=0, maximum=10_000_000
+    )
+
+
+def ckpt_async() -> int:
+    """TB_CKPT_ASYNC: 1 (default) runs the checkpoint's disk half
+    (grid writeback join, fdatasync, superblock flip) on a background
+    worker; 0 keeps the whole checkpoint on the commit path."""
+    return env_int("TB_CKPT_ASYNC", 1, minimum=0, maximum=1)
+
+
 def env_choice(name: str, default: str, choices: tuple[str, ...]) -> str:
     raw = os.environ.get(name)
     if raw is None or raw == "":

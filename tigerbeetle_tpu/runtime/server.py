@@ -184,18 +184,46 @@ class ReplicaServer:
             )
         self.replica.open()
         self._last_tick = 0
+        self._last_stats = 0
+        self._stats_printed: tuple | None = None
 
     @property
     def port(self) -> int:
         return self.bus.port
 
+    # Bound on drain rounds per poll_once: each extra round is a
+    # zero-timeout poll, so a chattering peer cannot starve ticks.
+    DRAIN_ROUNDS_MAX = 16
+
     def poll_once(self, timeout_ms: int = 10) -> None:
-        """One loop iteration: deliver bus events + tick on cadence."""
-        for ev_type, conn, payload in self.bus.native.poll(timeout_ms):
-            if ev_type == EV_CLOSED:
-                self.bus.drop_conn(conn)
-            elif ev_type == EV_MESSAGE:
-                self._on_raw_message(conn, payload)
+        """One loop iteration: drain ALL ready bus events (so one
+        group-commit sync covers a whole pipeline's worth of prepares
+        and replies coalesce per drain), then tick on cadence, then
+        flush the group commit — no ack leaves before its covering
+        sync.  TB_GROUP_COMMIT_MAX_US bounds deferral inside a long
+        drain."""
+        deadline_ns = self.replica.group_commit_max_us * 1_000
+        drain_t0 = None
+        rounds = 0
+        while True:
+            events = self.bus.native.poll(timeout_ms if rounds == 0 else 0)
+            rounds += 1
+            for ev_type, conn, payload in events:
+                if ev_type == EV_CLOSED:
+                    self.bus.drop_conn(conn)
+                elif ev_type == EV_MESSAGE:
+                    self._on_raw_message(conn, payload)
+                if self.replica._gc_pending and drain_t0 is None:
+                    drain_t0 = time.monotonic_ns()
+            if drain_t0 is not None and (
+                time.monotonic_ns() - drain_t0 >= deadline_ns
+            ):
+                # Deferral deadline inside a busy drain: sync + release
+                # now; later messages start a fresh batch.
+                self.replica.flush_group_commit()
+                drain_t0 = None
+            if not events or rounds >= self.DRAIN_ROUNDS_MAX:
+                break
         now = time.monotonic_ns()
         if now - self._last_tick >= TICK_NS:
             self._last_tick = now
@@ -206,6 +234,29 @@ class ReplicaServer:
             self.replica.monotonic = now
             self.replica.tick()
             self.bus.connect_peers(self.replica.cluster, self.replica.view)
+            if now - self._last_stats >= 100 * TICK_NS:  # ~1s cadence
+                self._last_stats = now
+                self._print_stats()
+        self.replica.flush_group_commit()
+
+    def _print_stats(self) -> None:
+        """One greppable counters line per second of activity on
+        stdout (the replica log): the replicated bench and the smoke
+        test harvest per-replica fsync/prepare counts from the log
+        tail — kill -9'd servers still leave their numbers behind."""
+        r = self.replica
+        stats = (
+            self.storage.stat_fsyncs, r.stat_prepares_written,
+            r.stat_gc_flushes, r.commit_min, r.stat_ckpt_async,
+        )
+        if stats == self._stats_printed:
+            return  # idle: don't grow the log
+        self._stats_printed = stats
+        print(
+            "TB_STATS fsyncs=%d prepares=%d gc_flushes=%d commit_min=%d "
+            "ckpt_async=%d" % stats,
+            flush=True,
+        )
 
     def _on_raw_message(self, conn: int, payload: bytes) -> None:
         if len(payload) < HEADER_SIZE:
@@ -260,6 +311,14 @@ class ReplicaServer:
         dev = getattr(sm, "_dev", None)
         if dev is not None and hasattr(dev, "close"):
             dev.close()
+        # Release any held acks, then join background durability work
+        # (in-flight async checkpoint flip, WAL sync) BEFORE any fd
+        # closes — the checkpoint worker's finalize calls aof.sync()
+        # and storage.sync(), and closing those fds first would turn
+        # the join into an EBADF (or worse, an fdatasync on a reused
+        # fd number).
+        self.replica.flush_group_commit()
+        self.replica.close()
         if self.replica.aof is not None:
             self.replica.aof.close()
         if self._trace_path:

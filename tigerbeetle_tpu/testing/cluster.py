@@ -333,6 +333,12 @@ class Cluster:
         for c in self.clients.values():
             c.tick()
         self.network.advance(self._deliver)
+        # Group-commit flush point (deterministic: once per step, in
+        # replica order).  A no-op unless a test opted the replica's
+        # MemoryStorage into deferred sync.
+        for r in self.replicas:
+            if r.status != "crashed":
+                r.flush_group_commit()
 
     def _deliver(self, dst, header: np.ndarray, body: bytes) -> None:
         if isinstance(dst, int) and dst < len(self.replicas):

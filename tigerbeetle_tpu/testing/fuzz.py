@@ -127,19 +127,26 @@ def fuzz_free_set(seed: int, rounds: int) -> None:
         acquired: set[int] = set()
         for _ in range(rounds):
             roll = rng.random()
-            if roll < 0.5 and fs.count_free() > 0:
-                want = int(rng.integers(1, min(8, fs.count_free()) + 1))
+            if roll < 0.5 and fs.count_reservable() > 0:
+                want = int(
+                    rng.integers(1, min(8, fs.count_reservable()) + 1)
+                )
                 r = fs.reserve(want)
                 took = [fs.acquire(r) for _ in range(int(rng.integers(want + 1)))]
                 fs.forfeit(r)
                 for a in took:
                     assert a not in acquired, "double allocation"
+                    # A quarantined block must never be handed out.
+                    assert not fs.quarantine[a - 1], "reused quarantined"
                     acquired.add(a)
             elif acquired and roll < 0.8:
                 a = acquired.pop()
                 fs.release(a)
             else:
                 fs.checkpoint()
+                # Freeze: released blocks are free in the encoded blob
+                # but quarantined from reuse until the next freeze.
+                assert not (fs.quarantine & ~fs.free).any(), seed
                 blob = fs.encode()
                 back = FreeSet.decode(blob, n)
                 assert np.array_equal(back.free, fs.free), seed
@@ -348,10 +355,12 @@ def fuzz_manifest_log(seed: int, rounds: int) -> None:
             else:
                 addresses = mlog.checkpoint()
                 # The durable-checkpoint ack that makes staged block
-                # releases reusable (production: forest.py:150).
-                # Without it every log compaction leaks its released
-                # blocks into staging and long runs exhaust the grid.
+                # releases reusable (production: forest.py:150 at the
+                # freeze + the flip's release_quarantine).  Without it
+                # every log compaction leaks its released blocks into
+                # staging and long runs exhaust the grid.
                 grid.free_set.checkpoint()
+                grid.free_set.release_quarantine()
         addresses = mlog.checkpoint()
         tail = mlog.tail_bytes()
         replayed = ManifestLog(grid).open(addresses, tail)

@@ -34,6 +34,13 @@ class FreeSet:
         # Blocks released this checkpoint stay unavailable until the
         # checkpoint durably commits (reference: staging set).
         self.staging = np.zeros(block_count, bool)
+        # Released-this-checkpoint blocks that became free at the
+        # FREEZE but whose flip is not yet the durable recovery root
+        # (async checkpoints): the PREVIOUS superblock's manifest may
+        # still reference them, so reuse is quarantined until
+        # release_quarantine() after the flip lands.  Empty whenever
+        # checkpoints are synchronous (freeze and flip are adjacent).
+        self.quarantine = np.zeros(block_count, bool)
         # Blocks inside outstanding reservations (not yet acquired).
         self._reserved_mask = np.zeros(block_count, bool)
         self._reservations = 0
@@ -46,8 +53,13 @@ class FreeSet:
     def reserve(self, blocks_needed: int) -> Reservation:
         """Reserve a window of exactly `blocks_needed` free blocks —
         the window is fixed now, so concurrent reservations allocate
-        deterministically regardless of acquire interleaving."""
-        candidates = np.flatnonzero(self.free & ~self._reserved_mask)
+        deterministically regardless of acquire interleaving.
+        Quarantined blocks (freed by a checkpoint whose flip is still
+        in flight) are excluded: the previous superblock — the durable
+        recovery root until the flip lands — may reference them."""
+        candidates = np.flatnonzero(
+            self.free & ~self._reserved_mask & ~self.quarantine
+        )
         assert blocks_needed <= len(candidates), "grid full"
         window = candidates[:blocks_needed].copy()
         self._reserved_mask[window] = True
@@ -87,11 +99,30 @@ class FreeSet:
         return self.free[idx] | self.staging[idx]
 
     def checkpoint(self) -> None:
-        """The previous checkpoint is durable: staged releases become
-        actually free."""
+        """Freeze point: staged releases become free (the checkpoint
+        blob encodes them free — it is only ever read once its flip is
+        durable) but quarantined from REUSE until the NEXT freeze.
+        The next-freeze boundary (rather than "when the flip lands")
+        keeps allocation a pure function of the commit stream: flip
+        wall time varies per replica, and the replica's checkpoint
+        join guarantees freeze N+1 runs after flip N is durable, so
+        the quarantine always covers the vulnerable window."""
         assert self._reservations == 0, "checkpoint with open reservations"
+        # Replacing the mask IS the release of the previous freeze's
+        # quarantine.
+        self.quarantine = self.staging.copy()
         self.free |= self.staging
         self.staging[:] = False
+
+    def release_quarantine(self) -> None:
+        """Explicit early release — for harnesses that know no older
+        superblock can reference the blocks (standalone forests,
+        fuzzers modeling a landed flip).  The replica itself never
+        calls this: reuse timing must not depend on flip wall time."""
+        self.quarantine[:] = False
+
+    def count_reservable(self) -> int:
+        return int((self.free & ~self.quarantine).sum())
 
     # -- persistence --
 

@@ -160,12 +160,25 @@ class Grid:
             self._write_error = first_exc
             raise first_exc
 
+    def _join_pending(self, address: int) -> None:
+        """Barrier for ONE address: flush_writes alone is not enough
+        when another thread (the async-checkpoint finalize) already
+        swapped the futures list — its batch may still be mid-write.
+        The pending refcount is decremented only after the pwrite, so
+        spin on it (the writer thread is making progress)."""
+        import time
+
+        self.flush_writes()
+        while address in self._pending_writes:
+            time.sleep(0.0002)
+            self.flush_writes()
+
     def read_block(self, address: int) -> bytes:
         cached = self._cache.get(address)
         if cached is not None:
             return cached
         if self._writer is not None and address in self._pending_writes:
-            self.flush_writes()
+            self._join_pending(address)
         raw = self.storage.read(self._offset(address), self.block_size)
         h = np.frombuffer(raw[:BLOCK_HEADER_SIZE], BLOCK_DTYPE)[0]
         length = int(h["length"])
@@ -184,7 +197,7 @@ class Grid:
         must not churn hot entries (reference:
         src/vsr/grid_scrubber.zig)."""
         if self._writer is not None and address in self._pending_writes:
-            self.flush_writes()
+            self._join_pending(address)
         raw = self.storage.read(self._offset(address), self.block_size)
         return block_frame_valid(raw, address, self.payload_size)
 

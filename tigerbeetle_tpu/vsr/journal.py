@@ -56,6 +56,9 @@ class Journal:
         self.slot_count = self.config.journal_slot_count
         # In-memory redundant header ring (mirrors the disk ring).
         self.headers = np.zeros(self.slot_count, HEADER_DTYPE)
+        # Deferred-sync bookkeeping (group commit): WAL writes issued
+        # with sync=False since the last covering sync_batch().
+        self.unsynced_writes = 0
         from tigerbeetle_tpu.utils import tracer as tracer_mod
 
         self.tracer = tracer_mod.NULL
@@ -93,6 +96,29 @@ class Journal:
                 # (storage.py FileStorage), so LSM spill/compaction
                 # writeback never rides the ack latency.
                 self.storage.sync_wal()
+            else:
+                # Deferred (group commit): the caller owns the covering
+                # sync_batch() and must not ack this op before it.
+                self.unsynced_writes += 1
+
+    def sync_batch(self) -> bool:
+        """One covering fdatasync for every deferred WAL write since
+        the last batch — the group-commit seam: a whole poll-drain's
+        prepares (and their redundant sectors, and any scrub heals)
+        share one durability syscall.  No-op when nothing is deferred,
+        so idle flush points cost nothing.  Returns True when a sync
+        was actually issued."""
+        if self.unsynced_writes == 0:
+            return False
+        self.unsynced_writes = 0
+        try:
+            self.storage.sync_wal()
+        except BaseException:
+            # The covering sync did not complete: everything it would
+            # have covered is still unsynced (acks must stay held).
+            self.unsynced_writes += 1
+            raise
+        return True
 
     def header_sector_intact(self, slot: int) -> bool:
         """Does the DISK redundant-header sector for `slot` match the
@@ -107,11 +133,17 @@ class Journal:
         )
         return disk == want
 
-    def rewrite_header_sector(self, slot: int) -> None:
+    def rewrite_header_sector(self, slot: int, sync: bool = True) -> None:
         """Self-heal a latent error in the redundant ring from the
-        in-memory copy (authoritative while the process lives)."""
+        in-memory copy (authoritative while the process lives).  Only
+        the WAL file is flushed (the grid has its own barriers); with
+        sync=False the heal rides the caller's covering sync_batch()
+        instead of paying its own fdatasync."""
         self._write_header_sector(slot)
-        self.storage.sync()
+        if sync:
+            self.storage.sync_wal()
+        else:
+            self.unsynced_writes += 1
 
     def _write_header_sector(self, slot: int) -> None:
         sector_index = slot // HEADERS_PER_SECTOR

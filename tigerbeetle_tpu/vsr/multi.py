@@ -35,7 +35,7 @@ import dataclasses
 
 import numpy as np
 
-from tigerbeetle_tpu import constants, types
+from tigerbeetle_tpu import constants, envcheck, types
 from tigerbeetle_tpu.state_machine import demuxer
 from tigerbeetle_tpu.vsr import superblock as superblock_mod
 from tigerbeetle_tpu.vsr import wire
@@ -83,6 +83,13 @@ class PipelineEntry:
     # this prepare multiplexes several client requests (see
     # state_machine/demuxer.py); None for plain prepares.
     subs: list[tuple[int, int, int]] | None = None
+    # False while the PRIMARY's own WAL write for this op is not yet
+    # covered by a sync (group commit): the self-vote in ok_replicas
+    # must not count toward a commit until then — committing earlier
+    # would let commit_min (which rides UNGATED heartbeats and prepare
+    # headers) advertise an op with one durable copy fewer than the
+    # quorum promises.  flush_group_commit marks entries synced.
+    synced: bool = True
 
 
 class VsrReplica(Replica):
@@ -250,6 +257,32 @@ class VsrReplica(Replica):
         self._sync_chunks: dict[int, dict[int, bytes]] = {}
         # Throttle: dst replica -> tick of last sync blob sent.
         self._sync_sent: dict[int, int] = {}
+
+        # WAL group commit (deferred-sync): prepares append to the WAL
+        # unsynced; ONE covering fdatasync per poll-drain (or per
+        # TB_GROUP_COMMIT_MAX_US deadline) is issued by
+        # flush_group_commit() BEFORE any prepare_ok / client reply it
+        # covers leaves the process — up to a pipeline's worth of
+        # prepares share a single durability syscall instead of paying
+        # one each.  Only on backends whose deferred sync is crash
+        # -equivalent (FileStorage); the deterministic MemoryStorage
+        # clusters keep the synchronous path (tests opt in per
+        # -instance via storage.supports_deferred_sync).
+        self.group_commit_max_us = envcheck.group_commit_max_us()
+        self._gc_enabled = (
+            bool(getattr(storage, "supports_deferred_sync", False))
+            and self.group_commit_max_us > 0
+        )
+        # Deferred outbound acks: (kind, dst, header, body) released in
+        # order by flush_group_commit() after the covering sync.
+        self._gc_pending: list[tuple[str, object, np.ndarray, bytes]] = []
+        # Leading-edge covering sync riding the WAL worker (disk wait
+        # overlaps the drain's commit CPU work) + how many deferred
+        # writes it covers.
+        self._gc_sync_job = None
+        self._gc_sync_cover = 0
+        self.stat_prepares_written = 0
+        self.stat_gc_flushes = 0
 
     # ------------------------------------------------------------------
 
@@ -741,7 +774,7 @@ class VsrReplica(Replica):
         )
         wire.finalize_header(prepare, body)
 
-        self.journal.write_prepare(prepare, body)
+        self._journal_write(prepare, body)
         self.op = op
         self.parent_checksum = wire.u128(prepare, "checksum")
         self._vouched[op] = self.parent_checksum  # we ARE the canon
@@ -749,7 +782,10 @@ class VsrReplica(Replica):
         # prepare supersedes it (a matching stale fill would otherwise
         # overwrite this slot — seed 460991023).
         self._repair_wanted.pop(op, None)
-        self.pipeline[op] = PipelineEntry(prepare, body, {self.replica}, subs)
+        self.pipeline[op] = PipelineEntry(
+            prepare, body, {self.replica}, subs,
+            synced=not self._gc_enabled,
+        )
         self._replicate(prepare, body)
         self._maybe_commit_pipeline()
 
@@ -800,7 +836,10 @@ class VsrReplica(Replica):
             ):
                 _events, subs = demuxer.decode_trailer(body, n_subs)
             self.pipeline[op] = PipelineEntry(
-                header, body, {self.replica}, subs
+                header, body, {self.replica}, subs,
+                # Journaled earlier, but possibly within the current
+                # unsynced window — conservative.
+                synced=not self._gc_defer(),
             )
             self._replicate(header, body)
         self._maybe_commit_pipeline()
@@ -813,6 +852,14 @@ class VsrReplica(Replica):
                 continue
             entry = self.pipeline[op]
             if len(entry.ok_replicas) < self.quorum_replication:
+                return
+            if not entry.synced:
+                # Our own WAL copy is not yet covered: backup acks
+                # alone must not commit (the quorum's durable-copy
+                # count includes our self-vote), and the committed
+                # commit_min would leak pre-sync through heartbeats
+                # and the next prepare's header.  flush_group_commit
+                # re-enters after the covering sync.
                 return
             if op != self.commit_min + 1:
                 return  # waiting on repair of earlier ops
@@ -832,7 +879,7 @@ class VsrReplica(Replica):
             elif client:
                 self._send_reply(entry.header, reply_body)
             del self.pipeline[op]
-            if self.commit_min - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
+            if self._checkpoint_due():
                 # Deterministic checkpoint point: commit_min crosses the
                 # interval boundary at the same op on every replica, so
                 # spill bases and manifests are byte-identical cluster-wide
@@ -932,7 +979,7 @@ class VsrReplica(Replica):
             op=entry.session, commit=entry.session,
         )
         wire.finalize_header(reply, b"")
-        self.bus.send_client(client, reply, b"")
+        self._gc_send_client(client, reply, b"")
 
     def _send_reply(self, prepare: np.ndarray, reply_body: bytes) -> None:
         client = wire.u128(prepare, "client")
@@ -943,11 +990,11 @@ class VsrReplica(Replica):
         entry = self.sessions.get(client)
         if entry is not None and entry.reply_header:
             header = wire.header_from_bytes(entry.reply_header)
-            self.bus.send_client(client, header, reply_body)
+            self._gc_send_client(client, header, reply_body)
 
     def _send_stored_reply(self, client: int, entry: Session) -> None:
         body = self._read_reply(entry)
-        self.bus.send_client(
+        self._gc_send_client(
             client, wire.header_from_bytes(entry.reply_header), body
         )
 
@@ -961,7 +1008,97 @@ class VsrReplica(Replica):
             client=client, replica=self.replica,
         )
         wire.finalize_header(h, b"")
-        self.bus.send_client(client, h, b"")
+        self._gc_send_client(client, h, b"")
+
+    # ------------------------------------------------------------------
+    # WAL group commit (deferred-sync mode).
+
+    def _journal_write(self, header: np.ndarray, body: bytes) -> None:
+        """WAL append on the group-commit plan when enabled: written
+        unsynced, covered by flush_group_commit()'s one fdatasync per
+        drain; a leading-edge sync is kicked onto the WAL worker so
+        the disk wait overlaps the rest of the drain's commit CPU."""
+        self.stat_prepares_written += 1
+        if not self._gc_enabled:
+            self.journal.write_prepare(header, body)
+            return
+        self.journal.write_prepare(header, body, sync=False)
+        if self._wal_sync_worker is not None and self._gc_sync_job is None:
+            self._gc_sync_cover = self.journal.unsynced_writes
+            self._gc_sync_job = self._wal_sync_worker.submit(
+                self.storage.sync_wal
+            )
+
+    def _gc_defer(self) -> bool:
+        """True while an ack sent NOW could precede its covering sync."""
+        return self._gc_enabled and (
+            self.journal.unsynced_writes > 0 or self._gc_sync_job is not None
+        )
+
+    def _gc_send(self, dst: int, header: np.ndarray, body: bytes) -> None:
+        if self._gc_defer():
+            self._gc_pending.append(("replica", dst, header, body))
+        else:
+            self.bus.send(dst, header, body)
+
+    def _gc_send_client(self, client: int, header: np.ndarray,
+                        body: bytes) -> None:
+        if self._gc_defer():
+            self._gc_pending.append(("client", client, header, body))
+        else:
+            self.bus.send_client(client, header, body)
+
+    def _gc_covering_sync(self) -> None:
+        """Make every deferred WAL write durable NOW (acks stay
+        buffered — flush_group_commit releases them)."""
+        job, self._gc_sync_job = self._gc_sync_job, None
+        if job is not None:
+            job.result()
+            # Writes that landed after the leading-edge sync was
+            # submitted may have raced past its fdatasync: only the
+            # covered prefix is settled, the rest re-syncs below.
+            self.journal.unsynced_writes = max(
+                0, self.journal.unsynced_writes - self._gc_sync_cover
+            )
+            self._gc_sync_cover = 0
+        self.journal.sync_batch()
+
+    def flush_group_commit(self) -> None:
+        """Group-commit flush point (end of a server poll drain, or
+        the TB_GROUP_COMMIT_MAX_US deadline): one covering sync for
+        the drain's deferred WAL writes, THEN the acks it gates
+        (prepare_ok, client replies, evictions) go out in order.  No
+        ack ever precedes its covering sync."""
+        if not self._gc_enabled:
+            return
+        if self.journal.unsynced_writes or self._gc_sync_job is not None:
+            self._gc_covering_sync()
+            self.stat_gc_flushes += 1
+        if self._gc_pending:
+            pending, self._gc_pending = self._gc_pending, []
+            for kind, dst, header, body in pending:
+                if kind == "client":
+                    self.bus.send_client(dst, header, body)
+                else:
+                    self.bus.send(dst, header, body)
+        # The covering sync makes our self-votes count: commit any
+        # pipeline entries that were waiting on it (their replies go
+        # out directly — nothing is deferred any more).
+        if self.is_primary and any(
+            not e.synced for e in self.pipeline.values()
+        ):
+            for e in self.pipeline.values():
+                e.synced = True
+            self._maybe_commit_pipeline()
+
+    def _aof_barrier(self) -> None:
+        # The AOF must never record an op a crash could erase from the
+        # WAL: in group-commit mode the covering sync is forced before
+        # the AOF append (per-op syncs return — AOF trades the group
+        # -commit batching for its stream guarantee).
+        super()._aof_barrier()
+        if self._gc_enabled:
+            self._gc_covering_sync()
 
     # ------------------------------------------------------------------
     # Normal operation: backup.
@@ -1053,7 +1190,7 @@ class VsrReplica(Replica):
 
     def _accept_prepare(self, header: np.ndarray, body: bytes) -> None:
         op = int(header["op"])
-        self.journal.write_prepare(header, body)
+        self._journal_write(header, body)
         self.op = op
         self.parent_checksum = wire.u128(header, "checksum")
         # A current-view prepare is canonical for its op, and its
@@ -1093,7 +1230,10 @@ class VsrReplica(Replica):
             client=wire.u128(prepare, "client"),
         )
         wire.finalize_header(ok, b"")
-        self.bus.send(self.primary_index(), ok, b"")
+        # Routed through the group-commit gate: a prepare_ok for an op
+        # whose WAL write is not yet covered by a sync must wait for
+        # the flush (the durability-before-ack contract).
+        self._gc_send(self.primary_index(), ok, b"")
 
     def _on_commit(self, header: np.ndarray, body: bytes) -> None:
         # Heartbeats advertise the freshest adopted membership: a
@@ -1229,7 +1369,7 @@ class VsrReplica(Replica):
             self._commit_prepare(header, body)
             self.commit_parent = wire.u128(header, "checksum")
             self._vouched.pop(op, None)
-            if self.commit_min - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
+            if self._checkpoint_due():
                 # Deterministic checkpoint point: commit_min crosses the
                 # interval boundary at the same op on every replica, so
                 # spill bases and manifests are byte-identical cluster-wide
@@ -1380,7 +1520,7 @@ class VsrReplica(Replica):
         # entries first resolve to a checksum via request_headers).
         if want != checksum or want == 0:
             return
-        self.journal.write_prepare(header, body)
+        self._journal_write(header, body)
         self._repair_wanted.pop(op, None)
         self._vouched[op] = checksum  # pinned fill == canonical content
         if op == self.op:
@@ -1562,7 +1702,10 @@ class VsrReplica(Replica):
             and int(self.journal.headers[slot]["op"]) <= op
             and self.journal.read_prepare(op) is None
         ):
-            self.journal.write_prepare(header, body)
+            # Deferred-sync mode folds the prepare-ring write and any
+            # header-sector heal into ONE covering sync at the next
+            # flush — a repaired prepare no longer fsyncs twice.
+            self._journal_write(header, body)
             del self._wal_scrub_wanted[op]
             self.stat_wal_scrub_repaired += 1
             self.tracer.instant("wal_scrub", op=op)
@@ -1686,7 +1829,11 @@ class VsrReplica(Replica):
         if have is not None:
             self._wal_scrub_wanted.pop(op, None)
             if not self.journal.header_sector_intact(slot):
-                self.journal.rewrite_header_sector(slot)
+                # Deferred-sync mode: the heal rides the next covering
+                # flush instead of paying its own fdatasync.
+                self.journal.rewrite_header_sector(
+                    slot, sync=not self._gc_enabled
+                )
                 self.stat_wal_scrub_repaired += 1
             return
         if self.replica_count <= 1:
@@ -1814,6 +1961,9 @@ class VsrReplica(Replica):
         self.tracer.instant("block_repair", address=addr)
 
     def _send_sync_checkpoint(self, dst: int) -> None:
+        # The shipped blob is read via the WORKING superblock's
+        # references: an in-flight async flip must land first.
+        self._ckpt_join()
         sb = self.superblock.working
         size = int(sb["checkpoint_size"])
         if size == 0:
@@ -1876,6 +2026,7 @@ class VsrReplica(Replica):
                                  commit_min_checksum: int, blob_checksum: int,
                                  remote_commit: int) -> None:
         assert checkpoint_op > self.commit_min  # guarded at receive
+        self._ckpt_join()  # superblock writes serialize with async flips
         # Shipped grid blocks must land BEFORE restore: restoring a
         # spilled snapshot reads the LSM tier to rebuild directories.
         try:
@@ -1959,6 +2110,7 @@ class VsrReplica(Replica):
         # this log_view's claim would make a superseded-sibling tail
         # durable and top-cohort.  Claim only the committed prefix
         # (always within the recovered journal, so restart-neutral).
+        self._ckpt_join()  # superblock writes serialize with async flips
         self.superblock.view_change(
             self.view, self.log_view, self.commit_max,
             op_claimed=self.commit_min,
@@ -2048,6 +2200,7 @@ class VsrReplica(Replica):
         if self.standby:
             return
         # Persist before participating (reference: superblock view_change).
+        self._ckpt_join()  # superblock writes serialize with async flips
         self.superblock.view_change(
             self.view, self.log_view, self.commit_max,
             op_claimed=self.op,
@@ -2169,6 +2322,7 @@ class VsrReplica(Replica):
             return
         self._dvc[int(header["replica"])] = _decode_dvc(body)
         if self.replica not in self._dvc:
+            self._ckpt_join()
             self.superblock.view_change(
                 self.view, self.log_view, self.commit_max,
                 op_claimed=self.op,
@@ -2234,6 +2388,7 @@ class VsrReplica(Replica):
 
         self.status = "normal"
         self.log_view = self.view
+        self._ckpt_join()
         self.superblock.view_change(
             self.view, self.log_view, self.commit_max,
             op_claimed=self.op,
@@ -2501,6 +2656,7 @@ class VsrReplica(Replica):
                     vh[int(prev["op"])] = raw
         for ch in self._installed_canonical:
             vh[int(ch["op"])] = ch.tobytes()
+        self._ckpt_join()
         self.superblock.view_change(
             self.view, self.log_view, self.commit_max,
             op_claimed=self.op,

@@ -76,13 +76,30 @@ class Replica:
         # model stays deterministic.
         self._wal_sync_worker = None
         self._wal_sync_inflight = None
+        # Asynchronous checkpoints (TB_CKPT_ASYNC, default on): the
+        # commit-visible part of checkpoint() is only the freeze
+        # (spill residue + snapshot encode + buffered blob write); the
+        # disk barriers (grid writeback join, fdatasync, superblock
+        # flip) run on a background worker and the NEXT checkpoint (or
+        # close()) joins them.  Only on FileStorage — MemoryStorage
+        # keeps the synchronous path so seeded crash tests stay
+        # deterministic.
+        self._ckpt_worker = None
+        self._ckpt_job = None         # non-None while a flip is in flight
+        self._ckpt_last_op = 0        # commit_min of the latest freeze
+        self.stat_ckpt_async = 0
+        self.stat_ckpt_sync = 0
         if getattr(storage, "supports_async_writeback", False):
             import weakref
 
+            from tigerbeetle_tpu import envcheck
             from tigerbeetle_tpu.utils.worker import SerialWorker
 
             self._wal_sync_worker = SerialWorker("wal-sync")
             weakref.finalize(self, self._wal_sync_worker.close)
+            if envcheck.ckpt_async():
+                self._ckpt_worker = SerialWorker("ckpt")
+                weakref.finalize(self, self._ckpt_worker.close)
         # Optional testing.hash_log.HashLog: per-commit chained digests
         # for determinism-divergence pinpointing (reference:
         # src/testing/hash_log.zig).
@@ -336,14 +353,28 @@ class Replica:
 
         # Checkpoint cadence (reference: src/constants.zig:55-81) — must
         # run before the WAL ring wraps over the previous checkpoint.
-        if self.op - self.checkpoint_op >= self.config.vsr_checkpoint_interval:
+        if self._checkpoint_due():
             self.checkpoint()
         return reply
+
+    def _checkpoint_due(self) -> bool:
+        """Interval crossed since the latest FREEZE (an async flip
+        still in flight counts — re-freezing against it would just
+        serialize every commit on the join)."""
+        return (
+            self.commit_min - max(self.checkpoint_op, self._ckpt_last_op)
+            >= self.config.vsr_checkpoint_interval
+        )
 
     def _join_wal_sync(self) -> None:
         if self._wal_sync_inflight is not None:
             self._wal_sync_inflight.result()
             self._wal_sync_inflight = None
+
+    def _aof_barrier(self) -> None:
+        """WAL durability barrier before an AOF append (VsrReplica
+        extends this to force the group-commit covering sync)."""
+        self._join_wal_sync()
 
     def set_tracer(self, tracer) -> None:
         """Attach a utils.tracer.Tracer to this replica's hot paths
@@ -369,7 +400,7 @@ class Replica:
             # reference: src/vsr/replica.zig:4136-4141 — AOF before
             # apply, and never ahead of the WAL's durability: the AOF
             # must not record an op a crash could erase from the WAL.
-            self._join_wal_sync()
+            self._aof_barrier()
             self.aof.write(header, body)
 
         if operation == int(VsrOperation.register):
@@ -550,6 +581,12 @@ class Replica:
         on a whole interval's worth."""
         if self.forest is None:
             return
+        # Spill/compaction beats keep running through an async flip
+        # window: allocation is safe because the FreeSet quarantines
+        # the frozen checkpoint's released blocks from reuse until the
+        # flip lands (the previous superblock — still the durable
+        # recovery root — may reference them), and beats stay a pure
+        # function of commit count either way (cluster-deterministic).
         spilled = 0
         if hasattr(self.sm, "spill_beat"):
             spilled = self.sm.spill_beat()
@@ -635,17 +672,56 @@ class Replica:
     # Checkpointing.
 
     def checkpoint(self) -> None:
-        """Write a snapshot blob to the grid zone (A/B alternating),
-        then advance the superblock — write ordering guarantees the
-        previous checkpoint survives a torn snapshot write."""
+        """Freeze a snapshot of the committed state, then make it the
+        durable recovery root.  The freeze (spill residue into LSM
+        memtables, snapshot encode, buffered blob write) runs inline;
+        the disk barriers + superblock flip run on the checkpoint
+        worker when async checkpointing is on — commits keep flowing
+        while they land, and the next checkpoint (or close()) joins.
+        Write ordering guarantees the previous checkpoint survives a
+        torn snapshot write either way."""
+        self._ckpt_join()
         # Learn the operator's checkpoint cadence for compaction
         # pacing (_compact_beat escalates toward the next barrier).
-        if self.op > self.checkpoint_op:
-            self._ckpt_interval_observed = self.op - self.checkpoint_op
+        base = max(self.checkpoint_op, self._ckpt_last_op)
+        if self.op > base:
+            self._ckpt_interval_observed = self.op - base
         with self.tracer.span("checkpoint", op=self.commit_min):
-            self._checkpoint()
+            args = self._checkpoint_freeze()
+            self._ckpt_last_op = self.commit_min
+            if self._ckpt_worker is not None:
+                self.stat_ckpt_async += 1
+                self._ckpt_job = self._ckpt_worker.submit(
+                    self._checkpoint_finalize, *args
+                )
+            else:
+                self.stat_ckpt_sync += 1
+                self._checkpoint_finalize(*args)
 
-    def _checkpoint(self) -> None:
+    def _ckpt_join(self) -> None:
+        """Barrier: wait for the in-flight async flip (if any).  Must
+        run before anything that reads or writes the superblock, and
+        before the next freeze."""
+        job, self._ckpt_job = self._ckpt_job, None
+        if job is not None:
+            job.result()
+
+    def close(self) -> None:
+        """Join in-flight background work (async checkpoint flip, WAL
+        sync) and stop the workers.  Idempotent."""
+        self._ckpt_join()
+        self._join_wal_sync()
+        if self._ckpt_worker is not None:
+            self._ckpt_worker.close()
+        if self._wal_sync_worker is not None:
+            self._wal_sync_worker.close()
+
+    def _checkpoint_freeze(self):
+        """Foreground half: bring the LSM tier + snapshot blob to a
+        consistent image of commit_min and stage it in the grid zone
+        (buffered writes).  Returns the finalize args — everything the
+        background flip needs, captured now so later commits cannot
+        skew it."""
         head = self.journal.read_prepare(self.commit_min)
         if head is not None:
             head_checksum = wire.u128(head[0], "checksum")
@@ -661,11 +737,6 @@ class Replica:
             ) == wire.Command.prepare, "checkpoint head unrecoverable"
             head_checksum = wire.u128(mem, "checksum")
 
-        if self.aof is not None:
-            # The AOF is a recovery stream: make it durable at least as
-            # often as checkpoints (reference: src/aof.zig fsyncs).
-            self.aof.sync()
-
         if self.forest is not None:
             # Spill frozen state into LSM grid blocks first so the
             # snapshot blob covers only the RAM tail (O(delta)).
@@ -676,24 +747,58 @@ class Replica:
         region = int(self.superblock.working["sequence"]) % 2
         offset = self._grid_region_offset(region, len(blob))
         self._write_grid(offset, blob)
+        return (
+            self.commit_min, head_checksum, offset, len(blob),
+            wire.checksum(blob), self.view, self.epoch,
+            list(self.members) if self.members is not None else None,
+        )
+
+    def _checkpoint_finalize(self, commit_min, head_checksum, offset,
+                             size, blob_checksum, view, epoch,
+                             members) -> None:
+        """Disk half (checkpoint worker in async mode): everything the
+        new superblock references must be durable before the flip."""
+        if self.aof is not None:
+            # The AOF is a recovery stream: make it durable at least as
+            # often as checkpoints (reference: src/aof.zig fsyncs).
+            self.aof.sync()
         if self.forest is not None:
             # Outstanding async block writes must be on disk before
             # the sync that the new superblock's references rely on.
             self.forest.grid.flush_writes()
+            # Paced chunked writeback first — ASYNC MODE ONLY: one
+            # monolithic grid fdatasync monopolizes the device and the
+            # ack path's WAL fsyncs queue behind it for its whole
+            # duration (the commit-p99 spike this worker exists to
+            # remove).  Inline (TB_CKPT_ASYNC=0) there is no
+            # concurrent WAL fsync to protect, and the chunk pauses
+            # would just lengthen the commit-loop stall.  Durability
+            # still comes from sync().
+            paced = getattr(self.storage, "sync_grid_paced", None)
+            if paced is not None and self._ckpt_worker is not None:
+                paced()
         self.storage.sync()
 
         self.superblock.checkpoint(
-            commit_min=self.commit_min,
+            commit_min=commit_min,
             commit_min_checksum=head_checksum,
-            commit_max=self.commit_min,
+            commit_max=commit_min,
             checkpoint_offset=offset,
-            checkpoint_size=len(blob),
-            checkpoint_checksum=wire.checksum(blob),
-            view=self.view,
-            epoch=self.epoch,
-            members=self.members,
+            checkpoint_size=size,
+            checkpoint_checksum=blob_checksum,
+            view=view,
+            epoch=epoch,
+            members=members,
         )
-        self.checkpoint_op = self.commit_min
+        self.checkpoint_op = commit_min
+        # Deliberately NOT releasing the free-set quarantine here: the
+        # flip lands at a nondeterministic WALL time, and letting it
+        # steer allocation would diverge grid layouts across replicas
+        # (beat allocation must stay a pure function of the commit
+        # stream — block-level peer repair relies on byte-identical
+        # grids).  The quarantine clears at the NEXT freeze instead
+        # (FreeSet.checkpoint replaces it), which the _ckpt_join
+        # barrier guarantees is after this flip is durable.
 
     def _grid_region_offset(self, region: int, blob_len: int) -> int:
         if self.forest is not None:

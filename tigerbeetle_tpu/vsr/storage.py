@@ -97,10 +97,25 @@ class ZoneLayout:
         return self.client_replies_offset + slot * _sectors(self.config.message_size_max)
 
 
+class FsyncCrash(RuntimeError):
+    """Seeded fault point: the process dies INSIDE an fsync — the sync
+    never completes, so nothing it would have covered may be acked
+    (MemoryStorage.crash_at_fsync; the VOPR group-commit contract
+    tests drive this)."""
+
+
 class Storage:
     """Backend interface: aligned read/write/sync."""
 
     layout: ZoneLayout
+    # Actual durability syscalls issued (one per fdatasync; the group
+    # -commit and async-checkpoint benches grade against this).
+    stat_fsyncs = 0
+    # True when write_prepare(sync=False) + a later covering
+    # sync_wal() is crash-equivalent to per-op syncs (FileStorage).
+    # The fault-injecting MemoryStorage keeps it False so seeded
+    # crash tests stay deterministic; tests opt in per-instance.
+    supports_deferred_sync = False
 
     def read(self, offset: int, size: int) -> bytes:
         raise NotImplementedError
@@ -161,6 +176,7 @@ class FileStorage(Storage):
     flushes both (checkpoint ordering barrier)."""
 
     supports_async_writeback = True  # grid writer thread (vsr/grid.py)
+    supports_deferred_sync = True    # WAL group commit (vsr/journal.py)
 
     def __init__(self, path: str, layout: ZoneLayout, create: bool = False) -> None:
         self.layout = layout
@@ -180,6 +196,14 @@ class FileStorage(Storage):
         self._grid_off = layout.grid_offset
         self._grid_dirty = False
         self._wal_dirty = False
+        # Dirty extent of the grid file since the last paced walk:
+        # sync_grid_paced must scale with bytes WRITTEN, not file size
+        # (a 32 GB mostly-clean grid must not cost 2k chunk-sleeps per
+        # checkpoint).  Plain attributes: a racing write during the
+        # walk at worst rides the next walk — durability always comes
+        # from the fdatasync that follows.
+        self._grid_ext_lo = None
+        self._grid_ext_hi = 0
         # Write-amplification accounting (bench durable config reports
         # bytes/event; reference analog: devhub's datafile-size metric,
         # src/scripts/devhub.zig:36-41).  WAL counts only the journal
@@ -189,6 +213,7 @@ class FileStorage(Storage):
         self.stat_bytes_wal = 0
         self.stat_bytes_grid = 0
         self.stat_bytes_control = 0
+        self.stat_fsyncs = 0
         self._wal_lo = layout.wal_headers_offset
         self._wal_hi = layout.wal_prepares_offset + layout.wal_prepares_size
 
@@ -213,6 +238,10 @@ class FileStorage(Storage):
         if fd == self._fd_grid:
             self._grid_dirty = True
             self.stat_bytes_grid += written
+            if self._grid_ext_lo is None or off < self._grid_ext_lo:
+                self._grid_ext_lo = off
+            if off + written > self._grid_ext_hi:
+                self._grid_ext_hi = off + written
         else:
             self._wal_dirty = True
             if self._wal_lo <= offset < self._wal_hi:
@@ -229,6 +258,7 @@ class FileStorage(Storage):
         if self._wal_dirty:
             self._wal_dirty = False
             try:
+                self.stat_fsyncs += 1
                 os.fdatasync(self._fd)
             except OSError:
                 self._wal_dirty = True
@@ -236,6 +266,7 @@ class FileStorage(Storage):
         if self._grid_dirty:
             self._grid_dirty = False
             try:
+                self.stat_fsyncs += 1
                 os.fdatasync(self._fd_grid)
             except OSError:
                 self._grid_dirty = True
@@ -245,6 +276,7 @@ class FileStorage(Storage):
         """Flush the control/WAL file only (per-op ack durability)."""
         self._wal_dirty = False
         try:
+            self.stat_fsyncs += 1
             os.fdatasync(self._fd)
         except OSError:
             self._wal_dirty = True
@@ -254,6 +286,32 @@ class FileStorage(Storage):
         if _sync_file_range is not None:
             fd, off = self._at(offset)
             _sync_file_range(fd, off, size, _SFR_WRITE)
+
+    def sync_grid_paced(self, chunk: int = 16 << 20,
+                        pause_s: float = 0.001) -> None:
+        """Push the grid file's dirty EXTENT to the device in bounded
+        chunks with yields in between, so a concurrent WAL fdatasync
+        (the ack path's per-op/per-drain sync) never queues behind one
+        monolithic grid flush — the async-checkpoint finalize calls
+        this BEFORE its covering storage.sync(), which is then left
+        with little more than metadata.  Only the range written since
+        the last walk is paced (cost scales with dirty bytes, not
+        file size).  Purely a pacing optimization: sync_file_range
+        does NOT flush the drive cache, so durability still comes
+        from the fdatasync that follows.  No-op where sync_file_range
+        is unavailable or nothing was written."""
+        lo, hi = self._grid_ext_lo, self._grid_ext_hi
+        self._grid_ext_lo, self._grid_ext_hi = None, 0
+        if _sync_file_range is None or lo is None or hi <= lo:
+            return
+        import time as _time
+
+        flags = _SFR_WAIT_BEFORE | _SFR_WRITE | _SFR_WAIT_AFTER
+        for off in range(lo, hi, chunk):
+            _sync_file_range(
+                self._fd_grid, off, min(chunk, hi - off), flags
+            )
+            _time.sleep(pause_s)
 
     def close(self) -> None:
         os.close(self._fd)
@@ -286,6 +344,11 @@ class MemoryStorage(Storage):
         self._p_lose = p_lose_unsynced
         self.reads = 0
         self.writes = 0
+        self.stat_fsyncs = 0
+        # Fault point: the Nth sync() from now RAISES FsyncCrash
+        # without persisting anything — the crash-at-fsync model the
+        # group-commit contract seeds drive (None = disabled).
+        self.crash_at_fsync: int | None = None
 
     def _read_range(self, pages: dict, offset: int, size: int) -> bytes:
         out = bytearray(size)
@@ -324,6 +387,15 @@ class MemoryStorage(Storage):
             self._dirty.add(s)
 
     def sync(self) -> None:
+        if self.crash_at_fsync is not None:
+            self.crash_at_fsync -= 1
+            if self.crash_at_fsync <= 0:
+                self.crash_at_fsync = None
+                # The sync never completed: nothing moves to the
+                # synced image, and the caller must treat the process
+                # as dead (crash() then models the power loss).
+                raise FsyncCrash("seeded crash inside fsync")
+        self.stat_fsyncs += 1
         for s in self._dirty:
             off = s * SECTOR_SIZE
             self._write_range(
