@@ -605,6 +605,23 @@ def _run_durable_once(n_events: int, ckpt_async: bool = True) -> dict:
         storage.stat_bytes_grid = 0
         storage.stat_bytes_control = 0
         storage.stat_fsyncs = 0
+        # Registry baseline for the timed window: counters delta by
+        # value, histograms by bucket counts (obs.counts_delta) —
+        # registry instruments are monotonic and never reset, and the
+        # setup phase above (incl. first-commit JIT cold starts) must
+        # not pollute the timed percentiles.
+        from tigerbeetle_tpu import obs
+
+        wal_writes_before = r.metrics.snapshot().get("journal.writes", 0)
+        h_request = r.metrics.histogram("request_us")
+        h_commit = r.metrics.histogram("commit_us")
+        request_counts_before = dict(h_request.counts)
+        commit_counts_before = dict(h_commit.counts)
+
+        def _windowed_p(hist, before, q):
+            return obs.percentile_of_counts(
+                obs.counts_delta(dict(hist.counts), before), q
+            )
         # ~5 checkpoints over the stream, min every 4 ops (small runs
         # must still exercise spill + compaction debt).
         ckpt_every = max(4, min(48, len(timed) // 3))
@@ -632,6 +649,7 @@ def _run_durable_once(n_events: int, ckpt_async: bool = True) -> dict:
         assert failed == 0, f"durable: {failed} transfers failed"
         n_timed = n_events_of(timed)
         lat_ms = np.sort(np.asarray(lat)) * 1e3
+        reg = r.metrics.snapshot()
         return {
             "events_per_sec": round(n_timed / elapsed, 1),
             "events": n_timed,
@@ -660,6 +678,36 @@ def _run_durable_once(n_events: int, ckpt_async: bool = True) -> dict:
                 float(lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.999))]), 2
             ),
             "commit_p100_ms": round(float(lat_ms[-1]), 2),
+            # Registry-sourced percentiles (obs/registry.py),
+            # WINDOWED to the timed loop via bucket-count deltas so
+            # the setup phase's cold-start outliers stay out.
+            # request_us covers the full prepare -> WAL sync ->
+            # commit chain (the registry counterpart of the
+            # driver-side commit_p* timings above, which ride along
+            # as the independent cross-check); commit_us isolates the
+            # state-machine commit stage.
+            "registry_request_p50_ms": round(
+                _windowed_p(h_request, request_counts_before, 0.5) / 1e3, 2
+            ),
+            "registry_request_p99_ms": round(
+                _windowed_p(h_request, request_counts_before, 0.99) / 1e3, 2
+            ),
+            "registry_request_p999_ms": round(
+                _windowed_p(h_request, request_counts_before, 0.999) / 1e3,
+                2,
+            ),
+            "registry_commit_p50_ms": round(
+                _windowed_p(h_commit, commit_counts_before, 0.5) / 1e3, 2
+            ),
+            "registry_commit_p99_ms": round(
+                _windowed_p(h_commit, commit_counts_before, 0.99) / 1e3, 2
+            ),
+            "registry_commit_p999_ms": round(
+                _windowed_p(h_commit, commit_counts_before, 0.999) / 1e3, 2
+            ),
+            "registry_ckpt_freeze_ms_p100": round(
+                reg.get("ckpt.freeze_us.max", 0.0) / 1e3, 2
+            ),
             "checkpoints": n_ckpt,
             "ckpt_async": ckpt_async,
             "ckpt_stall_ms_p50": round(
@@ -669,6 +717,14 @@ def _run_durable_once(n_events: int, ckpt_async: bool = True) -> dict:
                 float(max(ckpt_stall) * 1e3), 2
             ) if ckpt_stall else 0.0,
             "fsyncs": storage.stat_fsyncs,
+            # Timed-window WAL appends from the registry: the durable
+            # analog of the replicated config's scraped ratio.
+            "prepares": int(reg.get("journal.writes", 0) - wal_writes_before),
+            "fsyncs_per_prepare": round(
+                storage.stat_fsyncs
+                / max(1, reg.get("journal.writes", 0) - wal_writes_before),
+                3,
+            ),
             "spilled_rows": int(sm._store.base),
             "hot_tail_batches": sm.stat_hot_tail_batches,
             "slow_tail_batches": sm.stat_slow_tail_batches,
@@ -910,14 +966,16 @@ def _run_replicated_once(n_events: int, group_commit: bool = True) -> dict:
                 "replica_log_tails": tails,
             }
         lat_ms = np.sort(np.concatenate([np.asarray(v) for v in lat_per])) * 1e3
-        # Per-replica durability counters, harvested from the server
-        # logs' periodic TB_STATS lines (runtime/server.py): the group
-        # -commit win must be counter-verified, not claimed.
-        per_replica_stats = {}
-        for i, lp in enumerate(log_paths):
-            stats = _parse_tb_stats(lp)
-            if stats is not None:
-                per_replica_stats[f"replica{i}"] = stats
+        # Per-replica durability counters, scraped LIVE from each
+        # server's registry over the `stats` wire op (obs/scrape.py) —
+        # the TB_STATS log-tail parser survives only as the
+        # counter-verified fallback for replicas that died (a kill -9
+        # can't answer a scrape but did leave its last line behind).
+        # When both sources exist they must agree: they render the
+        # same registry.
+        per_replica_stats, scrape_extra = _harvest_replica_stats(
+            [f"127.0.0.1:{p}" for p in ports], log_paths, cluster=12
+        )
         # .get(): a replica killed mid-print can leave a truncated
         # TB_STATS line — a missing key must not void the whole run.
         fsyncs_total = sum(
@@ -936,6 +994,7 @@ def _run_replicated_once(n_events: int, group_commit: bool = True) -> dict:
             "client_sessions": n_sessions,
             "group_commit": group_commit,
             "per_replica_stats": per_replica_stats,
+            **scrape_extra,
             "fsyncs_total": fsyncs_total,
             "prepares_total": prepares_total,
             "fsyncs_per_prepare": round(
@@ -962,6 +1021,86 @@ def _run_replicated_once(n_events: int, group_commit: bool = True) -> dict:
         for log in logs:
             log.close()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _harvest_replica_stats(
+    addresses: list[str], log_paths: list[str], cluster: int,
+) -> tuple[dict, dict]:
+    """Per-replica durability counters: registry scrape first (the
+    `stats` wire op), TB_STATS log tail only as the fallback for dead
+    replicas.  When both sources are available they MUST agree on the
+    durability counters — they render the same registry; a mismatch
+    means the observability spine itself is broken, which is exactly
+    what this cross-check exists to catch.
+
+    -> (per_replica_stats, extra_keys): per-replica dicts in the
+    legacy key schema (fsyncs/prepares/gc_flushes/commit_min), plus
+    top-level bench keys (stats_source, server-side commit
+    percentiles from replica 0's scrape)."""
+    from tigerbeetle_tpu.obs.scrape import scrape_stats
+
+    per_replica: dict = {}
+    sources: dict = {}
+    extra: dict = {}
+    for i, (addr, lp) in enumerate(zip(addresses, log_paths)):
+        name = f"replica{i}"
+        snap = None
+        try:
+            snap = scrape_stats(addr, cluster, timeout_ms=10_000)
+        except (OSError, TimeoutError, ValueError):
+            snap = None  # dead replica: log tail below
+        if snap is not None:
+            stats = {
+                "fsyncs": int(snap.get("storage.fsyncs", 0)),
+                "prepares": int(snap.get("vsr.prepares_written", 0)),
+                "gc_flushes": int(snap.get("vsr.gc_flushes", 0)),
+                "commit_min": int(snap.get("vsr.commit_min", 0)),
+                "ckpt_async": int(snap.get("vsr.ckpt.async", 0)),
+            }
+            sources[name] = "scrape"
+            # Cross-check vs the log tail (same registry, two
+            # renderings).  The server prints at ~1 Hz on change, so
+            # allow it a few beats to emit the final line.
+            deadline = time.time() + 5.0
+            log_stats = _parse_tb_stats(lp)
+            while log_stats is not None and time.time() < deadline:
+                if all(
+                    log_stats.get(k, stats[k]) == stats[k]
+                    for k in ("fsyncs", "prepares", "gc_flushes")
+                ):
+                    break
+                time.sleep(1.0)
+                log_stats = _parse_tb_stats(lp)
+            if log_stats is not None:
+                mismatch = {
+                    k: (stats[k], log_stats[k])
+                    for k in ("fsyncs", "prepares", "gc_flushes")
+                    if k in log_stats and log_stats[k] != stats[k]
+                }
+                assert not mismatch, (
+                    f"{name}: scrape and TB_STATS log tail disagree "
+                    f"(scrape, log): {mismatch}"
+                )
+            if i == 0:
+                extra["server_commit_p50_ms"] = round(
+                    snap.get("vsr.commit_us.p50", 0.0) / 1e3, 2
+                )
+                extra["server_commit_p99_ms"] = round(
+                    snap.get("vsr.commit_us.p99", 0.0) / 1e3, 2
+                )
+                extra["server_commit_p999_ms"] = round(
+                    snap.get("vsr.commit_us.p999", 0.0) / 1e3, 2
+                )
+                extra["server_drain_msgs_p50"] = snap.get(
+                    "server.drain_msgs.p50", 0.0
+                )
+        else:
+            stats = _parse_tb_stats(lp)
+            sources[name] = "log_tail" if stats is not None else "missing"
+        if stats is not None:
+            per_replica[name] = stats
+    extra["stats_source"] = sources
+    return per_replica, extra
 
 
 def _parse_tb_stats(log_path: str) -> dict | None:

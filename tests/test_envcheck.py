@@ -176,3 +176,42 @@ def test_scrub_jitter_env_parses(monkeypatch):
         envcheck.env_int("TB_DEV_SCRUB_JITTER", -1, minimum=-1)
     monkeypatch.setenv("TB_DEV_SCRUB_JITTER", "17")
     assert envcheck.env_int("TB_DEV_SCRUB_JITTER", -1, minimum=-1) == 17
+
+
+def test_tb_metrics_validated(monkeypatch):
+    monkeypatch.setenv("TB_METRICS", "maybe")
+    with pytest.raises(envcheck.EnvVarError, match="TB_METRICS"):
+        envcheck.metrics_enabled()
+    monkeypatch.setenv("TB_METRICS", "2")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 1"):
+        envcheck.metrics_enabled()
+    monkeypatch.setenv("TB_METRICS", "0")
+    assert envcheck.metrics_enabled() == 0
+    monkeypatch.delenv("TB_METRICS")
+    assert envcheck.metrics_enabled() == 1  # default on
+
+
+def test_tb_trace_validated(monkeypatch):
+    monkeypatch.setenv("TB_TRACE", "perfetto")
+    with pytest.raises(
+        envcheck.EnvVarError, match="TB_TRACE.*none/json"
+    ):
+        envcheck.trace_backend()
+    monkeypatch.setenv("TB_TRACE", "json")
+    assert envcheck.trace_backend() == "json"
+    monkeypatch.delenv("TB_TRACE")
+    assert envcheck.trace_backend() == "none"  # default off
+
+
+def test_tb_metrics_disables_histograms(monkeypatch):
+    from tigerbeetle_tpu import obs
+
+    monkeypatch.setenv("TB_METRICS", "0")
+    reg = obs.Registry()
+    hist = reg.histogram("x_us")
+    hist.observe(12.0)  # no-op: nothing recorded, no clock reads
+    assert hist.count == 0 and hist.percentile(0.99) == 0.0
+    assert "x_us.count" not in reg.snapshot()
+    # Counters stay live regardless of the knob.
+    reg.counter("c").inc(3)
+    assert reg.snapshot()["c"] == 3

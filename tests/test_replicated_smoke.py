@@ -134,6 +134,33 @@ def test_two_replica_group_commit_smoke(tmp_path):
         # Both replicas committed the full stream (backup learns via
         # piggybacked commit numbers/heartbeats within a tick or two).
         assert primary.commit_min >= backup.commit_min >= 0
+
+        # Live scrape (obs/scrape.py): the `stats` wire op answers
+        # from the same registry the in-process handles feed, and the
+        # fsync/prepare counters satisfy the r10 group-commit
+        # contract — one covering sync amortized over many prepares,
+        # never an ack-relevant prepare left uncovered.
+        from tigerbeetle_tpu.obs.scrape import scrape_stats
+
+        for i, server in enumerate(servers):
+            snap = scrape_stats(addresses[i], CLUSTER, timeout_ms=20_000)
+            assert snap["replica"] == i
+            r = server.server.replica
+            # Quiescent counters must agree bit-for-bit with the
+            # in-process registry (drain histograms keep moving with
+            # heartbeats; durability counters do not).
+            assert snap["vsr.prepares_written"] == r.stat_prepares_written
+            assert snap["vsr.gc_flushes"] == r.stat_gc_flushes
+            assert snap["storage.fsyncs"] == server.server.storage.stat_fsyncs
+            assert snap["vsr.commit_min"] == r.commit_min
+            assert snap["version"] > 0
+            if i == 0:
+                assert snap["vsr.gc_flushes"] > 0
+                # r10 contract: group commit => fewer covering syncs
+                # than WAL appends once load overlaps (each flush
+                # covers a whole drain), and every sync accounted.
+                assert snap["vsr.gc_flushes"] <= snap["vsr.prepares_written"]
+                assert snap["storage.fsyncs"] > 0
     finally:
         for c in clients:
             try:

@@ -38,6 +38,9 @@ commands:
   benchmark  [--transfers=N] [--accounts=N] [--batch=N] [--addresses=...]
              [--statsd-port=N]
   bindings   [--out=<dir>]   (generate C / TypeScript / Go type bindings)
+  trace-demo [--out=<path>] [--replicas=N] [--batches=N]
+             (drive a replicated drain with tracing on and write one
+              merged Perfetto-loadable timeline)
 """
 
 
@@ -166,6 +169,23 @@ def cmd_benchmark(args: list[str]) -> None:
     print(json.dumps(result))
 
 
+def cmd_trace_demo(args: list[str]) -> None:
+    opts, _ = flags.parse(
+        args, {"out": "tb_trace_merged.json", "replicas": 2, "batches": 8}
+    )
+    from tigerbeetle_tpu.testing.cluster import trace_demo
+
+    result = trace_demo(
+        opts["out"], n_replicas=opts["replicas"], batches=opts["batches"]
+    )
+    print(json.dumps(result))
+    print(
+        f"load {opts['out']} at https://ui.perfetto.dev "
+        "(or chrome://tracing)",
+        file=sys.stderr,
+    )
+
+
 def cmd_bindings(args: list[str]) -> None:
     opts, _ = flags.parse(args, {"out": "bindings"})
     from tigerbeetle_tpu import bindings
@@ -192,6 +212,8 @@ def main(argv: list[str] | None = None) -> None:
         cmd_benchmark(rest)
     elif command == "bindings":
         cmd_bindings(rest)
+    elif command == "trace-demo":
+        cmd_trace_demo(rest)
     else:
         print(USAGE)
         flags.fatal(f"unknown command {command!r}")

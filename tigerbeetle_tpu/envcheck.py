@@ -82,3 +82,17 @@ def env_choice(name: str, default: str, choices: tuple[str, ...]) -> str:
     if raw not in choices:
         _fail(name, raw, "expected one of " + "/".join(choices))
     return raw
+
+
+def metrics_enabled() -> int:
+    """TB_METRICS: 1 (default) records latency histograms in the obs
+    registry; 0 skips the clock reads (counters stay live — logic and
+    bench accounting depend on them)."""
+    return env_int("TB_METRICS", 1, minimum=0, maximum=1)
+
+
+def trace_backend() -> str:
+    """TB_TRACE: span-tracer backend (utils/tracer.py) for processes
+    that don't pass an explicit --trace path.  `json` writes a Chrome
+    -trace file per process (TB_TRACE_PATH or tb_trace_r<i>.json)."""
+    return env_choice("TB_TRACE", "none", ("none", "json"))

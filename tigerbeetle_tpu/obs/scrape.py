@@ -1,0 +1,76 @@
+"""Live counter scrape over the wire: the `stats` admin operation.
+
+A running ReplicaServer answers `Command.request` +
+`VsrOperation.stats` directly from its registry snapshot — read-only,
+no session, no consensus (each replica reports its OWN counters, which
+is exactly what fsyncs-per-prepare accounting needs).  The reply is a
+`Command.reply` whose body is the JSON-encoded snapshot dict.
+
+bench.py's replicated config and the tier-1 TCP smoke test use this
+instead of regex-parsing TB_STATS log tails; the log-tail parser
+survives only as the counter-verified fallback for kill -9'd replicas
+(which can't answer a scrape but did leave their last line behind).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from tigerbeetle_tpu.constants import HEADER_SIZE
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.wire import Command, VsrOperation
+
+# Fixed request id for scrape matching: scrapes are sessionless
+# (client=0), so the request field is free for correlation.
+SCRAPE_REQUEST = 0x57A7
+
+
+def scrape_stats(address: str, cluster: int, timeout_ms: int = 10_000) -> dict:
+    """One registry snapshot from the replica at `address`
+    ("host:port").  Raises TimeoutError when the server never answers
+    (dead replica — callers fall back to its log tail)."""
+    from tigerbeetle_tpu.runtime.native import EV_MESSAGE, NativeBus
+
+    host, _, port = address.rpartition(":")
+    bus = NativeBus()
+    try:
+        conn = bus.connect(host or "127.0.0.1", int(port))
+        h = wire.make_header(
+            command=Command.request, operation=VsrOperation.stats,
+            cluster=cluster, request=SCRAPE_REQUEST,
+        )
+        wire.finalize_header(h, b"")
+        bus.send(conn, h.tobytes())
+        deadline = time.monotonic() + timeout_ms / 1e3
+        while time.monotonic() < deadline:
+            for ev_type, _conn, payload in bus.poll(50):
+                if ev_type != EV_MESSAGE or len(payload) < HEADER_SIZE:
+                    continue
+                header = wire.header_from_bytes(payload[:HEADER_SIZE])
+                body = payload[HEADER_SIZE:]
+                if not wire.verify_header(header, body):
+                    continue
+                if (
+                    int(header["command"]) == int(Command.reply)
+                    and int(header["operation"]) == int(VsrOperation.stats)
+                    and int(header["request"]) == SCRAPE_REQUEST
+                ):
+                    return json.loads(body.decode())
+    finally:
+        bus.close()
+    raise TimeoutError(f"stats scrape of {address} timed out")
+
+
+def stats_reply(snapshot: dict, request_header) -> tuple:
+    """Server side: (reply_header, body) answering `request_header`
+    with `snapshot` (runtime/server.py sends it on the raw conn)."""
+    body = json.dumps(snapshot, sort_keys=True).encode()
+    reply = wire.make_header(
+        command=Command.reply, operation=VsrOperation.stats,
+        cluster=wire.u128(request_header, "cluster"),
+        client=wire.u128(request_header, "client"),
+        request=int(request_header["request"]),
+    )
+    wire.finalize_header(reply, body)
+    return reply, body

@@ -13,6 +13,7 @@ tick (reference: src/message_bus.zig reconnect w/ backoff).
 
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
@@ -173,6 +174,25 @@ class ReplicaServer:
             replica_count=len(addresses) - standby_count,
             standby_count=standby_count, aof=aof,
         )
+        # Knob-controlled tracing (TB_TRACE=json): processes started
+        # without an explicit --trace path still record the span
+        # timeline, written to TB_TRACE_PATH (or tb_trace_r<i>.json)
+        # at close — per-replica files merge into one Perfetto
+        # timeline via testing/cluster.merge_traces.
+        from tigerbeetle_tpu import envcheck
+
+        if not trace_path and envcheck.trace_backend() == "json":
+            trace_path = os.environ.get(
+                "TB_TRACE_PATH", f"tb_trace_r{replica_index}.json"
+            )
+            if "{replica}" in trace_path:
+                trace_path = trace_path.format(replica=replica_index)
+            elif replica_index and os.environ.get("TB_TRACE_PATH"):
+                # One exported TB_TRACE_PATH shared by a whole cluster
+                # must not let replicas clobber each other's trace at
+                # close: non-zero indices get a suffix.
+                root, ext = os.path.splitext(trace_path)
+                trace_path = f"{root}.r{replica_index}{ext}"
         self._trace_path = trace_path
         if trace_path:
             # Chrome-trace span recording of the commit/checkpoint/
@@ -182,10 +202,43 @@ class ReplicaServer:
             self.replica.set_tracer(
                 Tracer("json", process_id=replica_index)
             )
+        # Unified registry tree (obs/registry.py): the replica's and
+        # state machine's registries graft in under "vsr."/"sm.", the
+        # storage's fsync/byte counters ride as pull gauges, and the
+        # server's own drain-loop instruments live at the top.  ONE
+        # source of truth rendered three ways: TB_STATS lines
+        # (_print_stats), the `stats` wire scrape, bench JSON.
+        from tigerbeetle_tpu import obs
+
+        self.registry = obs.Registry()
+        self.registry.attach("vsr", self.replica.metrics)
+        sm_metrics = getattr(self.replica.sm, "metrics", None)
+        if sm_metrics is not None:
+            self.registry.attach("sm", sm_metrics)
+        storage = self.storage
+        self.registry.gauge_fn("replica", lambda: replica_index)
+        self.registry.gauge_fn(
+            "storage.fsyncs", lambda: storage.stat_fsyncs
+        )
+        self.registry.gauge_fn(
+            "storage.bytes_wal", lambda: storage.stat_bytes_wal
+        )
+        self.registry.gauge_fn(
+            "storage.bytes_grid", lambda: storage.stat_bytes_grid
+        )
+        self.registry.gauge_fn(
+            "server.queue_depth", lambda: len(self.replica.request_queue)
+        )
+        # Drain-loop instruments: messages per drain, wire decode time
+        # per message, drains that hit the round bound.
+        self._h_drain = self.registry.histogram("server.drain_msgs")
+        self._h_decode = self.registry.histogram("server.decode_us")
+        self._c_drains = self.registry.counter("server.drains")
+        self._c_drain_rounds = self.registry.counter("server.drain_rounds")
         self.replica.open()
         self._last_tick = 0
         self._last_stats = 0
-        self._stats_printed: tuple | None = None
+        self._stats_snapshot: tuple | None = None
 
     @property
     def port(self) -> int:
@@ -205,6 +258,7 @@ class ReplicaServer:
         deadline_ns = self.replica.group_commit_max_us * 1_000
         drain_t0 = None
         rounds = 0
+        drained = 0
         while True:
             events = self.bus.native.poll(timeout_ms if rounds == 0 else 0)
             rounds += 1
@@ -212,6 +266,7 @@ class ReplicaServer:
                 if ev_type == EV_CLOSED:
                     self.bus.drop_conn(conn)
                 elif ev_type == EV_MESSAGE:
+                    drained += 1
                     self._on_raw_message(conn, payload)
                 if self.replica._gc_pending and drain_t0 is None:
                     drain_t0 = time.monotonic_ns()
@@ -224,6 +279,12 @@ class ReplicaServer:
                 drain_t0 = None
             if not events or rounds >= self.DRAIN_ROUNDS_MAX:
                 break
+        if drained:
+            # Drain-size distribution: how many messages one covering
+            # sync amortizes over (the group-commit win, measured).
+            self._c_drains.inc()
+            self._c_drain_rounds.inc(rounds)
+            self._h_drain.observe(drained)
         now = time.monotonic_ns()
         if now - self._last_tick >= TICK_NS:
             self._last_tick = now
@@ -239,33 +300,70 @@ class ReplicaServer:
                 self._print_stats()
         self.replica.flush_group_commit()
 
+    # TB_STATS line schema: legacy key -> registry snapshot key.  The
+    # line is a RENDERING of the registry (one source of truth with
+    # the `stats` scrape); it survives kill -9 in the log tail, which
+    # is why bench keeps a log-tail parser as fallback.
+    STATS_LINE_KEYS = (
+        ("fsyncs", "storage.fsyncs"),
+        ("prepares", "vsr.prepares_written"),
+        ("gc_flushes", "vsr.gc_flushes"),
+        ("commit_min", "vsr.commit_min"),
+        ("ckpt_async", "vsr.ckpt.async"),
+        ("commits", "vsr.commits"),
+    )
+
     def _print_stats(self) -> None:
         """One greppable counters line per second of activity on
-        stdout (the replica log): the replicated bench and the smoke
-        test harvest per-replica fsync/prepare counts from the log
-        tail — kill -9'd servers still leave their numbers behind."""
-        r = self.replica
-        stats = (
-            self.storage.stat_fsyncs, r.stat_prepares_written,
-            r.stat_gc_flushes, r.commit_min, r.stat_ckpt_async,
+        stdout (the replica log), rendered from the registry snapshot.
+        Idle-dedup compares the RENDERED values — derived from the
+        same STATS_LINE_KEYS map that prints, so a key added to the
+        line is automatically in the comparison (the old hand-picked
+        tuple silently went stale instead).  The raw snapshot version
+        deliberately stays out of the line: heartbeat decode samples
+        bump it every tick, and keying the dedup on it would grow an
+        idle cluster's log ~1 line/s forever."""
+        snap = self.registry.snapshot()
+        rendered = tuple(
+            int(snap.get(key, 0)) for _legacy, key in self.STATS_LINE_KEYS
         )
-        if stats == self._stats_printed:
+        if rendered == self._stats_snapshot:
             return  # idle: don't grow the log
-        self._stats_printed = stats
+        self._stats_snapshot = rendered
         print(
-            "TB_STATS fsyncs=%d prepares=%d gc_flushes=%d commit_min=%d "
-            "ckpt_async=%d" % stats,
+            "TB_STATS " + " ".join(
+                f"{legacy}={value}"
+                for (legacy, _key), value in zip(
+                    self.STATS_LINE_KEYS, rendered
+                )
+            ),
             flush=True,
         )
 
     def _on_raw_message(self, conn: int, payload: bytes) -> None:
         if len(payload) < HEADER_SIZE:
             return
-        header = wire.header_from_bytes(payload[:HEADER_SIZE])
-        body = payload[HEADER_SIZE:]
-        if not wire.verify_header(header, body):
+        # Wire decode cost (header cast + checksum verify) — the piece
+        # the native-ingest fast path will attack; measured per
+        # message so the bench can report µs/event honestly.
+        with self._h_decode.time():
+            header = wire.header_from_bytes(payload[:HEADER_SIZE])
+            body = payload[HEADER_SIZE:]
+            ok = wire.verify_header(header, body)
+        if not ok:
             return
         cmd = int(header["command"])
+        if cmd == int(Command.request) and (
+            int(header["operation"]) == int(wire.VsrOperation.stats)
+        ):
+            # Admin scrape (obs/scrape.py): answered from the registry
+            # snapshot right here — read-only, sessionless, and never
+            # enters the consensus pipeline.
+            from tigerbeetle_tpu.obs.scrape import stats_reply
+
+            reply, body = stats_reply(self.registry.snapshot(), header)
+            self.bus.native.send(conn, reply.tobytes() + body)
+            return
         if cmd in (Command.ping, Command.pong):
             announce = int(header["request"]) == TcpBus.ANNOUNCE_REQUEST
             self.bus.register_peer(

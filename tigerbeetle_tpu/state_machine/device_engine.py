@@ -52,8 +52,10 @@ import jax
 import jax.numpy as jnp
 
 from tigerbeetle_tpu import envcheck
+from tigerbeetle_tpu.obs import stat_property as obs_stat_property
 from tigerbeetle_tpu.state_machine import device_kernels as dk
 from tigerbeetle_tpu.types import EngineState
+from tigerbeetle_tpu.utils import tracer as tracer_mod
 
 _WINDOW = envcheck.env_int("TB_DEV_WINDOW", 96, minimum=1)
 _RING = envcheck.env_int("TB_DEV_RING", 256, minimum=2)
@@ -302,7 +304,7 @@ class DeviceEngine:
     """Authoritative device tables + windowed semantic dispatch."""
 
     def __init__(self, capacity: int, mirror, link: DeviceLink | None = None,
-                 seed: int | None = None) -> None:
+                 seed: int | None = None, metrics=None) -> None:
         self.capacity = capacity
         self.mirror = mirror  # host bookkeeping copy (recovery + parity)
         self.window = _WINDOW
@@ -330,9 +332,50 @@ class DeviceEngine:
         self._scrub_offset = (seed * 0x9E3779B9) % (cap + 1) if cap else 0
         self._last_scrub_fetch = -self._scrub_offset
         self._closed = False
+        # Metrics registry handles (obs/registry.py): the owning state
+        # machine passes a scoped view of ITS registry ("dev." prefix)
+        # so one snapshot covers the whole engine; standalone engines
+        # get a private registry.  A restore-recreated engine re-binds
+        # the same handles — counters are process-lifetime cumulative.
         # Initialized before the first _place below can retry.
-        self.stat_retries = 0
-        self.stat_link_errors = 0
+        from tigerbeetle_tpu import obs
+
+        self.metrics = metrics if metrics is not None else obs.Registry()
+        # Span/instant tracer (utils/tracer.py): NULL unless the owner
+        # shares one — demotions/re-promotions then land as instants
+        # on the merged cross-replica timeline.
+        self.tracer = tracer_mod.NULL
+        _c = self.metrics.counter
+        self._stats = {
+            "stat_retries": _c("link.retries"),
+            "stat_link_errors": _c("link.errors"),
+            "stat_semantic_events": _c("semantic_events"),
+            "stat_fallback_batches": _c("fallback_batches"),
+            "stat_fetches": _c("fetches"),
+            # Degraded-mode lifecycle (bench engine_health reports).
+            "stat_demotions": _c("demotions"),
+            "stat_repromotions": _c("repromotions"),
+            "stat_probe_failures": _c("probe_failures"),
+            "stat_degraded_events": _c("degraded_events"),
+            "stat_scrubs": _c("scrubs"),
+            "stat_scrub_heals": _c("scrub_heals"),
+            # Wave-record memory + sharded-execution forensics.
+            "stat_wave_window_bytes_peak": _c("wave.window_bytes_peak"),
+            "stat_wave_window_padded_peak": _c("wave.window_padded_peak"),
+            "stat_wave_sharded": _c("wave.sharded"),
+            # Wall-time split (seconds) for perf forensics.
+            "stat_t_h2d": _c("t.h2d_s"),
+            "stat_t_dispatch": _c("t.dispatch_s"),
+            "stat_t_fetch": _c("t.fetch_s"),
+            "stat_t_finish": _c("t.finish_s"),
+        }
+        # Per-stage crossing-latency histograms, hoisted so _retry
+        # pays one dict lookup per crossing (no string building; the
+        # shared no-op instances when TB_METRICS=0).
+        self._link_hists = {
+            stage: self.metrics.histogram(f"link.{stage}_us")
+            for stage in ("h2d", "dispatch", "fetch", "probe")
+        }
         # Multi-device: the authoritative tables shard ROW-WISE across
         # every visible device (NamedSharding over a 1-D "shard" mesh);
         # the semantic kernels then run SPMD with XLA-inserted
@@ -385,30 +428,30 @@ class DeviceEngine:
         # Degraded-mode read() cache: (mirror version, capacity) ->
         # CPU-placed (capacity, 8) table handle.
         self._degraded_cache = None
-        # Stats.
-        self.stat_semantic_events = 0
-        self.stat_fallback_batches = 0
-        self.stat_fetches = 0
-        # Link-robustness counters (bench.py reports them per config;
-        # retry/error counters live above, before the first upload).
-        self.stat_demotions = 0
-        self.stat_repromotions = 0
-        self.stat_probe_failures = 0
-        self.stat_degraded_events = 0
-        self.stat_scrubs = 0
-        self.stat_scrub_heals = 0
-        # Wave-record memory + sharded-execution forensics: peak bytes
-        # of compact pending wave records vs what the old padded event
-        # dicts would have held, and wave records executed SPMD over
-        # the row mesh (bench device_waves reports all three).
-        self.stat_wave_window_bytes_peak = 0
-        self.stat_wave_window_padded_peak = 0
-        self.stat_wave_sharded = 0
-        # Wall-time split (seconds) for perf forensics.
-        self.stat_t_h2d = 0.0
-        self.stat_t_dispatch = 0.0
-        self.stat_t_fetch = 0.0
-        self.stat_t_finish = 0.0
+    # Compatibility properties: every stat_* above reads/writes its
+    # registry handle (bench/experiment resets included).
+    stat_retries = obs_stat_property("stat_retries")
+    stat_link_errors = obs_stat_property("stat_link_errors")
+    stat_semantic_events = obs_stat_property("stat_semantic_events")
+    stat_fallback_batches = obs_stat_property("stat_fallback_batches")
+    stat_fetches = obs_stat_property("stat_fetches")
+    stat_demotions = obs_stat_property("stat_demotions")
+    stat_repromotions = obs_stat_property("stat_repromotions")
+    stat_probe_failures = obs_stat_property("stat_probe_failures")
+    stat_degraded_events = obs_stat_property("stat_degraded_events")
+    stat_scrubs = obs_stat_property("stat_scrubs")
+    stat_scrub_heals = obs_stat_property("stat_scrub_heals")
+    stat_wave_window_bytes_peak = obs_stat_property(
+        "stat_wave_window_bytes_peak"
+    )
+    stat_wave_window_padded_peak = obs_stat_property(
+        "stat_wave_window_padded_peak"
+    )
+    stat_wave_sharded = obs_stat_property("stat_wave_sharded")
+    stat_t_h2d = obs_stat_property("stat_t_h2d")
+    stat_t_dispatch = obs_stat_property("stat_t_dispatch")
+    stat_t_fetch = obs_stat_property("stat_t_fetch")
+    stat_t_finish = obs_stat_property("stat_t_finish")
 
     # ------------------------------------------------------------------
     # Link crossings: bounded retry + transient/fatal classification.
@@ -419,20 +462,26 @@ class DeviceEngine:
     def _retry(self, fn, stage: str):
         delay_s = _BACKOFF_MS / 1e3
         attempt = 0
+        # Per-stage crossing latency — handles hoisted in __init__;
+        # the no-op histogram when TB_METRICS=0 (no clock reads).
+        hist = self._link_hists.get(stage)
+        if hist is None:
+            hist = self.metrics.histogram("link." + stage + "_us")
         while True:
             try:
-                return fn()
+                with hist.time():
+                    return fn()
             except Exception as exc:  # noqa: BLE001
                 if isinstance(exc, DeviceLostError):
                     raise
-                self.stat_link_errors += 1
+                self._stats["stat_link_errors"].inc()
                 if (
                     classify_link_error(exc) != "transient"
                     or attempt >= _RETRIES
                 ):
                     raise DeviceLostError(stage, exc) from exc
                 attempt += 1
-                self.stat_retries += 1
+                self._stats["stat_retries"].inc()
                 if delay_s > 0:
                     _time.sleep(delay_s)
                 delay_s = min(delay_s * 2, _BACKOFF_CAP_MS / 1e3)
@@ -1278,6 +1327,7 @@ class DeviceEngine:
         re-promotion handshake passes."""
         self.state = EngineState.degraded
         self.stat_demotions += 1
+        self.tracer.instant("device_demoted", error=repr(exc)[:200])
         self.last_demotion = repr(exc)
         self._degraded_submits = 0
         outstanding = self._recovering + self._launched + self._pending
@@ -1364,6 +1414,7 @@ class DeviceEngine:
             return False
         self.state = EngineState.healthy
         self.stat_repromotions += 1
+        self.tracer.instant("device_repromoted")
         return True
 
     def scrub(self) -> bool:

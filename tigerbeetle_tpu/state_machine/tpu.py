@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from tigerbeetle_tpu import constants as cfg
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.lsm import pack_u128
+from tigerbeetle_tpu.obs import stat_property as obs_stat_property
 from tigerbeetle_tpu.utils import HashIndex, RunIndex
 from tigerbeetle_tpu.state_machine import kernel, kernel_fast, resolve, waves
 from tigerbeetle_tpu.state_machine.mirror import BalanceMirror, _sub_u128
@@ -355,6 +356,56 @@ class TpuStateMachine:
         self.commit_timestamp = 0
         self.pulse_next_timestamp = TIMESTAMP_MIN
 
+        # Metrics registry (obs/registry.py): every stat_* forensics
+        # counter below is a registry handle behind a compatibility
+        # property (bench resets still work), the device engine's
+        # counters graft in under "dev.", and the owning ReplicaServer
+        # attaches the whole tree under "sm." for TB_STATS lines and
+        # the `stats` wire scrape.
+        from tigerbeetle_tpu import obs
+
+        self.metrics = obs.Registry()
+        _c = self.metrics.counter
+        self._stats = {
+            # Device/host work-split accounting (reported by bench.py):
+            # events whose balance effects were admitted order-free and
+            # applied via device scatter-adds vs events resolved by the
+            # serial exact engine (host); device-SEMANTIC split (result
+            # codes computed by a device kernel) vs host.
+            "stat_device_events": _c("device_events"),
+            "stat_exact_events": _c("exact_events"),
+            "stat_host_semantic_events": _c("host_semantic_events"),
+            "stat_fallback_events": _c("fallback_events"),
+            # Vectorized order-dependent resolution (resolve.py):
+            # batches routed + fixpoint iterations spent.
+            "stat_linked_batches": _c("linked_batches"),
+            "stat_two_phase_batches": _c("two_phase_batches"),
+            "stat_resolve_iters": _c("resolve_iters"),
+            # Which bookkeeping tail ran (VERDICT r4 #4): the
+            # all-success one-C-pass hot tail is ~2x the general tail.
+            "stat_hot_tail_batches": _c("hot_tail_batches"),
+            "stat_slow_tail_batches": _c("slow_tail_batches"),
+            # Conflict-aware wave execution (waves.py) on the JAX
+            # exact path: wave batches, device-step equivalents, and
+            # the event split (waves_per_batch / wave_parallelism_pct).
+            "stat_wave_batches": _c("wave.batches"),
+            "stat_wave_steps": _c("wave.steps"),
+            "stat_wave_events": _c("wave.events"),
+            "stat_wave_parallel_events": _c("wave.parallel_events"),
+            # Device-engine wave dispatch (TB_DEV_WAVES): window
+            # batches executed as wave plans against the authoritative
+            # HBM table, declines, step equivalents, cumulative
+            # plan+admission wall time.
+            "stat_dev_wave_batches": _c("dev_wave.batches"),
+            "stat_dev_wave_declined": _c("dev_wave.declined"),
+            "stat_dev_wave_steps": _c("dev_wave.steps"),
+            "stat_dev_wave_events": _c("dev_wave.events"),
+            "stat_dev_wave_plan_s": _c("dev_wave.plan_s"),
+        }
+        # Per-batch wave plan wall time (the cumulative counter above
+        # hides the tail; the histogram is scrapeable).
+        self._h_dev_wave_plan = self.metrics.histogram("dev_wave.plan_us")
+
         # Account state. The device table is authoritative; the host
         # mirror serves routing decisions and balance reads without
         # blocking on the device link (see mirror.py / kernel_fast.py).
@@ -367,7 +418,8 @@ class TpuStateMachine:
             )
 
             self._dev = DeviceEngine(
-                account_capacity, self._mirror, link=device_link
+                account_capacity, self._mirror, link=device_link,
+                metrics=self.metrics.scope("dev"),
             )
             # Off-hot-path warmup of the named kinds' transfer plans +
             # scan compiles (bench passes these per config;
@@ -419,54 +471,36 @@ class TpuStateMachine:
         self._expiry_rows: np.ndarray | None = None
         self._exp_dead = 0
 
-        # Device/host work-split accounting (reported by bench.py):
-        # events whose balance effects were admitted order-free and
-        # applied via device scatter-adds vs events resolved by the
-        # serial exact engine (host).
-        self.stat_device_events = 0
-        self.stat_exact_events = 0
-        # Device-SEMANTIC split (VERDICT r3 #1e): events whose result
-        # codes were computed by a device kernel (the
-        # stat_device_semantic_events property) vs on the host.
-        self.stat_host_semantic_events = 0
-        self.stat_fallback_events = 0
         self._inflight_timeouts = False
-        # Vectorized order-dependent resolution (resolve.py): batches
-        # routed + fixpoint iterations spent (perf observability).
-        self.stat_linked_batches = 0
-        self.stat_two_phase_batches = 0
-        self.stat_resolve_iters = 0
-        # Which bookkeeping tail ran (VERDICT r4 #4): the all-success
-        # one-C-pass hot tail is ~2x the general tail, so bench output
-        # must show its engagement, not leave a bimodal headline
-        # unexplained.
-        self.stat_hot_tail_batches = 0
-        self.stat_slow_tail_batches = 0
-        # Conflict-aware wave execution (waves.py): batches the JAX
-        # exact path ran as wave plans instead of the B-step scan, the
-        # device-step equivalents those plans executed (1 per wave +
-        # segment length per conflict group), and the event split
-        # (bench reports waves_per_batch / wave_parallelism_pct).
-        self.stat_wave_batches = 0
-        self.stat_wave_steps = 0
-        self.stat_wave_events = 0
-        self.stat_wave_parallel_events = 0
-        # Device-engine wave dispatch (TB_DEV_WAVES): window batches
-        # that executed as wave plans against the authoritative HBM
-        # table instead of draining to the host, batches that declined
-        # (admission/profitability), their device-step equivalents,
-        # and the cumulative plan+admission wall time (bench.py's
-        # device_waves section reports all of these).
-        self.stat_dev_wave_batches = 0
-        self.stat_dev_wave_declined = 0
-        self.stat_dev_wave_steps = 0
-        self.stat_dev_wave_events = 0
-        self.stat_dev_wave_plan_s = 0.0
         # Declines by reason ("plan" = admission/profitability, "mesh"
         # = unsupported sharding geometry, "shard_plan" = plan shape
         # the SPMD executors don't cover, "degraded" = engine lost the
         # link mid-probe): measured, not guessed — bench reports it.
+        # The dict is the bench-resettable window view; cumulative
+        # per-reason registry counters ride under dev_wave.decline.*.
         self.stat_dev_wave_decline_reasons: dict = {}
+
+    # Compatibility properties: migrated stat_* counters live in the
+    # metrics registry (reads and writes route to handles, so bench's
+    # between-arm resets keep working).
+    stat_device_events = obs_stat_property("stat_device_events")
+    stat_exact_events = obs_stat_property("stat_exact_events")
+    stat_host_semantic_events = obs_stat_property("stat_host_semantic_events")
+    stat_fallback_events = obs_stat_property("stat_fallback_events")
+    stat_linked_batches = obs_stat_property("stat_linked_batches")
+    stat_two_phase_batches = obs_stat_property("stat_two_phase_batches")
+    stat_resolve_iters = obs_stat_property("stat_resolve_iters")
+    stat_hot_tail_batches = obs_stat_property("stat_hot_tail_batches")
+    stat_slow_tail_batches = obs_stat_property("stat_slow_tail_batches")
+    stat_wave_batches = obs_stat_property("stat_wave_batches")
+    stat_wave_steps = obs_stat_property("stat_wave_steps")
+    stat_wave_events = obs_stat_property("stat_wave_events")
+    stat_wave_parallel_events = obs_stat_property("stat_wave_parallel_events")
+    stat_dev_wave_batches = obs_stat_property("stat_dev_wave_batches")
+    stat_dev_wave_declined = obs_stat_property("stat_dev_wave_declined")
+    stat_dev_wave_steps = obs_stat_property("stat_dev_wave_steps")
+    stat_dev_wave_events = obs_stat_property("stat_dev_wave_events")
+    stat_dev_wave_plan_s = obs_stat_property("stat_dev_wave_plan_s")
 
     @property
     def stat_device_semantic_events(self) -> int:
@@ -1242,9 +1276,9 @@ class TpuStateMachine:
         drained up to the batch before it; mirror is current)."""
 
         def run() -> bytes:
-            self.stat_fallback_events = getattr(
-                self, "stat_fallback_events", 0
-            ) + len(input_bytes) // TRANSFER_DTYPE.itemsize
+            self._stats["stat_fallback_events"].inc(
+                len(input_bytes) // TRANSFER_DTYPE.itemsize
+            )
             self._dev._suppress_enqueue = True
             try:
                 return self._commit_create_transfers(timestamp, input_bytes)
@@ -1254,7 +1288,10 @@ class TpuStateMachine:
         return run
 
     def _dev_wave_decline(self, reason: str) -> None:
-        self.stat_dev_wave_declined += 1
+        self._stats["stat_dev_wave_declined"].inc()
+        # Cumulative per-reason registry counter (scrapeable) + the
+        # bench-resettable window dict.
+        self.metrics.counter("dev_wave.decline." + reason).inc()
         reasons = self.stat_dev_wave_decline_reasons
         reasons[reason] = reasons.get(reason, 0) + 1
 
@@ -1338,7 +1375,9 @@ class TpuStateMachine:
             d["amount_lo"], d["amount_hi"], force=(dm == "1"),
             extra_bound=dev.inflight_bound(),
         )
-        self.stat_dev_wave_plan_s += _time.perf_counter() - t0
+        plan_dt = _time.perf_counter() - t0
+        self._stats["stat_dev_wave_plan_s"].inc(plan_dt)
+        self._h_dev_wave_plan.observe(plan_dt * 1e6)
         if plan is None:
             self._dev_wave_decline("plan")
             return None, d
@@ -3656,7 +3695,8 @@ def _tpu_restore(self, data: bytes) -> None:
         )
 
         self._dev = DeviceEngine(
-            cap, self._mirror, link=self._device_link
+            cap, self._mirror, link=self._device_link,
+            metrics=self.metrics.scope("dev"),
         )
         try:
             if self._dev.state is types.EngineState.healthy:

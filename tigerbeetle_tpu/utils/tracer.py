@@ -26,13 +26,19 @@ import json
 import time
 
 
-# Event vocabulary (reference: src/tracer.zig:48-70).
+# Event vocabulary (reference: src/tracer.zig:48-70), extended with
+# the cross-replica drain timeline (prepare -> covering fsync ->
+# prepare_ok -> commit -> reply) and the server/device seams.  The
+# list is documentation — spans are keyed by name, not index.
 EVENTS = (
     "commit", "checkpoint",
     "state_machine_prefetch", "state_machine_commit", "state_machine_compact",
     "tree_compaction", "lsm_spill", "grid_read", "grid_write",
     "io_read", "io_write", "replica_on_message", "journal_write",
     "device_flush", "wal_scrub", "block_repair",
+    "prepare", "prepare_ok", "gc_covering_sync", "reply",
+    "ckpt_freeze", "ckpt_finalize", "poll_drain", "device_link",
+    "wave_dispatch",
 )
 
 BUFFER_MAX = 200_000  # events kept before oldest-first dropping
@@ -72,7 +78,11 @@ class Tracer:
     def stop(self, event: str, slot: int = 0) -> None:
         if not self.enabled:
             return
-        begin, args = self._open.pop((event, slot))
+        key = (event, slot)
+        # Unbalanced end asserts immediately (the reference's slot
+        # discipline), instead of surfacing as a bare KeyError.
+        assert key in self._open, f"span {event}[{slot}] not open"
+        begin, args = self._open.pop(key)
         now = self.clock()
         span = {
             "name": event, "ph": "X", "pid": self.process_id, "tid": slot,
@@ -134,6 +144,13 @@ class Tracer:
     def write(self, path: str) -> None:
         with open(path, "w") as f:
             f.write(self.dump())
+
+    @classmethod
+    def from_env(cls, process_id: int = 0) -> "Tracer":
+        """Backend from the TB_TRACE knob (envcheck-validated)."""
+        from tigerbeetle_tpu import envcheck
+
+        return cls(envcheck.trace_backend(), process_id=process_id)
 
 
 class _Span:

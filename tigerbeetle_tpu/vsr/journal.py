@@ -62,6 +62,20 @@ class Journal:
         from tigerbeetle_tpu.utils import tracer as tracer_mod
 
         self.tracer = tracer_mod.NULL
+        # Private default registry until the owning replica shares its
+        # own via set_metrics (standalone journals stay observable).
+        from tigerbeetle_tpu import obs
+
+        self.set_metrics(obs.Registry())
+
+    def set_metrics(self, registry) -> None:
+        """Create this journal's handles on `registry` (the owning
+        replica's, so one snapshot covers WAL write/sync latency)."""
+        self.metrics = registry
+        self._c_writes = registry.counter("journal.writes")
+        self._c_sync_batches = registry.counter("journal.sync_batches")
+        self._h_write = registry.histogram("journal.write_us")
+        self._h_sync = registry.histogram("journal.sync_us")
 
     # ------------------------------------------------------------------
 
@@ -80,7 +94,10 @@ class Journal:
         op = int(header["op"])
         slot = self.slot_for_op(op)
 
-        with self.tracer.span("journal_write", op=op, bytes=len(body)):
+        self._c_writes.inc()
+        with self.tracer.span(
+            "journal_write", op=op, bytes=len(body)
+        ), self._h_write.time():
             msg = header.tobytes() + body
             padded = msg.ljust(_sectors(len(msg)), b"\x00")
             self.storage.write(self.layout.prepare_slot_offset(slot), padded)
@@ -111,8 +128,10 @@ class Journal:
         if self.unsynced_writes == 0:
             return False
         self.unsynced_writes = 0
+        self._c_sync_batches.inc()
         try:
-            self.storage.sync_wal()
+            with self._h_sync.time():
+                self.storage.sync_wal()
         except BaseException:
             # The covering sync did not complete: everything it would
             # have covered is still unsynced (acks must stay held).

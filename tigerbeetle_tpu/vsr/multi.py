@@ -36,6 +36,7 @@ import dataclasses
 import numpy as np
 
 from tigerbeetle_tpu import constants, envcheck, types
+from tigerbeetle_tpu.obs import stat_property as obs_stat_property
 from tigerbeetle_tpu.state_machine import demuxer
 from tigerbeetle_tpu.vsr import superblock as superblock_mod
 from tigerbeetle_tpu.vsr import wire
@@ -183,10 +184,22 @@ class VsrReplica(Replica):
             self.scrubber = GridScrubber(
                 self.forest.grid, cycle_ticks=4096, blocks_per_tick_max=8
             )
+            # Scrub progress rides the registry as pull gauges: the
+            # scrubber owns its tour counters; snapshots read them.
+            scrubber = self.scrubber
+            self.metrics.gauge_fn(
+                "scrub.blocks_verified", lambda: scrubber.blocks_verified
+            )
+            self.metrics.gauge_fn("scrub.cycles", lambda: scrubber.cycles)
+            self.metrics.gauge_fn(
+                "scrub.faults_found", lambda: scrubber.faults_found
+            )
         self._blocks_missing: set[int] = set()
         self._block_repair_last = -10**9
         self._block_repair_attempt = 0
-        self.stat_blocks_repaired = 0
+        self._stats["stat_blocks_repaired"] = self.metrics.counter(
+            "blocks_repaired"
+        )
         # WAL scrubber: probes committed journal slots for latent
         # sector errors, self-healing the redundant header ring from
         # memory and fetching corrupt prepares from peers pinned by
@@ -194,7 +207,9 @@ class VsrReplica(Replica):
         self._wal_scrub_cursor = 0
         self._wal_scrub_attempt = 0
         self._wal_scrub_wanted: dict[int, int] = {}
-        self.stat_wal_scrub_repaired = 0
+        self._stats["stat_wal_scrub_repaired"] = self.metrics.counter(
+            "wal_scrub_repaired"
+        )
         # Canonical vouches: op -> checksum of the prepare the current
         # view's history assigns to that op.  The commit path executes
         # an op ONLY with a matching vouch — the parent-linkage check
@@ -281,8 +296,18 @@ class VsrReplica(Replica):
         # writes it covers.
         self._gc_sync_job = None
         self._gc_sync_cover = 0
-        self.stat_prepares_written = 0
-        self.stat_gc_flushes = 0
+        self._stats["stat_prepares_written"] = self.metrics.counter(
+            "prepares_written"
+        )
+        self._stats["stat_gc_flushes"] = self.metrics.counter("gc_flushes")
+        self._h_gc_sync = self.metrics.histogram("gc.sync_us")
+        self._c_gc_deferred_acks = self.metrics.counter("gc.deferred_acks")
+
+    # Compatibility properties over the registry handles (obs).
+    stat_blocks_repaired = obs_stat_property("stat_blocks_repaired")
+    stat_wal_scrub_repaired = obs_stat_property("stat_wal_scrub_repaired")
+    stat_prepares_written = obs_stat_property("stat_prepares_written")
+    stat_gc_flushes = obs_stat_property("stat_gc_flushes")
 
     # ------------------------------------------------------------------
 
@@ -554,6 +579,12 @@ class VsrReplica(Replica):
             self.bus.send(self.primary_index(), header, body)
             return
         operation = int(header["operation"])
+        if operation == int(VsrOperation.stats):
+            # Admin scrape: answered by the server loop from its
+            # registry snapshot (obs/scrape.py), never prepared — a
+            # stats request reaching the pipeline would hit the
+            # asserting state-machine dispatch at commit.
+            return
         if operation >= constants.VSR_OPERATIONS_RESERVED:
             # Malformed client input (unknown op byte, wrong event
             # size, over batch_max) must not reach the state machine's
@@ -982,6 +1013,7 @@ class VsrReplica(Replica):
         self._gc_send_client(client, reply, b"")
 
     def _send_reply(self, prepare: np.ndarray, reply_body: bytes) -> None:
+        self.tracer.instant("reply", op=int(prepare["op"]))
         client = wire.u128(prepare, "client")
         operation = int(prepare["operation"])
         if operation == int(VsrOperation.register):
@@ -1018,7 +1050,8 @@ class VsrReplica(Replica):
         unsynced, covered by flush_group_commit()'s one fdatasync per
         drain; a leading-edge sync is kicked onto the WAL worker so
         the disk wait overlaps the rest of the drain's commit CPU."""
-        self.stat_prepares_written += 1
+        self._stats["stat_prepares_written"].inc()
+        self.tracer.instant("prepare", op=int(header["op"]))
         if not self._gc_enabled:
             self.journal.write_prepare(header, body)
             return
@@ -1037,6 +1070,7 @@ class VsrReplica(Replica):
 
     def _gc_send(self, dst: int, header: np.ndarray, body: bytes) -> None:
         if self._gc_defer():
+            self._c_gc_deferred_acks.inc()
             self._gc_pending.append(("replica", dst, header, body))
         else:
             self.bus.send(dst, header, body)
@@ -1044,6 +1078,7 @@ class VsrReplica(Replica):
     def _gc_send_client(self, client: int, header: np.ndarray,
                         body: bytes) -> None:
         if self._gc_defer():
+            self._c_gc_deferred_acks.inc()
             self._gc_pending.append(("client", client, header, body))
         else:
             self.bus.send_client(client, header, body)
@@ -1051,17 +1086,20 @@ class VsrReplica(Replica):
     def _gc_covering_sync(self) -> None:
         """Make every deferred WAL write durable NOW (acks stay
         buffered — flush_group_commit releases them)."""
-        job, self._gc_sync_job = self._gc_sync_job, None
-        if job is not None:
-            job.result()
-            # Writes that landed after the leading-edge sync was
-            # submitted may have raced past its fdatasync: only the
-            # covered prefix is settled, the rest re-syncs below.
-            self.journal.unsynced_writes = max(
-                0, self.journal.unsynced_writes - self._gc_sync_cover
-            )
-            self._gc_sync_cover = 0
-        self.journal.sync_batch()
+        with self.tracer.span(
+            "gc_covering_sync", deferred=self.journal.unsynced_writes
+        ), self._h_gc_sync.time():
+            job, self._gc_sync_job = self._gc_sync_job, None
+            if job is not None:
+                job.result()
+                # Writes that landed after the leading-edge sync was
+                # submitted may have raced past its fdatasync: only the
+                # covered prefix is settled, the rest re-syncs below.
+                self.journal.unsynced_writes = max(
+                    0, self.journal.unsynced_writes - self._gc_sync_cover
+                )
+                self._gc_sync_cover = 0
+            self.journal.sync_batch()
 
     def flush_group_commit(self) -> None:
         """Group-commit flush point (end of a server poll drain, or
@@ -1230,6 +1268,7 @@ class VsrReplica(Replica):
             client=wire.u128(prepare, "client"),
         )
         wire.finalize_header(ok, b"")
+        self.tracer.instant("prepare_ok", op=int(prepare["op"]))
         # Routed through the group-commit gate: a prepare_ok for an op
         # whose WAL write is not yet covered by a sync must wait for
         # the flush (the durability-before-ack contract).

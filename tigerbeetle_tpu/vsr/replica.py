@@ -24,6 +24,7 @@ import numpy as np
 
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.constants import HEADER_SIZE
+from tigerbeetle_tpu.obs import stat_property
 from tigerbeetle_tpu.state_machine import demuxer
 from tigerbeetle_tpu.vsr import wire
 from tigerbeetle_tpu.vsr.journal import Journal
@@ -87,8 +88,23 @@ class Replica:
         self._ckpt_worker = None
         self._ckpt_job = None         # non-None while a flip is in flight
         self._ckpt_last_op = 0        # commit_min of the latest freeze
-        self.stat_ckpt_async = 0
-        self.stat_ckpt_sync = 0
+        # Metrics registry (obs/registry.py): every stat_* counter on
+        # this replica is a registry handle behind a compatibility
+        # property; latency histograms ride the same registry and the
+        # whole tree is scrapeable via the `stats` wire op.
+        from tigerbeetle_tpu import obs
+
+        self.metrics = obs.Registry()
+        self._stats = {
+            "stat_ckpt_async": self.metrics.counter("ckpt.async"),
+            "stat_ckpt_sync": self.metrics.counter("ckpt.sync"),
+        }
+        self._c_commits = self.metrics.counter("commits")
+        self._h_commit = self.metrics.histogram("commit_us")
+        self._h_request = self.metrics.histogram("request_us")
+        self._h_ckpt_freeze = self.metrics.histogram("ckpt.freeze_us")
+        self._h_ckpt_finalize = self.metrics.histogram("ckpt.finalize_us")
+        self.metrics.gauge_fn("commit_min", lambda: self.commit_min)
         if getattr(storage, "supports_async_writeback", False):
             import weakref
 
@@ -137,6 +153,7 @@ class Replica:
 
         self.superblock = SuperBlock(storage, cluster)
         self.journal = Journal(storage, cluster)
+        self.journal.set_metrics(self.metrics)
 
         # LSM forest over the grid zone's block region (state machines
         # that support it spill frozen state there, so checkpoints stay
@@ -169,6 +186,11 @@ class Replica:
         # pipeline honors Operation.upgrade commits).
         self.release = 1
         self.upgrade_target: int | None = None
+
+    # Compatibility: migrated stat_* counters live in the metrics
+    # registry (obs/registry.py); reads and writes route to handles.
+    stat_ckpt_async = stat_property("stat_ckpt_async")
+    stat_ckpt_sync = stat_property("stat_ckpt_sync")
 
     # ------------------------------------------------------------------
     # Open / recovery.
@@ -279,7 +301,11 @@ class Replica:
 
         if operation != types.Operation.pulse:
             self._tick_pulses()
-        reply = self._prepare_and_commit(operation, body, client, request)
+        # request_us covers the whole prepare -> WAL -> commit chain
+        # (what a single-replica client waits for); commit_us inside
+        # it isolates the state-machine commit stage.
+        with self._h_request.time():
+            reply = self._prepare_and_commit(operation, body, client, request)
         return reply
 
     def register_client(self, client: int) -> None:
@@ -378,14 +404,30 @@ class Replica:
 
     def set_tracer(self, tracer) -> None:
         """Attach a utils.tracer.Tracer to this replica's hot paths
-        (commit stages, checkpoint, journal writes)."""
+        (commit stages, checkpoint, journal writes, device engine
+        lifecycle)."""
         self.tracer = tracer
         self.journal.tracer = tracer
+        dev = getattr(self.sm, "_dev", None)
+        if dev is not None and hasattr(dev, "tracer"):
+            dev.tracer = tracer
 
     def _commit_prepare(self, header: np.ndarray, body: bytes,
                         replay: bool = False) -> bytes:
         """The commit stage chain (reference: src/vsr/replica.zig:
-        3456-3535): prefetch -> commit -> reply store."""
+        3456-3535): prefetch -> commit -> reply store.  Wrapped whole
+        in the `commit` span + commit_us histogram so per-op commit
+        latency is scrapeable (bench sources its commit percentiles
+        from this, not from re-derived timings)."""
+        with self.tracer.span(
+            "commit", op=int(header["op"])
+        ), self._h_commit.time():
+            reply = self._commit_prepare_impl(header, body, replay)
+        self._c_commits.inc()
+        return reply
+
+    def _commit_prepare_impl(self, header: np.ndarray, body: bytes,
+                             replay: bool = False) -> bytes:
         op = int(header["op"])
         operation = int(header["operation"])
         timestamp = int(header["timestamp"])
@@ -687,15 +729,18 @@ class Replica:
         if self.op > base:
             self._ckpt_interval_observed = self.op - base
         with self.tracer.span("checkpoint", op=self.commit_min):
-            args = self._checkpoint_freeze()
+            with self.tracer.span(
+                "ckpt_freeze", op=self.commit_min
+            ), self._h_ckpt_freeze.time():
+                args = self._checkpoint_freeze()
             self._ckpt_last_op = self.commit_min
             if self._ckpt_worker is not None:
-                self.stat_ckpt_async += 1
+                self._stats["stat_ckpt_async"].inc()
                 self._ckpt_job = self._ckpt_worker.submit(
                     self._checkpoint_finalize, *args
                 )
             else:
-                self.stat_ckpt_sync += 1
+                self._stats["stat_ckpt_sync"].inc()
                 self._checkpoint_finalize(*args)
 
     def _ckpt_join(self) -> None:
@@ -758,6 +803,15 @@ class Replica:
                              members) -> None:
         """Disk half (checkpoint worker in async mode): everything the
         new superblock references must be durable before the flip."""
+        with self._h_ckpt_finalize.time():
+            self._checkpoint_finalize_impl(
+                commit_min, head_checksum, offset, size, blob_checksum,
+                view, epoch, members,
+            )
+
+    def _checkpoint_finalize_impl(self, commit_min, head_checksum, offset,
+                                  size, blob_checksum, view, epoch,
+                                  members) -> None:
         if self.aof is not None:
             # The AOF is a recovery stream: make it durable at least as
             # often as checkpoints (reference: src/aof.zig fsyncs).

@@ -62,6 +62,10 @@ class VsrOperation(enum.IntEnum):
     reconfigure = 3
     pulse = 4
     upgrade = 5
+    # Admin scrape (ours): answered by the server loop directly from
+    # its obs registry snapshot — read-only, sessionless, never enters
+    # the consensus pipeline (obs/scrape.py).
+    stats = 6
 
 
 HEADER_DTYPE = np.dtype(
