@@ -1126,6 +1126,320 @@ def _parse_tb_stats(log_path: str) -> dict | None:
     return out
 
 
+def run_open_loop() -> dict:
+    """Open-loop latency-under-load grading (ROADMAP "open-loop
+    overload + multi-tenant scenario bench").
+
+    Every closed-loop config waits for the last batch before sending
+    the next, which hides queueing collapse; production traffic is
+    open-loop and bursty.  This config measures a quick closed-loop
+    capacity, then drives Poisson arrivals (plus per-second bursts at
+    BENCH_OPEN_BURST x the rate and a BENCH_OPEN_HOT_PCT hot-account
+    mix) at 50/80/95/120% of that capacity through OpenLoopSession
+    clients (many requests in flight), grading p50/p99/p999 reply
+    latency per sustained rate — the rate-vs-SLO curve — and, at 120%,
+    that admission control sheds typed busy replies while the queue
+    stays bounded (no unbounded tail growth)."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    from tigerbeetle_tpu import envcheck
+
+    phase_secs = envcheck.open_loop_secs()
+    batch = envcheck.open_loop_batch()
+    hot_pct = envcheck.open_loop_hot_pct()
+    burst = envcheck.open_loop_burst()
+    n_replicas = 2
+    n_sessions = int(os.environ.get("BENCH_OPEN_SESSIONS", 4))
+    tmp = tempfile.mkdtemp(prefix="tb_bench_open_")
+    ports = []
+    socks = []
+    for _ in range(n_replicas):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    addresses = ",".join(f"127.0.0.1:{p}" for p in ports)
+    here = os.path.dirname(os.path.abspath(__file__))
+    procs = []
+    logs = []
+    sessions = []
+    sync_clients = []
+    try:
+        for i in range(n_replicas):
+            path = os.path.join(tmp, f"0_{i}.tigerbeetle")
+            subprocess.run(
+                [
+                    sys.executable, "-m", "tigerbeetle_tpu", "format",
+                    "--cluster=13", f"--replica={i}",
+                    f"--replica-count={n_replicas}", path,
+                ],
+                check=True, capture_output=True, cwd=here, timeout=120,
+            )
+        runner = (
+            "import sys; sys.path.insert(0, {here!r})\n"
+            "from tigerbeetle_tpu.runtime.server import ReplicaServer\n"
+            "from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine\n"
+            "s = ReplicaServer({path!r}, addresses={addrs!r}.split(','),\n"
+            "    replica_index={i}, grid_size=1 << 30,\n"
+            "    state_machine_factory=lambda: TpuStateMachine(\n"
+            "        account_capacity=1 << 12,\n"
+            "        transfer_capacity=1 << 22))\n"
+            "print('listening', flush=True)\n"
+            "s.serve_forever()\n"
+        )
+        server_env = dict(os.environ)
+        server_env.setdefault("TB_ADMIT_QUEUE", "64")
+        admit_bound = int(server_env["TB_ADMIT_QUEUE"])
+        log_paths = []
+        for i in range(n_replicas):
+            path = os.path.join(tmp, f"0_{i}.tigerbeetle")
+            log_path = os.path.join(tmp, f"replica{i}.log")
+            log_paths.append(log_path)
+            log = open(log_path, "w")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [
+                    sys.executable, "-c",
+                    runner.format(here=here, path=path, addrs=addresses, i=i),
+                ],
+                stdout=log, stderr=subprocess.STDOUT, cwd=here,
+                env=server_env,
+            ))
+        deadline = time.time() + 120
+        for i, lp in enumerate(log_paths):
+            while time.time() < deadline:
+                if procs[i].poll() is not None:
+                    raise AssertionError(
+                        f"replica {i} exited rc={procs[i].returncode}:\n"
+                        + open(lp).read()[-2000:]
+                    )
+                try:
+                    if "listening" in open(lp).read():
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.5)
+            else:
+                raise AssertionError(f"replica did not start: {lp}")
+
+        from tigerbeetle_tpu.client import Client, OpenLoopSession
+        from tigerbeetle_tpu.obs.scrape import scrape_stats
+
+        n_acct = 1_000
+        n_hot = 4  # celebrity accounts taking hot_pct% of transfers
+        setup = Client(addresses, 13, timeout_ms=120_000)
+        sync_clients.append(setup)
+        ids = np.arange(1, n_acct + 1, dtype=np.uint64)
+        reply = setup._native.request(
+            Operation.create_accounts, accounts_bytes(ids), 120_000
+        )
+        assert reply == b"", "open-loop setup: account failures"
+        rng = np.random.default_rng(53)
+        tid_next = [1]
+
+        def make_body(n: int) -> bytes:
+            tids = np.arange(
+                tid_next[0], tid_next[0] + n, dtype=np.uint64
+            )
+            tid_next[0] += n
+            dr = rng.integers(n_hot + 1, n_acct + 1, n, np.uint64)
+            cr = rng.integers(n_hot + 1, n_acct + 1, n, np.uint64)
+            hot = rng.random(n) < hot_pct / 100.0
+            cr[hot] = rng.integers(1, n_hot + 1, int(hot.sum()), np.uint64)
+            same = dr == cr
+            cr[same] = dr[same] % np.uint64(n_acct) + np.uint64(1)
+            return transfers_bytes(
+                tids, dr, cr, rng.integers(1, 100, n, np.uint64)
+            )
+
+        # -- closed-loop capacity probe: two sync sessions, ~2 s ------
+        # Untimed warmup first: JIT compiles and page-cache fill must
+        # not depress the measured capacity (every open-loop rate is a
+        # fraction of it).
+        for _ in range(3):
+            setup._native.request(
+                Operation.create_transfers, make_body(batch), 120_000
+            )
+        cap_secs = float(os.environ.get("BENCH_OPEN_CAP_SECS", 2.0))
+        done = []
+        lock = threading.Lock()
+
+        def cap_drive():
+            c = Client(addresses, 13, timeout_ms=120_000)
+            sync_clients.append(c)
+            with lock:
+                body = make_body(batch)
+            t_end = time.perf_counter() + cap_secs
+            n = 0
+            while time.perf_counter() < t_end:
+                c._native.request(Operation.create_transfers, body, 120_000)
+                with lock:
+                    body = make_body(batch)
+                n += batch
+            done.append(n)
+
+        threads = [threading.Thread(target=cap_drive, daemon=True)
+                   for _ in range(2)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        capacity_eps = sum(done) / (time.perf_counter() - t0)
+
+        # -- open-loop phases -----------------------------------------
+        phases = {}
+        for frac in (0.5, 0.8, 0.95, 1.2):
+            target_eps = capacity_eps * frac
+            req_rate = max(0.5, target_eps / batch)
+            for s in sessions:
+                s.completed.clear()
+            if not sessions:
+                sessions.extend(
+                    OpenLoopSession(f"127.0.0.1:{ports[0]}", 13, 0x0BE0 + k)
+                    for k in range(n_sessions)
+                )
+            t_start = time.perf_counter()
+            t_end = t_start + phase_secs
+            next_arrival = t_start
+            next_burst = t_start + 1.0
+            next_scrape = t_start
+            sent = 0
+            queue_depth_max = 0
+            rr = 0
+            while time.perf_counter() < t_end:
+                now = time.perf_counter()
+                while next_arrival <= now:
+                    sessions[rr % n_sessions].submit(
+                        Operation.create_transfers, make_body(batch)
+                    )
+                    rr += 1
+                    sent += 1
+                    next_arrival += float(rng.exponential(1.0 / req_rate))
+                if burst > 1.0 and now >= next_burst:
+                    # Burst: 5% of a second's volume lands at once,
+                    # (burst-1)x over the Poisson baseline.
+                    next_burst += 1.0
+                    extra = int((burst - 1.0) * req_rate * 0.05)
+                    for _ in range(extra):
+                        sessions[rr % n_sessions].submit(
+                            Operation.create_transfers, make_body(batch)
+                        )
+                        rr += 1
+                        sent += 1
+                for s in sessions:
+                    s.poll(0)
+                if now >= next_scrape:
+                    next_scrape = now + 0.3
+                    try:
+                        snap = scrape_stats(
+                            f"127.0.0.1:{ports[0]}", 13, timeout_ms=5_000
+                        )
+                        queue_depth_max = max(
+                            queue_depth_max,
+                            int(snap.get("server.queue_depth", 0)),
+                        )
+                    except (OSError, TimeoutError, ValueError):
+                        pass
+                time.sleep(0.001)
+            # Grace drain: let queued work finish (bounded).
+            grace = time.perf_counter() + max(10.0, 2 * phase_secs)
+            while time.perf_counter() < grace and any(
+                s.inflight for s in sessions
+            ):
+                for s in sessions:
+                    s.poll(10)
+            elapsed = time.perf_counter() - t_start
+            lats = sorted(
+                lat for s in sessions
+                for (_r, kind, lat, _b) in s.completed if kind == "reply"
+            )
+            busy = sum(
+                1 for s in sessions
+                for (_r, kind, _l, _b) in s.completed if kind == "busy"
+            )
+            replied = len(lats)
+            unresolved = sum(len(s.inflight) for s in sessions)
+            for s in sessions:
+                s.inflight.clear()  # abandoned; report honestly
+
+            def pct(q):
+                if not lats:
+                    return None
+                return round(lats[min(len(lats) - 1,
+                                      int(q * len(lats)))] * 1e3, 2)
+
+            phases[f"{int(frac * 100)}pct"] = {
+                "offered_eps": round(target_eps, 1),
+                "achieved_eps": round(replied * batch / elapsed, 1),
+                "requests_sent": sent,
+                "requests_replied": replied,
+                "busy_replies": busy,
+                "unresolved": unresolved,
+                "p50_ms": pct(0.50),
+                "p99_ms": pct(0.99),
+                "p999_ms": pct(0.999),
+                "queue_depth_max": queue_depth_max,
+            }
+
+        # Post-run forensics from the primary's registry.
+        extra = {}
+        try:
+            snap = scrape_stats(f"127.0.0.1:{ports[0]}", 13,
+                                timeout_ms=10_000)
+            extra = {
+                "shed_total": int(snap.get("server.shed", 0)),
+                "admit_queue": int(snap.get("server.admit_queue", 0)),
+                "exemplars_scraped": len(
+                    snap.get("anatomy.exemplars", [])
+                ),
+                "anatomy_e2e_p99_ms": round(
+                    snap.get("vsr.anatomy.e2e_us.p99", 0.0) / 1e3, 2
+                ),
+            }
+        except (OSError, TimeoutError, ValueError):
+            pass
+        over = phases.get("120pct", {})
+        return {
+            "capacity_eps": round(capacity_eps, 1),
+            "batch_events": batch,
+            "hot_account_pct": hot_pct,
+            "burst_multiplier": burst,
+            "phase_secs": phase_secs,
+            "sessions": n_sessions,
+            "replicas": n_replicas,
+            "phases": phases,
+            # The overload verdict: bounded queue + visible shedding.
+            "queue_bounded_at_120": (
+                over.get("queue_depth_max", 0) <= admit_bound
+            ),
+            "host_cores": os.cpu_count(),
+            **extra,
+        }
+    finally:
+        for s in sessions:
+            try:
+                s.close()
+            except Exception:
+                pass
+        for c in sync_clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for p in procs:
+            p.kill()
+        for log in logs:
+            log.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _run_subprocess_config(flag: str, timeout_s: int | None = None) -> dict:
     """One config in a fresh subprocess; ANY failure (non-zero exit,
     timeout, unparseable output) yields an error dict, never an
@@ -1781,8 +2095,8 @@ def main() -> None:
     t_run0 = time.time()
     budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 5400))
     # memory configs + waves compare + device-waves compare + durable
-    # + replicated
-    n_configs_left = [len(CONFIGS) + 4]
+    # + replicated + open-loop
+    n_configs_left = [len(CONFIGS) + 5]
 
     def next_timeout(cap_s: float) -> int | None:
         remaining = budget_s - (time.time() - t_run0)
@@ -1885,7 +2199,8 @@ def main() -> None:
     )
 
     for cname, flag in (("durable", "--durable-only"),
-                        ("replicated", "--replicated-only")):
+                        ("replicated", "--replicated-only"),
+                        ("open_loop", "--open-loop")):
         t = next_timeout(per_config_cap)
         configs_out[cname] = (
             dict(_SKIP_ROW) if t is None
@@ -2156,6 +2471,10 @@ if __name__ == "__main__":
         print(json.dumps(_mark_device_fallback(run_durable(N_OTHER))))
     elif "--replicated-only" in sys.argv:
         print(json.dumps(_mark_device_fallback(run_replicated(N_OTHER))))
+    elif "--open-loop" in sys.argv:
+        # Open-loop arrival mode: sustained-rate-vs-SLO curves
+        # (p50/p99/p999 at 50/80/95/120% of measured capacity).
+        print(json.dumps(_mark_device_fallback(run_open_loop())))
     elif memory_only:
         print(json.dumps(_mark_device_fallback(run_memory_only(memory_only[0]))))
     else:

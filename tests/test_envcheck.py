@@ -203,6 +203,79 @@ def test_tb_trace_validated(monkeypatch):
     assert envcheck.trace_backend() == "none"  # default off
 
 
+def test_tb_trace_exemplars_validated(monkeypatch):
+    monkeypatch.setenv("TB_TRACE_EXEMPLARS", "lots")
+    with pytest.raises(envcheck.EnvVarError, match="TB_TRACE_EXEMPLARS"):
+        envcheck.trace_exemplars()
+    monkeypatch.setenv("TB_TRACE_EXEMPLARS", "0")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 1"):
+        envcheck.trace_exemplars()
+    monkeypatch.setenv("TB_TRACE_EXEMPLARS", "64")
+    assert envcheck.trace_exemplars() == 64
+    monkeypatch.delenv("TB_TRACE_EXEMPLARS")
+    assert envcheck.trace_exemplars() == 32  # default
+
+
+def test_tb_flight_ring_validated(monkeypatch):
+    monkeypatch.setenv("TB_FLIGHT_RING", "big")
+    with pytest.raises(envcheck.EnvVarError, match="TB_FLIGHT_RING"):
+        envcheck.flight_ring()
+    monkeypatch.setenv("TB_FLIGHT_RING", "0")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 1"):
+        envcheck.flight_ring()
+    monkeypatch.setenv("TB_FLIGHT_RING", "128")
+    assert envcheck.flight_ring() == 128
+    monkeypatch.delenv("TB_FLIGHT_RING")
+    assert envcheck.flight_ring() == 4096  # default
+
+
+def test_tb_admit_queue_constraint_names_pipeline(monkeypatch):
+    monkeypatch.setenv("TB_ADMIT_QUEUE", "soonish")
+    with pytest.raises(envcheck.EnvVarError, match="TB_ADMIT_QUEUE"):
+        envcheck.admit_queue(8)
+    # Constraint: queue bound >= pipeline depth, named in the error.
+    monkeypatch.setenv("TB_ADMIT_QUEUE", "4")
+    with pytest.raises(
+        envcheck.EnvVarError, match="pipeline depth \\(8\\)"
+    ):
+        envcheck.admit_queue(8)
+    assert envcheck.admit_queue(4) == 4  # boundary is legal
+    monkeypatch.setenv("TB_ADMIT_QUEUE", "16")
+    assert envcheck.admit_queue(8) == 16
+    monkeypatch.delenv("TB_ADMIT_QUEUE")
+    assert envcheck.admit_queue(8) == 1024  # default
+
+
+def test_open_loop_bench_envs_validated(monkeypatch):
+    monkeypatch.setenv("BENCH_OPEN_SECS", "fast")
+    with pytest.raises(envcheck.EnvVarError, match="BENCH_OPEN_SECS"):
+        envcheck.open_loop_secs()
+    monkeypatch.setenv("BENCH_OPEN_SECS", "0.01")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0.1"):
+        envcheck.open_loop_secs()
+    monkeypatch.delenv("BENCH_OPEN_SECS")
+    assert envcheck.open_loop_secs() == 4.0
+
+    monkeypatch.setenv("BENCH_OPEN_BATCH", "9000")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 8190"):
+        envcheck.open_loop_batch()
+    monkeypatch.delenv("BENCH_OPEN_BATCH")
+    assert envcheck.open_loop_batch() == 256
+
+    monkeypatch.setenv("BENCH_OPEN_HOT_PCT", "150")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 100"):
+        envcheck.open_loop_hot_pct()
+    monkeypatch.setenv("BENCH_OPEN_HOT_PCT", "35")
+    assert envcheck.open_loop_hot_pct() == 35.0
+    monkeypatch.delenv("BENCH_OPEN_HOT_PCT")
+
+    monkeypatch.setenv("BENCH_OPEN_BURST", "0.5")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 1"):
+        envcheck.open_loop_burst()
+    monkeypatch.delenv("BENCH_OPEN_BURST")
+    assert envcheck.open_loop_burst() == 4.0
+
+
 def test_tb_metrics_disables_histograms(monkeypatch):
     from tigerbeetle_tpu import obs
 

@@ -341,6 +341,158 @@ def test_trace_demo_produces_cross_replica_drain(tmp_path):
     assert {e["pid"] for e in data["traceEvents"]} == {0, 1}
 
 
+def test_merge_traces_skips_bad_files_and_warns(tmp_path):
+    """Empty, truncated, missing, and non-object inputs are skipped
+    with a warning + otherData.skipped entry; the survivors still
+    merge (a replica killed mid-dump must not void the postmortem)."""
+    from tigerbeetle_tpu.testing.cluster import merge_traces
+
+    good = tmp_path / "good.json"
+    t = Tracer("json")
+    t.instant("commit", op=1)
+    t.write(str(good))
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    truncated = tmp_path / "truncated.json"
+    truncated.write_text('{"traceEvents": [{"name": "comm')
+    notdict = tmp_path / "notdict.json"
+    notdict.write_text("[1, 2, 3]")
+    missing = tmp_path / "missing.json"
+
+    with pytest.warns(UserWarning, match="merge_traces: skipping"):
+        merged = merge_traces(
+            [str(empty), str(good), str(truncated), str(missing),
+             str(notdict)],
+            str(tmp_path / "merged.json"),
+        )
+    names = [e["name"] for e in merged["traceEvents"]]
+    assert "commit" in names  # the good file survived
+    skipped = merged["otherData"]["skipped"]
+    assert {s["label"] for s in skipped} == {
+        "replica0", "replica2", "replica3", "replica4"
+    }
+    # The written file parses and matches.
+    assert json.load(open(tmp_path / "merged.json")) == merged
+
+
+def test_merge_traces_many_replicas(tmp_path):
+    """>2-replica merges keep every input on its own re-keyed track."""
+    from tigerbeetle_tpu.testing.cluster import merge_traces
+
+    paths = []
+    for i in range(5):
+        t = Tracer("json", process_id=0)
+        t.instant("prepare", op=i)
+        p = tmp_path / f"r{i}.json"
+        t.write(str(p))
+        paths.append(str(p))
+    merged = merge_traces(paths)
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {0, 1, 2, 3, 4}
+    meta = [e for e in merged["traceEvents"] if e.get("ph") == "M"]
+    assert len(meta) == 5
+    assert "skipped" not in merged["otherData"]
+
+
+def test_stats_scrape_monotonic_under_concurrent_load(tmp_path):
+    """Scrape while drains are mid-flight: counters in successive
+    snapshots never decrease, the version strictly increases whenever
+    values change, and the exemplar ring honors its bound —
+    concurrency must not tear the snapshot."""
+    import socket
+    import threading
+
+    from tigerbeetle_tpu import constants as cfg
+    from tigerbeetle_tpu.client import Client
+    from tigerbeetle_tpu.obs.scrape import scrape_stats
+    from tigerbeetle_tpu.runtime.native import native_available
+    from tigerbeetle_tpu.runtime.server import (
+        ReplicaServer,
+        format_data_file,
+    )
+    from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+    if not native_available():
+        pytest.skip("native runtime not built")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    address = f"127.0.0.1:{port}"
+    path = str(tmp_path / "r0.tb")
+    format_data_file(path, cluster=17, config=cfg.TEST_MIN)
+    server = ReplicaServer(
+        path, cluster=17, addresses=[address], replica_index=0,
+        state_machine_factory=lambda: CpuStateMachine(cfg.TEST_MIN),
+        config=cfg.TEST_MIN,
+    )
+    stop = threading.Event()
+    loop = threading.Thread(
+        target=lambda: [server.poll_once(1) for _ in iter(
+            lambda: not stop.is_set(), False
+        )],
+        daemon=True,
+    )
+    loop.start()
+    client = None
+    try:
+        client = Client(address, 17, client_id=91, timeout_ms=30_000)
+        assert client.create_accounts(
+            [{"id": 1, "ledger": 1, "code": 1},
+             {"id": 2, "ledger": 1, "code": 1}]
+        ) == []
+        errors = []
+
+        def drive():
+            try:
+                for k in range(60):
+                    client.create_transfers([
+                        {"id": 1000 + k, "debit_account_id": 1,
+                         "credit_account_id": 2, "amount": 1,
+                         "ledger": 1, "code": 1}
+                    ])
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+
+        driver = threading.Thread(target=drive, daemon=True)
+        driver.start()
+        ring = server.replica.anatomy.exemplar_ring
+        prev = None
+        scrapes = 0
+        while driver.is_alive() or scrapes < 3:
+            snap = scrape_stats(address, 17, timeout_ms=10_000)
+            scrapes += 1
+            assert len(snap["anatomy.exemplars"]) <= ring
+            if prev is not None:
+                for key, value in snap.items():
+                    if ".p" in key or key in (
+                        "server.queue_depth", "vsr.anatomy.open",
+                        "anatomy.exemplars",
+                    ):
+                        continue  # gauges/percentiles move both ways
+                    if key in prev and isinstance(value, (int, float)):
+                        assert value >= prev[key] - 1e-9, (
+                            key, prev[key], value
+                        )
+                if {k: v for k, v in snap.items()
+                        if k != "anatomy.exemplars"} != {
+                            k: v for k, v in prev.items()
+                            if k != "anatomy.exemplars"}:
+                    assert snap["version"] >= prev["version"]
+            prev = snap
+            if not driver.is_alive() and scrapes >= 3:
+                break
+        driver.join(timeout=30)
+        assert errors == [], errors
+        assert prev["vsr.commits"] >= 60
+    finally:
+        stop.set()
+        loop.join(timeout=5)
+        if client is not None:
+            client.close()
+        server.close()
+
+
 def test_stats_reply_roundtrips_snapshot():
     from tigerbeetle_tpu.obs.scrape import SCRAPE_REQUEST, stats_reply
     from tigerbeetle_tpu.vsr import wire

@@ -11,6 +11,8 @@ device" path.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from tigerbeetle_tpu import types
@@ -141,3 +143,116 @@ class Client:
             self.timeout_ms,
         )
         return np.frombuffer(reply, ACCOUNT_BALANCE_DTYPE)
+
+
+class OpenLoopSession:
+    """Open-loop wire client: MANY requests in flight on one session.
+
+    The synchronous `Client` is closed-loop (one request blocks until
+    its reply) — it cannot generate the arrival pressure production
+    traffic has.  This client submits without waiting: `submit()`
+    stamps a wire trace context (trace_id + origin CLOCK_MONOTONIC ns
+    + sampled flag, vsr/wire.py) and returns immediately; `poll()`
+    drains completions — `reply` (committed) or `busy` (typed
+    admission shed, Command.client_busy) — each with client-measured
+    latency.  bench.py's --open-loop mode and the overload smoke test
+    drive it.
+    """
+
+    def __init__(self, address: str, cluster: int, client_id: int, *,
+                 register_timeout_ms: int = 30_000) -> None:
+        from tigerbeetle_tpu.constants import HEADER_SIZE
+        from tigerbeetle_tpu.runtime.native import EV_MESSAGE, NativeBus
+        from tigerbeetle_tpu.vsr import wire
+
+        self._wire = wire
+        self._hs = HEADER_SIZE
+        self._ev_message = EV_MESSAGE
+        self.cluster = cluster
+        self.id = client_id
+        self.request_number = 0
+        # request number -> submit perf_counter_ns (open completions).
+        self.inflight: dict[int, int] = {}
+        # (request_number, kind "reply"|"busy", latency_s, reply_body).
+        self.completed: list[tuple[int, str, float, bytes]] = []
+        self.busy_replies = 0
+        host, _, port = address.rpartition(":")
+        self.bus = NativeBus()
+        self.conn = self.bus.connect(host or "127.0.0.1", int(port))
+        self._register(register_timeout_ms)
+
+    def _register(self, timeout_ms: int) -> None:
+        wire = self._wire
+        h = wire.make_header(
+            command=wire.Command.request,
+            operation=wire.VsrOperation.register,
+            cluster=self.cluster, client=self.id, request=0,
+        )
+        wire.finalize_header(h, b"")
+        deadline = time.monotonic() + timeout_ms / 1e3
+        last_sent = 0.0
+        while time.monotonic() < deadline:
+            if time.monotonic() - last_sent >= 1.0:
+                last_sent = time.monotonic()
+                self.bus.send(self.conn, h.tobytes())
+            for ev_type, _conn, payload in self.bus.poll(50):
+                if ev_type != self._ev_message or len(payload) < self._hs:
+                    continue
+                rh = wire.header_from_bytes(payload[: self._hs])
+                if not wire.verify_header(rh, payload[self._hs:]):
+                    continue
+                if int(rh["command"]) == int(wire.Command.reply) and (
+                    int(rh["operation"]) == int(wire.VsrOperation.register)
+                ):
+                    return
+        raise TimeoutError(f"open-loop register of client {self.id:#x}")
+
+    def submit(self, operation, body: bytes) -> int:
+        """Fire one request (no waiting).  Returns its request number;
+        the completion arrives via poll()."""
+        wire = self._wire
+        self.request_number += 1
+        now = time.perf_counter_ns()
+        h = wire.make_header(
+            command=wire.Command.request, operation=operation,
+            cluster=self.cluster, client=self.id,
+            request=self.request_number,
+            trace_id=((self.id << 20) ^ self.request_number)
+            & 0xFFFFFFFFFFFFFFFF,
+            trace_ts=now,
+            trace_flags=wire.TRACE_SAMPLED,
+        )
+        wire.finalize_header(h, body)
+        self.inflight[self.request_number] = now
+        self.bus.send(self.conn, h.tobytes() + body)
+        return self.request_number
+
+    def poll(self, timeout_ms: int = 0) -> None:
+        """Drain completions into `self.completed`."""
+        wire = self._wire
+        for ev_type, _conn, payload in self.bus.poll(timeout_ms):
+            if ev_type != self._ev_message or len(payload) < self._hs:
+                continue
+            h = wire.header_from_bytes(payload[: self._hs])
+            body = payload[self._hs:]
+            if not wire.verify_header(h, body):
+                continue
+            cmd = int(h["command"])
+            req = int(h["request"])
+            t0 = self.inflight.get(req)
+            if cmd == int(wire.Command.client_busy):
+                if t0 is not None:
+                    del self.inflight[req]
+                    lat = (time.perf_counter_ns() - t0) / 1e9
+                    self.busy_replies += 1
+                    self.completed.append((req, "busy", lat, b""))
+            elif cmd == int(wire.Command.reply):
+                if t0 is not None:
+                    del self.inflight[req]
+                    lat = (time.perf_counter_ns() - t0) / 1e9
+                    self.completed.append((req, "reply", lat, bytes(body)))
+            elif cmd == int(wire.Command.eviction):
+                raise RuntimeError(f"open-loop client {self.id:#x} evicted")
+
+    def close(self) -> None:
+        self.bus.close()

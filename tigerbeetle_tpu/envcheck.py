@@ -96,3 +96,63 @@ def trace_backend() -> str:
     that don't pass an explicit --trace path.  `json` writes a Chrome
     -trace file per process (TB_TRACE_PATH or tb_trace_r<i>.json)."""
     return env_choice("TB_TRACE", "none", ("none", "json"))
+
+
+def trace_exemplars() -> int:
+    """TB_TRACE_EXEMPLARS: tail-exemplar ring size (obs/anatomy.py) —
+    how many slow-request stage timelines each replica retains for the
+    `stats` scrape.  Must be > 0 (the recorder is disabled via
+    TB_METRICS=0, not by an empty ring)."""
+    return env_int("TB_TRACE_EXEMPLARS", 32, minimum=1, maximum=1 << 16)
+
+
+def flight_ring() -> int:
+    """TB_FLIGHT_RING: flight-recorder ring capacity (obs/flight.py) —
+    recent trace events kept in memory per replica for the postmortem
+    dump.  Must be > 0."""
+    return env_int("TB_FLIGHT_RING", 4096, minimum=1, maximum=1 << 22)
+
+
+def admit_queue(pipeline_depth: int) -> int:
+    """TB_ADMIT_QUEUE: bound on the primary's client-request queue
+    (runtime/server.py admission control).  Requests beyond it are
+    shed with a typed Command.client_busy instead of growing the tail
+    unboundedly.  Must be >= the prepare pipeline depth — a smaller
+    bound would shed requests the pipeline could already hold."""
+    value = env_int("TB_ADMIT_QUEUE", 1024, minimum=1)
+    if value < pipeline_depth:
+        _fail(
+            "TB_ADMIT_QUEUE", str(value),
+            f"must be >= pipeline depth ({pipeline_depth}) — a smaller "
+            "queue sheds requests the prepare pipeline could hold",
+        )
+    return value
+
+
+def open_loop_secs() -> float:
+    """BENCH_OPEN_SECS: seconds per open-loop bench phase."""
+    return env_float("BENCH_OPEN_SECS", 4.0, minimum=0.1)
+
+
+def open_loop_batch() -> int:
+    """BENCH_OPEN_BATCH: transfers per open-loop request (small
+    batches make queueing dynamics visible; the closed-loop bench's
+    8190-event batches would hide them)."""
+    return env_int("BENCH_OPEN_BATCH", 256, minimum=1, maximum=8190)
+
+
+def open_loop_hot_pct() -> float:
+    """BENCH_OPEN_HOT_PCT: percentage of open-loop transfers that hit
+    one of the few hot (celebrity) accounts — the multi-tenant
+    contention mix."""
+    raw = env_float("BENCH_OPEN_HOT_PCT", 20.0, minimum=0.0)
+    if raw > 100.0:
+        _fail("BENCH_OPEN_HOT_PCT", str(raw), "must be <= 100")
+    return raw
+
+
+def open_loop_burst() -> float:
+    """BENCH_OPEN_BURST: burstiness multiplier — arrivals are Poisson
+    at the phase rate, with periodic bursts at `burst`x the rate.
+    1.0 = pure Poisson."""
+    return env_float("BENCH_OPEN_BURST", 4.0, minimum=1.0)

@@ -194,14 +194,36 @@ class ReplicaServer:
                 root, ext = os.path.splitext(trace_path)
                 trace_path = f"{root}.r{replica_index}{ext}"
         self._trace_path = trace_path
-        if trace_path:
-            # Chrome-trace span recording of the commit/checkpoint/
-            # journal hot paths (utils/tracer.py; written at close).
-            from tigerbeetle_tpu.utils.tracer import Tracer
+        # Flight recorder (obs/flight.py): always-on bounded ring of
+        # recent trace events, dumped on demotion / assertion failure /
+        # SIGTERM for postmortems — no file I/O until then.
+        from tigerbeetle_tpu.obs.flight import FlightRecorder
 
-            self.replica.set_tracer(
-                Tracer("json", process_id=replica_index)
-            )
+        flight_path = os.environ.get(
+            "TB_FLIGHT_PATH", f"tb_flight_r{replica_index}.json"
+        )
+        if "{replica}" in flight_path:
+            flight_path = flight_path.format(replica=replica_index)
+        elif replica_index and os.environ.get("TB_FLIGHT_PATH"):
+            root, ext = os.path.splitext(flight_path)
+            flight_path = f"{root}.r{replica_index}{ext}"
+        self._flight_path = flight_path
+        self.flight = FlightRecorder(
+            process_id=replica_index, dump_path=flight_path
+        )
+        # The tracer now exists unconditionally: backend "json" only
+        # when a trace path is configured (spans cost nothing on
+        # "none"), but its instants ALWAYS mirror into the flight ring
+        # — so demotions/view changes are in the postmortem dump even
+        # with full tracing off (utils/tracer.py).
+        from tigerbeetle_tpu.utils.tracer import Tracer
+
+        tracer = Tracer(
+            "json" if trace_path else "none", process_id=replica_index
+        )
+        tracer.flight = self.flight
+        self.replica.set_tracer(tracer)
+        self.replica.anatomy.flight = self.flight
         # Unified registry tree (obs/registry.py): the replica's and
         # state machine's registries graft in under "vsr."/"sm.", the
         # storage's fsync/byte counters ride as pull gauges, and the
@@ -235,6 +257,20 @@ class ReplicaServer:
         self._h_decode = self.registry.histogram("server.decode_us")
         self._c_drains = self.registry.counter("server.drains")
         self._c_drain_rounds = self.registry.counter("server.drain_rounds")
+        # Admission control: fresh requests beyond TB_ADMIT_QUEUE
+        # queued requests are shed with a typed Command.client_busy —
+        # overload degrades visibly (shed counter, bounded queue)
+        # instead of growing the tail unboundedly.  The bound lives in
+        # the REPLICA's enqueue path, below the at-most-once gate, so
+        # a retransmission of a committed request still gets its
+        # stored reply under overload (never a busy).
+        self.admit_queue = envcheck.admit_queue(
+            config.pipeline_prepare_queue_max
+        )
+        self.registry.gauge_fn("server.admit_queue", lambda: self.admit_queue)
+        self._c_shed = self.registry.counter("server.shed")
+        self.replica.admit_queue = self.admit_queue
+        self.replica.on_shed = self._on_shed
         self.replica.open()
         self._last_tick = 0
         self._last_stats = 0
@@ -358,10 +394,16 @@ class ReplicaServer:
         ):
             # Admin scrape (obs/scrape.py): answered from the registry
             # snapshot right here — read-only, sessionless, and never
-            # enters the consensus pipeline.
+            # enters the consensus pipeline.  Tail exemplars (the slow
+            # requests' stage timelines) ride along as a structured
+            # key next to the flat counters.
             from tigerbeetle_tpu.obs.scrape import stats_reply
 
-            reply, body = stats_reply(self.registry.snapshot(), header)
+            snap = self.registry.snapshot()
+            snap["anatomy.exemplars"] = (
+                self.replica.anatomy.exemplar_snapshot()
+            )
+            reply, body = stats_reply(snap, header)
             self.bus.native.send(conn, reply.tobytes() + body)
             return
         if cmd in (Command.ping, Command.pong):
@@ -387,6 +429,13 @@ class ReplicaServer:
             self.replica.on_message(header, body)
             return
         if cmd == Command.request:
+            # Ingress stage for sampled requests (trace context is
+            # CLIENT-owned: the server never mints one — a minted id
+            # would alter prepare checksums and break the recorded
+            # wire contract for legacy clients; unsampled requests
+            # stay byte-identical end to end).  Admission shedding
+            # happens in the replica's enqueue path, AFTER dedupe.
+            self.replica.anatomy.stage_h(header, "ingress")
             self.bus.register_client(conn, wire.u128(header, "client"))
         elif int(header["replica"]) != self.replica.replica:
             # Learn peer identity from any replica-sourced message.
@@ -397,9 +446,45 @@ class ReplicaServer:
                 self.bus.register_peer(conn, int(header["replica"]))
         self.replica.on_message(header, body)
 
+    def _on_shed(self, header) -> None:
+        """Replica shed callback: count + flight-note (the replica
+        already sent the typed busy on the client's connection)."""
+        self._c_shed.inc()
+        self.flight.note(
+            "shed", client=wire.u128(header, "client"),
+            request=int(header["request"]),
+            queue=len(self.replica.request_queue),
+        )
+
+    def install_flight_handlers(self) -> None:
+        """Dump the flight ring on SIGTERM, then die with the default
+        disposition (exit code intact for supervisors).  Main-thread
+        only — in-process test servers (threaded loops) skip it."""
+        import signal
+
+        def on_sigterm(signum, frame):
+            try:
+                self.flight.write(self._flight_path, reason="sigterm")
+            finally:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        try:
+            signal.signal(signal.SIGTERM, on_sigterm)
+        except ValueError:
+            pass  # not the main thread: no signal-based dump
+
     def serve_forever(self) -> None:
+        self.install_flight_handlers()
         while True:
-            self.poll_once()
+            try:
+                self.poll_once()
+            except AssertionError as exc:
+                # Invariant violation: capture the last moments before
+                # the crash.  The `assertion_failure` event is a flight
+                # trigger, so note() flushes the ring to disk.
+                self.flight.note("assertion_failure", error=repr(exc)[:500])
+                raise
 
     def close(self) -> None:
         # Device-engine end-of-life barrier first: every outstanding

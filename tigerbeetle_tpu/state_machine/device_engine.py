@@ -376,6 +376,14 @@ class DeviceEngine:
             stage: self.metrics.histogram(f"link.{stage}_us")
             for stage in ("h2d", "dispatch", "fetch", "probe")
         }
+        # Cadence first-guesses as pull gauges + measured per-scrub
+        # cost (ROADMAP "scrub/probe cadence tuning" carry-over): the
+        # next real-link session reads the actual digest-compare cost
+        # out of the same scrape that shows the cadence it ran at,
+        # instead of re-deriving both from guesses.
+        self.metrics.gauge_fn("scrub.every", lambda: _SCRUB_EVERY)
+        self.metrics.gauge_fn("probe.every", lambda: _PROBE_EVERY)
+        self._h_scrub_cost = self.metrics.histogram("scrub.cost_us")
         # Multi-device: the authoritative tables shard ROW-WISE across
         # every visible device (NamedSharding over a 1-D "shard" mesh);
         # the semantic kernels then run SPMD with XLA-inserted
@@ -1430,11 +1438,18 @@ class DeviceEngine:
             return True
         self._last_scrub_fetch = self.stat_fetches
         self.stat_scrubs += 1
-        if (self._device_health_digest() == self._host_health_digest()).all():
-            return True
-        self.stat_scrub_heals += 1
-        self._upload_from_mirror()
-        self.meta = self._place(jnp.asarray(self._meta_host))
+        with self._h_scrub_cost.time():
+            clean = bool(
+                (
+                    self._device_health_digest()
+                    == self._host_health_digest()
+                ).all()
+            )
+            if clean:
+                return True
+            self.stat_scrub_heals += 1
+            self._upload_from_mirror()
+            self.meta = self._place(jnp.asarray(self._meta_host))
         return False
 
     # ------------------------------------------------------------------

@@ -405,6 +405,14 @@ class TpuStateMachine:
         # Per-batch wave plan wall time (the cumulative counter above
         # hides the tail; the histogram is scrapeable).
         self._h_dev_wave_plan = self.metrics.histogram("dev_wave.plan_us")
+        # Per-request anatomy hook (obs/anatomy.py): the owning
+        # Replica shares its recorder and stamps the current prepare's
+        # trace id before each commit, so commit_async can attribute
+        # the device-window dispatch hop to the request's timeline.
+        from tigerbeetle_tpu.obs import anatomy as anatomy_mod
+
+        self.anatomy = anatomy_mod.NULL
+        self.anatomy_trace = 0
 
         # Account state. The device table is authoritative; the host
         # mirror serves routing decisions and balance reads without
@@ -754,6 +762,10 @@ class TpuStateMachine:
         assert op != 0
         assert self.input_valid(operation, input_bytes)
         assert timestamp > self.commit_timestamp
+        if self.anatomy_trace:
+            # The request's device-window hop: when its batch was
+            # handed to the engine (window admit / host dispatch).
+            self.anatomy.stage(self.anatomy_trace, "device_dispatch")
         if self.engine == "device":
             # Lifecycle tick on EVERY committed operation (not just
             # transfers): re-promotion probes while degraded must fire

@@ -128,6 +128,7 @@ class SimClient:
         self.reply: bytes | None = None
         self.registered = False
         self.evicted = False
+        self.busy_replies = 0  # typed admission sheds received
         self._inflight: tuple[np.ndarray, bytes] | None = None
         self._last_sent = -(10**9)
         self.replies: list[bytes] = []
@@ -138,6 +139,11 @@ class SimClient:
         if not wire.verify_header(header, body):
             return
         cmd = Command(int(header["command"]))
+        if cmd == Command.client_busy:
+            # Typed admission shed: NOT fatal — the request was never
+            # admitted; the retransmission cadence retries it.
+            self.busy_replies += 1
+            return
         if cmd == Command.eviction:
             # Fatal for the session (reference clients surface this as
             # a terminal error); recorded, not raised, so a multi-client
@@ -183,10 +189,20 @@ class SimClient:
     def request(self, operation: types.Operation, body: bytes) -> None:
         assert self.registered and not self.busy()
         self.request_number += 1
+        import time as _time
+
         h = wire.make_header(
             command=Command.request, operation=operation,
             cluster=self.cluster.cluster_id, client=self.id,
             request=self.request_number,
+            # Wire trace context from client submit: the id is a
+            # deterministic function of (client, request) so seeded
+            # runs stay reproducible; the origin timestamp is real
+            # CLOCK_MONOTONIC — observability only, never state.
+            trace_id=((self.id << 20) ^ self.request_number)
+            & 0xFFFFFFFFFFFFFFFF,
+            trace_ts=_time.perf_counter_ns(),
+            trace_flags=wire.TRACE_SAMPLED,
         )
         wire.finalize_header(h, body)
         self.reply = None
@@ -444,19 +460,47 @@ def merge_traces(trace_paths, out_path: str | None = None,
     all processes on one host — merging traces from different hosts
     would need an offset pass (the vsr/clock.py sync could provide
     one; not needed for single-box clusters).
+
+    Robustness: a missing, empty, truncated, or otherwise unparseable
+    per-replica file (a replica killed mid-dump is the common case) is
+    SKIPPED with a warning and listed under otherData.skipped — one
+    bad file must not void a postmortem merge of the survivors.  Any
+    number of inputs merges (>2-replica clusters, flight dumps mixed
+    with live tracer dumps).
     """
     import json as _json
+    import warnings
 
     merged_events: list[dict] = []
     dropped_total = 0
+    skipped: list[dict] = []
     for i, path in enumerate(trace_paths):
-        with open(path) as f:
-            data = _json.load(f)
         label = labels[i] if labels else f"replica{i}"
+        try:
+            with open(path) as f:
+                data = _json.load(f)
+            if not isinstance(data, dict):
+                raise ValueError(f"expected a trace object, got "
+                                 f"{type(data).__name__}")
+            events = data.get("traceEvents", ())
+            if not isinstance(events, list):
+                raise ValueError("traceEvents is not a list")
+        except (OSError, ValueError) as exc:
+            # ValueError covers json.JSONDecodeError (its subclass):
+            # empty and truncated files land here too.
+            warnings.warn(
+                f"merge_traces: skipping {label} ({path}): {exc}",
+                stacklevel=2,
+            )
+            skipped.append({"label": label, "path": str(path),
+                            "error": str(exc)})
+            continue
         # Re-key pid per input file: every tracer defaults its own
         # process_id, and two replicas that both said pid=0 would
         # otherwise collapse onto one track.
-        for ev in data.get("traceEvents", ()):
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
             ev = dict(ev)
             ev["pid"] = i
             merged_events.append(ev)
@@ -466,13 +510,18 @@ def merge_traces(trace_paths, out_path: str | None = None,
                 "args": {"name": label},
             }
         )
-        dropped_total += int(
-            data.get("otherData", {}).get("dropped_events", 0)
-        )
+        other = data.get("otherData", {})
+        if isinstance(other, dict):
+            try:
+                dropped_total += int(other.get("dropped_events", 0))
+            except (TypeError, ValueError):
+                pass
     merged = {
         "traceEvents": merged_events,
         "otherData": {"dropped_events": dropped_total},
     }
+    if skipped:
+        merged["otherData"]["skipped"] = skipped
     if out_path:
         with open(out_path, "w") as f:
             _json.dump(merged, f)

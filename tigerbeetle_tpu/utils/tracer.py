@@ -54,6 +54,11 @@ class Tracer:
         self.process_id = process_id
         self.clock = clock
         self.buffer_max = buffer_max
+        # Optional obs.flight.FlightRecorder sink: instants (and span
+        # ends) are mirrored into its bounded ring EVEN when the
+        # backend is "none" — the flight recorder is the always-on
+        # postmortem buffer, the backend the opt-in full trace.
+        self.flight = None
         self._open: dict[tuple[str, int], tuple[int, dict | None]] = {}
         # deque(maxlen) drops oldest in O(1); a list shift per event
         # would make every traced hot-path op O(buffer_max) once full.
@@ -114,7 +119,11 @@ class Tracer:
         )
 
     def instant(self, name: str, **args) -> None:
-        """Zero-duration marker (view change, crash recovery, …)."""
+        """Zero-duration marker (view change, crash recovery, …).
+        Mirrored into the flight ring even with backend "none" — the
+        postmortem buffer must not depend on full tracing being on."""
+        if self.flight is not None:
+            self.flight.note(name, **args)
         if not self.enabled:
             return
         ev = {

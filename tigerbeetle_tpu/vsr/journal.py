@@ -59,9 +59,15 @@ class Journal:
         # Deferred-sync bookkeeping (group commit): WAL writes issued
         # with sync=False since the last covering sync_batch().
         self.unsynced_writes = 0
+        from tigerbeetle_tpu.obs import anatomy as anatomy_mod
         from tigerbeetle_tpu.utils import tracer as tracer_mod
 
         self.tracer = tracer_mod.NULL
+        # Per-request anatomy (obs/anatomy.py): the journal_write
+        # stage timestamp is taken HERE, next to the WAL append, so a
+        # sampled request's timeline shows exactly when its durability
+        # write landed (the owning replica shares its recorder).
+        self.anatomy = anatomy_mod.NULL
         # Private default registry until the owning replica shares its
         # own via set_metrics (standalone journals stay observable).
         from tigerbeetle_tpu import obs
@@ -117,6 +123,7 @@ class Journal:
                 # Deferred (group commit): the caller owns the covering
                 # sync_batch() and must not ack this op before it.
                 self.unsynced_writes += 1
+        self.anatomy.stage_h(header, "journal_write")
 
     def sync_batch(self) -> bool:
         """One covering fdatasync for every deferred WAL write since

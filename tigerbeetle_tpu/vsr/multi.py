@@ -151,6 +151,11 @@ class VsrReplica(Replica):
         self.pipeline: dict[int, PipelineEntry] = {}
         self.request_queue: list[tuple[np.ndarray, bytes]] = []
         self._queued_keys: set[tuple[int, int]] = set()
+        # Admission control (runtime/server.py sets both): bound on
+        # the request queue — None = unbounded (sim clusters) — and
+        # an owner callback fired per shed (counters, flight ring).
+        self.admit_queue: int | None = None
+        self.on_shed = None
 
         # Cluster clock synchronization (reference: src/vsr/clock.zig).
         self.clock = Clock(replica, replica_count)
@@ -296,6 +301,9 @@ class VsrReplica(Replica):
         # writes it covers.
         self._gc_sync_job = None
         self._gc_sync_cover = 0
+        # Sampled trace ids whose WAL writes await the covering sync
+        # (drained and stage-stamped by _gc_covering_sync).
+        self._gc_trace_ids: list[int] = []
         self._stats["stat_prepares_written"] = self.metrics.counter(
             "prepares_written"
         )
@@ -545,7 +553,13 @@ class VsrReplica(Replica):
             return
         if wire.u128(header, "cluster") != self.cluster:
             return
-        cmd = Command(int(header["command"]))
+        try:
+            cmd = Command(int(header["command"]))
+        except ValueError:
+            # Unknown command byte (e.g. a client_busy shed bounced
+            # off a forwarded request, or a newer peer): drop, never
+            # crash the protocol loop.
+            return
         handler = {
             Command.request: self._on_request_msg,
             Command.prepare: self._on_prepare,
@@ -620,12 +634,43 @@ class VsrReplica(Replica):
     def _enqueue_request(self, header: np.ndarray, body: bytes) -> None:
         """Queue a request exactly once: broadcast retransmissions of
         the same (client, request) must not pile up (a batched drain
-        would execute every copy)."""
+        would execute every copy).
+
+        Admission control lives HERE, after the at-most-once gate —
+        a retransmission of an already-committed request must get its
+        stored reply even under overload, never a busy (shedding at
+        the server's raw-message layer had exactly that bug).  A
+        fresh request past the `admit_queue` bound is shed with a
+        typed Command.client_busy: session intact, client may retry."""
         key = (wire.u128(header, "client"), int(header["request"]))
         if key in self._queued_keys:
             return
+        if self.admit_queue is not None and (
+            len(self.request_queue) >= self.admit_queue
+        ):
+            self._shed_request(header)
+            return
         self._queued_keys.add(key)
+        self.anatomy.stage_h(header, "queued")
         self.request_queue.append((header, body))
+
+    def _shed_request(self, header: np.ndarray) -> None:
+        """Typed load shed: the queue is full.  The busy reply rides
+        the client's registered connection (a request forwarded from
+        a backup has none here — its client recovers by retransmit
+        timeout, which is the legacy-client path anyway)."""
+        client = wire.u128(header, "client")
+        busy = wire.make_header(
+            command=Command.client_busy, cluster=self.cluster,
+            client=client, request=int(header["request"]),
+            replica=self.replica, view=self.view,
+        )
+        wire.copy_trace(busy, header)
+        wire.finalize_header(busy, b"")
+        if client:
+            self.bus.send_client(client, busy, b"")
+        if self.on_shed is not None:
+            self.on_shed(header)
 
     def _pop_request(self) -> tuple[np.ndarray, bytes]:
         h, b = self.request_queue.pop(0)
@@ -803,7 +848,12 @@ class VsrReplica(Replica):
             context=len(subs) if subs else 0,
             release=self.release,
         )
+        # Trace context rides the prepare so every replica's hops key
+        # off the same request id (backups record journal_write /
+        # prepare_ok against it without any side channel).
+        wire.copy_trace(prepare, request)
         wire.finalize_header(prepare, body)
+        self.anatomy.stage_h(prepare, "prepare")
 
         self._journal_write(prepare, body)
         self.op = op
@@ -843,6 +893,7 @@ class VsrReplica(Replica):
         if wire.u128(header, "context") != wire.u128(entry.header, "checksum"):
             return
         entry.ok_replicas.add(int(header["replica"]))
+        self.anatomy.stage_h(header, "prepare_ok")
         self._maybe_commit_pipeline()
 
     def _primary_requeue_uncommitted(self) -> None:
@@ -901,14 +952,24 @@ class VsrReplica(Replica):
             self.commit_max = max(self.commit_max, op)
             client = wire.u128(entry.header, "client")
             if entry.subs:
-                # Batched prepare: each sub-request's demuxed reply was
-                # stored at commit; forward them to their clients.
-                for sub_client, _, _ in entry.subs:
-                    session = self.sessions.get(sub_client)
-                    if sub_client and session is not None:
-                        self._send_stored_reply(sub_client, session)
+                # Batched prepare: forward each sub-request's OWN
+                # reply, captured at commit — re-reading the session's
+                # stored reply here would send the batch's LAST reply
+                # to every sub when one client multiplexed several
+                # requests into the batch (open-loop sessions).
+                batch_replies, self._batch_replies = (
+                    self._batch_replies, []
+                )
+                for sub_client, rh_bytes, piece in batch_replies:
+                    self._gc_send_client(
+                        sub_client,
+                        wire.header_from_bytes(rh_bytes), piece,
+                    )
             elif client:
                 self._send_reply(entry.header, reply_body)
+            # The request's timeline closes at reply: e2e into the
+            # anatomy histogram, tail exemplars retained.
+            self.anatomy.finish_h(entry.header, "reply")
             del self.pipeline[op]
             if self._checkpoint_due():
                 # Deterministic checkpoint point: commit_min crosses the
@@ -999,6 +1060,13 @@ class VsrReplica(Replica):
             cluster=self.cluster, view=self.view,
             client=0, request=0, context=len(subs),
         )
+        # A multiplexed prepare carries ONE trace context: the first
+        # sampled sub-request's (the batch executes as one unit, so
+        # one timeline describes them all).
+        for rh, _ in requests:
+            if wire.trace_sampled(rh):
+                wire.copy_trace(head, rh)
+                break
         wire.finalize_header(head, body)
         self._primary_prepare(head, body, subs=subs)
 
@@ -1055,6 +1123,12 @@ class VsrReplica(Replica):
         if not self._gc_enabled:
             self.journal.write_prepare(header, body)
             return
+        # Sampled requests deferred behind this drain's covering sync
+        # get a gc_covering_sync stage stamped when it lands — the
+        # group-commit gate's contribution to THIS request's latency.
+        tid = wire.trace_sampled(header)
+        if tid:
+            self._gc_trace_ids.append(tid)
         self.journal.write_prepare(header, body, sync=False)
         if self._wal_sync_worker is not None and self._gc_sync_job is None:
             self._gc_sync_cover = self.journal.unsynced_writes
@@ -1100,6 +1174,12 @@ class VsrReplica(Replica):
                 )
                 self._gc_sync_cover = 0
             self.journal.sync_batch()
+        if self._gc_trace_ids:
+            # One covering sync settled every deferred write in this
+            # batch: stamp the shared stage timestamp on each sampled
+            # request that waited for it.
+            ids, self._gc_trace_ids = self._gc_trace_ids, []
+            self.anatomy.stage_many(ids, "gc_covering_sync")
 
     def flush_group_commit(self) -> None:
         """Group-commit flush point (end of a server poll drain, or
@@ -1267,6 +1347,10 @@ class VsrReplica(Replica):
             context=wire.u128(prepare, "checksum"),
             client=wire.u128(prepare, "client"),
         )
+        # The ack echoes the prepare's trace context so the PRIMARY
+        # can stamp a prepare_ok stage (per acking backup) onto the
+        # request's timeline.
+        wire.copy_trace(ok, prepare)
         wire.finalize_header(ok, b"")
         self.tracer.instant("prepare_ok", op=int(prepare["op"]))
         # Routed through the group-commit gate: a prepare_ok for an op
@@ -1406,6 +1490,10 @@ class VsrReplica(Replica):
                 self._send_repair_requests()
                 return
             self._commit_prepare(header, body)
+            # Backups (and a catching-up primary) close the record at
+            # commit — there is no reply hop on this replica; the
+            # partial timeline still feeds exemplars/e2e.
+            self.anatomy.finish_h(header)
             self.commit_parent = wire.u128(header, "checksum")
             self._vouched.pop(op, None)
             if self._checkpoint_due():

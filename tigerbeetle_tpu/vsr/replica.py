@@ -105,6 +105,14 @@ class Replica:
         self._h_ckpt_freeze = self.metrics.histogram("ckpt.freeze_us")
         self._h_ckpt_finalize = self.metrics.histogram("ckpt.finalize_us")
         self.metrics.gauge_fn("commit_min", lambda: self.commit_min)
+        # Per-request anatomy (obs/anatomy.py): stage timelines for
+        # sampled requests, keyed by the wire trace context.  Enabled
+        # iff metrics are; the owning server attaches the flight ring.
+        from tigerbeetle_tpu.obs.anatomy import AnatomyRecorder
+
+        self.anatomy = AnatomyRecorder(self.metrics.scope("anatomy"))
+        if hasattr(state_machine, "anatomy"):
+            state_machine.anatomy = self.anatomy
         if getattr(storage, "supports_async_writeback", False):
             import weakref
 
@@ -154,6 +162,7 @@ class Replica:
         self.superblock = SuperBlock(storage, cluster)
         self.journal = Journal(storage, cluster)
         self.journal.set_metrics(self.metrics)
+        self.journal.anatomy = self.anatomy
 
         # LSM forest over the grid zone's block region (state machines
         # that support it spill frozen state there, so checkpoints stay
@@ -180,6 +189,10 @@ class Replica:
         self.parent_checksum = 0     # checksum of prepare at self.op
         self.checkpoint_op = 0
         self.sessions: dict[int, Session] = {}
+        # (client, reply_header_bytes, reply_body) per sub-request of
+        # the most recently committed batched prepare (see
+        # _commit_prepare_impl; the primary pipeline drains it).
+        self._batch_replies: list[tuple[int, bytes, bytes]] = []
         self._next_reply_slot = 0
         self.realtime = 0
         # Multiversion upgrades (multi.py drives these; the base
@@ -424,6 +437,7 @@ class Replica:
         ), self._h_commit.time():
             reply = self._commit_prepare_impl(header, body, replay)
         self._c_commits.inc()
+        self.anatomy.stage_h(header, "commit")
         return reply
 
     def _commit_prepare_impl(self, header: np.ndarray, body: bytes,
@@ -432,6 +446,10 @@ class Replica:
         operation = int(header["operation"])
         timestamp = int(header["timestamp"])
         client = wire.u128(header, "client")
+        if hasattr(self.sm, "anatomy_trace"):
+            # Stamp the current prepare's trace id so the state
+            # machine can attribute its device-window hop.
+            self.sm.anatomy_trace = wire.trace_sampled(header)
 
         if replay:
             # Timestamps replay from the header, not the clock
@@ -491,6 +509,14 @@ class Replica:
                     )
                 dm = demuxer.Demuxer(sm_op, reply)
                 offset = 0
+                # Per-sub replies captured AT commit: a session stores
+                # only its LATEST reply, so when one batch multiplexes
+                # several requests of the SAME client (open-loop
+                # sessions keep many in flight), sending the stored
+                # reply N times would answer every sub with the last
+                # request's bytes — earlier subs would never resolve.
+                # The pipeline sends these captured pairs instead.
+                self._batch_replies = []
                 for sub_client, sub_request, count in subs:
                     piece = dm.decode(offset, count)
                     offset += count
@@ -500,6 +526,11 @@ class Replica:
                         sub_h["client_hi"] = sub_client >> 64
                         sub_h["request"] = sub_request
                         self._store_reply(sub_h, piece)
+                        entry = self.sessions.get(sub_client)
+                        if entry is not None and entry.reply_header:
+                            self._batch_replies.append(
+                                (sub_client, entry.reply_header, piece)
+                            )
                 self._compact_beat()
                 self.commit_min = op
                 if self.hash_log is not None and not replay:
@@ -687,6 +718,10 @@ class Replica:
             timestamp=int(prepare["timestamp"]),
             context=wire.u128(prepare, "checksum"),
         )
+        # The reply carries the request's trace context back to the
+        # client (origin timestamp included), closing the loop: the
+        # client can compute wire-to-wire latency from its own clock.
+        wire.copy_trace(reply, prepare)
         wire.finalize_header(reply, reply_body)
         entry.request = int(prepare["request"])
         entry.reply_header = reply.tobytes()

@@ -51,6 +51,12 @@ class Command(enum.IntEnum):
     block = 20
     request_sync_checkpoint = 21
     sync_checkpoint = 22
+    # Ours: typed admission-control shed (runtime/server.py).  Unlike
+    # `eviction` it is NOT fatal to the session — the request was never
+    # admitted, so the client may back off and retry under the same
+    # request number.  Legacy clients that predate the command ignore
+    # it and recover through their normal retransmission cadence.
+    client_busy = 23
 
 
 # reference: src/vsr.zig:318-411 — operations 0-127 are VSR-reserved;
@@ -85,10 +91,22 @@ HEADER_DTYPE = np.dtype(
         ("release", "<u4"),                                      # [148, 152)
         ("replica", "u1"), ("command", "u1"),                    # [152, 154)
         ("operation", "u1"), ("version", "u1"),                  # [154, 156)
-        ("reserved", "V100"),                                    # [156, 256)
+        # Trace context (ours): carved from the reserved region so
+        # every hop of a sampled request carries its identity — the
+        # request id, the origin CLOCK_MONOTONIC timestamp stamped at
+        # client submit, and the sampled flag.  Zero everywhere for
+        # untraced messages (the old all-reserved layout), so legacy
+        # headers stay bit-identical.
+        ("trace_id", "<u8"),                                     # [156, 164)
+        ("trace_ts", "<u8"),                                     # [164, 172)
+        ("trace_flags", "u1"),                                   # [172, 173)
+        ("reserved", "V83"),                                     # [173, 256)
     ]
 )
 assert HEADER_DTYPE.itemsize == HEADER_SIZE, HEADER_DTYPE.itemsize
+
+# trace_flags bits.
+TRACE_SAMPLED = 1
 
 # Wire-protocol version (ours, not the reference's).
 VERSION = 1
@@ -129,6 +147,24 @@ def make_header(**fields) -> np.ndarray:
 
 def u128(h: np.ndarray, name: str) -> int:
     return int(h[f"{name}_lo"]) | (int(h[f"{name}_hi"]) << 64)
+
+
+def copy_trace(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """Propagate the trace context from `src` into `dst` (request ->
+    prepare -> prepare_ok / reply).  Must run BEFORE finalize_header:
+    the checksum covers the trace fields."""
+    dst["trace_id"] = src["trace_id"]
+    dst["trace_ts"] = src["trace_ts"]
+    dst["trace_flags"] = src["trace_flags"]
+    return dst
+
+
+def trace_sampled(h: np.ndarray) -> int:
+    """The header's trace id when it is sampled, else 0 — one check
+    for every stage-recording call site."""
+    if int(h["trace_flags"]) & TRACE_SAMPLED:
+        return int(h["trace_id"])
+    return 0
 
 
 def finalize_header(h: np.ndarray, body: bytes = b"") -> np.ndarray:
