@@ -1152,6 +1152,7 @@ def run_open_loop() -> dict:
     batch = envcheck.open_loop_batch()
     hot_pct = envcheck.open_loop_hot_pct()
     burst = envcheck.open_loop_burst()
+    read_pct = envcheck.open_loop_read_pct()
     n_replicas = 2
     n_sessions = int(os.environ.get("BENCH_OPEN_SESSIONS", 4))
     tmp = tempfile.mkdtemp(prefix="tb_bench_open_")
@@ -1258,6 +1259,41 @@ def run_open_loop() -> dict:
                 tids, dr, cr, rng.integers(1, 100, n, np.uint64)
             )
 
+        # Read-heavy mix (BENCH_OPEN_READ_PCT): lookup_accounts id
+        # batches with the same hot-account skew, plus a sprinkle of
+        # AccountFilter queries over the hot accounts (the committed
+        # scan path) — interleaved with the transfer stream so the
+        # rate-vs-SLO curves price a realistic read/write mix.
+        def make_read() -> tuple:
+            if rng.random() < 0.15:
+                row = np.zeros(1, dtype=types.ACCOUNT_FILTER_DTYPE)[0]
+                types.u128_set(
+                    row, "account_id", int(rng.integers(1, n_hot + 1))
+                )
+                row["limit"] = 128
+                row["flags"] = (types.AccountFilterFlags.debits
+                                | types.AccountFilterFlags.credits)
+                return Operation.get_account_transfers, row.tobytes()
+            n = max(1, batch // 4)
+            ids = rng.integers(n_hot + 1, n_acct + 1, n, np.uint64)
+            hot = rng.random(n) < hot_pct / 100.0
+            ids[hot] = rng.integers(1, n_hot + 1, int(hot.sum()),
+                                    np.uint64)
+            arr = np.zeros(n, dtype=types.U128_PAIR_DTYPE)
+            arr["lo"] = ids
+            return Operation.lookup_accounts, arr.tobytes()
+
+        def submit_one(session) -> None:
+            # Reads ride ON TOP of the transfer stream (additive, not
+            # substitutive): the write arrival rate — and therefore
+            # achieved_eps vs offered_eps and comparability with prior
+            # BENCH_r*.json open_loop rows — is unchanged; the read
+            # mix adds BENCH_OPEN_READ_PCT% extra requests.
+            session.submit(Operation.create_transfers, make_body(batch))
+            if rng.random() < read_pct / 100.0:
+                op, body = make_read()
+                session.submit(op, body)
+
         # -- closed-loop capacity probe: two sync sessions, ~2 s ------
         # Untimed warmup first: JIT compiles and page-cache fill must
         # not depress the measured capacity (every open-loop rate is a
@@ -1316,9 +1352,7 @@ def run_open_loop() -> dict:
             while time.perf_counter() < t_end:
                 now = time.perf_counter()
                 while next_arrival <= now:
-                    sessions[rr % n_sessions].submit(
-                        Operation.create_transfers, make_body(batch)
-                    )
+                    submit_one(sessions[rr % n_sessions])
                     rr += 1
                     sent += 1
                     next_arrival += float(rng.exponential(1.0 / req_rate))
@@ -1328,9 +1362,7 @@ def run_open_loop() -> dict:
                     next_burst += 1.0
                     extra = int((burst - 1.0) * req_rate * 0.05)
                     for _ in range(extra):
-                        sessions[rr % n_sessions].submit(
-                            Operation.create_transfers, make_body(batch)
-                        )
+                        submit_one(sessions[rr % n_sessions])
                         rr += 1
                         sent += 1
                 for s in sessions:
@@ -1356,28 +1388,44 @@ def run_open_loop() -> dict:
                 for s in sessions:
                     s.poll(10)
             elapsed = time.perf_counter() - t_start
+            write_op = int(Operation.create_transfers)
             lats = sorted(
                 lat for s in sessions
-                for (_r, kind, lat, _b) in s.completed if kind == "reply"
+                for (_r, kind, lat, _b, _op) in s.completed
+                if kind == "reply"
+            )
+            write_lats = sorted(
+                lat for s in sessions
+                for (_r, kind, lat, _b, op) in s.completed
+                if kind == "reply" and op == write_op
+            )
+            read_lats = sorted(
+                lat for s in sessions
+                for (_r, kind, lat, _b, op) in s.completed
+                if kind == "reply" and op != write_op
             )
             busy = sum(
                 1 for s in sessions
-                for (_r, kind, _l, _b) in s.completed if kind == "busy"
+                for (_r, kind, _l, _b, _op) in s.completed
+                if kind == "busy"
             )
             replied = len(lats)
             unresolved = sum(len(s.inflight) for s in sessions)
             for s in sessions:
                 s.inflight.clear()  # abandoned; report honestly
 
-            def pct(q):
-                if not lats:
+            def pct(q, xs=None):
+                xs = lats if xs is None else xs
+                if not xs:
                     return None
-                return round(lats[min(len(lats) - 1,
-                                      int(q * len(lats)))] * 1e3, 2)
+                return round(xs[min(len(xs) - 1,
+                                    int(q * len(xs)))] * 1e3, 2)
 
             phases[f"{int(frac * 100)}pct"] = {
                 "offered_eps": round(target_eps, 1),
-                "achieved_eps": round(replied * batch / elapsed, 1),
+                "achieved_eps": round(
+                    len(write_lats) * batch / elapsed, 1
+                ),
                 "requests_sent": sent,
                 "requests_replied": replied,
                 "busy_replies": busy,
@@ -1385,6 +1433,13 @@ def run_open_loop() -> dict:
                 "p50_ms": pct(0.50),
                 "p99_ms": pct(0.99),
                 "p999_ms": pct(0.999),
+                # Read/write split (BENCH_OPEN_READ_PCT mix): reads
+                # ride the same sessions, so overload pricing covers
+                # both sides of the mix.
+                "reads_replied": len(read_lats),
+                "read_p50_ms": pct(0.50, read_lats),
+                "read_p99_ms": pct(0.99, read_lats),
+                "write_p99_ms": pct(0.99, write_lats),
                 "queue_depth_max": queue_depth_max,
             }
 
@@ -1410,6 +1465,7 @@ def run_open_loop() -> dict:
             "capacity_eps": round(capacity_eps, 1),
             "batch_events": batch,
             "hot_account_pct": hot_pct,
+            "read_pct": read_pct,
             "burst_multiplier": burst,
             "phase_secs": phase_secs,
             "sessions": n_sessions,
@@ -1435,6 +1491,376 @@ def run_open_loop() -> dict:
                 pass
         for p in procs:
             p.kill()
+        for log in logs:
+            log.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_sharded_cluster() -> dict:
+    """Account-sharded multi-cluster scaling (runtime/router.py): K
+    single-replica consensus groups behind the crash-safe 2PC router,
+    measured at 1/2/4 shards on this box.  Graded on scaling
+    efficiency vs shard count, cross-shard ratio, 2PC round trips per
+    cross-shard transfer, and the in-doubt recovery count after a
+    mid-run router kill -9 + restart (shards > 1)."""
+    counts = [
+        int(x) for x in os.environ.get(
+            "BENCH_SHARD_COUNTS", "1,2,4"
+        ).split(",")
+    ]
+    out: dict = {"shard_counts": counts}
+    base_eps = None
+    for n_shards in counts:
+        row = _run_sharded_once(n_shards)
+        out[f"shards_{n_shards}"] = row
+        eps = row.get("events_per_sec")
+        if eps and n_shards == counts[0]:
+            base_eps = eps / counts[0]
+        if eps and base_eps:
+            # 1.0 = perfect linear scaling over the first configuration
+            # (per-shard normalized).
+            row["scaling_efficiency"] = round(
+                eps / (n_shards * base_eps), 3
+            )
+    # Reference point for the ROADMAP target (>= 3x `replicated` at 4
+    # shards): the newest graded replicated number on this box.
+    try:
+        import glob
+        import re
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        newest = max(
+            glob.glob(os.path.join(here, "BENCH_r*.json")),
+            key=lambda p: int(re.search(r"r(\d+)", p).group(1)),
+        )
+        ref = json.load(open(newest))["configs"]["replicated"][
+            "events_per_sec"
+        ]
+        out["replicated_reference_eps"] = ref
+        top = out.get(f"shards_{counts[-1]}", {}).get("events_per_sec")
+        if top and ref:
+            out["vs_replicated_reference"] = round(top / ref, 2)
+    except (ValueError, KeyError, OSError, AttributeError):
+        pass
+    out["host_cores"] = os.cpu_count()
+    return out
+
+
+def _run_sharded_once(n_shards: int) -> dict:
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    from tigerbeetle_tpu.types import shard_of_account
+
+    n_events = int(os.environ.get("BENCH_SHARD_EVENTS", 40_000))
+    batch = int(os.environ.get("BENCH_SHARD_BATCH", 4096))
+    cross_pct = float(os.environ.get("BENCH_SHARD_CROSS_PCT", 10.0))
+    n_sessions = int(os.environ.get("BENCH_SHARD_SESSIONS", 4))
+    request_timeout_ms = int(
+        os.environ.get("BENCH_SHARD_TIMEOUT_MS", 300_000)
+    )
+    kill_router = n_shards > 1 and os.environ.get(
+        "BENCH_SHARD_KILL", "1"
+    ) != "0"
+    cluster_id = 21
+    tmp = tempfile.mkdtemp(prefix="tb_bench_shard_")
+    here = os.path.dirname(os.path.abspath(__file__))
+    procs: list = []
+    logs: list = []
+    clients: list = []
+    router_proc: list = [None]
+
+    def free_ports(n):
+        socks = [socket.socket() for _ in range(n)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+        return ports
+
+    def wait_listening(proc, log_path, what, n_marks=1):
+        """Wait for the n_marks-th "listening" line: restarted routers
+        APPEND to the same log, so counting (not mere presence) is
+        what proves THIS incarnation is up."""
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"{what} exited rc={proc.returncode}:\n"
+                    + open(log_path).read()[-2000:]
+                )
+            try:
+                if open(log_path).read().count("listening") >= n_marks:
+                    return
+            except OSError:
+                pass
+            time.sleep(0.3)
+        raise AssertionError(f"{what} did not start: {log_path}")
+
+    try:
+        shard_addrs = []
+        for s in range(n_shards):
+            port = free_ports(1)[0]
+            addr = f"127.0.0.1:{port}"
+            shard_addrs.append(addr)
+            path = os.path.join(tmp, f"s{s}.tigerbeetle")
+            subprocess.run(
+                [
+                    sys.executable, "-m", "tigerbeetle_tpu", "format",
+                    f"--cluster={cluster_id}", "--replica=0",
+                    "--replica-count=1", path,
+                ],
+                check=True, capture_output=True, cwd=here, timeout=120,
+            )
+            runner = (
+                "import sys; sys.path.insert(0, {here!r})\n"
+                "from tigerbeetle_tpu.runtime.server import ReplicaServer\n"
+                "from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine\n"
+                "s = ReplicaServer({path!r}, addresses=[{addr!r}],\n"
+                "    replica_index=0, grid_size=1 << 30,\n"
+                "    state_machine_factory=lambda: TpuStateMachine(\n"
+                "        account_capacity=1 << 12,\n"
+                "        transfer_capacity={cap}))\n"
+                "print('listening', flush=True)\n"
+                "s.serve_forever()\n"
+            ).format(here=here, path=path, addr=addr,
+                     cap=4 * n_events + (1 << 16))
+            log_path = os.path.join(tmp, f"shard{s}.log")
+            log = open(log_path, "w")
+            logs.append(log)
+            procs.append((subprocess.Popen(
+                [sys.executable, "-c", runner], stdout=log,
+                stderr=subprocess.STDOUT, cwd=here,
+            ), log_path))
+        for proc, log_path in procs:
+            wait_listening(proc, log_path, "shard replica")
+
+        router_port = free_ports(1)[0]
+        router_runner = (
+            "import sys; sys.path.insert(0, {here!r})\n"
+            "from tigerbeetle_tpu.runtime.router import RouterServer\n"
+            "r = RouterServer('127.0.0.1:{port}', {shards!r},\n"
+            "    cluster={cluster}, recover={recover})\n"
+            "print('listening', flush=True)\n"
+            "r.serve_forever()\n"
+        )
+
+        router_starts = [0]
+
+        def start_router(recover: bool):
+            log_path = os.path.join(tmp, "router.log")
+            log = open(log_path, "a")
+            logs.append(log)
+            p = subprocess.Popen(
+                [
+                    sys.executable, "-c",
+                    router_runner.format(
+                        here=here, port=router_port, shards=shard_addrs,
+                        cluster=cluster_id, recover=recover,
+                    ),
+                ],
+                stdout=log, stderr=subprocess.STDOUT, cwd=here,
+            )
+            router_proc[0] = p
+            router_starts[0] += 1
+            wait_listening(p, log_path, "router",
+                           n_marks=router_starts[0])
+            return p
+
+        start_router(recover=False)
+        router_addr = f"127.0.0.1:{router_port}"
+
+        from tigerbeetle_tpu.client import Client
+        from tigerbeetle_tpu.obs.scrape import scrape_stats
+
+        # Accounts, grouped per shard by the deterministic mapping.
+        n_acct = 1_024
+        ids = np.arange(1, n_acct + 1, dtype=np.uint64)
+        by_shard = [[] for _ in range(n_shards)]
+        for v in ids:
+            by_shard[shard_of_account(int(v), n_shards)].append(int(v))
+        by_shard = [np.asarray(v, dtype=np.uint64) for v in by_shard]
+        # The doubled router address keeps the native client's
+        # retransmission rotating (and reconnecting) through the
+        # router restart window.
+        setup = Client(f"{router_addr},{router_addr}", cluster_id,
+                       timeout_ms=request_timeout_ms)
+        clients.append(setup)
+        reply = setup._native.request(
+            Operation.create_accounts, accounts_bytes(ids),
+            request_timeout_ms,
+        )
+        assert reply == b"", "sharded setup: account failures"
+
+        # Transfer batches: rows round-robin across home shards;
+        # cross_pct% pair a debit on shard s with a credit on s+1.
+        rng = np.random.default_rng(71)
+        bodies = []
+        tid = 1
+        done = 0
+        while done < n_events:
+            n = min(batch, n_events - done)
+            tids = np.arange(tid, tid + n, dtype=np.uint64)
+            tid += n
+            home = (np.arange(n) + len(bodies)) % n_shards
+            dr = np.empty(n, np.uint64)
+            cr = np.empty(n, np.uint64)
+            cross = rng.random(n) < cross_pct / 100.0
+            for s in range(n_shards):
+                mask = home == s
+                pool = by_shard[s]
+                dr[mask] = rng.choice(pool, int(mask.sum()))
+                peer = by_shard[(s + 1) % n_shards]
+                cr_s = rng.choice(pool, int(mask.sum()))
+                cr_x = rng.choice(peer, int(mask.sum()))
+                cr[mask] = np.where(cross[mask], cr_x, cr_s)
+            same = dr == cr
+            if same.any():
+                for i in np.flatnonzero(same):
+                    pool = by_shard[shard_of_account(int(dr[i]), n_shards)]
+                    cr[i] = pool[0] if pool[0] != dr[i] else pool[1]
+            bodies.append(transfers_bytes(
+                tids, dr, cr, rng.integers(1, 100, n, np.uint64)
+            ))
+            done += n
+
+        lat: list = []
+        acceptable_fail = [0]
+        hard_fail = [0]
+        errors: list = []
+        expired = int(types.CreateTransferResult.pending_transfer_expired)
+        lock = threading.Lock()
+
+        def drive(s: int) -> None:
+            c = Client(f"{router_addr},{router_addr}", cluster_id,
+                       timeout_ms=request_timeout_ms)
+            clients.append(c)
+            try:
+                for body in bodies[s::n_sessions]:
+                    b0 = time.perf_counter()
+                    reply = c._native.request(
+                        Operation.create_transfers, body,
+                        request_timeout_ms,
+                    )
+                    dt = time.perf_counter() - b0
+                    codes = np.frombuffer(
+                        reply, types.CREATE_RESULT_DTYPE
+                    )["result"]
+                    with lock:
+                        lat.append(dt)
+                        # A cross-shard transfer aborted by the router
+                        # kill resolves as a typed expired — a clean
+                        # abort, priced but not an error.
+                        acceptable_fail[0] += int(
+                            (codes == expired).sum()
+                        )
+                        hard_fail[0] += int((codes != expired).sum())
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"session {s}: {exc!r}")
+
+        threads = [
+            threading.Thread(target=drive, args=(s,), daemon=True)
+            for s in range(n_sessions)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        killed_mid_run = False
+        indoubt = 0
+        if kill_router:
+            # Coordinator crash mid-stream: kill -9, restart with
+            # recovery; clients ride their retransmission loops.
+            time.sleep(max(1.0, min(10.0, n_events / 20_000)))
+            router_proc[0].kill()
+            router_proc[0].wait()
+            start_router(recover=True)
+            killed_mid_run = True
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors or hard_fail[0]:
+            return {
+                "error": "; ".join(errors)
+                or f"{hard_fail[0]} hard transfer failures",
+                "n_shards": n_shards,
+                "router_log_tail": open(
+                    os.path.join(tmp, "router.log")
+                ).read()[-1500:],
+            }
+        stats = {}
+        try:
+            # The scrape is a single request/reply exchange with no
+            # retransmission; retry a couple of times before declaring
+            # the router unscrapable.
+            snap = None
+            for _attempt in range(3):
+                try:
+                    snap = scrape_stats(router_addr, cluster_id,
+                                        timeout_ms=20_000)
+                    break
+                except (OSError, TimeoutError, ValueError):
+                    if _attempt == 2:
+                        raise
+            cross = int(snap.get("router.cross_shard_transfers", 0))
+            stats = {
+                "cross_shard_transfers": cross,
+                "local_transfers": int(
+                    snap.get("router.local_transfers", 0)
+                ),
+                "cross_shard_ratio": round(
+                    cross / max(1, n_events), 4
+                ),
+                "two_pc_roundtrips": int(
+                    snap.get("router.2pc_roundtrips", 0)
+                ),
+                "two_pc_commits": int(snap.get("router.2pc_commits", 0)),
+                "two_pc_aborts": int(snap.get("router.2pc_aborts", 0)),
+                "two_pc_compensations": int(
+                    snap.get("router.2pc_compensations", 0)
+                ),
+                "two_pc_conflicts": int(
+                    snap.get("router.2pc_conflicts", 0)
+                ),
+                "indoubt_recovered": int(
+                    snap.get("router.indoubt_recovered", 0)
+                ),
+                "router_retries": int(snap.get("router.retries", 0)),
+            }
+            indoubt = stats["indoubt_recovered"]
+        except (OSError, TimeoutError, ValueError):
+            stats = {"scrape_error": True}
+        lat_ms = np.sort(np.asarray(lat)) * 1e3
+        return {
+            "n_shards": n_shards,
+            "events": n_events,
+            "events_per_sec": round(n_events / elapsed, 1),
+            "batch_events": batch,
+            "client_sessions": n_sessions,
+            "router_killed_mid_run": killed_mid_run,
+            "aborted_by_kill": acceptable_fail[0],
+            "indoubt_recovered": indoubt,
+            "request_p50_ms": round(
+                float(lat_ms[len(lat_ms) // 2]), 2
+            ) if len(lat_ms) else None,
+            "request_p99_ms": round(
+                float(lat_ms[int(len(lat_ms) * 0.99)]), 2
+            ) if len(lat_ms) else None,
+            **stats,
+        }
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        if router_proc[0] is not None:
+            router_proc[0].kill()
+        for proc, _lp in procs:
+            proc.kill()
         for log in logs:
             log.close()
         shutil.rmtree(tmp, ignore_errors=True)
@@ -2095,8 +2521,8 @@ def main() -> None:
     t_run0 = time.time()
     budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 5400))
     # memory configs + waves compare + device-waves compare + durable
-    # + replicated + open-loop
-    n_configs_left = [len(CONFIGS) + 5]
+    # + replicated + open-loop + sharded-cluster
+    n_configs_left = [len(CONFIGS) + 6]
 
     def next_timeout(cap_s: float) -> int | None:
         remaining = budget_s - (time.time() - t_run0)
@@ -2200,7 +2626,8 @@ def main() -> None:
 
     for cname, flag in (("durable", "--durable-only"),
                         ("replicated", "--replicated-only"),
-                        ("open_loop", "--open-loop")):
+                        ("open_loop", "--open-loop"),
+                        ("sharded_cluster", "--sharded-cluster-only")):
         t = next_timeout(per_config_cap)
         configs_out[cname] = (
             dict(_SKIP_ROW) if t is None
@@ -2475,6 +2902,10 @@ if __name__ == "__main__":
         # Open-loop arrival mode: sustained-rate-vs-SLO curves
         # (p50/p99/p999 at 50/80/95/120% of measured capacity).
         print(json.dumps(_mark_device_fallback(run_open_loop())))
+    elif "--sharded-cluster-only" in sys.argv:
+        # Account-sharded multi-cluster scaling behind the 2PC router
+        # (scaling efficiency vs shard count + in-doubt recovery).
+        print(json.dumps(_mark_device_fallback(run_sharded_cluster())))
     elif memory_only:
         print(json.dumps(_mark_device_fallback(run_memory_only(memory_only[0]))))
     else:

@@ -288,3 +288,66 @@ def test_tb_metrics_disables_histograms(monkeypatch):
     # Counters stay live regardless of the knob.
     reg.counter("c").inc(3)
     assert reg.snapshot()["c"] == 3
+
+
+def test_sharded_router_envs_validated(monkeypatch):
+    monkeypatch.setenv("TB_SHARDS", "many")
+    with pytest.raises(envcheck.EnvVarError, match="TB_SHARDS"):
+        envcheck.shards()
+    monkeypatch.setenv("TB_SHARDS", "0")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 1"):
+        envcheck.shards()
+    monkeypatch.setenv("TB_SHARDS", "65")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 64"):
+        envcheck.shards()
+    monkeypatch.setenv("TB_SHARDS", "4")
+    assert envcheck.shards() == 4
+    monkeypatch.delenv("TB_SHARDS")
+    assert envcheck.shards() == 1  # default: unsharded
+
+    monkeypatch.setenv("TB_ROUTER_QUEUE", "0")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 1"):
+        envcheck.router_queue()
+    monkeypatch.setenv("TB_ROUTER_QUEUE", "512")
+    assert envcheck.router_queue() == 512
+    monkeypatch.delenv("TB_ROUTER_QUEUE")
+    assert envcheck.router_queue() == 256
+
+    monkeypatch.setenv("TB_COORD_RETRY_MS", "5")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 10"):
+        envcheck.coord_retry_ms()
+    monkeypatch.delenv("TB_COORD_RETRY_MS")
+    assert envcheck.coord_retry_ms() == 1000
+
+
+def test_coord_timeout_names_view_change_constraint(monkeypatch):
+    """The named constraint: the cross-shard hold timeout must exceed
+    one shard's view-change budget, or a decided commit could expire
+    under a primary failover on the credit shard."""
+    budget = envcheck.view_change_budget_s()
+    assert budget == 5.0  # VIEW_CHANGE_TICKS * TICK_NS
+    monkeypatch.setenv("TB_COORD_TIMEOUT_S", "soon")
+    with pytest.raises(envcheck.EnvVarError, match="TB_COORD_TIMEOUT_S"):
+        envcheck.coord_timeout_s()
+    monkeypatch.setenv("TB_COORD_TIMEOUT_S", "5")
+    with pytest.raises(
+        envcheck.EnvVarError, match="view-change budget \\(5s\\)"
+    ):
+        envcheck.coord_timeout_s()
+    monkeypatch.setenv("TB_COORD_TIMEOUT_S", "6")
+    assert envcheck.coord_timeout_s() == 6
+    monkeypatch.delenv("TB_COORD_TIMEOUT_S")
+    assert envcheck.coord_timeout_s() == 30  # default
+
+
+def test_open_loop_read_pct_validated(monkeypatch):
+    monkeypatch.setenv("BENCH_OPEN_READ_PCT", "110")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 100"):
+        envcheck.open_loop_read_pct()
+    monkeypatch.setenv("BENCH_OPEN_READ_PCT", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.open_loop_read_pct()
+    monkeypatch.setenv("BENCH_OPEN_READ_PCT", "35")
+    assert envcheck.open_loop_read_pct() == 35.0
+    monkeypatch.delenv("BENCH_OPEN_READ_PCT")
+    assert envcheck.open_loop_read_pct() == 20.0  # default

@@ -1,0 +1,374 @@
+"""Router core + sharded-cluster 2PC tests (runtime/router.py over the
+deterministic sim harness in testing/cluster.py).
+
+The crash-window regressions at the bottom pin the three coordinator
+crash points the protocol must survive: before any decision (clean
+abort or retransmit-commit), after the durable decision (recovery
+completes the credit side), and with no client left (recovery alone
+resolves) — each deterministic, no nemesis randomness.
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.runtime import router as router_mod
+from tigerbeetle_tpu.runtime.router import (
+    RouterCore,
+    pack_results,
+    result_codes,
+)
+from tigerbeetle_tpu.testing.cluster import ShardedCluster
+from tigerbeetle_tpu.testing.harness import account, ids_bytes, pack, transfer
+from tigerbeetle_tpu.types import (
+    CreateTransferResult as CTR,
+    TransferPendingStatus as TPS,
+    XShardIds,
+    shard_of_account,
+)
+
+# Account ids 2,3 map to shard 0 and 1,4 to shard 1 under n_shards=2
+# (pinned by test_shard_mapping below).
+S0A, S0B = 2, 3
+S1A, S1B = 1, 4
+
+
+# ----------------------------------------------------------------------
+# Pure helpers.
+
+
+def test_shard_mapping_deterministic_and_balanced():
+    assert shard_of_account(7, 1) == 0
+    for n in (2, 3, 8):
+        counts = [0] * n
+        for i in range(1, 4001):
+            s = shard_of_account(i, n)
+            assert s == shard_of_account(i, n)  # stable
+            counts[s] += 1
+        # Multiplicative mixing: no shard starves or hogs.
+        assert min(counts) > 4000 / n * 0.7, counts
+    assert shard_of_account(S0A, 2) == 0 and shard_of_account(S0B, 2) == 0
+    assert shard_of_account(S1A, 2) == 1 and shard_of_account(S1B, 2) == 1
+
+
+def test_xshard_ids_deterministic_distinct():
+    a, b = XShardIds(123), XShardIds(123)
+    ids_a = [getattr(a, r) for r in XShardIds._ROLES]
+    assert ids_a == [getattr(b, r) for r in XShardIds._ROLES]
+    assert len(set(ids_a)) == len(ids_a)
+    other = [getattr(XShardIds(124), r) for r in XShardIds._ROLES]
+    assert not set(ids_a) & set(other)
+    for v in ids_a:
+        assert v >> 127 == 1  # derived namespace: upper half
+        assert v != types.U128_MAX
+
+
+def test_result_codes_roundtrip():
+    reply = pack_results([(3, 21), (0, 5), (2, 0)])
+    assert result_codes(5, reply) == [5, 0, 0, 21, 0]
+    assert pack_results([]) == b""
+
+
+def test_coord_account_namespace():
+    assert types.is_coord_account(types.coord_account_id(1))
+    assert types.is_coord_account(types.COORD_REGISTRY_ACCOUNT)
+    assert not types.is_coord_account(123456789)
+    leg, peer = types.xleg_untag(types.xleg_tag(types.XLEG_CREDIT, 7))
+    assert (leg, peer) == (types.XLEG_CREDIT, 7)
+
+
+def test_split_keeps_chains_together_and_broadcasts_post_void():
+    core = RouterCore(2, coord_timeout_s=8)
+    rows = [
+        transfer(1, debit_account_id=S0A, credit_account_id=S1A,
+                 amount=1, flags=types.TransferFlags.linked),
+        transfer(2, debit_account_id=S1A, credit_account_id=S1B, amount=1),
+        transfer(3, pending_id=99,
+                 flags=types.TransferFlags.post_pending_transfer),
+        transfer(4, debit_account_id=S0A, credit_account_id=S1A, amount=1),
+        transfer(5, debit_account_id=S0A, credit_account_id=S0B, amount=1),
+    ]
+    _rows, fwd, broadcast, xrows, rejects = core._plan_create_transfers(
+        pack(rows)
+    )
+    # The chain [0,1] rides shard_of(debit of row 0) whole.
+    assert fwd[shard_of_account(S0A, 2)][:2] == [0, 1]
+    assert broadcast == [2]
+    assert [x.index for x in xrows] == [3]
+    assert fwd[0][-1] == 4 or 4 in fwd[0]
+    assert rejects == []
+
+
+def test_split_rejects_cross_shard_timeout():
+    core = RouterCore(2, coord_timeout_s=8)
+    rows = [transfer(1, debit_account_id=S0A, credit_account_id=S1A,
+                     amount=1, timeout=5)]
+    _rows, fwd, broadcast, xrows, rejects = core._plan_create_transfers(
+        pack(rows)
+    )
+    assert not xrows and not fwd
+    assert rejects == [(0, int(CTR.timeout_reserved_for_pending_transfer))]
+
+
+# ----------------------------------------------------------------------
+# Sim-cluster end-to-end (deterministic, no nemesis).
+
+
+@pytest.fixture()
+def sharded():
+    sc = ShardedCluster(n_shards=2, replica_count=2, seed=5)
+    cl = sc.client(9001)
+    cl.register()
+    sc.run_until(lambda: cl.registered)
+    assert sc.run_request(
+        cl, types.Operation.create_accounts,
+        pack([account(S1A), account(S0A), account(S0B), account(S1B)]),
+    ) == b""
+    return sc, cl
+
+
+def test_cross_shard_commit_and_lookup(sharded):
+    sc, cl = sharded
+    reply = sc.run_request(cl, types.Operation.create_transfers, pack([
+        transfer(100, debit_account_id=S0A, credit_account_id=S0B,
+                 amount=5),
+        transfer(101, debit_account_id=S0A, credit_account_id=S1A,
+                 amount=7),
+    ]))
+    assert reply == b""
+    rows = np.frombuffer(
+        sc.run_request(cl, types.Operation.lookup_accounts,
+                       ids_bytes([S1A, S0A, S0B])),
+        types.ACCOUNT_DTYPE,
+    )
+    assert types.u128_get(rows[0], "credits_posted") == 7
+    assert types.u128_get(rows[1], "debits_posted") == 12
+    assert types.u128_get(rows[2], "credits_posted") == 5
+    # Cross-shard transfers have no row under their client id anywhere;
+    # the router reconstructs the client-view row from the 2PC legs.
+    trows = np.frombuffer(
+        sc.run_request(cl, types.Operation.lookup_transfers,
+                       ids_bytes([100, 101])),
+        types.TRANSFER_DTYPE,
+    )
+    assert len(trows) == 2
+    assert types.u128_get(trows[1], "id") == 101
+    assert types.u128_get(trows[1], "debit_account_id") == S0A
+    assert types.u128_get(trows[1], "credit_account_id") == S1A
+    assert types.u128_get(trows[1], "amount") == 7
+    sc.settle()
+    sc.check_shards()
+    sc.check_conservation()
+    sc.check_atomicity([(101, 0, 1)], final=True)
+
+
+def test_cross_shard_error_codes_match_oracle(sharded):
+    sc, cl = sharded
+    # Missing debit account / missing credit account / zero amount:
+    # the 2PC holds hit the same validations the oracle runs, and the
+    # min-nonzero-code merge reproduces its precedence ordering.
+    reply = sc.run_request(cl, types.Operation.create_transfers, pack([
+        transfer(200, debit_account_id=777, credit_account_id=S1A,
+                 amount=3),  # 777 -> shard 0, unknown
+        transfer(201, debit_account_id=S0A, credit_account_id=888,
+                 amount=3),  # 888 -> shard 1, unknown
+        transfer(202, debit_account_id=S0A, credit_account_id=S1A,
+                 amount=0),
+    ]))
+    got = {int(r["index"]): int(r["result"])
+           for r in np.frombuffer(reply, types.CREATE_RESULT_DTYPE)}
+    assert got[0] == int(CTR.debit_account_not_found), got
+    assert got[1] == int(CTR.credit_account_not_found), got
+    assert got[2] == int(CTR.amount_must_not_be_zero), got
+    sc.settle()
+    sc.check_conservation()
+    sc.check_atomicity([(200, 0, 1), (201, 0, 1), (202, 0, 1)],
+                       final=True)
+
+
+def test_local_post_void_broadcast_routing(sharded):
+    sc, cl = sharded
+    assert sc.run_request(cl, types.Operation.create_transfers, pack([
+        transfer(300, debit_account_id=S1A, credit_account_id=S1B,
+                 amount=9, flags=types.TransferFlags.pending),
+    ])) == b""
+    # The post references a pending id only shard 1 knows; the router
+    # broadcasts and keeps the owner's verdict.
+    assert sc.run_request(cl, types.Operation.create_transfers, pack([
+        transfer(301, pending_id=300,
+                 flags=types.TransferFlags.post_pending_transfer),
+    ])) == b""
+    # Unknown pending id: every shard answers not_found.
+    reply = sc.run_request(cl, types.Operation.create_transfers, pack([
+        transfer(302, pending_id=999_999,
+                 flags=types.TransferFlags.void_pending_transfer),
+    ]))
+    got = np.frombuffer(reply, types.CREATE_RESULT_DTYPE)
+    assert int(got[0]["result"]) == int(CTR.pending_transfer_not_found)
+    rows = np.frombuffer(
+        sc.run_request(cl, types.Operation.lookup_accounts,
+                       ids_bytes([S1B])),
+        types.ACCOUNT_DTYPE,
+    )
+    assert types.u128_get(rows[0], "credits_posted") == 9
+    assert types.u128_get(rows[0], "credits_pending") == 0
+
+
+def test_get_account_transfers_routes_by_filter_account(sharded):
+    sc, cl = sharded
+    assert sc.run_request(cl, types.Operation.create_transfers, pack([
+        transfer(400, debit_account_id=S0A, credit_account_id=S0B,
+                 amount=2),
+    ])) == b""
+    row = np.zeros(1, dtype=types.ACCOUNT_FILTER_DTYPE)[0]
+    types.u128_set(row, "account_id", S0B)
+    row["limit"] = 10
+    row["flags"] = (types.AccountFilterFlags.debits
+                    | types.AccountFilterFlags.credits)
+    reply = sc.run_request(cl, types.Operation.get_account_transfers,
+                           row.tobytes())
+    trows = np.frombuffer(reply, types.TRANSFER_DTYPE)
+    assert len(trows) == 1
+    assert types.u128_get(trows[0], "id") == 400
+
+
+# ----------------------------------------------------------------------
+# Deterministic coordinator-crash windows.
+
+
+def _drive_to(sc, cl, tid, want):
+    """Step until the cross-shard transfer reaches hold-state `want`."""
+    for _ in range(8000):
+        sc.step()
+        sd, s_c, _ = sc.cross_status(tid, 0, 1)
+        if (sd, s_c) == want:
+            return
+    raise AssertionError(f"never reached {want}: now {(sd, s_c)}")
+
+
+def _resolve(sc, cl, max_steps=20_000):
+    sc.run_until(lambda: not cl.busy(), max_steps)
+    sc.settle(max_steps)
+
+
+def test_crash_before_decision_retransmit_commits(sharded):
+    """Coordinator dies with both holds pending, no decision; the
+    client retransmits to the restarted coordinator; the transfer must
+    resolve terminally (commit or clean abort), never stay in doubt."""
+    sc, cl = sharded
+    cl.request(types.Operation.create_transfers, pack([
+        transfer(500, debit_account_id=S0A, credit_account_id=S1A,
+                 amount=9),
+    ]))
+    _drive_to(sc, cl, 500, (TPS.pending, TPS.pending))
+    sc.kill_router()
+    sc.start_router()  # recover=True; client retransmits on attach
+    _resolve(sc, cl)
+    sd, s_c, comp = sc.cross_status(500, 0, 1)
+    assert not comp
+    assert (sd, s_c) in ((TPS.posted, TPS.posted),
+                         (TPS.voided, TPS.voided))
+    codes = np.frombuffer(cl.reply, types.CREATE_RESULT_DTYPE)
+    if (sd, s_c) == (TPS.posted, TPS.posted):
+        assert len(codes) == 0
+    else:
+        assert int(codes[0]["result"]) == int(
+            CTR.pending_transfer_expired
+        )
+    sc.check_shards()
+    sc.check_conservation()
+    sc.check_atomicity([(500, 0, 1)], final=True)
+
+
+def test_crash_after_decision_recovery_completes_commit(sharded):
+    """The durable decision (debit-side post) survives the crash; the
+    recovered coordinator MUST finish the credit side — posting, never
+    voiding (no lost money) — even with the client gone."""
+    sc, cl = sharded
+    cl.request(types.Operation.create_transfers, pack([
+        transfer(501, debit_account_id=S0A, credit_account_id=S1A,
+                 amount=6),
+    ]))
+    _drive_to(sc, cl, 501, (TPS.posted, TPS.pending))
+    sc.kill_router()
+    cl._inflight = None  # client dies with the coordinator
+    sc.start_router()
+    sc.run_until(
+        lambda: sc.router.recovery_result is not None and sc.router.idle,
+        max_steps=20_000,
+    )
+    assert sc.router.recovery_result["indoubt"] >= 1
+    sc.settle(20_000)
+    sd, s_c, comp = sc.cross_status(501, 0, 1)
+    assert (sd, s_c) == (TPS.posted, TPS.posted) and not comp
+    got = sc._live_sm(1).account_balances_raw(S1A)
+    assert got[3] == 6  # credits_posted
+    sc.check_atomicity([(501, 0, 1)], final=True)
+
+
+def test_crash_orphan_recovery_aborts_cleanly(sharded):
+    """No decision, no client: recovery alone probe-voids both holds —
+    a clean abort, both sides released, zero balance residue."""
+    sc, cl = sharded
+    cl.request(types.Operation.create_transfers, pack([
+        transfer(502, debit_account_id=S0A, credit_account_id=S1A,
+                 amount=4),
+    ]))
+    _drive_to(sc, cl, 502, (TPS.pending, TPS.pending))
+    sc.kill_router()
+    cl._inflight = None
+    sc.start_router()
+    sc.run_until(
+        lambda: sc.router.recovery_result is not None and sc.router.idle,
+        max_steps=20_000,
+    )
+    assert sc.router.recovery_result["indoubt"] == 1
+    sc.settle(20_000)
+    sd, s_c, comp = sc.cross_status(502, 0, 1)
+    assert (sd, s_c) == (TPS.voided, TPS.voided) and not comp
+    assert sc._live_sm(0).account_balances_raw(S0A) == (0, 0, 0, 0)
+    assert sc._live_sm(1).account_balances_raw(S1A) == (0, 0, 0, 0)
+    sc.check_shards()
+    sc.check_conservation()
+    sc.check_atomicity([(502, 0, 1)], final=True)
+
+
+def test_orphan_holds_expire_without_any_coordinator(sharded):
+    """Coordinator loss with NO successor: the shards' own transfer-
+    timeout machinery expires the orphaned holds — bounded in-doubt
+    window, clean abort, never lost money."""
+    sc, cl = sharded
+    cl.request(types.Operation.create_transfers, pack([
+        transfer(503, debit_account_id=S0A, credit_account_id=S1A,
+                 amount=3),
+    ]))
+    _drive_to(sc, cl, 503, (TPS.pending, TPS.pending))
+    sc.kill_router()
+    cl._inflight = None
+    # coord_timeout_s=8 virtual seconds at 10 ms/step, plus pulse slack.
+    for _ in range(int(sc.coord_timeout_s * 100) + 400):
+        sc.step()
+    sd, s_c, comp = sc.cross_status(503, 0, 1)
+    assert (sd, s_c) == (TPS.expired, TPS.expired) and not comp
+    assert sc._live_sm(0).account_balances_raw(S0A) == (0, 0, 0, 0)
+    sc.check_conservation()
+    sc.check_atomicity([(503, 0, 1)])
+
+
+def test_coordinator_session_survives_many_incarnations(sharded):
+    """Coordinator kills must not consume shard session slots: the
+    stable coordinator identity re-registers (a replay) and resumes
+    its numbering; the client's impersonated sessions keep deduping
+    retransmissions (19 kills once evicted a live client session)."""
+    sc, cl = sharded
+    for k in range(20):
+        sc.kill_router()
+        sc.start_router()
+    tid = 600
+    assert sc.run_request(cl, types.Operation.create_transfers, pack([
+        transfer(tid, debit_account_id=S0A, credit_account_id=S1A,
+                 amount=2),
+    ]), max_steps=30_000) == b""
+    sc.settle(30_000)
+    sc.check_shards()
+    sc.check_atomicity([(tid, 0, 1)], final=True)

@@ -1,0 +1,323 @@
+"""Tier-1 sharded-cluster smoke: 2 shards x 2 replicas over REAL TCP
+(in-process ReplicaServers on the native bus) behind the TCP
+RouterServer, driven by native client sessions.
+
+Proves, in seconds: a mixed shard-local / cross-shard workload through
+the router replies BIT-IDENTICAL to a single-shard oracle cluster; the
+router is killed (no graceful shutdown) and restarted MID-STREAM and
+the stream continues — at-most-once intact through the shards' session
+dedupe and the 2PC's derived-id idempotency; conservation of money
+holds across both shards (settlement accounts net zero); and no client
+request is left stranded.  The trace satellite: both 2PC legs carry
+the client's wire trace context, so one merge_traces pass over the
+router's flight dump + the shard replicas' flight dumps yields a
+single Perfetto timeline showing hold -> hold -> post end to end.
+"""
+
+import dataclasses
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.runtime.native import native_available
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+from tigerbeetle_tpu.testing.harness import pack, transfer
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native runtime not built"
+)
+
+CLUSTER = 31
+CONF = dataclasses.replace(cfg.TEST_MIN, clients_max=16)
+# Account ids by shard under n_shards=2 (pinned in test_router.py).
+S0 = [2, 3, 6, 7]
+S1 = [1, 4, 5, 8]
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class _Server:
+    def __init__(self, path, addresses, index):
+        from tigerbeetle_tpu.runtime.server import ReplicaServer
+
+        self.server = ReplicaServer(
+            path, cluster=CLUSTER, addresses=addresses,
+            replica_index=index,
+            state_machine_factory=lambda: CpuStateMachine(CONF),
+            config=CONF,
+        )
+        self._stop = False
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            self.server.poll_once(timeout_ms=1)
+
+    def close(self):
+        self._stop = True
+        self.thread.join(timeout=5)
+        self.server.close()
+
+
+class _Router:
+    def __init__(self, port, shard_addrs, recover):
+        from tigerbeetle_tpu.runtime.router import RouterServer
+
+        self.server = RouterServer(
+            f"127.0.0.1:{port}", shard_addrs, cluster=CLUSTER,
+            recover=recover,
+        )
+        self._stop = False
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            self.server.poll_once(timeout_ms=1)
+
+    def kill(self):
+        """Crash, not shutdown: stop the loop and drop the sockets;
+        every bit of volatile router state dies here."""
+        self._stop = True
+        self.thread.join(timeout=5)
+        self.server.close()
+
+
+@pytest.fixture()
+def tcp_sharded(tmp_path):
+    from tigerbeetle_tpu.runtime.server import format_data_file
+
+    n_shards, n_repl = 2, 2
+    servers = []
+    shard_addrs = []
+    for s in range(n_shards):
+        ports = _free_ports(n_repl)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        shard_addrs.append(",".join(addrs))
+        for i in range(n_repl):
+            path = str(tmp_path / f"s{s}_r{i}.tb")
+            format_data_file(path, cluster=CLUSTER, replica_index=i,
+                             replica_count=n_repl, config=CONF)
+            servers.append(_Server(path, addrs, i))
+    # Oracle: one single-replica, single-shard cluster fed the same
+    # logical stream directly.
+    oport = _free_ports(1)[0]
+    opath = str(tmp_path / "oracle.tb")
+    format_data_file(opath, cluster=CLUSTER, replica_index=0,
+                     replica_count=1, config=CONF)
+    oracle = _Server(opath, [f"127.0.0.1:{oport}"], 0)
+    router_port = _free_ports(1)[0]
+    router_box = [_Router(router_port, shard_addrs, recover=False)]
+    clients = []
+    try:
+        yield {
+            "shard_addrs": shard_addrs,
+            "router_port": router_port,
+            "router_box": router_box,
+            "oracle_addr": f"127.0.0.1:{oport}",
+            "servers": servers,
+            "oracle": oracle,
+            "clients": clients,
+        }
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        if router_box[0] is not None:
+            router_box[0].kill()
+        oracle.close()
+        for s in servers:
+            s.close()
+
+
+def test_sharded_tcp_router_kill_oracle_identical(tcp_sharded):
+    """The headline smoke: mixed workload, router kill -9 + restart
+    mid-stream, every reply bit-identical to the single-shard oracle."""
+    from tigerbeetle_tpu.client import Client
+
+    env = tcp_sharded
+    router_addr = f"127.0.0.1:{env['router_port']}"
+    # Doubled address: the native client's retransmission rotation
+    # keeps reconnecting through the router restart window.
+    sharded = Client(f"{router_addr},{router_addr}", CLUSTER,
+                     client_id=501, timeout_ms=60_000)
+    single = Client(env["oracle_addr"], CLUSTER, client_id=502,
+                    timeout_ms=60_000)
+    env["clients"] += [sharded, single]
+
+    accounts = [{"id": i, "ledger": 1, "code": 1} for i in S0 + S1]
+    assert sharded.create_accounts(accounts) == []
+    assert single.create_accounts(accounts) == []
+
+    # Mixed stream: local on each shard, cross both directions, and
+    # deliberate failures (unknown accounts, zero amount) whose codes
+    # must come back identical.  Unique ids, ample balances, distinct
+    # accounts per batch: order-insensitive, so the relaxed intra-batch
+    # ordering cannot change any result.
+    def batches(base):
+        return [
+            [t(base + 1, S0[0], S0[1], 5), t(base + 2, S1[0], S1[1], 6)],
+            [t(base + 3, S0[0], S1[0], 7), t(base + 4, S1[1], S0[1], 8)],
+            [t(base + 5, 999, S1[0], 1), t(base + 6, S0[0], 998, 1),
+             t(base + 7, S0[2], S1[2], 0)],
+            [t(base + 8, S0[2], S1[2], 9), t(base + 9, S0[3], S0[2], 2)],
+        ]
+
+    def t(tid, dr, cr, amount):
+        return {"id": tid, "debit_account_id": dr,
+                "credit_account_id": cr, "amount": amount,
+                "ledger": 1, "code": 1}
+
+    def run_batch(rows):
+        got = sharded.create_transfers(rows)
+        want = single.create_transfers(rows)
+        assert got == want, (rows[0]["id"], got, want)
+
+    for rows in batches(1000):
+        run_batch(rows)
+
+    # --- coordinator crash mid-stream -----------------------------
+    env["router_box"][0].kill()
+    env["router_box"][0] = None
+    time.sleep(0.1)
+    env["router_box"][0] = _Router(env["router_port"],
+                                   env["shard_addrs"], recover=True)
+
+    for rows in batches(2000):
+        run_batch(rows)
+
+    # Replies bit-identical extends to reads: balance columns match
+    # the oracle account-for-account (timestamps legitimately differ).
+    got_rows = sharded.lookup_accounts(S0 + S1)
+    want_rows = single.lookup_accounts(S0 + S1)
+    assert len(got_rows) == len(want_rows) == len(S0 + S1)
+    for g, w in zip(got_rows, want_rows):
+        for col in ("id", "debits_pending", "debits_posted",
+                    "credits_pending", "credits_posted"):
+            assert types.u128_get(g, col) == types.u128_get(w, col), col
+
+    # No stranded client work: the router has nothing open, nothing
+    # pending, and both clients saw every reply (sync API returned).
+    router = env["router_box"][0].server
+    deadline = time.time() + 10
+    while time.time() < deadline and (router._open or router._tasks):
+        time.sleep(0.05)
+    assert not router._open and not router._tasks
+
+    # Conservation of money across both shards: per-shard double entry
+    # AND the settlement accounts net to zero cluster-wide.
+    imbalance = 0
+    for s in env["servers"]:
+        sm = s.server.replica.sm
+        dp = sum(a.debits_pending for a in sm.accounts.values())
+        cp = sum(a.credits_pending for a in sm.accounts.values())
+        dpo = sum(a.debits_posted for a in sm.accounts.values())
+        cpo = sum(a.credits_posted for a in sm.accounts.values())
+        assert dp == cp and dpo == cpo
+    for s in env["servers"][::2]:  # one replica per shard
+        sm = s.server.replica.sm
+        for aid, acct in sm.accounts.items():
+            if types.is_coord_account(aid):
+                imbalance += acct.credits_posted - acct.debits_posted
+    assert imbalance == 0
+
+    # The restarted router's registry (fresh — volatile by design)
+    # shows the POST-restart cross-shard work, clean of conflicts.
+    from tigerbeetle_tpu.obs.scrape import scrape_stats
+
+    snap = scrape_stats(router_addr, CLUSTER, timeout_ms=20_000)
+    assert snap["router.cross_shard_transfers"] >= 3
+    assert snap["router.2pc_commits"] >= 3
+    assert snap["router.2pc_compensations"] == 0
+    assert snap["router.2pc_conflicts"] == 0
+
+
+def test_sharded_trace_context_merges_end_to_end(tcp_sharded, tmp_path):
+    """Both 2PC legs carry the client's trace id: the router's flight
+    ring records hold/decide/post instants under it, each shard's
+    anatomy stages land in that shard's flight ring under it, and
+    merge_traces stitches all three dumps into ONE Perfetto timeline."""
+    from tigerbeetle_tpu.client import OpenLoopSession
+    from tigerbeetle_tpu.testing.cluster import merge_traces
+
+    env = tcp_sharded
+    router_addr = f"127.0.0.1:{env['router_port']}"
+    session = OpenLoopSession(router_addr, CLUSTER, 0x7AB)
+    try:
+        # Accounts first (untraced is fine), then one traced
+        # cross-shard transfer.
+        from tigerbeetle_tpu.testing.harness import account
+
+        session.submit(types.Operation.create_accounts,
+                       pack([account(S0[0]), account(S1[0])]))
+        deadline = time.time() + 30
+        while time.time() < deadline and session.inflight:
+            session.poll(20)
+        assert not session.inflight
+        req = session.submit(
+            types.Operation.create_transfers,
+            pack([transfer(9001, debit_account_id=S0[0],
+                           credit_account_id=S1[0], amount=3)]),
+        )
+        trace_id = ((session.id << 20) ^ req) & 0xFFFFFFFFFFFFFFFF
+        deadline = time.time() + 30
+        while time.time() < deadline and session.inflight:
+            session.poll(20)
+        assert not session.inflight
+        reply = [c for c in session.completed if c[0] == req][0]
+        assert reply[1] == "reply" and reply[3] == b""
+    finally:
+        session.close()
+
+    router = env["router_box"][0].server
+    names = {
+        ev["name"]: ev for ev in router.flight.events()
+        if ev.get("args", {}).get("trace_id") == trace_id
+    }
+    assert "x2pc_holds" in names and "x2pc_post_credit" in names
+
+    # Per-shard flight rings carry the same trace id through the
+    # replicas' anatomy stages (ingress/prepare/commit...).
+    dumps = []
+    rpath = str(tmp_path / "router_flight.json")
+    router.flight.write(rpath, reason="test")
+    dumps.append(rpath)
+    shards_with_trace = 0
+    for i, s in enumerate(env["servers"]):
+        hits = [
+            ev for ev in s.server.flight.events()
+            if ev.get("args", {}).get("trace_id") == trace_id
+        ]
+        if hits:
+            shards_with_trace += 1
+        p = str(tmp_path / f"shard_flight_{i}.json")
+        s.server.flight.write(p, reason="test")
+        dumps.append(p)
+    assert shards_with_trace >= 2  # both sides of the 2PC
+
+    merged = merge_traces(dumps, str(tmp_path / "merged.json"))
+    in_merged = [
+        ev for ev in merged["traceEvents"]
+        if isinstance(ev.get("args"), dict)
+        and ev["args"].get("trace_id") == trace_id
+    ]
+    pids = {ev["pid"] for ev in in_merged}
+    assert len(pids) >= 3  # router + both shards on one timeline
+    assert not merged["otherData"].get("skipped")

@@ -33,6 +33,12 @@ commands:
              [--aof=<path>] [--trace=<path>] [--standby-count=<n>]
              [--cache-accounts=<n>] [--cache-transfers=<n>]
              <path>...
+  router     --listen=<host:port> --shards=<addrs;addrs;...>
+             [--cluster=<int>] [--no-recover]
+             (account-sharded multi-cluster front-end: each ';'-
+              separated entry is one shard's comma-joined replica
+              address list; on start the router recovers in-doubt
+              cross-shard transfers from shard state)
   version
   repl       --addresses=<host:port> [--cluster=<int>] [--command=<stmts>]
   benchmark  [--transfers=N] [--accounts=N] [--batch=N] [--addresses=...]
@@ -135,6 +141,40 @@ def cmd_start(args: list[str]) -> None:
         server.close()
 
 
+def cmd_router(args: list[str]) -> None:
+    opts, paths = flags.parse(
+        args,
+        {"listen": "127.0.0.1:3000", "shards": None, "cluster": 0,
+         "no_recover": False},
+    )
+    if paths:
+        flags.fatal("router takes no positional arguments")
+    if not opts["shards"]:
+        flags.fatal("router requires --shards=<addrs;addrs;...>")
+    from tigerbeetle_tpu.runtime.router import RouterServer
+
+    server = RouterServer(
+        opts["listen"], opts["shards"].split(";"),
+        cluster=opts["cluster"], recover=not opts["no_recover"],
+    )
+    print(
+        f"router listening on port {server.port} "
+        f"({server.n_shards} shards)", flush=True,
+    )
+    import signal
+
+    def _stop(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _stop)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+
+
 def cmd_repl(args: list[str]) -> None:
     opts, _ = flags.parse(
         args, {"addresses": None, "cluster": 0, "command": ""}
@@ -206,6 +246,8 @@ def main(argv: list[str] | None = None) -> None:
         cmd_format(rest)
     elif command == "start":
         cmd_start(rest)
+    elif command == "router":
+        cmd_router(rest)
     elif command == "repl":
         cmd_repl(rest)
     elif command == "benchmark":

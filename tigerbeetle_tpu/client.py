@@ -171,10 +171,13 @@ class OpenLoopSession:
         self.cluster = cluster
         self.id = client_id
         self.request_number = 0
-        # request number -> submit perf_counter_ns (open completions).
-        self.inflight: dict[int, int] = {}
-        # (request_number, kind "reply"|"busy", latency_s, reply_body).
-        self.completed: list[tuple[int, str, float, bytes]] = []
+        # request number -> (submit perf_counter_ns, operation).
+        self.inflight: dict[int, tuple[int, int]] = {}
+        # (request_number, kind "reply"|"busy", latency_s, reply_body,
+        #  operation) — the operation rides along so a mixed-op driver
+        # (the read-heavy open-loop bench) can grade reads and writes
+        # separately.
+        self.completed: list[tuple[int, str, float, bytes, int]] = []
         self.busy_replies = 0
         host, _, port = address.rpartition(":")
         self.bus = NativeBus()
@@ -223,7 +226,7 @@ class OpenLoopSession:
             trace_flags=wire.TRACE_SAMPLED,
         )
         wire.finalize_header(h, body)
-        self.inflight[self.request_number] = now
+        self.inflight[self.request_number] = (now, int(operation))
         self.bus.send(self.conn, h.tobytes() + body)
         return self.request_number
 
@@ -239,18 +242,22 @@ class OpenLoopSession:
                 continue
             cmd = int(h["command"])
             req = int(h["request"])
-            t0 = self.inflight.get(req)
+            entry = self.inflight.get(req)
             if cmd == int(wire.Command.client_busy):
-                if t0 is not None:
+                if entry is not None:
                     del self.inflight[req]
+                    t0, op = entry
                     lat = (time.perf_counter_ns() - t0) / 1e9
                     self.busy_replies += 1
-                    self.completed.append((req, "busy", lat, b""))
+                    self.completed.append((req, "busy", lat, b"", op))
             elif cmd == int(wire.Command.reply):
-                if t0 is not None:
+                if entry is not None:
                     del self.inflight[req]
+                    t0, op = entry
                     lat = (time.perf_counter_ns() - t0) / 1e9
-                    self.completed.append((req, "reply", lat, bytes(body)))
+                    self.completed.append(
+                        (req, "reply", lat, bytes(body), op)
+                    )
             elif cmd == int(wire.Command.eviction):
                 raise RuntimeError(f"open-loop client {self.id:#x} evicted")
 

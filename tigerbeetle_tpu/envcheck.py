@@ -156,3 +156,77 @@ def open_loop_burst() -> float:
     at the phase rate, with periodic bursts at `burst`x the rate.
     1.0 = pure Poisson."""
     return env_float("BENCH_OPEN_BURST", 4.0, minimum=1.0)
+
+
+def open_loop_read_pct() -> float:
+    """BENCH_OPEN_READ_PCT: read requests (lookup_accounts /
+    get_account_transfers filter queries) added ON TOP of the transfer
+    stream, as a percentage of it — the read-heavy mix.  Additive so
+    the write arrival rate (and comparability with earlier open-loop
+    baselines) is unchanged."""
+    raw = env_float("BENCH_OPEN_READ_PCT", 20.0, minimum=0.0)
+    if raw > 100.0:
+        _fail("BENCH_OPEN_READ_PCT", str(raw), "must be <= 100")
+    return raw
+
+
+# ----------------------------------------------------------------------
+# Sharded multi-cluster (runtime/router.py).
+
+
+def shards() -> int:
+    """TB_SHARDS: number of account-range shards (independent
+    consensus groups) behind the router.  1 = unsharded."""
+    return env_int("TB_SHARDS", 1, minimum=1, maximum=64)
+
+
+def router_queue() -> int:
+    """TB_ROUTER_QUEUE: bound on concurrently open client requests in
+    the router; fresh requests beyond it are shed with a typed
+    Command.client_busy (the same admission contract the replicas
+    use)."""
+    return env_int("TB_ROUTER_QUEUE", 256, minimum=1)
+
+
+def coord_retry_ms() -> int:
+    """TB_COORD_RETRY_MS: coordinator sub-operation retry cadence —
+    how long the router waits for a shard's reply to a 2PC leg before
+    re-issuing it (idempotent: derived ids dedupe re-drives)."""
+    return env_int("TB_COORD_RETRY_MS", 1000, minimum=10,
+                   maximum=60_000)
+
+
+def view_change_budget_s() -> float:
+    """Worst-case time for one shard to elect a new primary: the
+    backup's view-change timeout in wall-clock terms (vsr/multi.py
+    VIEW_CHANGE_TICKS at the shared TICK_NS cadence)."""
+    from tigerbeetle_tpu.constants import TICK_NS
+    from tigerbeetle_tpu.vsr.multi import VIEW_CHANGE_TICKS
+
+    return VIEW_CHANGE_TICKS * TICK_NS / 1e9
+
+
+def coord_timeout_s() -> int:
+    """TB_COORD_TIMEOUT_S: cross-shard hold timeout (seconds) — the
+    pending-transfer timeout stamped on both 2PC holds, bounding how
+    long an orphaned hold (coordinator lost before its decision) can
+    reserve balances before the shard's own expiry pulse voids it.
+
+    Named constraint: must EXCEED a shard's view-change budget.  The
+    commit decision is durable the moment the debit-side hold posts;
+    the credit-side post may then have to wait out a full primary
+    failover on the credit shard, and a hold that can expire inside
+    that window would turn a decided commit into a half-applied
+    transfer (the compensation path — flagged, never silent — exists
+    for exactly the case this constraint rules out)."""
+    value = env_int("TB_COORD_TIMEOUT_S", 30, minimum=1,
+                    maximum=24 * 3600)
+    budget = view_change_budget_s()
+    if value <= budget:
+        _fail(
+            "TB_COORD_TIMEOUT_S", str(value),
+            f"must exceed the view-change budget ({budget:g}s) — a "
+            "decided cross-shard commit must survive one primary "
+            "failover on the credit shard without its hold expiring",
+        )
+    return value

@@ -706,7 +706,20 @@ class VsrReplica(Replica):
                 # reply slot and evict an innocent session — reference:
                 # src/vsr/replica.zig:5035-5100).
                 if not peek:
-                    self._send_register_reply(client, entry)
+                    # The resume hint must also cover the session's
+                    # IN-FLIGHT requests (pipeline/queue/tail): a
+                    # failed-over session owner resuming from the
+                    # committed number alone collided with its dead
+                    # predecessor's uncommitted ops and adopted their
+                    # replies (sharded-VOPR seed 2046).  While the
+                    # tail is not materialized the bound is unknowable
+                    # — defer the replay instead of guessing.
+                    inflight_now = self._inflight_requests()
+                    if inflight_now is UNDECIDABLE:
+                        return "queue"
+                    self._send_register_reply(
+                        client, entry, inflight_now
+                    )
                 return "drop"
             # No session yet: fall through to the in-flight scans — a
             # retransmitted register whose original is still in flight
@@ -1070,12 +1083,28 @@ class VsrReplica(Replica):
         wire.finalize_header(head, body)
         self._primary_prepare(head, body, subs=subs)
 
-    def _send_register_reply(self, client: int, entry: Session) -> None:
+    def _send_register_reply(self, client: int, entry: Session,
+                             inflight=None) -> None:
+        # Session-resume hint: the highest request number this session
+        # has committed OR still has in flight (pipeline, queue,
+        # journal tail — anything that could yet commit is visible to
+        # a normal-status primary).  A failed-over session owner (the
+        # sharded router's coordinator identity) resumes its numbering
+        # safely above it — re-registering under a fresh id instead
+        # would grow the session table until an eviction hit an
+        # innocent live session (found by the sharded VOPR at 18
+        # coordinator kills).  Plain clients ignore the field.
+        bound = entry.request
+        if inflight:
+            bound = max(
+                [bound] + [r for (c, r) in inflight if c == client]
+            )
         reply = wire.make_header(
             command=Command.reply, operation=VsrOperation.register,
             cluster=self.cluster, client=client,
             request=0, view=self.view,
             op=entry.session, commit=entry.session,
+            context=bound,
         )
         wire.finalize_header(reply, b"")
         self._gc_send_client(client, reply, b"")
