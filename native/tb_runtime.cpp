@@ -90,7 +90,15 @@ struct tb_bus {
     std::map<int, Connection> conns;       // conn id -> state
     std::map<int, int> fd_to_conn;
     std::deque<tb_event> events;
-    std::vector<std::vector<uint8_t>> held;  // message buffers for events
+    // Message buffers backing queued events' data pointers, FIFO in
+    // event order.  A buffer must outlive BOTH its queued event and
+    // (for the legacy next_event API) the poll that follows its
+    // consumption — so consumed buffers are counted and reclaimed at
+    // the next poll, NOT freed on pop (clearing only when the event
+    // deque drained empty leaked every buffer under sustained load,
+    // where the deque is never observed empty).
+    std::deque<std::vector<uint8_t>> held;
+    size_t held_consumed = 0;
 };
 
 tb_bus* tb_bus_create(uint32_t message_size_max) {
@@ -184,6 +192,24 @@ int tb_bus_send(tb_bus* bus, int conn, const uint8_t* data, uint32_t len) {
     return 0;
 }
 
+// Scatter-gather send: header + body queued as ONE message without
+// the caller concatenating them first (the Python-side `header.tobytes
+// () + body` concat copied every megabyte body an extra time per hop).
+int tb_bus_send2(tb_bus* bus, int conn, const uint8_t* head,
+                 uint32_t head_len, const uint8_t* body,
+                 uint32_t body_len) {
+    auto it = bus->conns.find(conn);
+    if (it == bus->conns.end()) return -1;
+    Connection& c = it->second;
+    c.send_queue.emplace_back();
+    auto& msg = c.send_queue.back();
+    msg.reserve(size_t(head_len) + body_len);
+    msg.insert(msg.end(), head, head + head_len);
+    msg.insert(msg.end(), body, body + body_len);
+    bus_arm(bus, c);
+    return 0;
+}
+
 static void bus_close_conn(tb_bus* bus, int id) {
     auto it = bus->conns.find(id);
     if (it == bus->conns.end()) return;
@@ -216,7 +242,18 @@ static void bus_drain_recv(tb_bus* bus, int id, Connection& c) {
 }
 
 int tb_bus_poll(tb_bus* bus, int timeout_ms) {
-    bus->held.clear();
+    // Reclaim buffers whose message events were consumed before this
+    // poll (their data pointers were only promised valid until now);
+    // buffers for still-queued events stay (partial drains — arena
+    // full — leave events queued across polls).
+    while (bus->held_consumed > 0 && !bus->held.empty()) {
+        bus->held.pop_front();
+        bus->held_consumed--;
+    }
+    if (bus->events.empty()) {
+        bus->held.clear();
+        bus->held_consumed = 0;
+    }
     epoll_event evs[64];
     int n = epoll_wait(bus->epfd, evs, 64, timeout_ms);
     for (int i = 0; i < n; i++) {
@@ -294,7 +331,44 @@ int tb_bus_next_event(tb_bus* bus, tb_event* out) {
     if (bus->events.empty()) return 0;
     *out = bus->events.front();
     bus->events.pop_front();
+    if (out->type == 3 && out->len) bus->held_consumed++;
     return 1;
+}
+
+// One-call drain for the columnar ingest fast path: poll, then copy
+// every pending event out in one pass — message payloads packed
+// back-to-back into `arena`, one (type, conn, offset, len) row per
+// event.  Returns the number of events emitted; events that don't fit
+// this arena stay queued for the next call (their buffers are held
+// until consumed — see tb_bus_poll).  This replaces the per-event
+// ctypes round trip AND hands Python one contiguous buffer the batch
+// decoder (tb_fastpath tb_fp_verify_frames) can verify in one pass.
+int tb_bus_poll_drain(tb_bus* bus, int timeout_ms, uint8_t* arena,
+                      uint64_t arena_cap, int32_t* types, int32_t* conns,
+                      uint64_t* offsets, uint32_t* lens,
+                      int32_t max_events) {
+    tb_bus_poll(bus, timeout_ms);
+    int32_t n = 0;
+    uint64_t at = 0;
+    while (n < max_events && !bus->events.empty()) {
+        const tb_event& ev = bus->events.front();
+        if (ev.type == 3 && ev.len) {
+            if (at + ev.len > arena_cap) break;  // next call resumes
+            memcpy(arena + at, ev.data, ev.len);
+            offsets[n] = at;
+            lens[n] = ev.len;
+            at += ev.len;
+            bus->held_consumed++;  // copied out: reclaim at next poll
+        } else {
+            offsets[n] = at;
+            lens[n] = 0;
+        }
+        types[n] = ev.type;
+        conns[n] = ev.conn;
+        bus->events.pop_front();
+        n++;
+    }
+    return n;
 }
 
 // ----------------------------------------------------------------------

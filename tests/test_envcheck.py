@@ -81,6 +81,71 @@ def test_tb_ckpt_async_disables_worker(monkeypatch, tmp_path):
     storage.close()
 
 
+def test_tb_fastpath_decode_validated(monkeypatch):
+    monkeypatch.setenv("TB_FASTPATH_DECODE", "fast")
+    with pytest.raises(envcheck.EnvVarError, match="TB_FASTPATH_DECODE"):
+        envcheck.fastpath_decode()
+    monkeypatch.setenv("TB_FASTPATH_DECODE", "2")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 1"):
+        envcheck.fastpath_decode()
+    monkeypatch.setenv("TB_FASTPATH_DECODE", "0")  # forced legacy path
+    assert envcheck.fastpath_decode() == 0
+    monkeypatch.delenv("TB_FASTPATH_DECODE")
+    assert envcheck.fastpath_decode() == 1  # default: columnar on
+
+
+def test_tb_fastpath_decode_zero_forces_legacy(monkeypatch, tmp_path):
+    """TB_FASTPATH_DECODE=0 must actually pin the server to the
+    per-message path (differential runs depend on it), and =1 must
+    engage the columnar drain when the native bus supports it."""
+    from tigerbeetle_tpu import constants as cfg
+    from tigerbeetle_tpu.runtime.native import native_available
+    from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+    if not native_available():
+        pytest.skip("native runtime not built")
+    from tigerbeetle_tpu.runtime.server import (
+        ReplicaServer, format_data_file,
+    )
+
+    def build(flag):
+        monkeypatch.setenv("TB_FASTPATH_DECODE", flag)
+        path = str(tmp_path / f"fp{flag}.tb")
+        format_data_file(path, cluster=5, config=cfg.TEST_MIN)
+        return ReplicaServer(
+            path, cluster=5, addresses=["127.0.0.1:0"], replica_index=0,
+            state_machine_factory=lambda: CpuStateMachine(cfg.TEST_MIN),
+            config=cfg.TEST_MIN,
+        )
+
+    off = build("0")
+    try:
+        assert off._fastpath_decode is False
+    finally:
+        off.close()
+    on = build("1")
+    try:
+        assert on._fastpath_decode == on.bus.native.supports_drain
+    finally:
+        on.close()
+
+
+def test_tb_drain_batch_constraint_named(monkeypatch):
+    monkeypatch.setenv("TB_DRAIN_BATCH", "many")
+    with pytest.raises(envcheck.EnvVarError, match="TB_DRAIN_BATCH"):
+        envcheck.drain_batch_max()
+    monkeypatch.setenv("TB_DRAIN_BATCH", "4")
+    with pytest.raises(envcheck.EnvVarError, match="per-message rounds"):
+        envcheck.drain_batch_max()
+    monkeypatch.setenv("TB_DRAIN_BATCH", str(1 << 17))
+    with pytest.raises(envcheck.EnvVarError, match="must be <="):
+        envcheck.drain_batch_max()
+    monkeypatch.setenv("TB_DRAIN_BATCH", "64")
+    assert envcheck.drain_batch_max() == 64
+    monkeypatch.delenv("TB_DRAIN_BATCH")
+    assert envcheck.drain_batch_max() == 4096
+
+
 def test_window_ring_constraint_named():
     with pytest.raises(envcheck.EnvVarError) as err:
         _validate_window_ring(200, 256)

@@ -1,0 +1,361 @@
+"""Columnar ingest fast path: fixture-oracle differential tests.
+
+The server drain (runtime/server.py) may decode a whole poll's worth
+of client frames in one batch pass (native tb_fp_verify_frames or the
+vectorized Python fallback) and coalesce replies per drain — and the
+wire contract must not move by a single bit.  The checked-in client
+fixtures (clients/fixtures/frames.json, conversation.json) are the
+oracle: decode columns must equal the legacy per-frame decode, and a
+pinned-clock server must produce byte-identical reply FRAMES with the
+columnar path forced on vs off, including when request frames arrive
+torn across drain boundaries.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.runtime import fastpath
+from tigerbeetle_tpu.runtime.native import native_available
+from tigerbeetle_tpu.vsr import wire
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "clients", "fixtures")
+HEADER_SIZE = 256
+CLUSTER = 3
+PINNED_NS = 1_000_000_000
+
+
+def _fixture_frames() -> list[bytes]:
+    with open(os.path.join(FIXTURES, "frames.json")) as fh:
+        return [bytes.fromhex(c["frame_hex"]) for c in json.load(fh)]
+
+
+def _arena_of(frames: list[bytes]):
+    blob = b"".join(frames)
+    arena = np.frombuffer(blob, np.uint8)
+    offsets = np.zeros(len(frames), np.uint64)
+    lens = np.zeros(len(frames), np.uint32)
+    at = 0
+    for i, f in enumerate(frames):
+        offsets[i] = at
+        lens[i] = len(f)
+        at += len(f)
+    return arena, offsets, lens
+
+
+def _mutations(frames: list[bytes]) -> list[bytes]:
+    """The fixture frames plus torn/corrupt variants every decoder
+    must reject identically: flipped body byte, flipped header byte,
+    wrong version, size field lying about the frame length."""
+    out = list(frames)
+    body_frame = next(f for f in frames if len(f) > HEADER_SIZE)
+    flipped_body = bytearray(body_frame)
+    flipped_body[HEADER_SIZE + 3] ^= 0xFF
+    out.append(bytes(flipped_body))
+    flipped_header = bytearray(frames[0])
+    flipped_header[40] ^= 0x01
+    out.append(bytes(flipped_header))
+    bad_version = bytearray(frames[0])
+    bad_version[155] = 99
+    out.append(bytes(bad_version))
+    lying_size = bytearray(body_frame)
+    lying_size[144:148] = (len(body_frame) + 128).to_bytes(4, "little")
+    out.append(bytes(lying_size))
+    return out
+
+
+def test_batch_verify_matches_legacy_per_frame():
+    frames = _mutations(_fixture_frames())
+    arena, offsets, lens = _arena_of(frames)
+    legacy = []
+    for f in frames:
+        h = wire.header_from_bytes(f[:HEADER_SIZE])
+        legacy.append(int(wire.verify_header(h, f[HEADER_SIZE:])))
+    ok_py = fastpath.verify_frames_py(arena, offsets, lens, len(frames))
+    assert [int(v) for v in ok_py] == legacy
+    ok_native = fastpath.verify_frames(arena, offsets, lens, len(frames))
+    if ok_native is None:
+        pytest.skip("native fastpath not built (fallback verified above)")
+    assert [int(v) for v in ok_native] == legacy
+
+
+def test_headers_from_arena_bit_identical():
+    frames = _fixture_frames()
+    arena, offsets, _lens = _arena_of(frames)
+    hdrs = wire.headers_from_arena(arena, offsets, len(frames))
+    for i, f in enumerate(frames):
+        assert hdrs[i].tobytes() == f[:HEADER_SIZE]
+        legacy = wire.header_from_bytes(f[:HEADER_SIZE])
+        for name in ("command", "operation", "request", "client_lo",
+                     "size", "trace_id", "trace_flags"):
+            assert hdrs[i][name] == legacy[name], name
+
+
+def test_finalize_headers_batch_parity():
+    bodies = [b"", b"r" * 333, bytes(range(128)) * 5]
+    hdrs = np.zeros(len(bodies), wire.HEADER_DTYPE)
+    hdrs["version"] = wire.VERSION
+    hdrs["command"] = int(wire.Command.reply)
+    hdrs["request"] = np.arange(len(bodies))
+    hdrs["client_lo"] = 0xC0FFEE
+    oracle = hdrs.copy()
+    wire.finalize_headers_py(oracle, bodies)
+    for i, b in enumerate(bodies):
+        assert wire.verify_header(oracle[i], b)
+    if not fastpath.finalize_headers(hdrs, bodies):
+        pytest.skip("native fastpath not built (fallback verified above)")
+    assert hdrs.tobytes() == oracle.tobytes()
+
+
+# ----------------------------------------------------------------------
+# Live differential replay: columnar on vs off, bit-identical replies.
+
+
+@pytest.fixture()
+def pinned_time(monkeypatch):
+    # Same determinism trick as the conversation fixture: pinned wall
+    # clock (timestamps derive from event counts) + pinned monotonic
+    # (no pulse op lands at a scheduling-dependent position), so two
+    # separate server runs produce byte-identical reply frames.
+    monkeypatch.setattr(time, "time_ns", lambda: PINNED_NS)
+    monkeypatch.setattr(time, "monotonic_ns", lambda: 0)
+
+
+def _replay_requests(tmp_path, tag: str, requests: list[bytes],
+                     chunker) -> tuple[list[bytes], dict]:
+    """One pinned-clock server run: send each request's bytes through
+    `chunker` (which may tear them across writes), read one reply
+    frame per request.  -> (reply frames, registry snapshot)."""
+    from tigerbeetle_tpu.runtime.server import (
+        ReplicaServer, format_data_file,
+    )
+    from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+    path = str(tmp_path / f"{tag}.tigerbeetle")
+    format_data_file(path, cluster=CLUSTER, replica_index=0,
+                     replica_count=1)
+    server = ReplicaServer(
+        path, addresses=["127.0.0.1:0"], replica_index=0,
+        state_machine_factory=CpuStateMachine,
+    )
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            server.poll_once(10)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    replies = []
+    try:
+        sock = socket.create_connection(
+            ("127.0.0.1", server.port), timeout=30
+        )
+        sock.settimeout(30)
+        buf = b""
+        for req in requests:
+            for chunk in chunker(req):
+                sock.sendall(chunk)
+            while True:
+                if len(buf) >= HEADER_SIZE:
+                    size = int.from_bytes(buf[144:148], "little")
+                    if len(buf) >= size:
+                        replies.append(buf[:size])
+                        buf = buf[size:]
+                        break
+                chunk = sock.recv(1 << 20)
+                assert chunk, "server closed mid-replay"
+                buf += chunk
+        sock.close()
+        return replies, server.registry.snapshot()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        server.close()
+
+
+def _conversation_requests() -> list[bytes]:
+    with open(os.path.join(FIXTURES, "conversation.json")) as fh:
+        return [bytes.fromhex(s["request_hex"]) for s in json.load(fh)]
+
+
+@pytest.mark.skipif(not native_available(), reason="native runtime not built")
+def test_conversation_columnar_vs_legacy_bit_identical(
+    tmp_path, pinned_time, monkeypatch
+):
+    """The full recorded conversation (register, failures, RETRANSMIT,
+    lookups, queries) through the columnar drain vs the legacy
+    per-message path: every reply frame byte-identical — headers,
+    trace context, checksums, bodies — and equal to the checked-in
+    transcript."""
+    requests = _conversation_requests()
+
+    def whole(req):
+        return [req]
+
+    monkeypatch.setenv("TB_FASTPATH_DECODE", "1")
+    on, snap_on = _replay_requests(tmp_path, "on", requests, whole)
+    monkeypatch.setenv("TB_FASTPATH_DECODE", "0")
+    off, snap_off = _replay_requests(tmp_path, "off", requests, whole)
+    assert on == off
+    with open(os.path.join(FIXTURES, "conversation.json")) as fh:
+        recorded = [bytes.fromhex(s["reply_hex"]) for s in json.load(fh)]
+    assert on == recorded
+    assert snap_on.get("fastpath.batch_decode_hits", 0) > 0
+    assert snap_off.get("fastpath.batch_decode_hits", 0) == 0
+
+
+@pytest.mark.skipif(not native_available(), reason="native runtime not built")
+def test_torn_frames_across_drain_boundaries(
+    tmp_path, pinned_time, monkeypatch
+):
+    """Fuzz the framing: request bytes torn into random chunks with
+    scheduling gaps, so frames split and coalesce arbitrarily across
+    poll drains — replies stay bit-identical between the columnar and
+    legacy paths (and across tear patterns, since both runs use the
+    same seed)."""
+    requests = _conversation_requests()
+
+    def torn(req: bytes, rng=np.random.default_rng(4242)):
+        chunks = []
+        at = 0
+        while at < len(req):
+            n = int(rng.integers(1, 512))
+            chunks.append(req[at : at + n])
+            at += n
+        return chunks
+
+    monkeypatch.setenv("TB_FASTPATH_DECODE", "1")
+    on, _ = _replay_requests(
+        tmp_path, "torn_on", requests, lambda r: torn(r)
+    )
+    monkeypatch.setenv("TB_FASTPATH_DECODE", "0")
+    off, _ = _replay_requests(
+        tmp_path, "torn_off", requests, lambda r: torn(r)
+    )
+    assert on == off
+    with open(os.path.join(FIXTURES, "conversation.json")) as fh:
+        recorded = [bytes.fromhex(s["reply_hex"]) for s in json.load(fh)]
+    assert on == recorded
+
+
+@pytest.mark.skipif(not native_available(), reason="native runtime not built")
+def test_wrong_cluster_dropped_on_both_arms(tmp_path, pinned_time,
+                                            monkeypatch):
+    """A checksum-valid request addressed to a DIFFERENT cluster must
+    be dropped by the columnar intake exactly as on_message drops it
+    (cross-cluster isolation): the next same-connection request for
+    the right cluster is answered, the foreign one never is."""
+    from tigerbeetle_tpu import types
+
+    frames = _fixture_frames()
+    acct = np.zeros(1, types.ACCOUNT_DTYPE)
+    acct["id_lo"] = 4242
+    acct["ledger"] = 1
+    acct["code"] = 1
+    foreign = wire.make_header(
+        command=wire.Command.request, cluster=CLUSTER + 1,
+        client=0xBAD, request=1,
+        operation=int(types.Operation.create_accounts),
+    )
+    wire.finalize_header(foreign, acct.tobytes())
+    ids = np.zeros(1, types.U128_PAIR_DTYPE)
+    ids[0]["lo"] = 4242
+    lookup = wire.make_header(
+        command=wire.Command.request, cluster=CLUSTER,
+        client=0xC0FFEE, request=1,
+        operation=int(types.Operation.lookup_accounts),
+    )
+    wire.finalize_header(lookup, ids.tobytes())
+    for flag, tag in (("1", "iso_on"), ("0", "iso_off")):
+        monkeypatch.setenv("TB_FASTPATH_DECODE", flag)
+        # register (real) || foreign-cluster create_accounts || real
+        # lookup: the foreign create must never commit, so the lookup
+        # reply body is empty on BOTH arms.
+        replies, _snap = _replay_requests(
+            tmp_path, tag,
+            [frames[0],
+             foreign.tobytes() + acct.tobytes()
+             + lookup.tobytes() + ids.tobytes()],
+            lambda req: [req],
+        )
+        assert replies[1][HEADER_SIZE:] == b"", (
+            f"arm {flag}: foreign-cluster request leaked into commit"
+        )
+
+
+@pytest.mark.skipif(not native_available(), reason="native runtime not built")
+def test_frames_fixture_flood_one_drain(tmp_path, pinned_time, monkeypatch):
+    """All frames.json requests flushed in ONE write after register:
+    the whole stream lands in a single drain, so the columnar path
+    multiplexes the intake — reply frames must still match the legacy
+    path bit-for-bit (pinned clock makes both runs deterministic)."""
+    frames = _fixture_frames()
+
+    def run_burst(flag, tag):
+        from tigerbeetle_tpu.runtime.server import (
+            ReplicaServer, format_data_file,
+        )
+        from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+        monkeypatch.setenv("TB_FASTPATH_DECODE", flag)
+        path = str(tmp_path / f"{tag}.tigerbeetle")
+        format_data_file(path, cluster=CLUSTER, replica_index=0,
+                         replica_count=1)
+        server = ReplicaServer(
+            path, addresses=["127.0.0.1:0"], replica_index=0,
+            state_machine_factory=CpuStateMachine,
+        )
+        stop = threading.Event()
+
+        def loop():
+            while not stop.is_set():
+                server.poll_once(10)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        try:
+            sock = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=30
+            )
+            sock.settimeout(30)
+            buf = b""
+
+            def read_frame():
+                nonlocal buf
+                while True:
+                    if len(buf) >= HEADER_SIZE:
+                        size = int.from_bytes(buf[144:148], "little")
+                        if len(buf) >= size:
+                            out, buf2 = buf[:size], buf[size:]
+                            buf = buf2
+                            return out
+                    chunk = sock.recv(1 << 20)
+                    assert chunk
+                    buf += chunk
+
+            sock.sendall(frames[0])  # register
+            replies = [read_frame()]
+            sock.sendall(b"".join(frames[1:]))  # one drain's worth
+            for _ in frames[1:]:
+                replies.append(read_frame())
+            sock.close()
+            return replies
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            server.close()
+
+    on = run_burst("1", "flood_on")
+    off = run_burst("0", "flood_off")
+    assert on == off
+    for f, r in zip(frames, on):
+        rh = wire.header_from_bytes(r[:HEADER_SIZE])
+        assert wire.verify_header(rh, r[HEADER_SIZE:])
+        assert int(rh["request"]) == int.from_bytes(f[112:116], "little")

@@ -59,10 +59,26 @@ class _Server:
         self.server.close()
 
 
-def test_two_replica_group_commit_smoke(tmp_path):
+def test_two_replica_group_commit_smoke(tmp_path, monkeypatch):
+    """Both ingest arms, one assertion set: the cluster runs once with
+    the columnar fast path forced ON and once forced OFF.  The
+    create_transfers reply BODIES (result pairs, including a
+    deliberate failure per session) must be identical across arms —
+    the wire contract does not move with the decode strategy — and
+    the ON arm's scrape must show nonzero fastpath.batch_decode hits
+    (bit-level reply-frame identity incl. headers is pinned by the
+    pinned-clock differential in tests/test_fastpath_decode.py)."""
+    replies_on = _run_cluster_once(tmp_path / "on", "1", monkeypatch)
+    replies_off = _run_cluster_once(tmp_path / "off", "0", monkeypatch)
+    assert replies_on == replies_off
+
+
+def _run_cluster_once(tmp_path, fastpath_flag, monkeypatch):
     from tigerbeetle_tpu.client import Client
     from tigerbeetle_tpu.runtime.server import format_data_file
 
+    monkeypatch.setenv("TB_FASTPATH_DECODE", fastpath_flag)
+    tmp_path.mkdir(parents=True, exist_ok=True)
     n_sessions = max(1, int(os.environ.get("BENCH_REPL_SESSIONS", "2")))
     ports = _free_ports(N_REPLICAS)
     addresses = [f"127.0.0.1:{p}" for p in ports]
@@ -76,6 +92,7 @@ def test_two_replica_group_commit_smoke(tmp_path):
         _Server(paths[i], addresses, i) for i in range(N_REPLICAS)
     ]
     clients = []
+    reply_bodies: dict = {}
     try:
         for r in servers:
             # Group commit must be live on the real server storage.
@@ -90,19 +107,43 @@ def test_two_replica_group_commit_smoke(tmp_path):
 
         errors = []
 
+        def transfer_body(tid, dr, cr):
+            row = np.zeros(1, types.TRANSFER_DTYPE)
+            row["id_lo"] = tid
+            row["debit_account_id_lo"] = dr
+            row["credit_account_id_lo"] = cr
+            row["amount_lo"] = 1
+            row["ledger"] = 1
+            row["code"] = 1
+            return row.tobytes()
+
         def drive(s):
             try:
                 c = Client(addr, CLUSTER, client_id=100 + s,
                            timeout_ms=30_000)
                 clients.append(c)
                 base = 1000 * (s + 1)
+                bodies = []
                 for k in range(TRANSFERS_PER_SESSION):
-                    failures = c.create_transfers([
-                        {"id": base + k, "debit_account_id": 1,
-                         "credit_account_id": 2, "amount": 1,
-                         "ledger": 1, "code": 1}
-                    ])
-                    assert failures == [], failures
+                    reply = c._native.request(
+                        types.Operation.create_transfers,
+                        transfer_body(base + k, 1, 2), 30_000,
+                    )
+                    assert reply == b"", reply
+                    bodies.append(reply)
+                # Deliberate failure so the compared reply bytes are
+                # non-trivial: debit == credit must come back as
+                # accounts_must_be_different, identically in both arms.
+                reply = c._native.request(
+                    types.Operation.create_transfers,
+                    transfer_body(base + 900, 1, 1), 30_000,
+                )
+                res = np.frombuffer(reply, types.CREATE_RESULT_DTYPE)
+                assert len(res) == 1 and int(res[0]["result"]) == int(
+                    types.CreateTransferResult.accounts_must_be_different
+                ), res
+                bodies.append(reply)
+                reply_bodies[s] = bodies
             except Exception as exc:  # noqa: BLE001
                 errors.append(f"session {s}: {exc!r}")
 
@@ -154,6 +195,16 @@ def test_two_replica_group_commit_smoke(tmp_path):
             assert snap["storage.fsyncs"] == server.server.storage.stat_fsyncs
             assert snap["vsr.commit_min"] == r.commit_min
             assert snap["version"] > 0
+            # Columnar-ingest contract: the forced arm is the arm that
+            # actually ran — nonzero batch-decode hits when on, zero
+            # when off — and a native-capable build never fell back.
+            if fastpath_flag == "1":
+                assert snap["fastpath.batch_decode_hits"] > 0
+                if not snap["fastpath.native_unavailable"]:
+                    assert snap["fastpath.batch_decode_fallbacks"] == 0
+                assert snap["server.decode_us_per_event.count"] > 0
+            else:
+                assert snap["fastpath.batch_decode_hits"] == 0
             if i == 0:
                 assert snap["vsr.gc_flushes"] > 0
                 # r10 contract: group commit => fewer covering syncs
@@ -161,6 +212,7 @@ def test_two_replica_group_commit_smoke(tmp_path):
                 # covers a whole drain), and every sync accounted.
                 assert snap["vsr.gc_flushes"] <= snap["vsr.prepares_written"]
                 assert snap["storage.fsyncs"] > 0
+        return reply_bodies
     finally:
         for c in clients:
             try:

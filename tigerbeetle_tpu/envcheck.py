@@ -84,6 +84,34 @@ def env_choice(name: str, default: str, choices: tuple[str, ...]) -> str:
     return raw
 
 
+def fastpath_decode() -> int:
+    """TB_FASTPATH_DECODE: 1 (default) drains the server bus through
+    the columnar ingest fast path — one arena drain + one batch
+    checksum-verify pass per poll (native tb_fp_verify_frames, or the
+    vectorized Python fallback), headers gathered in one vectorized
+    pass, replies coalesced per drain.  0 forces the legacy per-message
+    decode path end to end, for differential runs (replies must stay
+    bit-identical either way)."""
+    return env_int("TB_FASTPATH_DECODE", 1, minimum=0, maximum=1)
+
+
+def drain_batch_max() -> int:
+    """TB_DRAIN_BATCH: cap on events pulled per columnar drain call —
+    bounds the arena scan and the latency of one decode pass under a
+    flood (excess events stay queued in the native bus and drain on
+    the next zero-timeout round).  Must cover at least one pipeline's
+    worth of messages or the drain loop degenerates to per-message
+    rounds."""
+    value = env_int("TB_DRAIN_BATCH", 4096, maximum=1 << 16)
+    if value < 16:
+        _fail(
+            "TB_DRAIN_BATCH", str(value),
+            "must be >= 16 — smaller drain batches degenerate the "
+            "columnar decode into per-message rounds",
+        )
+    return value
+
+
 def metrics_enabled() -> int:
     """TB_METRICS: 1 (default) records latency histograms in the obs
     registry; 0 skips the clock reads (counters stay live — logic and

@@ -29,6 +29,7 @@ _LIB_PATH = os.environ.get(
 )
 
 _lib = None
+_lib_failed = False  # negative cache: never retry (or re-make) per call
 _lib_lock = threading.Lock()
 
 _U64P = ctypes.POINTER(ctypes.c_uint64)
@@ -77,23 +78,30 @@ def kway_merge(streams, value_size: int):
 
 
 def _load():
-    global _lib
+    global _lib, _lib_failed
     with _lib_lock:
         if _lib is not None:
             return _lib
+        if _lib_failed:
+            # This round's drain/commit hot paths probe availability
+            # per call: without this, a host where the build fails
+            # would fork a `make` per server drain instead of
+            # degrading to the pure-Python fallback.
+            return None
         if os.environ.get("TB_FASTPATH_DISABLE"):
             return None
+        _lib_failed = True  # cleared on success below
         # Always invoke make: a no-op when fresh, and it rebuilds a
         # stale prebuilt .so whose missing symbols would fail the
-        # argtypes registration below.
-        try:
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR], check=True,
-                capture_output=True, timeout=120,
-            )
-        except (OSError, subprocess.SubprocessError):
-            if not os.path.exists(_LIB_PATH):
-                return None
+        # argtypes registration below.  Build failures are recorded +
+        # warned (runtime/native.py _run_make), never silently eaten —
+        # a bench must not report pure-Python fallback numbers as
+        # native.
+        from tigerbeetle_tpu.runtime import native as native_mod
+
+        native_mod._run_make(_LIB_PATH)
+        if not os.path.exists(_LIB_PATH):
+            return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
@@ -163,7 +171,21 @@ def _load():
             _U64P, _U64P, _U64P,
             _U32P, _U32P, _U32P, _U32P, _U32P, _U64P, _U8P,
         ]
+        # Columnar ingest (absent from a stale prebuilt .so when the
+        # rebuild failed: callers fall back per-call).
+        try:
+            lib.tb_fp_verify_frames.argtypes = [
+                _U8P, ctypes.POINTER(ctypes.c_uint64), _U32P,
+                ctypes.c_uint32, _U8P,
+            ]
+            lib.tb_fp_finalize_headers.argtypes = [
+                _U8P, ctypes.c_uint32, ctypes.POINTER(_U8P), _U32P,
+            ]
+        except AttributeError:
+            lib.tb_fp_verify_frames = None
+            lib.tb_fp_finalize_headers = None
         _lib = lib
+        _lib_failed = False
         return _lib
 
 
@@ -476,3 +498,118 @@ class NativeFastpath:
 
 def available() -> bool:
     return _load() is not None
+
+
+# ----------------------------------------------------------------------
+# Columnar ingest: batch frame verification + batch reply finalize
+# (the server-drain half of the fast path — runtime/server.py).
+
+
+def batch_verify_available() -> bool:
+    lib = _load()
+    return lib is not None and getattr(
+        lib, "tb_fp_verify_frames", None
+    ) is not None
+
+
+def verify_frames(arena: np.ndarray, offsets: np.ndarray,
+                  lens: np.ndarray, n: int):
+    """One native pass over `n` frames packed in `arena`: header +
+    body checksums, version, size — exactly wire.verify_header per
+    frame.  -> u8 ok flags, or None when the native library lacks the
+    symbol (caller takes the vectorized Python fallback).  The flag
+    buffer is allocated per call: several buses poll concurrently in
+    one process (in-process test clusters, router + shards) and
+    ctypes releases the GIL during the C pass — a shared module
+    buffer raced."""
+    lib = _load()
+    if lib is None or getattr(lib, "tb_fp_verify_frames", None) is None:
+        return None
+    ok = np.empty(n, np.uint8)
+    offsets = np.ascontiguousarray(offsets[:n], np.uint64)
+    lens = np.ascontiguousarray(lens[:n], np.uint32)
+    lib.tb_fp_verify_frames(
+        ctypes.cast(arena.ctypes.data, _U8P),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        _p(lens, _U32P), n, _p(ok, _U8P),
+    )
+    return ok
+
+
+def verify_frames_py(arena: np.ndarray, offsets: np.ndarray,
+                     lens: np.ndarray, n: int,
+                     hdrs: np.ndarray | None = None) -> np.ndarray:
+    """Pure-Python vectorized fallback: structural checks (version,
+    size) in one numpy pass, checksums per frame via hashlib (C-speed
+    SHA-256 — the same hashes the legacy path paid, minus its
+    per-message numpy/dispatch churn).  Pass `hdrs` when the caller
+    already gathered the header records (verify_and_gather) so the
+    fallback arm doesn't pay the gather twice."""
+    from tigerbeetle_tpu.vsr import wire
+
+    if hdrs is None:
+        hdrs = wire.headers_from_arena(arena, offsets, n)
+    ok = (
+        (hdrs["version"] == wire.VERSION)
+        & (hdrs["size"] == lens[:n])
+        & (lens[:n] >= np.uint32(256))
+    )
+    mv = memoryview(arena)  # zero-copy per-frame slices
+    for i in np.nonzero(ok)[0]:
+        off = int(offsets[i])
+        size = int(lens[i])
+        frame = mv[off : off + size]
+        c = wire.checksum(frame[16:256])
+        if (
+            int(hdrs[i]["checksum_lo"]) != c & 0xFFFFFFFFFFFFFFFF
+            or int(hdrs[i]["checksum_hi"]) != c >> 64
+        ):
+            ok[i] = False
+            continue
+        cb = wire.checksum(frame[256:])
+        if (
+            int(hdrs[i]["checksum_body_lo"]) != cb & 0xFFFFFFFFFFFFFFFF
+            or int(hdrs[i]["checksum_body_hi"]) != cb >> 64
+        ):
+            ok[i] = False
+    return ok.astype(np.uint8)
+
+
+def verify_and_gather(arena: np.ndarray, moffs: np.ndarray,
+                      mlens: np.ndarray):
+    """The shared drain-decode sequence (server dispatch + open-loop
+    client completions): one batch checksum pass over the message
+    frames — native, or the vectorized Python fallback — plus one
+    vectorized header gather.  -> (ok u8 flags, (n,) HEADER_DTYPE
+    records, native bool)."""
+    from tigerbeetle_tpu.vsr import wire
+
+    n = len(moffs)
+    hdrs = wire.headers_from_arena(arena, moffs, n)
+    ok = verify_frames(arena, moffs, mlens, n)
+    native = ok is not None
+    if not native:
+        ok = verify_frames_py(arena, moffs, mlens, n, hdrs=hdrs)
+    return ok, hdrs, native
+
+
+def finalize_headers(headers: np.ndarray, bodies: list) -> bool:
+    """Batch reply finalize: set size + checksum_body + checksum on
+    each 256-byte header record in the contiguous `headers` array for
+    its body in `bodies` — one C call instead of 2n hashlib calls.
+    Returns False when the native symbol is unavailable (caller loops
+    wire.finalize_header)."""
+    lib = _load()
+    if lib is None or getattr(lib, "tb_fp_finalize_headers", None) is None:
+        return False
+    n = len(headers)
+    assert headers.dtype.itemsize == 256 and headers.flags["C_CONTIGUOUS"]
+    assert len(bodies) == n
+    ptrs = (_U8P * n)(
+        *[ctypes.cast(ctypes.c_char_p(b), _U8P) for b in bodies]
+    )
+    blens = np.array([len(b) for b in bodies], np.uint32)
+    lib.tb_fp_finalize_headers(
+        ctypes.cast(headers.ctypes.data, _U8P), n, ptrs, _p(blens, _U32P)
+    )
+    return True

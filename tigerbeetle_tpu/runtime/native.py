@@ -22,7 +22,59 @@ _LIB_PATH = os.environ.get(
 )
 
 _lib = None
+_lib_failed = False  # negative cache: don't re-make per failed load
 _lib_lock = threading.Lock()
+# Build-failure forensics: when `make -C native` fails we fall back to
+# a prebuilt .so (or to None), but the failure must be VISIBLE — a
+# silent pure-Python fallback let benches report fallback numbers as
+# native.  The make error tail is kept here for build_error() and the
+# one-shot warning below; obs gauges surface it to scrapes.
+_build_error: str | None = None
+
+
+def build_error() -> str | None:
+    """Tail of the native build failure, or None when the build was
+    clean (or not attempted yet)."""
+    return _build_error
+
+
+_make_attempted = False
+
+
+def _run_make(lib_path: str) -> None:
+    """Invoke make; record + warn ONCE on failure instead of silently
+    swallowing it (the prebuilt-.so / pure-Python fallback still
+    engages, but now visibly).  One `make` covers both libraries
+    (Makefile `all:`), so the runtime and fastpath loaders share a
+    single attempt — and a failing build warns once, not per caller."""
+    global _build_error, _make_attempted
+    if _make_attempted:
+        return
+    _make_attempted = True
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR], check=True,
+            capture_output=True, timeout=120,
+        )
+    except subprocess.CalledProcessError as exc:
+        tail = (exc.stderr or exc.stdout or b"")[-800:].decode(
+            "utf-8", "replace"
+        )
+        _build_error = f"make -C native failed (rc={exc.returncode}): {tail}"
+    except (OSError, subprocess.SubprocessError) as exc:
+        _build_error = f"make -C native failed: {exc!r}"
+    if _build_error is not None:
+        import warnings
+
+        fallback = (
+            "falling back to the prebuilt library"
+            if os.path.exists(lib_path)
+            else "no prebuilt library — pure-Python fallback"
+        )
+        warnings.warn(
+            f"native build failed ({fallback}): {_build_error}",
+            RuntimeWarning, stacklevel=3,
+        )
 
 
 class _Event(ctypes.Structure):
@@ -38,22 +90,20 @@ EV_ACCEPTED, EV_CONNECTED, EV_MESSAGE, EV_CLOSED = 1, 2, 3, 4
 
 
 def _load():
-    global _lib
+    global _lib, _lib_failed
     with _lib_lock:
         if _lib is not None:
             return _lib
+        if _lib_failed:
+            return None
+        _lib_failed = True  # cleared on success below
         # Always invoke make: the Makefile's dependency tracking makes
         # this a no-op when the library is fresh, and it REBUILDS a
         # stale prebuilt .so whose symbols would otherwise fail the
         # argtypes registration below with an AttributeError.
-        try:
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR], check=True,
-                capture_output=True, timeout=120,
-            )
-        except (OSError, subprocess.SubprocessError):
-            if not os.path.exists(_LIB_PATH):
-                return None
+        _run_make(_LIB_PATH)
+        if not os.path.exists(_LIB_PATH):
+            return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
@@ -94,7 +144,31 @@ def _load():
         lib.tb_checksum128.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64 * 2,
         ]
+        # Columnar drain + scatter-gather send (may be absent from a
+        # stale prebuilt .so when the rebuild failed — the bus then
+        # reports unsupported and callers keep the per-event paths).
+        try:
+            lib.tb_bus_send2.restype = ctypes.c_int
+            lib.tb_bus_send2.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_char_p, ctypes.c_uint32,
+            ]
+        except AttributeError:
+            lib.tb_bus_send2 = None
+        try:
+            lib.tb_bus_poll_drain.restype = ctypes.c_int
+            lib.tb_bus_poll_drain.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_int32,
+            ]
+        except AttributeError:
+            lib.tb_bus_poll_drain = None
         _lib = lib
+        _lib_failed = False
         return _lib
 
 
@@ -119,6 +193,49 @@ class NativeBus:
         self._bus = self._lib.tb_bus_create(message_size_max)
         if not self._bus:
             raise RuntimeError("tb_bus_create failed")
+        self._message_size_max = message_size_max
+        self._drain_bufs = None
+
+    @property
+    def supports_drain(self) -> bool:
+        return getattr(self._lib, "tb_bus_poll_drain", None) is not None
+
+    def poll_drain(self, timeout_ms: int = 0, max_events: int = 4096):
+        """Columnar drain: one C call copies every ready event into a
+        reusable arena — `(n, types, conns, offsets, lens, arena)`
+        numpy views, valid until the NEXT poll_drain/poll call.
+        Message payloads are `arena[offsets[i]: offsets[i]+lens[i]]`;
+        non-message events have len 0.  Returns None when the loaded
+        library predates the symbol (callers keep the per-event poll
+        path)."""
+        import numpy as np
+
+        if not self.supports_drain:
+            return None
+        bufs = self._drain_bufs
+        if bufs is None or len(bufs[0]) < max_events:
+            cap = max(
+                4 << 20, 2 * (self._message_size_max + 256)
+            )
+            bufs = self._drain_bufs = (
+                np.empty(max(max_events, 4096), np.int32),   # types
+                np.empty(max(max_events, 4096), np.int32),   # conns
+                np.empty(max(max_events, 4096), np.uint64),  # offsets
+                np.empty(max(max_events, 4096), np.uint32),  # lens
+                np.empty(cap, np.uint8),                     # arena
+            )
+        types, conns, offsets, lens, arena = bufs
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        n = self._lib.tb_bus_poll_drain(
+            self._bus, timeout_ms,
+            arena.ctypes.data_as(u8p), arena.nbytes,
+            types.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            conns.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            min(max_events, len(types)),
+        )
+        return n, types, conns, offsets, lens, arena
 
     def listen(self, host: str, port: int) -> int:
         rc = self._lib.tb_bus_listen(self._bus, host.encode(), port)
@@ -134,6 +251,16 @@ class NativeBus:
 
     def send(self, conn: int, data: bytes) -> None:
         self._lib.tb_bus_send(self._bus, conn, data, len(data))
+
+    def send2(self, conn: int, head: bytes, body: bytes) -> None:
+        """One queued message from two parts — no Python-side concat
+        (a megabyte body saved one full copy per hop)."""
+        if getattr(self._lib, "tb_bus_send2", None) is None:
+            self.send(conn, head + body)
+            return
+        self._lib.tb_bus_send2(
+            self._bus, conn, head, len(head), body, len(body)
+        )
 
     def close_conn(self, conn: int) -> None:
         self._lib.tb_bus_close(self._bus, conn)
