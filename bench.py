@@ -1908,6 +1908,19 @@ def _run_sharded_once(n_shards: int) -> dict:
                 "router_retries": int(snap.get("router.retries", 0)),
             }
             indoubt = stats["indoubt_recovered"]
+            # Cluster proof-of-state through the router's state_root
+            # query (per-shard roots folded deterministically) — the
+            # audit hook clients get, graded here end to end.
+            try:
+                from tigerbeetle_tpu.obs.scrape import scrape_state_root
+
+                croot, n_folded = scrape_state_root(
+                    router_addr, cluster_id, timeout_ms=20_000
+                )
+                stats["cluster_root"] = croot.hex()
+                stats["cluster_root_shards"] = n_folded
+            except (OSError, TimeoutError, ValueError):
+                stats["cluster_root"] = None
         except (OSError, TimeoutError, ValueError):
             stats = {"scrape_error": True}
         lat_ms = np.sort(np.asarray(lat)) * 1e3
@@ -2125,6 +2138,21 @@ def _run_memory_config(name, gen) -> dict:
             v for k, v in health.items() if k != "state"
         ):
             out["engine_health"] = health
+        # Incremental state commitment (commitment.py): digest-update
+        # dispatches + their per-step cost, the cheap (16-byte) vs
+        # fallback (full-fetch) scrub split, and the root itself —
+        # the graded evidence for the "scrub is 16 bytes now" claim.
+        if d._commit_enabled:
+            hu = d._h_commit_update
+            out["commitment"] = {
+                "updates": d.stat_commit_updates,
+                "update_us_p50": hu.percentile(0.50),
+                "update_us_p99": hu.percentile(0.99),
+                "scrub_cheap": d.stat_scrub_cheap,
+                "scrub_fallback": d.stat_scrub_fallback,
+                "full_fetches": d.stat_full_fetches,
+                "state_root": sm.state_root().hex(),
+            }
     del sm, h
     return out
 
@@ -2187,13 +2215,24 @@ def run_waves_compare() -> dict:
     waves_n = int(os.environ.get("BENCH_WAVES_N", 16_380 if SMALL else 65_520))
     out = {"events_per_config": waves_n}
     saved = os.environ.get("TB_WAVES")
+    saved_commit = os.environ.get("TB_STATE_COMMIT")
     try:
         for name in ("simple", "linked", "two_phase", "zipf", "mixed"):
             setup, timed, sizing = CONFIGS[name](waves_n)
             n_timed = n_events_of(timed)
             runs = {}
-            for mode, env_val in (("wave", "exact"), ("scan", "scan")):
+            # Three same-session arms: wave vs scan isolates the
+            # kernel shape (as before); wave vs wave_nodigest grades
+            # the incremental-commitment overhead (TB_STATE_COMMIT
+            # A/B) instead of asserting it — replies and final state
+            # must stay bit-identical across ALL arms.
+            for mode, env_val, commit_env in (
+                ("wave", "exact", "1"),
+                ("wave_nodigest", "exact", "0"),
+                ("scan", "scan", "1"),
+            ):
                 os.environ["TB_WAVES"] = env_val
+                os.environ["TB_STATE_COMMIT"] = commit_env
                 # NOT _make_tpu: a TB_ENGINE=device override would
                 # silently put BOTH arms on the device engine (which
                 # TB_WAVES does not bypass) and grade a meaningless
@@ -2208,7 +2247,7 @@ def run_waves_compare() -> dict:
                     engine="host",
                 )
                 sm._native = None  # isolate the JAX exact path
-                if mode == "wave":
+                if mode in ("wave", "wave_nodigest"):
                     # Untimed compile of every (batch, segment) bucket
                     # pair: the setup warmup only hits simple-shaped
                     # full-batch waves, and e.g. two_phase's ~B/2-event
@@ -2241,22 +2280,35 @@ def run_waves_compare() -> dict:
                 }
                 del sm, h
             parity = "ok"
-            for i, (a, b) in enumerate(
-                zip(runs["wave"]["replies"], runs["scan"]["replies"])
-            ):
-                if a != b:
-                    parity = f"reply[{i}] differs"
+            for other in ("scan", "wave_nodigest"):
+                for i, (a, b) in enumerate(
+                    zip(runs["wave"]["replies"], runs[other]["replies"])
+                ):
+                    if a != b:
+                        parity = f"reply[{i}] differs vs {other}"
+                        break
+                if parity == "ok" and (
+                    runs["wave"]["digest"] != runs[other]["digest"]
+                ):
+                    parity = f"state digest differs vs {other}"
+                if parity != "ok":
                     break
-            if parity == "ok" and (
-                runs["wave"]["digest"] != runs["scan"]["digest"]
-            ):
-                parity = "state digest differs"
             w, s = runs["wave"], runs["scan"]
+            wn = runs["wave_nodigest"]
             row = {
                 "events": n_timed,
                 "scan_events_per_sec": round(n_timed / s["elapsed"], 1),
                 "wave_events_per_sec": round(n_timed / w["elapsed"], 1),
                 "speedup": round(s["elapsed"] / w["elapsed"], 2),
+                "nodigest_events_per_sec": round(
+                    n_timed / wn["elapsed"], 1
+                ),
+                # Measured cost of maintaining the incremental state
+                # commitment on this stream (positive = digest arm
+                # slower).
+                "digest_overhead_pct": round(
+                    (w["elapsed"] / wn["elapsed"] - 1.0) * 100.0, 1
+                ),
                 "parity": parity,
             }
             if w["wave_batches"]:
@@ -2272,6 +2324,10 @@ def run_waves_compare() -> dict:
             os.environ.pop("TB_WAVES", None)
         else:
             os.environ["TB_WAVES"] = saved
+        if saved_commit is None:
+            os.environ.pop("TB_STATE_COMMIT", None)
+        else:
+            os.environ["TB_STATE_COMMIT"] = saved_commit
     return out
 
 

@@ -416,3 +416,51 @@ def test_open_loop_read_pct_validated(monkeypatch):
     assert envcheck.open_loop_read_pct() == 35.0
     monkeypatch.delenv("BENCH_OPEN_READ_PCT")
     assert envcheck.open_loop_read_pct() == 20.0  # default
+
+
+def test_tb_state_commit_validated(monkeypatch):
+    monkeypatch.setenv("TB_STATE_COMMIT", "maybe")
+    with pytest.raises(envcheck.EnvVarError, match="TB_STATE_COMMIT"):
+        envcheck.state_commit()
+    monkeypatch.setenv("TB_STATE_COMMIT", "2")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 1"):
+        envcheck.state_commit()
+    monkeypatch.setenv("TB_STATE_COMMIT", "0")
+    assert envcheck.state_commit() == 0
+    monkeypatch.delenv("TB_STATE_COMMIT")
+    assert envcheck.state_commit() == 1  # default on
+
+
+def test_tb_dev_scrub_fallback_validated(monkeypatch):
+    monkeypatch.setenv("TB_DEV_SCRUB_FALLBACK", "often")
+    with pytest.raises(envcheck.EnvVarError, match="TB_DEV_SCRUB_FALLBACK"):
+        envcheck.scrub_fallback_every()
+    monkeypatch.setenv("TB_DEV_SCRUB_FALLBACK", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.scrub_fallback_every()
+    monkeypatch.setenv("TB_DEV_SCRUB_FALLBACK", "4")
+    assert envcheck.scrub_fallback_every() == 4
+    monkeypatch.delenv("TB_DEV_SCRUB_FALLBACK")
+    assert envcheck.scrub_fallback_every() == 0  # only on mismatch
+
+
+def test_tb_metrics_disables_commitment_instruments(monkeypatch):
+    """TB_METRICS=0: the commitment's latency histograms (digest
+    update, cheap/fallback scrub split) become shared no-ops — a
+    digest-update site costs one attribute check, no clock read —
+    while the commit.* counters stay live (bench accounting reads
+    them)."""
+    from tigerbeetle_tpu import obs
+
+    monkeypatch.setenv("TB_METRICS", "0")
+    reg = obs.Registry()
+    for name in ("commit.update_us", "scrub.cheap_us", "scrub.fallback_us"):
+        hist = reg.histogram(name)
+        hist.observe(5.0)
+        assert hist.count == 0 and hist.percentile(0.5) == 0.0
+        assert f"{name}.count" not in reg.snapshot()
+    reg.counter("commit.updates").inc()
+    reg.counter("commit.scrub_cheap").inc(2)
+    snap = reg.snapshot()
+    assert snap["commit.updates"] == 1
+    assert snap["commit.scrub_cheap"] == 2

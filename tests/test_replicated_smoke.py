@@ -8,6 +8,7 @@ minutes."""
 import os
 import socket
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -212,6 +213,27 @@ def _run_cluster_once(tmp_path, fastpath_flag, monkeypatch):
                 # covers a whole drain), and every sync accounted.
                 assert snap["vsr.gc_flushes"] <= snap["vsr.prepares_written"]
                 assert snap["storage.fsyncs"] > 0
+
+        # Proof-of-state query (state_machine/commitment.py): both
+        # replicas answer the sessionless `state_root` op with the
+        # SAME nonzero 16-byte root once converged — the wire-level
+        # rendering of the hash-log convergence claim.
+        from tigerbeetle_tpu.obs.scrape import scrape_state_root
+
+        roots = {}
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            roots = {
+                i: scrape_state_root(addresses[i], CLUSTER,
+                                     timeout_ms=20_000)
+                for i in range(len(servers))
+            }
+            if len({cm for _root, cm in roots.values()}) == 1:
+                break
+            time.sleep(0.2)  # backup still applying the tail
+        assert len({root for root, _cm in roots.values()}) == 1, roots
+        assert roots[0][0] != bytes(16)
+        assert roots[0][0] == servers[0].server.replica.sm.state_root()
         return reply_bodies
     finally:
         for c in clients:

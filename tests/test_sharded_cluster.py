@@ -248,6 +248,33 @@ def test_sharded_tcp_router_kill_oracle_identical(tcp_sharded):
     assert snap["router.2pc_compensations"] == 0
     assert snap["router.2pc_conflicts"] == 0
 
+    # Cluster proof of state over the wire: each shard's replicas
+    # answer the sessionless `state_root` query with one root, and the
+    # router's query folds exactly those per-shard roots.
+    from tigerbeetle_tpu.obs.scrape import scrape_state_root
+    from tigerbeetle_tpu.state_machine import commitment as cm
+
+    import time as _time
+
+    shard_roots = []
+    for shard, addr_list in enumerate(env["shard_addrs"]):
+        deadline = _time.monotonic() + 30.0
+        while True:
+            roots = {
+                scrape_state_root(addr, CLUSTER, timeout_ms=20_000)[0]
+                for addr in addr_list.split(",")
+            }
+            if len(roots) == 1 or _time.monotonic() > deadline:
+                break
+            _time.sleep(0.2)  # a backup still applying the tail
+        assert len(roots) == 1, (shard, roots)
+        shard_roots.append(next(iter(roots)))
+    cluster_root, n_folded = scrape_state_root(
+        router_addr, CLUSTER, timeout_ms=20_000
+    )
+    assert n_folded == len(env["shard_addrs"])
+    assert cluster_root == cm.fold_cluster(shard_roots)
+
 
 def test_sharded_trace_context_merges_end_to_end(tcp_sharded, tmp_path):
     """Both 2PC legs carry the client's trace id: the router's flight

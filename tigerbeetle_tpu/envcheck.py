@@ -199,6 +199,33 @@ def open_loop_read_pct() -> float:
 
 
 # ----------------------------------------------------------------------
+# Incremental state commitments (state_machine/commitment.py).
+
+
+def state_commit() -> int:
+    """TB_STATE_COMMIT: 1 (default) maintains the incremental state
+    commitment — a per-row-hash digest of the account table updated
+    from just the rows each step touched, kept bit-identically on the
+    host mirror and the device engine.  Enables 16-byte scrub /
+    re-promotion compares, checkpoint state roots, and the
+    `state_root` query.  0 disables the digest machinery entirely
+    (the A/B arm for grading its overhead); roots are then computed
+    from scratch on demand and scrub falls back to the legacy
+    full-digest compare."""
+    return env_int("TB_STATE_COMMIT", 1, minimum=0, maximum=1)
+
+
+def scrub_fallback_every() -> int:
+    """TB_DEV_SCRUB_FALLBACK: run the full-fetch divergence-
+    localization scrub every Nth healthy-mode scrub even when the
+    cheap 16-byte digest compare matched (a belt-and-braces deep
+    scrub against digest-collision paranoia).  0 (default) = the full
+    fetch runs only on a digest mismatch."""
+    return env_int("TB_DEV_SCRUB_FALLBACK", 0, minimum=0,
+                   maximum=1 << 20)
+
+
+# ----------------------------------------------------------------------
 # Sharded multi-cluster (runtime/router.py).
 
 

@@ -62,6 +62,73 @@ def scrape_stats(address: str, cluster: int, timeout_ms: int = 10_000) -> dict:
     raise TimeoutError(f"stats scrape of {address} timed out")
 
 
+def scrape_state_root(
+    address: str, cluster: int, timeout_ms: int = 10_000
+) -> tuple[bytes, int]:
+    """Proof-of-state query: the replica's 16-byte state commitment
+    (state_machine/commitment.py) + the commit_min it covers.  Same
+    sessionless shape as the stats scrape — read-only, answered by the
+    server loop, never enters consensus."""
+    from tigerbeetle_tpu.runtime.native import EV_MESSAGE, NativeBus
+    from tigerbeetle_tpu.state_machine import commitment
+
+    host, _, port = address.rpartition(":")
+    bus = NativeBus()
+    try:
+        conn = bus.connect(host or "127.0.0.1", int(port))
+        h = wire.make_header(
+            command=Command.request, operation=VsrOperation.state_root,
+            cluster=cluster, request=SCRAPE_REQUEST,
+        )
+        wire.finalize_header(h, b"")
+        bus.send(conn, h.tobytes())
+        deadline = time.monotonic() + timeout_ms / 1e3
+        while time.monotonic() < deadline:
+            for ev_type, _conn, payload in bus.poll(50):
+                if ev_type != EV_MESSAGE or len(payload) < HEADER_SIZE:
+                    continue
+                header = wire.header_from_bytes(payload[:HEADER_SIZE])
+                body = payload[HEADER_SIZE:]
+                if not wire.verify_header(header, body):
+                    continue
+                if (
+                    int(header["command"]) == int(Command.client_busy)
+                    and int(header["request"]) == SCRAPE_REQUEST
+                ):
+                    # The router runs this query through its admission
+                    # bound (unlike stats, answered pre-admission): a
+                    # shed under load replies client_busy.  Resend
+                    # instead of burning the rest of the deadline.
+                    bus.send(conn, h.tobytes())
+                    continue
+                if (
+                    int(header["command"]) == int(Command.reply)
+                    and int(header["operation"])
+                    == int(VsrOperation.state_root)
+                    and int(header["request"]) == SCRAPE_REQUEST
+                ):
+                    return commitment.parse_root_body(bytes(body))
+    finally:
+        bus.close()
+    raise TimeoutError(f"state_root scrape of {address} timed out")
+
+
+def state_root_reply(root: bytes, commit_min: int, request_header) -> tuple:
+    """Server side: (reply_header, body) answering a `state_root`
+    request with the 24-byte root+commit_min body."""
+    from tigerbeetle_tpu.state_machine import commitment
+
+    body = commitment.root_body(root, commit_min)
+    reply = wire.make_header(
+        command=Command.reply, operation=VsrOperation.state_root,
+        cluster=wire.u128(request_header, "cluster"),
+        client=wire.u128(request_header, "client"),
+        request=int(request_header["request"]),
+    )
+    wire.finalize_header(reply, body)
+    return reply, body
+
+
 def stats_reply(snapshot: dict, request_header) -> tuple:
     """Server side: (reply_header, body) answering `request_header`
     with `snapshot` (runtime/server.py sends it on the raw conn)."""

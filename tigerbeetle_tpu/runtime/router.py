@@ -176,6 +176,10 @@ class SubOp:
                   client's request number (at-most-once dedupe).
     kind "coord": coordinator-session op — the transport picks request
                   numbers freely; idempotency is id-level.
+    kind "root":  sessionless proof-of-state query (VsrOperation.
+                  state_root) — answered by the shard's server loop
+                  outside consensus, so the transport sends it with
+                  no session at all (read-only, trivially idempotent).
     """
 
     __slots__ = ("shard", "kind", "operation", "body", "done", "reply",
@@ -890,6 +894,42 @@ class RouterCore:
         return sub.reply
 
     # ------------------------------------------------------------------
+    # Proof of state: fold per-shard roots into ONE deterministic
+    # cluster commitment (commitment.fold_cluster).  Runs as a normal
+    # task — the `state_root` client query and the recovery audit both
+    # ride it.
+
+    def _root_subops(self) -> list[SubOp]:
+        from tigerbeetle_tpu.vsr.wire import VsrOperation
+
+        return [
+            SubOp(shard, "root", VsrOperation.state_root, b"")
+            for shard in range(self.n_shards)
+        ]
+
+    def _fold_roots(self, subs: list[SubOp]) -> bytes:
+        from tigerbeetle_tpu.state_machine import commitment
+
+        roots = []
+        for sub in subs:
+            root, _commit_min = commitment.parse_root_body(sub.reply)
+            roots.append(root)
+        return commitment.fold_cluster(roots)
+
+    def state_root(self) -> _Task:
+        """Cluster-wide proof of state: query every shard's root,
+        fold deterministically, reply with root_body(folded,
+        n_shards)."""
+        return _Task(self._run_state_root())
+
+    def _run_state_root(self):
+        from tigerbeetle_tpu.state_machine import commitment
+
+        subs = self._root_subops()
+        yield subs
+        return commitment.root_body(self._fold_roots(subs), self.n_shards)
+
+    # ------------------------------------------------------------------
     # Crash recovery.
 
     def recover(self) -> _Task:
@@ -1127,7 +1167,18 @@ class RouterCore:
                                        np.stack(rows_c).tobytes()))
         yield comp_subs
         self._c_recovered.inc(indoubt)
-        return {"indoubt": indoubt, "scanned": len(evidence)}
+        # Post-recovery audit point: fetch every shard's state root
+        # through the proof-of-state query and record the folded
+        # cluster commitment with the recovery result (flight note
+        # "router_recovered" carries it into the postmortem ring).
+        root_subs = self._root_subops()
+        yield root_subs
+        cluster_root = self._fold_roots(root_subs)
+        return {
+            "indoubt": indoubt,
+            "scanned": len(evidence),
+            "cluster_root": cluster_root.hex(),
+        }
 
 
 # ----------------------------------------------------------------------
@@ -1206,6 +1257,10 @@ class RouterServer:
         self._client_conns: dict[int, int] = {}
         # Wire bookkeeping.
         self._coord_request = 0
+        # Sessionless "root" subop numbering: client=0 frames, so the
+        # request field alone correlates replies.  Starts high to stay
+        # clear of register's request=0.
+        self._root_request = 0x5A00_0000
         self._pending: dict[tuple[int, int, int], SubOp] = {}
         self._sent_at: dict[int, tuple] = {}  # id(subop) -> state
         self._registered: dict[int, set[int]] = {}  # client -> shards
@@ -1254,6 +1309,29 @@ class RouterServer:
 
     def _send_subop(self, sub: SubOp, first: bool = False) -> None:
         wire = self._wire
+        if sub.kind == "root":
+            # Sessionless proof-of-state query: no registration, no
+            # session — the shard's server loop answers it directly.
+            self._root_request += 1
+            request = self._root_request
+            key = (sub.shard, 0, request)
+            old_key = self._sent_at.get(id(sub))
+            if old_key is not None:
+                self._pending.pop(old_key[0], None)
+            self._pending[key] = sub
+            self._sent_at[id(sub)] = (key, time.monotonic_ns())
+            h = wire.make_header(
+                command=wire.Command.request,
+                operation=wire.VsrOperation.state_root,
+                cluster=self.cluster, client=0, request=request,
+            )
+            wire.finalize_header(h, b"")
+            conn = self._connect_shard(sub.shard)
+            if conn is not None:
+                self.bus.send(conn, h.tobytes())
+            if not first:
+                self._c_retries.inc()
+            return
         if sub.kind == "fwd":
             client, request = sub.client, sub.request
         else:
@@ -1373,8 +1451,16 @@ class RouterServer:
 
     def _reply_client(self, ctx: dict, body: bytes) -> None:
         wire = self._wire
-        self._open.pop((ctx["client"], ctx["request"]), None)
-        conn = self._client_conns.get(ctx["client"])
+        self._open.pop(
+            ctx.get("open_key", (ctx["client"], ctx["request"])), None
+        )
+        # Sessionless queries (state_root) reply to the requesting
+        # CONNECTION — concurrent scrapers share client id 0, so the
+        # per-client conn map would route every reply to whichever
+        # scraper connected last.
+        conn = ctx.get("conn")
+        if conn is None:
+            conn = self._client_conns.get(ctx["client"])
         if conn is None:
             return  # client gone; retransmission re-derives the reply
         h = wire.make_header(
@@ -1530,6 +1616,33 @@ class RouterServer:
 
             reply, rbody = stats_reply(self.registry.snapshot(), header)
             self.bus.send(conn, reply.tobytes() + rbody)
+            return
+        if operation == int(wire.VsrOperation.state_root):
+            # Cluster proof of state: fan the sessionless query out to
+            # every shard and fold — a normal task, so it shares the
+            # retry sweep, the admission bound (a polling monitor with
+            # fresh request numbers must not grow _open past the queue
+            # while a shard is unreachable), and replies through
+            # _reply_client.  Scrapers share one well-known (client=0,
+            # SCRAPE_REQUEST) identity, so the open key and the reply
+            # route carry the CONNECTION: two concurrent scrapes are
+            # independent requests, not a retransmission.
+            open_key = (client, request, conn)
+            if open_key in self._open:
+                return
+            if len(self._open) >= self.admit_queue:
+                self._send_busy(header)
+                return
+            ctx = {
+                "client": client, "request": request,
+                "operation": operation, "header": header.copy(),
+                "conn": conn, "open_key": open_key,
+            }
+            self._open[open_key] = ctx
+            task = self.core.state_root()
+            self._issue_subops(task.subops)
+            self._tasks.append((task, ctx))
+            self._pump_tasks()
             return
         if operation == int(wire.VsrOperation.register):
             self._client_register[client] = header.copy()

@@ -496,6 +496,11 @@ class ReplicaServer:
                 if int(header["operation"]) == int(wire.VsrOperation.stats):
                     self._send_stats_reply(conn, header)
                     continue
+                if int(header["operation"]) == int(
+                    wire.VsrOperation.state_root
+                ):
+                    self._send_state_root_reply(conn, header)
+                    continue
                 self.replica.anatomy.stage_h(header, "ingress")
                 self.bus.register_client(conn, wire.u128(header, "client"))
                 req_hdrs.append(header)
@@ -522,6 +527,22 @@ class ReplicaServer:
             self.replica.anatomy.exemplar_snapshot()
         )
         reply, body = stats_reply(snap, header)
+        self.bus.native.send(conn, reply.tobytes() + body)
+
+    def _send_state_root_reply(self, conn: int, header) -> None:
+        # Proof-of-state hook (state_machine/commitment.py): the
+        # 16-byte incremental state commitment + the commit_min it is
+        # current to — read-only, sessionless, answered here so it can
+        # never enter consensus.  Replicas without a commitment-aware
+        # state machine answer zeros (the client treats an all-zero
+        # root as "not supported / empty").
+        from tigerbeetle_tpu.obs.scrape import state_root_reply
+
+        sm = self.replica.sm
+        root = sm.state_root() if hasattr(sm, "state_root") else bytes(16)
+        reply, body = state_root_reply(
+            root, self.replica.commit_min, header
+        )
         self.bus.native.send(conn, reply.tobytes() + body)
 
     def _on_raw_message(self, conn: int, payload: bytes) -> None:
@@ -552,6 +573,11 @@ class ReplicaServer:
             int(header["operation"]) == int(wire.VsrOperation.stats)
         ):
             self._send_stats_reply(conn, header)
+            return
+        if cmd == int(Command.request) and (
+            int(header["operation"]) == int(wire.VsrOperation.state_root)
+        ):
+            self._send_state_root_reply(conn, header)
             return
         if cmd in (Command.ping, Command.pong):
             announce = int(header["request"]) == TcpBus.ANNOUNCE_REQUEST

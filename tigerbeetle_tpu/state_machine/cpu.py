@@ -291,6 +291,29 @@ class CpuStateMachine:
             return None
         return (a.debits_pending, a.debits_posted, a.credits_pending, a.credits_posted)
 
+    def state_root(self) -> bytes:
+        """16-byte state commitment of the account table — the same
+        value TpuStateMachine.state_root reports for the same commit
+        stream (commitment.py; row index = creation order, which is
+        the TPU build's slot assignment).  Recomputed from scratch:
+        the oracle optimizes for simplicity, not update cost."""
+        from tigerbeetle_tpu.state_machine import commitment as cm
+
+        n = len(self.accounts)
+        bal8 = np.zeros((n, 8), np.uint64)
+        meta = np.zeros((n, 2), np.uint32)
+        mask = (1 << 64) - 1
+        for i, a in enumerate(self.accounts.values()):
+            for j, v in enumerate(
+                (a.debits_pending, a.debits_posted,
+                 a.credits_pending, a.credits_posted)
+            ):
+                bal8[i, 2 * j] = v & mask
+                bal8[i, 2 * j + 1] = v >> 64
+            meta[i, 0] = a.flags
+            meta[i, 1] = a.ledger
+        return cm.root_bytes(cm.table_digest(bal8, meta))
+
     # ------------------------------------------------------------------
     # Groove mutations (undo-aware).
 

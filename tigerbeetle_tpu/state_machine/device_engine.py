@@ -44,6 +44,7 @@ balances from the authoritative table (not the host mirror).
 
 from __future__ import annotations
 
+import os as _os
 import time as _time
 
 import numpy as np
@@ -83,7 +84,21 @@ _BACKOFF_CAP_MS = envcheck.env_float(
     "TB_DEV_BACKOFF_CAP_MS", 200.0, minimum=0.0
 )
 _PROBE_EVERY = envcheck.env_int("TB_DEV_PROBE_EVERY", 8, minimum=1)
-_SCRUB_EVERY = envcheck.env_int("TB_DEV_SCRUB_EVERY", 256, minimum=0)
+# r15: the healthy-mode scrub is a 16-byte incremental-digest compare
+# (state_machine/commitment.py) instead of a full-table digest pass,
+# so the default cadence drops from 256 to every TB_DEV_PROBE_EVERY
+# fetches (the full-fetch compare survives only as the divergence-
+# localization fallback).  On the tunneled link each scrub still pays
+# one d2h crossing's latency — dev.scrub.cheap_us/fallback_us record
+# the real split for the next chip session to retune against.
+# The tight default only makes sense for the CHEAP scrub: an engine
+# with the commitment disabled (TB_STATE_COMMIT=0) still pays the
+# legacy full-digest compare per scrub, so it keeps the legacy 256
+# unless the operator set the cadence explicitly (per-engine choice
+# in __init__).
+_SCRUB_EVERY_SET = bool(_os.environ.get("TB_DEV_SCRUB_EVERY"))
+_SCRUB_EVERY = envcheck.env_int("TB_DEV_SCRUB_EVERY", _PROBE_EVERY, minimum=0)
+_SCRUB_EVERY_LEGACY = 256
 # Maximum deterministic per-engine offset applied to the scrub cadence
 # so every engine's TB_DEV_SCRUB_EVERY-th fetch doesn't land on the
 # same ring rotation (each scrub costs a ~105 ms checksum fetch on the
@@ -260,7 +275,7 @@ class _InFlight:
     __slots__ = (
         "kind", "pk", "n", "ts_base", "finish", "fallback", "future",
         "ring_at", "id_keys", "handle", "slots", "rows", "meta_args",
-        "wave_args", "bound",
+        "wave_args", "bound", "touched",
     )
 
     def __init__(self, kind, future, finish, *, pk=None, n=0, ts_base=0,
@@ -282,6 +297,10 @@ class _InFlight:
         # (waves.PackedColumns, plan): the compact columnar record —
         # NOT the (B,)-padded event dict — rebuilt at launch.
         self.wave_args = wave_args
+        # Balance rows this record's execution can modify (wave
+        # records fill it at launch) — the incremental-commitment
+        # update's input (commitment.py).
+        self.touched = None
         # Host-integer bound on the balance additions this record can
         # still contribute (wave admission's in-flight term); released
         # when the record's bookkeeping lands on the mirror.
@@ -298,6 +317,34 @@ _KERNELS = {
     "two_phase_lo": dk.two_phase_lo,
 }
 _SEMANTIC_KINDS = tuple(_KERNELS)
+
+_MASK32_NP = np.uint64(0xFFFFFFFF)
+
+
+def _touched_of_pk(kind: str, pk, n: int) -> np.ndarray:
+    """Balance rows a packed semantic batch can modify, extracted from
+    the HOST copy of the packed columns (a superset is fine — the
+    commitment refresh of an unmodified row is a no-op).  Two-phase
+    kernels also write the durable pending target's accounts
+    (COL_TP_SLOTS); in-batch targets resolve to the creator event's
+    own dr/cr slots, which the batch already covers."""
+    pk = np.asarray(pk)
+    if kind == "orderfree_tight":
+        s = np.concatenate(
+            [pk[:n, 1].astype(np.int64), pk[:n, 2].astype(np.int64)]
+        ) - 1
+        return s[s >= 0]
+    w = pk[:n, dk.COL_SLOTS]
+    parts = [
+        (w & _MASK32_NP).astype(np.int64) - 1,
+        (w >> np.uint64(32)).astype(np.int64) - 1,
+    ]
+    if kind in ("two_phase", "two_phase_lo"):
+        w2 = pk[:n, dk.COL_TP_SLOTS]
+        parts.append((w2 & _MASK32_NP).astype(np.int64) - 1)
+        parts.append((w2 >> np.uint64(32)).astype(np.int64) - 1)
+    s = np.concatenate(parts)
+    return s[s >= 0]
 
 
 class DeviceEngine:
@@ -328,7 +375,16 @@ class DeviceEngine:
         _ENGINE_SEQ += 1
         if seed is None:
             seed = capacity + 0x85EBCA6B * _ENGINE_SEQ
-        cap = _scrub_jitter_cap(_SCRUB_EVERY, _SCRUB_JITTER)
+        # Commitment on => cheap 16-byte scrubs => the tight default
+        # cadence; commitment off (and no explicit operator cadence)
+        # => every scrub is the legacy full-digest compare, keep 256.
+        self._commit_enabled = envcheck.state_commit() == 1
+        self._scrub_every = (
+            _SCRUB_EVERY
+            if (self._commit_enabled or _SCRUB_EVERY_SET)
+            else _SCRUB_EVERY_LEGACY
+        )
+        cap = _scrub_jitter_cap(self._scrub_every, _SCRUB_JITTER)
         self._scrub_offset = (seed * 0x9E3779B9) % (cap + 1) if cap else 0
         self._last_scrub_fetch = -self._scrub_offset
         self._closed = False
@@ -359,6 +415,16 @@ class DeviceEngine:
             "stat_degraded_events": _c("degraded_events"),
             "stat_scrubs": _c("scrubs"),
             "stat_scrub_heals": _c("scrub_heals"),
+            # Incremental state commitment (commitment.py): digest
+            # updates dispatched, cheap (16-byte) vs fallback
+            # (full-fetch localization) scrub passes, full-table
+            # fetches actually paid, and accumulator repairs (tables
+            # matched but a digest drifted — should stay 0 forever).
+            "stat_commit_updates": _c("commit.updates"),
+            "stat_scrub_cheap": _c("commit.scrub_cheap"),
+            "stat_scrub_fallback": _c("commit.scrub_fallback"),
+            "stat_full_fetches": _c("commit.full_fetches"),
+            "stat_commit_repairs": _c("commit.repairs"),
             # Wave-record memory + sharded-execution forensics.
             "stat_wave_window_bytes_peak": _c("wave.window_bytes_peak"),
             "stat_wave_window_padded_peak": _c("wave.window_padded_peak"),
@@ -381,9 +447,16 @@ class DeviceEngine:
         # next real-link session reads the actual digest-compare cost
         # out of the same scrape that shows the cadence it ran at,
         # instead of re-deriving both from guesses.
-        self.metrics.gauge_fn("scrub.every", lambda: _SCRUB_EVERY)
+        self.metrics.gauge_fn("scrub.every", lambda: self._scrub_every)
         self.metrics.gauge_fn("probe.every", lambda: _PROBE_EVERY)
         self._h_scrub_cost = self.metrics.histogram("scrub.cost_us")
+        # Split scrub costs: the 16-byte digest compare vs the
+        # full-fetch localization fallback — the next chip session
+        # reads both (and the per-step digest-update overhead) off one
+        # scrape (ROADMAP "scrub/probe cadence tuning").
+        self._h_scrub_cheap = self.metrics.histogram("scrub.cheap_us")
+        self._h_scrub_fallback = self.metrics.histogram("scrub.fallback_us")
+        self._h_commit_update = self.metrics.histogram("commit.update_us")
         # Multi-device: the authoritative tables shard ROW-WISE across
         # every visible device (NamedSharding over a 1-D "shard" mesh);
         # the semantic kernels then run SPMD with XLA-inserted
@@ -404,9 +477,26 @@ class DeviceEngine:
         self._meta_host = np.zeros((capacity, 2), np.uint32)
         self.ring = jnp.zeros((_RING, dk.SUMMARY_WORDS), jnp.uint64)
         self._ring_at = 0
+        # Incremental state commitment (commitment.py): a device-side
+        # (capacity, 2) per-row-hash array + (2,) u64 fold, updated
+        # from just the rows each launch touched, with a bit-identical
+        # host twin on the mirror (self._commit_enabled decided with
+        # the scrub cadence above).  Standalone engines (unit tests)
+        # get a twin keyed to the engine's own meta table; the owning
+        # state machine attaches an attrs-backed twin BEFORE
+        # constructing the engine.
+        self.dev_row_hash = None
+        self.dev_digest = None
+        if self._commit_enabled and getattr(mirror, "commitment", None) is None:
+            from tigerbeetle_tpu.state_machine import commitment as _cm
+
+            mirror.commitment = _cm.HostCommitment(
+                capacity, meta_fn=self._twin_meta
+            )
         try:
             self.balances = self._place(jnp.zeros((capacity, 8), jnp.uint64))
             self.meta = self._place(jnp.zeros((capacity, 2), jnp.uint32))
+            self._commit_rebuild()
         except DeviceLostError as exc:
             # Born degraded: the link was already dead at construction.
             # Placeholders come from plain jnp (default backend, not the
@@ -456,6 +546,11 @@ class DeviceEngine:
         "stat_wave_window_padded_peak"
     )
     stat_wave_sharded = obs_stat_property("stat_wave_sharded")
+    stat_commit_updates = obs_stat_property("stat_commit_updates")
+    stat_scrub_cheap = obs_stat_property("stat_scrub_cheap")
+    stat_scrub_fallback = obs_stat_property("stat_scrub_fallback")
+    stat_full_fetches = obs_stat_property("stat_full_fetches")
+    stat_commit_repairs = obs_stat_property("stat_commit_repairs")
     stat_t_h2d = obs_stat_property("stat_t_h2d")
     stat_t_dispatch = obs_stat_property("stat_t_dispatch")
     stat_t_fetch = obs_stat_property("stat_t_fetch")
@@ -548,6 +643,24 @@ class DeviceEngine:
                 # (separate XLA executables) — warm those too so wave
                 # dispatch never first-compiles inside a timed window.
                 _waves.prewarm(self.capacity, engine=True)
+        if self._commit_enabled and self.dev_row_hash is not None:
+            # Compile the digest-update kernel's smallest slot bucket
+            # (every launch dispatches it) off the timed path.  An
+            # all-padding slot array contributes nothing, so the
+            # warmed dispatch cannot move the digest.
+            from tigerbeetle_tpu.state_machine import commitment as _cm
+
+            fns = _cm.device_fns()
+            self._retry(
+                lambda: self.link.block_until_ready(
+                    self.link.dispatch(
+                        fns["update"], self.balances, self.meta,
+                        self.dev_row_hash, self.dev_digest,
+                        jnp.asarray(_cm.pad_slots(np.zeros(0, np.int64))),
+                    )
+                ),
+                "dispatch",
+            )
         kinds = [k for k in kinds if k in _KERNELS]
         if not kinds:
             return
@@ -594,6 +707,11 @@ class DeviceEngine:
         slots = np.asarray(slots, np.int64)
         self._meta_host[slots, 0] = acct_flags
         self._meta_host[slots, 1] = acct_ledger
+        # Meta is part of the committed row content: refresh the host
+        # twin (the queued "meta" record folds the device side in at
+        # its launch).
+        if self.mirror.commitment is not None:
+            self.mirror.commitment.refresh(slots, self.mirror)
         if self.state is not EngineState.healthy:
             # The host copy above is authoritative while degraded;
             # re-promotion re-uploads the whole meta table from it.  A
@@ -614,6 +732,8 @@ class DeviceEngine:
         """Linked create_accounts rollback support."""
         slots = np.asarray(slots, np.int64)
         self._meta_host[slots] = 0
+        if self.mirror.commitment is not None:
+            self.mirror.commitment.refresh(slots, self.mirror)
         if self.state is not EngineState.healthy:
             return  # see add_accounts
         z = np.zeros(len(slots), np.uint32)
@@ -659,6 +779,10 @@ class DeviceEngine:
         try:
             self.balances = widen(self.balances, 8, jnp.uint64)
             self.meta = widen(self.meta, 2, jnp.uint32)
+            # Zero rows hash to 0, so the widened digest VALUE is
+            # unchanged — but the per-row hash array must match the
+            # new geometry (and possibly a dropped sharding): rebuild.
+            self._commit_rebuild()
         except DeviceLostError as exc:
             self._demote(exc)
 
@@ -992,6 +1116,12 @@ class DeviceEngine:
                 rec.ring_at = (self._ring_at + g) % _RING
             self._ring_at = (self._ring_at + len(urecs)) % _RING
         self.stat_t_dispatch += _time.perf_counter() - t1
+        # Absorb the whole window's touched rows into the on-device
+        # commitment: one extra dispatch per launch (commit.update_us).
+        if self._commit_enabled:
+            touched = self._collect_touched(recs)
+            if touched is not None:
+                self._commit_update(touched)
 
     def _dispatch(self, rec: _InFlight) -> None:
         """Immediate single-batch dispatch (fallback re-dispatch path)."""
@@ -1018,6 +1148,8 @@ class DeviceEngine:
 
         packed_rec, plan = rec.wave_args
         ev, dstat_init, hist_fix = _waves.unpack_wave_record(packed_rec)
+        if self._commit_enabled:
+            rec.touched = _waves.touched_slots(ev, rec.n)
         mesh = self.wave_mesh()
 
         def run():
@@ -1206,6 +1338,12 @@ class DeviceEngine:
                     self._exec_waves(rec)
                 else:
                     self._dispatch(rec)
+            # The re-dispatched suffix mutated the rebuilt table: fold
+            # its touched rows back into the commitment.
+            if self._commit_enabled and covered:
+                touched = self._collect_touched(covered)
+                if touched is not None:
+                    self._commit_update(touched)
             ring_np = None
 
     def _mirror_table_np(self) -> np.ndarray:
@@ -1286,6 +1424,119 @@ class DeviceEngine:
 
     def _upload_from_mirror(self) -> None:
         self.balances = self._place(jnp.asarray(self._mirror_table_np()))
+        # The device table just changed wholesale: re-derive the
+        # on-device commitment from scratch (one dispatch — callers
+        # are recovery/re-promotion/heal paths, never the hot path).
+        # Reads the CURRENT device meta table, so callers that also
+        # re-upload meta must do so BEFORE this.
+        self._commit_rebuild()
+
+    # ------------------------------------------------------------------
+    # Incremental state commitment (state_machine/commitment.py): the
+    # device maintains per-row hashes + a 16-byte fold of its
+    # balances+meta tables as a by-product of every execution path —
+    # each launch/flush/recovery re-dispatch absorbs exactly the rows
+    # it touched — and the host twin on mirror.commitment tracks the
+    # same value bit-identically.  Scrub and the re-promotion
+    # handshake compare 16 bytes; the full-table fetch survives only
+    # as _localize_divergence.
+
+    def _twin_meta(self, slots: np.ndarray) -> np.ndarray:
+        """Meta columns for a standalone engine's host twin (the
+        owning state machine supplies an attrs-backed one instead)."""
+        out = np.zeros((len(slots), 2), np.uint32)
+        m = slots < len(self._meta_host)
+        out[m] = self._meta_host[slots[m]]
+        return out
+
+    def _commit_rebuild(self) -> None:
+        """From-scratch device digest (vectorized over the table ON
+        DEVICE; on a row-sharded engine GSPMD computes shard-local
+        partial folds and all-reduces them over ICI)."""
+        if not self._commit_enabled:
+            return
+        from tigerbeetle_tpu.state_machine import commitment as _cm
+
+        fns = _cm.device_fns()
+        self.dev_row_hash, self.dev_digest = self._run(
+            fns["rebuild"], self.balances, self.meta
+        )
+
+    def _commit_update(self, slots) -> None:
+        """Absorb the touched rows of one launch/flush into the
+        on-device digest: ONE extra dispatch per window, O(touched)."""
+        if not self._commit_enabled or self.dev_row_hash is None:
+            return
+        slots = np.unique(np.asarray(slots, np.int64))
+        slots = slots[(slots >= 0) & (slots < self.capacity)]
+        if len(slots) == 0:
+            return
+        from tigerbeetle_tpu.state_machine import commitment as _cm
+
+        fns = _cm.device_fns()
+        self.stat_commit_updates += 1
+        with self._h_commit_update.time():
+            self.dev_row_hash, self.dev_digest = self._run(
+                fns["update"], self.balances, self.meta,
+                self.dev_row_hash, self.dev_digest,
+                jnp.asarray(_cm.pad_slots(slots)),
+            )
+
+    def _collect_touched(self, recs) -> np.ndarray | None:
+        """Union of balance rows a record list can have modified."""
+        touched = []
+        for rec in recs:
+            if rec.kind == "meta":
+                touched.append(rec.meta_args[0])
+            elif rec.kind == "waves" and rec.touched is not None:
+                touched.append(rec.touched)
+            elif rec.kind in _SEMANTIC_KINDS:
+                touched.append(_touched_of_pk(rec.kind, rec.pk, rec.n))
+        if not touched:
+            return None
+        return np.concatenate(touched)
+
+    def commit_probe(self) -> np.ndarray:
+        """(2, 2) u64 [maintained digest, from-scratch digest] from
+        the device — one dispatch + one 32-byte fetch.  Caller must
+        hold the engine drained/flushed."""
+        from tigerbeetle_tpu.state_machine import commitment as _cm
+
+        fns = _cm.device_fns()
+        return self._retry(
+            lambda: self.link.fetch(
+                self.link.dispatch(
+                    fns["probe"], self.balances, self.meta, self.dev_digest
+                )
+            ),
+            "fetch",
+        )
+
+    def device_root(self) -> np.ndarray:
+        """(2,) u64 maintained device digest (16-byte fetch)."""
+        return self._retry(
+            lambda: self.link.fetch(self.dev_digest), "fetch"
+        )
+
+    def _localize_divergence(self) -> np.ndarray:
+        """THE full-table-fetch path (counted in commit.full_fetches):
+        pull both device tables and name the diverged rows vs the
+        host's copies — runs only when a 16-byte compare already
+        failed (or the TB_DEV_SCRUB_FALLBACK deep-scrub cadence
+        forces it)."""
+        self.stat_full_fetches += 1
+        bal = self._retry(lambda: self.link.fetch(self.balances), "fetch")
+        meta = self._retry(lambda: self.link.fetch(self.meta), "fetch")
+        diverged = (bal != self._mirror_table_np()).any(axis=1) | (
+            meta != self._meta_host
+        ).any(axis=1)
+        return np.flatnonzero(diverged)
+
+    def _heal_from_mirror(self) -> None:
+        """Re-upload both tables from the host copies (meta first: the
+        commitment rebuild inside _upload_from_mirror hashes it)."""
+        self.meta = self._place(jnp.asarray(self._meta_host))
+        self._upload_from_mirror()
 
     def drain(self) -> None:
         # A drain nested inside exact recovery (host fallbacks read the
@@ -1338,6 +1589,11 @@ class DeviceEngine:
         self.tracer.instant("device_demoted", error=repr(exc)[:200])
         self.last_demotion = repr(exc)
         self._degraded_submits = 0
+        # The device commitment is as dead as the table it covers; the
+        # host twin stays live (mirror mutations keep refreshing it)
+        # and re-promotion rebuilds the device side from the upload.
+        self.dev_row_hash = None
+        self.dev_digest = None
         outstanding = self._recovering + self._launched + self._pending
         # Clear BEFORE replaying: the host path may drain/read this
         # engine re-entrantly, and must see an empty stream.
@@ -1383,9 +1639,10 @@ class DeviceEngine:
                 self.try_repromote()
             return
         if (
-            _SCRUB_EVERY
+            self._scrub_every
             and self.state is EngineState.healthy
-            and self.stat_fetches >= self._last_scrub_fetch + _SCRUB_EVERY
+            and self.stat_fetches
+            >= self._last_scrub_fetch + self._scrub_every
         ):
             try:
                 self.scrub()
@@ -1404,12 +1661,18 @@ class DeviceEngine:
         self.state = EngineState.repromoting
         try:
             self._retry(self.link.probe, "probe")
-            self._upload_from_mirror()
-            self.meta = self._place(jnp.asarray(self._meta_host))
+            self._heal_from_mirror()  # meta first, commitment rebuilt
             self.ring = jnp.zeros((_RING, dk.SUMMARY_WORDS), jnp.uint64)
             self._ring_at = 0
-            dev_sum = self._device_health_digest()
-            host_sum = self._host_health_digest()
+            if self._commit_enabled and self.mirror.commitment is not None:
+                # Cheap handshake: the device's freshly-rebuilt 16-byte
+                # root vs the incrementally-maintained host twin — no
+                # full-table fetch, no host-side full digest pass.
+                dev_sum = self.device_root()
+                host_sum = self.mirror.commitment.digest
+            else:
+                dev_sum = self._device_health_digest()
+                host_sum = self._host_health_digest()
             if not (dev_sum == host_sum).all():
                 raise FatalLinkError(
                     "re-promotion checksum handshake mismatch: "
@@ -1426,10 +1689,17 @@ class DeviceEngine:
         return True
 
     def scrub(self) -> bool:
-        """Checksum-compare the device table against the mirror while
-        idle; heal divergence by re-uploading from the mirror.  Returns
-        True when the tables already matched.  Raises DeviceLostError
-        if the link dies mid-scrub (caller demotes)."""
+        """Integrity-compare the device tables against the host while
+        idle; heal divergence by re-uploading from the mirror.
+        Returns True when the tables already matched.  Raises
+        DeviceLostError if the link dies mid-scrub (caller demotes).
+
+        Happy path (commitment enabled): ONE dispatch + one 32-byte
+        fetch — the device's maintained digest, its from-scratch
+        recompute (catches HBM corruption of rows no step touched),
+        and the host twin must all agree.  Only a mismatch (or the
+        TB_DEV_SCRUB_FALLBACK deep-scrub cadence) pays the full-table
+        fetch, which then NAMES the diverged rows before the heal."""
         if (
             self.state is not EngineState.healthy
             or self.has_inflight()
@@ -1438,24 +1708,61 @@ class DeviceEngine:
             return True
         self._last_scrub_fetch = self.stat_fetches
         self.stat_scrubs += 1
+        cheap = (
+            self._commit_enabled
+            and self.dev_digest is not None
+            and self.mirror.commitment is not None
+        )
         with self._h_scrub_cost.time():
-            clean = bool(
-                (
-                    self._device_health_digest()
-                    == self._host_health_digest()
-                ).all()
-            )
-            if clean:
+            if cheap:
+                self.stat_scrub_cheap += 1
+                with self._h_scrub_cheap.time():
+                    pair = self.commit_probe()
+                host = self.mirror.commitment.digest
+                clean = bool(
+                    (pair[0] == pair[1]).all() and (pair[1] == host).all()
+                )
+                deep_every = envcheck.scrub_fallback_every()
+                if clean and not (
+                    deep_every and self.stat_scrubs % deep_every == 0
+                ):
+                    return True
+            else:
+                clean = bool(
+                    (
+                        self._device_health_digest()
+                        == self._host_health_digest()
+                    ).all()
+                )
+                if clean:
+                    return True
+            # Divergence localization (the demoted full-fetch path) +
+            # heal.  A deep scrub that confirms the cheap verdict
+            # returns clean without healing.
+            self.stat_scrub_fallback += 1
+            with self._h_scrub_fallback.time():
+                rows = self._localize_divergence()
+            if len(rows) == 0:
+                if not clean:
+                    # Tables match byte-for-byte yet a digest
+                    # disagreed: incremental-accumulator drift.  Must
+                    # never happen (fuzz-pinned); repaired loudly so a
+                    # wedged digest cannot spam heals forever.
+                    self.stat_commit_repairs += 1
+                    if self.mirror.commitment is not None:
+                        self.mirror.commitment.rebuild(self.mirror)
+                    self._commit_rebuild()
                 return True
+            self.tracer.instant("scrub_divergence", rows=int(len(rows)))
             self.stat_scrub_heals += 1
-            self._upload_from_mirror()
-            self.meta = self._place(jnp.asarray(self._meta_host))
+            self._heal_from_mirror()
         return False
 
     # ------------------------------------------------------------------
     # Write-behind lane (host exact path) — kernel_fast.DeviceTable API.
 
-    def enqueue(self, slots, cols, add_lo, add_hi) -> None:
+    def enqueue(self, slots, cols, add_lo, add_hi,
+                refresh_twin: bool = True) -> None:
         if len(slots) == 0:
             return
         # The native fast path mutates the shared mirror arrays in
@@ -1463,8 +1770,16 @@ class DeviceEngine:
         # but ALWAYS feeds its deltas through here — bump the mutation
         # stamp so the degraded-read cache can never serve stale rows
         # (including suppressed re-execution enqueues, whose mirror
-        # mutation already happened natively).
+        # mutation already happened natively), and fold the touched
+        # rows into the host commitment twin for the same reason.
+        # Callers whose deltas came through the mirror's own Python
+        # methods (whose _touch already refreshed the twin) pass
+        # refresh_twin=False to skip the duplicate hashing.
         self.mirror.version += 1
+        if refresh_twin and self.mirror.commitment is not None:
+            self.mirror.commitment.refresh(
+                np.asarray(slots, np.int64), self.mirror
+            )
         if self._suppress_enqueue:
             return
         if self.state is not EngineState.healthy:
@@ -1544,6 +1859,7 @@ class DeviceEngine:
         # Flushed deltas must land before any later queued meta/lookup
         # records are dispatched — but those only dispatch at the next
         # launch, which follows this flush in program order.
+        self._commit_update(u_slot)
 
     def read(self):
         """Drain barrier + table handle (DeviceTable API compat).  In

@@ -105,6 +105,11 @@ class DeviceTable:
         self.balances = self._place(jnp.zeros((capacity, 8), jnp.uint64))
         self._q: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = []
         self._queued = 0
+        # Host BalanceMirror this table shadows (set by the owning
+        # state machine): the native fast path mutates the mirror
+        # arrays in place and feeds the deltas ONLY through enqueue,
+        # so the incremental-commitment twin refreshes here.
+        self.mirror = None
 
     def _place(self, table):
         if self.sharding is None:
@@ -127,10 +132,22 @@ class DeviceTable:
                 jnp.concatenate([jax.device_get(self.balances), extra])
             )
 
-    def enqueue(self, slots, cols, add_lo, add_hi) -> None:
-        """Queue compact (slot, col, delta) modular adds."""
+    def enqueue(self, slots, cols, add_lo, add_hi,
+                refresh_twin: bool = True) -> None:
+        """Queue compact (slot, col, delta) modular adds.
+
+        `refresh_twin=False`: the caller's deltas came through the
+        mirror's own methods, whose _touch already refreshed the
+        commitment twin — only native in-place mutations (which
+        bypass those methods) need the refresh here."""
         if len(slots) == 0:
             return
+        if refresh_twin and (
+            self.mirror is not None and self.mirror.commitment is not None
+        ):
+            self.mirror.commitment.refresh(
+                np.asarray(slots, np.int64), self.mirror
+            )
         self._q.append(
             (
                 np.asarray(slots, np.int32),

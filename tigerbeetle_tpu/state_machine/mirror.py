@@ -114,6 +114,16 @@ class BalanceMirror:
         self.lo = np.zeros((capacity, 4), np.uint64)
         self.hi = np.zeros((capacity, 4), np.uint64)
         self.version = 0
+        # Optional incremental state commitment (commitment.py): when
+        # attached, every mutating method re-hashes exactly the rows
+        # it touched, so the 16-byte state root is always current
+        # without a full-table pass.  None = disabled (TB_STATE_COMMIT
+        # =0), zero overhead.
+        self.commitment = None
+
+    def _touch(self, slots) -> None:
+        if self.commitment is not None:
+            self.commitment.refresh(slots, self)
 
     def grow(self, capacity: int) -> None:
         if capacity <= len(self.lo):
@@ -124,6 +134,8 @@ class BalanceMirror:
         hi[: len(self.hi)] = self.hi
         self.lo, self.hi = lo, hi
         self.version += 1
+        # All-zero rows hash to 0: growth never moves the root (the
+        # twin widens its per-row hash store lazily on next refresh).
 
     def rows8(self, slots: np.ndarray) -> np.ndarray:
         """(k, 8) interleaved rows matching the device layout."""
@@ -159,6 +171,7 @@ class BalanceMirror:
         self.lo[uniq] = rows[pick][:, 0::2]
         self.hi[uniq] = rows[pick][:, 1::2]
         self.version += 1
+        self._touch(uniq)
 
     def try_apply_adds(
         self, dr_slot, cr_slot, amt_lo, amt_hi, is_pending, mask,
@@ -249,6 +262,7 @@ class BalanceMirror:
             self.lo[u_slot, u_col] = new_lo
             self.hi[u_slot, u_col] = new_hi
             self.version += 1
+            self._touch(touched)
         return True
 
     def try_apply_deltas(self, slots, cols, amt_lo, amt_hi):
@@ -283,3 +297,4 @@ class BalanceMirror:
         self.lo[u_slot, u_col] = new_lo
         self.hi[u_slot, u_col] = new_hi
         self.version += 1
+        self._touch(u_slot)

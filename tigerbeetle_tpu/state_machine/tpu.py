@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import envcheck
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.lsm import pack_u128
 from tigerbeetle_tpu.obs import stat_property as obs_stat_property
@@ -420,6 +421,21 @@ class TpuStateMachine:
         self._acct_dir = RunIndex(_dir_capacity(account_capacity))
         self._attrs = Columns(_ATTR_FIELDS, capacity=max(1024, account_capacity))
         self._mirror = BalanceMirror(account_capacity)
+        # Incremental state commitment (commitment.py): the host twin
+        # rides the mirror — every mirror mutation re-hashes exactly
+        # the rows it touched — with meta columns read live from the
+        # attribute store (survives native re-pointing + restores).
+        # Attached BEFORE the device engine so both sides share it.
+        self._commitment = None
+        if envcheck.state_commit() == 1:
+            from tigerbeetle_tpu.state_machine import (
+                commitment as commitment_mod,
+            )
+
+            self._commitment = commitment_mod.HostCommitment(
+                account_capacity, meta_fn=self._commit_meta_cols
+            )
+            self._mirror.commitment = self._commitment
         if self.engine == "device":
             from tigerbeetle_tpu.state_machine.device_engine import (
                 DeviceEngine,
@@ -441,6 +457,7 @@ class TpuStateMachine:
                 )
         else:
             self._dev = kernel_fast.DeviceTable(account_capacity)
+            self._dev.mirror = self._mirror
         # Native C++ fast path (native/tb_fastpath.cpp): wire decode,
         # static ladder, account resolution, duplicate detection and
         # u128 overflow admission run natively; the balance mirror is
@@ -534,6 +551,53 @@ class TpuStateMachine:
         if self.engine == "device":
             self._dev.drain()
 
+    def _commit_meta_cols(self, slots: np.ndarray) -> np.ndarray:
+        """(k, 2) uint32 account-meta columns (flags, ledger) for the
+        state commitment — read live from the attribute store, zeros
+        past the live account count (matching the engine's meta
+        table, where rolled-back/unused slots are zero)."""
+        slots = np.asarray(slots, np.int64)
+        out = np.zeros((len(slots), 2), np.uint32)
+        m = slots < self._attrs.count
+        if m.any():
+            out[m, 0] = self._attrs.col("flags")[slots[m]]
+            out[m, 1] = self._attrs.col("ledger")[slots[m]]
+        return out
+
+    def _commit_touch_accounts(self, n0: int) -> None:
+        """Fold accounts created since slot n0 (their meta columns
+        just became nonzero) into the host commitment twin.  Device
+        engines already refreshed these rows in
+        DeviceEngine.add_accounts (via _sync_engine_meta, which runs
+        first at both call sites) — re-hashing them here would be an
+        idempotent double pay."""
+        if self._commitment is None or self._attrs.count <= n0:
+            return
+        if self.engine == "device":
+            return
+        self._commitment.refresh(
+            np.arange(n0, self._attrs.count, dtype=np.int64), self._mirror
+        )
+
+    def state_root(self) -> bytes:
+        """16-byte state commitment of the account table (balances +
+        meta), current to the last materialized commit: the
+        incrementally-maintained twin when TB_STATE_COMMIT=1, a
+        from-scratch digest of the same value otherwise.  Read-only —
+        never touches the device link (healthy, degraded, and
+        recovering engines all agree with the host by contract; the
+        scrub/handshake/checkpoint tripwires enforce it)."""
+        from tigerbeetle_tpu.state_machine import commitment as cm
+
+        if self._commitment is not None:
+            return self._commitment.root_bytes()
+        n = self._attrs.count
+        bal8 = np.empty((n, 8), np.uint64)
+        bal8[:, 0::2] = self._mirror.lo[:n]
+        bal8[:, 1::2] = self._mirror.hi[:n]
+        meta = self._commit_meta_cols(np.arange(n, dtype=np.int64))
+        return cm.root_bytes(cm.table_digest(bal8, meta))
+
     def verify_device_mirror(self) -> None:
         """Compare the device balance table against the host mirror via
         an order-independent digest; crash loudly on divergence
@@ -541,13 +605,77 @@ class TpuStateMachine:
         degraded mode the mirror IS the authoritative table, so there
         is nothing to compare (and no device work that could be done)
         — the handshake that matters there is re-promotion's
-        (device_engine.try_repromote)."""
+        (device_engine.try_repromote).
+
+        With the incremental commitment live the compare is 32 fetched
+        bytes (device maintained digest + from-scratch recompute vs
+        the host twin); the full-table fetch runs only to NAME the
+        diverged rows in the crash message."""
         from tigerbeetle_tpu.state_machine import device_kernels as dk
+        from tigerbeetle_tpu.state_machine.device_engine import (
+            DeviceLostError,
+        )
 
         dev = self._dev
         if getattr(dev, "state", None) is not None:
             if dev.state is not types.EngineState.healthy:
                 return
+            if (
+                dev._commit_enabled
+                and self._commitment is not None
+                and dev.dev_digest is not None
+            ):
+                from tigerbeetle_tpu.state_machine import commitment as cm
+
+                dev.drain()
+                dev.flush()
+                if dev.state is not types.EngineState.healthy:
+                    return
+                try:
+                    pair = np.asarray(dev.commit_probe())
+                except DeviceLostError as exc:
+                    dev._demote(exc)
+                    return
+                twin = self._commitment.digest
+                # Checkpoint tripwire = the strongest compare: the
+                # device's maintained digest, its from-scratch
+                # recompute, the incrementally-maintained host twin,
+                # AND a from-scratch host digest of the mirror must
+                # all agree — so device drift, HBM corruption, twin
+                # drift, and out-of-band mirror mutation each die
+                # here, four-way-attributed.  (The host pass costs
+                # what the old checksum8 compare cost; the CHEAP
+                # 16-byte compares are scrub's and the handshake's.)
+                n_rows = len(self._mirror.lo)
+                bal8 = np.empty((n_rows, 8), np.uint64)
+                bal8[:, 0::2] = self._mirror.lo
+                bal8[:, 1::2] = self._mirror.hi
+                host_scratch = cm.table_digest(
+                    bal8,
+                    self._commit_meta_cols(
+                        np.arange(n_rows, dtype=np.int64)
+                    ),
+                )
+                if (
+                    (pair[0] == pair[1]).all()
+                    and (pair[1] == twin).all()
+                    and (twin == host_scratch).all()
+                ):
+                    return
+                try:
+                    rows = dev._localize_divergence()
+                    detail = (
+                        f"{len(rows)} rows diverged"
+                        f" (first: {rows[:8].tolist()})"
+                    )
+                except DeviceLostError as exc:
+                    detail = f"localization fetch failed: {exc!r}"
+                raise AssertionError(
+                    "device/mirror commitment divergence at checkpoint: "
+                    f"{detail}; device(maintained, scratch)={pair.tolist()} "
+                    f"twin={twin.tolist()} "
+                    f"host_scratch={host_scratch.tolist()}"
+                )
             dev_sum = dev.checksum()  # drains + flushes internally
             if dev.state is not types.EngineState.healthy:
                 return  # the checksum crossing itself demoted
@@ -830,6 +958,7 @@ class TpuStateMachine:
         reply = self._commit_create_accounts_fast(timestamp, events, n)
         if reply is not None:
             self._sync_engine_meta(n0)
+            self._commit_touch_accounts(n0)
             return reply
         results: list[tuple[int, int]] = []
 
@@ -957,6 +1086,7 @@ class TpuStateMachine:
 
         self._ensure_balance_capacity(self._attrs.count)
         self._sync_engine_meta(n0)
+        self._commit_touch_accounts(n0)
 
         out = np.zeros(len(results), dtype=CREATE_RESULT_DTYPE)
         for i, (index, result) in enumerate(results):
@@ -2829,7 +2959,7 @@ class TpuStateMachine:
         )
         if deltas is None:
             return None
-        self._dev.enqueue(*deltas)
+        self._dev.enqueue(*deltas, refresh_twin=False)
 
         created = {
             "flags": flags,
@@ -2890,7 +3020,7 @@ class TpuStateMachine:
             amount_lo, amount_hi, np.zeros(n, bool), results == 0,
         )
         assert deltas is not None  # subset of the admitted superset
-        self._dev.enqueue(*deltas)
+        self._dev.enqueue(*deltas, refresh_twin=False)
         created = {
             "flags": flags,
             "dr_slot": dr_slot.astype(np.int32),
@@ -3069,9 +3199,10 @@ class TpuStateMachine:
                 np.concatenate([deltas[1], sub_cols]),
                 np.concatenate([deltas[2], neg_lo]),
                 np.concatenate([deltas[3], neg_hi]),
+                refresh_twin=False,
             )
         else:
-            self._dev.enqueue(*deltas)
+            self._dev.enqueue(*deltas, refresh_twin=False)
 
         # --- durable store rows (zero-means-inherit resolution for
         # created pv rows; reference: src/state_machine.zig:1697-1720).
@@ -3364,7 +3495,7 @@ class TpuStateMachine:
         self._mirror.apply_subs(slots, cols, amt_lo, amt_hi)
         zero = np.zeros(len(slots), np.uint64)
         neg_lo, neg_hi, _ = _sub_u128(zero, zero, amt_lo, amt_hi)
-        self._dev.enqueue(slots, cols, neg_lo, neg_hi)
+        self._dev.enqueue(slots, cols, neg_lo, neg_hi, refresh_twin=False)
 
         st["status"][rows] = np.uint8(TransferPendingStatus.expired)
         for row in rows:
@@ -3700,6 +3831,17 @@ def _tpu_restore(self, data: bytes) -> None:
     self._mirror.hi[:n_acct] = state["mirror_hi"]
     if self._native is not None:
         self._rebuild_native(cap)
+    if self._commitment is not None:
+        # Fresh twin over the restored mirror + attrs: recovery
+        # recomputes the commitment from scratch (the replica asserts
+        # it against the superblock's recorded state root).
+        from tigerbeetle_tpu.state_machine import commitment as commitment_mod
+
+        self._commitment = commitment_mod.HostCommitment(
+            cap, meta_fn=self._commit_meta_cols
+        )
+        self._commitment.rebuild(self._mirror)
+        self._mirror.commitment = self._commitment
     if self.engine == "device":
         from tigerbeetle_tpu.state_machine.device_engine import (
             DeviceEngine,
@@ -3725,6 +3867,7 @@ def _tpu_restore(self, data: bytes) -> None:
             )
     else:
         self._dev = kernel_fast.DeviceTable(cap)
+        self._dev.mirror = self._mirror
         self._dev.balances = self._dev._place(
             jnp.asarray(self._mirror.rows8(np.arange(cap, dtype=np.int64)))
         )

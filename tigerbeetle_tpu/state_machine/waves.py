@@ -2038,3 +2038,24 @@ def unpack_wave_record(pk: PackedColumns):
     dstat_init = cols.pop("__dstat_init__")
     hist_fix = cols.pop("__hist_fix__")
     return cols, dstat_init, hist_fix
+
+
+def touched_slots(ev: dict, n: int | None = None) -> np.ndarray:
+    """Balance rows a wave batch can modify — the event dict's own
+    dr/cr slots plus the durable pending targets' (post/void writes
+    land on the TARGET's accounts; in-batch targets resolve to the
+    creator event's slots, already covered).  A superset is fine: the
+    incremental-commitment refresh of an unmodified row is a no-op
+    (device_engine._commit_update)."""
+    parts = []
+    for key in ("dr_slot", "cr_slot", "p_dr_slot", "p_cr_slot"):
+        col = ev.get(key)
+        if col is None:
+            continue
+        a = np.asarray(col).astype(np.int64).ravel()
+        if n is not None:
+            a = a[:n]
+        parts.append(a[a >= 0])
+    if not parts:
+        return np.zeros(0, np.int64)
+    return np.unique(np.concatenate(parts))

@@ -440,6 +440,21 @@ class Cluster:
             "state machines diverged after identical commit hashes "
             "(non-deterministic state outside the commit path)"
         )
+        # State roots are the cheap always-on rendering of the same
+        # convergence claim (commitment.py): every replica at the same
+        # commit must report one 16-byte root.  Snapshot equality
+        # above makes this mostly redundant — it is asserted anyway so
+        # a root computation that diverges between replicas (e.g. an
+        # incremental-twin drift on one) fails HERE with the roots in
+        # hand, not later at a checkpoint assert.
+        roots = {
+            r.sm.state_root()
+            for r in self.replicas
+            if hasattr(r.sm, "state_root")
+        }
+        assert len(roots) <= 1, (
+            f"state roots diverged: {sorted(x.hex() for x in roots)}"
+        )
 
     def settle(self, max_steps: int = 3000) -> None:
         """Run until all replicas have converged on the same commit."""
@@ -609,6 +624,24 @@ class SimRouter:
 
     def _issue(self, subops) -> None:
         for sub in subops:
+            if sub.kind == "root":
+                # Sessionless proof-of-state query: in production the
+                # shard's server loop answers it outside consensus
+                # (runtime/server.py _send_state_root_reply); the sim
+                # transport models that by reading the live state
+                # machine directly.
+                from tigerbeetle_tpu.state_machine import commitment
+
+                shard = self.sharded.shards[sub.shard]
+                sm = self.sharded._live_sm(sub.shard)
+                root = (
+                    sm.state_root()
+                    if hasattr(sm, "state_root")
+                    else bytes(16)
+                )
+                commit_min = max(r.commit_min for r in shard.replicas)
+                sub.complete(commitment.root_body(root, commit_min))
+                continue
             if sub.kind == "fwd":
                 ep = self._endpoint(sub.shard, sub.client, self._fwd,
                                     (sub.client, sub.shard))
@@ -646,6 +679,17 @@ class SimRouter:
         return not self._tasks and not any(
             ep._current or ep._queue for ep in self.endpoints
         )
+
+    def query_cluster_root(self) -> bytes:
+        """The client-facing `state_root` query through the router
+        core: per-shard roots fetched via "root" subops (synchronous
+        in the sim transport) and folded deterministically.  Returns
+        the 24-byte root_body(folded_root, n_shards)."""
+        task = self.core.state_root()
+        self._issue(task.subops)
+        task.pump()
+        assert task.done, "sim root subops must complete synchronously"
+        return task.result
 
     def pump(self) -> None:
         done = []
@@ -883,6 +927,34 @@ class ShardedCluster:
             int((lo[:, c] + (hi[:, c] * (1 << 64))).sum()) for c in range(4)
         ]
         return totals[0], totals[2], totals[1], totals[3]
+
+    def cluster_commitment(self) -> bytes:
+        """The folded cluster state commitment: per-shard 16-byte
+        roots combined with the router's deterministic fold
+        (commitment.fold_cluster) — shard index bound into each
+        contribution, so shards swapping state moves the root."""
+        from tigerbeetle_tpu.state_machine import commitment
+
+        return commitment.fold_cluster(
+            [self._live_sm(s).state_root() for s in range(self.n_shards)]
+        )
+
+    def check_cluster_commitment(self) -> bytes:
+        """Audit point: every replica of every shard agrees on its
+        shard root, and the folded cluster commitment is well-defined
+        (returned so callers can compare it against the router's
+        query-path fold)."""
+        for s, shard in enumerate(self.shards):
+            roots = {
+                r.sm.state_root()
+                for r in shard.replicas
+                if hasattr(r.sm, "state_root")
+            }
+            assert len(roots) <= 1, (
+                f"shard {s} replicas disagree on state root: "
+                f"{sorted(x.hex() for x in roots)}"
+            )
+        return self.cluster_commitment()
 
     def check_conservation(self) -> None:
         """Double-entry conservation PER SHARD, at any audit point:

@@ -981,6 +981,15 @@ class ShardedVopr:
         self._audit_point()
         c.check_shards()
         c.check_atomicity(self.workload.xfers, final=True)
+        # Final proof-of-state audit: per-shard roots agree across
+        # replicas, the folded cluster commitment is well-defined, and
+        # the router's query path folds to the same value.
+        folded = c.check_cluster_commitment()
+        if c.router is not None:
+            from tigerbeetle_tpu.state_machine import commitment as _cm
+
+            root, _n = _cm.parse_root_body(c.router.query_cluster_root())
+            assert root == folded, (root.hex(), folded.hex())
         self.oracle_compare()
 
     def _record_ok(self, op, body: bytes, kind: str, reply: bytes) -> None:

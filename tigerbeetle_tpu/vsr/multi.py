@@ -597,11 +597,13 @@ class VsrReplica(Replica):
             self.bus.send(self.primary_index(), header, body)
             return
         operation = int(header["operation"])
-        if operation == int(VsrOperation.stats):
-            # Admin scrape: answered by the server loop from its
-            # registry snapshot (obs/scrape.py), never prepared — a
-            # stats request reaching the pipeline would hit the
-            # asserting state-machine dispatch at commit.
+        if operation in (
+            int(VsrOperation.stats), int(VsrOperation.state_root)
+        ):
+            # Admin scrape / proof-of-state query: answered by the
+            # server loop (obs/scrape.py), never prepared — such a
+            # request reaching the pipeline would hit the asserting
+            # state-machine dispatch at commit.
             return
         if operation >= constants.VSR_OPERATIONS_RESERVED:
             # Malformed client input (unknown op byte, wrong event
@@ -666,7 +668,9 @@ class VsrReplica(Replica):
         undecidable = inflight is UNDECIDABLE
         for i, h in enumerate(headers):
             operation = int(h["operation"])
-            if operation == int(VsrOperation.stats):
+            if operation in (
+                int(VsrOperation.stats), int(VsrOperation.state_root)
+            ):
                 continue  # answered by the server loop, never prepared
             body = bytes(bodies[i])
             if operation >= constants.VSR_OPERATIONS_RESERVED:
@@ -2305,6 +2309,14 @@ class VsrReplica(Replica):
             # restart.
             epoch=self.epoch,
             members=self.members,
+            # Recomputed from the state the blob restored, so a
+            # restart's recompute-and-assert covers synced
+            # checkpoints too.
+            state_root=(
+                int.from_bytes(self.sm.state_root(), "little")
+                if hasattr(self.sm, "state_root")
+                else 0
+            ),
         )
         self.checkpoint_op = checkpoint_op
         self.commit_min = checkpoint_op

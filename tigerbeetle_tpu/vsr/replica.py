@@ -255,6 +255,23 @@ class Replica:
             if wire.checksum(blob) != want:
                 raise RuntimeError("checkpoint snapshot corrupt")
             self._restore_snapshot(blob)
+            # State-root recompute-and-assert: the restored state
+            # machine re-derives its incremental commitment from
+            # scratch; it must match the root the checkpoint recorded
+            # — a blob that passes its checksum but decodes to
+            # different table content (codec drift, partial restore)
+            # dies HERE, not at the next cross-replica divergence.
+            root_stored = int(sb["state_root_lo"]) | (
+                int(sb["state_root_hi"]) << 64
+            )
+            if root_stored and hasattr(self.sm, "state_root"):
+                root_now = int.from_bytes(self.sm.state_root(), "little")
+                if root_now != root_stored:
+                    raise RuntimeError(
+                        "checkpoint state root mismatch after restore: "
+                        f"recorded {root_stored:#034x}, recomputed "
+                        f"{root_now:#034x}"
+                    )
 
         recovery = self.journal.recover(self.checkpoint_op)
         if recovery.faulty_ops and self.replica_count == 1:
@@ -885,6 +902,17 @@ class Replica:
                 self.sm.checkpoint_spill()
 
         blob = self._take_snapshot()
+        # The state root is part of the frozen image: captured here —
+        # the snapshot encode drained the state machine, so the
+        # incremental commitment is exactly commit_min's — and flipped
+        # into the superblock with the rest of the checkpoint
+        # references (recovery recomputes-and-asserts it; the VOPR
+        # compares it cross-replica).
+        state_root = (
+            int.from_bytes(self.sm.state_root(), "little")
+            if hasattr(self.sm, "state_root")
+            else 0
+        )
         region = int(self.superblock.working["sequence"]) % 2
         offset = self._grid_region_offset(region, len(blob))
         self._write_grid(offset, blob)
@@ -892,22 +920,23 @@ class Replica:
             self.commit_min, head_checksum, offset, len(blob),
             wire.checksum(blob), self.view, self.epoch,
             list(self.members) if self.members is not None else None,
+            state_root,
         )
 
     def _checkpoint_finalize(self, commit_min, head_checksum, offset,
                              size, blob_checksum, view, epoch,
-                             members) -> None:
+                             members, state_root) -> None:
         """Disk half (checkpoint worker in async mode): everything the
         new superblock references must be durable before the flip."""
         with self._h_ckpt_finalize.time():
             self._checkpoint_finalize_impl(
                 commit_min, head_checksum, offset, size, blob_checksum,
-                view, epoch, members,
+                view, epoch, members, state_root,
             )
 
     def _checkpoint_finalize_impl(self, commit_min, head_checksum, offset,
                                   size, blob_checksum, view, epoch,
-                                  members) -> None:
+                                  members, state_root) -> None:
         if self.aof is not None:
             # The AOF is a recovery stream: make it durable at least as
             # often as checkpoints (reference: src/aof.zig fsyncs).
@@ -939,6 +968,7 @@ class Replica:
             view=view,
             epoch=epoch,
             members=members,
+            state_root=state_root,
         )
         self.checkpoint_op = commit_min
         # Deliberately NOT releasing the free-set quarantine here: the

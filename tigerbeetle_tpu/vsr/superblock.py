@@ -75,8 +75,17 @@ SUPERBLOCK_DTYPE = np.dtype(
         # against the install point, not the current log_view.
         ("vh_log_view", "<u4"),
         ("view_headers", f"V{VIEW_HEADERS_MAX * HEADER_SIZE}"),
+        # State root (state_machine/commitment.py): the 16-byte
+        # incremental commitment of the account table at commit_min.
+        # Recovery recomputes it from the restored snapshot and
+        # asserts equality; the VOPR compares it cross-replica.  Zero
+        # = no checkpoint taken yet / state machine without roots.
+        # APPENDED (carved from reserved) so every pre-r15 field keeps
+        # its offset: an old data file decodes root=0 here, which the
+        # restore assert treats as "not recorded" and skips.
+        ("state_root_lo", "<u8"), ("state_root_hi", "<u8"),
         ("reserved",
-         f"V{SUPERBLOCK_COPY_SIZE - 208 - VIEW_HEADERS_MAX * HEADER_SIZE}"),
+         f"V{SUPERBLOCK_COPY_SIZE - 224 - VIEW_HEADERS_MAX * HEADER_SIZE}"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == SUPERBLOCK_COPY_SIZE
@@ -119,6 +128,7 @@ class SuperBlock:
         log_view: int | None = None,
         epoch: int | None = None,
         members: list[int] | None = None,
+        state_root: int = 0,
     ) -> None:
         """Durably advance to a new checkpoint (snapshot must already
         be synced in the grid zone — write ordering is the caller's
@@ -139,6 +149,8 @@ class SuperBlock:
         h["checkpoint_size"] = checkpoint_size
         h["checkpoint_checksum_lo"] = checkpoint_checksum & 0xFFFFFFFFFFFFFFFF
         h["checkpoint_checksum_hi"] = checkpoint_checksum >> 64
+        h["state_root_lo"] = state_root & 0xFFFFFFFFFFFFFFFF
+        h["state_root_hi"] = state_root >> 64
         if view is not None:
             h["view"] = view
         if log_view is not None:

@@ -72,6 +72,12 @@ class VsrOperation(enum.IntEnum):
     # its obs registry snapshot — read-only, sessionless, never enters
     # the consensus pipeline (obs/scrape.py).
     stats = 6
+    # Proof-of-state query (ours): the 16-byte incremental state
+    # commitment + commit_min, answered by the server loop from the
+    # state machine's host twin — same sessionless, never-prepared
+    # shape as `stats` (state_machine/commitment.py; the router folds
+    # per-shard roots into one cluster commitment).
+    state_root = 7
 
 
 HEADER_DTYPE = np.dtype(
