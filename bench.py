@@ -1573,6 +1573,712 @@ def run_open_loop() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_qos_suite() -> dict:
+    """Adversarial multi-tenant QoS scenario suite (round 16).
+
+    Three arms, each graded on ISOLATION: the victim tenant's p99
+    with the adversary present must stay within 25% of its solo-run
+    p99 at the same victim rate (the ROADMAP grade), while the
+    adversary drives 5x its fair share.
+
+    - noisy_neighbor: the hot tenant (ledger 1) drives 5x its fair
+      share with a Zipf-hot account mix; the victim (ledger 2) runs
+      at its share.  Per-tenant token buckets cap the hot tenant's
+      admitted rate, the per-tenant queue bound caps its backlog, and
+      the weighted-fair drain keeps the victim's queued requests from
+      waiting behind the flood.
+    - contention: the adversary (ledger 3) hammers ONE credit account
+      — serial row-dependency chains, the pathological wave shape —
+      while the victim (ledger 4) runs spread traffic at its share.
+    - cross_shard: through the r13 2PC router — the adversary
+      (ledger 1) is cross-shard-heavy (every transfer is a full 2PC),
+      the victim (ledger 2) strictly shard-local; the ROUTER's
+      tenant-keyed open-slot admission is the isolation mechanism.
+
+    Per-arm JSON carries victim solo/combined p99, the isolation
+    ratio + grade, and the per-tenant admit/shed counters scraped
+    from the live registries (vsr.qos.t<ledger>.*, router.qos.*)."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    from tigerbeetle_tpu import envcheck
+
+    phase_secs = envcheck.qos_suite_secs()
+    batch = int(os.environ.get("BENCH_QOS_BATCH", 64))
+    cluster_id = 29
+    tmp = tempfile.mkdtemp(prefix="tb_bench_qos_")
+    here = os.path.dirname(os.path.abspath(__file__))
+    procs: list = []
+    logs: list = []
+    clients: list = []
+    sessions: list = []
+    tid_next = [1]
+    out: dict = {
+        "phase_secs": phase_secs, "batch_events": batch,
+        "hot_offered_x_share": 5.0, "isolation_bound": 1.25,
+        "host_cores": os.cpu_count(),
+    }
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def wait_listening(proc, log_path, what):
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"{what} exited rc={proc.returncode}:\n"
+                    + open(log_path).read()[-2000:]
+                )
+            try:
+                if "listening" in open(log_path).read():
+                    return
+            except OSError:
+                pass
+            time.sleep(0.3)
+        raise AssertionError(f"{what} did not start: {log_path}")
+
+    def boot_replica(tag: str, port: int, extra_env: dict):
+        path = os.path.join(tmp, f"{tag}.tigerbeetle")
+        subprocess.run(
+            [
+                sys.executable, "-m", "tigerbeetle_tpu", "format",
+                f"--cluster={cluster_id}", "--replica=0",
+                "--replica-count=1", path,
+            ],
+            check=True, capture_output=True, cwd=here, timeout=120,
+        )
+        runner = (
+            "import sys; sys.path.insert(0, {here!r})\n"
+            "from tigerbeetle_tpu.runtime.server import ReplicaServer\n"
+            "from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine\n"
+            "s = ReplicaServer({path!r}, addresses=['127.0.0.1:{port}'],\n"
+            "    replica_index=0, grid_size=1 << 30,\n"
+            "    state_machine_factory=lambda: TpuStateMachine(\n"
+            "        account_capacity=1 << 12,\n"
+            "        transfer_capacity=1 << 22))\n"
+            "print('listening', flush=True)\n"
+            "s.serve_forever()\n"
+        ).format(here=here, path=path, port=port)
+        env = dict(os.environ)
+        env.update(extra_env)
+        log_path = os.path.join(tmp, f"{tag}.log")
+        log = open(log_path, "w")
+        logs.append(log)
+        p = subprocess.Popen(
+            [sys.executable, "-c", runner], stdout=log,
+            stderr=subprocess.STDOUT, cwd=here, env=env,
+        )
+        procs.append(p)
+        wait_listening(p, log_path, tag)
+        return p
+
+    def make_spread(rng, pool, n):
+        tids = np.arange(tid_next[0], tid_next[0] + n, dtype=np.uint64)
+        tid_next[0] += n
+        dr = rng.choice(pool, n)
+        cr = rng.choice(pool, n)
+        same = dr == cr
+        cr[same] = np.where(dr[same] == pool[0], pool[1], pool[0])
+        return tids, dr, cr
+
+    def pct(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))] * 1e3, 2)
+
+    def drive_open_loop(specs, secs):
+        """specs: (session, ledger, req_rate, body_fn).  Poisson per
+        spec; returns {ledger: {"lats": [...], "busy": n, "sent": n}}.
+        `busy` counts typed busy REPLIES received (the backoff path
+        retries them, so most never surface as completions)."""
+        rng = np.random.default_rng(97)
+        stats = {
+            ledger: {"lats": [], "busy": 0, "sent": 0}
+            for _s, ledger, _r, _f in specs
+        }
+        busy0 = {id(s): s.busy_replies for s, _l, _r, _f in specs}
+        for s, _ledger, _r, _f in specs:
+            s.completed.clear()
+        t0 = time.perf_counter()
+        t_end = t0 + secs
+        arrivals = [t0 for _ in specs]
+        while time.perf_counter() < t_end:
+            now = time.perf_counter()
+            for i, (s, ledger, rate, body_fn) in enumerate(specs):
+                while arrivals[i] <= now:
+                    s.submit(
+                        Operation.create_transfers, body_fn(),
+                        tenant=ledger,
+                    )
+                    stats[ledger]["sent"] += 1
+                    arrivals[i] += float(rng.exponential(1.0 / rate))
+            for s, _ledger, _r, _f in specs:
+                s.poll(0)
+            time.sleep(0.001)
+        grace = time.perf_counter() + max(10.0, 2 * secs)
+        while time.perf_counter() < grace and any(
+            s.inflight for s, _l, _r, _f in specs
+        ):
+            for s, _l, _r, _f in specs:
+                s.poll(10)
+        # Settle: the phase's server-side backlog must not drain into
+        # the NEXT phase's window (a combined phase's residue would
+        # pollute the following solo baseline).
+        settle = time.perf_counter() + 8.0
+        while time.perf_counter() < settle:
+            try:
+                snap = scrape_stats(addr, cluster_id, timeout_ms=3_000)
+                if int(snap.get("server.queue_depth", 0)) == 0:
+                    break
+            except (OSError, TimeoutError, ValueError):
+                pass
+            time.sleep(0.2)
+        for s, ledger, _r, _f in specs:
+            for (_req, kind, lat, _b, _op) in s.completed:
+                if kind == "reply":
+                    stats[ledger]["lats"].append(lat)
+            stats[ledger]["busy"] += s.busy_replies - busy0[id(s)]
+            s.inflight.clear()
+            s.completed.clear()
+        return stats
+
+    def tenant_counters(snap, scope, ledgers):
+        return {
+            f"t{ledger}": {
+                "admit": int(snap.get(f"{scope}.t{ledger}.admit", 0)),
+                "shed": int(snap.get(f"{scope}.t{ledger}.shed", 0)),
+            }
+            for ledger in ledgers
+        }
+
+    try:
+        from tigerbeetle_tpu.client import Client, OpenLoopSession
+        from tigerbeetle_tpu.obs.scrape import scrape_stats
+
+        # -- capacity probe: unrated server, closed loop ~1.5 s -------
+        port = free_port()
+        probe = boot_replica("probe", port, {"TB_TENANT_QOS": "0"})
+        addr = f"127.0.0.1:{port}"
+        setup = Client(addr, cluster_id, timeout_ms=120_000)
+        clients.append(setup)
+        n_acct = 256
+        pools = {}
+        for ledger in (1, 2, 3, 4):
+            ids = np.arange(
+                ledger * 10_000 + 1, ledger * 10_000 + n_acct + 1,
+                dtype=np.uint64,
+            )
+            reply = setup._native.request(
+                Operation.create_accounts,
+                accounts_bytes(ids, ledger=ledger), 120_000,
+            )
+            assert reply == b"", "qos setup: account failures"
+            pools[ledger] = ids
+        rng = np.random.default_rng(43)
+        for _ in range(3):  # untimed warmup (JIT)
+            tids, dr, cr = make_spread(rng, pools[1], batch)
+            setup._native.request(
+                Operation.create_transfers,
+                transfers_bytes(tids, dr, cr,
+                                rng.integers(1, 100, batch, np.uint64),
+                                ledger=1),
+                120_000,
+            )
+        cap_secs = float(os.environ.get("BENCH_QOS_CAP_SECS", 1.5))
+        # Best of two windows: every rate below is a fraction of this
+        # number, and a single window on this box can undershoot 5x+
+        # when a scheduler stall lands inside it.
+        capacity_eps = 0.0
+        for _win in range(2):
+            t_end = time.perf_counter() + cap_secs
+            t0 = time.perf_counter()
+            done = 0
+            while time.perf_counter() < t_end:
+                tids, dr, cr = make_spread(rng, pools[1], batch)
+                setup._native.request(
+                    Operation.create_transfers,
+                    transfers_bytes(tids, dr, cr,
+                                    rng.integers(1, 100, batch,
+                                                 np.uint64),
+                                    ledger=1),
+                    120_000,
+                )
+                done += batch
+            capacity_eps = max(
+                capacity_eps, done / (time.perf_counter() - t0)
+            )
+        capacity_rps = capacity_eps / batch
+        setup.close()
+        clients.remove(setup)
+        probe.kill()
+        probe.wait(timeout=30)
+        procs.remove(probe)
+        out["capacity_eps"] = round(capacity_eps, 1)
+
+        # Shares: a fair share is 0.25x measured capacity; every
+        # tenant's bucket admits exactly ONE share (TB_TENANT_RATE is
+        # per-tenant, so the victim's own bucket is the same size —
+        # a bucket below the victim's rate sheds the VICTIM, measured
+        # here inflating its p99 with busy-backoff retries).  The
+        # victim runs at 0.7x its share — under its bucket, so its
+        # Poisson bursts ride the burst credit and it is never shed —
+        # while the hot tenant OFFERS 5x a share and is admitted at
+        # 1x: the flood's excess lives in its shed stream, not in
+        # shared queues, and aggregate admitted load (~0.43x
+        # capacity) stays below the tail-latency knee.  Sizing the
+        # bucket near the remaining headroom instead moves the
+        # overload inside: at 1.3x-share admission (combined
+        # utilization ~0.6 vs solo ~0.25) plain queueing put the
+        # victim's combined p99 at 1.7-2x solo with ZERO victim
+        # sheds — and fsync/checkpoint stall frequency scales with
+        # admitted throughput on this box's one disk, which no
+        # admission policy can remove.
+        share_rps = 0.25 * capacity_rps
+        victim_rate = max(0.5, 0.7 * share_rps)
+        hot_rate = max(1.0, 5.0 * share_rps)
+        rated_env = {
+            "TB_TENANT_QOS": "1",
+            "TB_TENANT_RATE": str(share_rps),
+            "TB_ADMIT_QUEUE": "64",
+            # Wide enough to absorb a scheduler/checkpoint stall at
+            # the victim's rate without shedding it (48 requests at a
+            # 0.25x-capacity share is ~640 ms of stall headroom on
+            # this box); the flood's backlog is still bounded per
+            # tenant, and the WFQ drain keeps the victim's requests
+            # from waiting behind it.
+            "TB_TENANT_QUEUE": "48",
+        }
+        out["tenant_rate_rps"] = round(share_rps, 2)
+
+        # -- single-server arms: noisy_neighbor + contention ----------
+        port = free_port()
+        boot_replica("rated", port, rated_env)
+        addr = f"127.0.0.1:{port}"
+        setup = Client(addr, cluster_id, timeout_ms=120_000)
+        clients.append(setup)
+        for ledger in (1, 2, 3, 4):
+            reply = setup._native.request(
+                Operation.create_accounts,
+                accounts_bytes(pools[ledger], ledger=ledger), 120_000,
+            )
+            assert reply == b"", "qos rated setup: account failures"
+        for _ in range(3):  # warmup the fresh server
+            tids, dr, cr = make_spread(rng, pools[1], batch)
+            setup._native.request(
+                Operation.create_transfers,
+                transfers_bytes(tids, dr, cr,
+                                rng.integers(1, 100, batch, np.uint64),
+                                ledger=1),
+                120_000,
+            )
+
+        def spread_body(ledger):
+            def make():
+                tids, dr, cr = make_spread(rng, pools[ledger], batch)
+                return transfers_bytes(
+                    tids, dr, cr,
+                    rng.integers(1, 100, batch, np.uint64),
+                    ledger=ledger,
+                )
+            return make
+
+        def zipf_body(ledger):
+            hot_ids = pools[ledger][:4]
+
+            def make():
+                tids, dr, cr = make_spread(rng, pools[ledger], batch)
+                hot = rng.random(batch) < 0.5
+                cr[hot] = rng.choice(hot_ids, int(hot.sum()))
+                same = dr == cr
+                dr[same] = pools[ledger][-1]
+                return transfers_bytes(
+                    tids, dr, cr,
+                    rng.integers(1, 100, batch, np.uint64),
+                    ledger=ledger,
+                )
+            return make
+
+        def hammer_body(ledger):
+            target = pools[ledger][0]
+
+            def make():
+                tids, dr, _cr = make_spread(rng, pools[ledger], batch)
+                cr = np.full(batch, target, np.uint64)
+                same = dr == cr
+                dr[same] = pools[ledger][-1]
+                return transfers_bytes(
+                    tids, dr, cr,
+                    rng.integers(1, 100, batch, np.uint64),
+                    ledger=ledger,
+                )
+            return make
+
+        import statistics
+
+        repeats = max(1, int(os.environ.get("BENCH_QOS_REPEATS", 3)))
+        out["repeats"] = repeats
+
+        def med(xs):
+            xs = [x for x in xs if x is not None]
+            return round(statistics.median(xs), 2) if xs else None
+
+        def single_server_arm(hot_ledger, victim_ledger, hot_fn):
+            """Interleaved solo/combined repeats, per-phase median p99
+            (the BENCH_r08 recipe: this box's wall-clock windows are
+            noisy; medians keep one scheduler stall from deciding the
+            grade)."""
+            victim_s = OpenLoopSession(addr, cluster_id,
+                                       0xA000 + victim_ledger)
+            hot_s = OpenLoopSession(addr, cluster_id, 0xA100 + hot_ledger)
+            sessions.extend([victim_s, hot_s])
+            solo_p99s, comb_p99s, comb_p50s, hot_p99s = [], [], [], []
+            replied = {"victim": 0, "hot": 0, "victim_solo": 0}
+            busy = {"victim": 0, "hot": 0}
+            pre = scrape_stats(addr, cluster_id, timeout_ms=10_000)
+            for _rep in range(repeats):
+                solo = drive_open_loop(
+                    [(victim_s, victim_ledger, victim_rate,
+                      spread_body(victim_ledger))],
+                    phase_secs,
+                )
+                combined = drive_open_loop(
+                    [
+                        (victim_s, victim_ledger, victim_rate,
+                         spread_body(victim_ledger)),
+                        (hot_s, hot_ledger, hot_rate, hot_fn(hot_ledger)),
+                    ],
+                    phase_secs,
+                )
+                solo_p99s.append(pct(solo[victim_ledger]["lats"], 0.99))
+                comb_p99s.append(
+                    pct(combined[victim_ledger]["lats"], 0.99)
+                )
+                comb_p50s.append(
+                    pct(combined[victim_ledger]["lats"], 0.5)
+                )
+                hot_p99s.append(pct(combined[hot_ledger]["lats"], 0.99))
+                replied["victim_solo"] += len(solo[victim_ledger]["lats"])
+                replied["victim"] += len(combined[victim_ledger]["lats"])
+                replied["hot"] += len(combined[hot_ledger]["lats"])
+                busy["victim"] += combined[victim_ledger]["busy"]
+                busy["hot"] += combined[hot_ledger]["busy"]
+            post = scrape_stats(addr, cluster_id, timeout_ms=10_000)
+            solo_p99 = med(solo_p99s)
+            comb_p99 = med(comb_p99s)
+            # Median of PER-REP ratios: each combined window is judged
+            # against its adjacent solo window, so a noisy-box stall
+            # that lands on one pair cannot decide the grade alone.
+            ratios = [
+                round(c / s_, 3)
+                for s_, c in zip(solo_p99s, comb_p99s) if s_ and c
+            ]
+            ratio = med(ratios)
+            ctr = tenant_counters(
+                post, "vsr.qos", (hot_ledger, victim_ledger)
+            )
+            pre_ctr = tenant_counters(
+                pre, "vsr.qos", (hot_ledger, victim_ledger)
+            )
+            for k in ctr:  # per-arm deltas, not since-boot totals
+                ctr[k] = {
+                    f: ctr[k][f] - pre_ctr[k][f] for f in ("admit", "shed")
+                }
+            return {
+                "victim_ledger": victim_ledger, "hot_ledger": hot_ledger,
+                "victim_offered_rps": round(victim_rate, 2),
+                "hot_offered_rps": round(hot_rate, 2),
+                "victim_solo_p99_ms": solo_p99,
+                "victim_solo_p99_ms_all": solo_p99s,
+                "victim_p99_ms": comb_p99,
+                "victim_p99_ms_all": comb_p99s,
+                "victim_p50_ms": med(comb_p50s),
+                "hot_p99_ms": med(hot_p99s),
+                "victim_replied": replied["victim"],
+                "hot_replied": replied["hot"],
+                "victim_busy": busy["victim"],
+                "hot_busy": busy["hot"],
+                "isolation_ratio": ratio,
+                "isolation_ratio_all": ratios,
+                "isolation_ok": (
+                    ratio is not None and ratio <= 1.25
+                ),
+                # Mechanism grade, wall-clock-insensitive: per-tenant
+                # admission must discriminate — the flood eats the
+                # sheds (>50% of its offered requests) while the
+                # victim keeps >95% admitted AND its reply throughput
+                # within 25% of solo.  On a loaded 1-2 core box the
+                # p99 grade above also prices shared-CPU/fsync stalls
+                # no admission policy can remove; this one does not.
+                "victim_throughput_retained": round(
+                    replied["victim"] / max(1, replied["victim_solo"]), 3
+                ),
+                "admission_isolation_ok": (
+                    ctr[f"t{hot_ledger}"]["shed"]
+                    > ctr[f"t{hot_ledger}"]["admit"]
+                    and ctr[f"t{victim_ledger}"]["shed"]
+                    <= 0.05 * max(1, ctr[f"t{victim_ledger}"]["admit"])
+                    and replied["victim"]
+                    >= 0.75 * replied["victim_solo"]
+                ),
+                "tenant_counters": ctr,
+            }
+
+        arms = {}
+        arms["noisy_neighbor"] = single_server_arm(1, 2, zipf_body)
+        arms["contention"] = single_server_arm(3, 4, hammer_body)
+        for s in sessions:
+            s.close()
+        sessions.clear()
+        setup.close()
+        clients.remove(setup)
+        for p in procs:
+            p.kill()
+            p.wait(timeout=30)
+        procs.clear()
+
+        # -- cross_shard arm: 2 shards behind the 2PC router ----------
+        # The router keys OPEN SLOTS, not rates: a cross-shard-heavy
+        # tenant costs ~4 shard sub-ops per request, so the isolation
+        # mechanism is a tight per-tenant open-slot bound AT THE
+        # ROUTER (2 of 64) — the aggressor's excess requests shed
+        # typed busy while local tenants' slots stay free.  The
+        # shards keep the relaxed bound (2PC legs must not churn
+        # through shard-side shedding).
+        shard_addrs = []
+        shard_env = dict(rated_env)
+        shard_env["TB_TENANT_RATE"] = "0"
+        router_env = dict(shard_env)
+        router_env["TB_ROUTER_QUEUE"] = "64"
+        router_env["TB_TENANT_QUEUE"] = "2"
+        for s in range(2):
+            sport = free_port()
+            shard_addrs.append(f"127.0.0.1:{sport}")
+            boot_replica(f"shard{s}", sport, shard_env)
+        rport = free_port()
+        router_runner = (
+            "import sys; sys.path.insert(0, {here!r})\n"
+            "from tigerbeetle_tpu.runtime.router import RouterServer\n"
+            "r = RouterServer('127.0.0.1:{port}', {shards!r},\n"
+            "    cluster={cluster}, recover=False)\n"
+            "print('listening', flush=True)\n"
+            "r.serve_forever()\n"
+        ).format(here=here, port=rport, shards=shard_addrs,
+                 cluster=cluster_id)
+        renv = dict(os.environ)
+        renv.update(router_env)
+        rlog_path = os.path.join(tmp, "router.log")
+        rlog = open(rlog_path, "w")
+        logs.append(rlog)
+        rproc = subprocess.Popen(
+            [sys.executable, "-c", router_runner], stdout=rlog,
+            stderr=subprocess.STDOUT, cwd=here, env=renv,
+        )
+        procs.append(rproc)
+        wait_listening(rproc, rlog_path, "router")
+        router_addr = f"127.0.0.1:{rport}"
+
+        from tigerbeetle_tpu.types import shard_of_account
+
+        setup = Client(router_addr, cluster_id, timeout_ms=120_000)
+        clients.append(setup)
+        n_acct2 = 512
+        rpools = {}
+        for ledger in (1, 2):
+            ids = np.arange(
+                ledger * 10_000 + 1, ledger * 10_000 + n_acct2 + 1,
+                dtype=np.uint64,
+            )
+            reply = setup._native.request(
+                Operation.create_accounts,
+                accounts_bytes(ids, ledger=ledger), 120_000,
+            )
+            assert reply == b"", "qos router setup: account failures"
+            rpools[ledger] = ids
+        by_shard = {
+            ledger: {
+                s: np.asarray(
+                    [a for a in rpools[ledger]
+                     if shard_of_account(int(a), 2) == s], np.uint64
+                )
+                for s in range(2)
+            }
+            for ledger in (1, 2)
+        }
+
+        lock = threading.Lock()
+
+        def next_tids(n):
+            with lock:
+                t = tid_next[0]
+                tid_next[0] += n
+            return np.arange(t, t + n, dtype=np.uint64)
+
+        xbatch = max(1, batch // 8)  # 2PC legs amplify per-event cost
+
+        def local_body(trng):
+            s = int(trng.integers(2))
+            pool = by_shard[2][s]
+            tids = next_tids(xbatch)
+            dr = trng.choice(pool, xbatch)
+            cr = trng.choice(pool, xbatch)
+            same = dr == cr
+            cr[same] = np.where(dr[same] == pool[0], pool[1], pool[0])
+            return transfers_bytes(
+                tids, dr, cr, trng.integers(1, 100, xbatch, np.uint64),
+                ledger=2,
+            )
+
+        def cross_body(trng):
+            tids = next_tids(xbatch)
+            dr = trng.choice(by_shard[1][0], xbatch)
+            cr = trng.choice(by_shard[1][1], xbatch)
+            return transfers_bytes(
+                tids, dr, cr, trng.integers(1, 100, xbatch, np.uint64),
+                ledger=1,
+            )
+
+        def closed_loop(ledger, body_fn, secs, lats, k):
+            trng = np.random.default_rng(1000 + k)
+            c = Client(f"{router_addr},{router_addr}", cluster_id,
+                       timeout_ms=120_000)
+            clients.append(c)
+            t_end = time.perf_counter() + secs
+            while time.perf_counter() < t_end:
+                body = body_fn(trng)
+                t1 = time.perf_counter()
+                c._native.request(
+                    Operation.create_transfers, body, 120_000
+                )
+                lats.append((ledger, time.perf_counter() - t1))
+
+        def router_phase(with_aggressor):
+            lats: list = []
+            threads = [threading.Thread(
+                target=closed_loop,
+                args=(2, local_body, phase_secs, lats, 0),
+                daemon=True,
+            )]
+            if with_aggressor:
+                threads.extend(
+                    threading.Thread(
+                        target=closed_loop,
+                        args=(1, cross_body, phase_secs, lats, k),
+                        daemon=True,
+                    )
+                    for k in range(1, 4)
+                )
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=phase_secs + 120)
+            return lats
+
+        pre = scrape_stats(router_addr, cluster_id, timeout_ms=10_000)
+        v_solo, v_comb, a_comb = [], [], []
+        solo_p99s, comb_p99s = [], []
+        for _rep in range(repeats):
+            solo_lats = router_phase(with_aggressor=False)
+            time.sleep(1.0)  # let 2PC residue settle between windows
+            comb_lats = router_phase(with_aggressor=True)
+            time.sleep(1.0)
+            vs = [lat for ledger, lat in solo_lats if ledger == 2]
+            vc = [lat for ledger, lat in comb_lats if ledger == 2]
+            v_solo.extend(vs)
+            v_comb.extend(vc)
+            a_comb.extend(
+                lat for ledger, lat in comb_lats if ledger == 1
+            )
+            solo_p99s.append(pct(vs, 0.99))
+            comb_p99s.append(pct(vc, 0.99))
+        post = scrape_stats(router_addr, cluster_id, timeout_ms=10_000)
+        solo_p99 = med(solo_p99s)
+        comb_p99 = med(comb_p99s)
+        xratios = [
+            round(c / s_, 3)
+            for s_, c in zip(solo_p99s, comb_p99s) if s_ and c
+        ]
+        ratio = med(xratios)
+        arms["cross_shard"] = {
+            "victim_ledger": 2, "hot_ledger": 1,
+            "victim_solo_requests": len(v_solo),
+            "victim_requests": len(v_comb),
+            "aggressor_requests": len(a_comb),
+            "victim_solo_p99_ms": solo_p99,
+            "victim_solo_p99_ms_all": solo_p99s,
+            "victim_p99_ms": comb_p99,
+            "victim_p99_ms_all": comb_p99s,
+            "victim_solo_p50_ms": pct(v_solo, 0.5),
+            "victim_p50_ms": pct(v_comb, 0.5),
+            "aggressor_p99_ms": pct(a_comb, 0.99),
+            "isolation_ratio": ratio,
+            "isolation_ratio_all": xratios,
+            "isolation_ok": ratio is not None and ratio <= 1.25,
+            # The router's tenant slot bound throttles the 2PC
+            # aggressor; the victim's throughput share is the
+            # CPU-insensitive view of the same isolation (a 1-2 core
+            # box serializes the 4 processes, so the victim's p99
+            # tail picks up scheduler noise no admission policy can
+            # remove — the ROADMAP multi-core carry-over applies).
+            "victim_throughput_retained": (
+                round(len(v_comb) / max(1, len(v_solo)), 3)
+            ),
+            "admission_isolation_ok": (
+                len(v_comb) >= 0.5 * len(v_solo)
+            ),
+            "cpu_bound": (os.cpu_count() or 1) <= 2,
+            "router_tenant_slots": 2,
+            "router_shed": int(post.get("router.shed", 0))
+            - int(pre.get("router.shed", 0)),
+            "router_2pc": int(post.get("router.2pc_commits", 0))
+            - int(pre.get("router.2pc_commits", 0)),
+        }
+
+        out["arms"] = arms
+        out["isolation_grade"] = all(
+            a.get("isolation_ok") for a in arms.values()
+        )
+        # The acceptance grade (noisy-neighbor victim within 25% while
+        # the hot tenant drives 5x): single-server arms, where the
+        # admission path — not host-core oversubscription — is what's
+        # being measured.
+        out["isolation_grade_single_server"] = all(
+            arms[a].get("isolation_ok")
+            for a in ("noisy_neighbor", "contention")
+        )
+        out["admission_isolation_grade"] = all(
+            a.get("admission_isolation_ok") for a in arms.values()
+        )
+        return out
+    finally:
+        for s in sessions:
+            try:
+                s.close()
+            except Exception:
+                pass
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        for log in logs:
+            log.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_sharded_cluster() -> dict:
     """Account-sharded multi-cluster scaling (runtime/router.py): K
     single-replica consensus groups behind the crash-safe 2PC router,
@@ -2654,8 +3360,8 @@ def main() -> None:
     t_run0 = time.time()
     budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 5400))
     # memory configs + waves compare + device-waves compare + durable
-    # + replicated + open-loop + sharded-cluster
-    n_configs_left = [len(CONFIGS) + 6]
+    # + replicated + open-loop + sharded-cluster + qos-suite
+    n_configs_left = [len(CONFIGS) + 7]
 
     def next_timeout(cap_s: float) -> int | None:
         remaining = budget_s - (time.time() - t_run0)
@@ -2760,7 +3466,8 @@ def main() -> None:
     for cname, flag in (("durable", "--durable-only"),
                         ("replicated", "--replicated-only"),
                         ("open_loop", "--open-loop"),
-                        ("sharded_cluster", "--sharded-cluster-only")):
+                        ("sharded_cluster", "--sharded-cluster-only"),
+                        ("qos_suite", "--qos-suite")):
         t = next_timeout(per_config_cap)
         configs_out[cname] = (
             dict(_SKIP_ROW) if t is None
@@ -3039,6 +3746,10 @@ if __name__ == "__main__":
         # Account-sharded multi-cluster scaling behind the 2PC router
         # (scaling efficiency vs shard count + in-doubt recovery).
         print(json.dumps(_mark_device_fallback(run_sharded_cluster())))
+    elif "--qos-suite" in sys.argv:
+        # Adversarial multi-tenant QoS arms (noisy-neighbor /
+        # contention / cross-shard), graded on victim-tenant isolation.
+        print(json.dumps(_mark_device_fallback(run_qos_suite())))
     elif memory_only:
         print(json.dumps(_mark_device_fallback(run_memory_only(memory_only[0]))))
     else:

@@ -328,7 +328,7 @@ def test_shed_runs_below_the_dedupe_gate():
     # Zero-bound the queue: anything that would queue is shed.
     r.admit_queue = 0
     sheds = []
-    r.on_shed = lambda h: sheds.append(int(h["request"]))
+    r.on_shed = lambda h, tenant=None: sheds.append(int(h["request"]))
 
     # Retransmit of the COMMITTED request: replayed from the stored
     # reply, never shed (the dedupe gate runs first).

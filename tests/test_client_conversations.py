@@ -48,12 +48,20 @@ def _recv_frame(sock, buf):
         buf += chunk
 
 
-def test_conversation_replay_byte_exact(tmp_path, pinned_time):
+@pytest.mark.parametrize("tenant_qos", ["0", "1"])
+def test_conversation_replay_byte_exact(tmp_path, pinned_time,
+                                        monkeypatch, tenant_qos):
+    """Replies must match the recorded pre-QoS contract in BOTH
+    tenant-QoS arms (round 16 differential): TB_TENANT_QOS=0 pins the
+    legacy single-queue path, and QoS ON under non-overload load must
+    be bit-identical to it (strict-FIFO drain outside an overload
+    episode)."""
     from tigerbeetle_tpu.runtime.server import (
         ReplicaServer, format_data_file,
     )
     from tigerbeetle_tpu.state_machine import CpuStateMachine
 
+    monkeypatch.setenv("TB_TENANT_QOS", tenant_qos)
     with open(FIXTURE) as fh:
         steps = json.load(fh)
     assert len(steps) >= 7

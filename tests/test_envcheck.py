@@ -341,6 +341,94 @@ def test_open_loop_bench_envs_validated(monkeypatch):
     assert envcheck.open_loop_burst() == 4.0
 
 
+def test_tenant_qos_envs_validated(monkeypatch):
+    monkeypatch.setenv("TB_TENANT_QOS", "2")
+    with pytest.raises(envcheck.EnvVarError, match="TB_TENANT_QOS"):
+        envcheck.tenant_qos()
+    monkeypatch.delenv("TB_TENANT_QOS")
+    assert envcheck.tenant_qos() == 1  # QoS on by default
+
+    monkeypatch.setenv("TB_TENANT_RATE", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="TB_TENANT_RATE"):
+        envcheck.tenant_rate()
+    monkeypatch.delenv("TB_TENANT_RATE")
+    assert envcheck.tenant_rate() == 0.0  # rate limit off by default
+
+    monkeypatch.setenv("TB_BUSY_BACKOFF_MS", "nah")
+    with pytest.raises(envcheck.EnvVarError, match="TB_BUSY_BACKOFF_MS"):
+        envcheck.busy_backoff_ms()
+    monkeypatch.setenv("TB_BUSY_BACKOFF_MS", "0")
+    assert envcheck.busy_backoff_ms() == 0.0  # legacy immediate retry
+    monkeypatch.delenv("TB_BUSY_BACKOFF_MS")
+    assert envcheck.busy_backoff_ms() == 20.0
+
+    monkeypatch.setenv("BENCH_QOS_SECS", "0.01")
+    with pytest.raises(envcheck.EnvVarError, match="BENCH_QOS_SECS"):
+        envcheck.qos_suite_secs()
+    monkeypatch.delenv("BENCH_QOS_SECS")
+    assert envcheck.qos_suite_secs() == 3.0
+
+
+def test_tenant_queue_constraint_names_global_bound(monkeypatch):
+    # A per-tenant bound above the global queue bound can never bind.
+    monkeypatch.setenv("TB_TENANT_QUEUE", "100")
+    with pytest.raises(
+        envcheck.EnvVarError, match="TB_ADMIT_QUEUE \\(64\\)"
+    ):
+        envcheck.tenant_queue(64)
+    monkeypatch.setenv("TB_TENANT_QUEUE", "16")
+    assert envcheck.tenant_queue(64) == 16
+    monkeypatch.delenv("TB_TENANT_QUEUE")
+    # 0 (default) = the global bound: no extra per-tenant isolation.
+    assert envcheck.tenant_queue(64) == 64
+
+
+def test_tenant_weights_validated(monkeypatch):
+    monkeypatch.setenv("TB_TENANT_WEIGHTS", "1:4, 7:2")
+    assert envcheck.tenant_weights() == {1: 4.0, 7: 2.0}
+    monkeypatch.setenv("TB_TENANT_WEIGHTS", "1:0")
+    with pytest.raises(envcheck.EnvVarError, match="TB_TENANT_WEIGHTS"):
+        envcheck.tenant_weights()
+    monkeypatch.setenv("TB_TENANT_WEIGHTS", "banana")
+    with pytest.raises(envcheck.EnvVarError, match="TB_TENANT_WEIGHTS"):
+        envcheck.tenant_weights()
+    monkeypatch.delenv("TB_TENANT_WEIGHTS")
+    assert envcheck.tenant_weights() == {}
+
+
+def test_no_tb_knob_bypasses_envcheck():
+    """Audit lint: every TB_* knob in the package must be read through
+    envcheck.py (validated, named errors), never via a raw os.environ
+    / os.getenv call.  A raw read silently accepts garbage and hides
+    the knob from the envcheck surface tests — this lint turns the
+    convention into a tier-1 invariant (and covers the round-16 QoS
+    knobs TB_TENANT_QOS / TB_TENANT_RATE / TB_TENANT_QUEUE /
+    TB_TENANT_WEIGHTS / TB_BUSY_BACKOFF_MS by construction)."""
+    import os
+    import re
+
+    pkg = os.path.dirname(envcheck.__file__)
+    pattern = re.compile(
+        r"os\.(?:environ\.get|environ\[|getenv)\s*\(?\s*"
+        r"(['\"])(TB_[A-Z0-9_]+)\1"
+    )
+    offenders = []
+    for root, _dirs, files in os.walk(pkg):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py") or fname == "envcheck.py":
+                continue
+            path = os.path.join(root, fname)
+            text = open(path).read()
+            for m in pattern.finditer(text):
+                line = text[: m.start()].count("\n") + 1
+                offenders.append(f"{path}:{line}: raw read of {m.group(2)}")
+    assert not offenders, (
+        "TB_* knobs must go through envcheck.py:\n" + "\n".join(offenders)
+    )
+
+
 def test_tb_metrics_disables_histograms(monkeypatch):
     from tigerbeetle_tpu import obs
 

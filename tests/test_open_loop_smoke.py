@@ -239,6 +239,30 @@ def test_open_loop_overload_sheds_and_dumps(tmp_path):
         assert flight["otherData"]["reason"] == "sigterm"
         assert flight["traceEvents"], "flight ring empty"
 
+        # 4b) The PRIMARY's flight dump names WHO was shed (round 16):
+        # every shed note carries the tenant (ledger 1 here, derived
+        # from the body — these sessions don't stamp the header), and
+        # a per-tenant `shed.t<ledger>` instant makes the per-tenant
+        # timeline greppable without parsing note args.
+        procs[0].send_signal(signal.SIGTERM)
+        flight0_path = tmp_path / "flight_r0.json"
+        deadline = time.time() + 15
+        while time.time() < deadline and not flight0_path.exists():
+            time.sleep(0.2)
+        assert flight0_path.exists(), "no flight dump on SIGTERM (r0)"
+        procs[0].wait(timeout=15)
+        flight0 = json.loads(flight0_path.read_text())
+        shed_notes = [
+            e for e in flight0["traceEvents"] if e["name"] == "shed"
+        ]
+        assert shed_notes, "primary shed but recorded no flight notes"
+        assert all(
+            e.get("args", {}).get("tenant") == 1 for e in shed_notes
+        ), shed_notes[:3]
+        assert any(
+            e["name"] == "shed.t1" for e in flight0["traceEvents"]
+        ), "no per-tenant shed instant"
+
         # 5) Perfetto round-trip: exemplar spans + the flight dump
         # merge into one loadable timeline with all stage names.
         ex_path = tmp_path / "exemplars.json"

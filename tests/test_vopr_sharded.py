@@ -85,3 +85,24 @@ def test_sharded_vopr_device_loss():
     )
     v.run()
     assert v._chaos_links
+
+
+def test_sharded_vopr_multi_tenant_flood():
+    """Multi-tenant workload through the 2PC router (round 16): three
+    ledgers with tenant 1 driving ~70% of the traffic, per-tenant QoS
+    live on every shard replica, coordinator kills included — 2PC
+    atomicity, conservation, and the oracle replay must hold across
+    the flood."""
+    v = ShardedVopr(
+        13, n_shards=2, replica_count=2, requests=26,
+        coordinator_kill_probability=0.008,
+        crash_probability=0.004, partition_probability=0.004,
+        fsync_crash_probability=0.002,
+        tenants=3,
+        tenant_qos=dict(rate=0.0, queue_bound=4),
+    )
+    v.run()
+    assert v.audits > 0
+    # The flood bias actually produced multi-ledger traffic.
+    ledgers = set(v.workload.ledger_of.values())
+    assert ledgers == {1, 2, 3}, ledgers

@@ -159,8 +159,11 @@ class OpenLoopSession:
     drive it.
     """
 
+    BUSY_RETRIES_MAX = 6  # then the busy surfaces as a completion
+
     def __init__(self, address: str, cluster: int, client_id: int, *,
                  register_timeout_ms: int = 30_000) -> None:
+        from tigerbeetle_tpu import envcheck
         from tigerbeetle_tpu.constants import HEADER_SIZE
         from tigerbeetle_tpu.runtime.native import EV_MESSAGE, NativeBus
         from tigerbeetle_tpu.vsr import wire
@@ -171,14 +174,27 @@ class OpenLoopSession:
         self.cluster = cluster
         self.id = client_id
         self.request_number = 0
-        # request number -> (submit perf_counter_ns, operation).
-        self.inflight: dict[int, tuple[int, int]] = {}
+        # request number -> (submit perf_counter_ns, operation, frame
+        # bytes) — the frame is kept so a typed busy can be
+        # retransmitted verbatim after backoff (same request number:
+        # it is a RETRANSMIT, so the at-most-once gate still applies).
+        self.inflight: dict[int, tuple[int, int, bytes]] = {}
         # (request_number, kind "reply"|"busy", latency_s, reply_body,
         #  operation) — the operation rides along so a mixed-op driver
         # (the read-heavy open-loop bench) can grade reads and writes
         # separately.
         self.completed: list[tuple[int, str, float, bytes, int]] = []
         self.busy_replies = 0
+        # Busy backoff (TB_BUSY_BACKOFF_MS; round 16): a shed request
+        # retransmits after base * 2^(streak-1) ms (capped 16x) plus
+        # deterministic seeded jitter instead of completing
+        # immediately — immediate retransmit re-offers the overload
+        # that shed it and self-amplifies the storm.  0 disables
+        # (busy surfaces as a completion at once, the legacy shape).
+        self.busy_backoffs = 0
+        self._backoff_base_ns = int(envcheck.busy_backoff_ms() * 1e6)
+        self._busy_streak: dict[int, int] = {}   # request -> streak
+        self._retry_at: dict[int, int] = {}      # request -> due ns
         host, _, port = address.rpartition(":")
         self.bus = NativeBus()
         self.conn = self.bus.connect(host or "127.0.0.1", int(port))
@@ -210,9 +226,11 @@ class OpenLoopSession:
                     return
         raise TimeoutError(f"open-loop register of client {self.id:#x}")
 
-    def submit(self, operation, body: bytes) -> int:
+    def submit(self, operation, body: bytes, *, tenant: int = 0) -> int:
         """Fire one request (no waiting).  Returns its request number;
-        the completion arrives via poll()."""
+        the completion arrives via poll().  `tenant` stamps the wire
+        tenant key (0 = legacy: the server derives it from the body's
+        leading event)."""
         wire = self._wire
         self.request_number += 1
         now = time.perf_counter_ns()
@@ -220,14 +238,16 @@ class OpenLoopSession:
             command=wire.Command.request, operation=operation,
             cluster=self.cluster, client=self.id,
             request=self.request_number,
+            tenant=tenant,
             trace_id=((self.id << 20) ^ self.request_number)
             & 0xFFFFFFFFFFFFFFFF,
             trace_ts=now,
             trace_flags=wire.TRACE_SAMPLED,
         )
         wire.finalize_header(h, body)
-        self.inflight[self.request_number] = (now, int(operation))
-        self.bus.send(self.conn, h.tobytes() + body)
+        frame = h.tobytes() + body
+        self.inflight[self.request_number] = (now, int(operation), frame)
+        self.bus.send(self.conn, frame)
         return self.request_number
 
     def poll(self, timeout_ms: int = 0) -> None:
@@ -246,6 +266,7 @@ class OpenLoopSession:
                 if not wire.verify_header(h, body):
                     continue
                 self._complete(h, bytes(body))
+            self._flush_backoff(time.perf_counter_ns())
             return
         import numpy as np
 
@@ -253,22 +274,38 @@ class OpenLoopSession:
 
         n, ev_types, _conns, offsets, lens, arena = batch
         if not n:
+            self._flush_backoff(time.perf_counter_ns())
             return
         is_msg = (ev_types[:n] == self._ev_message) & (lens[:n] > 0)
         midx = np.nonzero(is_msg)[0]
-        if not len(midx):
-            return
-        moffs = offsets[midx]
-        mlens = lens[midx]
-        ok, hdrs, _native = fastpath.verify_and_gather(arena, moffs, mlens)
-        mv = memoryview(arena)
-        for i in range(len(midx)):
-            if not ok[i]:
-                continue
-            off = int(moffs[i])
-            self._complete(
-                hdrs[i], bytes(mv[off + self._hs : off + int(mlens[i])])
+        if len(midx):
+            moffs = offsets[midx]
+            mlens = lens[midx]
+            ok, hdrs, _native = fastpath.verify_and_gather(
+                arena, moffs, mlens
             )
+            mv = memoryview(arena)
+            for i in range(len(midx)):
+                if not ok[i]:
+                    continue
+                off = int(moffs[i])
+                self._complete(
+                    hdrs[i],
+                    bytes(mv[off + self._hs : off + int(mlens[i])]),
+                )
+        self._flush_backoff(time.perf_counter_ns())
+
+    def _flush_backoff(self, now_ns: int) -> None:
+        """Retransmit busy-shed requests whose backoff expired."""
+        if not self._retry_at:
+            return
+        for req in [r for r, due in self._retry_at.items() if due <= now_ns]:
+            del self._retry_at[req]
+            entry = self.inflight.get(req)
+            if entry is None:
+                self._busy_streak.pop(req, None)
+                continue
+            self.bus.send(self.conn, entry[2])
 
     def _complete(self, h, body: bytes) -> None:
         wire = self._wire
@@ -277,15 +314,38 @@ class OpenLoopSession:
         entry = self.inflight.get(req)
         if cmd == int(wire.Command.client_busy):
             if entry is not None:
-                del self.inflight[req]
-                t0, op = entry
-                lat = (time.perf_counter_ns() - t0) / 1e9
                 self.busy_replies += 1
+                streak = self._busy_streak.get(req, 0) + 1
+                if (
+                    self._backoff_base_ns > 0
+                    and streak <= self.BUSY_RETRIES_MAX
+                ):
+                    # Hold the request in flight and retransmit after
+                    # capped exponential backoff (qos.backoff_delay:
+                    # deterministic seeded jitter, shared with
+                    # SimClient).
+                    from tigerbeetle_tpu import qos
+
+                    self._busy_streak[req] = streak
+                    self._retry_at[req] = (
+                        time.perf_counter_ns() + qos.backoff_delay(
+                            self.id, req, streak, self._backoff_base_ns,
+                        )
+                    )
+                    self.busy_backoffs += 1
+                    return
+                del self.inflight[req]
+                self._busy_streak.pop(req, None)
+                self._retry_at.pop(req, None)
+                t0, op, _frame = entry
+                lat = (time.perf_counter_ns() - t0) / 1e9
                 self.completed.append((req, "busy", lat, b"", op))
         elif cmd == int(wire.Command.reply):
             if entry is not None:
                 del self.inflight[req]
-                t0, op = entry
+                self._busy_streak.pop(req, None)
+                self._retry_at.pop(req, None)
+                t0, op, _frame = entry
                 lat = (time.perf_counter_ns() - t0) / 1e9
                 self.completed.append((req, "reply", lat, body, op))
         elif cmd == int(wire.Command.eviction):

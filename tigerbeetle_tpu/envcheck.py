@@ -59,6 +59,21 @@ def env_float(
     return value
 
 
+def env_str(name: str, default: str | None = None) -> str | None:
+    """String-valued knob (paths, engine names).  Empty counts as
+    unset — consistent with env_int/env_float."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw
+
+
+def env_is_set(name: str) -> bool:
+    """True when the variable is present and non-empty (feature
+    toggles whose VALUE is read elsewhere or irrelevant)."""
+    return bool(os.environ.get(name))
+
+
 def group_commit_max_us() -> int:
     """TB_GROUP_COMMIT_MAX_US: longest a replicated ack may wait for
     its covering WAL fdatasync, in microseconds.  0 disables group
@@ -223,6 +238,71 @@ def scrub_fallback_every() -> int:
     fetch runs only on a digest mismatch."""
     return env_int("TB_DEV_SCRUB_FALLBACK", 0, minimum=0,
                    maximum=1 << 20)
+
+
+# ----------------------------------------------------------------------
+# Multi-tenant QoS (qos.py; round 16).  The tenant key is the LEDGER.
+
+
+def tenant_qos() -> int:
+    """TB_TENANT_QOS: 1 (default) keys admission, scheduling, and
+    shedding by tenant (ledger) — per-tenant token buckets, bounded
+    per-tenant queues, weighted-fair drain, typed busy payloads.
+    0 pins today's single-queue path exactly (bit-identical
+    differential runs)."""
+    return env_int("TB_TENANT_QOS", 1, minimum=0, maximum=1)
+
+
+def tenant_rate() -> float:
+    """TB_TENANT_RATE: per-tenant admission rate, requests/second
+    (token bucket, burst = one second's worth).  0 (default) disables
+    rate limiting — QoS-on under non-overload stays bit-identical to
+    QoS-off; the queue bounds still apply."""
+    return env_float("TB_TENANT_RATE", 0.0, minimum=0.0)
+
+
+def tenant_queue(admit_queue: int) -> int:
+    """TB_TENANT_QUEUE: bound on one tenant's queued requests.  0
+    (default) = the global TB_ADMIT_QUEUE bound (no extra per-tenant
+    bound).  Must not exceed the global bound — a per-tenant bound
+    above it could never bind and would silently misrepresent the
+    isolation the operator configured."""
+    value = env_int("TB_TENANT_QUEUE", 0, minimum=0)
+    if value > admit_queue:
+        _fail(
+            "TB_TENANT_QUEUE", str(value),
+            f"must be <= TB_ADMIT_QUEUE ({admit_queue}) — a per-tenant "
+            "bound above the global queue bound can never bind",
+        )
+    return value if value else admit_queue
+
+
+def tenant_weights() -> dict:
+    """TB_TENANT_WEIGHTS: weighted-fair drain shares, e.g. "1:4,7:2"
+    (ledger:weight; unlisted tenants weigh 1)."""
+    from tigerbeetle_tpu import qos
+
+    raw = env_str("TB_TENANT_WEIGHTS", "")
+    try:
+        return qos.parse_weights(raw)
+    except ValueError as exc:
+        _fail("TB_TENANT_WEIGHTS", raw, str(exc))
+
+
+def qos_suite_secs() -> float:
+    """BENCH_QOS_SECS: seconds per adversarial-QoS bench arm phase
+    (bench.py --qos-suite: noisy-neighbor / cross-shard-heavy /
+    pathological-contention)."""
+    return env_float("BENCH_QOS_SECS", 3.0, minimum=0.1)
+
+
+def busy_backoff_ms() -> float:
+    """TB_BUSY_BACKOFF_MS: client-side base backoff after a typed
+    client_busy — capped exponential (x2 per consecutive busy, 16x
+    cap) plus deterministic seeded jitter, so shed storms don't
+    self-amplify into retransmit storms.  0 disables (the legacy
+    immediate-retransmit-cadence behavior)."""
+    return env_float("TB_BUSY_BACKOFF_MS", 20.0, minimum=0.0)
 
 
 # ----------------------------------------------------------------------

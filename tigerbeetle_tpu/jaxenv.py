@@ -41,5 +41,7 @@ def pin_cpu_backend() -> None:
 def force_cpu_jax_if_requested() -> None:
     """If TB_FORCE_CPU_JAX=1, pin this process's JAX to the CPU
     backend before any device backend can initialize."""
-    if os.environ.get("TB_FORCE_CPU_JAX") == "1":
+    from tigerbeetle_tpu.envcheck import env_str
+
+    if env_str("TB_FORCE_CPU_JAX") == "1":
         pin_cpu_backend()

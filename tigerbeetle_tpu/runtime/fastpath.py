@@ -24,7 +24,9 @@ _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_LIB_PATH = os.environ.get(
+from tigerbeetle_tpu import envcheck
+
+_LIB_PATH = envcheck.env_str(
     "TB_FASTPATH_LIB", os.path.join(_NATIVE_DIR, "libtb_fastpath.so")
 )
 
@@ -88,7 +90,7 @@ def _load():
             # would fork a `make` per server drain instead of
             # degrading to the pure-Python fallback.
             return None
-        if os.environ.get("TB_FASTPATH_DISABLE"):
+        if envcheck.env_is_set("TB_FASTPATH_DISABLE"):
             return None
         _lib_failed = True  # cleared on success below
         # Always invoke make: a no-op when fresh, and it rebuilds a

@@ -17,7 +17,9 @@ import threading
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_LIB_PATH = os.environ.get(
+from tigerbeetle_tpu.envcheck import env_str as _env_str
+
+_LIB_PATH = _env_str(
     "TB_RUNTIME_LIB", os.path.join(_NATIVE_DIR, "libtb_runtime.so")
 )
 

@@ -49,7 +49,6 @@ transport in `testing/cluster.py`.
 
 from __future__ import annotations
 
-import os
 import time
 
 import numpy as np
@@ -1225,10 +1224,28 @@ class RouterServer:
         self.core = RouterCore(self.n_shards, registry=self.registry)
         self.flight = FlightRecorder(
             process_id=0,
-            dump_path=os.environ.get("TB_FLIGHT_PATH", "tb_flight_router.json"),
+            dump_path=envcheck.env_str(
+                "TB_FLIGHT_PATH", "tb_flight_router.json"
+            ),
         )
         self.core.flight = self.flight
         self.admit_queue = envcheck.router_queue()
+        # Multi-tenant QoS (round 16): the router keys its own
+        # admission (open-request slots) and its retry sweep's drain
+        # order by tenant (ledger), mirroring the replica-side
+        # contract — one hot tenant cannot pin every open slot or
+        # starve other tenants' retries.  TB_TENANT_QOS=0 pins the
+        # legacy single-bound path.
+        self.qos = None
+        if envcheck.tenant_qos():
+            from tigerbeetle_tpu.qos import TenantQos
+
+            self.qos = TenantQos(
+                rate=envcheck.tenant_rate(),
+                queue_bound=envcheck.tenant_queue(self.admit_queue),
+                weights=envcheck.tenant_weights(),
+                registry=self.registry.scope("router.qos"),
+            )
         self.retry_ns = envcheck.coord_retry_ms() * 1_000_000
         self._c_shed = self.registry.counter("router.shed")
         self._c_retries = self.registry.counter("router.retries")
@@ -1269,6 +1286,13 @@ class RouterServer:
         self._register_sent: dict[tuple[int, int], int] = {}
         self._client_register: dict[int, np.ndarray] = {}
         self._open: dict[tuple[int, int], dict] = {}
+        # tenant -> open-request count, maintained incrementally at
+        # every _open insert/remove: admission (and the busy payload)
+        # reads a tenant's slot usage per incoming request, and a
+        # full-table scan there would put O(TB_ROUTER_QUEUE) work on
+        # the router's per-request hot path (same reasoning as
+        # VsrReplica._tenant_depth).
+        self._tenant_open: dict[int, int] = {}
         self._tasks: list[tuple[_Task, dict | None]] = []
         self._recovery: _Task | None = None
         if recover:
@@ -1395,12 +1419,36 @@ class RouterServer:
 
     def _retry_sweep(self) -> None:
         now = time.monotonic_ns()
+        due = []
         for sub in list(self._pending.values()):
             if sub.kind == "register":
                 continue
             state = self._sent_at.get(id(sub))
             if state is not None and now - state[1] >= self.retry_ns:
+                due.append(sub)
+        if self.qos is None or len(due) <= 1:
+            for sub in due:
                 self._send_subop(sub)
+        else:
+            # Weighted-fair retry order: coordinator legs (2PC
+            # decisions — cluster safety, never a tenant's fault)
+            # re-drive first; forwarded client ops then drain across
+            # tenant groups by WFQ pick, so a flooding tenant's retry
+            # backlog cannot starve other tenants' re-drives.
+            by_tenant: dict[int, list] = {}
+            for sub in due:
+                if sub.kind != "fwd":
+                    self._send_subop(sub)
+                    continue
+                ctx = self._open.get((sub.client, sub.request))
+                tenant = ctx.get("tenant", 0) if ctx else 0
+                by_tenant.setdefault(tenant, []).append(sub)
+            while by_tenant:
+                t = self.qos.pick(by_tenant.keys())
+                group = by_tenant[t]
+                self._send_subop(group.pop(0))
+                if not group:
+                    del by_tenant[t]
         # Re-send pending registers on the same cadence (NOT every
         # poll — a shard mid-view-change must not be flooded).
         for key, h in list(self._register_pending.items()):
@@ -1451,9 +1499,11 @@ class RouterServer:
 
     def _reply_client(self, ctx: dict, body: bytes) -> None:
         wire = self._wire
-        self._open.pop(
+        self._tenant_open_dec(self._open.pop(
             ctx.get("open_key", (ctx["client"], ctx["request"])), None
-        )
+        ))
+        if self.qos is not None and ctx.get("tenant") is not None:
+            self.qos.on_reply(ctx["tenant"], ctx["header"])
         # Sessionless queries (state_root) reply to the requesting
         # CONNECTION — concurrent scrapers share client id 0, so the
         # per-client conn map would route every reply to whichever
@@ -1562,6 +1612,7 @@ class RouterServer:
         caller already delivered a terminal eviction)."""
         for key in [k for k in self._open if k[0] == client]:
             ctx = self._open.pop(key)
+            self._tenant_open_dec(ctx)
             dead = [t for t, c in self._tasks if c is ctx]
             self._tasks = [(t, c) for t, c in self._tasks
                            if c is not ctx]
@@ -1575,6 +1626,7 @@ class RouterServer:
         ctx = self._open.pop((client, request), None)
         if ctx is None:
             return
+        self._tenant_open_dec(ctx)
         # Drop the task AND every outstanding subop it owns (fwd and
         # coord alike) — an orphaned coord subop would otherwise stay
         # in the retry sweep forever.  Its holds, if any, expire: a
@@ -1586,24 +1638,59 @@ class RouterServer:
                 state = self._sent_at.pop(id(sub), None)
                 if state is not None:
                     self._pending.pop(state[0], None)
-        self._send_busy(ctx["header"])
+        self._send_busy(ctx["header"], ctx.get("tenant"), admission=False)
 
-    def _send_busy(self, req_header) -> None:
+    def _send_busy(self, req_header, tenant=None, *,
+                   admission: bool = True) -> None:
+        """`admission=False` marks a busy for an ALREADY-ADMITTED
+        request (a shard shed its sub-op): the typed payload and the
+        flight note still go out, but it must not count as a tenant
+        admission shed — the t<ledger>.shed counter is the router's
+        own admission discriminator, and mixing downstream shard
+        overload into it would let shed+admit both increment for one
+        request."""
         wire = self._wire
         client = wire.u128(req_header, "client")
         conn = self._client_conns.get(client)
+        payload = b""
+        if self.qos is not None and tenant is not None:
+            payload = wire.busy_body(
+                tenant, self._open_of_tenant(tenant),
+                self.qos.rate_of(tenant),
+            )
+            if admission:
+                self.qos.on_shed(tenant)
         busy = wire.make_header(
             command=wire.Command.client_busy, cluster=self.cluster,
             client=client, request=int(req_header["request"]),
         )
         wire.copy_trace(busy, req_header)
-        wire.finalize_header(busy, b"")
+        wire.finalize_header(busy, payload)
         if conn is not None:
-            self.bus.send(conn, busy.tobytes())
+            self.bus.send(conn, busy.tobytes() + payload)
         self._c_shed.inc()
         self.flight.note("router_shed", client=client,
                          request=int(req_header["request"]),
-                         open=len(self._open))
+                         open=len(self._open),
+                         tenant=-1 if tenant is None else tenant)
+        if tenant is not None:
+            self.flight.note(f"shed.t{tenant}")
+
+    def _open_of_tenant(self, tenant: int) -> int:
+        return self._tenant_open.get(tenant, 0)
+
+    def _tenant_open_dec(self, ctx: dict | None) -> None:
+        """Bookkeeping for an _open removal (reply/fail/drop): ctxs
+        without a tenant (QoS off, sessionless state_root queries)
+        are not counted on insert and skip here too."""
+        tenant = None if ctx is None else ctx.get("tenant")
+        if tenant is None:
+            return
+        count = self._tenant_open.get(tenant, 0) - 1
+        if count > 0:
+            self._tenant_open[tenant] = count
+        else:
+            self._tenant_open.pop(tenant, None)
 
     def _on_client_request(self, conn: int, header, body: bytes) -> None:
         wire = self._wire
@@ -1655,16 +1742,39 @@ class RouterServer:
             return  # VSR-internal ops are not routable
         if (client, request) in self._open:
             return  # retransmission of an in-flight request
+        tenant = None
+        if self.qos is not None:
+            # Tenant-keyed admission (retransmissions of in-flight
+            # requests returned above — shedding here never answers a
+            # request the router is already driving): a rate-capped
+            # or slot-hogging tenant is shed with its own typed
+            # payload while other tenants' requests still fit.  The
+            # GLOBAL slot bound checks first so a request the full
+            # table sheds anyway never consumes one of its tenant's
+            # tokens (the tenant still rides the busy payload).
+            tenant = wire.tenant_of(header, body)
+            now = time.monotonic_ns()
+            self.qos.observe(tenant, now)
         if len(self._open) >= self.admit_queue:
-            self._send_busy(header)
+            self._send_busy(header, tenant)
             return
+        if self.qos is not None:
+            if not self.qos.admit(tenant, now, self._open_of_tenant(tenant)):
+                self._send_busy(header, tenant)
+                return
+            self.qos.on_admit(tenant)
         trace = (int(header["trace_id"]), int(header["trace_ts"]),
                  int(header["trace_flags"]))
         ctx = {
             "client": client, "request": request,
             "operation": operation, "header": header.copy(),
+            "tenant": tenant,
         }
         self._open[(client, request)] = ctx
+        if tenant is not None:
+            self._tenant_open[tenant] = (
+                self._tenant_open.get(tenant, 0) + 1
+            )
         task = self.core.open_request(client, request, operation, body,
                                       trace)
         self._issue_subops(task.subops)

@@ -182,12 +182,12 @@ class ReplicaServer:
         from tigerbeetle_tpu import envcheck
 
         if not trace_path and envcheck.trace_backend() == "json":
-            trace_path = os.environ.get(
+            trace_path = envcheck.env_str(
                 "TB_TRACE_PATH", f"tb_trace_r{replica_index}.json"
             )
             if "{replica}" in trace_path:
                 trace_path = trace_path.format(replica=replica_index)
-            elif replica_index and os.environ.get("TB_TRACE_PATH"):
+            elif replica_index and envcheck.env_is_set("TB_TRACE_PATH"):
                 # One exported TB_TRACE_PATH shared by a whole cluster
                 # must not let replicas clobber each other's trace at
                 # close: non-zero indices get a suffix.
@@ -199,12 +199,12 @@ class ReplicaServer:
         # SIGTERM for postmortems — no file I/O until then.
         from tigerbeetle_tpu.obs.flight import FlightRecorder
 
-        flight_path = os.environ.get(
+        flight_path = envcheck.env_str(
             "TB_FLIGHT_PATH", f"tb_flight_r{replica_index}.json"
         )
         if "{replica}" in flight_path:
             flight_path = flight_path.format(replica=replica_index)
-        elif replica_index and os.environ.get("TB_FLIGHT_PATH"):
+        elif replica_index and envcheck.env_is_set("TB_FLIGHT_PATH"):
             root, ext = os.path.splitext(flight_path)
             flight_path = f"{root}.r{replica_index}{ext}"
         self._flight_path = flight_path
@@ -315,6 +315,26 @@ class ReplicaServer:
         self._c_shed = self.registry.counter("server.shed")
         self.replica.admit_queue = self.admit_queue
         self.replica.on_shed = self._on_shed
+        # Multi-tenant QoS (round 16): admission, drain order, and
+        # shedding keyed by tenant (ledger).  TB_TENANT_QOS=0 pins the
+        # legacy single-queue path exactly (replica.qos stays None);
+        # on, the per-tenant admit/shed/lat_us instruments land under
+        # vsr.qos.t<ledger>.* in the registry tree, so the stats wire
+        # op scrapes them like everything else.
+        if envcheck.tenant_qos():
+            from tigerbeetle_tpu.qos import TenantQos
+
+            self.replica.qos = TenantQos(
+                rate=envcheck.tenant_rate(),
+                queue_bound=envcheck.tenant_queue(self.admit_queue),
+                weights=envcheck.tenant_weights(),
+                registry=self.replica.metrics.scope("qos"),
+            )
+            qos = self.replica.qos
+            self.registry.gauge_fn("server.tenant_rate", lambda: qos.rate)
+            self.registry.gauge_fn(
+                "server.tenant_queue", lambda: qos.queue_bound
+            )
         self.replica.open()
         self._last_tick = 0
         self._last_stats = 0
@@ -619,15 +639,22 @@ class ReplicaServer:
                 self.bus.register_peer(conn, int(header["replica"]))
         self.replica.on_message(header, body, verified=verified)
 
-    def _on_shed(self, header) -> None:
+    def _on_shed(self, header, tenant=None) -> None:
         """Replica shed callback: count + flight-note (the replica
-        already sent the typed busy on the client's connection)."""
+        already sent the typed busy on the client's connection).  The
+        tenant rides the note so a postmortem flight dump shows WHO
+        was shed during an overload window — and a per-tenant shed
+        instant (`shed.t<ledger>`) makes the per-tenant timeline
+        greppable without parsing note args."""
         self._c_shed.inc()
         self.flight.note(
             "shed", client=wire.u128(header, "client"),
             request=int(header["request"]),
             queue=len(self.replica.request_queue),
+            tenant=-1 if tenant is None else tenant,
         )
+        if tenant is not None:
+            self.flight.note(f"shed.t{tenant}")
 
     def install_flight_handlers(self) -> None:
         """Dump the flight ring on SIGTERM, then die with the default

@@ -96,7 +96,7 @@ _PROBE_EVERY = envcheck.env_int("TB_DEV_PROBE_EVERY", 8, minimum=1)
 # legacy full-digest compare per scrub, so it keeps the legacy 256
 # unless the operator set the cadence explicitly (per-engine choice
 # in __init__).
-_SCRUB_EVERY_SET = bool(_os.environ.get("TB_DEV_SCRUB_EVERY"))
+_SCRUB_EVERY_SET = envcheck.env_is_set("TB_DEV_SCRUB_EVERY")
 _SCRUB_EVERY = envcheck.env_int("TB_DEV_SCRUB_EVERY", _PROBE_EVERY, minimum=0)
 _SCRUB_EVERY_LEGACY = 256
 # Maximum deterministic per-engine offset applied to the scrub cadence

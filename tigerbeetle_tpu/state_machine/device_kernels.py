@@ -1107,7 +1107,9 @@ PK_SPEC = {
 # more batches; lax.scan compile time is length-independent, so the
 # only cost of a big tier is its staged input buffer.
 def _scan_sizes() -> tuple[int, ...]:
-    raw = os.environ.get("TB_DEV_SCAN_SIZES", "16,4")
+    from tigerbeetle_tpu import envcheck
+
+    raw = envcheck.env_str("TB_DEV_SCAN_SIZES", "16,4")
     try:
         sizes = {int(x) for x in raw.split(",") if x.strip()}
     except ValueError:

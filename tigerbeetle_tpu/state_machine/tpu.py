@@ -350,7 +350,9 @@ class TpuStateMachine:
         import os as _os
 
         self.config = config
-        self.engine = engine or _os.environ.get("TB_ENGINE", "host")
+        from tigerbeetle_tpu import envcheck as _envcheck
+
+        self.engine = engine or _envcheck.env_str("TB_ENGINE", "host")
         assert self.engine in ("host", "device"), self.engine
         self._device_link = device_link
         self.prepare_timestamp = 0
@@ -448,7 +450,11 @@ class TpuStateMachine:
             # Off-hot-path warmup of the named kinds' transfer plans +
             # scan compiles (bench passes these per config;
             # construction happens during untimed setup).
-            warm_kinds = prewarm or _os.environ.get("TB_DEV_PREWARM", "")
+            from tigerbeetle_tpu import envcheck as _envcheck
+
+            warm_kinds = prewarm or _envcheck.env_str(
+                "TB_DEV_PREWARM", ""
+            )
             if warm_kinds:
                 self._dev.prewarm(
                     warm_kinds.split(",")
@@ -3731,9 +3737,9 @@ def _tpu_snapshot(self) -> bytes:
     # silent divergence would otherwise surface only on a fallback.
     # Host mode pays a ~100ms fetch on this link, so it verifies only
     # when asked (TB_CKPT_VERIFY=1; tests and VOPR set it).
-    import os as _os
+    from tigerbeetle_tpu import envcheck as _envcheck
 
-    if self.engine == "device" or _os.environ.get("TB_CKPT_VERIFY") == "1":
+    if self.engine == "device" or _envcheck.env_str("TB_CKPT_VERIFY") == "1":
         self.verify_device_mirror()
     count = self._attrs.count
     # prepare_timestamp is primary-only in-memory state, re-derived from

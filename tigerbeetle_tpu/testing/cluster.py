@@ -18,6 +18,7 @@ import heapq
 import numpy as np
 
 from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import qos as qos_mod
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.state_machine import CpuStateMachine
 from tigerbeetle_tpu.testing.hash_log import HashLog
@@ -116,11 +117,20 @@ class _Bus:
 
 class SimClient:
     """Driver-side client session: register, pipelined-one request,
-    retransmit on timeout (reference: src/vsr/client.zig:18-120)."""
+    retransmit on timeout (reference: src/vsr/client.zig:18-120).
+
+    A typed client_busy backs the retransmit cadence off with capped
+    exponential delay + deterministic jitter (TB_BUSY_BACKOFF_MS;
+    round 16): a shed storm answered by immediate retransmits
+    re-offers the same overload and self-amplifies.  One sim tick is
+    10 ms (constants.TICK_NS), so the ms knob converts directly; 0
+    disables (the legacy immediate-cadence behavior)."""
 
     RETRY_TICKS = 8
 
     def __init__(self, cluster: "Cluster", client_id: int) -> None:
+        from tigerbeetle_tpu import envcheck
+
         self.cluster = cluster
         self.id = client_id
         self.request_number = 0
@@ -129,6 +139,12 @@ class SimClient:
         self.registered = False
         self.evicted = False
         self.busy_replies = 0  # typed admission sheds received
+        self.busy_backoffs = 0  # retransmits delayed by busy backoff
+        self._backoff_base_ticks = int(
+            round(envcheck.busy_backoff_ms() * 1e6 / cfg.TICK_NS)
+        )
+        self._busy_streak = 0
+        self._backoff_until = -(10**9)
         self._inflight: tuple[np.ndarray, bytes] | None = None
         self._last_sent = -(10**9)
         self.replies: list[bytes] = []
@@ -141,8 +157,29 @@ class SimClient:
         cmd = Command(int(header["command"]))
         if cmd == Command.client_busy:
             # Typed admission shed: NOT fatal — the request was never
-            # admitted; the retransmission cadence retries it.
+            # admitted; the retransmission cadence retries it, backed
+            # off exponentially per CONSECUTIVE busy (reset on reply)
+            # with deterministic jitter so a fleet of shed clients
+            # doesn't re-converge on one retry instant.
             self.busy_replies += 1
+            if (
+                self._backoff_base_ticks > 0
+                and self._inflight is not None
+                # A stale busy for an ALREADY-COMPLETED request (one
+                # retransmit copy shed, another committed and replied)
+                # must not inflate the streak or delay the CURRENT
+                # request's cadence.
+                and int(header["request"])
+                == int(self._inflight[0]["request"])
+            ):
+                self._busy_streak += 1
+                self._backoff_until = (
+                    self.cluster.network.now + qos_mod.backoff_delay(
+                        self.id, self.request_number, self._busy_streak,
+                        self._backoff_base_ticks,
+                    )
+                )
+                self.busy_backoffs += 1
             return
         if cmd == Command.eviction:
             # Fatal for the session (reference clients surface this as
@@ -162,12 +199,16 @@ class SimClient:
         if int(self._inflight[0]["operation"]) == int(VsrOperation.register):
             self.registered = True
         self._inflight = None
+        self._busy_streak = 0
+        self._backoff_until = -(10**9)
         self.reply = body
         self.replies.append(body)
 
     def tick(self) -> None:
         if self._inflight is None:
             return
+        if self.cluster.network.now < self._backoff_until:
+            return  # busy backoff window: hold the retransmit cadence
         if self.cluster.network.now - self._last_sent >= self.RETRY_TICKS:
             self._send(broadcast=True)
 
@@ -186,7 +227,8 @@ class SimClient:
         self._inflight = (h, b"")
         self._send()
 
-    def request(self, operation: types.Operation, body: bytes) -> None:
+    def request(self, operation: types.Operation, body: bytes, *,
+                tenant: int = 0) -> None:
         assert self.registered and not self.busy()
         self.request_number += 1
         import time as _time
@@ -195,6 +237,9 @@ class SimClient:
             command=Command.request, operation=operation,
             cluster=self.cluster.cluster_id, client=self.id,
             request=self.request_number,
+            # Explicit tenant stamp (round 16): 0 = derive from the
+            # body's leading event (the legacy-client path).
+            tenant=tenant,
             # Wire trace context from client submit: the id is a
             # deterministic function of (client, request) so seeded
             # runs stay reproducible; the origin timestamp is real
@@ -229,7 +274,8 @@ class Cluster:
                  standby_count: int = 0,
                  config: cfg.Config = cfg.TEST_MIN,
                  options: PacketOptions | None = None,
-                 state_machine_factory=None) -> None:
+                 state_machine_factory=None,
+                 tenant_qos: dict | None = None) -> None:
         self.cluster_id = 0xC1
         self.replica_count = replica_count
         self.standby_count = standby_count
@@ -237,6 +283,11 @@ class Cluster:
         self.network = PacketSimulator(options or PacketOptions(), seed)
         factory = state_machine_factory or (lambda: CpuStateMachine(config))
         self._factory = factory
+        # Multi-tenant QoS (round 16): TenantQos kwargs applied to
+        # every replica — including restarts, which build a fresh
+        # VsrReplica (a restarted replica silently losing its
+        # admission policy would fake isolation coverage in VOPR).
+        self.tenant_qos = tenant_qos
 
         self.replicas: list[VsrReplica] = []
         self.storages: list[MemoryStorage] = []
@@ -250,6 +301,7 @@ class Cluster:
                 replica=i, replica_count=replica_count,
                 standby_count=standby_count,
             )
+            self._apply_tenant_qos(r)
             r.hash_log = HashLog()
             r.open()
             self.storages.append(storage)
@@ -263,6 +315,15 @@ class Cluster:
         # (vsr/clock.py) must keep primary timestamps near true time
         # despite this.
         self.clock_skew = [0] * (replica_count + standby_count)
+
+    def _apply_tenant_qos(self, r) -> None:
+        if self.tenant_qos is None:
+            return
+        from tigerbeetle_tpu.qos import TenantQos
+
+        kw = dict(self.tenant_qos)
+        r.admit_queue = kw.pop("admit_queue", r.admit_queue)
+        r.qos = TenantQos(**kw)
 
     def process_of_slot(self, slot: int) -> int:
         """Current process filling a protocol slot (reconfiguration
@@ -342,6 +403,7 @@ class Cluster:
             release=release if release is not None else old.release,
             releases_available=avail,
         )
+        self._apply_tenant_qos(r)
         r.hash_log = self.hash_logs[index]
         r.open()
         # Pre-crash commits beyond the durable checkpoint floor may
@@ -805,7 +867,8 @@ class ShardedCluster:
                  seed: int = 0, config: cfg.Config | None = None,
                  options: PacketOptions | None = None,
                  state_machine_factories=None,
-                 coord_timeout_s: int = 8) -> None:
+                 coord_timeout_s: int = 8,
+                 tenant_qos: dict | None = None) -> None:
         import dataclasses as _dc
 
         self.n_shards = n_shards
@@ -822,6 +885,7 @@ class ShardedCluster:
                     state_machine_factories[s]
                     if state_machine_factories else None
                 ),
+                tenant_qos=tenant_qos,
             )
             for s in range(n_shards)
         ]

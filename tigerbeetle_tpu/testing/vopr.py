@@ -221,6 +221,29 @@ class Workload:
         )
 
 
+def check_conservation(cluster) -> None:
+    """Double-entry invariant on every replica: total debits == total
+    credits, in both posted and pending columns."""
+    for r in cluster.replicas:
+        sm = r.sm
+        if isinstance(sm, CpuStateMachine):
+            dp = sum(a.debits_pending for a in sm.accounts.values())
+            cp = sum(a.credits_pending for a in sm.accounts.values())
+            dpo = sum(a.debits_posted for a in sm.accounts.values())
+            cpo = sum(a.credits_posted for a in sm.accounts.values())
+        else:  # TpuStateMachine: sum the balance-mirror columns
+            n = sm._attrs.count
+            lo = sm._mirror.lo[:n].astype(object)
+            hi = sm._mirror.hi[:n].astype(object)
+            totals = [
+                int((lo[:, c] + (hi[:, c] * (1 << 64))).sum())
+                for c in range(4)
+            ]
+            dp, dpo, cp, cpo = totals
+        assert dp == cp, (dp, cp)
+        assert dpo == cpo, (dpo, cpo)
+
+
 class FaultAtlas:
     """Seeded targeting for sector corruption that guarantees >= 1
     intact copy of everything cluster-wide (reference:
@@ -550,26 +573,7 @@ class Vopr:
     # -- checkers --
 
     def check_conservation(self) -> None:
-        """Double-entry invariant: total debits == total credits, in
-        both posted and pending columns."""
-        for r in self.cluster.replicas:
-            sm = r.sm
-            if isinstance(sm, CpuStateMachine):
-                dp = sum(a.debits_pending for a in sm.accounts.values())
-                cp = sum(a.credits_pending for a in sm.accounts.values())
-                dpo = sum(a.debits_posted for a in sm.accounts.values())
-                cpo = sum(a.credits_posted for a in sm.accounts.values())
-            else:  # TpuStateMachine: sum the balance-mirror columns
-                n = sm._attrs.count
-                lo = sm._mirror.lo[:n].astype(object)
-                hi = sm._mirror.hi[:n].astype(object)
-                totals = [
-                    int((lo[:, c] + (hi[:, c] * (1 << 64))).sum())
-                    for c in range(4)
-                ]
-                dp, dpo, cp, cpo = totals
-            assert dp == cp, (dp, cp)
-            assert dpo == cpo, (dpo, cpo)
+        check_conservation(self.cluster)
 
     def check_restart_equivalence(self) -> None:
         """Recovery is re-execution: opening a fresh replica over live
@@ -611,6 +615,192 @@ class Vopr:
 
 
 # ----------------------------------------------------------------------
+# Multi-tenant VOPR (round 16): one tenant floods while others
+# trickle, with per-tenant QoS live on every replica.
+
+
+class TenantStream:
+    """One tenant's seeded request stream: its own ledger, its own
+    account pool, every request constructed-valid (unique ids, no
+    balance limits) so any failure row in a reply is a finding."""
+
+    def __init__(self, seed: int, ledger: int, namespace: int) -> None:
+        self.rng = np.random.default_rng(seed)
+        self.ledger = ledger
+        self.account_ids: list[int] = []
+        # Per-STREAM id namespaces: several clients may drive the same
+        # tenant (the flood), and ids are globally unique.
+        self.next_account = namespace * 1_000_000 + 1
+        self.next_transfer = namespace * 1_000_000 + 500_000
+
+    def next_request(self) -> tuple[types.Operation, bytes]:
+        if len(self.account_ids) < 4 or self.rng.random() < 0.06:
+            rows = []
+            for _ in range(int(self.rng.integers(2, 5))):
+                rows.append(account(self.next_account, ledger=self.ledger))
+                self.account_ids.append(self.next_account)
+                self.next_account += 1
+            return types.Operation.create_accounts, pack(rows)
+        rows = []
+        for _ in range(int(self.rng.integers(1, 4))):
+            dr, cr = self.rng.choice(self.account_ids, size=2,
+                                     replace=False)
+            rows.append(transfer(
+                self.next_transfer, debit_account_id=int(dr),
+                credit_account_id=int(cr),
+                amount=int(self.rng.integers(1, 100)),
+                ledger=self.ledger,
+            ))
+            self.next_transfer += 1
+        return types.Operation.create_transfers, pack(rows)
+
+
+class MultiTenantVopr:
+    """Seeded multi-tenant overload fuzz: a flooding tenant (ledger 1,
+    several back-to-back clients) vs trickling tenants (one client
+    each, paced), against replicas running per-tenant QoS with a
+    deliberately tight admit queue so the flood tenant is SHED —
+    hash-log convergence, linearizability, and conservation-of-money
+    must hold across the shed/retry/backoff storms, crash/restart and
+    packet-loss nemeses included.  Typed busy is load shedding, not
+    data loss: every constructed-valid request must eventually commit
+    with zero failure rows."""
+
+    def __init__(self, seed: int, *, tenants: int = 3,
+                 flood_clients: int = 3, requests: int = 45,
+                 replica_count: int = 3, packet_loss: float = 0.01,
+                 crash_probability: float = 0.004,
+                 trickle_every: int = 12,
+                 tenant_queue: int = 2, admit_queue: int = 4,
+                 weights: dict | None = None) -> None:
+        import dataclasses as _dc
+
+        self.seed = seed
+        self.rng = np.random.default_rng(seed + 1)
+        self.requests = requests
+        self.crash_probability = crash_probability
+        self.trickle_every = trickle_every
+        self.crashed: set[int] = set()
+        self.cluster = Cluster(
+            replica_count=replica_count, seed=seed,
+            config=_dc.replace(
+                cfg.TEST_MIN, clients_max=flood_clients + tenants + 2
+            ),
+            options=PacketOptions(packet_loss_probability=packet_loss),
+            tenant_qos=dict(
+                rate=0.0, queue_bound=tenant_queue,
+                weights=weights, admit_queue=admit_queue,
+            ),
+        )
+        c = self.cluster
+        # Flood tenant: ledger 1, several closed-loop clients driving
+        # back-to-back (well past its fair share); trickle tenants:
+        # ledgers 2..tenants, one paced client each.
+        self.streams: list[tuple] = []  # (client, stream, paced)
+        cid = 9000
+        ns = 1
+        for k in range(flood_clients):
+            self.streams.append(
+                (c.client(cid), TenantStream(seed + 10 + k, 1, ns), False)
+            )
+            cid += 1
+            ns += 1
+        for ledger in range(2, tenants + 1):
+            self.streams.append(
+                (c.client(cid),
+                 TenantStream(seed + 50 + ledger, ledger, ns), True)
+            )
+            cid += 1
+            ns += 1
+        self.sheds = 0
+        self.busy_replies = 0
+        self.busy_backoffs = 0
+
+    def _nemesis(self) -> None:
+        c = self.cluster
+        if self.crashed:
+            if self.rng.random() < 0.05:
+                c.restart_replica(self.crashed.pop())
+            return
+        if self.rng.random() < self.crash_probability:
+            i = int(self.rng.integers(len(c.replicas)))
+            c.crash_replica(i)
+            self.crashed.add(i)
+
+    def run(self) -> None:
+        c = self.cluster
+        for client, _stream, _paced in self.streams:
+            client.register()
+        c.run_until(
+            lambda: all(cl.registered for cl, _s, _p in self.streams),
+            max_steps=8000,
+        )
+        sent = {id(cl): 0 for cl, _s, _p in self.streams}
+        pending: dict[int, types.Operation] = {}
+        guard = 0
+        while any(
+            sent[id(cl)] < self.requests or cl.busy()
+            for cl, _s, _p in self.streams
+        ):
+            guard += 1
+            assert guard < 400_000, "multi-tenant vopr stalled"
+            self._nemesis()
+            for client, stream, paced in self.streams:
+                if client.busy():
+                    continue
+                assert not client.evicted, "tenant client wrongly evicted"
+                op = pending.pop(id(client), None)
+                if op in (types.Operation.create_accounts,
+                          types.Operation.create_transfers):
+                    results = np.frombuffer(
+                        client.reply, types.CREATE_RESULT_DTYPE
+                    )
+                    assert len(results) == 0, (
+                        "constructed-valid request failed under QoS",
+                        op, results[:4],
+                    )
+                if sent[id(client)] >= self.requests:
+                    continue
+                if paced and guard % self.trickle_every:
+                    continue  # trickle cadence
+                op, body = stream.next_request()
+                client.request(op, body)
+                pending[id(client)] = op
+                sent[id(client)] += 1
+            c.step()
+
+        # Drain the last replies' audits.
+        for client, _stream, _paced in self.streams:
+            op = pending.pop(id(client), None)
+            if op is not None:
+                results = np.frombuffer(
+                    client.reply, types.CREATE_RESULT_DTYPE
+                )
+                assert len(results) == 0, (op, results[:4])
+
+        # Heal, restart the dead, settle, check everything.
+        c.network.heal()
+        for i in sorted(self.crashed):
+            c.restart_replica(i)
+        self.crashed.clear()
+        c.settle(max_steps=30_000)
+        c.check_linearized()
+        c.check_convergence()
+        check_conservation(c)
+        # Shed/backoff accounting (restarts reset replica counters;
+        # this is a floor, not a total).
+        self.sheds = sum(
+            r.qos.sheds for r in c.replicas if r.qos is not None
+        )
+        self.busy_replies = sum(
+            cl.busy_replies for cl, _s, _p in self.streams
+        )
+        self.busy_backoffs = sum(
+            cl.busy_backoffs for cl, _s, _p in self.streams
+        )
+
+
+# ----------------------------------------------------------------------
 # Sharded VOPR: the multi-cluster router under the full nemesis mix.
 
 
@@ -628,14 +818,25 @@ class ShardedWorkload:
     """
 
     def __init__(self, seed: int, n_shards: int,
-                 cross_ratio: float = 0.35) -> None:
+                 cross_ratio: float = 0.35, tenants: int = 1) -> None:
         self.rng = np.random.default_rng(seed)
         self.n_shards = n_shards
         self.cross_ratio = cross_ratio
+        # Multi-tenant mode (round 16): accounts spread round-robin
+        # over `tenants` ledgers; transfer traffic is flood-biased
+        # toward ledger 1 (one tenant drives most of the load while
+        # the rest trickle).  tenants=1 consumes the RNG stream
+        # byte-identically to the frozen v1 profile — the pinned
+        # regression seeds (4242/2046/3013) must keep reproducing
+        # their original fault interleavings.
+        self.tenants = tenants
         self.by_shard: dict[int, list[int]] = {s: [] for s in range(n_shards)}
+        self.pools: dict[tuple[int, int], list[int]] = {}  # (shard, ledger)
+        self.ledger_of: dict[int, int] = {}
         self.account_ids: list[int] = []
-        # Local (same-shard) pending transfers awaiting post/void.
-        self.pending_local: list[tuple[int, int]] = []  # (tid, shard)
+        # Local (same-shard) pending transfers awaiting post/void:
+        # (tid, shard, ledger).
+        self.pending_local: list[tuple[int, int, int]] = []
         self.next_account = 1
         self.next_transfer = 1_000_000
         # Every attempted cross-shard transfer: (tid, dshard, cshard),
@@ -645,37 +846,85 @@ class ShardedWorkload:
         self.xfer_amount: dict[int, int] = {}
         self.xfer_debitor: dict[int, int] = {}
 
+    def _pick_tenant(self) -> int:
+        """Flood-biased ledger choice: tenant 1 drives ~70% of the
+        traffic, the rest trickle.  No RNG draw in single-tenant mode
+        (the frozen stream)."""
+        if self.tenants == 1:
+            return 1
+        if self.rng.random() < 0.7:
+            return 1
+        return 2 + int(self.rng.integers(self.tenants - 1))
+
     def _new_accounts(self, n: int):
         rows = []
         for _ in range(n):
             aid = self.next_account
             self.next_account += 1
-            rows.append(account(aid, ledger=1, code=1))
+            # Round-robin ledger assignment (deterministic, no RNG):
+            # every tenant's pool fills on every shard.
+            ledger = 1 + (aid % self.tenants) if self.tenants > 1 else 1
+            rows.append(account(aid, ledger=ledger, code=1))
             self.account_ids.append(aid)
-            self.by_shard[types.shard_of_account(aid, self.n_shards)].append(
-                aid
-            )
+            self.ledger_of[aid] = ledger
+            shard = types.shard_of_account(aid, self.n_shards)
+            self.by_shard[shard].append(aid)
+            self.pools.setdefault((shard, ledger), []).append(aid)
         return types.Operation.create_accounts, pack(rows), "accounts"
 
-    def _pick_local_pair(self) -> tuple[int, int, int]:
-        """(debit, credit, shard) on one shard (needs >= 2 accounts)."""
-        shards = [s for s, ids in self.by_shard.items() if len(ids) >= 2]
+    def _pick_local_pair(self, ledger: int = 0) -> tuple[int, int, int]:
+        """(debit, credit, shard) on one shard (needs >= 2 accounts
+        of `ledger`; 0 = any, the frozen single-tenant path)."""
+        if not ledger:
+            shards = [s for s, ids in self.by_shard.items() if len(ids) >= 2]
+            s = int(self.rng.choice(shards))
+            dr, cr = self.rng.choice(self.by_shard[s], size=2, replace=False)
+            return int(dr), int(cr), s
+        shards = [
+            s for s in range(self.n_shards)
+            if len(self.pools.get((s, ledger), ())) >= 2
+        ]
         s = int(self.rng.choice(shards))
-        dr, cr = self.rng.choice(self.by_shard[s], size=2, replace=False)
+        dr, cr = self.rng.choice(self.pools[(s, ledger)], size=2,
+                                 replace=False)
         return int(dr), int(cr), s
 
-    def _pick_cross_pair(self) -> tuple[int, int, int, int]:
-        shards = [s for s, ids in self.by_shard.items() if ids]
+    def _pick_cross_pair(self, ledger: int = 0) -> tuple[int, int, int, int]:
+        if not ledger:
+            shards = [s for s, ids in self.by_shard.items() if ids]
+            a, b = self.rng.choice(shards, size=2, replace=False)
+            dr = int(self.rng.choice(self.by_shard[int(a)]))
+            cr = int(self.rng.choice(self.by_shard[int(b)]))
+            return dr, cr, int(a), int(b)
+        shards = [
+            s for s in range(self.n_shards)
+            if self.pools.get((s, ledger))
+        ]
         a, b = self.rng.choice(shards, size=2, replace=False)
-        dr = int(self.rng.choice(self.by_shard[int(a)]))
-        cr = int(self.rng.choice(self.by_shard[int(b)]))
+        dr = int(self.rng.choice(self.pools[(int(a), ledger)]))
+        cr = int(self.rng.choice(self.pools[(int(b), ledger)]))
         return dr, cr, int(a), int(b)
 
     def _ready(self) -> bool:
-        return (
-            sum(1 for ids in self.by_shard.values() if len(ids) >= 2)
-            >= self.n_shards
-        )
+        if self.tenants == 1:
+            return (
+                sum(1 for ids in self.by_shard.values() if len(ids) >= 2)
+                >= self.n_shards
+            )
+        # Every tenant needs a local pair somewhere AND presence on
+        # two distinct shards (for the cross-shard leg).
+        for ledger in range(1, self.tenants + 1):
+            if not any(
+                len(self.pools.get((s, ledger), ())) >= 2
+                for s in range(self.n_shards)
+            ):
+                return False
+            if sum(
+                1 for s in range(self.n_shards)
+                if self.pools.get((s, ledger))
+            ) < 2:
+                return False
+        return True
 
     def next_request(self):
         """-> (operation, body, kind); kind in accounts/local/cross/
@@ -683,8 +932,11 @@ class ShardedWorkload:
         if not self._ready() or self.rng.random() < 0.06:
             return self._new_accounts(int(self.rng.integers(2, 5)))
         roll = self.rng.random()
+        # 0 = frozen single-tenant path (ledger defaults on the rows);
+        # >0 = the flood-biased tenant whose pools the pickers filter.
+        ledger = self._pick_tenant() if self.tenants > 1 else 0
         if roll < self.cross_ratio:
-            dr, cr, ds, cs = self._pick_cross_pair()
+            dr, cr, ds, cs = self._pick_cross_pair(ledger)
             rows = []
             for _ in range(int(self.rng.integers(1, 4))):
                 tid = self.next_transfer
@@ -695,11 +947,11 @@ class ShardedWorkload:
                 self.xfer_debitor[tid] = dr
                 rows.append(transfer(
                     tid, debit_account_id=dr, credit_account_id=cr,
-                    amount=amount,
+                    amount=amount, ledger=ledger or 1,
                 ))
             return types.Operation.create_transfers, pack(rows), "cross"
         if roll < self.cross_ratio + 0.30:
-            dr, cr, _s = self._pick_local_pair()
+            dr, cr, _s = self._pick_local_pair(ledger)
             rows = []
             for _ in range(int(self.rng.integers(1, 5))):
                 tid = self.next_transfer
@@ -707,23 +959,25 @@ class ShardedWorkload:
                 rows.append(transfer(
                     tid, debit_account_id=dr, credit_account_id=cr,
                     amount=int(self.rng.integers(1, 100)),
+                    ledger=ledger or 1,
                 ))
             return types.Operation.create_transfers, pack(rows), "local"
         if roll < self.cross_ratio + 0.42:
-            dr, cr, s = self._pick_local_pair()
+            dr, cr, s = self._pick_local_pair(ledger)
             tid = self.next_transfer
             self.next_transfer += 1
-            self.pending_local.append((tid, s))
+            self.pending_local.append((tid, s, ledger or 1))
             return (
                 types.Operation.create_transfers,
                 pack([transfer(tid, debit_account_id=dr,
                                credit_account_id=cr,
                                amount=int(self.rng.integers(1, 50)),
+                               ledger=ledger or 1,
                                flags=types.TransferFlags.pending)]),
                 "local",
             )
         if roll < self.cross_ratio + 0.52 and self.pending_local:
-            pid, _s = self.pending_local.pop(
+            pid, _s, pledger = self.pending_local.pop(
                 int(self.rng.integers(len(self.pending_local)))
             )
             tid = self.next_transfer
@@ -735,7 +989,8 @@ class ShardedWorkload:
             )
             return (
                 types.Operation.create_transfers,
-                pack([transfer(tid, pending_id=pid, flags=flags)]),
+                pack([transfer(tid, pending_id=pid, ledger=pledger,
+                               flags=flags)]),
                 "post_void",
             )
         ids = [
@@ -769,7 +1024,9 @@ class ShardedVopr:
                  partition_probability: float = 0.004,
                  coordinator_kill_probability: float = 0.004,
                  device_loss_probability: float = 0.0,
-                 cross_ratio: float = 0.35) -> None:
+                 cross_ratio: float = 0.35,
+                 tenants: int = 1,
+                 tenant_qos: dict | None = None) -> None:
         self.seed = seed
         self.rng = np.random.default_rng(seed + 1)
         factories = None
@@ -788,9 +1045,11 @@ class ShardedVopr:
             n_shards, replica_count=replica_count, seed=seed,
             options=PacketOptions(packet_loss_probability=packet_loss),
             state_machine_factories=factories,
+            tenant_qos=tenant_qos,
         )
         self.workload = ShardedWorkload(seed + 2, n_shards,
-                                        cross_ratio=cross_ratio)
+                                        cross_ratio=cross_ratio,
+                                        tenants=tenants)
         self.requests = requests
         self.crash_probability = crash_probability
         self.fsync_crash_probability = fsync_crash_probability

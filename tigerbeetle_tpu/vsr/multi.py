@@ -156,6 +156,28 @@ class VsrReplica(Replica):
         # an owner callback fired per shed (counters, flight ring).
         self.admit_queue: int | None = None
         self.on_shed = None
+        # Multi-tenant QoS (qos.TenantQos; round 16): tenant-keyed
+        # admission + weighted-fair drain.  None (the TB_TENANT_QOS=0
+        # path) keeps every queue operation on the legacy single-FIFO
+        # code exactly.  When set, `_queue_tenants` mirrors
+        # request_queue entry-for-entry with each request's tenant
+        # (ledger), so per-tenant depths and the WFQ pick index are
+        # one list scan over small ints, bounded by admit_queue.
+        self.qos = None
+        self._queue_tenants: list[int] = []
+        # tenant -> queued-request count, maintained incrementally on
+        # enqueue/pop/clear: admission and the busy payload read a
+        # tenant's depth per fresh request, and a list .count() there
+        # would put an O(admit_queue) scan on the ingest hot path.
+        self._tenant_depth: dict[int, int] = {}
+        self._last_pop_tenant: int | None = None
+        # Weighted-fair drain engages only inside an OVERLOAD EPISODE:
+        # the first shed opens it, the queue running empty closes it.
+        # Outside an episode the queue is strict FIFO and batch
+        # lookahead reads the global head — bit-identical to the
+        # TB_TENANT_QOS=0 path (the differential contract: QoS on
+        # under non-overload load must not reorder anything).
+        self._qos_episode = False
 
         # Cluster clock synchronization (reference: src/vsr/clock.zig).
         self.clock = Clock(replica, replica_count)
@@ -711,7 +733,8 @@ class VsrReplica(Replica):
                     inflight.add(key)
         self._drain_request_queue()
 
-    def _enqueue_request(self, header: np.ndarray, body: bytes) -> None:
+    def _enqueue_request(self, header: np.ndarray, body: bytes,
+                         readmit: bool = False) -> None:
         """Queue a request exactly once: broadcast retransmissions of
         the same (client, request) must not pile up (a batched drain
         would execute every copy).
@@ -720,44 +743,131 @@ class VsrReplica(Replica):
         a retransmission of an already-committed request must get its
         stored reply even under overload, never a busy (shedding at
         the server's raw-message layer had exactly that bug).  A
-        fresh request past the `admit_queue` bound is shed with a
-        typed Command.client_busy: session intact, client may retry."""
+        fresh request past the `admit_queue` bound — or, with QoS on,
+        past its TENANT's token bucket / queue bound — is shed with a
+        typed Command.client_busy: session intact, client may retry.
+        `readmit` (a "queue"-verdict request cycling back from the
+        drain) skips the token bucket: its arrival was already
+        charged once."""
         key = (wire.u128(header, "client"), int(header["request"]))
         if key in self._queued_keys:
             return
+        tenant = None
+        if self.qos is not None:
+            tenant = wire.tenant_of(header, body)
+            if not readmit:
+                self.qos.observe(tenant, self.monotonic)
+        # Global bound FIRST: a request the full queue sheds anyway
+        # must not consume one of its tenant's tokens (an unrefunded
+        # charge here would let a flood that fills the global queue
+        # drain a victim tenant's bucket, throttling the victim below
+        # its configured rate after the queue clears).
         if self.admit_queue is not None and (
             len(self.request_queue) >= self.admit_queue
         ):
-            self._shed_request(header)
+            self._shed_request(header, tenant)
             return
+        if self.qos is not None and not readmit:
+            if not self.qos.admit(
+                tenant, self.monotonic,
+                self._tenant_depth.get(tenant, 0),
+            ):
+                self._shed_request(header, tenant)
+                return
         self._queued_keys.add(key)
         self.anatomy.stage_h(header, "queued")
         self.request_queue.append((header, body))
+        if self.qos is not None:
+            self._queue_tenants.append(tenant)
+            self._tenant_depth[tenant] = (
+                self._tenant_depth.get(tenant, 0) + 1
+            )
+            if not readmit:
+                self.qos.on_admit(tenant)
 
-    def _shed_request(self, header: np.ndarray) -> None:
-        """Typed load shed: the queue is full.  The busy reply rides
-        the client's registered connection (a request forwarded from
-        a backup has none here — its client recovers by retransmit
-        timeout, which is the legacy-client path anyway)."""
+    def _shed_request(self, header: np.ndarray,
+                      tenant: int | None = None) -> None:
+        """Typed load shed: the queue (global or the tenant's) is
+        full.  The busy reply rides the client's registered connection
+        (a request forwarded from a backup has none here — its client
+        recovers by retransmit timeout, which is the legacy-client
+        path anyway).  With QoS on the body carries WHO was shed and
+        the rate the server observed for that tenant (wire.busy_body)
+        so the client can size its backoff; QoS off keeps the legacy
+        empty body bit-identically."""
         client = wire.u128(header, "client")
+        payload = b""
+        if self.qos is not None and tenant is not None:
+            payload = wire.busy_body(
+                tenant, self._tenant_depth.get(tenant, 0),
+                self.qos.rate_of(tenant),
+            )
+            self.qos.on_shed(tenant)
+            # First shed opens an overload episode: weighted-fair
+            # drain engages until the queue next runs empty.
+            self._qos_episode = True
         busy = wire.make_header(
             command=Command.client_busy, cluster=self.cluster,
             client=client, request=int(header["request"]),
             replica=self.replica, view=self.view,
         )
         wire.copy_trace(busy, header)
-        wire.finalize_header(busy, b"")
+        wire.finalize_header(busy, payload)
         if client:
-            self.bus.send_client(client, busy, b"")
+            self.bus.send_client(client, busy, payload)
         if self.on_shed is not None:
-            self.on_shed(header)
+            self.on_shed(header, tenant)
 
-    def _pop_request(self) -> tuple[np.ndarray, bytes]:
-        h, b = self.request_queue.pop(0)
+    def _pop_request(self, tenant: int | None = None,
+                     ) -> tuple[np.ndarray, bytes]:
+        """FIFO head when QoS is off or no overload episode is open;
+        weighted-fair across tenant FIFOs inside an episode (`tenant`
+        pins the pick — logical-batch continuation stays within one
+        tenant, so inside an episode a prepare's multiplexed requests
+        share one tenant and reply attribution is exact; outside one,
+        FIFO batches may mix tenants and attribution is head-of-batch
+        approximate — mixed batches only form under non-overload,
+        where the per-tenant histograms are not the diagnostic).
+
+        The episode gate is the differential contract: outside an
+        episode (no shed since the queue last ran empty) the drain is
+        strict FIFO — bit-identical to TB_TENANT_QOS=0 — because a
+        weighted-fair pick depends on queue CONTENT at pop time, and
+        content varies with ingest drain cadence (per-message vs
+        columnar batch) even when arrivals are identical."""
+        idx = 0
+        if self.qos is not None:
+            if self._qos_episode:
+                if tenant is None:
+                    tenant = self.qos.pick(self._queue_tenants)
+                idx = self._queue_tenants.index(tenant)
+            self._last_pop_tenant = self._queue_tenants.pop(idx)
+            depth = self._tenant_depth.get(self._last_pop_tenant, 0) - 1
+            if depth > 0:
+                self._tenant_depth[self._last_pop_tenant] = depth
+            else:
+                self._tenant_depth.pop(self._last_pop_tenant, None)
+        h, b = self.request_queue.pop(idx)
         self._queued_keys.discard(
             (wire.u128(h, "client"), int(h["request"]))
         )
+        if self.qos is not None and not self.request_queue:
+            # Queue drained: the overload episode (if any) is over;
+            # the next pops are FIFO again until the next shed.
+            self._qos_episode = False
         return h, b
+
+    def _queue_peek(self, tenant: int | None,
+                    ) -> tuple[np.ndarray, bytes] | None:
+        """The next request a `_pop_request(tenant)` would return —
+        the queue head (legacy / outside an episode), or the tenant's
+        FIFO head (weighted-fair episode)."""
+        if self.qos is None or not self._qos_episode:
+            return self.request_queue[0] if self.request_queue else None
+        try:
+            return self.request_queue[self._queue_tenants.index(tenant)]
+        except ValueError:
+            return None
 
     def _request_dedupe(
         self, header: np.ndarray, in_queue: bool = False,
@@ -1063,6 +1173,15 @@ class VsrReplica(Replica):
             # The request's timeline closes at reply: e2e into the
             # anatomy histogram, tail exemplars retained.
             self.anatomy.finish_h(entry.header, "reply")
+            if self.qos is not None and client:
+                # Per-tenant reply latency, attributed to the batch
+                # head's tenant: exact inside an overload episode
+                # (WFQ keeps logical batches within one tenant),
+                # head-of-batch approximate for FIFO batches outside
+                # one (see _pop_request).
+                self.qos.on_reply(
+                    wire.tenant_of(entry.header, entry.body), entry.header
+                )
             del self.pipeline[op]
             if self._checkpoint_due():
                 # Deterministic checkpoint point: commit_min crosses the
@@ -1101,6 +1220,7 @@ class VsrReplica(Replica):
             and self._prepare_headroom()
         ):
             h, b = self._pop_request()
+            cur_tenant = self._last_pop_tenant
             # Queued requests re-run the at-most-once gate: their
             # duplicate may have committed (or become decidable) while
             # they waited.
@@ -1125,7 +1245,10 @@ class VsrReplica(Replica):
                 total = len(b) + sub_size
                 limit = self.config.message_body_size_max
                 while self.request_queue:
-                    h2, b2 = self.request_queue[0]
+                    nxt = self._queue_peek(cur_tenant)
+                    if nxt is None:
+                        break
+                    h2, b2 = nxt
                     if int(h2["operation"]) != operation:
                         break
                     if total + len(b2) + sub_size > limit:
@@ -1137,7 +1260,7 @@ class VsrReplica(Replica):
                         is not None
                     ):
                         break  # handled/undecidable: not batchable now
-                    batch.append(self._pop_request())
+                    batch.append(self._pop_request(cur_tenant))
                     total += len(b2) + sub_size
             prepared = [(h, b)] + batch
             if batch:
@@ -1150,7 +1273,7 @@ class VsrReplica(Replica):
                     if c:
                         inflight.add((c, int(ph["request"])))
         for rh, rb in requeue:
-            self._enqueue_request(rh, rb)
+            self._enqueue_request(rh, rb, readmit=True)
 
     def _primary_prepare_batch(
         self, requests: list[tuple[np.ndarray, bytes]]
@@ -2388,6 +2511,13 @@ class VsrReplica(Replica):
         )
         self.pipeline.clear()
         self.request_queue.clear()
+        self._queue_tenants.clear()
+        self._tenant_depth.clear()
+        # The queue is empty: any open overload episode closes with it
+        # (left latched, the new view's first drain would run WFQ
+        # order with no shed since — breaking the differential
+        # contract's strict-FIFO-outside-an-episode guarantee).
+        self._qos_episode = False
         self._queued_keys.clear()
         self._svc_votes.clear()
         self._dvc.clear()

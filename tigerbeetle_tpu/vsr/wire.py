@@ -106,13 +106,97 @@ HEADER_DTYPE = np.dtype(
         ("trace_id", "<u8"),                                     # [156, 164)
         ("trace_ts", "<u8"),                                     # [164, 172)
         ("trace_flags", "u1"),                                   # [172, 173)
-        ("reserved", "V83"),                                     # [173, 256)
+        # Tenant key (ours, round 16): the LEDGER this request's
+        # events belong to, stamped by tenant-aware clients so
+        # admission/scheduling can key on it without touching the
+        # body.  Zero (legacy clients, VSR-internal messages) means
+        # "derive from the body's leading event" (tenant_of below) —
+        # so legacy headers stay bit-identical, exactly like the
+        # trace-context carve-out above.
+        ("tenant", "<u4"),                                       # [173, 177)
+        ("reserved", "V79"),                                     # [177, 256)
     ]
 )
 assert HEADER_DTYPE.itemsize == HEADER_SIZE, HEADER_DTYPE.itemsize
 
 # trace_flags bits.
 TRACE_SAMPLED = 1
+
+# ----------------------------------------------------------------------
+# Multi-tenant QoS (round 16): the tenant key is the LEDGER.
+
+# Typed busy payload: a QoS shed carries WHO was shed and the rate the
+# server observed for that tenant, so a client can size its backoff.
+# Legacy (QoS-off) busy replies keep an empty body — bit-identical to
+# the r12 wire contract; clients must treat both shapes as busy.
+BUSY_BODY_DTYPE = np.dtype(
+    [
+        ("tenant", "<u4"),        # ledger the shed request belonged to
+        ("queue_depth", "<u4"),   # tenant's queued requests at shed time
+        ("observed_rps", "<u8"),  # tenant's arrival rate, requests/sec
+    ]
+)
+
+# `ledger` offset inside the 128-byte Account AND Transfer wire rows
+# (both place it at the same offset; asserted against types.py on
+# first use so a layout change cannot silently break derivation).
+_LEDGER_OFFSET: int | None = None
+_LEDGER_OPS: tuple[int, int] = ()  # (create_accounts, create_transfers)
+
+
+def _ledger_layout() -> tuple[int, tuple[int, int]]:
+    global _LEDGER_OFFSET, _LEDGER_OPS
+    if _LEDGER_OFFSET is None:
+        from tigerbeetle_tpu import types
+
+        off_a = types.ACCOUNT_DTYPE.fields["ledger"][1]
+        off_t = types.TRANSFER_DTYPE.fields["ledger"][1]
+        assert off_a == off_t, (off_a, off_t)
+        _LEDGER_OFFSET = off_a
+        _LEDGER_OPS = (
+            int(types.Operation.create_accounts),
+            int(types.Operation.create_transfers),
+        )
+    return _LEDGER_OFFSET, _LEDGER_OPS
+
+
+def tenant_of(header: np.ndarray, body: bytes | memoryview | None = None,
+              ) -> int:
+    """The tenant (ledger) a client request belongs to.
+
+    Precedence: the header's explicit `tenant` stamp (tenant-aware
+    clients), else the `ledger` field of the body's first event for
+    the create operations (legacy clients grouped by their actual
+    ledger), else 0 — the shared best-effort class (lookups/filters
+    carry no ledger on the wire)."""
+    t = int(header["tenant"])
+    if t:
+        return t
+    if body is None or len(body) == 0:
+        return 0
+    offset, ledger_ops = _ledger_layout()
+    if int(header["operation"]) not in ledger_ops:
+        return 0
+    if len(body) < offset + 4:
+        return 0
+    return int.from_bytes(bytes(body[offset : offset + 4]), "little")
+
+
+def busy_body(tenant: int, queue_depth: int, observed_rps: int) -> bytes:
+    row = np.zeros(1, BUSY_BODY_DTYPE)[0]
+    row["tenant"] = tenant & 0xFFFFFFFF
+    row["queue_depth"] = min(queue_depth, 0xFFFFFFFF)
+    row["observed_rps"] = observed_rps
+    return row.tobytes()
+
+
+def parse_busy_body(body: bytes) -> tuple[int, int, int] | None:
+    """(tenant, queue_depth, observed_rps), or None for a legacy
+    (empty / unknown-shape) busy body."""
+    if len(body) != BUSY_BODY_DTYPE.itemsize:
+        return None
+    row = np.frombuffer(body, BUSY_BODY_DTYPE)[0]
+    return int(row["tenant"]), int(row["queue_depth"]), int(row["observed_rps"])
 
 # Wire-protocol version (ours, not the reference's).
 VERSION = 1
