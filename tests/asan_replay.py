@@ -1,0 +1,190 @@
+"""Sanitizer replay driver (run by tests/test_sanitizers.py, or by
+hand — see experiments/README.md):
+
+    make -C native asan
+    LD_PRELOAD="$(gcc -print-file-name=libasan.so)" \
+    ASAN_OPTIONS=detect_leaks=0 TB_NATIVE_SANITIZE=asan \
+    JAX_PLATFORMS=cpu python tests/asan_replay.py
+
+Drives the fixture differential from tests/test_fastpath_decode.py
+plus a torn-frame / oversize-frame fuzz through the SANITIZED native
+libraries (native/asan/): batch frame verification vs the Python
+oracle over the checked-in frames and their corrupt mutations, batch
+reply finalize parity, seeded random tearing of the fixture stream
+through the native bus framing, and oversize size-field frames that
+must drop the connection without touching out-of-bounds memory.
+Exits 0 with the final OK marker only if every differential holds;
+address/UB findings abort the process with a sanitizer report the
+caller parses.
+"""
+
+import json
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tigerbeetle_tpu.runtime import fastpath  # noqa: E402
+from tigerbeetle_tpu.runtime.native import (  # noqa: E402
+    EV_CLOSED,
+    EV_MESSAGE,
+    NativeBus,
+    native_available,
+)
+from tigerbeetle_tpu.vsr import wire  # noqa: E402
+
+HEADER_SIZE = 256
+FIXTURES = os.path.join(REPO, "clients", "fixtures")
+
+
+def fixture_frames() -> list:
+    with open(os.path.join(FIXTURES, "frames.json")) as fh:
+        return [bytes.fromhex(c["frame_hex"]) for c in json.load(fh)]
+
+
+def mutations(frames: list) -> list:
+    """Same corrupt variants the tier-1 differential uses (flipped
+    body/header bytes, wrong version, lying size field)."""
+    out = list(frames)
+    body_frame = next(f for f in frames if len(f) > HEADER_SIZE)
+    flipped_body = bytearray(body_frame)
+    flipped_body[HEADER_SIZE + 3] ^= 0xFF
+    out.append(bytes(flipped_body))
+    flipped_header = bytearray(frames[0])
+    flipped_header[40] ^= 0x01
+    out.append(bytes(flipped_header))
+    bad_version = bytearray(frames[0])
+    bad_version[155] = 99
+    out.append(bytes(bad_version))
+    lying_size = bytearray(body_frame)
+    lying_size[144:148] = (len(body_frame) + 128).to_bytes(4, "little")
+    out.append(bytes(lying_size))
+    return out
+
+
+def arena_of(frames: list):
+    blob = b"".join(frames)
+    arena = np.frombuffer(blob, np.uint8)
+    offsets = np.zeros(len(frames), np.uint64)
+    lens = np.zeros(len(frames), np.uint32)
+    at = 0
+    for i, f in enumerate(frames):
+        offsets[i] = at
+        lens[i] = len(f)
+        at += len(f)
+    return arena, offsets, lens
+
+
+def check_fixture_differential() -> None:
+    frames = mutations(fixture_frames())
+    arena, offsets, lens = arena_of(frames)
+    legacy = []
+    for f in frames:
+        h = wire.header_from_bytes(f[:HEADER_SIZE])
+        legacy.append(int(wire.verify_header(h, f[HEADER_SIZE:])))
+    ok_native = fastpath.verify_frames(arena, offsets, lens, len(frames))
+    assert ok_native is not None, "sanitized fastpath lacks verify"
+    assert [int(v) for v in ok_native] == legacy, "verify differential"
+    ok_py = fastpath.verify_frames_py(arena, offsets, lens, len(frames))
+    assert [int(v) for v in ok_py] == legacy, "python oracle drifted"
+    print("asan-replay: fixture differential ok "
+          f"({len(frames)} frames incl. corrupt mutations)")
+
+
+def check_finalize_parity() -> None:
+    bodies = [b"", b"r" * 333, bytes(range(128)) * 5, b"x" * 8190]
+    hdrs = np.zeros(len(bodies), wire.HEADER_DTYPE)
+    hdrs["version"] = wire.VERSION
+    hdrs["command"] = int(wire.Command.reply)
+    hdrs["request"] = np.arange(len(bodies))
+    oracle = hdrs.copy()
+    wire.finalize_headers_py(oracle, bodies)
+    assert fastpath.finalize_headers(hdrs, bodies), "native finalize"
+    assert hdrs.tobytes() == oracle.tobytes(), "finalize parity"
+    print("asan-replay: batch finalize parity ok")
+
+
+def check_torn_frames(seed: int = 4242, rounds: int = 8) -> None:
+    """The fixture stream torn at seeded-random boundaries through the
+    native bus framing: every frame must reassemble byte-identically,
+    every round, with the sanitizer watching the C framing buffers."""
+    frames = fixture_frames()
+    stream = b"".join(frames)
+    rng = np.random.default_rng(seed)
+    for _round in range(rounds):
+        bus = NativeBus(1 << 20)
+        port = bus.listen("127.0.0.1", 0)
+        sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+        got: list = []
+
+        def drain(timeout_ms: int) -> None:
+            r = bus.poll_drain(timeout_ms)
+            if r is None:
+                raise AssertionError("sanitized bus lacks poll_drain")
+            n, types, _conns, offs, lens, arena = r
+            for i in range(n):
+                if types[i] == EV_MESSAGE:
+                    lo = int(offs[i])
+                    got.append(bytes(arena[lo : lo + int(lens[i])]))
+
+        at = 0
+        while at < len(stream):
+            n = int(rng.integers(1, 512))
+            sock.sendall(stream[at : at + n])
+            at += n
+            drain(0)
+        deadline = time.time() + 30
+        while len(got) < len(frames) and time.time() < deadline:
+            drain(10)
+        assert got == frames, (
+            f"torn round {_round}: {len(got)}/{len(frames)} frames"
+        )
+        sock.close()
+        bus.close()
+    print(f"asan-replay: torn-frame fuzz ok ({rounds} rounds)")
+
+
+def check_oversize_frames() -> None:
+    """Size fields past the frame bound (message_size_max bodies +
+    the 256-byte header) must drop the connection — never index the
+    framing buffer out of bounds.  Probed at bound+1, bound+4096, and
+    a u32 in the sign-bit range."""
+    max_size = 1 << 20
+    bound = max_size + HEADER_SIZE
+    for oversize in (bound + 1, bound + 4096, (1 << 31) + 7):
+        bus = NativeBus(max_size)
+        port = bus.listen("127.0.0.1", 0)
+        sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+        h = wire.make_header(command=wire.Command.request, cluster=1)
+        h["size"] = oversize & 0xFFFFFFFF
+        sock.sendall(h.tobytes())
+        closed = False
+        deadline = time.time() + 30
+        while not closed and time.time() < deadline:
+            for t, _c, _p in bus.poll(10):
+                if t == EV_CLOSED:
+                    closed = True
+        assert closed, f"oversize {oversize} did not drop the conn"
+        sock.close()
+        bus.close()
+    print("asan-replay: oversize-frame fuzz ok")
+
+
+def main() -> int:
+    assert native_available(), "sanitized native runtime failed to load"
+    assert fastpath.available(), "sanitized fastpath failed to load"
+    check_fixture_differential()
+    check_finalize_parity()
+    check_torn_frames()
+    check_oversize_frames()
+    print("ASAN-REPLAY-OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

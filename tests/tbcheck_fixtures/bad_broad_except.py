@@ -1,0 +1,22 @@
+"""Known-bad: broad handlers that swallow without routing anywhere."""
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except Exception:
+        return None  # flagged: no re-raise, no classification
+
+
+def swallow_everything(fn):
+    try:
+        return fn()
+    except BaseException:
+        pass  # flagged
+
+
+def swallow_bare(fn):
+    try:
+        return fn()
+    except:  # noqa: E722
+        pass  # flagged

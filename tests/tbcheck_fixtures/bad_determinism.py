@@ -1,0 +1,22 @@
+"""Known-bad: wall clocks + unseeded entropy, behind import aliases."""
+
+import time as _clock
+from os import urandom as entropy
+
+import numpy as np
+
+
+def tick() -> float:
+    return _clock.monotonic()  # flagged: time.monotonic via alias
+
+
+def stamp() -> int:
+    return _clock.time_ns()  # flagged: time.time_ns via alias
+
+
+def nonce() -> bytes:
+    return entropy(8)  # flagged: os.urandom via from-import alias
+
+
+def rng():
+    return np.random.default_rng()  # flagged: unseeded default_rng

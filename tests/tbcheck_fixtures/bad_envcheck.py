@@ -1,0 +1,16 @@
+"""Known-bad: raw TB_*/BENCH_* reads that walk past a grep."""
+
+import os as _o
+from os import environ as E
+
+
+def window() -> str:
+    return E["TB_DEV_WINDOW"]  # flagged: subscript via alias
+
+
+def secs():
+    return E.get("BENCH_OPEN_SECS")  # flagged: .get via alias
+
+
+def waves():
+    return _o.getenv("TB_WAVES")  # flagged: getenv via module alias

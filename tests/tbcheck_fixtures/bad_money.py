@@ -1,0 +1,19 @@
+"""Known-bad: float contamination of u128 money math."""
+
+import numpy as np
+
+
+def split(amount: int) -> int:
+    return amount / 2  # flagged: true division
+
+
+def fee_of(amount: int):
+    return amount * 0.01  # flagged: float literal
+
+
+def widen(debits_pending):
+    return np.asarray(debits_pending, np.float64)  # flagged: dtype
+
+
+def approximate(credits_posted):
+    return float(credits_posted)  # flagged: float() cast

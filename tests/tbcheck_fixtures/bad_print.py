@@ -1,0 +1,5 @@
+"""Known-bad: print in a core module."""
+
+
+def report(x):
+    print("value:", x)  # flagged

@@ -1,0 +1,10 @@
+"""Known-bad: suppressions that don't carry their weight."""
+
+
+def report(x):
+    print("value:", x)  # tbcheck: allow(no-print)
+
+
+def quiet(x, log):
+    # tbcheck: allow(no-print): stale — the print below was removed.
+    log.info("value: %s", x)

@@ -1,0 +1,13 @@
+"""Known-bad: a scratch carve-out whose offsets collide."""
+
+import numpy as np
+
+SCRATCH_HEADER_DTYPE = np.dtype(
+    {
+        "names": ["checksum", "trace_id", "tenant", "reserved"],
+        "formats": ["V16", "<u8", "<u4", "V232"],
+        # tenant claims [20, 24) — overlapping trace_id [16, 24).
+        "offsets": [0, 16, 20, 24],
+        "itemsize": 256,
+    }
+)

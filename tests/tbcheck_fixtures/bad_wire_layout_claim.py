@@ -1,0 +1,11 @@
+"""Known-bad: the annotation lies about the derived byte range."""
+
+import numpy as np
+
+CLAIM_HEADER_DTYPE = np.dtype(
+    [
+        ("checksum", "V16"),                                 # [0, 16)
+        ("trace_id", "<u8"),                                 # [150, 158)
+        ("reserved", "V232"),                                # [24, 256)
+    ]
+)

@@ -1,0 +1,19 @@
+"""Known-bad: worker closure and method both mutate an undeclared
+attribute."""
+
+from tigerbeetle_tpu.utils.worker import SerialWorker
+
+
+class Counter:
+    def __init__(self):
+        self._worker = SerialWorker("count")
+        self.count = 0
+
+    def _bump_job(self):
+        self.count += 1  # worker-thread write
+
+    def kick(self):
+        self._worker.submit(self._bump_job)
+
+    def reset(self):
+        self.count = 0  # foreground write: flagged (undeclared)

@@ -1,0 +1,29 @@
+"""Known-good twin: typed, re-raising, or classifying handlers."""
+
+from tigerbeetle_tpu.state_machine.device_engine import (
+    classify_link_error,
+)
+
+
+def typed(fn):
+    try:
+        return fn()
+    except ValueError:
+        return None  # narrow: allowed
+
+
+def reraising(fn, log):
+    try:
+        return fn()
+    except Exception as exc:
+        log(exc)
+        raise  # re-raise: allowed
+
+
+def classifying(fn):
+    try:
+        return fn()
+    except Exception as exc:
+        if classify_link_error(exc) == "transient":
+            return None  # classified: allowed
+        return False

@@ -1,0 +1,18 @@
+"""Known-good twin: injected clocks and seeded generators only."""
+
+import time as _clock
+
+import numpy as np
+
+
+def elapsed_us(t0: int) -> float:
+    # perf counters are observability, not state input: allowed.
+    return (_clock.perf_counter_ns() - t0) / 1e3
+
+
+def rng(seed: int):
+    return np.random.default_rng(seed)  # seeded: allowed
+
+
+def tick(clock) -> int:
+    return clock()  # injected clock: the sanctioned pattern

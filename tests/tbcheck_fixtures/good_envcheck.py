@@ -1,0 +1,13 @@
+"""Known-good twin: knobs go through envcheck; non-knob env is fine."""
+
+import os
+
+from tigerbeetle_tpu import envcheck
+
+
+def window() -> int:
+    return envcheck.env_int("TB_DEV_WINDOW", 96, minimum=1)
+
+
+def home():
+    return os.environ.get("HOME")  # not a TB_/BENCH_ knob: allowed

@@ -1,0 +1,15 @@
+"""Known-good twin: integer/limb money math only."""
+
+import numpy as np
+
+
+def split(amount: int) -> int:
+    return amount // 2  # integer division: allowed
+
+
+def widen(debits_pending):
+    return np.asarray(debits_pending, np.uint64)
+
+
+def ratio(events: int, secs: float) -> float:
+    return events / secs  # floats fine outside money expressions

@@ -1,0 +1,5 @@
+"""Known-good twin: core modules narrate through a tracer/logger."""
+
+
+def report(x, tracer):
+    tracer.instant("value", value=x)

@@ -1,0 +1,12 @@
+"""Known-good twin: contiguous carve-outs, annotations match."""
+
+import numpy as np
+
+MINI_HEADER_DTYPE = np.dtype(
+    [
+        ("checksum", "V16"),                                 # [0, 16)
+        ("trace_id", "<u8"),                                 # [16, 24)
+        ("tenant", "<u4"),                                   # [24, 28)
+        ("reserved", "V228"),                                # [28, 256)
+    ]
+)

@@ -1,0 +1,28 @@
+"""Known-good twin: the shared attribute is declared (and the class
+says what serializes it)."""
+
+import threading
+
+from tigerbeetle_tpu.utils.worker import SerialWorker
+
+
+class Counter:
+    # count is written by the worker job and by reset(); every write
+    # holds _lock.
+    _WORKER_SHARED = frozenset({"count"})
+
+    def __init__(self):
+        self._worker = SerialWorker("count")
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def _bump_job(self):
+        with self._lock:
+            self.count += 1
+
+    def kick(self):
+        self._worker.submit(self._bump_job)
+
+    def reset(self):
+        with self._lock:
+            self.count = 0

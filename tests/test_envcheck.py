@@ -396,36 +396,30 @@ def test_tenant_weights_validated(monkeypatch):
     assert envcheck.tenant_weights() == {}
 
 
-def test_no_tb_knob_bypasses_envcheck():
-    """Audit lint: every TB_* knob in the package must be read through
-    envcheck.py (validated, named errors), never via a raw os.environ
-    / os.getenv call.  A raw read silently accepts garbage and hides
-    the knob from the envcheck surface tests — this lint turns the
-    convention into a tier-1 invariant (and covers the round-16 QoS
-    knobs TB_TENANT_QOS / TB_TENANT_RATE / TB_TENANT_QUEUE /
-    TB_TENANT_WEIGHTS / TB_BUSY_BACKOFF_MS by construction)."""
-    import os
-    import re
+def test_tb_native_sanitize_validated(monkeypatch):
+    monkeypatch.setenv("TB_NATIVE_SANITIZE", "msan")
+    with pytest.raises(envcheck.EnvVarError, match="TB_NATIVE_SANITIZE"):
+        envcheck.native_sanitize()
+    monkeypatch.setenv("TB_NATIVE_SANITIZE", "asan")
+    assert envcheck.native_sanitize() == "asan"
+    monkeypatch.delenv("TB_NATIVE_SANITIZE")
+    assert envcheck.native_sanitize() == ""  # default: release builds
 
-    pkg = os.path.dirname(envcheck.__file__)
-    pattern = re.compile(
-        r"os\.(?:environ\.get|environ\[|getenv)\s*\(?\s*"
-        r"(['\"])(TB_[A-Z0-9_]+)\1"
-    )
-    offenders = []
-    for root, _dirs, files in os.walk(pkg):
-        if "__pycache__" in root:
-            continue
-        for fname in files:
-            if not fname.endswith(".py") or fname == "envcheck.py":
-                continue
-            path = os.path.join(root, fname)
-            text = open(path).read()
-            for m in pattern.finditer(text):
-                line = text[: m.start()].count("\n") + 1
-                offenders.append(f"{path}:{line}: raw read of {m.group(2)}")
-    assert not offenders, (
-        "TB_* knobs must go through envcheck.py:\n" + "\n".join(offenders)
+
+def test_no_tb_knob_bypasses_envcheck():
+    """Audit lint: every TB_*/BENCH_* knob in the package must be read
+    through envcheck.py (validated, named errors), never via a raw
+    os.environ / os.getenv call.  Round 17 migrated the r16 grep onto
+    the tbcheck `envcheck` AST rule, which also resolves import
+    aliases — ``from os import environ as E; E["TB_X"]`` no longer
+    walks past the audit (proven by fixture in tests/test_tbcheck.py).
+    """
+    from tigerbeetle_tpu.analysis import run_lint
+    from tigerbeetle_tpu.analysis.rules import EnvcheckRule
+
+    result = run_lint(rules=[EnvcheckRule()])
+    assert not result.findings, "\n".join(
+        str(f) for f in result.findings
     )
 
 

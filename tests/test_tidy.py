@@ -1,58 +1,28 @@
-"""Source hygiene lints (reference: src/tidy.zig — banned patterns and
-line-length limits enforced as a test)."""
+"""Source hygiene (reference: src/tidy.zig).
+
+Round 17: the three banned-pattern regexes (wall clock, unseeded
+random, print) migrated into tbcheck AST rules — `determinism` and
+`no-print` in tigerbeetle_tpu/analysis/rules.py — which resolve import
+aliases, scope by the real import graph instead of a filename
+exemption list, and ignore pattern-lookalikes inside string literals
+(the regexes flagged a docstring that merely said "print(").
+tests/test_tbcheck.py proves by fixture that every previously-flagged
+pattern is still caught.  Only the line-length limit stays here: it is
+a token-level property, not an AST one.
+"""
 
 import os
-import re
 
 ROOT = os.path.join(os.path.dirname(__file__), "..", "tigerbeetle_tpu")
-
-BANNED = [
-    # (pattern, why)
-    (re.compile(r"\btime\.time\(\)"), "wall clock in core code breaks "
-     "determinism; use injected realtime/monotonic"),
-    (re.compile(r"\brandom\.random\(\)"), "unseeded randomness breaks "
-     "deterministic simulation; use seeded numpy Generators"),
-    (re.compile(r"\bprint\("), "core modules must not print; use logging "
-     "or tracer"),
-]
-# Modules where process I/O or wall time is the point.
-EXEMPT = {"cli.py", "repl.py", "benchmark.py", "server.py", "native.py",
-          "fastpath.py", "flags.py", "fuzz.py", "soak.py"}
 
 
 def _py_files():
     for dirpath, _dirs, files in os.walk(ROOT):
+        if "__pycache__" in dirpath:
+            continue
         for f in files:
             if f.endswith(".py"):
                 yield os.path.join(dirpath, f)
-
-
-def _strip_comment(line: str) -> str:
-    """Drop a trailing comment, respecting string literals (a '#'
-    inside quotes is not a comment start)."""
-    quote = None
-    for i, ch in enumerate(line):
-        if quote:
-            if ch == quote and line[i - 1] != "\\":
-                quote = None
-        elif ch in "'\"":
-            quote = ch
-        elif ch == "#":
-            return line[:i]
-    return line
-
-
-def test_no_banned_patterns():
-    offenders = []
-    for path in _py_files():
-        if os.path.basename(path) in EXEMPT:
-            continue
-        for lineno, line in enumerate(open(path), 1):
-            stripped = _strip_comment(line)
-            for pat, why in BANNED:
-                if pat.search(stripped):
-                    offenders.append(f"{path}:{lineno}: {pat.pattern} ({why})")
-    assert not offenders, "\n".join(offenders)
 
 
 def test_line_length_limit():

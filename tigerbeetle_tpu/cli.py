@@ -6,6 +6,8 @@ formats a temp single-replica cluster when no --addresses is given,
 then streams transfer batches and reports throughput + latency
 percentiles).
 """
+# tbcheck: allow-file(no-print): the CLI's stdout IS its interface
+# (command results, usage, listen-port handshake).
 
 from __future__ import annotations
 
@@ -44,6 +46,9 @@ commands:
   benchmark  [--transfers=N] [--accounts=N] [--batch=N] [--addresses=...]
              [--statsd-port=N]
   bindings   [--out=<dir>]   (generate C / TypeScript / Go type bindings)
+  lint       [--json] [paths...]
+             (tbcheck: AST invariant lint over the package — exits
+              nonzero on any unsuppressed finding)
   trace-demo [--out=<path>] [--replicas=N] [--batches=N]
              (drive a replicated drain with tracing on and write one
               merged Perfetto-loadable timeline)
@@ -234,6 +239,12 @@ def cmd_bindings(args: list[str]) -> None:
         print(f"wrote {path}")
 
 
+def cmd_lint(args: list[str]) -> None:
+    from tigerbeetle_tpu import analysis
+
+    raise SystemExit(analysis.main(args))
+
+
 def main(argv: list[str] | None = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
@@ -254,6 +265,8 @@ def main(argv: list[str] | None = None) -> None:
         cmd_benchmark(rest)
     elif command == "bindings":
         cmd_bindings(rest)
+    elif command == "lint":
+        cmd_lint(rest)
     elif command == "trace-demo":
         cmd_trace_demo(rest)
     else:

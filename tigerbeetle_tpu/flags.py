@@ -1,6 +1,8 @@
 """Bespoke CLI flag parser (no argparse), mirroring the reference's
 src/flags.zig: `--flag=value` syntax only, typed by a spec dict,
 `fatal()` on any error."""
+# tbcheck: allow-file(no-print): flag errors go to stderr by
+# contract (reference: src/flags.zig fatal()).
 
 from __future__ import annotations
 

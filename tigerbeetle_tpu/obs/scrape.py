@@ -11,6 +11,8 @@ instead of regex-parsing TB_STATS log tails; the log-tail parser
 survives only as the counter-verified fallback for kill -9'd replicas
 (which can't answer a scrape but did leave their last line behind).
 """
+# tbcheck: allow-file(determinism): scrape clients poll a live TCP
+# server with wall-clock deadlines; the sim never executes them.
 
 from __future__ import annotations
 

@@ -102,10 +102,13 @@ class WeightedFair:
             return tenants[0]
         total = 0.0
         best = None
+        # tbcheck: allow(money): WRR scheduling credits are weights,
+        # not balances — float by design, never touch u128 amounts.
         best_credit = 0.0
         for t in tenants:
             w = self.weight_of(t)
             total += w
+            # tbcheck: allow(money): same scheduler credit accumulator.
             c = self._credit.get(t, 0.0) + w
             self._credit[t] = c
             # Deterministic tie-break: sorted iteration + strict `>`

@@ -11,6 +11,8 @@ Statement syntax (same shape as the reference's):
 Objects are comma-separated; `flags` takes |-separated names.  Output
 is JSON-ish, one object per line.
 """
+# tbcheck: allow-file(no-print): the REPL's stdout is the user
+# conversation.
 
 from __future__ import annotations
 

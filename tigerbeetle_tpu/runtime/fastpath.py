@@ -26,9 +26,16 @@ _REPO_ROOT = os.path.dirname(
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 from tigerbeetle_tpu import envcheck
 
-_LIB_PATH = envcheck.env_str(
-    "TB_FASTPATH_LIB", os.path.join(_NATIVE_DIR, "libtb_fastpath.so")
-)
+def _default_lib_path() -> str:
+    # Shares the sanitizer-flavor knob with runtime/native.py: under
+    # TB_NATIVE_SANITIZE=asan both libraries load their sanitized
+    # builds from native/asan/.
+    from tigerbeetle_tpu.runtime import native as native_mod
+
+    return os.path.join(native_mod._lib_dir(), "libtb_fastpath.so")
+
+
+_LIB_PATH = envcheck.env_str("TB_FASTPATH_LIB", _default_lib_path())
 
 _lib = None
 _lib_failed = False  # negative cache: never retry (or re-make) per call

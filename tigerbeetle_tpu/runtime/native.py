@@ -18,9 +18,23 @@ import threading
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 from tigerbeetle_tpu.envcheck import env_str as _env_str
+from tigerbeetle_tpu.envcheck import native_sanitize as _native_sanitize
+
+# Sanitizer flavor (TB_NATIVE_SANITIZE=asan): libraries load from
+# native/asan/ (same basenames) and `make` targets the asan build —
+# shared by this loader and runtime/fastpath.py so one knob flips
+# BOTH libraries to their sanitized builds.
+_SANITIZE = _native_sanitize()
+_MAKE_TARGET = _SANITIZE or "all"
+
+
+def _lib_dir() -> str:
+    return (os.path.join(_NATIVE_DIR, _SANITIZE) if _SANITIZE
+            else _NATIVE_DIR)
+
 
 _LIB_PATH = _env_str(
-    "TB_RUNTIME_LIB", os.path.join(_NATIVE_DIR, "libtb_runtime.so")
+    "TB_RUNTIME_LIB", os.path.join(_lib_dir(), "libtb_runtime.so")
 )
 
 _lib = None
@@ -53,18 +67,27 @@ def _run_make(lib_path: str) -> None:
     if _make_attempted:
         return
     _make_attempted = True
+    # Build-failure forensics name the sanitizer flavor attempted: a
+    # failing `make asan` (no compiler-rt, say) must never read as a
+    # failing release build — and vice versa.
+    flavor = f"sanitizer={_SANITIZE or 'none'}"
     try:
         subprocess.run(
-            ["make", "-C", _NATIVE_DIR], check=True,
+            ["make", "-C", _NATIVE_DIR, _MAKE_TARGET], check=True,
             capture_output=True, timeout=120,
         )
     except subprocess.CalledProcessError as exc:
         tail = (exc.stderr or exc.stdout or b"")[-800:].decode(
             "utf-8", "replace"
         )
-        _build_error = f"make -C native failed (rc={exc.returncode}): {tail}"
+        _build_error = (
+            f"make -C native {_MAKE_TARGET} failed ({flavor}, "
+            f"rc={exc.returncode}): {tail}"
+        )
     except (OSError, subprocess.SubprocessError) as exc:
-        _build_error = f"make -C native failed: {exc!r}"
+        _build_error = (
+            f"make -C native {_MAKE_TARGET} failed ({flavor}): {exc!r}"
+        )
     if _build_error is not None:
         import warnings
 
@@ -287,6 +310,9 @@ class NativeBus:
     def __del__(self):  # noqa: D105
         try:
             self.close()
+        # tbcheck: allow(broad-except): __del__ during interpreter
+        # teardown — the bus handle may already be torn down; any
+        # raise here becomes an unraisable-exception warning storm.
         except Exception:
             pass
 
@@ -336,5 +362,7 @@ class NativeClient:
     def __del__(self):  # noqa: D105
         try:
             self.close()
+        # tbcheck: allow(broad-except): same __del__-at-teardown story
+        # as NativeBus above.
         except Exception:
             pass

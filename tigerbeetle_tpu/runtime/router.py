@@ -1343,6 +1343,9 @@ class RouterServer:
             if old_key is not None:
                 self._pending.pop(old_key[0], None)
             self._pending[key] = sub
+            # tbcheck: allow(determinism): RouterServer is the real-TCP
+            # front-end; retry/observe cadence runs on wall time.  The
+            # sim drives RouterCore, which takes injected ticks.
             self._sent_at[id(sub)] = (key, time.monotonic_ns())
             h = wire.make_header(
                 command=wire.Command.request,
@@ -1377,6 +1380,9 @@ class RouterServer:
         if old_key is not None:
             self._pending.pop(old_key[0], None)
         self._pending[key] = sub
+        # tbcheck: allow(determinism): RouterServer is the real-TCP
+        # front-end; retry/observe cadence runs on wall time.  The
+        # sim drives RouterCore, which takes injected ticks.
         self._sent_at[id(sub)] = (key, time.monotonic_ns())
         h = wire.make_header(
             command=wire.Command.request, operation=int(sub.operation),
@@ -1414,10 +1420,16 @@ class RouterServer:
         )
         conn = self._connect_shard(shard)
         if conn is not None:
+            # tbcheck: allow(determinism): RouterServer is the real-TCP
+            # front-end; retry/observe cadence runs on wall time.  The
+            # sim drives RouterCore, which takes injected ticks.
             self._register_sent[key] = time.monotonic_ns()
             self.bus.send(conn, h.tobytes())
 
     def _retry_sweep(self) -> None:
+        # tbcheck: allow(determinism): RouterServer is the real-TCP
+        # front-end; retry/observe cadence runs on wall time.  The
+        # sim drives RouterCore, which takes injected ticks.
         now = time.monotonic_ns()
         due = []
         for sub in list(self._pending.values()):
@@ -1753,6 +1765,9 @@ class RouterServer:
             # table sheds anyway never consumes one of its tenant's
             # tokens (the tenant still rides the busy payload).
             tenant = wire.tenant_of(header, body)
+            # tbcheck: allow(determinism): RouterServer is the real-TCP
+            # front-end; retry/observe cadence runs on wall time.  The
+            # sim drives RouterCore, which takes injected ticks.
             now = time.monotonic_ns()
             self.qos.observe(tenant, now)
         if len(self._open) >= self.admit_queue:

@@ -10,6 +10,11 @@ Peer connection rule: replica i initiates connections to every j < i
 (one TCP connection per replica pair); reconnects are retried each
 tick (reference: src/message_bus.zig reconnect w/ backoff).
 """
+# tbcheck: allow-file(determinism, no-print): ReplicaServer is the
+# real-TCP process loop — realtime stamps (replica.realtime),
+# drain deadlines, and TB_STATS lines are wall-clock/stdout by
+# design.  The deterministic sim drives VsrReplica through SimBus
+# (testing/cluster.py), never through this module.
 
 from __future__ import annotations
 

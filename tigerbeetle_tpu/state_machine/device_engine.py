@@ -620,9 +620,10 @@ class DeviceEngine:
             return
         try:
             self._prewarm_inner(kinds)
-        except Exception as exc:  # noqa: BLE001
-            # A device that cannot even warm up cannot serve the
-            # window pipeline: degrade instead of dying at setup.
+        # tbcheck: allow(broad-except): ANY prewarm failure (compile
+        # error, tunnel flap, OOM) demotes to the host path via a typed
+        # DeviceLostError — degraded service beats dying at setup.
+        except Exception as exc:
             self._demote(DeviceLostError("prewarm", exc))
 
     def _prewarm_inner(self, kinds) -> None:
@@ -1559,7 +1560,10 @@ class DeviceEngine:
         try:
             self.drain()
             self.flush()
-        except Exception as exc:  # noqa: BLE001 — host replay failed too
+        # tbcheck: allow(broad-except): end-of-life barrier — when even
+        # the host replay fails, every stranded future must still be
+        # terminated with a typed DeviceLostError (never a hang).
+        except Exception as exc:
             for rec in self._recovering + self._launched + self._pending:
                 if rec.future is not None and not rec.future.done():
                     rec.future.fail(DeviceLostError("close", exc))
@@ -1619,9 +1623,10 @@ class DeviceEngine:
             else:
                 self.stat_degraded_events += rec.n
                 fut.resolve(rec.fallback())
-        except Exception as exc:  # noqa: BLE001
-            # The host replay itself failed: fail THIS future with the
-            # real error and keep terminating the rest of the stream.
+        # tbcheck: allow(broad-except): the host replay itself failed —
+        # fail THIS future with the real error and keep terminating the
+        # rest of the stream (one bad record must not strand the rest).
+        except Exception as exc:
             fut.fail(exc)
         finally:
             self._release_bound(rec)
@@ -1678,7 +1683,11 @@ class DeviceEngine:
                     "re-promotion checksum handshake mismatch: "
                     f"device={dev_sum.tolist()} host={host_sum.tolist()}"
                 )
-        except Exception as exc:  # noqa: BLE001
+        # tbcheck: allow(broad-except): re-promotion is opportunistic —
+        # any failure (probe via the classifying _retry, upload, digest
+        # handshake) leaves the engine degraded and counted, never
+        # half-promoted; the next tick retries.
+        except Exception as exc:
             self.state = EngineState.degraded
             self.stat_probe_failures += 1
             self.last_probe_failure = repr(exc)

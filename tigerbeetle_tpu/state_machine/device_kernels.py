@@ -53,7 +53,10 @@ if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
             "jax_compilation_cache_dir",
             os.path.expanduser("~/.cache/tigerbeetle_tpu_xla"),
         )
-    except Exception:  # noqa: BLE001 - cache is an optimization only
+    # tbcheck: allow(broad-except): the XLA compile cache is an
+    # optimization only — any backend rejection means compiles stay
+    # per-process, never an error.
+    except Exception:
         pass
 
 import jax.numpy as jnp

@@ -477,6 +477,15 @@ class TpuStateMachine:
                 self._native = fastpath.NativeFastpath(account_capacity)
                 self._mirror.lo = self._native.lo
                 self._mirror.hi = self._native.hi
+        except envcheck.EnvVarError:
+            # A typo'd knob (TB_NATIVE_SANITIZE=msan) must fail fast
+            # with its named error, not read as "no compiler" — a
+            # silently-unsanitized run is exactly the confusion the
+            # build forensics exist to prevent.
+            raise
+        # tbcheck: allow(broad-except): the native fast path is an
+        # optional accelerator — ANY load/ctypes/ABI failure must fall
+        # back to the pure-Python engines, bit-identically.
         except Exception:
             self._native = None
 

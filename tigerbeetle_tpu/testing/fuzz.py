@@ -11,6 +11,8 @@ The smoke tier runs in CI on every test run (tests/test_fuzzers.py);
 long runs are for soak sessions, mirroring the reference's CFO fleet
 (reference: src/scripts/cfo.zig:1-46).
 """
+# tbcheck: allow-file(no-print): fuzzer entry point — progress and
+# repro lines print to the terminal/CI log by design.
 
 from __future__ import annotations
 

@@ -156,6 +156,8 @@ class Workload:
         self.next_transfer += 1
         flags = (
             types.TransferFlags.balancing_debit
+            # tbcheck: allow(money): seeded-RNG coin flip choosing a
+            # flag — the 0.5 is a probability, not an amount.
             if self.rng.random() < 0.5
             else types.TransferFlags.balancing_credit
         )

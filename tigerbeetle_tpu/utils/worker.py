@@ -63,7 +63,10 @@ class SerialWorker:
                 return
             try:
                 job.fn(*job.args)
-            except BaseException as e:  # surfaced at job.result()
+            # tbcheck: allow(broad-except): the worker thread must
+            # survive any job failure — the exception is stored and
+            # re-raised at job.result() on the submitting thread.
+            except BaseException as e:
                 job._exc = e
             finally:
                 job._done.set()

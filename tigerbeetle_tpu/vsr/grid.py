@@ -34,6 +34,12 @@ assert BLOCK_DTYPE.itemsize == BLOCK_HEADER_SIZE
 
 
 class Grid:
+    # Audited write-write sharing with the grid-write SerialWorker
+    # (tbcheck worker-shared): _write_one (worker) and write_block /
+    # _join_pending (callers) both mutate the _pending_writes
+    # refcounts — every access holds _pending_lock.
+    _WORKER_SHARED = frozenset({"_pending_writes"})
+
     def __init__(self, storage: Storage, *, block_size: int = 1 << 16,
                  block_count: int = 1 << 12, base_offset: int | None = None,
                  cache_blocks: int = 256) -> None:
@@ -153,6 +159,9 @@ class Grid:
         for f in futures:
             try:
                 f.result()
+            # tbcheck: allow(broad-except): join EVERY queued write
+            # before raising — the first error is sticky and re-raised
+            # below; skipping the rest would leak unjoined futures.
             except BaseException as e:
                 if first_exc is None:
                     first_exc = e

@@ -2407,8 +2407,14 @@ class VsrReplica(Replica):
         # spilled snapshot reads the LSM tier to rebuild directories.
         try:
             blob = self._sync_unwrap(payload)
-        except Exception:
-            return  # malformed payload from peer: drop, retry later
+        except (ValueError, KeyError, TypeError):
+            # Malformed sync payload from a peer (SnapshotError is a
+            # ValueError; geometry checks raise ValueError; missing
+            # state keys raise KeyError; type-confused entries — e.g.
+            # `blocks` encoded as an int — raise TypeError in the
+            # len()/int() geometry code): drop it and retry later —
+            # a buggy peer must not crash this replica.
+            return
         self._restore_snapshot(blob)
         self.sm.prepare_timestamp = self.sm.commit_timestamp
 

@@ -61,6 +61,13 @@ class Session:
 
 
 class Replica:
+    # Audited write-write sharing with the ckpt SerialWorker (tbcheck
+    # worker-shared): the async checkpoint flip publishes checkpoint_op
+    # from the worker thread, while open()/recovery set it on the
+    # foreground thread — serialized by the _ckpt_join barrier, which
+    # runs before any foreground read or write of checkpoint state.
+    _WORKER_SHARED = frozenset({"checkpoint_op"})
+
     def __init__(self, storage: Storage, cluster: int, state_machine,
                  replica: int = 0, replica_count: int = 1, aof=None,
                  forest_block_count: int = FOREST_BLOCK_COUNT) -> None:
