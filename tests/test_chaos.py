@@ -342,6 +342,55 @@ def test_chaos_smoke_differential():
     replay_pipelined(h_d, h_c, tail)
 
 
+def test_demote_during_speculation_releases_whole_batch_bound(monkeypatch):
+    """Regression (r18): a speculative record's admission charge is the
+    WHOLE-batch superset — the same bound the wave path charges.  Kill
+    the link with speculative batches in flight (mid-validation): the
+    demotion replays them through the exact host fallback, the bound
+    releases exactly once, and a sibling batch admitted after the
+    replay sees the true mirror headroom — replies stay
+    oracle-identical, nothing over-applies."""
+    monkeypatch.setenv("TB_WAVES_SPECULATE", "force")
+    h_d, h_c, link = mk_chaos_pair()
+    ops = [(Operation.create_accounts, accounts(range(1, 21)))]
+    h_d.submit(*ops[0])
+    h_c.submit(*ops[0])
+    # Fatal loss at the dispatch stage: the speculative step (or
+    # its validation fetch) dies with the record in flight.
+    link.fail_next(stage="dispatch", kind="fatal")
+    mixed = []
+    tid = 100
+    for k in range(6):
+        rows = [
+            dict(id=tid + j, debit_account_id=1 + (k + j) % 20,
+                 credit_account_id=1 + (k + j + 1) % 20,
+                 amount=(1 << 40) + j)
+            for j in range(4)
+        ]
+        tid += 4
+        mixed.append((Operation.create_transfers, transfers(rows)))
+    mixed.append((Operation.lookup_accounts,
+                  hz.ids_bytes(list(range(1, 21)))))
+    replay_pipelined(h_d, h_c, mixed)
+    dev = h_d.sm._dev
+    assert dev.stat_demotions >= 1, "fault never hit a record in flight"
+    assert dev.inflight_bound() == 0, (
+        "speculative record leaked (or double-released) its "
+        "admission bound across the demotion replay"
+    )
+    # Post-heal batches must re-admit against the true mirror state.
+    link.heal()
+    tail = [
+        (Operation.create_transfers, transfers(
+            [dict(id=900 + k, debit_account_id=1 + k,
+                  credit_account_id=2 + k, amount=7)]))
+        for k in range(4)
+    ]
+    replay_pipelined(h_d, h_c, tail)
+    assert dev.inflight_bound() == 0
+    h_d.sm.verify_device_mirror()
+
+
 def test_vopr_device_loss_nemesis():
     """Whole-cluster VOPR with the device-loss nemesis: replicas run
     the device engine behind seeded chaos links that die and heal at

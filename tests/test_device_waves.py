@@ -245,8 +245,12 @@ def test_two_phase_stream_waves_in_window(monkeypatch):
     sm = h_d.sm
     assert sm.stat_dev_wave_batches == 6, "wave dispatch did not engage"
     assert sm.stat_host_semantic_events == 0, "batch drained to the host"
-    assert sm.stat_dev_wave_steps <= 2 * sm.stat_dev_wave_batches, (
-        f"{sm.stat_dev_wave_steps} steps for {sm.stat_dev_wave_batches} "
+    # Steps live on either side of the r18 speculation split: wave-plan
+    # steps in dev_wave.steps, speculative + residue steps in
+    # dev_wave.spec.steps — combined, pairs still collapse to <=2.
+    steps = sm.stat_dev_wave_steps + sm._dev.spec_stats["steps"].value
+    assert steps <= 2 * sm.stat_dev_wave_batches, (
+        f"{steps} steps for {sm.stat_dev_wave_batches} "
         "batches — two_phase pairs must collapse to <=2 waves"
     )
     sm.verify_device_mirror()

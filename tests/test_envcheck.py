@@ -200,6 +200,36 @@ def test_tb_waves_chain_max_validated(monkeypatch):
     assert waves.chain_max() == 64
 
 
+def test_tb_waves_speculate_validated(monkeypatch):
+    monkeypatch.setenv("TB_WAVES_SPECULATE", "maybe")
+    with pytest.raises(envcheck.EnvVarError, match="TB_WAVES_SPECULATE"):
+        waves.spec_mode()
+    for legal in ("auto", "0", "1", "force"):
+        monkeypatch.setenv("TB_WAVES_SPECULATE", legal)
+        assert waves.spec_mode() == legal
+    monkeypatch.delenv("TB_WAVES_SPECULATE")
+    assert waves.spec_mode() == "auto"
+
+
+def test_tb_waves_spec_residue_cap_validated(monkeypatch):
+    monkeypatch.setenv("TB_WAVES_SPEC_RESIDUE_CAP", "some")
+    with pytest.raises(
+        envcheck.EnvVarError, match="TB_WAVES_SPEC_RESIDUE_CAP"
+    ):
+        waves.spec_residue_cap()
+    monkeypatch.setenv("TB_WAVES_SPEC_RESIDUE_CAP", "-0.1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        waves.spec_residue_cap()
+    # Named constraint: the cap is a FRACTION of the batch.
+    monkeypatch.setenv("TB_WAVES_SPEC_RESIDUE_CAP", "1.5")
+    with pytest.raises(envcheck.EnvVarError, match="fraction of the batch"):
+        waves.spec_residue_cap()
+    monkeypatch.setenv("TB_WAVES_SPEC_RESIDUE_CAP", "0.5")
+    assert waves.spec_residue_cap() == 0.5
+    monkeypatch.delenv("TB_WAVES_SPEC_RESIDUE_CAP")
+    assert waves.spec_residue_cap() == 0.25
+
+
 def test_env_float_minimum(monkeypatch):
     monkeypatch.setenv("TB_DEV_BACKOFF_MS", "-1")
     with pytest.raises(envcheck.EnvVarError, match="TB_DEV_BACKOFF_MS"):

@@ -542,6 +542,93 @@ def test_tb_metrics_env_plumbs_to_state_machine(monkeypatch):
     assert sm.metrics.enabled
 
 
+def _drive_speculative_batches(monkeypatch):
+    """One fresh-id stream forced through the speculative dispatcher;
+    returns the machine after every future resolved."""
+    from tigerbeetle_tpu.types import Operation
+
+    monkeypatch.setattr(de, "_WINDOW", 2)
+    monkeypatch.setenv("TB_WAVES_SPECULATE", "force")
+    sm = TpuStateMachine(engine="device", account_capacity=(1 << 10) + 1)
+    h = hz.SingleNodeHarness(sm)
+    h.submit(
+        Operation.create_accounts,
+        hz.pack([hz.account(i) for i in range(1, 9)]),
+    )
+    futs = []
+    for k in range(4):
+        rows = [
+            hz.transfer(100 + 4 * k + j, debit_account_id=1 + j,
+                        credit_account_id=5 + j, amount=1 + j)
+            for j in range(4)
+        ]
+        futs.append(h.submit_async(Operation.create_transfers, hz.pack(rows)))
+    for f in futs:
+        f.result()
+    sm.sync()
+    return sm
+
+
+def test_spec_counters_in_registry_and_metrics_off_noop(monkeypatch):
+    """dev_wave.spec.* rides the machine registry (the stats scrape and
+    flight postmortem read the same snapshot): counters tick under
+    TB_METRICS=1 with the validation histogram populated; under
+    TB_METRICS=0 the histogram is the shared no-op (no clock-derived
+    samples in the snapshot) while the routing counters stay live —
+    bench accounting depends on them."""
+    monkeypatch.setenv("TB_METRICS", "1")
+    sm = _drive_speculative_batches(monkeypatch)
+    snap = sm.metrics.snapshot()
+    assert snap["dev_wave.spec.attempts"] == 4
+    assert snap["dev_wave.spec.hits"] == 4
+    assert snap["dev_wave.spec.plan_skipped"] == 4
+    assert snap["dev_wave.spec.steps"] == 4
+    assert snap["dev_wave.spec.validation_us.count"] == 4
+
+    monkeypatch.setenv("TB_METRICS", "0")
+    sm0 = _drive_speculative_batches(monkeypatch)
+    assert not sm0.metrics.enabled
+    hist = sm0._dev.spec_stats["validation_us"]
+    assert hist is obs.Registry(enabled=False).histogram("x_us"), (
+        "TB_METRICS=0 must hand the spec path the shared no-op histogram"
+    )
+    snap0 = sm0.metrics.snapshot()
+    assert snap0["dev_wave.spec.attempts"] == 4  # counters stay live
+    assert snap0["dev_wave.spec.hits"] == 4
+    assert "dev_wave.spec.validation_us.count" not in snap0
+
+
+def test_flight_dump_embeds_stats_snapshot(tmp_path):
+    """A flight recorder wired with a stats provider embeds the full
+    registry snapshot in every dump's otherData — the demotion
+    postmortem carries the dev_wave.spec.* / link counters that
+    explain it — and a provider failure degrades to a recorded error,
+    never a voided postmortem (dumps run inside signal handlers)."""
+    from tigerbeetle_tpu.obs.flight import FlightRecorder
+
+    reg = obs.Registry(enabled=True)
+    reg.counter("dev_wave.spec.attempts").inc(3)
+    fr = FlightRecorder(capacity=8, stats_fn=reg.snapshot)
+    fr.note("device_demoted", error="boom")
+    dump = fr.dump(reason="test")
+    assert dump["otherData"]["stats"]["dev_wave.spec.attempts"] == 3
+    path = tmp_path / "flight.json"
+    fr.write(str(path))
+    assert json.load(open(path))["otherData"]["stats"][
+        "dev_wave.spec.attempts"
+    ] == 3
+
+    def bad_stats():
+        raise RuntimeError("registry gone")
+
+    fr2 = FlightRecorder(capacity=8, stats_fn=bad_stats)
+    fr2.note("assertion_failure")
+    dump2 = fr2.dump()
+    assert "stats" not in dump2["otherData"]
+    assert "registry gone" in dump2["otherData"]["stats_error"]
+    assert len(dump2["traceEvents"]) == 1  # the ring survived
+
+
 def test_tb_trace_env_selects_backend(monkeypatch):
     monkeypatch.setenv("TB_TRACE", "json")
     assert Tracer.from_env(3).enabled

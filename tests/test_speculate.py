@@ -1,0 +1,449 @@
+"""Optimistic wave execution (round 18): speculate on device, validate
+on device, re-execute only the residue.
+
+Four layers:
+
+1. Validator microtests: the on-device conflict detection
+   (waves._spec_conflicts) against the partitioner's round-0 level
+   assignment over fuzzed metadata, and a hand-built conflicting batch
+   pinning the PREFIX-COMMIT rule — an event commits iff no earlier
+   event in the batch conflicts with it, so an unconflicted event
+   AFTER a conflicted one still commits while the conflicted set (not
+   a positional suffix) replays.
+2. Acceptance shapes: fresh-id batches forced through speculation
+   execute in exactly ONE speculative device step with the partitioner
+   never running (plan_skipped == hits == batches); in-batch
+   pending/finalize pairs miss validation and replay their finalizers
+   as a one-wave residue (2 steps/batch).
+3. Forced-optimistic vs pessimistic-waves vs CPU-oracle differential
+   fuzz over full device-engine windows (duplicate ids,
+   pending/post/void, linked rollback, grow/remove interleavings,
+   timeouts): replies, result codes, and the authoritative table
+   digest must be byte-identical across every arm.
+4. A chaos smoke with speculation forced on: demote / degraded-serve /
+   re-promote keeps every reply oracle-identical — speculative records
+   replay through their exact host fallback like any other record.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import tigerbeetle_tpu.state_machine.device_engine as de
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.state_machine import resolve, waves
+from tigerbeetle_tpu.state_machine.cpu import CpuStateMachine
+from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine
+from tigerbeetle_tpu.testing import harness as hz
+from tigerbeetle_tpu.testing.chaos import ChaosLink
+from tigerbeetle_tpu.types import EngineState, Operation, TransferFlags
+
+from test_device_waves import (  # noqa: E402 — shared fuzz fixtures
+    _fuzz_stream,
+    _pv_balancing_batch,
+    accounts,
+    mk_pair,
+    replay_both,
+)
+
+TF = TransferFlags
+AF = types.AccountFlags
+
+
+def spec_counters(sm) -> dict:
+    return {
+        name: handle.value
+        for name, handle in sm._dev.spec_stats.items()
+        if hasattr(handle, "value")
+    }
+
+
+# ---------------------------------------------------------------------------
+# Validator: on-device conflict flags vs the partitioner's round 0.
+
+
+def _ev_from_meta(n, meta, p_found):
+    """Minimal (B,)-shaped event dict feeding _spec_conflicts: the
+    validator reads only the conflict-token columns."""
+    K = 1 << max(3, (n - 1).bit_length())
+    flags = np.zeros(K, np.uint32)
+    flags[:n] = np.where(meta["is_pv"], np.uint32(TF.post_pending_transfer), 0)
+
+    def pad(a, fill=0, dtype=None):
+        out = np.full(K, fill, dtype or np.asarray(a).dtype)
+        out[:n] = a
+        return out
+
+    ev = {
+        "i": np.arange(K, dtype=np.int32),
+        "flags": flags,
+        "id_group": pad(meta["id_group"].astype(np.int32)),
+        "p_group": pad(meta["p_group"].astype(np.int32), fill=-1),
+        "p_tgt": pad(meta["p_tgt"].astype(np.int32), fill=-1),
+        "p_found": pad(p_found),
+        "dr_slot": pad(meta["ev_dr"].astype(np.int32), fill=-1),
+        "cr_slot": pad(meta["ev_cr"].astype(np.int32), fill=-1),
+        # Reads in the metadata came from balancing/limit columns;
+        # reconstruct equivalent flag columns: a read on the dr side
+        # becomes a balancing_debit flag, on the cr side a limit flag.
+        "dr_flags": pad(np.zeros(n, np.uint32)),
+        "cr_flags": pad(
+            np.where(meta["reads1"] >= 0,
+                     np.uint32(AF.credits_must_not_exceed_debits), 0)
+        ),
+        "p_dr_slot": pad(
+            np.where(p_found, meta["writes0"], -1).astype(np.int32),
+            fill=-1,
+        ),
+        "p_cr_slot": pad(
+            np.where(p_found, meta["writes1"], -1).astype(np.int32),
+            fill=-1,
+        ),
+    }
+    ev["flags"][:n] |= np.where(
+        meta["reads0"] >= 0, np.uint32(TF.balancing_debit), 0
+    )
+    return ev, K
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_spec_conflicts_match_partitioner_round0(seed):
+    """For chain-free batches without in-batch finalizers, the
+    on-device validator's conflict set must equal the partitioner's
+    non-level-0 set exactly: both are the same round-0 blocked test
+    over the same tokens.  (In-batch finalizers are excluded from the
+    EQUALITY claim only: their widened static write set makes the
+    partitioner strictly more conservative than the validator needs
+    to be — see _spec_conflicts' docstring.)"""
+    rng = np.random.default_rng(5000 + seed)
+    for _ in range(6):
+        n = int(rng.integers(2, 100))
+        flags = np.zeros(n, np.uint32)
+        flags[rng.random(n) < 0.1] |= int(TF.balancing_debit)
+        pv = rng.random(n) < 0.25
+        flags[pv] |= int(TF.post_pending_transfer)
+        p_found = pv & (rng.random(n) < 0.6)
+        p_tgt = np.where(
+            p_found, rng.integers(0, max(1, n // 3), n), -1
+        ).astype(np.int32)
+        cr_flags = np.where(
+            rng.random(n) < 0.15,
+            np.uint32(AF.credits_must_not_exceed_debits), np.uint32(0),
+        )
+        meta = resolve.wave_dependency_metadata(
+            n, flags,
+            rng.integers(0, 6, n).astype(np.int64),
+            rng.integers(6, 12, n).astype(np.int64),
+            np.zeros(n, np.uint32), cr_flags,
+            rng.integers(0, max(1, n // 2), n).astype(np.int64),
+            np.full(n, -1, np.int32),  # no in-batch finalizers
+            p_tgt, p_found,
+            np.where(p_found, rng.integers(0, 6, n), -1).astype(np.int64),
+            np.where(p_found, rng.integers(6, 12, n), -1).astype(np.int64),
+        )
+        assert not meta["chain_member"].any()
+        ev, K = _ev_from_meta(n, meta, p_found)
+        conflicted = np.asarray(
+            waves._spec_conflicts(
+                {k: jnp.asarray(v) for k, v in ev.items()},
+                jnp.zeros(K, bool), jnp.int32(n), 16, K,
+            )
+        )[:n]
+        plan = waves.plan_waves(n, meta, use_walk=True)
+        level0 = np.zeros(n, bool)
+        kind0, idx0 = plan.segments[0]
+        assert kind0 == "wave"
+        level0[idx0] = True
+        assert np.array_equal(conflicted, ~level0), (
+            f"seed {seed}: validator disagrees with round-0 levels"
+        )
+
+
+def test_prefix_commit_rule_hand_built(monkeypatch):
+    """The prefix-commit rule on a hand-built conflicting batch:
+
+      e0: create pending t=100            -> commits (no earlier conflict)
+      e1: post pending_id=100 (in-batch)  -> CONFLICTED (e0's id claim)
+      e2: independent create              -> commits DESPITE following a
+                                             conflicted event (commuting)
+      e3: duplicate id of e0              -> CONFLICTED (same id group)
+      e4: independent create              -> commits
+
+    The committable set is the non-conflicted set, NOT the positional
+    prefix before the first conflict — e2/e4 must not replay.  Replies
+    stay oracle-identical and the residue counters expose exactly the
+    two conflicted events."""
+    monkeypatch.setattr(de, "_WINDOW", 1)
+    monkeypatch.setenv("TB_WAVES_SPECULATE", "force")
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts(range(1, 9)))]
+    rows = [
+        hz.transfer(100, debit_account_id=1, credit_account_id=2,
+                    amount=10, flags=int(TF.pending)),
+        hz.transfer(101, amount=0, pending_id=100,
+                    flags=int(TF.post_pending_transfer)),
+        hz.transfer(102, debit_account_id=3, credit_account_id=4,
+                    amount=7),
+        hz.transfer(100, debit_account_id=5, credit_account_id=6,
+                    amount=3),  # duplicate id -> exists ladder
+        hz.transfer(103, debit_account_id=7, credit_account_id=8,
+                    amount=5),
+    ]
+    ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 9)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    st = spec_counters(sm)
+    assert st["attempts"] >= 1
+    assert st["hits"] == 0, "the batch genuinely conflicts"
+    # Exactly e1 (in-batch finalizer) and e3 (duplicate id) replay.
+    assert st["residue_events"] == 2, st
+    # One speculative step + a one-wave residue (e1 and e3 conflict
+    # with e0, not each other... e3 shares e0/e1's id group, so the
+    # residue serializes e1 before e3: two waves).
+    assert st["steps"] <= 1 + 2, st
+    sm.verify_device_mirror()
+
+
+def test_spec_record_codec_roundtrip():
+    """The sibling speculative-record codec is lossless: event dict,
+    dstat seed, and serial mask round-trip bit-for-bit."""
+    from test_device_waves import _random_event_dict
+
+    rng = np.random.default_rng(77)
+    n, B = 37, 64
+    ev = _random_event_dict(rng, n, B)
+    dstat = np.zeros(B, np.uint32)
+    dstat[:3] = 2
+    serial = rng.random(n) < 0.3
+    pk = waves.pack_spec_record(ev, dstat, serial, n)
+    ev2, dstat2, serial2 = waves.unpack_spec_record(pk)
+    for name, arr in ev.items():
+        assert np.array_equal(ev2[name], arr), name
+        assert ev2[name].dtype == arr.dtype, name
+    assert np.array_equal(dstat2, dstat)
+    assert np.array_equal(serial2[:n], serial)
+    assert not serial2[n:].any()
+    assert pk.nbytes < pk.padded_nbytes
+
+
+# ---------------------------------------------------------------------------
+# Acceptance shapes.
+
+
+def test_fresh_batches_hit_in_one_step(monkeypatch):
+    """Fresh-unique-id batches forced through speculation: every batch
+    validates conflict-free and executes in exactly ONE speculative
+    device step; the partitioner never runs (plan_skipped == hits ==
+    attempts == batches)."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    monkeypatch.setenv("TB_WAVES_SPECULATE", "force")
+    rng = np.random.default_rng(11)
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts(range(1, 41)))]
+    tid = 100
+    for _ in range(6):
+        rows = []
+        for _k in range(16):
+            a, b = rng.choice(np.arange(1, 41), 2, replace=False)
+            rows.append(
+                hz.transfer(tid, debit_account_id=int(a),
+                            credit_account_id=int(b),
+                            amount=int(rng.integers(1, 90)))
+            )
+            tid += 1
+        ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 41)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    st = spec_counters(sm)
+    assert st["attempts"] == 6, st
+    assert st["hits"] == 6, "fresh batches must validate conflict-free"
+    assert st["plan_skipped"] == 6, "partitioner ran on the hit path"
+    assert st["steps"] == 6, "hit batches must cost ONE device step"
+    assert st["residue_events"] == 0
+    assert sm.stat_host_semantic_events == 0
+    sm.verify_device_mirror()
+
+
+def test_two_phase_pairs_replay_finalizer_residue(monkeypatch):
+    """In-batch (pending, post) pairs: the pendings commit
+    speculatively, every post conflicts on its in-batch reference and
+    replays as a ONE-WAVE residue — 2 device steps per batch, with
+    first-wins/program-order semantics pinned by the oracle replies."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    rng = np.random.default_rng(7)
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts(range(1, 47)))]
+    accs = np.arange(1, 41)
+    tid = 100
+    for _ in range(6):
+        rows, tid = _pv_balancing_batch(
+            tid, accs, rng, bal_accs=list(range(41, 47))
+        )
+        ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 47)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    st = spec_counters(sm)
+    assert st["attempts"] == 6
+    assert st["hits"] == 0
+    assert st["residue_events"] == 6 * 6, "exactly the finalizers replay"
+    assert st["steps"] == 6 * 2, (
+        "each miss must cost one speculative step + a one-wave residue"
+    )
+    assert sm.stat_host_semantic_events == 0
+    sm.verify_device_mirror()
+
+
+def test_residue_cap_gate_skips_serial_batches(monkeypatch):
+    """Chain-dominated batches are KNOWN residue up front: the auto
+    gate must skip speculation (no wasted step) and route them through
+    the pessimistic wave plan — chain waves, ~max_chain_len steps."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts(range(1, 101)))]
+    tid = 100
+    rows = []
+    for c in range(16):
+        for j in range(3):
+            f = int(TF.linked) if j < 2 else 0
+            if j == 0:
+                f |= int(TF.pending)
+            rows.append(
+                hz.transfer(tid, debit_account_id=1 + 2 * c,
+                            credit_account_id=2 + 2 * c,
+                            amount=3 + j, flags=f)
+            )
+            tid += 1
+    ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 101)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    st = spec_counters(sm)
+    assert st["attempts"] == 0, "the residue-cap gate must not speculate"
+    assert sm.stat_dev_wave_batches == 1
+    assert sm.stat_dev_wave_steps == 8  # chain-wave position bucket
+    sm.verify_device_mirror()
+
+
+def test_forced_mode_replays_chain_residue(monkeypatch):
+    """TB_WAVES_SPECULATE=force takes even known-serial batches: the
+    whole chain batch conflicts, and the residue replays through chain
+    waves with full-batch claim counts — replies oracle-identical, a
+    failing chain still rolls back."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    monkeypatch.setenv("TB_WAVES_SPECULATE", "force")
+    h_d, h_c = mk_pair()
+    ops = [(Operation.create_accounts, accounts(range(1, 41)))]
+    rows = []
+    tid = 100
+    for c in range(8):
+        for j in range(3):
+            f = int(TF.linked) if j < 2 else 0
+            if j == 0:
+                f |= int(TF.pending)
+            dr, cr = 1 + 2 * c, 2 + 2 * c
+            if c == 3 and j == 1:
+                cr = dr  # accounts_must_be_different -> chain fails
+            rows.append(
+                hz.transfer(tid, debit_account_id=dr,
+                            credit_account_id=cr, amount=3 + j, flags=f)
+            )
+            tid += 1
+    ops.append((Operation.create_transfers, hz.pack(rows)))
+    ops.append((Operation.lookup_accounts, hz.ids_bytes(list(range(1, 41)))))
+    replay_both(h_d, h_c, ops)
+    sm = h_d.sm
+    st = spec_counters(sm)
+    assert st["attempts"] == 1
+    assert st["hits"] == 0
+    assert st["residue_events"] == 24, "every chain member replays"
+    sm.verify_device_mirror()
+
+
+# ---------------------------------------------------------------------------
+# Forced-optimistic vs pessimistic waves vs CPU oracle.
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_forced_optimistic_differential(monkeypatch, seed):
+    """Three arms over the SAME fuzz stream — speculation forced on
+    everything, speculation off (pessimistic wave plans), and the
+    default auto gate — must agree byte-for-byte on every reply AND on
+    the authoritative table digest with the CPU oracle: speculation is
+    an execution strategy, never a semantics change.  The stream mixes
+    duplicate ids, pending/post/void, linked rollback, timeouts, and
+    grow/remove interleavings (test_device_waves._fuzz_stream)."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    replies = {}
+    tables = {}
+    for mode in ("force", "0", "auto"):
+        monkeypatch.setenv("TB_WAVES_SPECULATE", mode)
+        rng = np.random.default_rng(seed)
+        sm = TpuStateMachine(engine="device", account_capacity=65)
+        h = hz.SingleNodeHarness(sm)
+        ops = _fuzz_stream(rng)
+        futs = [h.submit_async(op, body) for op, body in ops]
+        replies[mode] = [f.result() for f in futs]
+        sm.verify_device_mirror()
+        tables[mode] = np.asarray(sm._dev.checksum())
+        st = spec_counters(sm)
+        if mode == "force":
+            assert st["attempts"] > 0, "fuzz never speculated: vacuous"
+            assert st["hits"] > 0, "no batch validated clean: weak fuzz"
+            assert st["residue_events"] > 0, "no residue replayed"
+        elif mode == "0":
+            assert st["attempts"] == 0
+        del sm, h
+    rng = np.random.default_rng(seed)
+    h_c = hz.SingleNodeHarness(CpuStateMachine())
+    replies_c = [h_c.submit(op, body) for op, body in _fuzz_stream(rng)]
+    for arm in ("force", "0", "auto"):
+        for i, (a, b) in enumerate(zip(replies[arm], replies_c)):
+            assert a == b, (
+                f"seed {seed}: reply {i} diverges ({arm} vs CPU oracle)"
+            )
+    assert (tables["force"] == tables["0"]).all()
+    assert (tables["auto"] == tables["0"]).all()
+
+
+def test_chaos_smoke_with_speculation_on(monkeypatch):
+    """Probabilistic link chaos with speculation forced on: demote /
+    degraded-serve / re-promote must keep every reply oracle-identical
+    — speculative records replay through their exact host fallback
+    like any other in-flight record, and no in-flight bound leaks."""
+    monkeypatch.setattr(de, "_WINDOW", 4)
+    monkeypatch.setattr(de, "_BACKOFF_MS", 0.0)
+    monkeypatch.setattr(de, "_PROBE_EVERY", 2)
+    monkeypatch.setenv("TB_WAVES_SPECULATE", "force")
+    rng = np.random.default_rng(5)
+    link = ChaosLink(seed=17, p_transient=0.05, p_fatal=0.0, p_kill=0.0)
+    sm_d = TpuStateMachine(
+        engine="device", account_capacity=(1 << 10) + 1, device_link=link
+    )
+    h_d = hz.SingleNodeHarness(sm_d)
+    h_c = hz.SingleNodeHarness(CpuStateMachine())
+    ops = _fuzz_stream(rng, n_accts=40)
+    futs = []
+    for k, (op, body) in enumerate(ops):
+        if k in (len(ops) // 3, 2 * len(ops) // 3):
+            link.fail_next(kind="fatal")
+        futs.append(h_d.submit_async(op, body))
+    replies_d = [f.result() for f in futs]
+    for f in futs:
+        assert f.done()
+    replies_c = [h_c.submit(op, body) for op, body in ops]
+    mismatches = [
+        i for i, (a, b) in enumerate(zip(replies_d, replies_c)) if a != b
+    ]
+    assert not mismatches, f"replies diverge at {mismatches[:5]}"
+    dev = sm_d._dev
+    assert dev.stat_demotions >= 1, "chaos never demoted: weak smoke"
+    assert dev.inflight_bound() == 0, "in-flight bound leaked"
+    link.heal()
+    link.p_transient = link.p_fatal = link.p_kill = 0.0
+    assert dev.try_repromote()
+    assert dev.state is EngineState.healthy
+    sm_d.verify_device_mirror()

@@ -226,6 +226,53 @@ def open_loop_read_pct() -> float:
 
 
 # ----------------------------------------------------------------------
+# Optimistic wave execution (state_machine/waves.py; round 18).
+
+
+def waves_speculate() -> str:
+    """TB_WAVES_SPECULATE: speculative (optimistic) execution mode for
+    the device wave dispatcher (tpu._try_submit_device_waves):
+
+    - "auto" (default): off-kernel window batches execute the WHOLE
+      batch as one speculative device step, validate read-write
+      conflicts on device, and replay only the conflicted residue
+      through the wave plan — unless the host already knows too much
+      of the batch must replay (the TB_WAVES_SPEC_RESIDUE_CAP gate).
+    - "0": off — every admitted batch plans waves up front (the r8
+      pessimistic path, the differential control arm).
+    - "1": on — like auto, with the residue-cap gate still applied.
+    - "force": forced-optimistic — route EVERY window batch (including
+      shapes the semantic kernels could serve) through speculation and
+      attempt it regardless of the residue gate.  Differential-test /
+      bench routing: maximizes speculative-path coverage.
+    """
+    return env_choice(
+        "TB_WAVES_SPECULATE", "auto", ("auto", "0", "1", "force")
+    )
+
+
+def spec_residue_cap() -> float:
+    """TB_WAVES_SPEC_RESIDUE_CAP: fraction of a batch that may already
+    be KNOWN host-side to need residue replay (linked-chain members,
+    history-account events, serialized post/voids) before speculation
+    is skipped and the batch plans waves up front.  A speculative miss
+    still pays the full speculative step before replaying, so a batch
+    that is mostly known-residue would speculate at a guaranteed loss.
+
+    Named constraint: must be <= 1 — the cap is a fraction of the
+    batch; a value above 1 could never bind and would silently
+    misrepresent the gate the operator configured."""
+    value = env_float("TB_WAVES_SPEC_RESIDUE_CAP", 0.25, minimum=0.0)
+    if value > 1.0:
+        _fail(
+            "TB_WAVES_SPEC_RESIDUE_CAP", str(value),
+            "must be <= 1 — the cap is a fraction of the batch and a "
+            "larger value can never bind",
+        )
+    return value
+
+
+# ----------------------------------------------------------------------
 # Incremental state commitments (state_machine/commitment.py).
 
 

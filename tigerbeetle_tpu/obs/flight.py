@@ -32,7 +32,7 @@ TRIGGER_EVENTS = frozenset({"device_demoted", "assertion_failure"})
 class FlightRecorder:
     def __init__(self, capacity: int | None = None, *, process_id: int = 0,
                  dump_path: str | None = None,
-                 clock=time.perf_counter_ns) -> None:
+                 clock=time.perf_counter_ns, stats_fn=None) -> None:
         if capacity is None:
             from tigerbeetle_tpu import envcheck
 
@@ -42,6 +42,11 @@ class FlightRecorder:
         self.process_id = process_id
         self.dump_path = dump_path
         self.clock = clock
+        # Registry-snapshot provider (owner-wired, e.g. the server's
+        # `lambda: registry.snapshot()`): every dump then embeds the
+        # counters alongside the event ring, so a demotion postmortem
+        # carries the dev_wave.spec.* / link forensics that explain it.
+        self.stats_fn = stats_fn
         self._ring: collections.deque[tuple] = collections.deque(
             maxlen=capacity
         )
@@ -75,14 +80,24 @@ class FlightRecorder:
         return out
 
     def dump(self, reason: str = "on_demand") -> dict:
+        other = {
+            "flight_recorder": True,
+            "reason": reason,
+            "dropped_events": self.dropped,
+            "capacity": self.capacity,
+        }
+        if self.stats_fn is not None:
+            try:
+                other["stats"] = self.stats_fn()
+            # tbcheck: allow(broad-except): the dump may run inside a
+            # signal handler — a stats-provider failure records its
+            # error in place of the snapshot, never voids the
+            # postmortem.
+            except Exception as exc:
+                other["stats_error"] = repr(exc)[:200]
         return {
             "traceEvents": self.events(),
-            "otherData": {
-                "flight_recorder": True,
-                "reason": reason,
-                "dropped_events": self.dropped,
-                "capacity": self.capacity,
-            },
+            "otherData": other,
         }
 
     def write(self, path: str, reason: str = "on_demand") -> None:

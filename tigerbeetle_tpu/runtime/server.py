@@ -214,7 +214,12 @@ class ReplicaServer:
             flight_path = f"{root}.r{replica_index}{ext}"
         self._flight_path = flight_path
         self.flight = FlightRecorder(
-            process_id=replica_index, dump_path=flight_path
+            process_id=replica_index, dump_path=flight_path,
+            # Late-bound: every flight dump embeds the full registry
+            # snapshot (dev_wave.spec.*, link forensics, QoS counters)
+            # next to the event ring — the postmortem carries the
+            # numbers that explain it.
+            stats_fn=lambda: self.registry.snapshot(),
         )
         # The tracer now exists unconditionally: backend "json" only
         # when a trace path is configured (spans cost nothing on

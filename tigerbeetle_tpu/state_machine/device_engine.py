@@ -307,6 +307,32 @@ class _InFlight:
         self.bound = bound
 
 
+# Speculative-execution forensics (ISSUE r18): counters named
+# dev_wave.spec.* so the owning state machine's registry (and the
+# stats-op scrape / flight postmortem built from it) shows them next
+# to the dev_wave.* routing stats.  Standalone engines lazily build
+# them on their private registry under the same names; the owning
+# machine binds machine-registry handles right after construction.
+_SPEC_COUNTER_NAMES = (
+    "attempts",        # speculative launches dispatched
+    "hits",            # batches validated conflict-free (1 device step)
+    "plan_skipped",    # partitioner runs avoided (== hits by design)
+    "residue_events",  # events replayed through a residue plan
+    "steps",           # device-step equivalents incl. residue plans
+    "validation_s",    # wall time: speculative dispatch + flags fetch
+    "residue_plan_s",  # wall time: plan_residue on misses
+)
+
+
+def make_spec_stats(registry) -> dict:
+    st = {
+        name: registry.counter("dev_wave.spec." + name)
+        for name in _SPEC_COUNTER_NAMES
+    }
+    st["validation_us"] = registry.histogram("dev_wave.spec.validation_us")
+    return st
+
+
 _KERNELS = {
     "orderfree": dk.orderfree,
     "orderfree_lo": dk.orderfree_lo,
@@ -523,6 +549,11 @@ class DeviceEngine:
         # Sum of in-flight records' contribution bounds (wave admission
         # accounts for batches the mirror has not materialized yet).
         self._inflight_bound = 0
+        # dev_wave.spec.* handles: the owning state machine binds
+        # machine-registry counters right after construction (and
+        # after restore); standalone engines build them lazily on the
+        # private registry at first speculative launch.
+        self.spec_stats: dict | None = None
         # Degraded-mode read() cache: (mirror version, capacity) ->
         # CPU-placed (capacity, 8) table handle.
         self._degraded_cache = None
@@ -637,13 +668,18 @@ class DeviceEngine:
                 # Row-sharded engine: the window launch dispatches the
                 # SPMD executors — warm those against this mesh so
                 # sharded wave dispatch never first-compiles inside a
-                # timed window.
+                # timed window.  (Speculation declines on sharded
+                # engines, so no spec warm here.)
                 _waves.prewarm(self.capacity, mesh=mesh)
             else:
                 # The window launch dispatches the NON-DONATING twins
                 # (separate XLA executables) — warm those too so wave
-                # dispatch never first-compiles inside a timed window.
-                _waves.prewarm(self.capacity, engine=True)
+                # dispatch never first-compiles inside a timed window;
+                # the speculative executor rides along unless disabled.
+                _waves.prewarm(
+                    self.capacity, engine=True,
+                    spec=_waves.spec_mode() != "0",
+                )
         if self._commit_enabled and self.dev_row_hash is not None:
             # Compile the digest-update kernel's smallest slot bucket
             # (every launch dispatches it) off the timed path.  An
@@ -837,12 +873,52 @@ class DeviceEngine:
         from tigerbeetle_tpu.state_machine import waves as _waves
 
         packed = _waves.pack_wave_record(ev, dstat_init, hist_fix, n)
+        return self._submit_wave_like(
+            "waves", packed, plan, n, ts_base, finish, fallback,
+            id_keys, bound,
+        )
+
+    def submit_speculative(self, ev, dstat_init, n, ts_base, spec_serial,
+                           pv_serial, finish, fallback, id_keys=None,
+                           bound=0) -> ReplyFuture:
+        """Queue one SPECULATIVE batch: no wave plan exists yet — at
+        launch the ENTIRE batch executes as one validated device step
+        (waves.run_speculative_engine) and only a conflicted residue
+        replays through plan_waves (waves.plan_residue), so the
+        partitioner runs exactly when validation fails.
+
+        Everything else about the record is a wave record: the compact
+        columnar codec (waves.pack_spec_record), the hazard-probe id
+        keys, exact recovery (no failure flag — admission proved the
+        overflow bound, so the fetched packed output always resolves),
+        and the degraded-mode host fallback.  `bound` MUST be the
+        whole-batch superset the wave path would charge — NOT the
+        committed subset: a demotion mid-speculation replays the whole
+        batch through the exact host fallback, and a smaller charge
+        would let a sibling admission plan against headroom that
+        replay then consumes (over-apply).  `pv_serial` records the
+        submit-time routing fact (a pending target may sit on a
+        history account) the residue planner must reuse."""
+        from tigerbeetle_tpu.state_machine import waves as _waves
+
+        packed = _waves.pack_spec_record(ev, dstat_init, spec_serial, n)
+        return self._submit_wave_like(
+            "spec", packed, bool(pv_serial), n, ts_base, finish,
+            fallback, id_keys, bound,
+        )
+
+    def _submit_wave_like(self, kind, packed, extra, n, ts_base, finish,
+                          fallback, id_keys, bound) -> ReplyFuture:
+        """The shared tail of wave/speculative submission: one compact
+        record on the stream + the pending-window memory peaks.
+        `extra` is the kind's launch payload (the WavePlan for a wave
+        record, the pv_serial routing fact for a speculative one)."""
         fut = self._submit_record(
             n, fallback,
             lambda f: _InFlight(
-                "waves", f, finish, n=n, ts_base=ts_base,
+                kind, f, finish, n=n, ts_base=ts_base,
                 fallback=fallback, id_keys=id_keys, bound=bound,
-                wave_args=(packed, plan),
+                wave_args=(packed, extra),
             ),
         )
         compact, padded = self.pending_window_bytes()
@@ -854,13 +930,19 @@ class DeviceEngine:
         )
         return fut
 
+    def _spec_st(self) -> dict:
+        st = self.spec_stats
+        if st is None:
+            st = self.spec_stats = make_spec_stats(self.metrics)
+        return st
+
     def pending_window_bytes(self) -> tuple:
         """(compact, padded) host bytes retained by queued/in-flight
         wave records — what the window actually holds vs what the old
         padded event dicts would have held."""
         compact = padded = 0
         for rec in self._pending + self._launched + self._recovering:
-            if rec.kind == "waves" and rec.wave_args is not None:
+            if rec.kind in ("waves", "spec") and rec.wave_args is not None:
                 pk = rec.wave_args[0]
                 compact += pk.nbytes
                 padded += pk.padded_nbytes
@@ -1096,6 +1178,9 @@ class DeviceEngine:
             if ukind == "waves":
                 self._exec_waves(urecs[0])
                 continue
+            if ukind == "spec":
+                self._exec_spec(urecs[0])
+                continue
             if ukind == "solo":
                 rec = urecs[0]
                 self.balances, self.ring = self._run(
@@ -1168,6 +1253,79 @@ class DeviceEngine:
         self.balances = new_balances
         rec.handle = packed
 
+    def _exec_spec(self, rec: _InFlight) -> None:
+        """Execute a speculative record: ONE whole-batch device step
+        with on-device conflict validation, a small flags fetch (the
+        validation sync), then — only on a miss — plan_waves over the
+        conflicted residue and a carry-threaded replay.  The executor
+        never donates the engine's table handle and `self.balances`
+        is reassigned only after the whole closure succeeded, so a
+        transient fault anywhere (dispatch, validation fetch, residue
+        replay) retries the entire batch idempotently from the same
+        authoritative handle — exactly _exec_waves' contract."""
+        from tigerbeetle_tpu.state_machine import resolve as _resolve
+        from tigerbeetle_tpu.state_machine import waves as _waves
+
+        packed_rec, pv_serial = rec.wave_args
+        ev, dstat_init, spec_serial = _waves.unpack_spec_record(packed_rec)
+        if self._commit_enabled:
+            rec.touched = _waves.touched_slots(ev, rec.n)
+        n = rec.n
+        B = len(ev["flags"])
+
+        def run():
+            t0 = _time.perf_counter()
+            carry, confl = self.link.dispatch(
+                _waves.run_speculative_engine, self.balances, ev,
+                dstat_init, spec_serial, n, rec.ts_base,
+            )
+            # THE validation sync: a (K,) bool fetch.  Blocking here is
+            # the speculation tax — later records in the window read
+            # self.balances, so the hit/miss verdict cannot defer to
+            # rotation (a miss would leave residue effects unapplied
+            # underneath them).
+            confl_np = np.asarray(self.link.fetch(confl))[:n]
+            val_s = _time.perf_counter() - t0
+            residue = np.flatnonzero(confl_np)
+            hist = np.zeros(B, bool)
+            if len(residue) == 0:
+                hist[:n] = True
+                out = self.link.dispatch(
+                    _waves.finalize_engine, carry, hist
+                )
+                return out, 0, 1, val_s, 0.0
+            t1 = _time.perf_counter()
+            meta = _resolve.spec_meta_from_events(ev, n, pv_serial)
+            plan = _waves.plan_residue(n, meta, residue)
+            plan_s = _time.perf_counter() - t1
+            # Snapshot-rewrite mask: committed events rode the wave
+            # step (finals), residue wave/chain events likewise; scan
+            # residues keep their sequential-exact snapshots.
+            hist[:n] = ~confl_np
+            hist[:n] |= plan.wave_mask
+            out = self.link.dispatch(
+                _waves.continue_plan_engine, carry, ev, n, rec.ts_base,
+                plan, hist,
+            )
+            return out, len(residue), 1 + plan.n_steps, val_s, plan_s
+
+        st = self._spec_st()
+        st["attempts"].inc()
+        (new_balances, packed), residue_n, steps, val_s, plan_s = (
+            self._retry(run, "dispatch")
+        )
+        self.balances = new_balances
+        rec.handle = packed
+        if residue_n == 0:
+            st["hits"].inc()
+            st["plan_skipped"].inc()
+        else:
+            st["residue_events"].inc(residue_n)
+            st["residue_plan_s"].inc(plan_s)
+        st["steps"].inc(steps)
+        st["validation_s"].inc(val_s)
+        st["validation_us"].observe(val_s * 1e6)
+
     # ------------------------------------------------------------------
     # Hazard probe: does any probe id match an in-flight batch's ids?
 
@@ -1209,7 +1367,7 @@ class DeviceEngine:
             # THE burst fetch.
             ring_np = self._retry(lambda: self.link.fetch(self.ring), "fetch")
         for rec in recs:
-            if rec.kind in ("lookup", "waves") and rec.handle is not None:
+            if rec.kind in ("lookup", "waves", "spec") and rec.handle is not None:
                 rec.rows = self._retry(
                     lambda h=rec.handle: self.link.fetch(h), "fetch"
                 )
@@ -1234,7 +1392,7 @@ class DeviceEngine:
             if rec.kind == "lookup":
                 rec.future.resolve(rec.finish(rec.rows))
                 continue
-            if rec.kind == "waves":
+            if rec.kind in ("waves", "spec"):
                 self.stat_semantic_events += rec.n
                 rec.future.resolve(rec.finish(rec.rows))
                 self._release_bound(rec)
@@ -1299,10 +1457,10 @@ class DeviceEngine:
                 if rec.kind == "lookup":
                     rec.future.resolve(rec.finish(rec.rows))
                     continue
-                if rec.kind == "waves":
-                    # Wave records carry no failure flag: admission
-                    # proved the plan exact, so the fetched packed
-                    # output (computed against the stream prefix
+                if rec.kind in ("waves", "spec"):
+                    # Wave/speculative records carry no failure flag:
+                    # admission proved the plan exact, so the fetched
+                    # packed output (computed against the stream prefix
                     # before any LATER batch's fallback) resolves.
                     self.stat_semantic_events += rec.n
                     rec.future.resolve(rec.finish(rec.rows))
@@ -1337,6 +1495,8 @@ class DeviceEngine:
                     rec.handle = self._gather(rec.slots)
                 elif rec.kind == "waves":
                     self._exec_waves(rec)
+                elif rec.kind == "spec":
+                    self._exec_spec(rec)
                 else:
                     self._dispatch(rec)
             # The re-dispatched suffix mutated the rebuilt table: fold
@@ -1489,7 +1649,7 @@ class DeviceEngine:
         for rec in recs:
             if rec.kind == "meta":
                 touched.append(rec.meta_args[0])
-            elif rec.kind == "waves" and rec.touched is not None:
+            elif rec.kind in ("waves", "spec") and rec.touched is not None:
                 touched.append(rec.touched)
             elif rec.kind in _SEMANTIC_KINDS:
                 touched.append(_touched_of_pk(rec.kind, rec.pk, rec.n))

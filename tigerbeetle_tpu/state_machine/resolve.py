@@ -480,6 +480,32 @@ def _apply(code_out: np.ndarray, cond: np.ndarray, code) -> None:
     np.copyto(code_out, np.uint32(code), where=(code_out == 0) & cond)
 
 
+def spec_meta_from_events(ev: dict, n: int, pv_serial: bool) -> dict:
+    """wave_dependency_metadata rebuilt from a (B,)-padded host event
+    dict (kernel.EVENT_FIELDS contract) — the speculative dispatcher's
+    residue planner runs at window LAUNCH, where the padded arrays are
+    all that survives of the submit-time joins (the compact record
+    keeps nothing else).  Bit-identical to building the metadata from
+    the original join columns: every input below is the same value the
+    submit path passed, just padded and round-tripped through the
+    columnar codec (lossless)."""
+    return wave_dependency_metadata(
+        n,
+        np.asarray(ev["flags"][:n], np.uint32),
+        ev["dr_slot"][:n].astype(np.int64),
+        ev["cr_slot"][:n].astype(np.int64),
+        np.asarray(ev["dr_flags"][:n], np.uint32),
+        np.asarray(ev["cr_flags"][:n], np.uint32),
+        ev["id_group"][:n].astype(np.int64),
+        ev["p_group"][:n].astype(np.int64),
+        ev["p_tgt"][:n].astype(np.int64),
+        np.asarray(ev["p_found"][:n], bool),
+        ev["p_dr_slot"][:n].astype(np.int64),
+        ev["p_cr_slot"][:n].astype(np.int64),
+        pv_serial=pv_serial,
+    )
+
+
 def wave_dependency_metadata(
     n: int,
     flags: np.ndarray,

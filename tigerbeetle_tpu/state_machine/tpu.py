@@ -447,6 +447,15 @@ class TpuStateMachine:
                 account_capacity, self._mirror, link=device_link,
                 metrics=self.metrics.scope("dev"),
             )
+            # Speculative-execution counters live on the MACHINE
+            # registry (dev_wave.spec.*, next to the dev_wave.*
+            # routing stats) so the stats scrape and flight postmortem
+            # carry them; the engine increments the shared handles.
+            from tigerbeetle_tpu.state_machine.device_engine import (
+                make_spec_stats,
+            )
+
+            self._dev.spec_stats = make_spec_stats(self.metrics)
             # Off-hot-path warmup of the named kinds' transfer plans +
             # scan compiles (bench passes these per config;
             # construction happens during untimed setup).
@@ -1299,6 +1308,14 @@ class TpuStateMachine:
         if n == 0 or n > dk.B:
             return host_path()
 
+        # Forced-optimistic routing (TB_WAVES_SPECULATE=force): every
+        # window batch — including shapes the semantic kernels could
+        # serve — goes through the speculative wave dispatcher, the
+        # differential-fuzz / bench arm that maximizes coverage of the
+        # validate-and-residue machinery.
+        if waves.spec_mode() == "force":
+            return host_path()
+
         id_lo = np.asarray(events["id_lo"])
         id_hi = np.asarray(events["id_hi"])
         flags16 = np.asarray(events["flags"])
@@ -1444,6 +1461,13 @@ class TpuStateMachine:
 
         return run
 
+    def _observe_plan_time(self, t0: float) -> None:
+        """Record one wave-routing pass's host wall time (decode,
+        joins, admission, and the partitioner whenever it ran)."""
+        plan_dt = _time.perf_counter() - t0
+        self._stats["stat_dev_wave_plan_s"].inc(plan_dt)
+        self._h_dev_wave_plan.observe(plan_dt * 1e6)
+
     def _dev_wave_decline(self, reason: str) -> None:
         self._stats["stat_dev_wave_declined"].inc()
         # Cumulative per-reason registry counter (scrapeable) + the
@@ -1457,10 +1481,14 @@ class TpuStateMachine:
     ):
         """Wave-dispatch one window batch that fell off the semantic
         kernels (mixed kinds, conflicting/duplicate ids, balancing,
-        timeouts, two-phase edge shapes): host joins + wave plan at
-        submit time, segment execution against the authoritative HBM
-        table at window launch, exact-path bookkeeping from the
-        fetched packed outputs at materialization.  Returns
+        timeouts, two-phase edge shapes): host joins + overflow
+        admission at submit time, then either OPTIMISTIC submission
+        (TB_WAVES_SPECULATE: no plan — the whole batch speculates as
+        one device step at launch and only a conflicted residue is
+        planned, DeviceEngine._exec_spec) or the pessimistic wave plan
+        (segment execution against the authoritative HBM table at
+        window launch); exact-path bookkeeping runs from the
+        fetched packed outputs at materialization either way.  Returns
         (reply_future, None), or (None, decoded) on decline
         (admission, profitability, TB_DEV_WAVES=0, degraded engine,
         unsupported sharding geometry, plan shapes the SPMD executors
@@ -1525,25 +1553,63 @@ class TpuStateMachine:
             n, B, id_lo, id_hi, d["pend_lo"], d["pend_hi"], d["is_pv"],
             ascending, e_found, e_row,
         )
-        plan = self._plan_wave_execution(
+        meta, pv_serial = self._wave_metadata(
             n, d["flags"], d["dr_slot"], d["cr_slot"], d["dr_flags"],
             d["cr_flags"], j["id_group"], j["p_group"], j["p_tgt"],
-            j["p_found"], j["gather_p"], d["is_pv"],
-            d["amount_lo"], d["amount_hi"], force=(dm == "1"),
-            extra_bound=dev.inflight_bound(),
+            j["p_found"], j["gather_p"],
         )
-        plan_dt = _time.perf_counter() - t0
-        self._stats["stat_dev_wave_plan_s"].inc(plan_dt)
-        self._h_dev_wave_plan.observe(plan_dt * 1e6)
-        if plan is None:
+
+        # Optimistic routing (TB_WAVES_SPECULATE): admitted batches on
+        # a dense engine skip the partitioner entirely — the whole
+        # batch executes as ONE speculative device step, validated on
+        # device, with only the conflicted residue replayed through
+        # plan_waves at launch (DeviceEngine._exec_spec).  The
+        # residue-cap gate skips batches the host ALREADY knows are
+        # residue-dominated (chain members, history events, serialized
+        # post/voids) — a guaranteed-loss speculation; "force" takes
+        # them anyway (differential/bench routing).
+        sm_mode = waves.spec_mode()
+        speculate = sm_mode != "0" and not sharded
+        if speculate and sm_mode != "force":
+            speculate = (
+                int(meta["chain_member"].sum())
+                <= waves.spec_residue_cap() * n
+            )
+        # Both cheap pre-admission declines run before the per-column
+        # bound accumulation pays for itself.
+        if not speculate and self._chain_dominated(
+            n, meta, force=(dm == "1")
+        ):
+            self._observe_plan_time(t0)
             self._dev_wave_decline("plan")
             return None, d
-        if sharded and not waves.plan_shardable(plan):
-            # The plan needs a scan segment (history accounts, serial
-            # conflict regions) — no SPMD executor covers those, so
-            # the sharded engine declines to the drained host path.
-            self._dev_wave_decline("shard_plan")
+        adm = self._wave_admission(
+            n, meta, d["flags"], j["p_found"], j["gather_p"],
+            d["is_pv"], d["amount_lo"], d["amount_hi"],
+            extra_bound=dev.inflight_bound(),
+        )
+        if adm is None:
+            self._observe_plan_time(t0)
+            self._dev_wave_decline("plan")
             return None, d
+        inb_pairs, batch_bound = adm
+        plan = None
+        if not speculate:
+            plan = self._grade_plan(
+                n, meta, inb_pairs, batch_bound, force=(dm == "1")
+            )
+        self._observe_plan_time(t0)
+        if not speculate:
+            if plan is None:
+                self._dev_wave_decline("plan")
+                return None, d
+            if sharded and not waves.plan_shardable(plan):
+                # The plan needs a scan segment (history accounts,
+                # serial conflict regions) — no SPMD executor covers
+                # those, so the sharded engine declines to the drained
+                # host path.
+                self._dev_wave_decline("shard_plan")
+                return None, d
 
         ev = self._build_scan_events(
             n, B, events, d["flags"], d["static"], d["amount_lo"],
@@ -1565,8 +1631,21 @@ class TpuStateMachine:
             )
 
         self.stat_dev_wave_batches += 1
-        self.stat_dev_wave_steps += plan.n_steps
         self.stat_dev_wave_events += n
+        if speculate:
+            # The in-flight charge is the WHOLE-batch superset — the
+            # same bound the wave path charges — never the committed
+            # subset: a mid-flight demotion replays the entire batch
+            # through the host fallback, and a smaller charge could
+            # let a sibling admission over-apply (tests/test_chaos.py
+            # pins this window).
+            return dev.submit_speculative(
+                ev, dstat_init, n, ts_base, meta["chain_member"],
+                pv_serial, finish,
+                self._device_fallback(timestamp, input_bytes),
+                id_keys=np.sort(probe), bound=batch_bound,
+            ), None
+        self.stat_dev_wave_steps += plan.n_steps
         return dev.submit_waves(
             ev, dstat_init, n, ts_base, plan, _pad(plan.wave_mask, B),
             finish, self._device_fallback(timestamp, input_bytes),
@@ -2679,13 +2758,49 @@ class TpuStateMachine:
         amount_lo, amount_hi, force: bool = False, extra_bound: int = 0,
     ):
         """Wave routing decision for one exact-path batch: dependency
-        metadata (resolve.py) -> per-column overflow admission against
-        the mirror -> level partition (waves.plan_waves) ->
-        profitability.  Returns the plan or None — the scan path —
-        and is always safe to decline (never a wrong answer, only a
-        slower one).  `extra_bound` is the device engine's in-flight
-        contribution bound when planning a window batch (the mirror
-        lags materialization there); zero on the drained host path."""
+        metadata (_wave_metadata) -> cheap chain-dominance decline
+        (_chain_dominated) -> per-column overflow admission
+        (_wave_admission) -> level partition + profitability.
+        Returns the plan or None — the scan path — and is always safe
+        to decline (never a wrong answer, only a slower one).
+        `extra_bound` is the device engine's in-flight contribution
+        bound when planning a window batch (the mirror lags
+        materialization there); zero on the drained host path."""
+        meta, pv_serial = self._wave_metadata(
+            n, flags, dr_slot, cr_slot, dr_flags, cr_flags,
+            id_group, p_group, p_tgt, p_found, gather_p,
+        )
+        # Chain-dominance declines on a cheap metadata counter BEFORE
+        # the per-column admission pays its bound accumulation.
+        if self._chain_dominated(n, meta, force):
+            return None
+        adm = self._wave_admission(
+            n, meta, flags, p_found, gather_p, is_pv,
+            amount_lo, amount_hi, extra_bound=extra_bound,
+        )
+        if adm is None:
+            return None
+        inb_pairs, batch_bound = adm
+        return self._grade_plan(n, meta, inb_pairs, batch_bound, force)
+
+    def _grade_plan(self, n, meta, inb_pairs, batch_bound, force: bool):
+        """Partition + profitability + bound attachment — the ONE copy
+        shared by the drained host path and the window submission (a
+        profitability change made in one and not the other would
+        silently diverge the two routings)."""
+        plan = waves.plan_waves(n, meta, inb_pairs=inb_pairs)
+        if not (force or plan.profitable()):
+            return None
+        plan.batch_bound = batch_bound
+        return plan
+
+    def _wave_metadata(
+        self, n, flags, dr_slot, cr_slot, dr_flags, cr_flags,
+        id_group, p_group, p_tgt, p_found, gather_p,
+    ):
+        """Dependency metadata (resolve.py) + the pv_serial routing
+        fact, shared by the pessimistic wave path and the speculative
+        dispatcher — the cheap first stage every routing gate reads."""
         p_drs = gather_p("dr_slot").astype(np.int64)
         p_crs = gather_p("cr_slot").astype(np.int64)
 
@@ -2702,28 +2817,33 @@ class TpuStateMachine:
             pv_hist = bool(
                 (self._attrs["flags"][pj] & np.uint32(AF.history)).any()
             )
+        pv_serial = bool(hist_ev.any() or pv_hist)
         meta = resolve.wave_dependency_metadata(
             n, flags, dr_slot, cr_slot, dr_flags, cr_flags,
             id_group, p_group, p_tgt, p_found, p_drs, p_crs,
-            pv_serial=bool(hist_ev.any() or pv_hist),
+            pv_serial=pv_serial,
         )
-        # Chain members cost one exact step each UNLESS they are
-        # chain-wave candidates (clean linked runs, waves.py): decline
-        # chain-dominated batches before paying the partition only
-        # when the chains could not ride position-stepped anyway.
-        n_chain = int(meta["chain_member"].sum())
-        chain_wave_possible = (
-            waves.chain_max() >= 2
-            and not meta["chain_serial"].any()
-            and not (meta["chain_linked"] & meta["is_pv"]).any()
-        )
-        if (
-            not force
-            and n_chain
-            and not chain_wave_possible
-            and n < waves.min_ratio() * n_chain
-        ):
-            return None
+        # Stash the durable pending-target slot arrays for the
+        # admission stage — already gathered here, and this path's
+        # host wall time is exactly what dev_wave.plan_s instruments.
+        meta["p_drs"] = p_drs
+        meta["p_crs"] = p_crs
+        return meta, pv_serial
+
+    def _wave_admission(
+        self, n, meta, flags, p_found, gather_p, is_pv,
+        amount_lo, amount_hi, extra_bound: int = 0,
+    ):
+        """Per-column overflow admission against the mirror, shared by
+        the pessimistic wave path and the speculative dispatcher
+        (which must prove the same overflow superset before executing
+        the whole batch optimistically — the ov_* exactness argument
+        is order-free, so it covers the one-step speculative apply and
+        any residue replay identically).  Returns
+        (inb_pairs, batch_bound) or None when the batch lacks provable
+        u128 headroom."""
+        p_drs = meta["p_drs"]
+        p_crs = meta["p_crs"]
 
         # Per-column overflow admission (waves.admission_ok): per-event
         # amount upper bounds — balancing zero-amount means maxInt u64,
@@ -2761,7 +2881,7 @@ class TpuStateMachine:
         # in-batch finalizers (the creator is whichever applied).
         inb_ev, inb_slot = waves._inb_pv_write_pairs(n, meta)
         slots = np.concatenate(
-            [dr_slot.astype(np.int64), cr_slot.astype(np.int64),
+            [meta["ev_dr"], meta["ev_cr"],
              p_drs[p_found], p_crs[p_found], inb_slot]
         )
         bounds_lo = np.concatenate(
@@ -2780,12 +2900,27 @@ class TpuStateMachine:
             extra=extra_bound,
         ):
             return None
+        return (inb_ev, inb_slot), _amount_bound_total(bound_lo, bound_hi)
 
-        plan = waves.plan_waves(n, meta, inb_pairs=(inb_ev, inb_slot))
-        if not (force or plan.profitable()):
-            return None
-        plan.batch_bound = _amount_bound_total(bound_lo, bound_hi)
-        return plan
+    @staticmethod
+    def _chain_dominated(n, meta, force: bool) -> bool:
+        """Cheap pre-admission decline: chain members cost one exact
+        step each UNLESS they are chain-wave candidates (clean linked
+        runs, waves.py) — decline chain-dominated batches before
+        paying admission or the partition only when the chains could
+        not ride position-stepped anyway."""
+        n_chain = int(meta["chain_member"].sum())
+        chain_wave_possible = (
+            waves.chain_max() >= 2
+            and not meta["chain_serial"].any()
+            and not (meta["chain_linked"] & meta["is_pv"]).any()
+        )
+        return (
+            not force
+            and bool(n_chain)
+            and not chain_wave_possible
+            and n < waves.min_ratio() * n_chain
+        )
 
     def _try_native_two_phase(
         self, input_bytes, events, n, ts_base
@@ -3861,12 +3996,16 @@ def _tpu_restore(self, data: bytes) -> None:
         from tigerbeetle_tpu.state_machine.device_engine import (
             DeviceEngine,
             DeviceLostError,
+            make_spec_stats,
         )
 
         self._dev = DeviceEngine(
             cap, self._mirror, link=self._device_link,
             metrics=self.metrics.scope("dev"),
         )
+        # Re-bind the machine-registry dev_wave.spec.* handles — the
+        # counters are process-lifetime cumulative across restores.
+        self._dev.spec_stats = make_spec_stats(self.metrics)
         try:
             if self._dev.state is types.EngineState.healthy:
                 self._dev._upload_from_mirror()

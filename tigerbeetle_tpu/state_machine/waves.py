@@ -184,6 +184,25 @@ def dev_mode() -> str:
     return envcheck.env_choice("TB_DEV_WAVES", "auto", ("auto", "0", "1"))
 
 
+def spec_mode() -> str:
+    """TB_WAVES_SPECULATE routing mode for the device wave dispatcher
+    (see envcheck.waves_speculate for the full contract): "auto"/"1"
+    speculate behind the residue-cap gate, "0" keeps the pessimistic
+    plan-first path, "force" routes every window batch optimistically.
+    Read live (like mode()) so tests and bench arms can toggle it
+    after import."""
+    from tigerbeetle_tpu import envcheck
+
+    return envcheck.waves_speculate()
+
+
+def spec_residue_cap() -> float:
+    """TB_WAVES_SPEC_RESIDUE_CAP, read live (envcheck-validated)."""
+    from tigerbeetle_tpu import envcheck
+
+    return envcheck.spec_residue_cap()
+
+
 def chain_max() -> int:
     """TB_WAVES_CHAIN_MAX: longest chain (in positions) a chain-wave
     segment may carry — longer chains keep the exact scan, whose cost
@@ -551,7 +570,8 @@ def _chain_wave_steps(i: int, j: int, n: int, meta: dict, claims):
 
 
 def plan_waves(
-    n: int, meta: dict, use_walk: bool = False, inb_pairs=None
+    n: int, meta: dict, use_walk: bool = False, inb_pairs=None,
+    claims=None, group_slots_fn=None,
 ) -> WavePlan:
     """Partition a batch into wave/chain-wave/scan segments.
 
@@ -583,6 +603,14 @@ def plan_waves(
     admission in tpu._plan_wave_execution needs them too) pass them
     in instead of recomputing.  Runs once per batch on the host, only
     when the wave path is a routing candidate.
+
+    `claims` / `group_slots_fn` exist for SUBSET planning
+    (plan_residue): when `meta` covers only a batch's conflicted
+    residue, the chain-wave claims admission and the walk fallback's
+    in-batch slot unions must still count the COMMITTED events outside
+    the subset — the caller supplies full-batch claim counts and a
+    full-batch group->slot-union factory, and the subset-local lazy
+    builders are skipped.
     """
     chain_member = meta["chain_member"]
     id_group = meta["id_group"]
@@ -617,7 +645,6 @@ def plan_waves(
         inb_pairs if inb_pairs is not None else _inb_pv_write_pairs(n, meta)
     )
     group_slots = None  # walk-fallback slot unions, built lazily
-    claims = None  # batch-wide id-group claim counts, built lazily
 
     plan = WavePlan(n)
     wave_mask = np.zeros(n, bool)
@@ -630,16 +657,19 @@ def plan_waves(
         # group's slot union.
         nonlocal group_slots
         if group_slots is None:
-            group_slots = {}
-            if inb_pv.any():
-                ev_dr, ev_cr = meta["ev_dr"], meta["ev_cr"]
-                for e in range(n):
-                    g = int(id_group[e])
-                    s = group_slots.setdefault(g, set())
-                    if ev_dr[e] >= 0:
-                        s.add(int(ev_dr[e]))
-                    if ev_cr[e] >= 0:
-                        s.add(int(ev_cr[e]))
+            if group_slots_fn is not None:
+                group_slots = group_slots_fn()
+            else:
+                group_slots = {}
+                if inb_pv.any():
+                    ev_dr, ev_cr = meta["ev_dr"], meta["ev_cr"]
+                    for e in range(n):
+                        g = int(id_group[e])
+                        s = group_slots.setdefault(g, set())
+                        if ev_dr[e] >= 0:
+                            s.add(int(ev_dr[e]))
+                        if ev_cr[e] >= 0:
+                            s.add(int(ev_cr[e]))
         return group_slots
 
     def level_region(lo: int, hi: int) -> None:
@@ -681,6 +711,77 @@ def plan_waves(
         i = j
 
     plan.wave_mask = wave_mask
+    return plan
+
+
+def plan_residue(n: int, meta: dict, idx: np.ndarray) -> WavePlan:
+    """Wave plan for the conflicted RESIDUE of a speculatively-executed
+    batch: the level partition plan_waves builds, restricted to the
+    ascending global indices `idx`, with every segment's index set in
+    GLOBAL batch coordinates and `wave_mask` a (n,) global mask.
+
+    Soundness of planning the subset in isolation: a committed
+    (non-conflicted) event commutes with every residue event — a
+    conflict in either direction would have blocked one of them at
+    validation — so pre-applying all committed effects is sequentially
+    equivalent, and only residue-internal order constraints remain.
+    Two full-batch terms still leak into the subset plan and are
+    supplied from the full metadata: the chain-wave admission's
+    claimed-exactly-once-batch-wide counts (a committed claimant
+    outside the subset must still decline the chain wave — its created
+    record feeds the member's exists merge, which the chain-wave step
+    does not model) and the walk fallback's in-batch finalizer slot
+    unions (the committed creator's slots are part of a residue
+    finalizer's static write set)."""
+    idx = np.asarray(idx, np.int64)
+    sub = {
+        key: (val[idx] if isinstance(val, np.ndarray) else val)
+        for key, val in meta.items()
+    }
+    inb_ev, inb_slot = _inb_pv_write_pairs(n, meta)
+    if len(inb_ev):
+        keep = np.isin(inb_ev, idx)
+        local = np.searchsorted(idx, inb_ev[keep])
+        inb_pairs = (local.astype(np.int64), inb_slot[keep])
+    else:
+        inb_pairs = (inb_ev, inb_slot)
+    claims = None
+    if sub["chain_member"].any():
+        id_group, p_group = meta["id_group"], meta["p_group"]
+        span = int(max(id_group.max(), p_group.max())) + 1
+        claims = np.bincount(id_group, minlength=span)
+        pgv = p_group[p_group >= 0]
+        if len(pgv):
+            claims = claims + np.bincount(pgv, minlength=span)
+
+    def group_slots_full():
+        out: dict = {}
+        ev_dr, ev_cr = meta["ev_dr"], meta["ev_cr"]
+        id_group = meta["id_group"]
+        for e in range(n):
+            s = out.setdefault(int(id_group[e]), set())
+            if ev_dr[e] >= 0:
+                s.add(int(ev_dr[e]))
+            if ev_cr[e] >= 0:
+                s.add(int(ev_cr[e]))
+        return out
+
+    local_plan = plan_waves(
+        len(idx), sub, inb_pairs=inb_pairs, claims=claims,
+        group_slots_fn=group_slots_full,
+    )
+    plan = WavePlan(len(idx))
+    mask = np.zeros(n, bool)
+    for k, (kind, seg) in enumerate(local_plan.segments):
+        gseg = idx[np.asarray(seg)]
+        plan.segments.append((kind, gseg))
+        if kind == "chains":
+            plan.chain_steps[len(plan.segments) - 1] = (
+                local_plan.chain_steps[k]
+            )
+        if kind in ("wave", "chains"):
+            mask[gseg] = True
+    plan.wave_mask = mask
     return plan
 
 
@@ -906,7 +1007,7 @@ def _accum_u128(slots_c, cols, amt_lo, amt_hi, valid, A):
     return d_lo, d_hi
 
 
-def _wave_step_impl(carry, ev, n, ts_base, ops=_DENSE_OPS):
+def _wave_step_impl(carry, ev, n, ts_base, ops=_DENSE_OPS, commit_mask=None):
     """Apply one wave — K mutually independent events — as a single
     vectorized step against the segment carry.
 
@@ -920,6 +1021,12 @@ def _wave_step_impl(carry, ev, n, ts_base, ops=_DENSE_OPS):
     `ops` is the table-access seam: dense (whole table) by default,
     shard-local inside the SPMD executor — the body itself never
     indexes `carry["balances"]` directly.
+
+    `commit_mask` (speculative executor only) deactivates lanes whose
+    events failed conflict validation: a masked lane applies nothing,
+    scatters nothing, and leaves its result slot untouched — exactly
+    "not yet executed", so the conflicted residue replays later
+    against this carry.
     """
     table = carry["balances"]
     created = carry["created"]
@@ -929,6 +1036,8 @@ def _wave_step_impl(carry, ev, n, ts_base, ops=_DENSE_OPS):
 
     i = ev["i"]  # (K,) global indices; padding lanes carry i == B
     active = i < n
+    if commit_mask is not None:
+        active = active & commit_mask
     flags = ev["flags"]
     is_pv = (flags & (F_POST | F_VOID)) != 0
     ts_i = ts_base + i.astype(jnp.uint64)
@@ -1832,9 +1941,204 @@ def run_plan_engine(
     )
 
 
+# ---------------------------------------------------------------------------
+# Optimistic (speculative) execution — round 18.  Invert the wave
+# pipeline's order for low-contention batches (the Reddio parallel-EVM
+# recipe, arXiv:2503.04595): execute the ENTIRE batch as ONE
+# speculative wave step against the authoritative table, detect
+# read-write/write-write conflicts ON DEVICE with segmented-min passes
+# over the same conflict tokens the partitioner levels by, commit the
+# validated events, and replay only the conflicted residue through a
+# plan_waves subset plan.  The partitioner leaves the hot path
+# entirely: plan only on validation failure.
+#
+# The PREFIX-COMMIT rule (the subtle part): an event's speculative
+# result is committable iff NO earlier event in the batch conflicts
+# with it — the wavefront's round-0 unblocked test.  Its gathers then
+# saw exactly the sequential pre-state (nothing it depends on ran
+# before it), and committable events are pairwise non-conflicting (a
+# conflict between two of them would have blocked the later one), so
+# committing them as one wave is the wave executor's own exactness
+# argument.  An event that merely FOLLOWS a conflicted event commits
+# fine when they don't conflict — commuting adds reorder freely — so
+# the residue is the conflicted set itself, not a positional suffix.
+# The step is NON-DONATING: on validation failure nothing about the
+# authoritative handle changed, so "rollback" of the un-committed
+# lanes is a no-op by construction (their applies were masked out, not
+# undone).
+
+
+def _spec_conflicts(ev: dict, spec_serial, n, A: int, B: int):
+    """Per-lane conflict flags for one speculative step — the
+    wavefront's round-0 blocked test (_levels_wavefront) computed on
+    device from the event columns alone:
+
+    - serial tokens: only the minimum-index claimant of an id/pending
+      group or a durable first-wins target is unblocked;
+    - balance slots: a reader is unblocked only as the minimum-index
+      toucher of its slot, a writer only when no earlier reader
+      touches it (commuting writers share);
+    - `spec_serial` force-conflicts events the wave step does not
+      model (chain members, history-account events, serialized
+      post/voids) — they always replay through the residue plan.
+
+    The in-batch finalizer's WIDENED write set (its target group's
+    slot union) needs no entries here: the finalizer shares its
+    p_group token with any in-batch creator, so whenever the widened
+    writes could matter the finalizer is already blocked, and a
+    committed finalizer provably applied nothing to those slots (its
+    reference was durable or unresolved).
+    """
+    i = ev["i"]
+    active = i < n
+    big = jnp.int32(B)
+    flags = ev["flags"]
+    is_pv = (flags & (F_POST | F_VOID)) != 0
+
+    # Serial tokens, namespace 1: id-value groups (id_group claims +
+    # post/void pending-reference claims share the group space).
+    idg = jnp.clip(ev["id_group"], 0, B - 1)
+    pg = ev["p_group"]
+    pgm = active & (pg >= 0)
+    pgc = jnp.clip(pg, 0, B - 1)
+    tok_min = jnp.full(B + 1, big, jnp.int32)
+    tok_min = tok_min.at[jnp.where(active, idg, B)].min(i)
+    tok_min = tok_min.at[jnp.where(pgm, pgc, B)].min(i)
+    blk = active & (i > tok_min[idg])
+    blk = blk | (pgm & (i > tok_min[pgc]))
+    # Namespace 2: durable first-wins finalize targets.
+    pt = ev["p_tgt"]
+    ptm = active & (pt >= 0)
+    ptc = jnp.clip(pt, 0, B - 1)
+    pt_min = jnp.full(B + 1, big, jnp.int32).at[
+        jnp.where(ptm, ptc, B)
+    ].min(i)
+    blk = blk | (ptm & (i > pt_min[ptc]))
+
+    # Balance-slot entries (the metadata contract of
+    # resolve.wave_dependency_metadata, recomputed from the same
+    # columns): reads = balancing clamps + limit checks on own
+    # accounts; writes = own dr/cr for creates, the durable target's
+    # accounts for found finalizers.
+    dr_slot = ev["dr_slot"]
+    cr_slot = ev["cr_slot"]
+    read_dr = (
+        active & ~is_pv & (dr_slot >= 0)
+        & (((flags & F_BAL_DR) != 0)
+           | ((ev["dr_flags"] & AF_DR_LIMIT) != 0))
+    )
+    read_cr = (
+        active & ~is_pv & (cr_slot >= 0)
+        & (((flags & F_BAL_CR) != 0)
+           | ((ev["cr_flags"] & AF_CR_LIMIT) != 0))
+    )
+    pf = ev["p_found"]
+    neg = jnp.int32(-1)
+    w0 = jnp.where(is_pv, jnp.where(pf, ev["p_dr_slot"], neg), dr_slot)
+    w1 = jnp.where(is_pv, jnp.where(pf, ev["p_cr_slot"], neg), cr_slot)
+    wm0 = active & (w0 >= 0)
+    wm1 = active & (w1 >= 0)
+    dr_c = jnp.clip(dr_slot, 0, A - 1)
+    cr_c = jnp.clip(cr_slot, 0, A - 1)
+    w0_c = jnp.clip(w0, 0, A - 1)
+    w1_c = jnp.clip(w1, 0, A - 1)
+    a_min = (
+        jnp.full(A + 1, big, jnp.int32)
+        .at[jnp.where(read_dr, dr_c, A)].min(i)
+        .at[jnp.where(read_cr, cr_c, A)].min(i)
+        .at[jnp.where(wm0, w0_c, A)].min(i)
+        .at[jnp.where(wm1, w1_c, A)].min(i)
+    )
+    r_min = (
+        jnp.full(A + 1, big, jnp.int32)
+        .at[jnp.where(read_dr, dr_c, A)].min(i)
+        .at[jnp.where(read_cr, cr_c, A)].min(i)
+    )
+    blk = blk | (read_dr & (i > a_min[dr_c]))
+    blk = blk | (read_cr & (i > a_min[cr_c]))
+    blk = blk | (wm0 & (i > r_min[w0_c]))
+    blk = blk | (wm1 & (i > r_min[w1_c]))
+    return blk | (active & spec_serial)
+
+
+def _spec_exec_impl(balances, ev, dstat_init, spec_serial, n, ts_base):
+    """One speculative step: fresh carry -> on-device validation ->
+    the wave-step body gated on the validated lanes.  Returns
+    (carry, conflicted); the carry holds exactly the committed
+    events' effects and registrations — nothing of a conflicted lane
+    lands anywhere, so the residue replay resumes from it."""
+    B = dstat_init.shape[0]
+    A = balances.shape[0]
+    conflicted = _spec_conflicts(ev, spec_serial, n, A, B)
+    carry = kernel.make_carry(balances, dstat_init, B)
+    carry = _wave_step_impl(
+        carry, ev, n, ts_base, commit_mask=~conflicted
+    )
+    return carry, conflicted
+
+
+_spec_exec = jax.jit(_spec_exec_impl)
+
+
+def run_speculative_engine(balances, ev: dict, dstat_init, spec_serial,
+                           n: int, ts_base: int):
+    """Device-engine entry for one speculative step: the WHOLE batch
+    as one validated wave against the authoritative table handle,
+    never donating any caller buffer (a transient link fault retries
+    the entire batch idempotently from the same handle — exactly
+    run_plan_engine's contract).  Returns (carry, conflicted): fetch
+    `conflicted`, then either finalize_engine (no conflicts — the
+    speculation hit) or continue_plan_engine with the residue plan."""
+    B = ev["flags"].shape[0]
+    K = _bucket(n)
+    ev_seg = _gather_events(ev, np.arange(n), K, B)
+    ss = np.zeros(K, bool)
+    ss[:n] = np.asarray(spec_serial)[:n]
+    return _spec_exec(
+        balances, ev_seg,
+        jnp.asarray(np.asarray(dstat_init), jnp.uint32),
+        jnp.asarray(ss), jnp.int32(n), jnp.uint64(ts_base),
+    )
+
+
+def continue_plan_engine(carry, ev: dict, n: int, ts_base: int,
+                         plan: WavePlan, hist_fix: np.ndarray):
+    """Replay the conflicted residue: thread the speculative step's
+    carry — committed events' effects, created-record registrations,
+    statuses — through the residue plan's segments (global indices,
+    non-donating twins), then finalize.  Returns (new_balances,
+    packed outputs), the run_plan_engine contract."""
+    B = ev["flags"].shape[0]
+    id_group_full = jnp.asarray(ev["id_group"])
+    n_j = jnp.int32(n)
+    ts_j = jnp.uint64(ts_base)
+    for k, (seg_kind, idx) in enumerate(plan.segments):
+        if seg_kind == "chains":
+            ev_seg = _gather_chain_events(
+                ev, idx, plan.chain_steps[k], n, B
+            )
+            carry = _chain_step_keep(carry, ev_seg, n_j, ts_j)
+            continue
+        ev_seg = _gather_events(ev, idx, _bucket(len(idx)), B)
+        if seg_kind == "wave":
+            carry = _wave_step_keep(carry, ev_seg, n_j, ts_j)
+        else:
+            carry = kernel.scan_segment_keep(
+                carry, ev_seg, id_group_full, n_j, ts_j
+            )
+    return _finalize_keep(carry, jnp.asarray(hist_fix))
+
+
+def finalize_engine(carry, hist_fix: np.ndarray):
+    """Finalize a speculative carry with an empty residue (the hit
+    path): pack outputs, rewrite committed events' snapshots to batch
+    finals.  Returns (new_balances, packed outputs)."""
+    return _finalize_keep(carry, jnp.asarray(hist_fix))
+
+
 def prewarm(
     A: int, B_buckets=kernel.BATCH_BUCKETS, buckets=_SEG_BUCKETS,
-    engine: bool = False, mesh=None,
+    engine: bool = False, mesh=None, spec: bool = False,
 ) -> None:
     """Compile the wave step, the chain-wave step, and the paired scan
     segment for the given table geometry OFF the hot path: on the
@@ -1874,6 +2178,19 @@ def prewarm(
         if chain_ev is not None:
             carry = chainf(carry, chain_ev, jnp.int32(0), jnp.uint64(1))
         outs.append(fin(carry, jnp.zeros(B, bool)))
+        if spec:
+            # The speculative executor (engine-only, non-donating) is
+            # a separate XLA executable per (B, K): validation +
+            # masked wave step — warm it so a speculative launch never
+            # first-compiles inside a timed window.
+            sc, confl = _spec_exec(
+                jnp.zeros((A, 8), jnp.uint64),
+                _gather_events(ev, idx, K, B),
+                jnp.zeros(B, jnp.uint32), jnp.zeros(K, bool),
+                jnp.int32(0), jnp.uint64(1),
+            )
+            outs.append(confl)
+            outs.append(_finalize_keep(sc, jnp.zeros(B, bool)))
     jax.block_until_ready(outs)
 
 
@@ -2038,6 +2355,30 @@ def unpack_wave_record(pk: PackedColumns):
     dstat_init = cols.pop("__dstat_init__")
     hist_fix = cols.pop("__hist_fix__")
     return cols, dstat_init, hist_fix
+
+
+def pack_spec_record(ev: dict, dstat_init, spec_serial, n: int) -> PackedColumns:
+    """Sibling codec for a pending SPECULATIVE record (same lossless
+    columnar compaction, same admission/recovery treatment as a wave
+    record): the event dict plus the dstat seed and the known-serial
+    mask the on-device validator force-conflicts.  No hist_fix column
+    — the snapshot-rewrite mask depends on the validation outcome and
+    is derived at launch."""
+    cols = dict(ev)
+    cols["__dstat_init__"] = np.asarray(dstat_init)
+    serial = np.zeros(len(cols["flags"]), bool)
+    serial[:n] = np.asarray(spec_serial)[:n]
+    cols["__spec_serial__"] = serial
+    return PackedColumns(cols, n)
+
+
+def unpack_spec_record(pk: PackedColumns):
+    """-> (ev, dstat_init, spec_serial), bit-identical to what was
+    packed."""
+    cols = pk.unpack()
+    dstat_init = cols.pop("__dstat_init__")
+    spec_serial = cols.pop("__spec_serial__")
+    return cols, dstat_init, spec_serial
 
 
 def touched_slots(ev: dict, n: int | None = None) -> np.ndarray:
