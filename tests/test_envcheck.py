@@ -576,3 +576,99 @@ def test_tb_metrics_disables_commitment_instruments(monkeypatch):
     snap = reg.snapshot()
     assert snap["commit.updates"] == 1
     assert snap["commit.scrub_cheap"] == 2
+
+
+# ----------------------------------------------------------------------
+# Root-attested follower serving (round 19).
+
+
+def test_tb_root_ring_validated(monkeypatch):
+    monkeypatch.setenv("TB_ROOT_RING", "many")
+    with pytest.raises(envcheck.EnvVarError, match="TB_ROOT_RING"):
+        envcheck.root_ring()
+    monkeypatch.setenv("TB_ROOT_RING", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.root_ring()
+    monkeypatch.setenv("TB_ROOT_RING", "0")  # 0 = no at-op attestation
+    assert envcheck.root_ring() == 0
+    monkeypatch.delenv("TB_ROOT_RING")
+    assert envcheck.root_ring() == 4096
+
+
+def test_tb_read_policy_validated(monkeypatch):
+    monkeypatch.setenv("TB_READ_POLICY", "maybe")
+    with pytest.raises(envcheck.EnvVarError, match="TB_READ_POLICY"):
+        envcheck.read_policy()
+    for value in ("auto", "primary", "follower"):
+        monkeypatch.setenv("TB_READ_POLICY", value)
+        assert envcheck.read_policy() == value
+    monkeypatch.delenv("TB_READ_POLICY")
+    assert envcheck.read_policy() == "auto"
+
+
+def test_tb_read_staleness_ops_validated(monkeypatch):
+    monkeypatch.setenv("TB_READ_STALENESS_OPS", "fresh")
+    with pytest.raises(envcheck.EnvVarError, match="TB_READ_STALENESS_OPS"):
+        envcheck.read_staleness_ops()
+    monkeypatch.setenv("TB_READ_STALENESS_OPS", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.read_staleness_ops()
+    monkeypatch.setenv("TB_READ_STALENESS_OPS", "0")  # fully caught up
+    assert envcheck.read_staleness_ops() == 0
+    monkeypatch.delenv("TB_READ_STALENESS_OPS")
+    assert envcheck.read_staleness_ops() == 512
+
+
+def test_tb_follower_attest_ms_validated(monkeypatch):
+    monkeypatch.setenv("TB_FOLLOWER_ATTEST_MS", "0")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 1"):
+        envcheck.follower_attest_ms()
+    monkeypatch.setenv("TB_FOLLOWER_ATTEST_MS", "250")
+    assert envcheck.follower_attest_ms() == 250
+    monkeypatch.delenv("TB_FOLLOWER_ATTEST_MS")
+    assert envcheck.follower_attest_ms() == 100
+
+
+def test_tb_follower_root_ring_named_constraint(monkeypatch):
+    # Named constraint: < 16 discards the roots attestation needs
+    # under write load.
+    monkeypatch.setenv("TB_FOLLOWER_ROOT_RING", "8")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 16"):
+        envcheck.follower_ring()
+    monkeypatch.setenv("TB_FOLLOWER_ROOT_RING", "64")
+    assert envcheck.follower_ring() == 64
+    monkeypatch.delenv("TB_FOLLOWER_ROOT_RING")
+    assert envcheck.follower_ring() == 4096
+
+
+def test_tb_read_fallback_ms_validated(monkeypatch):
+    monkeypatch.setenv("TB_READ_FALLBACK_MS", "1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 10"):
+        envcheck.read_fallback_ms()
+    monkeypatch.setenv("TB_READ_FALLBACK_MS", "500")
+    assert envcheck.read_fallback_ms() == 500
+    monkeypatch.delenv("TB_READ_FALLBACK_MS")
+    assert envcheck.read_fallback_ms() == 250
+
+
+def test_tb_tenant_rate_bytes_validated(monkeypatch):
+    monkeypatch.setenv("TB_TENANT_RATE_BYTES", "fast")
+    with pytest.raises(envcheck.EnvVarError, match="TB_TENANT_RATE_BYTES"):
+        envcheck.tenant_rate_bytes()
+    monkeypatch.setenv("TB_TENANT_RATE_BYTES", "-5")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.tenant_rate_bytes()
+    monkeypatch.setenv("TB_TENANT_RATE_BYTES", "65536")
+    assert envcheck.tenant_rate_bytes() == 65536.0
+    monkeypatch.delenv("TB_TENANT_RATE_BYTES")
+    assert envcheck.tenant_rate_bytes() == 0.0  # default off
+
+
+def test_tb_follower_attest_max_ms_validated(monkeypatch):
+    monkeypatch.setenv("TB_FOLLOWER_ATTEST_MAX_MS", "0")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 1"):
+        envcheck.follower_attest_max_ms()
+    monkeypatch.setenv("TB_FOLLOWER_ATTEST_MAX_MS", "5000")
+    assert envcheck.follower_attest_max_ms() == 5000
+    monkeypatch.delenv("TB_FOLLOWER_ATTEST_MAX_MS")
+    assert envcheck.follower_attest_max_ms() == 2000

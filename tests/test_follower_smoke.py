@@ -1,0 +1,232 @@
+"""Tier-1 follower TCP smoke: a real 2-replica cluster + 1 follower +
+the read-steering router, end to end over the native bus.
+
+The wire-level half of the follower contract (the state-machine half
+lives in tests/test_follower.py's deterministic sim):
+
+- an UNATTESTED follower refuses typed and the router transparently
+  re-drives the read on the primary path (reads never fail),
+- an attested follower serves reads whose replies carry a verifiable
+  (root, commit_min) attestation — checked here against the primary's
+  root ring via the scrape_state_root at-op query,
+- follower replies are byte-identical to the primary's for the same
+  data,
+- kill -9 of the follower redirects reads to the primary,
+- TB_READ_POLICY=primary pins the legacy path end to end (zero
+  follower reads, identical bodies).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.runtime.native import native_available
+from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native runtime not built"
+)
+
+CLUSTER = 9
+
+
+class _Loop:
+    """Background poll loop for any server with poll_once/close."""
+
+    def __init__(self, server):
+        self.server = server
+        self._stop = False
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        while not self._stop:
+            self.server.poll_once(timeout_ms=1)
+
+    def close(self):
+        self._stop = True
+        self.thread.join(timeout=5)
+        self.server.close()
+
+
+def _ids_body(ids):
+    arr = np.zeros(len(ids), types.U128_PAIR_DTYPE)
+    for i, v in enumerate(ids):
+        arr[i]["lo"] = v
+    return arr.tobytes()
+
+
+def _read_once(session, body, timeout_s=20.0):
+    """One lookup_accounts round trip through an OpenLoopSession;
+    returns the completion tuple."""
+    req = session.submit(types.Operation.lookup_accounts, body)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        session.poll(20)
+        done = [c for c in session.completed if c[0] == req]
+        if done:
+            return done[0]
+    raise TimeoutError("read did not complete")
+
+
+def test_follower_smoke(tmp_path, monkeypatch):
+    from tigerbeetle_tpu.client import Client, OpenLoopSession
+    from tigerbeetle_tpu.obs.scrape import scrape_state_root, scrape_stats
+    from tigerbeetle_tpu.runtime.follower import FollowerServer
+    from tigerbeetle_tpu.runtime.router import RouterServer
+    from tigerbeetle_tpu.runtime.server import (
+        ReplicaServer,
+        format_data_file,
+    )
+
+    # Phase control: the first attestation is manually released so the
+    # unattested-refusal -> primary-fallback path is deterministic.
+    monkeypatch.setenv("TB_FOLLOWER_ATTEST_MS", "60000")
+    aof_path = str(tmp_path / "r0.aof")
+    paths = [str(tmp_path / f"r{i}.tb") for i in range(2)]
+    for i in range(2):
+        format_data_file(paths[i], cluster=CLUSTER, replica_index=i,
+                         replica_count=2, config=cfg.TEST_MIN)
+    loops = []
+    clients = []
+    try:
+        # Replica addresses are bound by the servers themselves
+        # (port 0), so start replicas first, then everyone else.
+        replicas = []
+        addresses = ["127.0.0.1:0", "127.0.0.1:0"]
+        servers = []
+        for i in range(2):
+            srv = ReplicaServer(
+                paths[i], cluster=CLUSTER, addresses=addresses,
+                replica_index=i,
+                state_machine_factory=lambda: CpuStateMachine(cfg.TEST_MIN),
+                config=cfg.TEST_MIN,
+                aof_path=aof_path if i == 0 else None,
+            )
+            addresses[i] = f"127.0.0.1:{srv.port}"
+            srv.bus.addresses = addresses  # rewritten with real ports
+            servers.append(srv)
+        for srv in servers:
+            loops.append(_Loop(srv))
+            replicas.append(srv)
+        assert replicas[0].replica.root_ring is not None  # TB_ROOT_RING
+
+        fsrv = FollowerServer(
+            "127.0.0.1:0", aof_path=aof_path,
+            upstream_address=addresses[0], cluster=CLUSTER,
+            state_machine=CpuStateMachine(cfg.TEST_MIN),
+            clock_ns=time.monotonic_ns, follower_id=3,
+        )
+        f_addr = f"127.0.0.1:{fsrv.port}"
+        f_loop = _Loop(fsrv)
+        loops.append(f_loop)
+
+        router = RouterServer(
+            "127.0.0.1:0", [",".join(addresses)], cluster=CLUSTER,
+            recover=False, follower_addresses=[f"0:{f_addr}"],
+        )
+        assert router.read_policy == "follower"  # auto + followers
+        loops.append(_Loop(router))
+        r_addr = f"127.0.0.1:{router.port}"
+
+        # Seed data THROUGH the router (it is the client surface).
+        setup = Client(r_addr, CLUSTER, client_id=77, timeout_ms=60_000)
+        clients.append(setup)
+        assert setup.create_accounts(
+            [{"id": 1, "ledger": 1, "code": 1},
+             {"id": 2, "ledger": 1, "code": 1}]
+        ) == []
+        assert setup.create_transfers(
+            [{"id": 5, "debit_account_id": 1, "credit_account_id": 2,
+              "amount": 11, "ledger": 1, "code": 1}]
+        ) == []
+
+        session = OpenLoopSession(r_addr, CLUSTER, 0xF00D)
+        body = _ids_body([1, 2])
+
+        # -- Phase A: unattested follower -> typed refusal -> the
+        # router re-drives on the primary; the client still gets its
+        # answer (reads never fail because a follower can't serve).
+        comp = _read_once(session, body)
+        assert comp[1] == "reply"
+        primary_body = comp[3]
+        rows = np.frombuffer(primary_body, types.ACCOUNT_DTYPE)
+        assert types.u128_get(rows[0], "debits_posted") == 11
+        assert comp[5][0] == "primary"
+        rsnap = scrape_stats(r_addr, CLUSTER, timeout_ms=20_000)
+        assert rsnap["router.follower_reads"] >= 1
+        assert rsnap["router.follower_redirects"] >= 1
+        fsnap = scrape_stats(f_addr, CLUSTER, timeout_ms=20_000)
+        assert fsnap["follower.refused"] >= 1
+        assert fsnap["follower.attested_op"] == 0
+
+        # -- Phase B: release attestation; the follower catches up,
+        # verifies its root against the upstream ring, and serves.
+        fsrv._attest_ns = 50_000_000  # 50 ms cadence from here on
+        deadline = time.monotonic() + 30.0
+        comp = None
+        while time.monotonic() < deadline:
+            comp = _read_once(session, body)
+            if comp[1] == "reply" and comp[5][0] == "follower":
+                break
+            time.sleep(0.2)
+        assert comp is not None and comp[5][0] == "follower", comp
+        tier, server_id, commit_min, root = comp[5]
+        assert server_id == 3 and commit_min > 0 and len(root) == 16
+        # Reply body bit-identical to the primary-served phase-A body.
+        assert comp[3] == primary_body
+        # Attestation verifiable against the PRIMARY's root ring: the
+        # at-op scrape must return the identical root at the claimed
+        # commit_min (the client-side verification story).
+        proot, pop = scrape_state_root(
+            addresses[0], CLUSTER, timeout_ms=20_000, at_op=commit_min
+        )
+        assert pop == commit_min, "primary no longer retains the op"
+        assert proot == root, "follower attestation mismatch"
+        # The follower's own state_root query agrees.
+        froot, fop = scrape_state_root(f_addr, CLUSTER,
+                                       timeout_ms=20_000)
+        assert fop >= commit_min and froot != bytes(16)
+
+        # -- Phase C: kill -9 the follower; reads redirect to the
+        # primary and keep succeeding.
+        f_loop.close()
+        loops.remove(f_loop)
+        for _ in range(3):
+            comp = _read_once(session, body)
+            assert comp[1] == "reply"
+            assert comp[3] == primary_body
+        assert comp[5][0] == "primary"
+
+        # -- Phase D: TB_READ_POLICY=primary pins the legacy path even
+        # with followers configured.
+        monkeypatch.setenv("TB_READ_POLICY", "primary")
+        router2 = RouterServer(
+            "127.0.0.1:0", [",".join(addresses)], cluster=CLUSTER,
+            recover=False, follower_addresses=[f"0:{f_addr}"],
+        )
+        assert router2.read_policy == "primary"
+        loops.append(_Loop(router2))
+        session2 = OpenLoopSession(
+            f"127.0.0.1:{router2.port}", CLUSTER, 0xF00E
+        )
+        comp = _read_once(session2, body)
+        assert comp[1] == "reply" and comp[5][0] == "primary"
+        assert comp[3] == primary_body
+        r2snap = scrape_stats(f"127.0.0.1:{router2.port}", CLUSTER,
+                              timeout_ms=20_000)
+        assert r2snap["router.follower_reads"] == 0
+        session2.close()
+        session.close()
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for loop in loops:
+            loop.close()

@@ -453,3 +453,64 @@ def test_shed_busy_payload_carries_tenant_and_rate():
     tenant, depth, _rps = seen[0]
     assert tenant == 4 and depth == 1
     assert r.qos.rate_of(4) >= 0  # observed-rate window is live
+
+
+# ----------------------------------------------------------------------
+# Per-tenant BYTE accounting (round 19, TB_TENANT_RATE_BYTES).
+
+
+def test_byte_bucket_charges_by_body_bytes():
+    """Mixed-size batches can't cheat the count bucket: with a byte
+    rate configured, admission is priced by body size."""
+    q = TenantQos(rate_bytes=1000.0)  # burst = 1000 body bytes
+    t0 = 10**9
+    assert q.admit(7, t0, 0, body_bytes=600)
+    assert not q.admit(7, t0, 0, body_bytes=600)  # budget exhausted
+    assert q.admit(7, t0, 0, body_bytes=300)      # small still fits
+    # ~1 second refills the byte budget.
+    assert q.admit(7, t0 + 10**9, 0, body_bytes=900)
+
+
+def test_byte_bucket_zero_rate_is_off():
+    q = TenantQos(rate_bytes=0.0)
+    t0 = 10**9
+    for _ in range(100):
+        assert q.admit(7, t0, 0, body_bytes=1 << 20)
+
+
+def test_dual_bucket_charge_is_atomic():
+    """A request the BYTE bucket refuses must not drain a COUNT token
+    (and vice versa): the shed leaves no half-charge behind."""
+    q = TenantQos(rate=1.0, rate_bytes=100.0)  # burst: 1 req, 100 bytes
+    t0 = 10**9
+    # Byte-refused: the count token must survive.
+    assert not q.admit(7, t0, 0, body_bytes=500)
+    assert q.admit(7, t0, 0, body_bytes=50)
+    # Count now exhausted: a zero-byte request is refused by count and
+    # must not drain the remaining byte budget.
+    assert not q.admit(7, t0, 0, body_bytes=50)
+    assert q._byte_buckets[7].tokens == pytest.approx(50.0)
+
+
+def test_byte_bucket_overflow_tenants_share():
+    """Past TENANTS_MAX distinct tenants, byte buckets share the
+    overflow bucket exactly like count buckets — an id sweep cannot
+    mint fresh byte budget."""
+    q = TenantQos(rate_bytes=100.0)
+    t0 = 10**9
+    for tenant in range(TenantQos.TENANTS_MAX):
+        assert q.admit(tenant, t0, 0, body_bytes=1)
+    assert q.admit(9999, t0, 0, body_bytes=90)   # overflow bucket
+    assert not q.admit(8888, t0, 0, body_bytes=90)  # shared, drained
+
+
+def test_follower_read_admission_uses_byte_bucket():
+    """The follower charges reads by body bytes through the same
+    TenantQos — covered end-to-end in tests/test_follower.py
+    (test_core_read_admission_charges_bytes); here: the bucket state
+    is per-tenant."""
+    q = TenantQos(rate_bytes=100.0)
+    t0 = 10**9
+    assert q.admit(1, t0, 0, body_bytes=90)
+    assert not q.admit(1, t0, 0, body_bytes=90)
+    assert q.admit(2, t0, 0, body_bytes=90)  # other tenant unaffected

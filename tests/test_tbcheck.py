@@ -294,6 +294,11 @@ def test_import_graph_sim_reachable_set():
         "tigerbeetle_tpu.testing.chaos",  # lazily imported by vopr
         "tigerbeetle_tpu.qos",
         "tigerbeetle_tpu.utils.worker",
+        # r19: SimFollower drives the follower core inside the sim,
+        # so the module is clock-free (FollowerServer's wall clock is
+        # injected at the process edge, cli.py/bench.py).
+        "tigerbeetle_tpu.runtime.follower",
+        "tigerbeetle_tpu.vsr.aof",
     }
     assert must_be_in <= sim, must_be_in - sim
     must_be_out = {

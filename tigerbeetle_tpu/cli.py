@@ -37,10 +37,20 @@ commands:
              <path>...
   router     --listen=<host:port> --shards=<addrs;addrs;...>
              [--cluster=<int>] [--no-recover]
+             [--followers=<shard:host:port;...>]
              (account-sharded multi-cluster front-end: each ';'-
               separated entry is one shard's comma-joined replica
               address list; on start the router recovers in-doubt
-              cross-shard transfers from shard state)
+              cross-shard transfers from shard state; --followers
+              names read-only followers reads steer to under
+              TB_READ_POLICY)
+  follower   --listen=<host:port> --aof=<path> --upstream=<host:port>
+             --cluster=<int> [--id=<n>]
+             (read-only follower: tails the upstream replica's AOF,
+              replays it, serves lookup/filter reads at a stated
+              commit_min with every reply carrying the r15 state root,
+              attested against the upstream's root ring — refuses
+              typed rather than serve unverifiable state)
   version
   repl       --addresses=<host:port> [--cluster=<int>] [--command=<stmts>]
   benchmark  [--transfers=N] [--accounts=N] [--batch=N] [--addresses=...]
@@ -150,7 +160,7 @@ def cmd_router(args: list[str]) -> None:
     opts, paths = flags.parse(
         args,
         {"listen": "127.0.0.1:3000", "shards": None, "cluster": 0,
-         "no_recover": False},
+         "no_recover": False, "followers": ""},
     )
     if paths:
         flags.fatal("router takes no positional arguments")
@@ -161,11 +171,53 @@ def cmd_router(args: list[str]) -> None:
     server = RouterServer(
         opts["listen"], opts["shards"].split(";"),
         cluster=opts["cluster"], recover=not opts["no_recover"],
+        follower_addresses=(
+            opts["followers"].split(";") if opts["followers"] else None
+        ),
     )
     print(
         f"router listening on port {server.port} "
         f"({server.n_shards} shards)", flush=True,
     )
+    import signal
+
+    def _stop(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _stop)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+
+
+def cmd_follower(args: list[str]) -> None:
+    opts, paths = flags.parse(
+        args,
+        {"listen": "127.0.0.1:0", "aof": None, "upstream": None,
+         "cluster": 0, "id": 0},
+    )
+    if paths:
+        flags.fatal("follower takes no positional arguments")
+    if not opts["aof"] or not opts["upstream"]:
+        flags.fatal("follower requires --aof=<path> and "
+                    "--upstream=<host:port>")
+    from tigerbeetle_tpu.runtime.follower import FollowerServer
+    from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+    # Followers replay on the CPU state machine (deterministic host
+    # replay, no device needed; r15 pins its state_root to the TPU
+    # engine's for the same commit stream) — a device-engine follower
+    # is a deliberate scope cut for now.
+    server = FollowerServer(
+        opts["listen"], aof_path=opts["aof"],
+        upstream_address=opts["upstream"], cluster=opts["cluster"],
+        state_machine=CpuStateMachine(cfg.PRODUCTION),
+        clock_ns=time.monotonic_ns, follower_id=opts["id"],
+    )
+    print(f"follower listening on port {server.port}", flush=True)
     import signal
 
     def _stop(signum, frame):
@@ -259,6 +311,8 @@ def main(argv: list[str] | None = None) -> None:
         cmd_start(rest)
     elif command == "router":
         cmd_router(rest)
+    elif command == "follower":
+        cmd_follower(rest)
     elif command == "repl":
         cmd_repl(rest)
     elif command == "benchmark":

@@ -180,10 +180,14 @@ class OpenLoopSession:
         # it is a RETRANSMIT, so the at-most-once gate still applies).
         self.inflight: dict[int, tuple[int, int, bytes]] = {}
         # (request_number, kind "reply"|"busy", latency_s, reply_body,
-        #  operation) — the operation rides along so a mixed-op driver
-        # (the read-heavy open-loop bench) can grade reads and writes
-        # separately.
-        self.completed: list[tuple[int, str, float, bytes, int]] = []
+        #  operation, tier) — the operation rides along so a mixed-op
+        # driver (the read-heavy open-loop bench) can grade reads and
+        # writes separately; `tier` records WHO served the completion
+        # (round 19): ("primary"|"follower", server id, claimed
+        # commit_min, attested root bytes) — zero/empty for primary
+        # replies, so the bench's write-p99-flat grade can attribute
+        # interference and a client can verify follower attestations.
+        self.completed: list[tuple[int, str, float, bytes, int, tuple]] = []
         self.busy_replies = 0
         # Busy backoff (TB_BUSY_BACKOFF_MS; round 16): a shed request
         # retransmits after base * 2^(streak-1) ms (capped 16x) plus
@@ -319,6 +323,11 @@ class OpenLoopSession:
                 if (
                     self._backoff_base_ns > 0
                     and streak <= self.BUSY_RETRIES_MAX
+                    # A FOLLOWER refusal is a redirect, not overload:
+                    # retransmitting at the same follower would just
+                    # collect the same typed refusal — surface it so
+                    # the driver re-routes to the primary.
+                    and wire.parse_follower_busy(body) is None
                 ):
                     # Hold the request in flight and retransmit after
                     # capped exponential backoff (qos.backoff_delay:
@@ -339,7 +348,9 @@ class OpenLoopSession:
                 self._retry_at.pop(req, None)
                 t0, op, _frame = entry
                 lat = (time.perf_counter_ns() - t0) / 1e9
-                self.completed.append((req, "busy", lat, b"", op))
+                self.completed.append(
+                    (req, "busy", lat, b"", op, self._tier_of(h, body))
+                )
         elif cmd == int(wire.Command.reply):
             if entry is not None:
                 del self.inflight[req]
@@ -347,9 +358,24 @@ class OpenLoopSession:
                 self._retry_at.pop(req, None)
                 t0, op, _frame = entry
                 lat = (time.perf_counter_ns() - t0) / 1e9
-                self.completed.append((req, "reply", lat, body, op))
+                self.completed.append(
+                    (req, "reply", lat, body, op, self._tier_of(h, b""))
+                )
         elif cmd == int(wire.Command.eviction):
             raise RuntimeError(f"open-loop client {self.id:#x} evicted")
+
+    def _tier_of(self, h, busy_body: bytes) -> tuple:
+        """Serving-tier attribution of one completion: a reply with an
+        attestation carve-out (or a typed follower busy) was follower-
+        served; everything else is the primary path."""
+        wire = self._wire
+        att = wire.attestation_of(h)
+        if att is not None:
+            return ("follower", int(h["replica"]), att[1], att[0])
+        fb = wire.parse_follower_busy(busy_body) if busy_body else None
+        if fb is not None:
+            return ("follower", fb[1], fb[3], b"")
+        return ("primary", int(h["replica"]), 0, b"")
 
     def close(self) -> None:
         self.bus.close()

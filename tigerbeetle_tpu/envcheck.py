@@ -300,6 +300,96 @@ def scrub_fallback_every() -> int:
 
 
 # ----------------------------------------------------------------------
+# Root-attested follower serving (runtime/follower.py; round 19).
+
+
+def root_ring() -> int:
+    """TB_ROOT_RING: how many recent commits' state roots a replica
+    retains for the `state_root` at-op query (the follower attestation
+    primitive; 16 bytes + dict entry per op).  0 disables — at-op
+    queries then answer the current root and followers can only attest
+    when exactly caught up."""
+    return env_int("TB_ROOT_RING", 4096, minimum=0, maximum=1 << 20)
+
+
+def read_policy() -> str:
+    """TB_READ_POLICY: where the router steers read operations
+    (lookup/filter queries):
+
+    - "primary" pins the legacy path end to end — every read rides
+      consensus exactly as before followers existed.
+    - "follower" prefers a configured follower whenever the read is
+      follower-servable (single-shard), falling back to the primary on
+      refusal/timeout.
+    - "auto" (default): like "follower" when followers are configured,
+      "primary" otherwise.
+    """
+    return env_choice(
+        "TB_READ_POLICY", "auto", ("auto", "primary", "follower")
+    )
+
+
+def read_staleness_ops() -> int:
+    """TB_READ_STALENESS_OPS: bounded-staleness policy — the most ops
+    a serving follower may lag the primary's attested commit point
+    before it refuses reads with a typed `lagging` busy (clients /
+    the router then redirect to the primary).  0 = the follower only
+    serves when fully caught up to the last attestation."""
+    return env_int("TB_READ_STALENESS_OPS", 512, minimum=0,
+                   maximum=1 << 30)
+
+
+def follower_attest_ms() -> int:
+    """TB_FOLLOWER_ATTEST_MS: cadence of the follower's attestation
+    query (state_root at-op against the upstream replica).  Lower =
+    fresher lag estimate + tighter divergence detection window, more
+    query traffic."""
+    return env_int("TB_FOLLOWER_ATTEST_MS", 100, minimum=1,
+                   maximum=60_000)
+
+
+def follower_attest_max_ms() -> int:
+    """TB_FOLLOWER_ATTEST_MAX_MS: maximum age of the last successful
+    attestation before a follower refuses reads as `lagging`.  The
+    lag estimate (last_primary_op) is a high-water mark fed by
+    attestation replies — under a FULL partition (upstream AND log
+    unreachable) nothing moves it, so without an age bound a follower
+    that attested once would serve frozen state forever while
+    claiming lag 0.  Must exceed the attestation cadence
+    (TB_FOLLOWER_ATTEST_MS) with room for a few lost replies; the
+    default (2000 ms) is 20 cadences of the default 100 ms."""
+    return env_int("TB_FOLLOWER_ATTEST_MAX_MS", 2000, minimum=1,
+                   maximum=24 * 3600 * 1000)
+
+
+def follower_ring() -> int:
+    """TB_FOLLOWER_ROOT_RING: per-op state roots the FOLLOWER retains
+    while replaying, for verifying primary attestations that answer a
+    few ops behind its replay head.  Named constraint: must be >= 16 —
+    a ring smaller than one attestation round trip's worth of commits
+    discards the root every verification needs and the follower can
+    never attest under write load."""
+    return env_int("TB_FOLLOWER_ROOT_RING", 4096, minimum=16,
+                   maximum=1 << 20)
+
+
+def read_scale_secs() -> float:
+    """BENCH_READ_SCALE_SECS: seconds per read-scale bench arm (one
+    arm per follower count)."""
+    return env_float("BENCH_READ_SCALE_SECS", 3.0, minimum=0.1)
+
+
+def read_fallback_ms() -> int:
+    """TB_READ_FALLBACK_MS: how long the router waits for a follower's
+    read reply before re-driving the read through the primary path.
+    Bounds the worst case a dead follower can add to one read; the
+    per-follower backoff (qos.backoff_delay) keeps later reads from
+    re-paying it every time."""
+    return env_int("TB_READ_FALLBACK_MS", 250, minimum=10,
+                   maximum=60_000)
+
+
+# ----------------------------------------------------------------------
 # Multi-tenant QoS (qos.py; round 16).  The tenant key is the LEDGER.
 
 
@@ -318,6 +408,17 @@ def tenant_rate() -> float:
     rate limiting — QoS-on under non-overload stays bit-identical to
     QoS-off; the queue bounds still apply."""
     return env_float("TB_TENANT_RATE", 0.0, minimum=0.0)
+
+
+def tenant_rate_bytes() -> float:
+    """TB_TENANT_RATE_BYTES: per-tenant admission rate in BODY BYTES
+    per second (a second token bucket next to the request-count one).
+    Mixed-size batches cheat a request-count bucket — one tenant's
+    8k-event batches cost the same token as another's single event —
+    so overload protection for byte-bound resources (decode, WAL
+    bandwidth, follower replay) charges by size.  0 (default)
+    disables; both buckets must admit when both are configured."""
+    return env_float("TB_TENANT_RATE_BYTES", 0.0, minimum=0.0)
 
 
 def tenant_queue(admit_queue: int) -> int:

@@ -65,12 +65,16 @@ def scrape_stats(address: str, cluster: int, timeout_ms: int = 10_000) -> dict:
 
 
 def scrape_state_root(
-    address: str, cluster: int, timeout_ms: int = 10_000
+    address: str, cluster: int, timeout_ms: int = 10_000,
+    at_op: int | None = None,
 ) -> tuple[bytes, int]:
     """Proof-of-state query: the replica's 16-byte state commitment
     (state_machine/commitment.py) + the commit_min it covers.  Same
     sessionless shape as the stats scrape — read-only, answered by the
-    server loop, never enters consensus."""
+    server loop, never enters consensus.  `at_op` asks for the root AS
+    OF a specific op (answered from the replica's root ring when
+    retained — the follower attestation query); callers must check the
+    returned op, since a server without that op answers current."""
     from tigerbeetle_tpu.runtime.native import EV_MESSAGE, NativeBus
     from tigerbeetle_tpu.state_machine import commitment
 
@@ -82,8 +86,9 @@ def scrape_state_root(
             command=Command.request, operation=VsrOperation.state_root,
             cluster=cluster, request=SCRAPE_REQUEST,
         )
-        wire.finalize_header(h, b"")
-        bus.send(conn, h.tobytes())
+        qbody = b"" if at_op is None else commitment.root_query_body(at_op)
+        wire.finalize_header(h, qbody)
+        bus.send(conn, h.tobytes() + qbody)
         deadline = time.monotonic() + timeout_ms / 1e3
         while time.monotonic() < deadline:
             for ev_type, _conn, payload in bus.poll(50):
@@ -101,7 +106,7 @@ def scrape_state_root(
                     # bound (unlike stats, answered pre-admission): a
                     # shed under load replies client_busy.  Resend
                     # instead of burning the rest of the deadline.
-                    bus.send(conn, h.tobytes())
+                    bus.send(conn, h.tobytes() + qbody)
                     continue
                 if (
                     int(header["command"]) == int(Command.reply)

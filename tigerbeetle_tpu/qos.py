@@ -56,6 +56,17 @@ class TokenBucket:
     def admit(self, now_ns: int, cost: float = 1.0) -> bool:
         """Take `cost` tokens if available.  `now_ns` must be
         monotonic non-decreasing (caller-supplied clock)."""
+        if self.peek(now_ns, cost):
+            self.take(cost)
+            return True
+        return False
+
+    def peek(self, now_ns: int, cost: float = 1.0) -> bool:
+        """Refill, then check WITHOUT consuming — the two-bucket
+        admission (request count AND body bytes) must be atomic: a
+        request one bucket refuses must not drain the other (the same
+        no-unrefunded-charge rule as the global-bound-first check in
+        VsrReplica._enqueue_request)."""
         if self.rate <= 0.0:
             return True
         if now_ns > self.last_ns:
@@ -64,10 +75,13 @@ class TokenBucket:
                 self.tokens + (now_ns - self.last_ns) * 1e-9 * self.rate,
             )
             self.last_ns = now_ns
-        if self.tokens >= cost:
+        return self.tokens >= cost
+
+    def take(self, cost: float = 1.0) -> None:
+        """Consume after a successful peek (no refill: peek just
+        refilled at the same clock reading)."""
+        if self.rate > 0.0:
             self.tokens -= cost
-            return True
-        return False
 
 
 class WeightedFair:
@@ -200,14 +214,22 @@ class TenantQos:
 
     TENANTS_MAX = 64
 
-    def __init__(self, *, rate: float = 0.0, queue_bound: int = 0,
+    def __init__(self, *, rate: float = 0.0, rate_bytes: float = 0.0,
+                 queue_bound: int = 0,
                  weights: dict[int, float] | None = None,
                  registry=None) -> None:
         self.rate = float(rate)
+        # Byte accounting (round 19, TB_TENANT_RATE_BYTES): a second
+        # bucket charged by request BODY BYTES, so mixed-size batches
+        # cannot cheat the request-count bucket (one 8k-event batch
+        # and one single-event request cost the same count token but
+        # ~8000x the decode/replay work).  0 = off.
+        self.rate_bytes = float(rate_bytes)
         self.queue_bound = int(queue_bound)
         self.wfq = WeightedFair(weights)
         self.window = RateWindow(cap=self.TENANTS_MAX)
         self._buckets: dict[int, TokenBucket] = {}
+        self._byte_buckets: dict[int, TokenBucket] = {}
         self._registry = registry
         self._metrics: dict[int, tuple] = {}
         self.sheds = 0
@@ -221,33 +243,57 @@ class TenantQos:
         tenant's offered load, not just what survived the bucket."""
         self.window.observe(tenant, now_ns)
 
-    def admit(self, tenant: int, now_ns: int, queued: int) -> bool:
+    def _bucket(self, store: dict, rate: float, tenant: int,
+                now_ns: int) -> TokenBucket:
+        bucket = store.get(tenant)
+        if bucket is None:
+            if len(store) >= self.TENANTS_MAX:
+                # Bounded state WITHOUT eviction: tenants beyond
+                # the cap share ONE overflow bucket (key -1, the
+                # `tother` pattern).  Evicting + re-creating
+                # instead would hand every returning tenant a
+                # fresh full burst — the tenant key is
+                # client-controlled (header stamp / body ledger),
+                # so an id sweep could cycle a hot tenant through
+                # eviction and sustain far above its configured
+                # rate.  Sharing under-admits the sweep: the safe
+                # direction for overload protection.
+                bucket = store.get(-1)
+                if bucket is not None:
+                    return bucket
+                tenant = -1
+            bucket = TokenBucket(rate)
+            bucket.last_ns = now_ns
+            store[tenant] = bucket
+        return bucket
+
+    def admit(self, tenant: int, now_ns: int, queued: int,
+              body_bytes: int = 0) -> bool:
         """True = enqueue; False = shed.  `queued` is the tenant's
-        current queue depth (owned by the caller's queue)."""
+        current queue depth (owned by the caller's queue);
+        `body_bytes` charges the byte bucket when TB_TENANT_RATE_BYTES
+        is configured.  Charging is ATOMIC across the two buckets:
+        both are checked before either is drained, so a shed never
+        leaves a half-charge behind."""
         if self.queue_bound > 0 and queued >= self.queue_bound:
             return False
+        count_bucket = byte_bucket = None
         if self.rate > 0.0:
-            bucket = self._buckets.get(tenant)
-            if bucket is None:
-                if len(self._buckets) >= self.TENANTS_MAX:
-                    # Bounded state WITHOUT eviction: tenants beyond
-                    # the cap share ONE overflow bucket (key -1, the
-                    # `tother` pattern).  Evicting + re-creating
-                    # instead would hand every returning tenant a
-                    # fresh full burst — the tenant key is
-                    # client-controlled (header stamp / body ledger),
-                    # so an id sweep could cycle a hot tenant through
-                    # eviction and sustain far above its configured
-                    # rate.  Sharing under-admits the sweep: the safe
-                    # direction for overload protection.
-                    tenant = -1
-                    bucket = self._buckets.get(tenant)
-                if bucket is None:
-                    bucket = TokenBucket(self.rate)
-                    bucket.last_ns = now_ns
-                    self._buckets[tenant] = bucket
-            if not bucket.admit(now_ns):
+            count_bucket = self._bucket(
+                self._buckets, self.rate, tenant, now_ns
+            )
+            if not count_bucket.peek(now_ns):
                 return False
+        if self.rate_bytes > 0.0:
+            byte_bucket = self._bucket(
+                self._byte_buckets, self.rate_bytes, tenant, now_ns
+            )
+            if not byte_bucket.peek(now_ns, float(body_bytes)):
+                return False
+        if count_bucket is not None:
+            count_bucket.take()
+        if byte_bucket is not None:
+            byte_bucket.take(float(body_bytes))
         return True
 
     def rate_of(self, tenant: int) -> int:

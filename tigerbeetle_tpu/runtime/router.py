@@ -1199,7 +1199,8 @@ class RouterServer:
     def __init__(self, listen_address: str, shard_addresses: list[str],
                  *, cluster: int = 0, recover: bool = True,
                  message_size_max: int = 1 << 20,
-                 incarnation: int | None = None) -> None:
+                 incarnation: int | None = None,
+                 follower_addresses: list[str] | None = None) -> None:
         from tigerbeetle_tpu.obs.flight import FlightRecorder
         from tigerbeetle_tpu.runtime.native import (
             EV_CLOSED, EV_MESSAGE, NativeBus,
@@ -1242,6 +1243,7 @@ class RouterServer:
 
             self.qos = TenantQos(
                 rate=envcheck.tenant_rate(),
+                rate_bytes=envcheck.tenant_rate_bytes(),
                 queue_bound=envcheck.tenant_queue(self.admit_queue),
                 weights=envcheck.tenant_weights(),
                 registry=self.registry.scope("router.qos"),
@@ -1250,6 +1252,44 @@ class RouterServer:
         self._c_shed = self.registry.counter("router.shed")
         self._c_retries = self.registry.counter("router.retries")
         self._c_shard_busy = self.registry.counter("router.shard_busy")
+        # Read steering (round 19): reads go to root-attested
+        # followers under TB_READ_POLICY, falling back to the primary
+        # path on refusal / timeout / death — a dead follower can slow
+        # one read by TB_READ_FALLBACK_MS, never fail it.  Entries are
+        # "shard:host:port" (or "host:port" = shard 0); a follower
+        # tails ONE shard's AOF and serves only reads that resolve to
+        # that shard (lookup_transfers additionally needs n_shards==1:
+        # the sharded path merges 2PC legs across shards into
+        # client-view rows, which a single shard's follower cannot).
+        self.followers: list[dict] = []
+        for fid, entry in enumerate(follower_addresses or []):
+            shard_s, sep, addr = entry.partition(":")
+            if sep and ":" in addr and shard_s.isdigit():
+                shard = int(shard_s)
+            else:
+                shard, addr = 0, entry
+            assert 0 <= shard < self.n_shards, entry
+            self.followers.append({
+                "id": fid, "shard": shard,
+                "addr": parse_address(addr), "conn": None,
+                "streak": 0, "not_before": 0,
+            })
+        self.read_policy = envcheck.read_policy()
+        if self.read_policy == "auto":
+            self.read_policy = (
+                "follower" if self.followers else "primary"
+            )
+        self.read_fallback_ns = envcheck.read_fallback_ms() * 1_000_000
+        self._conn_follower: dict[int, int] = {}  # conn -> follower idx
+        # (client, request) of reads currently riding a follower.
+        self._frd: dict[tuple[int, int], dict] = {}
+        self._c_frd = self.registry.counter("router.follower_reads")
+        self._c_frd_redirects = self.registry.counter(
+            "router.follower_redirects"
+        )
+        self._c_frd_timeouts = self.registry.counter(
+            "router.follower_timeouts"
+        )
         self.registry.gauge_fn("router.open_requests",
                                lambda: len(self._open))
         self.registry.gauge_fn("router.admit_queue",
@@ -1324,6 +1364,182 @@ class RouterServer:
         if shard is not None and self._shard_conn.get(shard) == conn:
             self._shard_conn[shard] = None
             self._shard_target[shard] += 1  # rotate replica on reconnect
+
+    # -- follower read steering ----------------------------------------
+
+    # Int view of the one shared read-op definition (types.py).
+    _READ_OPS = frozenset(int(op) for op in types.READ_OPERATIONS)
+
+    def _read_shard(self, operation: int, body: bytes) -> int | None:
+        """The single shard a read resolves to, or None when it is not
+        follower-servable (multi-shard id set; lookup_transfers in a
+        sharded deployment — see __init__)."""
+        try:
+            if operation in (int(Operation.get_account_transfers),
+                             int(Operation.get_account_balances)):
+                if len(body) != ACCOUNT_FILTER_DTYPE.itemsize:
+                    return None
+                row = np.frombuffer(body, ACCOUNT_FILTER_DTYPE)[0]
+                return shard_of_account(
+                    u128_get(row, "account_id"), self.n_shards
+                )
+            if operation == int(Operation.lookup_accounts):
+                if len(body) % U128_PAIR_DTYPE.itemsize or not body:
+                    return None
+                rows = np.frombuffer(body, U128_PAIR_DTYPE)
+                shards = {
+                    shard_of_account(
+                        int(r["lo"]) | (int(r["hi"]) << 64),
+                        self.n_shards,
+                    )
+                    for r in rows
+                }
+                return shards.pop() if len(shards) == 1 else None
+            if operation == int(Operation.lookup_transfers):
+                return 0 if self.n_shards == 1 else None
+        except (ValueError, KeyError):
+            return None
+        return None
+
+    def _pick_follower(self, shard: int, now: int) -> dict | None:
+        """A healthy follower for `shard`: not inside its failure
+        backoff window (qos.backoff_delay per consecutive failure, so
+        a dead follower costs one timeout per backoff window, not one
+        per read)."""
+        best = None
+        for f in self.followers:
+            if f["shard"] != shard or now < f["not_before"]:
+                continue
+            if best is None or f["streak"] < best["streak"]:
+                best = f
+        return best
+
+    def _connect_follower(self, f: dict) -> int | None:
+        if f["conn"] is not None:
+            return f["conn"]
+        try:
+            conn = self.bus.connect(*f["addr"])
+        except OSError:
+            return None
+        f["conn"] = conn
+        self._conn_follower[conn] = f["id"]
+        return conn
+
+    def _follower_failed(self, f: dict, now: int) -> None:
+        f["streak"] = min(f["streak"] + 1, 16)
+        from tigerbeetle_tpu import qos as qos_mod
+
+        f["not_before"] = now + qos_mod.backoff_delay(
+            f["id"] + 1, 0, f["streak"], self.read_fallback_ns
+        )
+
+    def _try_follower_read(self, ctx: dict, operation: int,
+                           body: bytes, now: int) -> bool:
+        """Steer one admitted read at a follower.  True = in flight
+        (reply or fallback will finish it); False = use the primary
+        path now."""
+        if self.read_policy != "follower":
+            return False
+        shard = self._read_shard(operation, body)
+        if shard is None:
+            return False
+        f = self._pick_follower(shard, now)
+        if f is None:
+            return False
+        conn = self._connect_follower(f)
+        if conn is None:
+            self._follower_failed(f, now)
+            return False
+        wire = self._wire
+        h = wire.make_header(
+            command=wire.Command.request, operation=operation,
+            cluster=self.cluster, client=ctx["client"],
+            request=ctx["request"],
+        )
+        wire.copy_trace(h, ctx["header"])
+        h["tenant"] = ctx["header"]["tenant"]
+        wire.finalize_header(h, body)
+        self.bus.send(conn, h.tobytes() + body)
+        self._c_frd.inc()
+        key = (ctx["client"], ctx["request"])
+        self._frd[key] = {
+            "ctx": ctx, "follower": f, "body": body,
+            "operation": operation, "deadline": now + self.read_fallback_ns,
+        }
+        return True
+
+    def _frd_fallback(self, key: tuple, *, timeout: bool) -> None:
+        """Follower refused / timed out / died: re-drive the read
+        through the primary path — reads never fail because a
+        follower did."""
+        state = self._frd.pop(key, None)
+        if state is None:
+            return
+        # tbcheck: allow(determinism): RouterServer is the real-TCP
+        # front-end; retry/observe cadence runs on wall time.  The
+        # sim drives RouterCore, which takes injected ticks.
+        now = time.monotonic_ns()
+        self._follower_failed(state["follower"], now)
+        (self._c_frd_timeouts if timeout
+         else self._c_frd_redirects).inc()
+        ctx = state["ctx"]
+        self.flight.note(
+            "follower_read_fallback", client=ctx["client"],
+            request=ctx["request"], follower=state["follower"]["id"],
+            timeout=int(timeout),
+        )
+        if self._open.get((ctx["client"], ctx["request"])) is not ctx:
+            return  # request since completed/dropped elsewhere
+        trace = (int(ctx["header"]["trace_id"]),
+                 int(ctx["header"]["trace_ts"]),
+                 int(ctx["header"]["trace_flags"]))
+        task = self.core.open_request(
+            ctx["client"], ctx["request"], state["operation"],
+            state["body"], trace,
+        )
+        self._issue_subops(task.subops)
+        self._tasks.append((task, ctx))
+
+    def _on_follower_message(self, conn: int, header, body: bytes,
+                             cmd: int) -> None:
+        wire = self._wire
+        f = self.followers[self._conn_follower[conn]]
+        key = (wire.u128(header, "client"), int(header["request"]))
+        state = self._frd.get(key)
+        if state is None or state["follower"] is not f:
+            return
+        if cmd == int(wire.Command.reply):
+            self._frd.pop(key)
+            f["streak"] = 0
+            ctx = state["ctx"]
+            self._tenant_open_dec(self._open.pop(
+                (ctx["client"], ctx["request"]), None
+            ))
+            if self.qos is not None and ctx.get("tenant") is not None:
+                self.qos.on_reply(ctx["tenant"], ctx["header"])
+            cconn = self._client_conns.get(ctx["client"])
+            if cconn is None:
+                return
+            h = wire.make_header(
+                command=wire.Command.reply, cluster=self.cluster,
+                client=ctx["client"], request=ctx["request"],
+                operation=int(ctx["operation"]),
+                replica=int(header["replica"]),
+            )
+            wire.copy_trace(h, ctx["header"])
+            # Relay the attestation untouched: the CLIENT verifies
+            # (root, commit_min) against the cluster commitment — the
+            # router must not launder an unattested reply into an
+            # attested-looking one or vice versa.
+            h["state_root_lo"] = header["state_root_lo"]
+            h["state_root_hi"] = header["state_root_hi"]
+            h["root_op"] = header["root_op"]
+            wire.finalize_header(h, body)
+            self.bus.send(cconn, h.tobytes() + body)
+        elif cmd == int(wire.Command.client_busy):
+            # Typed follower refusal (lagging / unattested / corrupt /
+            # overload): redirect to the primary path.
+            self._frd_fallback(key, timeout=False)
 
     # -- subop issue / retry -------------------------------------------
 
@@ -1478,6 +1694,7 @@ class RouterServer:
         for ev_type, conn, payload in self.bus.poll(timeout_ms):
             if ev_type == self._ev_closed:
                 self._drop_shard_conn(conn)
+                self._drop_follower_conn(conn)
                 self._client_conns = {
                     c: k for c, k in self._client_conns.items()
                     if k != conn
@@ -1485,7 +1702,32 @@ class RouterServer:
             elif ev_type == self._ev_message:
                 self._on_message(conn, payload)
         self._retry_sweep()
+        self._frd_sweep()
         self._pump_tasks()
+
+    def _drop_follower_conn(self, conn: int) -> None:
+        fid = self._conn_follower.pop(conn, None)
+        if fid is None:
+            return
+        f = self.followers[fid]
+        if f["conn"] == conn:
+            f["conn"] = None
+        # Reads in flight on the dead follower fall back NOW (kill -9
+        # redirect, not a fallback-timeout wait).
+        for key in [k for k, s in self._frd.items()
+                    if s["follower"] is f]:
+            self._frd_fallback(key, timeout=False)
+
+    def _frd_sweep(self) -> None:
+        if not self._frd:
+            return
+        # tbcheck: allow(determinism): RouterServer is the real-TCP
+        # front-end; retry/observe cadence runs on wall time.  The
+        # sim drives RouterCore, which takes injected ticks.
+        now = time.monotonic_ns()
+        for key in [k for k, s in self._frd.items()
+                    if now >= s["deadline"]]:
+            self._frd_fallback(key, timeout=True)
 
     def serve_forever(self) -> None:
         while True:
@@ -1545,6 +1787,9 @@ class RouterServer:
         if not wire.verify_header(header, body):
             return
         cmd = int(header["command"])
+        if conn in self._conn_follower:
+            self._on_follower_message(conn, header, body, cmd)
+            return
         if conn in self._conn_shard:
             self._on_shard_message(conn, header, body, cmd)
             return
@@ -1624,6 +1869,7 @@ class RouterServer:
         caller already delivered a terminal eviction)."""
         for key in [k for k in self._open if k[0] == client]:
             ctx = self._open.pop(key)
+            self._frd.pop(key, None)
             self._tenant_open_dec(ctx)
             dead = [t for t, c in self._tasks if c is ctx]
             self._tasks = [(t, c) for t, c in self._tasks
@@ -1638,6 +1884,7 @@ class RouterServer:
         ctx = self._open.pop((client, request), None)
         if ctx is None:
             return
+        self._frd.pop((client, request), None)
         self._tenant_open_dec(ctx)
         # Drop the task AND every outstanding subop it owns (fwd and
         # coord alike) — an orphaned coord subop would otherwise stay
@@ -1774,7 +2021,8 @@ class RouterServer:
             self._send_busy(header, tenant)
             return
         if self.qos is not None:
-            if not self.qos.admit(tenant, now, self._open_of_tenant(tenant)):
+            if not self.qos.admit(tenant, now, self._open_of_tenant(tenant),
+                                  body_bytes=len(body)):
                 self._send_busy(header, tenant)
                 return
             self.qos.on_admit(tenant)
@@ -1790,6 +2038,14 @@ class RouterServer:
             self._tenant_open[tenant] = (
                 self._tenant_open.get(tenant, 0) + 1
             )
+        if operation in self._READ_OPS:
+            # tbcheck: allow(determinism): RouterServer is the
+            # real-TCP front-end; retry/observe cadence runs on wall
+            # time.  The sim drives RouterCore, with injected ticks.
+            frd_now = time.monotonic_ns()
+            if self._try_follower_read(ctx, operation, bytes(body),
+                                       frd_now):
+                return  # reply/fallback finishes it
         task = self.core.open_request(client, request, operation, body,
                                       trace)
         self._issue_subops(task.subops)

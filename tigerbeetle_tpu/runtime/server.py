@@ -336,6 +336,7 @@ class ReplicaServer:
 
             self.replica.qos = TenantQos(
                 rate=envcheck.tenant_rate(),
+                rate_bytes=envcheck.tenant_rate_bytes(),
                 queue_bound=envcheck.tenant_queue(self.admit_queue),
                 weights=envcheck.tenant_weights(),
                 registry=self.replica.metrics.scope("qos"),
@@ -346,6 +347,14 @@ class ReplicaServer:
                 "server.tenant_queue", lambda: qos.queue_bound
             )
         self.replica.open()
+        # Root ring (round 19): retain the state root of recent
+        # commits so the `state_root` at-op query can attest follower
+        # replays.  TB_ROOT_RING=0 disables; costs one state_root()
+        # read per commit (a 16-byte digest copy on the incremental-
+        # commitment state machines).
+        ring = envcheck.root_ring()
+        if ring and hasattr(self.replica.sm, "state_root"):
+            self.replica.enable_root_ring(ring)
         self._last_tick = 0
         self._last_stats = 0
         self._stats_snapshot: tuple | None = None
@@ -529,7 +538,9 @@ class ReplicaServer:
                 if int(header["operation"]) == int(
                     wire.VsrOperation.state_root
                 ):
-                    self._send_state_root_reply(conn, header)
+                    self._send_state_root_reply(
+                        conn, header, mv[off + HEADER_SIZE : end]
+                    )
                     continue
                 self.replica.anatomy.stage_h(header, "ingress")
                 self.bus.register_client(conn, wire.u128(header, "client"))
@@ -559,20 +570,31 @@ class ReplicaServer:
         reply, body = stats_reply(snap, header)
         self.bus.native.send(conn, reply.tobytes() + body)
 
-    def _send_state_root_reply(self, conn: int, header) -> None:
+    def _send_state_root_reply(self, conn: int, header,
+                               query: bytes = b"") -> None:
         # Proof-of-state hook (state_machine/commitment.py): the
         # 16-byte incremental state commitment + the commit_min it is
         # current to — read-only, sessionless, answered here so it can
         # never enter consensus.  Replicas without a commitment-aware
         # state machine answer zeros (the client treats an all-zero
-        # root as "not supported / empty").
+        # root as "not supported / empty").  A query body naming an op
+        # answers from the root ring (the follower attestation
+        # primitive) when that op is still retained; otherwise the
+        # current root goes out and the caller sees the op mismatch.
         from tigerbeetle_tpu.obs.scrape import state_root_reply
+        from tigerbeetle_tpu.state_machine import commitment
 
         sm = self.replica.sm
-        root = sm.state_root() if hasattr(sm, "state_root") else bytes(16)
-        reply, body = state_root_reply(
-            root, self.replica.commit_min, header
-        )
+        at_op = commitment.parse_root_query(bytes(query))
+        root = at_op_root = None
+        if at_op is not None:
+            at_op_root = self.replica.root_at(at_op)
+        if at_op_root is not None:
+            root, commit_min = at_op_root, at_op
+        else:
+            root = sm.state_root() if hasattr(sm, "state_root") else bytes(16)
+            commit_min = self.replica.commit_min
+        reply, body = state_root_reply(root, commit_min, header)
         self.bus.native.send(conn, reply.tobytes() + body)
 
     def _on_raw_message(self, conn: int, payload: bytes) -> None:
@@ -607,7 +629,7 @@ class ReplicaServer:
         if cmd == int(Command.request) and (
             int(header["operation"]) == int(wire.VsrOperation.state_root)
         ):
-            self._send_state_root_reply(conn, header)
+            self._send_state_root_reply(conn, header, body)
             return
         if cmd in (Command.ping, Command.pong):
             announce = int(header["request"]) == TcpBus.ANNOUNCE_REQUEST

@@ -194,6 +194,27 @@ def parse_root_body(body: bytes) -> tuple[bytes, int]:
     return bytes(row["root"]), int(row["aux"])
 
 
+# At-op query (round 19): a `state_root` REQUEST may carry an 8-byte
+# little-endian op — "give me your root as of op N" — answered from
+# the replica's root ring (vsr/replica.py enable_root_ring).  An empty
+# request body keeps the legacy meaning (current root + commit_min);
+# a server without the requested op in its ring answers current too,
+# and the caller detects the op mismatch (unverifiable-at-N, not an
+# error).  The follower attestation loop is the primary client.
+
+
+def root_query_body(op: int) -> bytes:
+    return int(op).to_bytes(8, "little")
+
+
+def parse_root_query(body: bytes) -> int | None:
+    """Requested op of a state_root query body, or None for the
+    legacy empty (current-root) shape / any unknown shape."""
+    if len(body) != 8:
+        return None
+    return int.from_bytes(body, "little")
+
+
 # ----------------------------------------------------------------------
 # Host twin: the incrementally-maintained digest of the BalanceMirror
 # + account-meta columns.  Bit-identical to the device accumulator

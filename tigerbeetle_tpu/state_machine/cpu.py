@@ -445,6 +445,30 @@ class CpuStateMachine:
                 self.pulse_next_timestamp = value_next_expired_at
         return results
 
+    # Read-only operations a follower may answer out of band (the
+    # shared definition lives in types.READ_OPERATIONS) — every one
+    # dispatches to a pure executor below (no timestamp advance, no
+    # expiry scan, no mutation), so serving them outside the commit
+    # stream cannot perturb replayed state.
+    READ_OPERATIONS = types.READ_OPERATIONS
+
+    def execute_read(self, operation: Operation, input_bytes: bytes) -> bytes:
+        """Serve a read WITHOUT committing it (round 19, the follower
+        read path): byte-identical to what commit() would reply for
+        the same operation at the current state, but with zero state
+        effects — commit_timestamp, pulse scheduling, and the history
+        tables are untouched, so interleaved replay stays bit-exact."""
+        operation = Operation(operation)
+        assert operation in self.READ_OPERATIONS, operation
+        assert self.input_valid(operation, input_bytes)
+        if operation == Operation.lookup_accounts:
+            return self._execute_lookup_accounts(input_bytes)
+        if operation == Operation.lookup_transfers:
+            return self._execute_lookup_transfers(input_bytes)
+        if operation == Operation.get_account_transfers:
+            return self._execute_get_account_transfers(input_bytes)
+        return self._execute_get_account_balances(input_bytes)
+
     def commit(
         self,
         client: int,

@@ -304,6 +304,19 @@ class Operation(enum.IntEnum):
     get_account_balances = 134
 
 
+# Read-only operations: pure queries over committed state, safe to
+# serve outside the commit stream (the follower read path and the
+# router's read steering key on this; CpuStateMachine's executors are
+# the per-op twin).  ONE definition — three hand-maintained copies
+# would let a new query op silently miss follower serving.
+READ_OPERATIONS = frozenset({
+    Operation.lookup_accounts,
+    Operation.lookup_transfers,
+    Operation.get_account_transfers,
+    Operation.get_account_balances,
+})
+
+
 # Event/Result wire types per operation.
 # reference: src/state_machine.zig:503-525
 EVENT_DTYPE = {

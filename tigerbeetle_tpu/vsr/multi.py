@@ -771,6 +771,7 @@ class VsrReplica(Replica):
             if not self.qos.admit(
                 tenant, self.monotonic,
                 self._tenant_depth.get(tenant, 0),
+                body_bytes=len(body),
             ):
                 self._shed_request(header, tenant)
                 return

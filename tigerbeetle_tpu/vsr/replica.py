@@ -140,6 +140,14 @@ class Replica:
         # for determinism-divergence pinpointing (reference:
         # src/testing/hash_log.zig).
         self.hash_log = None
+        # Root ring (round 19): op -> 16-byte state root recorded after
+        # each commit, serving the `state_root` at-op query followers
+        # attest against (runtime/follower.py).  None = off (zero
+        # cost); the owning server/harness enables it by assigning a
+        # size via enable_root_ring().  Requires a state machine with
+        # state_root().
+        self.root_ring: dict[int, bytes] | None = None
+        self.root_ring_max = 0
         # Span tracer (utils/tracer.py; reference: src/tracer.zig
         # hooked in the commit path) — NULL until set_tracer().
         from tigerbeetle_tpu.utils import tracer as tracer_mod
@@ -466,8 +474,32 @@ class Replica:
         ), self._h_commit.time():
             reply = self._commit_prepare_impl(header, body, replay)
         self._c_commits.inc()
+        if self.root_ring is not None:
+            self._record_root(int(header["op"]))
         self.anatomy.stage_h(header, "commit")
         return reply
+
+    def enable_root_ring(self, size: int) -> None:
+        """Keep the state root of the last `size` committed ops so the
+        `state_root` query can answer AT a requested op — the follower
+        attestation primitive.  Backfills the current commit point so
+        a follower already caught up can attest immediately."""
+        assert size > 0 and hasattr(self.sm, "state_root")
+        self.root_ring = {}
+        self.root_ring_max = int(size)
+        if self.commit_min > 0:
+            self._record_root(self.commit_min)
+
+    def _record_root(self, op: int) -> None:
+        ring = self.root_ring
+        ring[op] = self.sm.state_root()
+        while len(ring) > self.root_ring_max:
+            ring.pop(next(iter(ring)))
+
+    def root_at(self, op: int) -> bytes | None:
+        """Ring lookup: the state root AFTER committing `op`, if still
+        retained."""
+        return None if self.root_ring is None else self.root_ring.get(op)
 
     def _commit_prepare_impl(self, header: np.ndarray, body: bytes,
                              replay: bool = False) -> bytes:
@@ -485,6 +517,16 @@ class Replica:
             # (prepare() only assigns timestamps, so setting the stored
             # value reproduces the live prepare exactly).
             self.sm.prepare_timestamp = timestamp
+            if self.aof is not None and op > self.aof.last_op:
+                # Gap fill (round 19): a crash can erase the AOF's
+                # unsynced tail while the ops it held stay committed
+                # cluster-wide (WAL recovery replays them with
+                # replay=True, which historically skipped the AOF
+                # entirely).  Re-appending exactly the missing ops
+                # keeps the AOF's op stream gap-free — the contract
+                # followers tail under.  No durability barrier needed:
+                # a replayed op is already covered by the WAL.
+                self.aof.write(header, body)
         elif self.aof is not None:
             # reference: src/vsr/replica.zig:4136-4141 — AOF before
             # apply, and never ahead of the WAL's durability: the AOF

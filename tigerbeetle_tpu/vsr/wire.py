@@ -114,7 +114,17 @@ HEADER_DTYPE = np.dtype(
         # so legacy headers stay bit-identical, exactly like the
         # trace-context carve-out above.
         ("tenant", "<u4"),                                       # [173, 177)
-        ("reserved", "V79"),                                     # [177, 256)
+        # Read attestation (ours, round 19): follower-served read
+        # replies carry the 16-byte state commitment of the state they
+        # were answered from plus the op it covers (`root_op` = the
+        # follower's commit_min), so a client can verify integrity AND
+        # staleness against the cluster commitment (the primary's
+        # root-at-op ring).  Zero everywhere else — primary replies
+        # and every legacy message stay bit-identical, exactly like
+        # the trace/tenant carve-outs above.
+        ("state_root_lo", "<u8"), ("state_root_hi", "<u8"),      # [177, 193)
+        ("root_op", "<u8"),                                      # [193, 201)
+        ("reserved", "V55"),                                     # [201, 256)
     ]
 )
 assert HEADER_DTYPE.itemsize == HEADER_SIZE, HEADER_DTYPE.itemsize
@@ -180,6 +190,86 @@ def tenant_of(header: np.ndarray, body: bytes | memoryview | None = None,
     if len(body) < offset + 4:
         return 0
     return int.from_bytes(bytes(body[offset : offset + 4]), "little")
+
+
+# ----------------------------------------------------------------------
+# Root-attested follower serving (round 19; runtime/follower.py).
+
+
+class FollowerRefuse(enum.IntEnum):
+    """Typed reasons a follower declines a read (client_busy body).
+    The split matters to routers: `lagging`/`overload` are transient
+    (redirect to the primary, retry the follower later with backoff);
+    `unattested`/`poisoned`/`corrupt`/`gap` mean the follower cannot
+    currently PROVE its state and refuses rather than lie."""
+
+    lagging = 1      # behind the staleness bound; primary has fresher
+    unattested = 2   # no successful root cross-check yet
+    poisoned = 3     # replayed root MISMATCHED the primary's — fatal
+    overload = 4     # read admission (QoS) shed
+    not_readable = 5  # write/unknown op sent to a read-only follower
+    corrupt = 6      # tailed log failed checksum mid-file
+    gap = 7          # op discontinuity in the tailed log
+    incompatible = 8  # replayed record rejected by the state machine
+
+
+# Typed follower refusal payload: WHY plus how far behind, so a
+# client/router can decide between redirecting and backing off.
+# Length-distinct from the 16-byte tenant BUSY_BODY_DTYPE, so
+# parse_busy_body / parse_follower_busy disambiguate structurally.
+FOLLOWER_BUSY_DTYPE = np.dtype(
+    [
+        ("reason", "<u4"),      # FollowerRefuse
+        ("follower", "<u4"),    # follower id (operator-assigned)
+        ("lag_ops", "<u8"),     # primary op estimate - follower commit_min
+        ("commit_min", "<u8"),  # the follower's replayed-to op
+    ]
+)
+assert FOLLOWER_BUSY_DTYPE.itemsize != BUSY_BODY_DTYPE.itemsize
+
+
+def follower_busy_body(reason: int, follower: int, lag_ops: int,
+                       commit_min: int) -> bytes:
+    row = np.zeros(1, FOLLOWER_BUSY_DTYPE)[0]
+    row["reason"] = int(reason)
+    row["follower"] = follower & 0xFFFFFFFF
+    row["lag_ops"] = max(0, lag_ops)
+    row["commit_min"] = commit_min
+    return row.tobytes()
+
+
+def parse_follower_busy(body: bytes) -> tuple[int, int, int, int] | None:
+    """(reason, follower, lag_ops, commit_min), or None for any other
+    busy-body shape."""
+    if len(body) != FOLLOWER_BUSY_DTYPE.itemsize:
+        return None
+    row = np.frombuffer(body, FOLLOWER_BUSY_DTYPE)[0]
+    return (int(row["reason"]), int(row["follower"]),
+            int(row["lag_ops"]), int(row["commit_min"]))
+
+
+def stamp_attestation(h: np.ndarray, root: bytes, op: int) -> np.ndarray:
+    """Stamp a follower reply's attestation fields.  Must run BEFORE
+    finalize_header — the checksum covers them."""
+    assert len(root) == 16, len(root)
+    h["state_root_lo"] = int.from_bytes(root[:8], "little")
+    h["state_root_hi"] = int.from_bytes(root[8:], "little")
+    h["root_op"] = op
+    return h
+
+
+def attestation_of(h: np.ndarray) -> tuple[bytes, int] | None:
+    """(root, op) when the reply carries an attestation, else None
+    (primary-served / legacy replies are all-zero here; op 0 is the
+    empty root prepare, never a servable state)."""
+    op = int(h["root_op"])
+    if not op:
+        return None
+    root = (
+        int(h["state_root_lo"]).to_bytes(8, "little")
+        + int(h["state_root_hi"]).to_bytes(8, "little")
+    )
+    return root, op
 
 
 def busy_body(tenant: int, queue_depth: int, observed_rps: int) -> bytes:
