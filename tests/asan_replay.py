@@ -11,7 +11,9 @@ plus a torn-frame / oversize-frame fuzz through the SANITIZED native
 libraries (native/asan/): batch frame verification vs the Python
 oracle over the checked-in frames and their corrupt mutations, batch
 reply finalize parity, seeded random tearing of the fixture stream
-through the native bus framing, and oversize size-field frames that
+through the native bus framing, the round-20 pipeline entry points
+(fuzzed prepare/ack sequences incl. torn WAL framing, oversize ops,
+and out-of-order prepare_oks), and oversize size-field frames that
 must drop the connection without touching out-of-bounds memory.
 Exits 0 with the final OK marker only if every differential holds;
 address/UB findings abort the process with a sanitizer report the
@@ -149,6 +151,119 @@ def check_torn_frames(seed: int = 4242, rounds: int = 8) -> None:
     print(f"asan-replay: torn-frame fuzz ok ({rounds} rounds)")
 
 
+def _r64(rng) -> int:
+    return int(rng.integers(0, 1 << 64, dtype=np.uint64))
+
+
+def _r128(rng) -> int:
+    return _r64(rng) | (_r64(rng) << 64)
+
+
+def check_pipeline_fuzz(seed: int = 2020, rounds: int = 60) -> None:
+    """Round-20 pipeline entry points under the sanitizer: fuzzed
+    prepare/ack sequences (out-of-order and stale prepare_oks, dup
+    acks, unknown ops), torn WAL framing (slots re-framed mid-ring
+    with different prepares), and oversize ops (message_size_max
+    bodies) — every byte differential against the wire.py/journal.py
+    Python oracles while asan watches the C builders and slot table."""
+    from tigerbeetle_tpu.vsr.journal import HEADERS_PER_SECTOR
+    from tigerbeetle_tpu.vsr.storage import _sectors
+
+    assert fastpath.pipeline_available(), (
+        f"sanitized fastpath lacks pipeline: {fastpath.pipeline_error()}"
+    )
+    sector_size = 4096
+    slot_count = 32
+    assert slot_count % HEADERS_PER_SECTOR == 0
+    rng = np.random.default_rng(seed)
+    pl = fastpath.create_pipeline()
+    ring_c = np.zeros(slot_count, wire.HEADER_DTYPE)
+    ring_py = np.zeros(slot_count, wire.HEADER_DTYPE)
+    max_body = 1 << 20  # message_size_max: the oversize-op bound
+    scratch_prepare = np.zeros(_sectors(HEADER_SIZE + max_body), np.uint8)
+    scratch_sector = np.zeros(sector_size, np.uint8)
+    for i in range(rounds):
+        # Oversize op every 8th round, torn re-frames from slot reuse
+        # (op % slot_count collides across rounds by construction).
+        body_len = max_body if i % 8 == 7 else int(rng.integers(0, 8192))
+        body = rng.bytes(body_len)
+        req = wire.make_header(
+            command=wire.Command.request,
+            operation=int(rng.integers(0, 200)),
+            cluster=_r64(rng), client=_r128(rng) or 1,
+            request=int(rng.integers(0, 1 << 32)),
+            timestamp=_r64(rng) >> 1,
+            trace_id=_r64(rng), trace_ts=_r64(rng),
+            trace_flags=int(rng.integers(0, 2)),
+        )
+        wire.finalize_header(req, body)
+        op = int(rng.integers(1, 4 * slot_count))
+        kw = dict(
+            cluster=_r128(rng) >> 1, view=int(rng.integers(0, 1 << 31)),
+            op=op, commit=_r64(rng) >> 2, timestamp=_r64(rng) >> 1,
+            parent=_r128(rng) >> 1, replica=int(rng.integers(0, 6)),
+            context=int(rng.integers(0, 64)),
+            release=int(rng.integers(0, 1 << 31)),
+        )
+        prepare = pl.build_prepare(req, body, **kw)
+        oracle = wire.make_header(
+            command=wire.Command.prepare, operation=int(req["operation"]),
+            client=wire.u128(req, "client"), request=int(req["request"]),
+            **kw,
+        )
+        wire.copy_trace(oracle, req)
+        wire.finalize_header(oracle, body)
+        assert prepare.tobytes() == oracle.tobytes(), "prepare differential"
+        # Torn WAL framing: the slot may already hold an older prepare.
+        slot = op % slot_count
+        padded_len = fastpath.frame_prepare(
+            prepare, body, ring_c, slot, HEADERS_PER_SECTOR, sector_size,
+            scratch_prepare, scratch_sector,
+        )
+        msg = prepare.tobytes() + body
+        padded_py = msg.ljust(_sectors(len(msg)), b"\x00")
+        ring_py[slot] = prepare
+        first = slot // HEADERS_PER_SECTOR * HEADERS_PER_SECTOR
+        sector_py = ring_py[
+            first : first + HEADERS_PER_SECTOR
+        ].tobytes().ljust(sector_size, b"\x00")
+        assert padded_len == len(padded_py), "framing length differential"
+        assert scratch_prepare.tobytes()[:padded_len] == padded_py
+        assert scratch_sector.tobytes() == sector_py, "sector differential"
+        # Fuzzed ack sequence: out-of-order replicas, duplicates, a
+        # stale-sibling checksum, and an unknown op — vote counts must
+        # stay exact-checksum popcounts, never a stray read or write.
+        pl.note_prepare(prepare, bool(rng.integers(0, 2)), kw["replica"])
+        replicas = rng.permutation(6)
+        votes = {kw["replica"]}
+        for rep in replicas:
+            ok = pl.build_prepare_ok(prepare, kw["view"], int(rep))
+            n = pl.on_ack(ok)
+            votes.add(int(rep))
+            assert n == len(votes), "vote differential"
+            if rng.integers(0, 3) == 0:
+                assert pl.on_ack(ok) == len(votes)  # dup ack: no-op
+        stale = wire.make_header(
+            command=wire.Command.prepare_ok, op=op, replica=1,
+            context=123456789,
+        )
+        wire.finalize_header(stale, b"")
+        assert pl.on_ack(stale) is None, "stale ack must not vote"
+        unknown = pl.build_prepare_ok(prepare, kw["view"], 1)
+        unknown["op"] = op + (1 << 40)
+        wire.finalize_header(unknown, b"")
+        assert pl.on_ack(unknown) is None, "unknown op must not vote"
+        pl.mark_all_synced()
+        assert pl.commit_ready(op - 1, 2), "gate differential"
+        if rng.integers(0, 2):
+            pl.drop(op)
+        else:
+            pl.reset()
+        assert pl.size() == 0
+    assert ring_c.tobytes() == ring_py.tobytes(), "ring differential"
+    print(f"asan-replay: pipeline fuzz ok ({rounds} rounds)")
+
+
 def check_oversize_frames() -> None:
     """Size fields past the frame bound (message_size_max bodies +
     the 256-byte header) must drop the connection — never index the
@@ -181,6 +296,7 @@ def main() -> int:
     check_fixture_differential()
     check_finalize_parity()
     check_torn_frames()
+    check_pipeline_fuzz()
     check_oversize_frames()
     print("ASAN-REPLAY-OK")
     return 0

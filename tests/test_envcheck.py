@@ -672,3 +672,59 @@ def test_tb_follower_attest_max_ms_validated(monkeypatch):
     assert envcheck.follower_attest_max_ms() == 5000
     monkeypatch.delenv("TB_FOLLOWER_ATTEST_MAX_MS")
     assert envcheck.follower_attest_max_ms() == 2000
+
+
+def test_tb_native_pipeline_validated(monkeypatch):
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "fast")
+    with pytest.raises(envcheck.EnvVarError, match="TB_NATIVE_PIPELINE"):
+        envcheck.native_pipeline()
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "2")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 1"):
+        envcheck.native_pipeline()
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "0")
+    assert envcheck.native_pipeline() == 0
+    monkeypatch.delenv("TB_NATIVE_PIPELINE")
+    assert envcheck.native_pipeline() == 1  # default on
+
+
+def test_tb_cpu_affinity_validated(monkeypatch):
+    monkeypatch.delenv("TB_CPU_AFFINITY", raising=False)
+    assert envcheck.cpu_affinity() == "none"  # default: no pinning
+    monkeypatch.setenv("TB_CPU_AFFINITY", "auto")
+    assert envcheck.cpu_affinity() == "auto"
+    monkeypatch.setenv("TB_CPU_AFFINITY", "0,1,2")
+    assert envcheck.cpu_affinity() == "0,1,2"
+    monkeypatch.setenv("TB_CPU_AFFINITY", "zero")
+    with pytest.raises(envcheck.EnvVarError, match="TB_CPU_AFFINITY"):
+        envcheck.cpu_affinity()
+    monkeypatch.setenv("TB_CPU_AFFINITY", "0,-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.cpu_affinity()
+    monkeypatch.setenv("TB_CPU_AFFINITY", "")
+    assert envcheck.cpu_affinity() == "none"  # empty counts as unset
+
+
+def test_affinity_plan_and_apply(monkeypatch):
+    import os as _os
+
+    from tigerbeetle_tpu.runtime import affinity
+
+    assert affinity.plan(0, "none") is None
+    ncpu = _os.cpu_count() or 1
+    assert affinity.plan(3, "auto") == (3 % ncpu,)
+    assert affinity.plan(0, "4,5") == (4,)
+    assert affinity.plan(1, "4,5") == (5,)
+    assert affinity.plan(2, "4,5") == (4,)  # wraps mod the list
+    # apply() pins to a real core and reports it; spec from the env.
+    monkeypatch.setenv("TB_CPU_AFFINITY", "auto")
+    before = _os.sched_getaffinity(0)
+    try:
+        pinned = affinity.apply(slot=0)
+        assert pinned == (0,)
+        assert _os.sched_getaffinity(0) == {0}
+    finally:
+        _os.sched_setaffinity(0, before)
+    # A planned core that does not exist on this box degrades to
+    # unpinned (None), never to a failed spawn.
+    assert affinity.apply(slot=0, spec="4096") is None
+    assert _os.sched_getaffinity(0) == before

@@ -1,0 +1,492 @@
+"""Native commit pipeline (round 20, native/tb_pipeline.cpp): the
+differential contract TB_NATIVE_PIPELINE=0/1 one layer above the r14
+decode fast path.
+
+Three tiers of evidence, mirroring how the seam can break:
+
+- Unit differential: the C header builders and the journal append
+  framing are fuzzed against the wire.py / journal.py Python oracles
+  byte for byte.
+- Cluster differential: the SAME deterministic sim-cluster script runs
+  with the native pipeline on and off, and every prepare, prepare_ok,
+  and client-reply FRAME on the wire (header bytes incl. trace /
+  tenant + body) must be bit-identical.
+- Chaos: crash-at-fsync failover fuzz and the r10 group-commit
+  contract (no ack before its covering sync, self-vote gated on sync)
+  re-run on the native arm with hash-log convergence, plus the
+  C-table/Python-dict mirror invariant checked live.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import SECTOR_SIZE
+from tigerbeetle_tpu.runtime import fastpath
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.harness import account, pack, transfer
+from tigerbeetle_tpu.vsr import storage as storage_mod
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.journal import HEADERS_PER_SECTOR
+from tigerbeetle_tpu.vsr.storage import FsyncCrash
+from tigerbeetle_tpu.vsr.wire import Command, HEADER_DTYPE
+
+from test_multi import (  # noqa: F401  (fixture plumbing)
+    _instrument_ack_ordering,
+    _register,
+    _setup_accounts,
+)
+
+pytestmark = pytest.mark.skipif(
+    not fastpath.pipeline_available(),
+    reason="libtb_fastpath with pipeline symbols not built",
+)
+
+
+# ----------------------------------------------------------------------
+# Unit differential: C builders vs the wire.py oracle.
+
+
+def _r64(rng) -> int:
+    return int(rng.integers(0, 1 << 64, dtype=np.uint64))
+
+
+def _r128(rng) -> int:
+    return _r64(rng) | (_r64(rng) << 64)
+
+
+def _fuzz_request(rng) -> tuple[np.void, bytes]:
+    body = rng.bytes(int(rng.integers(0, 512)))
+    req = wire.make_header(
+        command=Command.request,
+        operation=int(rng.integers(0, 200)),
+        cluster=_r64(rng),
+        client=_r128(rng) or 1,
+        request=int(rng.integers(0, 1 << 32)),
+        view=int(rng.integers(0, 1 << 16)),
+        op=0, commit=0,
+        timestamp=_r64(rng) >> 1,
+        replica=0,
+        release=int(rng.integers(0, 1 << 32)),
+        tenant=int(rng.integers(0, 1 << 32)),
+        trace_id=_r64(rng),
+        trace_ts=_r64(rng),
+        trace_flags=int(rng.integers(0, 2)),
+    )
+    wire.finalize_header(req, body)
+    return req, body
+
+
+def test_build_prepare_bit_identical_fuzz():
+    rng = np.random.default_rng(20_01)
+    pl = fastpath.create_pipeline()
+    assert pl is not None
+    for _ in range(200):
+        req, body = _fuzz_request(rng)
+        kw = dict(
+            cluster=_r128(rng) >> 1,
+            view=int(rng.integers(0, 1 << 31)),
+            op=(_r64(rng) >> 2) or 1,
+            commit=_r64(rng) >> 2,
+            timestamp=_r64(rng) >> 1,
+            parent=_r128(rng) >> 1,
+            replica=int(rng.integers(0, 6)),
+            context=int(rng.integers(0, 64)),
+            release=int(rng.integers(0, 1 << 31)),
+        )
+        oracle = wire.make_header(
+            command=Command.prepare, operation=int(req["operation"]),
+            client=wire.u128(req, "client"), request=int(req["request"]),
+            **kw,
+        )
+        wire.copy_trace(oracle, req)
+        wire.finalize_header(oracle, body)
+        native = pl.build_prepare(req, body, **kw)
+        assert native.tobytes() == oracle.tobytes()
+        # The oracle's checksum verifies — so the native one does too.
+        assert wire.verify_header(native, body)
+
+
+def test_build_prepare_ok_bit_identical_fuzz():
+    rng = np.random.default_rng(20_02)
+    pl = fastpath.create_pipeline()
+    for _ in range(200):
+        req, body = _fuzz_request(rng)
+        prepare = wire.make_header(
+            command=Command.prepare, operation=int(req["operation"]),
+            cluster=_r128(rng) >> 1,
+            client=wire.u128(req, "client"),
+            view=int(rng.integers(0, 1 << 16)),
+            op=(_r64(rng) >> 2) or 1,
+            commit=0, timestamp=1, parent=2, replica=0, release=3,
+        )
+        wire.copy_trace(prepare, req)
+        wire.finalize_header(prepare, body)
+        view = int(rng.integers(0, 1 << 31))
+        replica = int(rng.integers(0, 6))
+        oracle = wire.make_header(
+            command=Command.prepare_ok,
+            cluster=wire.u128(prepare, "cluster"), view=view,
+            op=int(prepare["op"]), replica=replica,
+            context=wire.u128(prepare, "checksum"),
+            client=wire.u128(prepare, "client"),
+        )
+        wire.copy_trace(oracle, prepare)
+        wire.finalize_header(oracle, b"")
+        native = pl.build_prepare_ok(prepare, view, replica)
+        assert native.tobytes() == oracle.tobytes()
+
+
+def test_frame_prepare_matches_python_framing_fuzz():
+    """The C journal framing (padded prepare + in-place ring update +
+    redundant sector) against journal.write_prepare's Python layout."""
+    from tigerbeetle_tpu.vsr.storage import _sectors
+
+    rng = np.random.default_rng(20_03)
+    slot_count = 64
+    assert slot_count % HEADERS_PER_SECTOR == 0
+    ring_py = np.zeros(slot_count, HEADER_DTYPE)
+    ring_c = np.zeros(slot_count, HEADER_DTYPE)
+    scratch_prepare = np.zeros(_sectors(256 + 4096), np.uint8)
+    scratch_sector = np.zeros(SECTOR_SIZE, np.uint8)
+    for _ in range(100):
+        body = rng.bytes(int(rng.integers(0, 4096)))
+        op = int(rng.integers(1, 1 << 32))
+        h = wire.make_header(
+            command=Command.prepare, operation=int(rng.integers(0, 200)),
+            cluster=7, client=9, view=1, op=op, commit=0,
+            timestamp=_r64(rng) >> 2, parent=1,
+            replica=0, release=1,
+        )
+        wire.finalize_header(h, body)
+        slot = op % slot_count
+        # Python oracle framing (journal.write_prepare's byte layout).
+        msg = h.tobytes() + body
+        padded_py = msg.ljust(_sectors(len(msg)), b"\x00")
+        ring_py[slot] = h
+        first = slot // HEADERS_PER_SECTOR * HEADERS_PER_SECTOR
+        sector_py = ring_py[
+            first : first + HEADERS_PER_SECTOR
+        ].tobytes().ljust(SECTOR_SIZE, b"\x00")
+        # Native framing.
+        padded_len = fastpath.frame_prepare(
+            h, body, ring_c, slot, HEADERS_PER_SECTOR, SECTOR_SIZE,
+            scratch_prepare, scratch_sector,
+        )
+        assert padded_len == len(padded_py)
+        assert scratch_prepare.tobytes()[:padded_len] == padded_py
+        assert scratch_sector.tobytes() == sector_py
+        assert ring_c[slot].tobytes() == h.tobytes()
+    assert ring_c.tobytes() == ring_py.tobytes()
+
+
+def test_slot_table_semantics():
+    """The C in-flight table's vote/sync/gate semantics in isolation:
+    exact-checksum votes, the synced gate, contiguity, reset."""
+    pl = fastpath.create_pipeline()
+    req, body = _fuzz_request(np.random.default_rng(20_04))
+    prepare = wire.make_header(
+        command=Command.prepare, cluster=7, client=9, view=1, op=5,
+        commit=4, timestamp=1, parent=2, replica=0, release=1,
+    )
+    wire.finalize_header(prepare, body)
+    pl.note_prepare(prepare, False, 0)
+    assert pl.size() == 1 and pl.votes(5) == 1
+    ok = wire.make_header(
+        command=Command.prepare_ok, cluster=7, view=1, op=5, replica=1,
+        context=wire.u128(prepare, "checksum"), client=9,
+    )
+    wire.finalize_header(ok, b"")
+    assert pl.on_ack(ok) == 2
+    assert pl.on_ack(ok) == 2  # duplicate ack: same bit, same count
+    # Stale-sibling ack (wrong checksum) and unknown op both -> None.
+    stale = wire.make_header(
+        command=Command.prepare_ok, cluster=7, view=1, op=5, replica=1,
+        context=123456789, client=9,
+    )
+    wire.finalize_header(stale, b"")
+    assert pl.on_ack(stale) is None
+    unknown = wire.make_header(
+        command=Command.prepare_ok, cluster=7, view=1, op=99, replica=1,
+        context=wire.u128(prepare, "checksum"), client=9,
+    )
+    wire.finalize_header(unknown, b"")
+    assert pl.on_ack(unknown) is None
+    # Quorum met but unsynced: the gate holds; sync opens it; a
+    # non-contiguous commit_min keeps it shut.
+    assert not pl.commit_ready(4, 2)
+    pl.mark_all_synced()
+    assert pl.commit_ready(4, 2)
+    assert not pl.commit_ready(3, 2)  # op 4 not in flight
+    assert not pl.commit_ready(4, 3)  # quorum of 3 not reached
+    pl.drop(5)
+    assert pl.size() == 0 and not pl.commit_ready(4, 2)
+    pl.note_prepare(prepare, True, 0)
+    pl.reset()
+    assert pl.size() == 0
+
+
+# ----------------------------------------------------------------------
+# Stale-.so forensics: a library without (or with mismatched) pipeline
+# symbols must fail fast on explicit opt-in, degrade once otherwise.
+
+
+class _StaleLib:
+    tb_pl_abi_version = None  # the loader's missing-symbol marker
+
+
+def test_stale_library_fails_fast_on_explicit_opt_in(monkeypatch):
+    monkeypatch.setattr(fastpath, "_load", lambda: _StaleLib())
+    monkeypatch.setattr(fastpath, "_pipeline_warned", False)
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    assert not fastpath.pipeline_available()
+    assert "make -C native" in fastpath.pipeline_error()
+    with pytest.raises(RuntimeError, match="make -C native"):
+        fastpath.create_pipeline()
+    # Defaulted knob: one RuntimeWarning, then a silent Python
+    # fallback — a bench box without a compiler still runs.
+    monkeypatch.delenv("TB_NATIVE_PIPELINE")
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        assert fastpath.create_pipeline() is None
+    assert fastpath.create_pipeline() is None  # warned once only
+
+
+def test_abi_version_mismatch_detected(monkeypatch):
+    class _OldLib:
+        @staticmethod
+        def tb_pl_abi_version():
+            return fastpath.PIPELINE_ABI + 1
+
+    monkeypatch.setattr(fastpath, "_load", lambda: _OldLib())
+    err = fastpath.pipeline_error()
+    assert err is not None and "ABI" in err and "make -C native" in err
+
+
+# ----------------------------------------------------------------------
+# Cluster differential: same deterministic script, native on vs off,
+# every consensus + reply frame bit-identical.
+
+
+def _capture_frames(c: Cluster) -> list[tuple]:
+    """Record every prepare / prepare_ok / reply frame leaving any
+    replica (header bytes include trace, tenant, and checksum — the
+    full 256-byte wire image — plus the body)."""
+    frames: list[tuple] = []
+    watched = {int(Command.prepare), int(Command.prepare_ok)}
+    for r in c.replicas:
+        orig_send = r.bus.send
+
+        def send(dst, header, body, *, _r=r, _o=orig_send):
+            if int(header["command"]) in watched:
+                frames.append(
+                    ("peer", _r.replica, dst, header.tobytes(), bytes(body))
+                )
+            _o(dst, header, body)
+
+        r.bus.send = send
+        orig_send_client = r.bus.send_client
+
+        def send_client(client, header, body, *, _r=r,
+                        _o=orig_send_client):
+            if int(header["command"]) == int(Command.reply):
+                frames.append(
+                    ("client", _r.replica, client, header.tobytes(),
+                     bytes(body))
+                )
+            _o(client, header, body)
+
+        r.bus.send_client = send_client
+    return frames
+
+
+def _scripted_run(monkeypatch, native: str, *, gc: bool,
+                  seed: int = 31) -> tuple[list[tuple], bytes]:
+    """One deterministic conversation (register, accounts, transfers
+    incl. a failure, lookups) on a 3-replica sim cluster; returns the
+    captured wire frames and the final account table bytes."""
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", native)
+    # The only nondeterministic bytes on the wire are trace_ts stamps
+    # (observability-only, CLOCK_MONOTONIC): pin the clock so the
+    # on/off frames are comparable bit for bit.
+    monkeypatch.setattr(time, "perf_counter_ns", lambda: 1_000_000_000)
+    if gc:
+        monkeypatch.setattr(
+            storage_mod.MemoryStorage, "supports_deferred_sync", True,
+            raising=False,
+        )
+    c = Cluster(3, seed=seed)
+    for r in c.replicas:
+        assert (r._np is not None) == (native == "1")
+        if gc:
+            assert r._gc_enabled
+    frames = _capture_frames(c)
+    cl = _register(c, 100)
+    _setup_accounts(c, cl, ids=(1, 2, 3))
+    for k in range(12):
+        reply = c.run_request(
+            cl, types.Operation.create_transfers,
+            pack([transfer(500 + k, debit_account_id=1 + (k % 2),
+                           credit_account_id=3, amount=1 + k)]),
+        )
+        assert reply == b""
+    # A failing transfer: result rows ride the reply body.
+    bad = c.run_request(
+        cl, types.Operation.create_transfers,
+        pack([transfer(900, debit_account_id=1, credit_account_id=1,
+                       amount=1)]),
+    )
+    assert bad != b""
+    out = c.run_request(
+        cl, types.Operation.lookup_accounts,
+        np.array([1, 0, 2, 0, 3, 0], "<u8").tobytes(),
+    )
+    c.settle(4000)
+    c.check_linearized()
+    c.check_convergence()
+    return frames, out
+
+
+@pytest.mark.parametrize("gc", [False, True], ids=["sync", "group_commit"])
+def test_conversation_frames_bit_identical_on_off(monkeypatch, gc):
+    frames_on, table_on = _scripted_run(monkeypatch, "1", gc=gc)
+    frames_off, table_off = _scripted_run(monkeypatch, "0", gc=gc)
+    assert table_on == table_off
+    assert len(frames_on) == len(frames_off)
+    for a, b in zip(frames_on, frames_off):
+        assert a == b
+    # The comparison covered real consensus traffic.
+    kinds = {f[0] for f in frames_on}
+    assert kinds == {"peer", "client"}
+
+
+def _assert_mirror(c: Cluster) -> None:
+    """The C slot table must mirror the Python pipeline dict: same
+    in-flight ops (above commit_min), same vote counts."""
+    for r in c.replicas:
+        if r._np is None:
+            continue
+        for op, entry in r.pipeline.items():
+            if op <= r.commit_min:
+                continue  # Python-side lazily cleaned; C already dropped
+            votes = r._np.votes(op)
+            assert votes == len(entry.ok_replicas), (
+                f"replica {r.replica} op {op}: native votes {votes} != "
+                f"python acks {len(entry.ok_replicas)}"
+            )
+
+
+def test_native_votes_mirror_python_acks(monkeypatch):
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    c = Cluster(3, seed=77)
+    cl = _register(c, 100)
+    _setup_accounts(c, cl)
+    for k in range(10):
+        cl.request(
+            types.Operation.create_transfers,
+            pack([transfer(700 + k, debit_account_id=1,
+                           credit_account_id=2, amount=1)]),
+        )
+        for _ in range(300):
+            c.step()
+            _assert_mirror(c)
+            if not cl.busy():
+                break
+        assert not cl.busy()
+        assert cl.reply == b""
+
+
+# ----------------------------------------------------------------------
+# Chaos on the native arm: the r10 group-commit contract and
+# crash-at-fsync failover with hash-log convergence.
+
+
+@pytest.fixture
+def native_gc_cluster(monkeypatch):
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    monkeypatch.setattr(
+        storage_mod.MemoryStorage, "supports_deferred_sync", True,
+        raising=False,
+    )
+    c = Cluster(3, seed=11)
+    for r in c.replicas:
+        assert r._gc_enabled and r._np is not None
+    return c
+
+
+def test_gc_contract_never_acks_before_covering_sync_native(
+    native_gc_cluster,
+):
+    """The r10 self-vote-gated-on-covering-sync contract, native arm:
+    the exact test body from test_multi re-driven with the C gate
+    answering the commit decision."""
+    import test_multi
+
+    test_multi.test_group_commit_never_acks_before_covering_sync(
+        native_gc_cluster
+    )
+
+
+@pytest.mark.parametrize("seed", [3, 19, 47])
+def test_crash_at_fsync_failover_fuzz_native(monkeypatch, seed):
+    """Primary dies inside a covering fsync at a fuzzed point in the
+    stream; failover + recovery must lose nothing acked, the hash
+    logs must converge, and the ack-ordering instrument must stay
+    clean — all with the native gate deciding commits."""
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    monkeypatch.setattr(
+        storage_mod.MemoryStorage, "supports_deferred_sync", True,
+        raising=False,
+    )
+    rng = np.random.default_rng(seed)
+    c = Cluster(3, seed=seed)
+    violations = _instrument_ack_ordering(c)
+    cl = _register(c, 100)
+    _setup_accounts(c, cl)
+    acked = 0
+    next_id = [seed * 1000]
+
+    def send_next():
+        next_id[0] += 1
+        cl.request(
+            types.Operation.create_transfers,
+            pack([transfer(next_id[0], debit_account_id=1,
+                           credit_account_id=2, amount=1)]),
+        )
+
+    for _ in range(int(rng.integers(2, 6))):
+        send_next()
+        c.run_until(lambda: not cl.busy())
+        assert cl.reply == b""
+        acked += 1
+
+    c.storages[0].crash_at_fsync = int(rng.integers(1, 4))
+    send_next()
+    crashed = False
+    for _ in range(600):
+        try:
+            c.step()
+        except FsyncCrash:
+            crashed = True
+            c.crash_replica(0)
+            break
+        if not cl.busy():
+            acked += 1
+            send_next()
+    assert crashed, "seeded crash_at_fsync never fired"
+
+    c.run_until(lambda: not cl.busy(), 6000)
+    acked += 1
+    c.restart_replica(0)
+    c.settle(6000)
+    c.check_linearized()
+    c.check_convergence()
+    assert violations == [], violations[:10]
+    _assert_mirror(c)
+
+    from tigerbeetle_tpu.testing.harness import ids_bytes
+
+    out = c.run_request(cl, types.Operation.lookup_accounts, ids_bytes([1]))
+    row = np.frombuffer(out, types.ACCOUNT_DTYPE)[0]
+    assert types.u128_get(row, "debits_posted") == acked

@@ -74,6 +74,23 @@ def test_two_replica_group_commit_smoke(tmp_path, monkeypatch):
     assert replies_on == replies_off
 
 
+def test_two_replica_native_pipeline_smoke(tmp_path, monkeypatch):
+    """Native-pipeline arm (round 20): the same cluster smoke with the
+    per-prepare hot loop in C (TB_NATIVE_PIPELINE=1) vs pure Python
+    (=0) — reply bodies identical, both over the columnar ingest path
+    (bit-level frame identity is pinned by the sim-cluster
+    differential in tests/test_native_pipeline.py)."""
+    from tigerbeetle_tpu.runtime import fastpath
+
+    if not fastpath.pipeline_available():
+        pytest.skip("libtb_fastpath pipeline symbols not built")
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    replies_native = _run_cluster_once(tmp_path / "np_on", "1", monkeypatch)
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "0")
+    replies_python = _run_cluster_once(tmp_path / "np_off", "1", monkeypatch)
+    assert replies_native == replies_python
+
+
 def _run_cluster_once(tmp_path, fastpath_flag, monkeypatch):
     from tigerbeetle_tpu.client import Client
     from tigerbeetle_tpu.runtime.server import format_data_file
@@ -207,12 +224,22 @@ def _run_cluster_once(tmp_path, fastpath_flag, monkeypatch):
             else:
                 assert snap["fastpath.batch_decode_hits"] == 0
             if i == 0:
+                # r20 per-prepare instrument: the primary timed every
+                # header-build + bookkeeping span, and the histogram
+                # reaches the scrape under the replica registry's
+                # "vsr." graft.
+                assert snap["vsr.prepare_us.count"] > 0
+                assert snap["vsr.prepare_us.p50"] > 0
                 assert snap["vsr.gc_flushes"] > 0
                 # r10 contract: group commit => fewer covering syncs
                 # than WAL appends once load overlaps (each flush
                 # covers a whole drain), and every sync accounted.
                 assert snap["vsr.gc_flushes"] <= snap["vsr.prepares_written"]
                 assert snap["storage.fsyncs"] > 0
+            else:
+                # Backup-side instrument: every accepted prepare timed
+                # its prepare_ok build span.
+                assert snap["vsr.prepare_ok_us.count"] > 0
 
         # Proof-of-state query (state_machine/commitment.py): both
         # replicas answer the sessionless `state_root` op with the

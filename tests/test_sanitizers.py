@@ -76,7 +76,8 @@ def test_fastpath_replay_under_asan():
     assert "ASAN-REPLAY-OK" in proc.stdout, combined[-4000:]
     # Every replay stage actually ran.
     for marker in ("fixture differential ok", "finalize parity ok",
-                   "torn-frame fuzz ok", "oversize-frame fuzz ok"):
+                   "torn-frame fuzz ok", "pipeline fuzz ok",
+                   "oversize-frame fuzz ok"):
         assert marker in proc.stdout, combined[-4000:]
     assert not _sanitizer_report(combined), combined[-4000:]
 

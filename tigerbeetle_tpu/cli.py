@@ -127,6 +127,13 @@ def cmd_start(args: list[str]) -> None:
             cluster = int(opts["cluster"], 0)
         except ValueError:
             flags.fatal(f"--cluster: invalid integer {opts['cluster']!r}")
+    # Core pinning (TB_CPU_AFFINITY): slot = replica index, so a
+    # cluster's replicas spread across cores under "auto".
+    from tigerbeetle_tpu.runtime import affinity
+
+    pinned = affinity.apply(slot=opts["replica"])
+    if pinned is not None:
+        print(f"pinned to cores {list(pinned)}", flush=True)
     server = ReplicaServer(
         paths[0], cluster=cluster,
         addresses=opts["addresses"].split(","), replica_index=opts["replica"],
@@ -166,8 +173,12 @@ def cmd_router(args: list[str]) -> None:
         flags.fatal("router takes no positional arguments")
     if not opts["shards"]:
         flags.fatal("router requires --shards=<addrs;addrs;...>")
+    from tigerbeetle_tpu.runtime import affinity
     from tigerbeetle_tpu.runtime.router import RouterServer
 
+    pinned = affinity.apply(slot=0)
+    if pinned is not None:
+        print(f"pinned to cores {list(pinned)}", flush=True)
     server = RouterServer(
         opts["listen"], opts["shards"].split(";"),
         cluster=opts["cluster"], recover=not opts["no_recover"],
@@ -204,8 +215,13 @@ def cmd_follower(args: list[str]) -> None:
     if not opts["aof"] or not opts["upstream"]:
         flags.fatal("follower requires --aof=<path> and "
                     "--upstream=<host:port>")
+    from tigerbeetle_tpu.runtime import affinity
     from tigerbeetle_tpu.runtime.follower import FollowerServer
     from tigerbeetle_tpu.state_machine import CpuStateMachine
+
+    pinned = affinity.apply(slot=opts["id"])
+    if pinned is not None:
+        print(f"pinned to cores {list(pinned)}", flush=True)
 
     # Followers replay on the CPU state machine (deterministic host
     # replay, no device needed; r15 pins its state_root to the TPU

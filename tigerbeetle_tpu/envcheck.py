@@ -122,6 +122,50 @@ def fastpath_decode() -> int:
     return env_int("TB_FASTPATH_DECODE", 1, minimum=0, maximum=1)
 
 
+def native_pipeline() -> int:
+    """TB_NATIVE_PIPELINE: 1 (default) runs the per-prepare hot loop
+    through native/tb_pipeline.cpp — prepare/prepare_ok header
+    construction + checksum stamping, journal append framing (sector
+    padding, redundant-ring sector build), the primary's in-flight
+    slot table, and the group-commit gate — falling back to Python
+    when libtb_fastpath is unavailable.  0 forces the pure-Python
+    per-prepare path for differential runs: reply frames, WAL bytes,
+    and commit decisions must be bit-identical either way (the r14
+    TB_FASTPATH_DECODE contract one layer higher).  Setting 1
+    EXPLICITLY makes a stale/missing library a hard error instead of
+    a silent fallback."""
+    return env_int("TB_NATIVE_PIPELINE", 1, minimum=0, maximum=1)
+
+
+def cpu_affinity() -> str:
+    """TB_CPU_AFFINITY: replica/router/follower core pinning for the
+    multi-process spawn paths (bench subprocess spawns and the
+    `tigerbeetle` server/router/follower CLIs):
+
+    - "none" (default): inherit the parent's affinity mask unchanged.
+    - "auto": pin process slot i to core (i mod cpu_count) — spreads a
+      cluster's replicas across cores so their Python VSR loops stop
+      serializing on a shared core.
+    - "0,1,2": explicit core list; slot i takes the (i mod len)'th
+      core of the list.
+
+    Validated here so a typo fails at spawn, not as a bare OSError
+    inside sched_setaffinity; runtime/affinity.py applies it."""
+    raw = env_str("TB_CPU_AFFINITY", "none")
+    if raw in ("none", "auto"):
+        return raw
+    parts = raw.split(",")
+    try:
+        cores = [int(p) for p in parts]
+    except ValueError:
+        _fail("TB_CPU_AFFINITY", raw,
+              'expected "none", "auto", or a comma-separated core '
+              'list like "0,1,2"')
+    if not cores or any(c < 0 for c in cores):
+        _fail("TB_CPU_AFFINITY", raw, "core ids must be >= 0")
+    return raw
+
+
 def drain_batch_max() -> int:
     """TB_DRAIN_BATCH: cap on events pulled per columnar drain call —
     bounds the arena scan and the latency of one decode pass under a

@@ -193,6 +193,55 @@ def _load():
         except AttributeError:
             lib.tb_fp_verify_frames = None
             lib.tb_fp_finalize_headers = None
+        # Native commit pipeline (round 20).  Absent symbols mean a
+        # stale prebuilt .so whose rebuild failed: pipeline_available()
+        # reports False with a rebuild hint instead of letting an
+        # AttributeError fire mid-drain.
+        try:
+            lib.tb_pl_abi_version.restype = ctypes.c_uint32
+            lib.tb_pl_abi_version.argtypes = []
+            lib.tb_pl_create.restype = ctypes.c_void_p
+            lib.tb_pl_create.argtypes = []
+            lib.tb_pl_destroy.argtypes = [ctypes.c_void_p]
+            lib.tb_pl_reset.argtypes = [ctypes.c_void_p]
+            lib.tb_pl_size.restype = ctypes.c_uint32
+            lib.tb_pl_size.argtypes = [ctypes.c_void_p]
+            lib.tb_pl_build_prepare.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+                ctypes.c_uint64, ctypes.c_uint32, _U8P,
+            ]
+            lib.tb_pl_build_prepare_ok.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32, _U8P,
+            ]
+            lib.tb_pl_frame_prepare.restype = ctypes.c_uint64
+            lib.tb_pl_frame_prepare.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+                _U8P, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+                _U8P, _U8P,
+            ]
+            lib.tb_pl_note_prepare.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_uint32,
+            ]
+            lib.tb_pl_on_ack.restype = ctypes.c_int
+            lib.tb_pl_on_ack.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.tb_pl_mark_all_synced.argtypes = [ctypes.c_void_p]
+            lib.tb_pl_set_synced.restype = ctypes.c_int
+            lib.tb_pl_set_synced.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+            ]
+            lib.tb_pl_drop.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.tb_pl_commit_ready.restype = ctypes.c_int
+            lib.tb_pl_commit_ready.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32,
+            ]
+            lib.tb_pl_votes.restype = ctypes.c_uint32
+            lib.tb_pl_votes.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        except AttributeError:
+            lib.tb_pl_abi_version = None
         _lib = lib
         _lib_failed = False
         return _lib
@@ -600,6 +649,180 @@ def verify_and_gather(arena: np.ndarray, moffs: np.ndarray,
     if not native:
         ok = verify_frames_py(arena, moffs, mlens, n, hdrs=hdrs)
     return ok, hdrs, native
+
+
+# ----------------------------------------------------------------------
+# Native commit pipeline (round 20): per-prepare header construction,
+# journal append framing, and the primary's in-flight slot table live
+# in native/tb_pipeline.cpp; VsrReplica (vsr/multi.py) keeps view
+# changes, checkpoints, and recovery.  The differential contract is
+# absolute: TB_NATIVE_PIPELINE=0/1 must produce bit-identical frames.
+
+# Expected tb_pl_abi_version().  Bump in lockstep with
+# native/tb_pipeline.cpp whenever any tb_pl_* signature changes.
+PIPELINE_ABI = 1
+
+_PIPELINE_HINT = (
+    "libtb_fastpath.so is stale (missing/mismatched tb_pl_* pipeline "
+    "symbols) and the automatic rebuild did not replace it — run "
+    "`make -C native` (or `make -C native asan` under "
+    "TB_NATIVE_SANITIZE=asan) and check runtime/native.py build_error()"
+)
+_pipeline_warned = False
+
+
+def pipeline_error() -> str | None:
+    """Why the native pipeline is unavailable even though the fastpath
+    library loaded (stale-.so forensics), else None."""
+    lib = _load()
+    if lib is None:
+        return None  # no library at all: the normal pure-Python path
+    if getattr(lib, "tb_pl_abi_version", None) is None:
+        return _PIPELINE_HINT
+    got = int(lib.tb_pl_abi_version())
+    if got != PIPELINE_ABI:
+        return (
+            f"libtb_fastpath.so pipeline ABI {got} != expected "
+            f"{PIPELINE_ABI} — {_PIPELINE_HINT}"
+        )
+    return None
+
+
+def pipeline_available() -> bool:
+    lib = _load()
+    return lib is not None and pipeline_error() is None
+
+
+def create_pipeline():
+    """A NativePipeline for one VsrReplica, or None when the native
+    library is absent (pure-Python fallback).  A LOADED-BUT-STALE
+    library fails fast: RuntimeError with the rebuild hint when the
+    operator explicitly demanded TB_NATIVE_PIPELINE=1, a one-shot
+    RuntimeWarning + fallback when the knob was defaulted."""
+    global _pipeline_warned
+    lib = _load()
+    if lib is None:
+        return None
+    err = pipeline_error()
+    if err is not None:
+        if envcheck.env_is_set("TB_NATIVE_PIPELINE"):
+            raise RuntimeError(err)
+        if not _pipeline_warned:
+            _pipeline_warned = True
+            import warnings
+
+            warnings.warn(
+                f"native pipeline unavailable ({err}); "
+                "falling back to the Python per-prepare path",
+                RuntimeWarning, stacklevel=2,
+            )
+        return None
+    return NativePipeline(lib)
+
+
+class NativePipeline:
+    """One native in-flight slot table + header builder per replica.
+
+    Headers cross the boundary as raw 256-byte buffers; built headers
+    come back as fresh HEADER_DTYPE records (bit-identical to the
+    wire.make_header/copy_trace/finalize_header sequence)."""
+
+    def __init__(self, lib) -> None:
+        from tigerbeetle_tpu.vsr.wire import HEADER_DTYPE
+
+        self._lib = lib
+        self._pl = lib.tb_pl_create()
+        assert self._pl, "tb_pl_create failed"
+        self._dtype = HEADER_DTYPE
+
+    def __del__(self):  # noqa: D105
+        try:
+            if getattr(self, "_pl", None):
+                self._lib.tb_pl_destroy(self._pl)
+                self._pl = None
+        # tbcheck: allow(broad-except): __del__ at interpreter
+        # teardown — the lib handle may already be gone.
+        except Exception:
+            pass
+
+    def build_prepare(self, request: np.void, body: bytes, *, cluster: int,
+                      view: int, op: int, commit: int, timestamp: int,
+                      parent: int, replica: int, context: int,
+                      release: int) -> np.void:
+        out = np.empty(1, self._dtype)
+        self._lib.tb_pl_build_prepare(
+            request.tobytes(), body, len(body),
+            cluster & 0xFFFFFFFFFFFFFFFF, cluster >> 64, view, op,
+            commit, timestamp, parent & 0xFFFFFFFFFFFFFFFF, parent >> 64,
+            replica, context, release,
+            ctypes.cast(out.ctypes.data, _U8P),
+        )
+        return out[0]
+
+    def build_prepare_ok(self, prepare: np.void, view: int,
+                         replica: int) -> np.void:
+        out = np.empty(1, self._dtype)
+        self._lib.tb_pl_build_prepare_ok(
+            prepare.tobytes(), view, replica,
+            ctypes.cast(out.ctypes.data, _U8P),
+        )
+        return out[0]
+
+    def note_prepare(self, header: np.void, synced: bool,
+                     self_replica: int) -> None:
+        self._lib.tb_pl_note_prepare(
+            self._pl, header.tobytes(), 1 if synced else 0, self_replica
+        )
+
+    def on_ack(self, header: np.void) -> int | None:
+        """Vote count after recording the ack, or None when the op has
+        no in-flight entry / the checksum names a stale sibling — the
+        same cases _on_prepare_ok drops."""
+        votes = self._lib.tb_pl_on_ack(self._pl, header.tobytes())
+        return None if votes < 0 else int(votes)
+
+    def mark_all_synced(self) -> None:
+        self._lib.tb_pl_mark_all_synced(self._pl)
+
+    def set_synced(self, op: int, synced: bool) -> bool:
+        return self._lib.tb_pl_set_synced(
+            self._pl, op, 1 if synced else 0
+        ) == 0
+
+    def drop(self, op: int) -> None:
+        self._lib.tb_pl_drop(self._pl, op)
+
+    def commit_ready(self, commit_min: int, quorum: int) -> bool:
+        return bool(self._lib.tb_pl_commit_ready(self._pl, commit_min, quorum))
+
+    def votes(self, op: int) -> int:
+        return int(self._lib.tb_pl_votes(self._pl, op))
+
+    def reset(self) -> None:
+        self._lib.tb_pl_reset(self._pl)
+
+    def size(self) -> int:
+        return int(self._lib.tb_pl_size(self._pl))
+
+
+def frame_prepare(header: np.void, body: bytes, headers_ring: np.ndarray,
+                  slot: int, headers_per_sector: int, sector_size: int,
+                  out_prepare: np.ndarray, out_sector: np.ndarray) -> int:
+    """Journal append framing in one C pass: builds the sector-padded
+    prepare buffer into `out_prepare` (returns the padded length),
+    writes `headers_ring[slot] = header` in place, and builds the
+    slot's redundant-header sector into `out_sector` — byte-identical
+    to journal.write_prepare's Python framing.  Caller guarantees the
+    library is loaded (pipeline_available())."""
+    lib = _load()
+    assert headers_ring.flags["C_CONTIGUOUS"]
+    return int(lib.tb_pl_frame_prepare(
+        header.tobytes(), body, len(body),
+        ctypes.cast(headers_ring.ctypes.data, _U8P), slot,
+        headers_per_sector, sector_size,
+        ctypes.cast(out_prepare.ctypes.data, _U8P),
+        ctypes.cast(out_sector.ctypes.data, _U8P),
+    ))
 
 
 def finalize_headers(headers: np.ndarray, bodies: list) -> bool:

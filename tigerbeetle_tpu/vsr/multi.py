@@ -32,6 +32,7 @@ the reference's VOPR does (reference: src/testing/cluster.zig:56-70).
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import numpy as np
 
@@ -332,6 +333,33 @@ class VsrReplica(Replica):
         self._stats["stat_gc_flushes"] = self.metrics.counter("gc_flushes")
         self._h_gc_sync = self.metrics.histogram("gc.sync_us")
         self._c_gc_deferred_acks = self.metrics.counter("gc.deferred_acks")
+
+        # Native commit pipeline (round 20): per-prepare header
+        # construction, journal append framing, the in-flight slot
+        # table, and the group-commit gate run in
+        # native/tb_pipeline.cpp when available; this replica keeps
+        # orchestration (view changes, checkpoints, recovery) and the
+        # Python pipeline dict stays authoritative for everything the
+        # slow paths scan (retransmit, eviction, view-change DVC).
+        # The C table mirrors the dict by pairing every mutation site;
+        # TB_NATIVE_PIPELINE=0 pins the pure-Python arm bit-identically.
+        from tigerbeetle_tpu.runtime import fastpath as _fastpath
+
+        self._np = (
+            _fastpath.create_pipeline()
+            if envcheck.native_pipeline() == 1
+            else None
+        )
+        # Per-prepare Python wall time (µs) on the primary's hot path —
+        # the `decode_us_per_event`-style instrument the native arm is
+        # graded against.  The replica registry grafts into the server
+        # snapshot under "vsr.", so these scrape as vsr.prepare_us /
+        # vsr.prepare_ok_us.  prepare_us times the primary's header
+        # build + pipeline bookkeeping; prepare_ok_us times the
+        # backup's ack build (body-independent, so the native-vs-
+        # Python delta stays visible under group-commit coalescing).
+        self._h_prepare_us = self.metrics.histogram("prepare_us")
+        self._h_prepare_ok_us = self.metrics.histogram("prepare_ok_us")
 
     # Compatibility properties over the registry handles (obs).
     stat_blocks_repaired = obs_stat_property("stat_blocks_repaired")
@@ -1043,20 +1071,40 @@ class VsrReplica(Replica):
         timestamp = self.sm.prepare_timestamp
 
         op = self.op + 1
-        prepare = wire.make_header(
-            command=Command.prepare, operation=operation,
-            cluster=self.cluster, client=wire.u128(request, "client"),
-            request=int(request["request"]), view=self.view,
-            op=op, commit=self.commit_min, timestamp=timestamp,
-            parent=self.parent_checksum, replica=self.replica,
-            context=len(subs) if subs else 0,
-            release=self.release,
-        )
-        # Trace context rides the prepare so every replica's hops key
-        # off the same request id (backups record journal_write /
-        # prepare_ok against it without any side channel).
-        wire.copy_trace(prepare, request)
-        wire.finalize_header(prepare, body)
+        # The instrument times exactly the spans the native pipeline
+        # replaces — header build + checksum stamping here, pipeline
+        # bookkeeping below — NOT sm.prepare / WAL write / replicate
+        # (body-proportional or I/O work both arms share; including it
+        # buried the arm delta under disk + scheduler noise).
+        t0 = time.perf_counter_ns()
+        if self._np is not None:
+            # Native arm: one C call builds + checksums the prepare
+            # header (client/request/operation/trace copied from the
+            # request in C) — bit-identical to the make_header +
+            # copy_trace + finalize_header sequence below.
+            prepare = self._np.build_prepare(
+                request, body, cluster=self.cluster, view=self.view,
+                op=op, commit=self.commit_min, timestamp=timestamp,
+                parent=self.parent_checksum, replica=self.replica,
+                context=len(subs) if subs else 0, release=self.release,
+            )
+        else:
+            prepare = wire.make_header(
+                command=Command.prepare, operation=operation,
+                cluster=self.cluster, client=wire.u128(request, "client"),
+                request=int(request["request"]), view=self.view,
+                op=op, commit=self.commit_min, timestamp=timestamp,
+                parent=self.parent_checksum, replica=self.replica,
+                context=len(subs) if subs else 0,
+                release=self.release,
+            )
+            # Trace context rides the prepare so every replica's hops
+            # key off the same request id (backups record
+            # journal_write / prepare_ok against it without any side
+            # channel).
+            wire.copy_trace(prepare, request)
+            wire.finalize_header(prepare, body)
+        build_ns = time.perf_counter_ns() - t0
         self.anatomy.stage_h(prepare, "prepare")
 
         self._journal_write(prepare, body)
@@ -1067,10 +1115,15 @@ class VsrReplica(Replica):
         # prepare supersedes it (a matching stale fill would otherwise
         # overwrite this slot — seed 460991023).
         self._repair_wanted.pop(op, None)
+        t1 = time.perf_counter_ns()
+        synced = not self._gc_enabled
         self.pipeline[op] = PipelineEntry(
-            prepare, body, {self.replica}, subs,
-            synced=not self._gc_enabled,
+            prepare, body, {self.replica}, subs, synced=synced,
         )
+        if self._np is not None:
+            self._np.note_prepare(prepare, synced, self.replica)
+        build_ns += time.perf_counter_ns() - t1
+        self._h_prepare_us.observe(build_ns / 1000.0)
         self._replicate(prepare, body)
         self._maybe_commit_pipeline()
 
@@ -1094,8 +1147,16 @@ class VsrReplica(Replica):
         entry = self.pipeline.get(op)
         if entry is None:
             return
-        if wire.u128(header, "context") != wire.u128(entry.header, "checksum"):
+        if self._np is not None:
+            # Native vote record: the C table checks op + exact
+            # checksum and updates the ack bitset; a None mirrors the
+            # Python early returns (unknown op / stale sibling).
+            if self._np.on_ack(header) is None:
+                return
+        elif wire.u128(header, "context") != wire.u128(entry.header, "checksum"):
             return
+        # The Python set stays maintained either way — retransmit,
+        # eviction, and view-change scans read it.
         entry.ok_replicas.add(int(header["replica"]))
         self.anatomy.stage_h(header, "prepare_ok")
         self._maybe_commit_pipeline()
@@ -1121,12 +1182,15 @@ class VsrReplica(Replica):
                 int(header["operation"]) >= constants.VSR_OPERATIONS_RESERVED
             ):
                 _events, subs = demuxer.decode_trailer(body, n_subs)
+            synced = not self._gc_defer()
             self.pipeline[op] = PipelineEntry(
                 header, body, {self.replica}, subs,
                 # Journaled earlier, but possibly within the current
                 # unsynced window — conservative.
-                synced=not self._gc_defer(),
+                synced=synced,
             )
+            if self._np is not None:
+                self._np.note_prepare(header, synced, self.replica)
             self._replicate(header, body)
         self._maybe_commit_pipeline()
 
@@ -1135,20 +1199,34 @@ class VsrReplica(Replica):
             op = min(self.pipeline)
             if op <= self.commit_min:  # committed via _advance_commit
                 del self.pipeline[op]
+                if self._np is not None:
+                    self._np.drop(op)
                 continue
             entry = self.pipeline[op]
-            if len(entry.ok_replicas) < self.quorum_replication:
-                return
-            if not entry.synced:
-                # Our own WAL copy is not yet covered: backup acks
-                # alone must not commit (the quorum's durable-copy
-                # count includes our self-vote), and the committed
-                # commit_min would leak pre-sync through heartbeats
-                # and the next prepare's header.  flush_group_commit
-                # re-enters after the covering sync.
-                return
-            if op != self.commit_min + 1:
-                return  # waiting on repair of earlier ops
+            if self._np is not None:
+                # Native group-commit gate: quorum of exact-checksum
+                # votes AND sync-covered AND contiguous (commit_min+1)
+                # answered by one C call over the slot table — the
+                # same three gates the Python arm below walks.
+                if not self._np.commit_ready(
+                    self.commit_min, self.quorum_replication
+                ):
+                    return
+                if op != self.commit_min + 1:
+                    return  # waiting on repair of earlier ops
+            else:
+                if len(entry.ok_replicas) < self.quorum_replication:
+                    return
+                if not entry.synced:
+                    # Our own WAL copy is not yet covered: backup acks
+                    # alone must not commit (the quorum's durable-copy
+                    # count includes our self-vote), and the committed
+                    # commit_min would leak pre-sync through heartbeats
+                    # and the next prepare's header.  flush_group_commit
+                    # re-enters after the covering sync.
+                    return
+                if op != self.commit_min + 1:
+                    return  # waiting on repair of earlier ops
             if int(entry.header["release"]) > self.release:
                 return  # prepared by a newer release; upgrade first
             reply_body = self._commit_prepare(entry.header, entry.body)
@@ -1184,6 +1262,8 @@ class VsrReplica(Replica):
                     wire.tenant_of(entry.header, entry.body), entry.header
                 )
             del self.pipeline[op]
+            if self._np is not None:
+                self._np.drop(op)
             if self._checkpoint_due():
                 # Deterministic checkpoint point: commit_min crosses the
                 # interval boundary at the same op on every replica, so
@@ -1457,6 +1537,8 @@ class VsrReplica(Replica):
         ):
             for e in self.pipeline.values():
                 e.synced = True
+            if self._np is not None:
+                self._np.mark_all_synced()
             self._maybe_commit_pipeline()
 
     def _aof_barrier(self) -> None:
@@ -1568,7 +1650,12 @@ class VsrReplica(Replica):
             self._vouched.setdefault(op - 1, wire.u128(header, "parent"))
         self._repair_wanted.pop(op, None)
         self._replicate(header, body)
+        # Backup-side instrument: just the prepare_ok build span (the
+        # work the native pipeline replaces here) — body-independent,
+        # so the arm delta survives heavy group-commit coalescing.
+        t0 = time.perf_counter_ns()
         self._send_prepare_ok(header)
+        self._h_prepare_ok_us.observe((time.perf_counter_ns() - t0) / 1000.0)
 
     def _flag_stale_predecessor(self, header: np.ndarray) -> None:
         """Chain continuity at journal-write time: the accepted prepare
@@ -1591,17 +1678,24 @@ class VsrReplica(Replica):
     def _send_prepare_ok(self, prepare: np.ndarray) -> None:
         if self.status != "normal" or self.is_primary or self.standby:
             return  # standbys replicate without acking: no quorum role
-        ok = wire.make_header(
-            command=Command.prepare_ok, cluster=self.cluster, view=self.view,
-            op=int(prepare["op"]), replica=self.replica,
-            context=wire.u128(prepare, "checksum"),
-            client=wire.u128(prepare, "client"),
-        )
-        # The ack echoes the prepare's trace context so the PRIMARY
-        # can stamp a prepare_ok stage (per acking backup) onto the
-        # request's timeline.
-        wire.copy_trace(ok, prepare)
-        wire.finalize_header(ok, b"")
+        if self._np is not None:
+            # Native arm: header build + checksum stamping in one C
+            # call (cluster/context/client/op/trace copied from the
+            # prepare in C) — bit-identical to the sequence below.
+            ok = self._np.build_prepare_ok(prepare, self.view, self.replica)
+        else:
+            ok = wire.make_header(
+                command=Command.prepare_ok, cluster=self.cluster,
+                view=self.view,
+                op=int(prepare["op"]), replica=self.replica,
+                context=wire.u128(prepare, "checksum"),
+                client=wire.u128(prepare, "client"),
+            )
+            # The ack echoes the prepare's trace context so the
+            # PRIMARY can stamp a prepare_ok stage (per acking backup)
+            # onto the request's timeline.
+            wire.copy_trace(ok, prepare)
+            wire.finalize_header(ok, b"")
         self.tracer.instant("prepare_ok", op=int(prepare["op"]))
         # Routed through the group-commit gate: a prepare_ok for an op
         # whose WAL write is not yet covered by a sync must wait for
@@ -2517,6 +2611,8 @@ class VsrReplica(Replica):
             # view likewise outrank the kept suffix in _tail_headers.
         )
         self.pipeline.clear()
+        if self._np is not None:
+            self._np.reset()
         self.request_queue.clear()
         self._queue_tenants.clear()
         self._tenant_depth.clear()
