@@ -156,21 +156,172 @@ def test_device_update_matches_scratch():
         np.uint32
     )
     fns = cm.device_fns()
-    row_hash, digest = fns["rebuild"](jnp.asarray(bal), jnp.asarray(meta))
+    arange = jnp.arange(128, dtype=jnp.uint64)
+    row_hash, digest = fns["rebuild"](
+        jnp.asarray(bal), jnp.asarray(meta), arange
+    )
     assert (np.asarray(digest) == cm.table_digest(bal, meta)).all()
     for _ in range(5):
         k = int(rng.integers(1, 40))
         slots = np.unique(rng.integers(0, 128, k))
         bal[slots] ^= rng.integers(0, 1 << 64, (len(slots), 8), dtype=np.uint64)
+        padded = jnp.asarray(cm.pad_slots(slots))
         row_hash, digest = fns["update"](
             jnp.asarray(bal), jnp.asarray(meta), row_hash, digest,
-            jnp.asarray(cm.pad_slots(slots)),
+            padded, padded,
         )
         assert (np.asarray(digest) == cm.table_digest(bal, meta)).all()
         pair = np.asarray(
-            fns["probe"](jnp.asarray(bal), jnp.asarray(meta), digest)
+            fns["probe"](jnp.asarray(bal), jnp.asarray(meta), digest, arange)
         )
         assert (pair[0] == pair[1]).all()
+
+
+def _mk_twin(rng, n):
+    """HostCommitment over a random fake mirror (lo/hi column pairs)."""
+    meta = rng.integers(0, 1 << 32, (n, 2), dtype=np.uint64).astype(np.uint32)
+
+    class _M:
+        pass
+
+    m = _M()
+    m.lo = rng.integers(0, 1 << 64, (n, 4), dtype=np.uint64)
+    m.hi = rng.integers(0, 1 << 64, (n, 4), dtype=np.uint64)
+    twin = cm.HostCommitment(n, meta_fn=lambda s: meta[s])
+    twin.refresh(np.arange(n, dtype=np.int64), m)
+    return twin, m
+
+
+def test_partial_fold_hot_cold_split_fuzz():
+    """Tiering's root invariant: for ANY hot/cold split of the table,
+    partial(hot) + partial(cold) == digest per lane (mod 2^64) — the
+    cold partial never needs hashing, it is digest - partial(hot).
+    Duplicates collapse and out-of-range/negative rows are ignored, so
+    a hot set handed in admission order folds the same as sorted."""
+    rng = np.random.default_rng(7)
+    for trial in range(10):
+        n = int(rng.integers(2, 200))
+        twin, _ = _mk_twin(rng, n)
+        k = int(rng.integers(0, n + 1))
+        hot = rng.choice(n, size=k, replace=False)
+        cold = np.setdiff1d(np.arange(n), hot)
+        assert (
+            twin.partial(hot) + twin.partial(cold) == twin.digest
+        ).all(), trial
+        messy = np.concatenate(
+            [rng.permutation(hot), hot, [-1, n, n + 17]]
+        )
+        assert (twin.partial(messy) == twin.partial(hot)).all(), trial
+
+
+def test_partial_fold_degenerate_splits():
+    """Empty cold tier: the hot partial IS the root (all-resident
+    collapses to today's compare).  Empty hot set: partial is the
+    zero lane pair and the cold partial is the whole digest."""
+    rng = np.random.default_rng(8)
+    twin, _ = _mk_twin(rng, 64)
+    assert (twin.partial(np.arange(64)) == twin.digest).all()
+    assert (twin.partial(np.zeros(0, np.int64)) == 0).all()
+
+
+def test_device_admit_tracks_hot_partial():
+    """Tiered device digest lifecycle against the host twin: an empty
+    hot table folds to zero; every admission (free slots), mid-
+    residency mutation (update kernel), and eviction-with-replacement
+    (admit kernel over occupied victim slots) leaves the maintained
+    device digest equal to twin.partial(occupied) — so
+    fold(hot_partial, cold_partial) == twin.digest throughout."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    N, H = 32, 8  # logical rows, hot budget
+    twin, m = _mk_twin(rng, N)
+    fns = cm.device_fns()
+
+    bal_hot = np.zeros((H, 8), np.uint64)
+    meta_hot = np.zeros((H, 2), np.uint32)
+    logical_of = np.full(H, -1, np.int64)
+
+    def rows_binding():
+        # Free slots bind to row 0; their all-zero content hashes to
+        # (0, 0) regardless of the binding (the engine's _commit_rows).
+        return jnp.asarray(
+            np.where(logical_of >= 0, logical_of, 0).astype(np.uint64)
+        )
+
+    row_hash, digest = fns["rebuild"](
+        jnp.asarray(bal_hot), jnp.asarray(meta_hot), rows_binding()
+    )
+    assert (np.asarray(digest) == 0).all()  # empty hot set
+
+    def admit(rows, slots):
+        rows = np.asarray(rows, np.int64)
+        slots = np.asarray(slots, np.int64)
+        bal_hot[slots, 0::2] = m.lo[rows]
+        bal_hot[slots, 1::2] = m.hi[rows]
+        meta_hot[slots] = twin.meta_fn(rows)
+        logical_of[slots] = rows
+        padded = cm.pad_slots(slots)
+        k = len(slots)
+        new_lo = np.zeros(len(padded), np.uint64)
+        new_hi = np.zeros(len(padded), np.uint64)
+        new_lo[:k] = twin.row_lo[rows]
+        new_hi[:k] = twin.row_hi[rows]
+        return fns["admit"](
+            row_hash, digest, jnp.asarray(padded),
+            jnp.asarray(new_lo), jnp.asarray(new_hi),
+        )
+
+    def check(step):
+        occupied = logical_of[logical_of >= 0]
+        assert (np.asarray(digest) == twin.partial(occupied)).all(), step
+        pair = np.asarray(
+            fns["probe"](
+                jnp.asarray(bal_hot), jnp.asarray(meta_hot), digest,
+                rows_binding(),
+            )
+        )
+        assert (pair[0] == pair[1]).all(), step
+
+    # Admission into free slots.
+    row_hash, digest = admit([3, 9, 20], [0, 1, 2])
+    check("admit-free")
+    row_hash, digest = admit([4, 5, 6, 7, 8], [3, 4, 5, 6, 7])
+    check("admit-fill")
+
+    # Mid-residency mutation: the mirror (and twin) move first, then
+    # the device row is rewritten and the update kernel rolls the
+    # partial — same order as a write-behind flush.
+    touched = np.array([9, 5], np.int64)
+    m.lo[touched] ^= rng.integers(0, 1 << 64, (2, 4), dtype=np.uint64)
+    twin.refresh(touched, m)
+    hot_slots = np.array(
+        [np.flatnonzero(logical_of == r)[0] for r in touched], np.int64
+    )
+    bal_hot[hot_slots, 0::2] = m.lo[touched]
+    bal_hot[hot_slots, 1::2] = m.hi[touched]
+    padded = cm.pad_slots(hot_slots)
+    rows_pad = np.where(
+        padded >= 0, logical_of[np.maximum(padded, 0)], 0
+    ).astype(np.uint64)
+    row_hash, digest = fns["update"](
+        jnp.asarray(bal_hot), jnp.asarray(meta_hot), row_hash, digest,
+        jnp.asarray(padded), jnp.asarray(rows_pad),
+    )
+    check("update-mid-residency")
+
+    # Eviction with replacement: new rows land on occupied victim
+    # slots; the admit kernel rolls out the victims' hashes.
+    row_hash, digest = admit([25, 26], [0, 3])
+    check("evict-readmit")
+
+    # The digest is the hot PARTIAL, not the table digest: with a
+    # non-empty cold tier they differ, and the cold partial closes
+    # the fold.
+    occupied = logical_of[logical_of >= 0]
+    cold = np.setdiff1d(np.arange(N), occupied)
+    assert len(cold) and not (np.asarray(digest) == twin.digest).all()
+    assert (np.asarray(digest) + twin.partial(cold) == twin.digest).all()
 
 
 def test_fold_cluster_deterministic_and_index_bound():

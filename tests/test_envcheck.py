@@ -674,6 +674,37 @@ def test_tb_follower_attest_max_ms_validated(monkeypatch):
     assert envcheck.follower_attest_max_ms() == 2000
 
 
+def test_tb_hot_capacity_validated(monkeypatch):
+    monkeypatch.setenv("TB_HOT_CAPACITY", "plenty")
+    with pytest.raises(envcheck.EnvVarError, match="TB_HOT_CAPACITY"):
+        envcheck.hot_capacity()
+    monkeypatch.setenv("TB_HOT_CAPACITY", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.hot_capacity()
+    monkeypatch.setenv("TB_HOT_CAPACITY", str((1 << 31) + 1))
+    with pytest.raises(envcheck.EnvVarError, match="must be <="):
+        envcheck.hot_capacity()
+    monkeypatch.setenv("TB_HOT_CAPACITY", "64")
+    assert envcheck.hot_capacity() == 64
+    monkeypatch.delenv("TB_HOT_CAPACITY")
+    assert envcheck.hot_capacity() == 0  # default: all-resident
+
+
+def test_tb_hot_capacity_gates_tiering(monkeypatch):
+    """The knob is read at CONSTRUCTION through hot_tier.from_env —
+    0/unset and budget >= capacity leave the table all-resident
+    (today's behavior bit-for-bit); a small budget builds the tier."""
+    from tigerbeetle_tpu.state_machine import hot_tier
+
+    monkeypatch.delenv("TB_HOT_CAPACITY", raising=False)
+    assert hot_tier.from_env(256) is None
+    monkeypatch.setenv("TB_HOT_CAPACITY", "256")
+    assert hot_tier.from_env(256) is None
+    monkeypatch.setenv("TB_HOT_CAPACITY", "16")
+    tier = hot_tier.from_env(256)
+    assert tier is not None and tier.hot_rows == 16
+
+
 def test_tb_native_pipeline_validated(monkeypatch):
     monkeypatch.setenv("TB_NATIVE_PIPELINE", "fast")
     with pytest.raises(envcheck.EnvVarError, match="TB_NATIVE_PIPELINE"):

@@ -344,6 +344,26 @@ def scrub_fallback_every() -> int:
 
 
 # ----------------------------------------------------------------------
+# Hot/cold account tiering (state_machine/hot_tier.py).
+
+
+def hot_capacity() -> int:
+    """TB_HOT_CAPACITY: device-resident hot-set rows for the tiered
+    account table.  0 (default) keeps the whole logical table
+    HBM-resident — bit-for-bit the untiered behavior.  A positive
+    value below the logical capacity caps the device table at that
+    many rows: the batch planner prefetches each batch's cold rows
+    from the host mirror (the cold tier) before the device step, LRU
+    admission/eviction rides the write-behind lane, and the 16-byte
+    state root keeps covering the whole logical table as
+    fold(hot_partial, cold_partial).  Values >= the logical capacity
+    degenerate to all-resident.  Read at engine CONSTRUCTION time
+    (per-arm env changes in one bench process work); forcing tiny
+    values is the differential-fuzz lever."""
+    return env_int("TB_HOT_CAPACITY", 0, minimum=0, maximum=1 << 31)
+
+
+# ----------------------------------------------------------------------
 # Root-attested follower serving (runtime/follower.py; round 19).
 
 

@@ -242,11 +242,10 @@ class HostCommitment:
     def _ensure(self, capacity: int) -> None:
         if capacity <= len(self.row_lo):
             return
-        lo = np.zeros(capacity, np.uint64)
-        hi = np.zeros(capacity, np.uint64)
-        lo[: len(self.row_lo)] = self.row_lo
-        hi[: len(self.row_hi)] = self.row_hi
-        self.row_lo, self.row_hi = lo, hi
+        from tigerbeetle_tpu.state_machine.hot_tier import grow_zero_host
+
+        self.row_lo = grow_zero_host(self.row_lo, capacity)
+        self.row_hi = grow_zero_host(self.row_hi, capacity)
 
     def refresh(self, slots, mirror) -> None:
         """Re-hash `slots` (any order, duplicates fine) from current
@@ -283,6 +282,23 @@ class HostCommitment:
         self.digest = np.zeros(2, np.uint64)
         self.refresh(np.arange(cap, dtype=np.int64), mirror)
 
+    def partial(self, rows) -> np.ndarray:
+        """(2,) u64 fold of the STORED hashes of `rows` (any order,
+        duplicates collapsed) — the host-side view of a tiered device
+        engine's hot partial.  Because the fold is an order-independent
+        per-lane sum, ``digest == partial(hot) + partial(cold)`` for
+        any split of the table, and the cold partial is just
+        ``digest - partial(hot)`` — no cold-row hashing needed."""
+        rows = np.unique(np.asarray(rows, np.int64))
+        rows = rows[(rows >= 0) & (rows < len(self.row_lo))]
+        return np.array(
+            [
+                np.add.reduce(self.row_lo[rows], dtype=np.uint64),
+                np.add.reduce(self.row_hi[rows], dtype=np.uint64),
+            ],
+            np.uint64,
+        )
+
     def root_bytes(self) -> bytes:
         return root_bytes(self.digest)
 
@@ -308,18 +324,27 @@ def device_fns() -> dict:
     jax.config.update("jax_enable_x64", True)  # u64 lanes throughout
     import jax.numpy as jnp
 
-    def _rebuild(balances, meta):
-        rows = jnp.arange(balances.shape[0], dtype=jnp.uint64)
+    # Every kernel takes an explicit `rows` binding — the LOGICAL row
+    # id hashed into each table row.  A dense (untiered) engine passes
+    # arange / the slot array itself; a TIERED engine's hot-shaped
+    # tables pass logical_of / the logical rows behind its hot slots,
+    # so the device digest is the HOT PARTIAL of the logical table's
+    # fold and fold(hot_partial, cold_partial) == root.  Free hot slots
+    # are all-zero rows, which hash to (0, 0) regardless of binding.
+
+    def _rebuild(balances, meta, rows):
         lo, hi = rows_hash(rows, balances, meta, jnp)
         return jnp.stack([lo, hi], axis=-1), fold(lo, hi, jnp)
 
-    def _update(balances, meta, row_hash, digest, slots):
-        """Incremental absorb of (deduplicated) touched `slots`; -1
-        entries are padding and contribute nothing."""
+    def _update(balances, meta, row_hash, digest, slots, rows):
+        """Incremental absorb of (deduplicated) touched `slots`
+        (indices into the device tables) hashed under logical ids
+        `rows`; -1 slot entries are padding and contribute nothing."""
         A = balances.shape[0]
         valid = slots >= 0
         idx = jnp.where(valid, slots, 0)
-        lo, hi = rows_hash(idx, balances[idx], meta[idx], jnp)
+        r = jnp.where(valid, rows, 0)
+        lo, hi = rows_hash(r, balances[idx], meta[idx], jnp)
         zero = jnp.uint64(0)
         lo = jnp.where(valid, lo, zero)
         hi = jnp.where(valid, hi, zero)
@@ -330,17 +355,38 @@ def device_fns() -> dict:
         row_hash = row_hash.at[scatter].set(new, mode="drop")
         return row_hash, digest
 
-    def _probe(balances, meta, digest):
+    def _admit(row_hash, digest, slots, new_lo, new_hi):
+        """Tiered admission/eviction in one step: replace the hashes
+        at hot `slots` (the victims' — or zero for free slots) with
+        the admitted rows' host-twin hashes `new_lo`/`new_hi`, rolling
+        the hot-partial digest by (new - old).  Exact because admitted
+        device content is uploaded from the very mirror rows the twin
+        hashed; -1 slots are padding."""
+        A = row_hash.shape[0]
+        valid = slots >= 0
+        idx = jnp.where(valid, slots, 0)
+        zero = jnp.uint64(0)
+        new = jnp.stack(
+            [jnp.where(valid, new_lo, zero), jnp.where(valid, new_hi, zero)],
+            axis=-1,
+        )
+        old = jnp.where(valid[:, None], row_hash[idx], zero)
+        digest = digest + (new - old).sum(axis=0, dtype=jnp.uint64)
+        scatter = jnp.where(valid, idx, A)
+        row_hash = row_hash.at[scatter].set(new, mode="drop")
+        return row_hash, digest
+
+    def _probe(balances, meta, digest, rows):
         """(2, 2): [maintained digest, from-scratch digest] — ONE
         dispatch + one 32-byte fetch covers both the drift check and
         the memory-corruption check."""
-        rows = jnp.arange(balances.shape[0], dtype=jnp.uint64)
         lo, hi = rows_hash(rows, balances, meta, jnp)
         return jnp.stack([digest, fold(lo, hi, jnp)])
 
     _DEVICE_FNS = {
         "rebuild": jax.jit(_rebuild),
         "update": jax.jit(_update),
+        "admit": jax.jit(_admit),
         "probe": jax.jit(_probe),
     }
     return _DEVICE_FNS

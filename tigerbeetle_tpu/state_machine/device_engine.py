@@ -275,12 +275,13 @@ class _InFlight:
     __slots__ = (
         "kind", "pk", "n", "ts_base", "finish", "fallback", "future",
         "ring_at", "id_keys", "handle", "slots", "rows", "meta_args",
-        "wave_args", "bound", "touched",
+        "wave_args", "bound", "touched", "hot_slots",
     )
 
     def __init__(self, kind, future, finish, *, pk=None, n=0, ts_base=0,
                  fallback=None, ring_at=-1, id_keys=None, handle=None,
-                 slots=None, meta_args=None, wave_args=None, bound=0):
+                 slots=None, meta_args=None, wave_args=None, bound=0,
+                 hot_slots=None):
         self.kind = kind
         self.pk = pk
         self.n = n
@@ -291,7 +292,9 @@ class _InFlight:
         self.ring_at = ring_at
         self.id_keys = id_keys  # sorted u128-packed ids (hazard probes)
         self.handle = handle    # lookup gather / wave packed-output handle
-        self.slots = slots      # lookup slots (for re-gather)
+        self.slots = slots      # lookup slots, LOGICAL (host replay reads
+                                # them against the mirror)
+        self.hot_slots = hot_slots  # tiered device translation of slots
         self.rows = None        # lookup rows / wave outputs fetched at rotation
         self.meta_args = meta_args  # (slots, flags, ledger) for "meta"
         # (waves.PackedColumns, plan): the compact columnar record —
@@ -333,6 +336,17 @@ def make_spec_stats(registry) -> dict:
     return st
 
 
+def make_tier_stats(registry) -> dict:
+    """dev_tier.* handles for the hot/cold tiering (hot_tier.py) —
+    same owning-machine-binds-handles contract as make_spec_stats."""
+    st = {
+        name: registry.counter("dev_tier." + name)
+        for name in ("hit", "miss", "evict", "prefetch", "prefetch_stall_us")
+    }
+    st["prefetch_us"] = registry.histogram("dev_tier.prefetch_us")
+    return st
+
+
 _KERNELS = {
     "orderfree": dk.orderfree,
     "orderfree_lo": dk.orderfree_lo,
@@ -345,6 +359,18 @@ _KERNELS = {
 _SEMANTIC_KINDS = tuple(_KERNELS)
 
 _MASK32_NP = np.uint64(0xFFFFFFFF)
+
+
+def _tier_set_rows(table, idx, rows):
+    """Overwrite table[idx] = rows; padding entries carry DISTINCT
+    out-of-range indices (dropped — duplicates would void the
+    unique_indices promise even for dropped entries)."""
+    return table.at[idx].set(rows, mode="drop", unique_indices=True)
+
+
+# No donation: the link layer may retry a transiently-failed dispatch,
+# which must not find its input buffer already consumed.
+_TIER_SET = jax.jit(_tier_set_rows)
 
 
 def _touched_of_pk(kind: str, pk, n: int) -> np.ndarray:
@@ -380,6 +406,15 @@ class DeviceEngine:
                  seed: int | None = None, metrics=None) -> None:
         self.capacity = capacity
         self.mirror = mirror  # host bookkeeping copy (recovery + parity)
+        # Hot/cold account tiering (hot_tier.py, TB_HOT_CAPACITY): when
+        # active, the device tables hold only `hot.hot_rows` rows; the
+        # mirror (+ _meta_host) is the full-logical cold tier, and
+        # every submit path prefetches its touched-account set into the
+        # hot window first (tier_prefetch).  None = all-resident.
+        from tigerbeetle_tpu.state_machine import hot_tier as _hot_tier
+
+        self.hot = _hot_tier.from_env(capacity)
+        device_rows = capacity if self.hot is None else self.hot.hot_rows
         self.window = _WINDOW
         self.link = link if link is not None else DeviceLink()
         # Lifecycle (types.EngineState): healthy -> degraded on fatal
@@ -491,7 +526,7 @@ class DeviceEngine:
         # CPU mesh).
         self.sharding = None
         devices = jax.devices()
-        if len(devices) > 1 and capacity % len(devices) == 0:
+        if len(devices) > 1 and device_rows % len(devices) == 0:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
@@ -520,8 +555,10 @@ class DeviceEngine:
                 capacity, meta_fn=self._twin_meta
             )
         try:
-            self.balances = self._place(jnp.zeros((capacity, 8), jnp.uint64))
-            self.meta = self._place(jnp.zeros((capacity, 2), jnp.uint32))
+            self.balances = self._place(
+                jnp.zeros((device_rows, 8), jnp.uint64)
+            )
+            self.meta = self._place(jnp.zeros((device_rows, 2), jnp.uint32))
             self._commit_rebuild()
         except DeviceLostError as exc:
             # Born degraded: the link was already dead at construction.
@@ -530,8 +567,8 @@ class DeviceEngine:
             # re-promotion replaces them from the mirror.
             self.state = EngineState.degraded
             self.last_demotion = repr(exc)
-            self.balances = jnp.zeros((capacity, 8), jnp.uint64)
-            self.meta = jnp.zeros((capacity, 2), jnp.uint32)
+            self.balances = jnp.zeros((device_rows, 8), jnp.uint64)
+            self.meta = jnp.zeros((device_rows, 2), jnp.uint32)
         # Window pipeline: _pending accumulates host-side; _launched is
         # the window currently executing on device; _recovering holds a
         # window mid-exact-recovery — detached from _launched so a
@@ -688,12 +725,13 @@ class DeviceEngine:
             from tigerbeetle_tpu.state_machine import commitment as _cm
 
             fns = _cm.device_fns()
+            warm_pad = jnp.asarray(_cm.pad_slots(np.zeros(0, np.int64)))
             self._retry(
                 lambda: self.link.block_until_ready(
                     self.link.dispatch(
                         fns["update"], self.balances, self.meta,
                         self.dev_row_hash, self.dev_digest,
-                        jnp.asarray(_cm.pad_slots(np.zeros(0, np.int64))),
+                        warm_pad, warm_pad,
                     )
                 ),
                 "dispatch",
@@ -754,15 +792,14 @@ class DeviceEngine:
             # re-promotion re-uploads the whole meta table from it.  A
             # queued record would force a doomed launch at next drain.
             return
-        self._pending.append(
-            _InFlight(
-                "meta", None, None,
-                meta_args=(
-                    slots,
-                    np.asarray(acct_flags, np.uint32),
-                    np.asarray(acct_ledger, np.uint32),
-                ),
-            )
+        self._queue_meta(
+            slots,
+            np.broadcast_to(
+                np.asarray(acct_flags, np.uint32), slots.shape
+            ).copy(),
+            np.broadcast_to(
+                np.asarray(acct_ledger, np.uint32), slots.shape
+            ).copy(),
         )
 
     def remove_accounts(self, slots) -> None:
@@ -774,8 +811,26 @@ class DeviceEngine:
         if self.state is not EngineState.healthy:
             return  # see add_accounts
         z = np.zeros(len(slots), np.uint32)
+        self._queue_meta(slots, z, z)
+
+    def _queue_meta(self, slots, flags_u32, ledger_u32) -> None:
+        """Queue a device meta update.  Tiered, meta records carry HOT
+        slots (the map is stable until the next admission, which drains
+        first), and cold rows are dropped — _meta_host stays the
+        authority and admission uploads their meta."""
+        if self.hot is not None:
+            h = self.hot.translate(slots)
+            keep = h >= 0
+            if not keep.any():
+                return
+            slots = h[keep]
+            flags_u32 = flags_u32[keep]
+            ledger_u32 = ledger_u32[keep]
         self._pending.append(
-            _InFlight("meta", None, None, meta_args=(slots, z, z))
+            _InFlight(
+                "meta", None, None,
+                meta_args=(slots, flags_u32, ledger_u32),
+            )
         )
 
     def grow(self, capacity: int) -> None:
@@ -783,18 +838,24 @@ class DeviceEngine:
             return
         self.drain()
         self.flush()
-        was_sharded = self.sharding is not None
-        if was_sharded and capacity % self.sharding.mesh.devices.size != 0:
-            self.sharding = None  # re-place replicated from here on
-        extra = capacity - self.capacity
         old_capacity = self.capacity
-        mh = np.zeros((capacity, 2), np.uint32)
-        mh[:old_capacity] = self._meta_host
-        self._meta_host = mh
+        from tigerbeetle_tpu.state_machine.hot_tier import grow_zero_host
+
+        self._meta_host = grow_zero_host(self._meta_host, capacity)
         # Capacity is committed before any link work: a demotion mid-
         # widen serves from the mirror at the NEW capacity, and
         # re-promotion rebuilds both tables from the mirror at it.
         self.capacity = capacity
+        if self.hot is not None:
+            # Tiered: the device tables keep their fixed hot-row
+            # geometry — logical growth widens only the host maps (the
+            # new rows are cold-zero, so the hot partial is untouched).
+            self.hot.grow_logical(capacity)
+            return
+        was_sharded = self.sharding is not None
+        if was_sharded and capacity % self.sharding.mesh.devices.size != 0:
+            self.sharding = None  # re-place replicated from here on
+        extra = capacity - old_capacity
         if self.state is not EngineState.healthy:
             return
 
@@ -822,6 +883,92 @@ class DeviceEngine:
             self._commit_rebuild()
         except DeviceLostError as exc:
             self._demote(exc)
+
+    # ------------------------------------------------------------------
+    # Hot/cold tiering (hot_tier.py): the batch planner calls
+    # tier_prefetch with a batch's LOGICAL touched-account set BEFORE
+    # packing; packed records then carry translated HOT slots.  The hot
+    # map only ever changes against a quiesced pipeline (admission
+    # drains + flushes first), so every in-flight record executes under
+    # the map it was translated with, and eviction is free: after the
+    # drain the mirror already holds every finished batch's effects —
+    # the write-behind lane IS the dirty write-back path.
+
+    def tier_prefetch(self, slots) -> bool:
+        """Make every LOGICAL row in `slots` device-resident (negative
+        entries ignored).  Returns False when the batch cannot run on
+        device — touched set wider than the hot window, engine not
+        healthy, or the link died mid-admission — and the caller takes
+        the exact host path."""
+        if self.hot is None:
+            return True
+        import time as _time
+
+        hot = self.hot
+        uniq, missing = hot.plan(np.asarray(slots, np.int64))
+        if len(missing) == 0:
+            hot.record_use(uniq, len(uniq), 0)
+            return True
+        if len(uniq) > hot.hot_rows:
+            return False
+        if self.state is not EngineState.healthy:
+            return False
+        t0 = _time.perf_counter()
+        # Quiesce before the map moves (see section comment); the
+        # drain can itself demote — re-check before touching the map.
+        self.drain()
+        self.flush()
+        if self.state is not EngineState.healthy:
+            return False
+        got = hot.admit(missing, protect=uniq)
+        if got is None:
+            return False
+        admitted, hot_slots, _evicted = got
+        try:
+            self._tier_upload(admitted, hot_slots)
+        except DeviceLostError as exc:
+            self._demote(exc)
+            return False
+        hot.record_use(uniq, len(uniq) - len(missing), len(missing))
+        hot.note_stall(_time.perf_counter() - t0)
+        return True
+
+    def _tier_upload(self, admitted, hot_slots) -> None:
+        """Upload admitted rows (balances + meta, straight from the
+        cold tier) into their hot slots, and roll the device digest by
+        the swap: the commitment "admit" kernel replaces the victim
+        slots' row hashes with the host twin's hashes for the admitted
+        rows — the digest stays the exact hot partial throughout."""
+        if len(admitted) == 0:
+            return
+        from tigerbeetle_tpu.state_machine import commitment as _cm
+
+        k = len(admitted)
+        padded = _cm.pad_slots(np.asarray(hot_slots, np.int64))
+        H = self.balances.shape[0]
+        idx = np.where(
+            padded >= 0, padded, H + np.arange(len(padded), dtype=np.int64)
+        )
+        bal = np.zeros((len(padded), 8), np.uint64)
+        bal[:k] = self.mirror.rows8(admitted)
+        meta = np.zeros((len(padded), 2), np.uint32)
+        meta[:k] = self._meta_host[admitted]
+        idx_j = self._put(idx)
+        self.balances = self._run(
+            _TIER_SET, self.balances, idx_j, self._put(bal)
+        )
+        self.meta = self._run(_TIER_SET, self.meta, idx_j, self._put(meta))
+        if self._commit_enabled and self.dev_row_hash is not None:
+            twin = self.mirror.commitment
+            new_lo = np.zeros(len(padded), np.uint64)
+            new_hi = np.zeros(len(padded), np.uint64)
+            new_lo[:k] = twin.row_lo[admitted]
+            new_hi[:k] = twin.row_hi[admitted]
+            fns = _cm.device_fns()
+            self.dev_row_hash, self.dev_digest = self._run(
+                fns["admit"], self.dev_row_hash, self.dev_digest,
+                self._put(padded), self._put(new_lo), self._put(new_hi),
+            )
 
     # ------------------------------------------------------------------
     # Semantic dispatch.
@@ -913,6 +1060,18 @@ class DeviceEngine:
         record on the stream + the pending-window memory peaks.
         `extra` is the kind's launch payload (the WavePlan for a wave
         record, the pv_serial routing fact for a speculative one)."""
+        if self.hot is not None:
+            # v1 tiering scope cut: the wave/speculative executors
+            # index the table by LOGICAL slot inside their event dicts;
+            # the router declines them (dev_wave.decline.tier) before
+            # reaching here, so this guard only covers direct engine
+            # callers — resolve exactly on the host.
+            fut = ReplyFuture(self)
+            self.drain()
+            self.flush()
+            self.stat_fallback_batches += 1
+            self._resolve_host_now(fut, fallback)
+            return fut
         fut = self._submit_record(
             n, fallback,
             lambda f: _InFlight(
@@ -1017,6 +1176,19 @@ class DeviceEngine:
                 fut, lambda: finish(self.mirror.rows8(slots))
             )
             return fut
+        # Tiered: the gather indexes the hot-shaped device table, so
+        # every looked-up row must be resident first.  If the batch
+        # can't be made resident, drain + flush and answer from the
+        # mirror — exact, since the drain materialized every earlier
+        # batch's bookkeeping there.
+        if not self.tier_prefetch(slots):
+            fut = ReplyFuture(self)
+            self.drain()
+            self.flush()
+            self._resolve_host_now(
+                fut, lambda: finish(self.mirror.rows8(slots))
+            )
+            return fut
         # Earlier host-resolved batches' write-behind deltas must be
         # visible to the gather (found by the wave-dispatch fuzz: a
         # lookup queued behind only meta records — no semantic submit,
@@ -1030,7 +1202,12 @@ class DeviceEngine:
             )
             return fut
         fut = ReplyFuture(self)
-        rec = _InFlight("lookup", fut, finish, slots=slots)
+        rec = _InFlight(
+            "lookup", fut, finish, slots=slots,
+            hot_slots=(
+                self.hot.translate(slots) if self.hot is not None else None
+            ),
+        )
         self._pending.append(rec)
         return fut
 
@@ -1173,7 +1350,11 @@ class DeviceEngine:
                 )
                 continue
             if ukind == "lookup":
-                urecs[0].handle = self._gather(urecs[0].slots)
+                rec0 = urecs[0]
+                urecs[0].handle = self._gather(
+                    rec0.hot_slots if rec0.hot_slots is not None
+                    else rec0.slots
+                )
                 continue
             if ukind == "waves":
                 self._exec_waves(urecs[0])
@@ -1492,7 +1673,10 @@ class DeviceEngine:
                         jnp.asarray(ledger),
                     )
                 elif rec.kind == "lookup":
-                    rec.handle = self._gather(rec.slots)
+                    rec.handle = self._gather(
+                        rec.hot_slots if rec.hot_slots is not None
+                        else rec.slots
+                    )
                 elif rec.kind == "waves":
                     self._exec_waves(rec)
                 elif rec.kind == "spec":
@@ -1510,6 +1694,22 @@ class DeviceEngine:
     def _mirror_table_np(self) -> np.ndarray:
         """Device-layout (capacity, 8) snapshot of the host mirror."""
         return self.mirror.table8(self.capacity)
+
+    def _mirror_hot_table_np(self) -> np.ndarray:
+        """Hot-shaped (hot_rows, 8) host image of the device balance
+        table — what the DEVICE table should equal under tiering."""
+        from tigerbeetle_tpu.state_machine.hot_tier import mirror_hot_table8
+
+        return mirror_hot_table8(self.mirror, self.hot.logical_of)
+
+    def _meta_hot_np(self) -> np.ndarray:
+        """Hot-shaped (hot_rows, 2) host image of the device meta
+        table (zeros for free hot slots)."""
+        lof = self.hot.logical_of
+        out = np.zeros((len(lof), 2), np.uint32)
+        occ = np.flatnonzero(lof >= 0)
+        out[occ] = self._meta_host[lof[occ]]
+        return out
 
     @staticmethod
     def _cpu_device():
@@ -1576,6 +1776,18 @@ class DeviceEngine:
         return np.concatenate([bal, meta])
 
     def _host_health_digest(self) -> np.ndarray:
+        # Tiered, the device tables are hot-shaped: digest the same
+        # hot-shaped host images the device should hold (the logical
+        # table is attested separately through the commitment fold).
+        if self.hot is not None:
+            from tigerbeetle_tpu.state_machine.mirror import digest_columns
+
+            return np.concatenate(
+                [
+                    digest_columns(self._mirror_hot_table_np()),
+                    self._meta_digest(self._meta_hot_np()),
+                ]
+            )
         return np.concatenate(
             [
                 self.mirror.checksum8(self.capacity),
@@ -1584,7 +1796,12 @@ class DeviceEngine:
         )
 
     def _upload_from_mirror(self) -> None:
-        self.balances = self._place(jnp.asarray(self._mirror_table_np()))
+        src = (
+            self._mirror_hot_table_np()
+            if self.hot is not None
+            else self._mirror_table_np()
+        )
+        self.balances = self._place(jnp.asarray(src))
         # The device table just changed wholesale: re-derive the
         # on-device commitment from scratch (one dispatch — callers
         # are recovery/re-promotion/heal paths, never the hot path).
@@ -1610,6 +1827,17 @@ class DeviceEngine:
         out[m] = self._meta_host[slots[m]]
         return out
 
+    def _commit_rows(self):
+        """Logical-row binding for the commitment kernels: identity
+        when all-resident, logical_of tiered.  Free hot slots bind to
+        row 0 — their all-zero content hashes to (0, 0) regardless of
+        the binding, so the digest is exactly the hot PARTIAL of the
+        logical table (fold(hot, cold) == the full root)."""
+        if self.hot is None:
+            return jnp.arange(self.balances.shape[0], dtype=jnp.uint64)
+        lof = self.hot.logical_of
+        return jnp.asarray(np.where(lof >= 0, lof, 0).astype(np.uint64))
+
     def _commit_rebuild(self) -> None:
         """From-scratch device digest (vectorized over the table ON
         DEVICE; on a row-sharded engine GSPMD computes shard-local
@@ -1620,27 +1848,35 @@ class DeviceEngine:
 
         fns = _cm.device_fns()
         self.dev_row_hash, self.dev_digest = self._run(
-            fns["rebuild"], self.balances, self.meta
+            fns["rebuild"], self.balances, self.meta, self._commit_rows()
         )
 
     def _commit_update(self, slots) -> None:
         """Absorb the touched rows of one launch/flush into the
-        on-device digest: ONE extra dispatch per window, O(touched)."""
+        on-device digest: ONE extra dispatch per window, O(touched).
+        `slots` index the DEVICE table (hot slots under tiering)."""
         if not self._commit_enabled or self.dev_row_hash is None:
             return
         slots = np.unique(np.asarray(slots, np.int64))
-        slots = slots[(slots >= 0) & (slots < self.capacity)]
+        slots = slots[(slots >= 0) & (slots < self.balances.shape[0])]
         if len(slots) == 0:
             return
         from tigerbeetle_tpu.state_machine import commitment as _cm
 
         fns = _cm.device_fns()
+        padded = _cm.pad_slots(slots)
+        if self.hot is None:
+            rows = padded
+        else:
+            rows = np.where(
+                padded >= 0, self.hot.logical_of[np.maximum(padded, 0)], 0
+            )
         self.stat_commit_updates += 1
         with self._h_commit_update.time():
             self.dev_row_hash, self.dev_digest = self._run(
                 fns["update"], self.balances, self.meta,
                 self.dev_row_hash, self.dev_digest,
-                jnp.asarray(_cm.pad_slots(slots)),
+                jnp.asarray(padded), jnp.asarray(rows),
             )
 
     def _collect_touched(self, recs) -> np.ndarray | None:
@@ -1667,7 +1903,8 @@ class DeviceEngine:
         return self._retry(
             lambda: self.link.fetch(
                 self.link.dispatch(
-                    fns["probe"], self.balances, self.meta, self.dev_digest
+                    fns["probe"], self.balances, self.meta,
+                    self.dev_digest, self._commit_rows(),
                 )
             ),
             "fetch",
@@ -1679,6 +1916,16 @@ class DeviceEngine:
             lambda: self.link.fetch(self.dev_digest), "fetch"
         )
 
+    def _twin_expected_digest(self) -> np.ndarray:
+        """What the host twin says the DEVICE digest should be: the
+        full root all-resident, the hot partial under tiering (the
+        cold partial is the twin's remainder — fold(hot, cold) stays
+        the whole-logical-table root)."""
+        twin = self.mirror.commitment
+        if self.hot is None:
+            return twin.digest
+        return twin.partial(self.hot.occupied())
+
     def _localize_divergence(self) -> np.ndarray:
         """THE full-table-fetch path (counted in commit.full_fetches):
         pull both device tables and name the diverged rows vs the
@@ -1688,6 +1935,13 @@ class DeviceEngine:
         self.stat_full_fetches += 1
         bal = self._retry(lambda: self.link.fetch(self.balances), "fetch")
         meta = self._retry(lambda: self.link.fetch(self.meta), "fetch")
+        if self.hot is not None:
+            # Compare hot-shaped tables, report LOGICAL row ids.
+            diverged = (bal != self._mirror_hot_table_np()).any(axis=1) | (
+                meta != self._meta_hot_np()
+            ).any(axis=1)
+            hot_rows = np.flatnonzero(diverged)
+            return self.hot.logical_of[hot_rows]
         diverged = (bal != self._mirror_table_np()).any(axis=1) | (
             meta != self._meta_host
         ).any(axis=1)
@@ -1696,7 +1950,10 @@ class DeviceEngine:
     def _heal_from_mirror(self) -> None:
         """Re-upload both tables from the host copies (meta first: the
         commitment rebuild inside _upload_from_mirror hashes it)."""
-        self.meta = self._place(jnp.asarray(self._meta_host))
+        meta_src = (
+            self._meta_hot_np() if self.hot is not None else self._meta_host
+        )
+        self.meta = self._place(jnp.asarray(meta_src))
         self._upload_from_mirror()
 
     def drain(self) -> None:
@@ -1833,8 +2090,10 @@ class DeviceEngine:
                 # Cheap handshake: the device's freshly-rebuilt 16-byte
                 # root vs the incrementally-maintained host twin — no
                 # full-table fetch, no host-side full digest pass.
+                # Tiered, the device root is the HOT PARTIAL of the
+                # logical table, so compare the twin's matching partial.
                 dev_sum = self.device_root()
-                host_sum = self.mirror.commitment.digest
+                host_sum = self._twin_expected_digest()
             else:
                 dev_sum = self._device_health_digest()
                 host_sum = self._host_health_digest()
@@ -1887,7 +2146,7 @@ class DeviceEngine:
                 self.stat_scrub_cheap += 1
                 with self._h_scrub_cheap.time():
                     pair = self.commit_probe()
-                host = self.mirror.commitment.digest
+                host = self._twin_expected_digest()
                 clean = bool(
                     (pair[0] == pair[1]).all() and (pair[1] == host).all()
                 )
@@ -2006,6 +2265,15 @@ class DeviceEngine:
             a_lo = np.concatenate([p[2] for p in parts])
             a_hi = np.concatenate([p[3] for p in parts])
         u_slot, u_col, d_lo, d_hi, _ = compact_deltas(slots, cols, a_lo, a_hi)
+        if self.hot is not None:
+            # Exact-path deltas arrive with LOGICAL slots; the device
+            # table is hot-shaped.  Cold rows keep their deltas in the
+            # mirror only (it already leads for host-resolved batches);
+            # they upload whole on admission.
+            h = self.hot.hot_of[u_slot]
+            keep = h >= 0
+            u_slot, u_col = h[keep], u_col[keep]
+            d_lo, d_hi = d_lo[keep], d_hi[keep]
         at = 0
         CH = 32_768
         while at < len(u_slot):
@@ -2044,15 +2312,42 @@ class DeviceEngine:
         self.flush()
         if self.state is not EngineState.healthy:
             return self._degraded_table()
+        if self.hot is not None:
+            # Tiered: the device holds only hot rows; the full LOGICAL
+            # table comes from the mirror, which the drain above made
+            # current for every finished batch.
+            return self._degraded_table()
         return self.balances
+
+    def write_back(self, value) -> None:
+        """Replace the device table from a full LOGICAL table image
+        (the owning machine's `_balances` setter).  Tiered, the hot
+        rows are gathered out of it and the digest rebuilt — the
+        mirror (which the caller updates through the same code path)
+        stays the cold-tier authority."""
+        if self.hot is None:
+            self.balances = value
+            return
+        lof = self.hot.logical_of
+        img = np.asarray(jax.device_get(value))
+        hot_np = np.zeros((len(lof), 8), np.uint64)
+        occ = np.flatnonzero(lof >= 0)
+        hot_np[occ] = img[lof[occ]]
+        try:
+            self.balances = self._place(jnp.asarray(hot_np))
+            self._commit_rebuild()
+        except DeviceLostError as exc:
+            self._demote(exc)
 
     def checksum(self) -> np.ndarray:
         """Authoritative-table digest (drained + flushed first): the
         device table while healthy, the mirror (computed host-side,
-        no device work at all) while degraded."""
+        no device work at all) while degraded.  Tiered, the digest
+        covers the LOGICAL table, so it always comes from the mirror —
+        the drain just guaranteed it is current."""
         self.drain()
         self.flush()
-        if self.state is not EngineState.healthy:
+        if self.state is not EngineState.healthy or self.hot is not None:
             return self.mirror.checksum8(self.capacity)
         try:
             return self._device_checksum()

@@ -94,15 +94,25 @@ class DeviceTable:
     """
 
     def __init__(self, capacity: int) -> None:
+        from tigerbeetle_tpu.state_machine import hot_tier
+
+        # Hot/cold tiering (TB_HOT_CAPACITY): when set below the
+        # logical capacity, the device table holds only the hot rows;
+        # the host mirror is the cold tier and full-table reads are
+        # served from it.  None (default) = all-resident, the untiered
+        # behavior bit-for-bit.
+        self.hot = hot_tier.from_env(capacity)
+        self.capacity = capacity
+        device_rows = capacity if self.hot is None else self.hot.hot_rows
         self.sharding = None
         devices = jax.devices()
-        if len(devices) > 1 and capacity % len(devices) == 0:
+        if len(devices) > 1 and device_rows % len(devices) == 0:
             from jax.sharding import Mesh, NamedSharding
             from jax.sharding import PartitionSpec as P
 
             mesh = Mesh(np.array(devices), ("shard",))
             self.sharding = NamedSharding(mesh, P("shard", None))
-        self.balances = self._place(jnp.zeros((capacity, 8), jnp.uint64))
+        self.balances = self._place(jnp.zeros((device_rows, 8), jnp.uint64))
         self._q: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = []
         self._queued = 0
         # Host BalanceMirror this table shadows (set by the owning
@@ -110,6 +120,9 @@ class DeviceTable:
         # arrays in place and feeds the deltas ONLY through enqueue,
         # so the incremental-commitment twin refreshes here.
         self.mirror = None
+        # Tiered full-table read cache, keyed by the mirror's mutation
+        # stamp (read() serves the LOGICAL table from the cold tier).
+        self._full_cache = None
 
     def _place(self, table):
         if self.sharding is None:
@@ -117,20 +130,103 @@ class DeviceTable:
         return jax.device_put(table, self.sharding)
 
     def grow(self, capacity: int) -> None:
-        have = self.balances.shape[0]
-        if capacity <= have:
+        if capacity <= self.capacity:
             return
-        extra = jnp.zeros((capacity - have, 8), jnp.uint64)
-        if self.sharding is None:
-            # Stays on-device and async — growth must not introduce a
-            # host round-trip on the commit path.
-            self.balances = jnp.concatenate([self.balances, extra])
-        else:
-            # Resharding to the new row count goes through the host
-            # (row boundaries move between devices).
-            self.balances = self._place(
-                jnp.concatenate([jax.device_get(self.balances), extra])
+        if self.hot is not None:
+            # The hot-row budget is a fixed HBM allowance: logical
+            # growth widens only the maps (new rows are cold; the
+            # mirror — the cold tier — grows through its own path).
+            self.hot.grow_logical(capacity)
+            self.capacity = capacity
+            return
+        from tigerbeetle_tpu.state_machine.hot_tier import grow_zero_device
+
+        # Dense growth stays on-device and async; sharded growth
+        # reshards through the host (shared growth-policy helper).
+        self.balances = grow_zero_device(
+            self.balances, capacity, self.sharding, self._place
+        )
+        self.capacity = capacity
+
+    def write_back(self, value) -> None:
+        """Replace the device table from a full LOGICAL table image.
+
+        Untiered this is a plain handle swap; tiered the hot rows are
+        gathered out of the logical image (the mirror stays the
+        authority for cold rows).
+        """
+        if self.hot is None:
+            self.balances = value
+            return
+        self._full_cache = None
+        occ = jnp.asarray(self.hot.logical_of)
+        rows = jnp.asarray(value)[jnp.where(occ >= 0, occ, 0)]
+        rows = jnp.where((occ >= 0)[:, None], rows, jnp.zeros_like(rows))
+        self.balances = self._place(rows)
+
+    def _tier_enqueue(self, slots, cols, add_lo, add_hi) -> None:
+        """Tiered write-behind: admit misses, queue only hot deltas.
+
+        The mirror LEADS in host mode (it was mutated before this
+        call), so cold rows need no device delta at all — the mirror
+        IS the cold tier — and rows admitted now are uploaded with
+        this batch's effects already folded in, so their queue entries
+        are dropped too.
+        """
+        import time as _time
+
+        hot = self.hot
+        self._full_cache = None
+        sl = np.asarray(slots, np.int64)
+        uniq, missing = hot.plan(sl)
+        admitted = np.zeros(0, np.int64)
+        if len(missing):
+            # Quiesce the lane BEFORE the map moves: queued deltas
+            # must flush under the map they were enqueued with, and a
+            # victim slot's pending deltas must land before reuse.
+            t0 = _time.perf_counter()
+            self.flush()
+            got = hot.admit(missing, protect=uniq, partial=True)
+            if got is not None:
+                admitted, hot_slots, _evicted = got
+                if len(admitted):
+                    # Bucket-padded upload: ONE compiled scatter shape
+                    # per power-of-two bucket, not one per admitted
+                    # count.  Padding uses DISTINCT out-of-range slots
+                    # (mode="drop") so unique_indices stays honest.
+                    from tigerbeetle_tpu.state_machine.commitment import (
+                        pad_slots,
+                    )
+
+                    padded = pad_slots(np.asarray(hot_slots, np.int64))
+                    k = len(hot_slots)
+                    idx = np.where(
+                        padded >= 0, padded,
+                        hot.hot_rows + np.arange(len(padded), dtype=np.int64),
+                    )
+                    rows = np.zeros((len(padded), 8), np.uint64)
+                    rows[:k] = self.mirror.rows8(admitted)
+                    self.balances = self.balances.at[jnp.asarray(idx)].set(
+                        jnp.asarray(rows), mode="drop", unique_indices=True
+                    )
+            hot.note_stall(_time.perf_counter() - t0)
+        hot.record_use(uniq, len(uniq) - len(missing), len(missing))
+        keep = hot.hot_of[sl] >= 0
+        if len(admitted):
+            keep &= ~np.isin(sl, admitted)
+        if not keep.any():
+            return
+        self._q.append(
+            (
+                sl[keep].astype(np.int32),
+                np.asarray(cols, np.int32)[keep],
+                np.asarray(add_lo, np.uint64)[keep],
+                np.asarray(add_hi, np.uint64)[keep],
             )
+        )
+        self._queued += int(keep.sum())
+        if self._queued >= FLUSH_THRESHOLD:
+            self.flush()
 
     def enqueue(self, slots, cols, add_lo, add_hi,
                 refresh_twin: bool = True) -> None:
@@ -148,6 +244,9 @@ class DeviceTable:
             self.mirror.commitment.refresh(
                 np.asarray(slots, np.int64), self.mirror
             )
+        if self.hot is not None:
+            self._tier_enqueue(slots, cols, add_lo, add_hi)
+            return
         self._q.append(
             (
                 np.asarray(slots, np.int32),
@@ -194,6 +293,15 @@ class DeviceTable:
             a_lo = np.concatenate([p[2] for p in parts])
             a_hi = np.concatenate([p[3] for p in parts])
         u_slot, u_col, d_lo, d_hi, _ = compact_deltas(slots, cols, a_lo, a_hi)
+        if self.hot is not None:
+            # Queue entries carry LOGICAL slots; the device table is
+            # hot-shaped.  All queued rows are hot at flush time (the
+            # map only moves against an empty queue), but translate
+            # defensively and drop any that fell cold.
+            h = self.hot.hot_of[u_slot]
+            keep = h >= 0
+            u_slot, u_col = h[keep], u_col[keep]
+            d_lo, d_hi = d_lo[keep], d_hi[keep]
 
         A = self.balances.shape[0]
         at = 0
@@ -216,6 +324,20 @@ class DeviceTable:
             at += take
 
     def read(self):
-        """Flush barrier + current device handle (still async)."""
+        """Flush barrier + current LOGICAL-table handle (still async).
+
+        Tiered, the device holds only the hot rows, so the logical
+        table is materialized from the cold tier (the mirror leads in
+        host mode) and cached against its mutation stamp — enqueue
+        invalidates the cache too, covering native in-place mutation
+        that bypasses the stamp.
+        """
         self.flush()
-        return self.balances
+        if self.hot is None:
+            return self.balances
+        key = self.mirror.version
+        if self._full_cache is None or self._full_cache[0] != key:
+            self._full_cache = (
+                key, jnp.asarray(self.mirror.table8(self.capacity))
+            )
+        return self._full_cache[1]

@@ -128,11 +128,10 @@ class BalanceMirror:
     def grow(self, capacity: int) -> None:
         if capacity <= len(self.lo):
             return
-        lo = np.zeros((capacity, 4), np.uint64)
-        hi = np.zeros((capacity, 4), np.uint64)
-        lo[: len(self.lo)] = self.lo
-        hi[: len(self.hi)] = self.hi
-        self.lo, self.hi = lo, hi
+        from tigerbeetle_tpu.state_machine.hot_tier import grow_zero_host
+
+        self.lo = grow_zero_host(self.lo, capacity)
+        self.hi = grow_zero_host(self.hi, capacity)
         self.version += 1
         # All-zero rows hash to 0: growth never moves the root (the
         # twin widens its per-row hash store lazily on next refresh).

@@ -456,6 +456,7 @@ class TpuStateMachine:
             )
 
             self._dev.spec_stats = make_spec_stats(self.metrics)
+            self._bind_tier_stats()
             # Off-hot-path warmup of the named kinds' transfer plans +
             # scan compiles (bench passes these per config;
             # construction happens during untimed setup).
@@ -473,6 +474,7 @@ class TpuStateMachine:
         else:
             self._dev = kernel_fast.DeviceTable(account_capacity)
             self._dev.mirror = self._mirror
+            self._bind_tier_stats()
         # Native C++ fast path (native/tb_fastpath.cpp): wire decode,
         # static ladder, account resolution, duplicate detection and
         # u128 overflow admission run natively; the balance mirror is
@@ -565,7 +567,9 @@ class TpuStateMachine:
 
     @_balances.setter
     def _balances(self, value) -> None:
-        self._dev.balances = value
+        # write_back gathers hot rows under tiering (plain handle swap
+        # all-resident) — never assign self._dev.balances directly.
+        self._dev.write_back(value)
 
     def sync(self) -> None:
         """Drain the write-behind queue and wait for the device."""
@@ -574,6 +578,19 @@ class TpuStateMachine:
     def _engine_drain(self) -> None:
         if self.engine == "device":
             self._dev.drain()
+
+    def _bind_tier_stats(self) -> None:
+        """Bind MACHINE-registry dev_tier.* handles to the hot tier
+        (both engine modes; no-op all-resident) — same contract as the
+        dev_wave.spec.* binding above."""
+        hot = getattr(self._dev, "hot", None)
+        if hot is None:
+            return
+        from tigerbeetle_tpu.state_machine.device_engine import (
+            make_tier_stats,
+        )
+
+        hot.stats = make_tier_stats(self.metrics)
 
     def _commit_meta_cols(self, slots: np.ndarray) -> np.ndarray:
         """(k, 2) uint32 account-meta columns (flags, ledger) for the
@@ -661,6 +678,16 @@ class TpuStateMachine:
                     dev._demote(exc)
                     return
                 twin = self._commitment.digest
+                # Tiered, the device digest is the HOT PARTIAL of the
+                # logical root: fold(hot, cold) == twin.digest by the
+                # r15 order-independent algebra, so comparing the
+                # partial attests the device AND (via twin ==
+                # host_scratch below) the whole logical table.
+                expected_dev = (
+                    self._commitment.partial(dev.hot.occupied())
+                    if dev.hot is not None
+                    else twin
+                )
                 # Checkpoint tripwire = the strongest compare: the
                 # device's maintained digest, its from-scratch
                 # recompute, the incrementally-maintained host twin,
@@ -682,7 +709,7 @@ class TpuStateMachine:
                 )
                 if (
                     (pair[0] == pair[1]).all()
-                    and (pair[1] == twin).all()
+                    and (pair[1] == expected_dev).all()
                     and (twin == host_scratch).all()
                 ):
                     return
@@ -700,15 +727,49 @@ class TpuStateMachine:
                     f"twin={twin.tolist()} "
                     f"host_scratch={host_scratch.tolist()}"
                 )
-            dev_sum = dev.checksum()  # drains + flushes internally
-            if dev.state is not types.EngineState.healthy:
-                return  # the checksum crossing itself demoted
-            host_sum = self._mirror.checksum8(dev.capacity)
+            if dev.hot is not None:
+                # Tiered without commitment: dev.checksum() answers
+                # from the mirror (trivially equal) — compare the
+                # hot-shaped device tables against the hot-shaped host
+                # images instead.
+                dev.drain()
+                dev.flush()
+                if dev.state is not types.EngineState.healthy:
+                    return
+                try:
+                    dev_sum = dev._device_health_digest()
+                except DeviceLostError as exc:
+                    dev._demote(exc)
+                    return
+                host_sum = dev._host_health_digest()
+            else:
+                dev_sum = dev.checksum()  # drains + flushes internally
+                if dev.state is not types.EngineState.healthy:
+                    return  # the checksum crossing itself demoted
+                host_sum = self._mirror.checksum8(dev.capacity)
         else:
             # Host-engine mode: _dev is a kernel_fast.DeviceTable.
-            table = dev.read()
-            dev_sum = np.asarray(dk.checksum(table))
-            host_sum = self._mirror.checksum8(int(table.shape[0]))
+            if dev.hot is not None:
+                # Tiered: read() serves the logical table FROM the
+                # mirror — compare the actual hot device table against
+                # the mirror's hot-shaped image instead.
+                from tigerbeetle_tpu.state_machine.hot_tier import (
+                    mirror_hot_table8,
+                )
+
+                from tigerbeetle_tpu.state_machine.mirror import (
+                    digest_columns,
+                )
+
+                dev.flush()
+                dev_sum = digest_columns(np.asarray(dev.balances))
+                host_sum = digest_columns(
+                    mirror_hot_table8(self._mirror, dev.hot.logical_of)
+                )
+            else:
+                table = dev.read()
+                dev_sum = np.asarray(dk.checksum(table))
+                host_sum = self._mirror.checksum8(int(table.shape[0]))
         if not (dev_sum == host_sum).all():
             raise AssertionError(
                 "device/mirror balance divergence at checkpoint: "
@@ -1406,15 +1467,21 @@ class TpuStateMachine:
             input_bytes=input_bytes,
         )
 
+        # Each submit path returns None when the batch cannot run on
+        # device — under tiering, a touched-account set the hot window
+        # cannot hold (tier_prefetch declined) — and the exact host
+        # path takes over.
         if not (has_linked or has_pv) and not touch_limit_hist:
-            return self._submit_device_orderfree(**common)
+            fut = self._submit_device_orderfree(**common)
+            return fut if fut is not None else host_path()
         if (
             has_linked
             and not (has_pending or has_pv)
             and not touch_hist
             and not amount_hi.any()
         ):
-            return self._submit_device_linked(**common)
+            fut = self._submit_device_linked(**common)
+            return fut if fut is not None else host_path()
         if has_pv and not has_linked and not timeout.any() and not touch_limit_hist:
             fut = self._submit_device_two_phase(**common)
             if fut is not None:
@@ -1515,6 +1582,12 @@ class TpuStateMachine:
         if dm == "0" or n == 0 or n > _BATCH_BUCKETS[-1]:
             return None, None
         if dev.state is not types.EngineState.healthy:
+            return None, None
+        if dev.hot is not None:
+            # v1 tiering scope cut: wave/speculative event dicts index
+            # the table by LOGICAL slot throughout (plan, executors,
+            # residue replay) — decline and take the host path.
+            self._dev_wave_decline("tier")
             return None, None
         sharded = dev.sharding is not None
         if sharded and dev.wave_mesh() is None:
@@ -1652,12 +1725,40 @@ class TpuStateMachine:
             id_keys=np.sort(probe), bound=plan.batch_bound,
         ), None
 
+    def _tier_translate(self, *slot_arrays):
+        """Batch planner front-door for the hot/cold tiering: compute
+        the batch's LOGICAL touched-account set up front, prefetch it
+        into the device hot window (DeviceEngine.tier_prefetch — rides
+        the write-behind lane for eviction), and return each input
+        array translated to HOT slots (negative entries pass through).
+        Returns None when the batch cannot run on device — the caller
+        takes the exact host path.  All-resident: identity."""
+        hot = getattr(self._dev, "hot", None)
+        if hot is None:
+            return slot_arrays
+        touched = np.concatenate(
+            [np.asarray(a, np.int64).ravel() for a in slot_arrays]
+        )
+        if not self._dev.tier_prefetch(touched):
+            self.metrics.counter("dev_tier.punt").inc()
+            return None
+        return tuple(
+            hot.translate(np.asarray(a, np.int64)) for a in slot_arrays
+        )
+
     def _submit_device_orderfree(
         self, events, n, ts_base, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
         flags, timeout, dr_slot, cr_slot, keys_sorted, timestamp, input_bytes,
     ):
         from tigerbeetle_tpu.state_machine import device_kernels as dk
 
+        # Tiered prefetch + translation happens BEFORE packing; the
+        # finish/bookkeeping closures keep the LOGICAL slots (the
+        # mirror and attrs are logical-indexed).
+        tr = self._tier_translate(dr_slot, cr_slot)
+        if tr is None:
+            return None
+        t_dr_slot, t_cr_slot = tr
         amount_lo = np.asarray(events["amount_lo"])
         amount_hi = np.asarray(events["amount_hi"])
         has_timeout = bool(timeout.any())
@@ -1679,12 +1780,12 @@ class TpuStateMachine:
                 ledger=np.asarray(events["ledger"]),
                 code=events["code"].astype(np.uint32),
                 ts_nonzero=np.asarray(events["timestamp"] != 0),
-                dr_slot=dr_slot, cr_slot=cr_slot,
+                dr_slot=t_dr_slot, cr_slot=t_cr_slot,
             )
         else:
             pk = self._device_pack_base(
                 n, events, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
-                flags, timeout, dr_slot, cr_slot,
+                flags, timeout, t_dr_slot, t_cr_slot,
             )
         if has_timeout:
             self._inflight_timeouts = True
@@ -1736,9 +1837,13 @@ class TpuStateMachine:
         self, events, n, ts_base, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
         flags, timeout, dr_slot, cr_slot, keys_sorted, timestamp, input_bytes,
     ):
+        tr = self._tier_translate(dr_slot, cr_slot)
+        if tr is None:
+            return None
+        t_dr_slot, t_cr_slot = tr
         pk = self._device_pack_base(
             n, events, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
-            flags, timeout, dr_slot, cr_slot,
+            flags, timeout, t_dr_slot, t_cr_slot,
         )
         amount_lo = np.asarray(events["amount_lo"])
         amount_hi = np.asarray(events["amount_hi"])
@@ -1873,16 +1978,32 @@ class TpuStateMachine:
             def jcol(name, dtype):
                 return np.zeros(n, dtype)
 
+        pj_dr_slot = jcol("dr_slot", np.int64)
+        pj_cr_slot = jcol("cr_slot", np.int64)
+        # Tiered prefetch over the batch's WHOLE touched set up front
+        # (event accounts + durable pending-target accounts — in-batch
+        # targets resolve to event slots already covered).  Only the
+        # packed device columns translate; ctx/finish keep LOGICAL
+        # slots.  Non-found pj entries keep their 0 default — the
+        # kernel reads them only under the p_found bit.
+        tr = self._tier_translate(
+            dr_slot, cr_slot,
+            np.where(p_found, pj_dr_slot, -1),
+            np.where(p_found, pj_cr_slot, -1),
+        )
+        if tr is None:
+            return None
+        t_dr_slot, t_cr_slot, t_pj_dr, t_pj_cr = tr
+        t_pj_dr = np.where(p_found, t_pj_dr, 0)
+        t_pj_cr = np.where(p_found, t_pj_cr, 0)
         pk = self._device_pack_base(
             n, events, id_lo, id_hi, dr_lo, dr_hi, cr_lo, cr_hi,
-            flags, timeout, dr_slot, cr_slot,
+            flags, timeout, t_dr_slot, t_cr_slot,
             p_found=p_found, p_tgt=p_tgt, n_cols=dk.N_COLS_TP,
         )
         # Target account-id equality predicates (host marshaling: u128
         # byte compares against in-batch events or durable attrs).
         tgt_c = np.clip(tgt_ev, 0, None)
-        pj_dr_slot = jcol("dr_slot", np.int64)
-        pj_cr_slot = jcol("cr_slot", np.int64)
         p_drs = np.where(ib, dr_slot[tgt_c], pj_dr_slot)
         p_crs = np.where(ib, cr_slot[tgt_c], pj_cr_slot)
         pd = np.clip(p_drs, 0, None)
@@ -1911,7 +2032,7 @@ class TpuStateMachine:
             p_flags=jcol("flags", np.uint32).astype(np.uint16),
             p_code=jcol("code", np.uint32).astype(np.uint16),
             p_ledger=jcol("ledger", np.uint32),
-            p_dr_slot=pj_dr_slot, p_cr_slot=pj_cr_slot,
+            p_dr_slot=t_pj_dr, p_cr_slot=t_pj_cr,
             p_amt_lo=p_amt_lo_d, p_amt_hi=p_amt_hi_d,
             tgt_ev=tgt_ev, dstat_init_ev=dstat_ev,
         )
@@ -4006,8 +4127,12 @@ def _tpu_restore(self, data: bytes) -> None:
         # Re-bind the machine-registry dev_wave.spec.* handles — the
         # counters are process-lifetime cumulative across restores.
         self._dev.spec_stats = make_spec_stats(self.metrics)
+        self._bind_tier_stats()
         try:
             if self._dev.state is types.EngineState.healthy:
+                # Tiered, this uploads the hot-shaped image for the
+                # FRESH engine's (empty) hot map — admissions refill
+                # the window on demand from the restored mirror.
                 self._dev._upload_from_mirror()
         except DeviceLostError as exc:
             # Restore must not die with the link: the mirror restored
@@ -4022,9 +4147,15 @@ def _tpu_restore(self, data: bytes) -> None:
     else:
         self._dev = kernel_fast.DeviceTable(cap)
         self._dev.mirror = self._mirror
-        self._dev.balances = self._dev._place(
-            jnp.asarray(self._mirror.rows8(np.arange(cap, dtype=np.int64)))
+        self._bind_tier_stats()
+        # write_back gathers hot rows under tiering (identity swap
+        # all-resident; _place only applies to device-resident tables).
+        full = jnp.asarray(
+            self._mirror.rows8(np.arange(cap, dtype=np.int64))
         )
+        if self._dev.hot is None:
+            full = self._dev._place(full)
+        self._dev.write_back(full)
     self._inflight_timeouts = False
     self._expiry_rows = None
 
