@@ -775,15 +775,19 @@ def run_replicated(n_events: int) -> dict:
     repeats = max(1, int(os.environ.get("BENCH_REPL_REPEATS", 1)))
     befores, afters = [], []
     for _ in range(repeats):
-        # Round 20: the graded before/after axis is the native commit
-        # pipeline (TB_NATIVE_PIPELINE=0/1); the columnar ingest fast
-        # path (r14) is on in BOTH arms, so the delta isolates the
-        # per-prepare native hot loop.
+        # Round 22: the graded before/after axis is the C-resident
+        # drain loop (TB_NATIVE_DRAIN=0/1); the columnar ingest fast
+        # path (r14) AND the native commit pipeline (r20) are on in
+        # BOTH arms, so the delta isolates batching the whole
+        # prepare→ack→commit-decision drain into one Python→C call
+        # vs N per-prepare calls over the same C kernels.
         befores.append(_run_replicated_once(
-            n_events, fastpath=True, native_pipeline=False
+            n_events, fastpath=True, native_pipeline=True,
+            native_drain=False,
         ))
         afters.append(_run_replicated_once(
-            n_events, fastpath=True, native_pipeline=True
+            n_events, fastpath=True, native_pipeline=True,
+            native_drain=True,
         ))
 
     def median_run(runs):
@@ -801,10 +805,12 @@ def run_replicated(n_events: int) -> dict:
             "events_per_sec", "request_p50_ms", "request_p99_ms",
             "request_p100_ms", "fsyncs_total", "prepares_total",
             "fsyncs_per_prepare", "fastpath_decode", "native_pipeline",
+            "native_drain",
             "decode_us_per_event_p50", "decode_us_per_event_p99",
             "reply_encode_us_p50", "fastpath_batch_decode_hits",
             "prepare_us_p50", "prepare_us_p99",
             "prepare_ok_us_p50", "prepare_ok_us_p99",
+            "drain_native_calls", "drain_py_fallbacks",
             "error",
         )
         if k in before
@@ -820,7 +826,8 @@ def run_replicated(n_events: int) -> dict:
 
 def _run_replicated_once(n_events: int, group_commit: bool = True,
                          fastpath: bool = True,
-                         native_pipeline: bool = True) -> dict:
+                         native_pipeline: bool = True,
+                         native_drain: bool = True) -> dict:
     """3-replica TCP cluster, real ReplicaServer processes, driven by
     CONCURRENT client sessions (VERDICT r4 #1b): each VSR session keeps
     one request in flight (request numbers are strictly increasing,
@@ -900,6 +907,10 @@ def _run_replicated_once(n_events: int, group_commit: bool = True,
         # Native commit pipeline arm selector (round 20): 0 pins the
         # pure-Python per-prepare path for the "before" run.
         server_env["TB_NATIVE_PIPELINE"] = "1" if native_pipeline else "0"
+        # C-resident drain arm selector (round 22): 0 pins the
+        # per-item Python loop over the same batch seams, so the
+        # differential isolates the one-call-per-drain batching.
+        server_env["TB_NATIVE_DRAIN"] = "1" if native_drain else "0"
         # Core pinning rides the environment into each replica's
         # runner (applied below via affinity.apply in-process); the
         # per-subprocess plan is recorded so regrades self-describe.
@@ -1055,6 +1066,7 @@ def _run_replicated_once(n_events: int, group_commit: bool = True,
             "group_commit": group_commit,
             "fastpath_decode": fastpath,
             "native_pipeline": native_pipeline,
+            "native_drain": native_drain,
             "pinned_cores": pinned_cores,
             "per_replica_stats": per_replica_stats,
             **scrape_extra,
@@ -1203,6 +1215,17 @@ def _harvest_replica_stats(
                 extra["prepare_ok_us_p99"] = snap.get(
                     "vsr.prepare_ok_us.p99", 0.0
                 )
+            # C-resident drain loop counters (round 22), summed across
+            # replicas: native_calls counts whole drains retired in one
+            # Python→C call, py_fallbacks counts per-item retreats to
+            # the Python loop.  The drained arm is graded on
+            # native_calls > 0 with py_fallbacks staying ~0.
+            extra["drain_native_calls"] = extra.get(
+                "drain_native_calls", 0
+            ) + int(snap.get("vsr.drain.native_calls", 0))
+            extra["drain_py_fallbacks"] = extra.get(
+                "drain_py_fallbacks", 0
+            ) + int(snap.get("vsr.drain.py_fallbacks", 0))
         else:
             stats = _parse_tb_stats(lp)
             sources[name] = "log_tail" if stats is not None else "missing"

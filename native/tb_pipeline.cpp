@@ -81,6 +81,28 @@ void pl_finalize(uint8_t* h, const uint8_t* body, uint64_t body_len) {
     memcpy(h + OFF_CHECKSUM, cs, 16);
 }
 
+// Journal append framing body, shared by the per-prepare entry point
+// (tb_pl_frame_prepare, r20) and the per-drain batch calls (r22):
+// out_prepare := header || body zero-padded to a sector multiple
+// (returned); headers_ring[slot] := header (in-memory redundant ring,
+// written in place); out_sector := the slot's redundant-header sector.
+uint64_t pl_frame(const uint8_t* hdr, const uint8_t* body, uint64_t body_len,
+                  uint8_t* headers_ring, uint64_t slot,
+                  uint32_t headers_per_sector, uint32_t sector_size,
+                  uint8_t* out_prepare, uint8_t* out_sector) {
+    uint64_t msg = PL_HEADER_SIZE + body_len;
+    uint64_t padded = (msg + sector_size - 1) / sector_size * sector_size;
+    memcpy(out_prepare, hdr, PL_HEADER_SIZE);
+    if (body_len) memcpy(out_prepare + PL_HEADER_SIZE, body, body_len);
+    memset(out_prepare + msg, 0, padded - msg);
+    memcpy(headers_ring + slot * PL_HEADER_SIZE, hdr, PL_HEADER_SIZE);
+    uint64_t first = slot / headers_per_sector * headers_per_sector;
+    uint64_t used = (uint64_t)headers_per_sector * PL_HEADER_SIZE;
+    memcpy(out_sector, headers_ring + first * PL_HEADER_SIZE, used);
+    memset(out_sector + used, 0, sector_size - used);
+    return padded;
+}
+
 // The primary's in-flight slot table.  Pipelines are shallow
 // (pipeline_prepare_queue_max, single digits), so a linear-scan
 // vector beats any hashing; entries are appended in op order and
@@ -110,7 +132,7 @@ extern "C" {
 // Bumped whenever any tb_pl_* signature or semantic changes; the
 // Python binding refuses to use a library reporting a different
 // version (stale prebuilt .so whose rebuild failed).
-uint32_t tb_pl_abi_version(void) { return 1; }
+uint32_t tb_pl_abi_version(void) { return 2; }
 
 Pipeline* tb_pl_create(void) { return new Pipeline(); }
 
@@ -185,17 +207,8 @@ uint64_t tb_pl_frame_prepare(
     const uint8_t* hdr, const uint8_t* body, uint64_t body_len,
     uint8_t* headers_ring, uint64_t slot, uint32_t headers_per_sector,
     uint32_t sector_size, uint8_t* out_prepare, uint8_t* out_sector) {
-    uint64_t msg = PL_HEADER_SIZE + body_len;
-    uint64_t padded = (msg + sector_size - 1) / sector_size * sector_size;
-    memcpy(out_prepare, hdr, PL_HEADER_SIZE);
-    if (body_len) memcpy(out_prepare + PL_HEADER_SIZE, body, body_len);
-    memset(out_prepare + msg, 0, padded - msg);
-    memcpy(headers_ring + slot * PL_HEADER_SIZE, hdr, PL_HEADER_SIZE);
-    uint64_t first = slot / headers_per_sector * headers_per_sector;
-    uint64_t used = (uint64_t)headers_per_sector * PL_HEADER_SIZE;
-    memcpy(out_sector, headers_ring + first * PL_HEADER_SIZE, used);
-    memset(out_sector + used, 0, sector_size - used);
-    return padded;
+    return pl_frame(hdr, body, body_len, headers_ring, slot,
+                    headers_per_sector, sector_size, out_prepare, out_sector);
 }
 
 // Register an in-flight prepare (op + canonical checksum from its
@@ -263,6 +276,152 @@ int tb_pl_commit_ready(Pipeline* pl, uint64_t commit_min,
 uint32_t tb_pl_votes(Pipeline* pl, uint64_t op) {
     PlEntry* e = pl_find(pl, op);
     return e == nullptr ? 0 : (uint32_t)__builtin_popcountll(e->votes);
+}
+
+// ---- r22: the C-resident drain loop (one call per drain batch) ----
+//
+// The per-item calls above stay for K=1 callers and the differential
+// arm; the three batch entry points below run a whole drain's worth
+// of builds/framings/votes in one Python->C crossing each.  Every
+// byte they produce must match a loop over the per-item calls — the
+// TB_NATIVE_DRAIN=0/1 differential suite holds them to it.
+
+// Primary drain: build + finalize K prepares chained
+// parent->checksum (parent_lo/hi seeds op0's parent), register each
+// in the slot table with the self-vote, and frame each for the
+// journal into caller arenas:
+//   wal_arena[out_wal_off[i] .. +out_wal_len[i]]  — sector-padded
+//     prepare for slot out_slot[i] (= (op0+i) % slot_count);
+//   sector_arena[i*sector_size ..]                — redundant-header
+//     sector out_sector_index[i].
+// Capacity is checked up front: on overflow returns -1 with NOTHING
+// mutated (the caller falls back to the per-item path).  Returns k.
+int64_t tb_pl_build_prepares(
+    Pipeline* pl, const uint8_t* req_hdrs, const uint8_t* const* bodies,
+    const uint64_t* body_lens, const uint64_t* timestamps,
+    const uint64_t* contexts, uint64_t k, uint64_t cluster_lo,
+    uint64_t cluster_hi, uint32_t view, uint64_t op0, uint64_t commit,
+    uint64_t parent_lo, uint64_t parent_hi, uint32_t replica,
+    uint32_t release, int synced, uint8_t* out_hdrs, uint8_t* headers_ring,
+    uint64_t slot_count, uint32_t headers_per_sector, uint32_t sector_size,
+    uint8_t* wal_arena, uint64_t wal_cap, uint64_t* out_wal_off,
+    uint64_t* out_wal_len, uint64_t* out_slot, uint8_t* sector_arena,
+    uint64_t* out_sector_index) {
+    uint64_t need = 0;
+    for (uint64_t i = 0; i < k; i++) {
+        uint64_t msg = PL_HEADER_SIZE + body_lens[i];
+        need += (msg + sector_size - 1) / sector_size * sector_size;
+    }
+    if (need > wal_cap) return -1;
+    uint64_t wal_at = 0;
+    uint64_t plo = parent_lo;
+    uint64_t phi = parent_hi;
+    for (uint64_t i = 0; i < k; i++) {
+        uint8_t* out = out_hdrs + i * PL_HEADER_SIZE;
+        tb_pl_build_prepare(req_hdrs + i * PL_HEADER_SIZE, bodies[i],
+                            body_lens[i], cluster_lo, cluster_hi, view,
+                            op0 + i, commit, timestamps[i], plo, phi,
+                            replica, contexts[i], release, out);
+        plo = pl_rd64(out + OFF_CHECKSUM);
+        phi = pl_rd64(out + OFF_CHECKSUM + 8);
+        tb_pl_note_prepare(pl, out, synced, replica);
+        uint64_t slot = (op0 + i) % slot_count;
+        uint64_t padded =
+            pl_frame(out, bodies[i], body_lens[i], headers_ring, slot,
+                     headers_per_sector, sector_size, wal_arena + wal_at,
+                     sector_arena + i * sector_size);
+        out_wal_off[i] = wal_at;
+        out_wal_len[i] = padded;
+        out_slot[i] = slot;
+        out_sector_index[i] = slot / headers_per_sector;
+        wal_at += padded;
+    }
+    return (int64_t)k;
+}
+
+// Backup drain: frame K accepted prepares for the journal (same
+// descriptor layout as tb_pl_build_prepares) and, unless the caller
+// is a standby (build_oks=0), build the K prepare_ok headers in one
+// pass.  No slot-table involvement — backups hold no vote state.
+// Returns k, or -1 on arena overflow with nothing mutated.
+int64_t tb_pl_accept_prepares(
+    const uint8_t* hdrs, const uint8_t* const* bodies,
+    const uint64_t* body_lens, uint64_t k, uint32_t view, uint32_t replica,
+    int build_oks, uint8_t* out_oks, uint8_t* headers_ring,
+    uint64_t slot_count, uint32_t headers_per_sector, uint32_t sector_size,
+    uint8_t* wal_arena, uint64_t wal_cap, uint64_t* out_wal_off,
+    uint64_t* out_wal_len, uint64_t* out_slot, uint8_t* sector_arena,
+    uint64_t* out_sector_index) {
+    uint64_t need = 0;
+    for (uint64_t i = 0; i < k; i++) {
+        uint64_t msg = PL_HEADER_SIZE + body_lens[i];
+        need += (msg + sector_size - 1) / sector_size * sector_size;
+    }
+    if (need > wal_cap) return -1;
+    uint64_t wal_at = 0;
+    for (uint64_t i = 0; i < k; i++) {
+        const uint8_t* h = hdrs + i * PL_HEADER_SIZE;
+        uint64_t slot = pl_rd64(h + OFF_OP) % slot_count;
+        uint64_t padded =
+            pl_frame(h, bodies[i], body_lens[i], headers_ring, slot,
+                     headers_per_sector, sector_size, wal_arena + wal_at,
+                     sector_arena + i * sector_size);
+        out_wal_off[i] = wal_at;
+        out_wal_len[i] = padded;
+        out_slot[i] = slot;
+        out_sector_index[i] = slot / headers_per_sector;
+        wal_at += padded;
+        if (build_oks) {
+            tb_pl_build_prepare_ok(h, view, replica,
+                                   out_oks + i * PL_HEADER_SIZE);
+        }
+    }
+    return (int64_t)k;
+}
+
+// Vote a whole run of prepare_ok headers in one call.  Per-ack
+// verdict in out_votes[i]: -4 foreign cluster, -3 stale/future view,
+// -1 unknown op, -2 stale-sibling checksum (tb_pl_on_ack's codes),
+// else the entry's vote count after this ack.  Returns the number of
+// acks that landed a vote.
+int64_t tb_pl_on_acks(Pipeline* pl, const uint8_t* ok_hdrs, uint64_t k,
+                      uint64_t cluster_lo, uint64_t cluster_hi,
+                      uint32_t view, int64_t* out_votes) {
+    int64_t accepted = 0;
+    for (uint64_t i = 0; i < k; i++) {
+        const uint8_t* h = ok_hdrs + i * PL_HEADER_SIZE;
+        if (pl_rd64(h + OFF_CLUSTER) != cluster_lo ||
+            pl_rd64(h + OFF_CLUSTER + 8) != cluster_hi) {
+            out_votes[i] = -4;
+            continue;
+        }
+        uint32_t hv;
+        memcpy(&hv, h + OFF_VIEW, 4);
+        if (hv != view) {
+            out_votes[i] = -3;
+            continue;
+        }
+        int r = tb_pl_on_ack(pl, h);
+        out_votes[i] = r;
+        if (r >= 0) accepted++;
+    }
+    return accepted;
+}
+
+// The contiguous run of commit-ready ops: the largest n such that
+// every op in (commit_min, commit_min + n] is in-flight, synced, and
+// holds a replication quorum — tb_pl_commit_ready extended from one
+// gate decision to the whole drain's worth.
+uint64_t tb_pl_commit_ready_run(Pipeline* pl, uint64_t commit_min,
+                                uint32_t quorum) {
+    uint64_t n = 0;
+    for (;;) {
+        PlEntry* e = pl_find(pl, commit_min + 1 + n);
+        if (e == nullptr || !e->synced) break;
+        if (__builtin_popcountll(e->votes) < (int)quorum) break;
+        n++;
+    }
+    return n;
 }
 
 }  // extern "C"

@@ -210,6 +210,22 @@ int tb_bus_send2(tb_bus* bus, int conn, const uint8_t* head,
     return 0;
 }
 
+// Vector send (r22 drain loop): queue k complete frames for one
+// connection in a single crossing — the backup's per-drain prepare_ok
+// run and any other same-destination frame burst.  Each frame is
+// appended as its own queued message; one epoll (re)arm at the end.
+int tb_bus_sendv(tb_bus* bus, int conn, const uint8_t* const* bufs,
+                 const uint32_t* lens, uint32_t k) {
+    auto it = bus->conns.find(conn);
+    if (it == bus->conns.end()) return -1;
+    Connection& c = it->second;
+    for (uint32_t i = 0; i < k; i++) {
+        c.send_queue.emplace_back(bufs[i], bufs[i] + lens[i]);
+    }
+    bus_arm(bus, c);
+    return 0;
+}
+
 static void bus_close_conn(tb_bus* bus, int id) {
     auto it = bus->conns.find(id);
     if (it == bus->conns.end()) return;

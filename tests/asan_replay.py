@@ -13,8 +13,13 @@ oracle over the checked-in frames and their corrupt mutations, batch
 reply finalize parity, seeded random tearing of the fixture stream
 through the native bus framing, the round-20 pipeline entry points
 (fuzzed prepare/ack sequences incl. torn WAL framing, oversize ops,
-and out-of-order prepare_oks), and oversize size-field frames that
-must drop the connection without touching out-of-bounds memory.
+and out-of-order prepare_oks), the round-22 batch drain entry points
+(multi-frame drains with chained parents and packed WAL arenas,
+shuffled ack runs laced with duplicates / stale siblings / foreign
+clusters / wrong views / unknown ops, commit-ready runs,
+message_size_max bodies, and the scatter-gather sendv path torn
+across socket reads), and oversize size-field frames that must drop
+the connection without touching out-of-bounds memory.
 Exits 0 with the final OK marker only if every differential holds;
 address/UB findings abort the process with a sanitizer report the
 caller parses.
@@ -264,6 +269,209 @@ def check_pipeline_fuzz(seed: int = 2020, rounds: int = 60) -> None:
     print(f"asan-replay: pipeline fuzz ok ({rounds} rounds)")
 
 
+def check_drain_fuzz(seed: int = 2222, rounds: int = 40) -> None:
+    """Round-22 batch drain entry points under the sanitizer: whole
+    multi-frame drains through tb_pl_build_prepares (chained parents,
+    WAL arena packing, slot re-frames torn across rounds) and
+    tb_pl_accept_prepares (backup framing + prepare_ok builds), acks
+    voted through tb_pl_on_acks in shuffled runs laced with
+    duplicates, stale siblings, foreign clusters, wrong views and
+    unknown ops, and the commit gate answered by
+    tb_pl_commit_ready_run — every byte and verdict differential
+    against the r20 scalar entry points (themselves oracle-checked
+    above), including message_size_max bodies."""
+    from tigerbeetle_tpu.vsr.journal import HEADERS_PER_SECTOR
+    from tigerbeetle_tpu.vsr.storage import _sectors
+
+    assert fastpath.drain_available(), (
+        f"sanitized fastpath lacks drain symbols: {fastpath.drain_error()}"
+    )
+    sector_size = 4096
+    slot_count = 32
+    max_body = 1 << 20
+    rng = np.random.default_rng(seed)
+    pl_c = fastpath.create_pipeline()
+    pl_py = fastpath.create_pipeline()
+    backup = fastpath.create_pipeline()
+    ring_primary = np.zeros(slot_count, wire.HEADER_DTYPE)
+    ring_oracle = np.zeros(slot_count, wire.HEADER_DTYPE)
+    ring_backup = np.zeros(slot_count, wire.HEADER_DTYPE)
+    cluster = 7_000_000_000_000_000_001
+    view = 9
+    op_next = 1
+    for i in range(rounds):
+        k = int(rng.integers(1, 7))
+        bodies = []
+        reqs = np.zeros(k, wire.HEADER_DTYPE)
+        for j in range(k):
+            body_len = (
+                max_body if (i % 6 == 5 and j == 0)
+                else int(rng.integers(0, 4096))
+            )
+            body = rng.bytes(body_len)
+            req = wire.make_header(
+                command=wire.Command.request,
+                operation=int(rng.integers(0, 200)),
+                cluster=cluster, client=_r128(rng) or 1,
+                request=int(rng.integers(0, 1 << 32)),
+                timestamp=_r64(rng) >> 1,
+                trace_id=_r64(rng), trace_ts=_r64(rng),
+                trace_flags=int(rng.integers(0, 2)),
+            )
+            wire.finalize_header(req, body)
+            reqs[j] = req
+            bodies.append(body)
+        op0 = op_next
+        op_next += k
+        timestamps = rng.integers(1, 1 << 62, k, dtype=np.uint64)
+        contexts = rng.integers(0, 64, k, dtype=np.uint64)
+        parent = _r128(rng) >> 1
+        kw = dict(
+            cluster=cluster, view=view, commit=op0 - 1, replica=0,
+            release=1,
+        )
+        built = fastpath.build_prepares(
+            pl_c, reqs, bodies, timestamps, contexts, op0=op0,
+            parent=parent, synced=bool(rng.integers(0, 2)),
+            headers_ring=ring_primary, slot_count=slot_count,
+            headers_per_sector=HEADERS_PER_SECTOR,
+            sector_size=sector_size, **kw,
+        )
+        assert built is not None, "exact-sized drain refused"
+        prepares, (wal, wal_off, wal_len, slots, sectors, sec_idx) = built
+        # Oracle: the scalar builder, chained by hand, framed by hand.
+        chain = parent
+        expect_off = 0
+        for j in range(k):
+            oracle = pl_py.build_prepare(
+                reqs[j], bodies[j], op=op0 + j,
+                timestamp=int(timestamps[j]), parent=chain,
+                context=int(contexts[j]), **kw,
+            )
+            chain = wire.u128(oracle, "checksum")
+            assert prepares[j].tobytes() == oracle.tobytes(), (
+                "drain prepare differential"
+            )
+            msg = oracle.tobytes() + bodies[j]
+            padded = msg.ljust(_sectors(len(msg)), b"\x00")
+            assert int(wal_off[j]) == expect_off
+            assert int(wal_len[j]) == len(padded)
+            assert wal[
+                expect_off : expect_off + len(padded)
+            ].tobytes() == padded, "drain WAL arena differential"
+            expect_off += len(padded)
+            ring_oracle[(op0 + j) % slot_count] = oracle
+        # Backup arm: accept the same run, oks vs the scalar builder.
+        accepted = fastpath.accept_prepares(
+            prepares, bodies, view=view, replica=2, build_oks=True,
+            headers_ring=ring_backup, slot_count=slot_count,
+            headers_per_sector=HEADERS_PER_SECTOR,
+            sector_size=sector_size,
+        )
+        assert accepted is not None
+        oks, _frames_b = accepted
+        for j in range(k):
+            oracle_ok = pl_py.build_prepare_ok(prepares[j], view, 2)
+            assert oks[j].tobytes() == oracle_ok.tobytes(), (
+                "drain prepare_ok differential"
+            )
+        # Ack runs: shuffled voters + poisoned frames, one C call.
+        acks = []
+        for j in rng.permutation(k):
+            for rep in rng.permutation(3):
+                ok = pl_py.build_prepare_ok(prepares[j], view, int(rep) + 1)
+                acks.append(ok)
+                if rng.integers(0, 4) == 0:
+                    acks.append(ok)  # duplicate
+        poison = pl_py.build_prepare_ok(prepares[0], view, 1)
+        poison["op"] = op0 + (1 << 40)  # unknown op
+        wire.finalize_header(poison, b"")
+        acks.append(poison)
+        stale = wire.make_header(
+            command=wire.Command.prepare_ok, cluster=cluster, view=view,
+            op=op0, replica=1, context=123456789,
+        )
+        wire.finalize_header(stale, b"")
+        acks.append(stale)
+        foreign = pl_py.build_prepare_ok(prepares[0], view, 1)
+        foreign["cluster_lo"] = 42
+        wire.finalize_header(foreign, b"")
+        acks.append(foreign)
+        wrong_view = pl_py.build_prepare_ok(prepares[0], view + 7, 1)
+        acks.append(wrong_view)
+        order = rng.permutation(len(acks))
+        run = np.array([acks[x] for x in order])
+        mirror = fastpath.create_pipeline()
+        for j in range(k):  # same registration build_prepares made
+            mirror.note_prepare(prepares[j], True, 0)
+        _n, verdicts = pl_c.on_acks(run, cluster, view)
+        for x, v in zip(order, (int(t) for t in verdicts)):
+            h = acks[x]
+            if wire.u128(h, "cluster") != cluster:
+                assert v == -4, "foreign cluster verdict"
+                continue
+            if int(h["view"]) != view:
+                assert v == -3, "view verdict"
+                continue
+            got = mirror.on_ack(h)
+            assert got == (None if v < 0 else v), "drain ack differential"
+        # Commit gate: the run answer vs the scalar walk.
+        pl_c.mark_all_synced()
+        ready = pl_c.commit_ready_run(op0 - 1, 2)
+        walk = 0
+        while pl_c.commit_ready(op0 - 1 + walk, 2):
+            walk += 1
+        assert ready == walk, "ready-run differential"
+        for j in range(k):
+            pl_c.drop(op0 + j)
+        assert pl_c.size() == 0
+    assert ring_primary.tobytes() == ring_oracle.tobytes(), (
+        "drain ring differential"
+    )
+    print(f"asan-replay: drain fuzz ok ({rounds} rounds)")
+
+
+def check_sendv_torn(seed: int = 777) -> None:
+    """tb_bus_sendv (the drain's scatter-gather send list) under the
+    sanitizer: multi-frame vectors — including a message_size_max body
+    — must arrive byte-identical over a real socket, with the receiver
+    reading across arbitrary boundaries."""
+    rng = np.random.default_rng(seed)
+    frames = list(fixture_frames())
+    big_body = rng.bytes(1 << 20)
+    h = wire.make_header(command=wire.Command.prepare, cluster=1, op=1)
+    wire.finalize_header(h, big_body)
+    frames.append(h.tobytes() + big_body)
+    bus = NativeBus(1 << 20)
+    port = bus.listen("127.0.0.1", 0)
+    sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+    # Handshake: one inbound frame surfaces the conn id to sendv on.
+    sock.sendall(frames[0])
+    conn = None
+    deadline = time.time() + 30
+    while conn is None and time.time() < deadline:
+        r = bus.poll_drain(10)
+        assert r is not None
+        n, types, conns, _offs, _lens, _arena = r
+        for i in range(n):
+            if types[i] == EV_MESSAGE:
+                conn = int(conns[i])
+    assert conn is not None, "handshake frame never surfaced"
+    bus.sendv(conn, frames)
+    want = b"".join(frames)
+    got = bytearray()
+    sock.settimeout(30)
+    while len(got) < len(want):
+        bus.poll(0)  # keep the writer side pumping
+        chunk = sock.recv(min(1 << 16, len(want) - len(got)))
+        assert chunk, "socket closed mid-vector"
+        got.extend(chunk)
+    assert bytes(got) == want, "sendv byte differential"
+    sock.close()
+    bus.close()
+    print(f"asan-replay: sendv fuzz ok ({len(frames)} frames)")
+
+
 def check_oversize_frames() -> None:
     """Size fields past the frame bound (message_size_max bodies +
     the 256-byte header) must drop the connection — never index the
@@ -297,6 +505,8 @@ def main() -> int:
     check_finalize_parity()
     check_torn_frames()
     check_pipeline_fuzz()
+    check_drain_fuzz()
+    check_sendv_torn()
     check_oversize_frames()
     print("ASAN-REPLAY-OK")
     return 0

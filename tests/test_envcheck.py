@@ -718,6 +718,45 @@ def test_tb_native_pipeline_validated(monkeypatch):
     assert envcheck.native_pipeline() == 1  # default on
 
 
+def test_tb_native_drain_validated(monkeypatch):
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "batch")
+    with pytest.raises(envcheck.EnvVarError, match="TB_NATIVE_DRAIN"):
+        envcheck.native_drain()
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "2")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 1"):
+        envcheck.native_drain()
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.native_drain()
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "0")
+    assert envcheck.native_drain() == 0
+    monkeypatch.delenv("TB_NATIVE_DRAIN")
+    assert envcheck.native_drain() == 1  # default on
+
+
+def test_tb_native_drain_explicit_on_fails_fast_on_stale_so(monkeypatch):
+    """TB_NATIVE_DRAIN=1 set EXPLICITLY against a loaded-but-stale
+    library is a hard RuntimeError naming the rebuild (`make -C
+    native`) at replica construction — the r20 stale-.so forensics
+    extended to the r22 batch symbols.  (The defaulted knob degrades
+    to the per-item arm; tests/test_native_drain.py covers that.)"""
+    from tigerbeetle_tpu.runtime import fastpath
+
+    class _Stale:
+        tb_pl_abi_version = None
+
+    monkeypatch.setattr(fastpath, "_load", lambda: _Stale())
+    monkeypatch.setattr(fastpath, "_pipeline_warned", True)
+    monkeypatch.delenv("TB_NATIVE_PIPELINE", raising=False)
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "1")
+    err = fastpath.drain_error()
+    assert err is not None and "make -C native" in err
+    from tigerbeetle_tpu.testing.cluster import Cluster
+
+    with pytest.raises(RuntimeError, match="make -C native"):
+        Cluster(3, seed=1)
+
+
 def test_tb_cpu_affinity_validated(monkeypatch):
     monkeypatch.delenv("TB_CPU_AFFINITY", raising=False)
     assert envcheck.cpu_affinity() == "none"  # default: no pinning

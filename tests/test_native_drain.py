@@ -1,0 +1,831 @@
+"""C-resident drain loop (round 22, native/tb_pipeline.cpp batch
+entry points): the differential contract TB_NATIVE_DRAIN=0/1 one layer
+above the r20 per-prepare pipeline.
+
+Four tiers of evidence, mirroring how the seam can break:
+
+- Unit differential: tb_pl_build_prepares / tb_pl_accept_prepares /
+  tb_pl_on_acks / tb_pl_commit_ready_run fuzzed against the r20
+  scalar entry points (themselves fuzzed against wire.py) byte for
+  byte — chained parents, journal framing, vote verdicts, ready runs.
+- Cluster differential: the sim cluster's per-message delivery never
+  reaches the batch seams (runtime/server.py's drain does), so a
+  BatchCluster pump regroups each tick's due packets into contiguous
+  same-command runs and feeds them through on_prepares_batch /
+  on_prepare_oks_batch / on_requests_batch — exactly the server's
+  _dispatch_drain shape — then the SAME deterministic script runs
+  with TB_NATIVE_DRAIN on and off and every consensus + reply frame
+  must be bit-identical.
+- Chaos: the r10 group-commit contract (no ack before its covering
+  sync — instrumented to see write_prepare_framed, the drain's WAL
+  entry point) and crash-at-fsync failover fuzz re-run on the drain
+  arm with batched delivery and hash-log convergence.
+- Regressions: retransmit-of-committed mid-drain gets its stored
+  reply (never a busy) while fresh traffic sheds around it, and a
+  stale .so fails fast on explicit TB_NATIVE_DRAIN=1.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import SECTOR_SIZE
+from tigerbeetle_tpu.runtime import fastpath
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.harness import account, ids_bytes, pack, transfer
+from tigerbeetle_tpu.vsr import storage as storage_mod
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.journal import HEADERS_PER_SECTOR
+from tigerbeetle_tpu.vsr.storage import FsyncCrash, _sectors
+from tigerbeetle_tpu.vsr.wire import Command, HEADER_DTYPE
+
+from test_multi import _register, _setup_accounts  # noqa: F401
+from test_native_pipeline import (  # noqa: F401
+    _StaleLib,
+    _assert_mirror,
+    _capture_frames,
+    _fuzz_request,
+    _r64,
+    _r128,
+)
+
+pytestmark = pytest.mark.skipif(
+    not fastpath.drain_available(),
+    reason="libtb_fastpath with r22 drain symbols not built",
+)
+
+
+# ----------------------------------------------------------------------
+# Unit differential: the batch C entry points vs the r20 scalar ones.
+
+
+def _fuzz_requests(rng, k):
+    pairs = [_fuzz_request(rng) for _ in range(k)]
+    req_hdrs = np.array([h for h, _ in pairs])
+    bodies = [b for _, b in pairs]
+    return req_hdrs, bodies
+
+
+def test_build_prepares_bit_identical_fuzz():
+    """One tb_pl_build_prepares call == K chained tb_pl_build_prepare
+    calls + K Python journal framings: headers, parent chain, slot
+    table registration, WAL arena bytes, redundant header sectors."""
+    rng = np.random.default_rng(22_01)
+    slot_count = 64
+    assert slot_count % HEADERS_PER_SECTOR == 0
+    for _ in range(40):
+        k = int(rng.integers(1, 9))
+        req_hdrs, bodies = _fuzz_requests(rng, k)
+        timestamps = rng.integers(1, 1 << 62, k, dtype=np.uint64)
+        contexts = rng.integers(0, 64, k, dtype=np.uint64)
+        kw = dict(
+            cluster=_r128(rng) >> 1,
+            view=int(rng.integers(0, 1 << 30)),
+            op0=int(rng.integers(1, 1 << 32)),
+            commit=int(rng.integers(0, 1 << 32)),
+            parent=_r128(rng) >> 1,
+            replica=int(rng.integers(0, 6)),
+            release=int(rng.integers(0, 1 << 31)),
+        )
+        ring_c = np.zeros(slot_count, HEADER_DTYPE)
+        ring_py = np.zeros(slot_count, HEADER_DTYPE)
+        pl_c = fastpath.create_pipeline()
+        pl_py = fastpath.create_pipeline()
+        built = fastpath.build_prepares(
+            pl_c, req_hdrs, bodies, timestamps, contexts,
+            synced=False, headers_ring=ring_c, slot_count=slot_count,
+            headers_per_sector=HEADERS_PER_SECTOR,
+            sector_size=SECTOR_SIZE, **kw,
+        )
+        assert built is not None
+        prepares, (wal, wal_off, wal_len, slots, sectors, sector_index) = (
+            built
+        )
+        parent = kw["parent"]
+        expect_off = 0
+        for i in range(k):
+            op = kw["op0"] + i
+            oracle = pl_py.build_prepare(
+                req_hdrs[i], bodies[i], cluster=kw["cluster"],
+                view=kw["view"], op=op, commit=kw["commit"],
+                timestamp=int(timestamps[i]), parent=parent,
+                replica=kw["replica"], context=int(contexts[i]),
+                release=kw["release"],
+            )
+            assert prepares[i].tobytes() == oracle.tobytes()
+            parent = wire.u128(oracle, "checksum")
+            # Slot table: registered with the self-vote, unsynced.
+            assert pl_c.votes(op) == 1
+            # Journal framing (the write_prepare byte layout).
+            msg = oracle.tobytes() + bodies[i]
+            padded = msg.ljust(_sectors(len(msg)), b"\x00")
+            slot = op % slot_count
+            assert int(slots[i]) == slot
+            assert int(wal_off[i]) == expect_off
+            assert int(wal_len[i]) == len(padded)
+            assert (
+                wal[expect_off : expect_off + len(padded)].tobytes()
+                == padded
+            )
+            expect_off += len(padded)
+            ring_py[slot] = oracle
+            first = slot // HEADERS_PER_SECTOR * HEADERS_PER_SECTOR
+            sector_py = ring_py[
+                first : first + HEADERS_PER_SECTOR
+            ].tobytes().ljust(SECTOR_SIZE, b"\x00")
+            base = i * SECTOR_SIZE
+            assert sectors[base : base + SECTOR_SIZE].tobytes() == sector_py
+        assert ring_c.tobytes() == ring_py.tobytes()
+        # Unsynced: the commit gate holds over the whole run.
+        assert pl_c.commit_ready_run(kw["op0"] - 1, 1) == 0
+        pl_c.mark_all_synced()
+        assert pl_c.commit_ready_run(kw["op0"] - 1, 1) == k
+
+
+def test_accept_prepares_bit_identical_fuzz():
+    """One tb_pl_accept_prepares call == K Python journal framings +
+    K tb_pl_build_prepare_ok calls."""
+    rng = np.random.default_rng(22_02)
+    slot_count = 64
+    pl = fastpath.create_pipeline()
+    for _ in range(40):
+        k = int(rng.integers(1, 9))
+        req_hdrs, bodies = _fuzz_requests(rng, k)
+        cluster = _r128(rng) >> 1
+        op0 = int(rng.integers(1, 1 << 32))
+        prepares = np.empty(k, HEADER_DTYPE)
+        parent = _r128(rng) >> 1
+        for i in range(k):
+            prepares[i] = pl.build_prepare(
+                req_hdrs[i], bodies[i], cluster=cluster,
+                view=3, op=op0 + i, commit=op0 - 1,
+                timestamp=int(rng.integers(1, 1 << 62)), parent=parent,
+                replica=0, context=0, release=1,
+            )
+            parent = wire.u128(prepares[i], "checksum")
+        view = int(rng.integers(0, 1 << 30))
+        replica = int(rng.integers(0, 6))
+        build_oks = bool(rng.integers(0, 2))
+        ring_c = np.zeros(slot_count, HEADER_DTYPE)
+        ring_py = np.zeros(slot_count, HEADER_DTYPE)
+        got = fastpath.accept_prepares(
+            prepares, bodies, view=view, replica=replica,
+            build_oks=build_oks, headers_ring=ring_c,
+            slot_count=slot_count,
+            headers_per_sector=HEADERS_PER_SECTOR,
+            sector_size=SECTOR_SIZE,
+        )
+        assert got is not None
+        oks, (wal, wal_off, wal_len, slots, sectors, sector_index) = got
+        expect_off = 0
+        for i in range(k):
+            h = prepares[i]
+            msg = h.tobytes() + bodies[i]
+            padded = msg.ljust(_sectors(len(msg)), b"\x00")
+            slot = (op0 + i) % slot_count
+            assert int(slots[i]) == slot
+            assert int(wal_off[i]) == expect_off
+            assert int(wal_len[i]) == len(padded)
+            assert (
+                wal[expect_off : expect_off + len(padded)].tobytes()
+                == padded
+            )
+            expect_off += len(padded)
+            ring_py[slot] = h
+            first = slot // HEADERS_PER_SECTOR * HEADERS_PER_SECTOR
+            sector_py = ring_py[
+                first : first + HEADERS_PER_SECTOR
+            ].tobytes().ljust(SECTOR_SIZE, b"\x00")
+            base = i * SECTOR_SIZE
+            assert sectors[base : base + SECTOR_SIZE].tobytes() == sector_py
+            if build_oks:
+                oracle = pl.build_prepare_ok(h, view, replica)
+                assert oks[i].tobytes() == oracle.tobytes()
+        assert ring_c.tobytes() == ring_py.tobytes()
+
+
+def test_on_acks_verdicts_mirror_scalar_path():
+    """One tb_pl_on_acks call over a mixed run (valid, duplicate,
+    foreign-cluster, wrong-view, unknown-op, stale-sibling) == the
+    per-ack path on a mirror table, plus the typed drop codes."""
+    rng = np.random.default_rng(22_03)
+    cluster = 7777
+    view = 5
+    pl = fastpath.create_pipeline()
+    mirror = fastpath.create_pipeline()
+    prepares = []
+    for i in range(6):
+        req, body = _fuzz_request(rng)
+        p = pl.build_prepare(
+            req, body, cluster=cluster, view=view, op=100 + i, commit=99,
+            timestamp=1 + i, parent=2, replica=0, context=0, release=1,
+        )
+        pl.note_prepare(p, False, 0)
+        mirror.note_prepare(p, False, 0)
+        prepares.append(p)
+
+    def _ok(prepare, *, cluster=cluster, view=view, op=None, context=None,
+            replica=1):
+        h = wire.make_header(
+            command=Command.prepare_ok, cluster=cluster, view=view,
+            op=int(prepare["op"]) if op is None else op, replica=replica,
+            context=(
+                wire.u128(prepare, "checksum") if context is None
+                else context
+            ),
+            client=wire.u128(prepare, "client"),
+        )
+        wire.finalize_header(h, b"")
+        return h
+
+    acks = [
+        _ok(prepares[0]),                       # vote -> 2
+        _ok(prepares[0]),                       # duplicate -> still 2
+        _ok(prepares[0], replica=2),            # vote -> 3
+        _ok(prepares[1], cluster=999),          # foreign cluster -> -4
+        _ok(prepares[1], view=view + 1),        # future view -> -3
+        _ok(prepares[1], op=555),               # unknown op -> -1
+        _ok(prepares[1], context=123456789),    # stale sibling -> -2
+        _ok(prepares[1]),                       # vote -> 2
+    ]
+    accepted, verdicts = pl.on_acks(np.array(acks), cluster, view)
+    assert list(verdicts) == [2, 2, 3, -4, -3, -1, -2, 2]
+    assert accepted == 4
+    # Per-ack differential: past the cluster/view screen (the caller's
+    # job in the scalar path), every verdict matches the scalar
+    # tb_pl_on_ack on a mirror table — None <=> a negative verdict.
+    for h, verdict in zip(acks, verdicts):
+        if wire.u128(h, "cluster") != cluster or int(h["view"]) != view:
+            continue
+        got = mirror.on_ack(h)
+        assert got == (None if verdict < 0 else int(verdict))
+    for op in range(100, 106):
+        assert pl.votes(op) == mirror.votes(op)
+
+
+def test_commit_ready_run_matches_scalar_walk_fuzz():
+    """tb_pl_commit_ready_run == iterating tb_pl_commit_ready op by
+    op, under fuzzed synced flags and vote counts."""
+    rng = np.random.default_rng(22_04)
+    for _ in range(50):
+        pl = fastpath.create_pipeline()
+        k = int(rng.integers(1, 12))
+        commit_min = int(rng.integers(0, 1 << 30))
+        quorum = int(rng.integers(1, 4))
+        for i in range(k):
+            req, body = _fuzz_request(rng)
+            p = pl.build_prepare(
+                req, body, cluster=1, view=1, op=commit_min + 1 + i,
+                commit=commit_min, timestamp=1 + i, parent=2, replica=0,
+                context=0, release=1,
+            )
+            pl.note_prepare(p, bool(rng.integers(0, 2)), 0)
+            for voter in range(1, int(rng.integers(1, 4))):
+                ok = wire.make_header(
+                    command=Command.prepare_ok, cluster=1, view=1,
+                    op=commit_min + 1 + i, replica=voter,
+                    context=wire.u128(p, "checksum"),
+                    client=wire.u128(p, "client"),
+                )
+                wire.finalize_header(ok, b"")
+                pl.on_ack(ok)
+        run = pl.commit_ready_run(commit_min, quorum)
+        oracle = 0
+        while pl.commit_ready(commit_min + oracle, quorum):
+            oracle += 1
+        assert run == oracle
+
+
+# ----------------------------------------------------------------------
+# Batched-delivery cluster: the sim's per-message _deliver never
+# reaches the batch seams, so this pump regroups each tick's due
+# packets into contiguous same-destination same-command runs — the
+# exact shape runtime/server.py's _dispatch_drain produces.
+
+
+class BatchCluster(Cluster):
+    BATCHED = {
+        int(Command.request), int(Command.prepare), int(Command.prepare_ok)
+    }
+
+    def step(self) -> None:
+        self.realtime += cfg.TICK_NS
+        for i, r in enumerate(self.replicas):
+            if r.status == "crashed":
+                continue
+            r.realtime = self.realtime + self.clock_skew[i]
+            r.tick()
+        for c in self.clients.values():
+            c.tick()
+        for f in self.followers:
+            f.tick()
+        due: list = []
+        self.network.advance(
+            lambda dst, header, body: due.append((dst, header, body))
+        )
+        run_dst = run_cmd = None
+        run_hdrs: list = []
+        run_bodies: list = []
+
+        def flush_run():
+            nonlocal run_dst, run_cmd, run_hdrs, run_bodies
+            if not run_hdrs:
+                return
+            r = self.replicas[run_dst]
+            if r.status != "crashed":
+                if run_cmd == int(Command.prepare):
+                    r.on_prepares_batch(run_hdrs, run_bodies)
+                elif run_cmd == int(Command.prepare_ok):
+                    r.on_prepare_oks_batch(run_hdrs)
+                else:
+                    r.on_requests_batch(run_hdrs, run_bodies)
+            run_dst = run_cmd = None
+            run_hdrs, run_bodies = [], []
+
+        for dst, header, body in due:
+            cmd = int(header["command"])
+            if (
+                isinstance(dst, int)
+                and dst < len(self.replicas)
+                and cmd in self.BATCHED
+            ):
+                if run_hdrs and (dst != run_dst or cmd != run_cmd):
+                    flush_run()
+                run_dst, run_cmd = dst, cmd
+                run_hdrs.append(header)
+                run_bodies.append(body)
+            else:
+                flush_run()
+                self._deliver(dst, header, body)
+        flush_run()
+        for r in self.replicas:
+            if r.status != "crashed":
+                r.flush_group_commit()
+        if self.root_ring_size:
+            self._merge_root_history()
+
+
+# ----------------------------------------------------------------------
+# Cluster differential: same deterministic script through the batch
+# seams, TB_NATIVE_DRAIN on vs off, every frame bit-identical.
+
+
+def _drained_run(monkeypatch, drain: str, *, seed: int = 31):
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    monkeypatch.setenv("TB_NATIVE_DRAIN", drain)
+    # The only nondeterministic wire bytes are trace_ts stamps: pin
+    # the clock so the on/off frames compare bit for bit.
+    monkeypatch.setattr(time, "perf_counter_ns", lambda: 1_000_000_000)
+    monkeypatch.setattr(
+        storage_mod.MemoryStorage, "supports_deferred_sync", True,
+        raising=False,
+    )
+    c = BatchCluster(3, seed=seed)
+    for r in c.replicas:
+        assert r._gc_enabled and r._np is not None
+        assert r._drain_native == (drain == "1")
+        assert r.journal._native_frame
+    frames = _capture_frames(c)
+    cl = _register(c, 100)
+    _setup_accounts(c, cl, ids=(1, 2, 3))
+    for k in range(12):
+        reply = c.run_request(
+            cl, types.Operation.create_transfers,
+            pack([transfer(500 + k, debit_account_id=1 + (k % 2),
+                           credit_account_id=3, amount=1 + k)]),
+        )
+        assert reply == b""
+    bad = c.run_request(
+        cl, types.Operation.create_transfers,
+        pack([transfer(900, debit_account_id=1, credit_account_id=1,
+                       amount=1)]),
+    )
+    assert bad != b""
+    out = c.run_request(
+        cl, types.Operation.lookup_accounts,
+        np.array([1, 0, 2, 0, 3, 0], "<u8").tobytes(),
+    )
+    c.settle(4000)
+    c.check_linearized()
+    c.check_convergence()
+    _assert_mirror(c)
+    native_calls = sum(r._c_drain_native.value for r in c.replicas)
+    return frames, out, native_calls
+
+
+def test_drain_frames_bit_identical_on_off(monkeypatch):
+    frames_on, table_on, native_on = _drained_run(monkeypatch, "1")
+    frames_off, table_off, native_off = _drained_run(monkeypatch, "0")
+    assert table_on == table_off
+    assert len(frames_on) == len(frames_off)
+    for a, b in zip(frames_on, frames_off):
+        assert a == b
+    kinds = {f[0] for f in frames_on}
+    assert kinds == {"peer", "client"}
+    # The on-arm really crossed into C per batch; the off-arm never did.
+    assert native_on > 0
+    assert native_off == 0
+
+
+def test_drain_state_matches_per_message_delivery(monkeypatch):
+    """Batched delivery is a transport regrouping, not a semantic
+    change: the same script through the legacy per-message sim lands
+    on the same account table."""
+    _, table_batched, _ = _drained_run(monkeypatch, "1")
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "1")
+    monkeypatch.setattr(time, "perf_counter_ns", lambda: 1_000_000_000)
+    monkeypatch.setattr(
+        storage_mod.MemoryStorage, "supports_deferred_sync", True,
+        raising=False,
+    )
+    c = Cluster(3, seed=31)
+    cl = _register(c, 100)
+    _setup_accounts(c, cl, ids=(1, 2, 3))
+    for k in range(12):
+        reply = c.run_request(
+            cl, types.Operation.create_transfers,
+            pack([transfer(500 + k, debit_account_id=1 + (k % 2),
+                           credit_account_id=3, amount=1 + k)]),
+        )
+        assert reply == b""
+    bad = c.run_request(
+        cl, types.Operation.create_transfers,
+        pack([transfer(900, debit_account_id=1, credit_account_id=1,
+                       amount=1)]),
+    )
+    assert bad != b""
+    out = c.run_request(
+        cl, types.Operation.lookup_accounts,
+        np.array([1, 0, 2, 0, 3, 0], "<u8").tobytes(),
+    )
+    c.settle(4000)
+    c.check_convergence()
+    assert out == table_batched
+
+
+def test_prefix_split_accepts_fresh_frames_past_a_stale_duplicate(
+    monkeypatch,
+):
+    """A retransmitted (stale-duplicate) prepare glued to the end of a
+    drain run must NOT demote the fresh frames ahead of it: the
+    eligible prefix still takes the one C call, only the duplicate
+    walks per-message _on_prepare (which re-acks it).  Counters pin
+    the split: native_calls > 0 and py_fallbacks counts EXACTLY the
+    injected duplicates, never whole runs."""
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "1")
+    monkeypatch.setattr(
+        storage_mod.MemoryStorage, "supports_deferred_sync", True,
+        raising=False,
+    )
+    c = BatchCluster(3, seed=77)
+    backup = next(r for r in c.replicas if not r.is_primary)
+    orig = backup.on_prepares_batch
+    injected = {"n": 0}
+
+    def wrapped(headers, bodies):
+        # Inject only into runs the eligibility scan would accept
+        # whole (steady-state shape), so the expected split is exactly
+        # prefix=run, suffix=[duplicate].
+        inject = (
+            len(headers) > 0
+            and backup.status == "normal"
+            and not backup.is_primary
+            and not backup._stash
+            and wire.u128(headers[0], "parent") == backup.parent_checksum
+            and all(int(h["view"]) == backup.view for h in headers)
+            and [int(h["op"]) for h in headers]
+            == list(range(backup.op + 1, backup.op + 1 + len(headers)))
+            and all(
+                wire.u128(b, "parent") == wire.u128(a, "checksum")
+                for a, b in zip(headers, headers[1:])
+            )
+        )
+        if inject:
+            headers = list(headers) + [headers[0].copy()]
+            bodies = [bytes(b) for b in bodies] + [bytes(bodies[0])]
+        fb0 = backup._c_drain_fallback.value
+        nat0 = backup._c_drain_native.value
+        orig(headers, bodies)
+        if inject:
+            injected["n"] += 1
+            # ONE native crossing for the fresh prefix, ONE per-item
+            # fallback for the duplicate — never the whole run.
+            assert backup._c_drain_native.value == nat0 + 1
+            assert backup._c_drain_fallback.value == fb0 + 1
+
+    backup.on_prepares_batch = wrapped
+    cl = _register(c, 100)
+    _setup_accounts(c, cl, ids=(1, 2))
+    for k in range(6):
+        reply = c.run_request(
+            cl, types.Operation.create_transfers,
+            pack([transfer(700 + k, debit_account_id=1,
+                           credit_account_id=2, amount=1)]),
+        )
+        assert reply == b""
+    c.settle(4000)
+    c.check_linearized()
+    c.check_convergence()
+    assert injected["n"] > 0
+    assert backup._c_drain_native.value > 0
+
+
+# ----------------------------------------------------------------------
+# Chaos on the drain arm: the r10 group-commit contract and
+# crash-at-fsync failover, with batched delivery.
+
+
+def _instrument_ack_ordering_drained(c):
+    """test_multi._instrument_ack_ordering extended to see the drain's
+    WAL entry point: write_prepare_framed is always an UNSYNCED write
+    (deferred-sync only), so it must register in wseq without moving
+    the synced watermark."""
+    violations = []
+    for r, st in zip(c.replicas, c.storages):
+        state = {"seq": 0, "synced": 0, "wseq": {}}
+
+        orig_write = r.journal.write_prepare
+
+        def write_prepare(header, body, sync=True, *, _s=state, _w=orig_write):
+            _s["seq"] += 1
+            _s["wseq"][int(header["op"])] = _s["seq"]
+            _w(header, body, sync=sync)
+            if sync:
+                _s["synced"] = _s["seq"]
+
+        r.journal.write_prepare = write_prepare
+
+        orig_framed = r.journal.write_prepare_framed
+
+        def write_prepare_framed(header, body_len, wal_view, slot,
+                                 sector_view, sector_index, *, _s=state,
+                                 _w=orig_framed):
+            _s["seq"] += 1
+            _s["wseq"][int(header["op"])] = _s["seq"]
+            _w(header, body_len, wal_view, slot, sector_view, sector_index)
+
+        r.journal.write_prepare_framed = write_prepare_framed
+
+        orig_sync = st.sync
+
+        def sync(*, _s=state, _o=orig_sync):
+            _o()  # raises (FsyncCrash) before anything counts as synced
+            _s["synced"] = _s["seq"]
+
+        st.sync = sync
+
+        orig_send = r.bus.send
+
+        def send(dst, header, body, *, _s=state, _r=r, _o=orig_send):
+            cmd = int(header["command"])
+            if cmd == int(Command.prepare_ok):
+                w = _s["wseq"].get(int(header["op"]))
+                if w is not None and w > _s["synced"]:
+                    violations.append(
+                        ("prepare_ok", _r.replica, int(header["op"]))
+                    )
+            if cmd in (int(Command.prepare), int(Command.commit)):
+                commit = int(header["commit"])
+                w = _s["wseq"].get(commit)
+                if w is not None and w > _s["synced"]:
+                    violations.append(("commit_leak", _r.replica, commit))
+            _o(dst, header, body)
+
+        r.bus.send = send
+
+        orig_send_client = r.bus.send_client
+
+        def send_client(client, header, body, *, _s=state, _r=r,
+                        _o=orig_send_client):
+            if int(header["command"]) == int(Command.reply):
+                w = _s["wseq"].get(int(header["op"]))
+                if w is not None and w > _s["synced"]:
+                    violations.append(
+                        ("reply", _r.replica, int(header["op"]))
+                    )
+            _o(client, header, body)
+
+        r.bus.send_client = send_client
+    return violations
+
+
+@pytest.fixture
+def drained_gc_cluster(monkeypatch):
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "1")
+    monkeypatch.setattr(
+        storage_mod.MemoryStorage, "supports_deferred_sync", True,
+        raising=False,
+    )
+    c = BatchCluster(3, seed=11)
+    for r in c.replicas:
+        assert r._gc_enabled and r._drain_native
+    return c
+
+
+def test_gc_contract_never_acks_before_covering_sync_drained(
+    drained_gc_cluster,
+):
+    """The r10 self-vote-gated-on-covering-sync contract re-driven
+    with the C drain journaling whole runs through framed writes."""
+    c = drained_gc_cluster
+    violations = _instrument_ack_ordering_drained(c)
+    cl = _register(c, 100)
+    _setup_accounts(c, cl)
+    others = [_register(c, 101 + k) for k in range(3)]
+
+    def drive(client, base):
+        sent = {"n": 0}
+
+        def step_one():
+            if client.busy():
+                return False
+            if sent["n"] >= 8:
+                return True
+            sent["n"] += 1
+            client.request(
+                types.Operation.create_transfers,
+                pack([
+                    transfer(base + sent["n"], debit_account_id=1,
+                             credit_account_id=2, amount=1)
+                ]),
+            )
+            return False
+
+        return step_one
+
+    steppers = [drive(cl, 1000)] + [
+        drive(o, 2000 + 100 * k) for k, o in enumerate(others)
+    ]
+    for _ in range(4000):
+        if all(s() for s in steppers):
+            break
+        c.step()
+    c.settle()
+    c.check_convergence()
+    assert violations == [], violations[:10]
+    assert sum(r._c_drain_native.value for r in c.replicas) > 0
+
+
+@pytest.mark.parametrize("seed", [3, 19, 47])
+def test_crash_at_fsync_failover_fuzz_drained(monkeypatch, seed):
+    """Primary dies inside a covering fsync at a fuzzed point; with
+    batched delivery + the C drain deciding commits, failover must
+    lose nothing acked and the hash logs must converge."""
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "1")
+    monkeypatch.setattr(
+        storage_mod.MemoryStorage, "supports_deferred_sync", True,
+        raising=False,
+    )
+    rng = np.random.default_rng(seed)
+    c = BatchCluster(3, seed=seed)
+    violations = _instrument_ack_ordering_drained(c)
+    cl = _register(c, 100)
+    _setup_accounts(c, cl)
+    acked = 0
+    next_id = [seed * 1000]
+
+    def send_next():
+        next_id[0] += 1
+        cl.request(
+            types.Operation.create_transfers,
+            pack([transfer(next_id[0], debit_account_id=1,
+                           credit_account_id=2, amount=1)]),
+        )
+
+    for _ in range(int(rng.integers(2, 6))):
+        send_next()
+        c.run_until(lambda: not cl.busy())
+        assert cl.reply == b""
+        acked += 1
+
+    c.storages[0].crash_at_fsync = int(rng.integers(1, 4))
+    send_next()
+    crashed = False
+    for _ in range(600):
+        try:
+            c.step()
+        except FsyncCrash:
+            crashed = True
+            c.crash_replica(0)
+            break
+        if not cl.busy():
+            acked += 1
+            send_next()
+    assert crashed, "seeded crash_at_fsync never fired"
+
+    c.run_until(lambda: not cl.busy(), 6000)
+    acked += 1
+    c.restart_replica(0)
+    c.settle(6000)
+    c.check_linearized()
+    c.check_convergence()
+    assert violations == [], violations[:10]
+    _assert_mirror(c)
+
+    out = c.run_request(cl, types.Operation.lookup_accounts, ids_bytes([1]))
+    row = np.frombuffer(out, types.ACCOUNT_DTYPE)[0]
+    assert types.u128_get(row, "debits_posted") == acked
+
+
+# ----------------------------------------------------------------------
+# Regression: a retransmission of an already-committed request, landing
+# MID-DRAIN between fresh requests under admission pressure, must get
+# its stored reply — never a busy (shedding ahead of the at-most-once
+# gate had exactly that bug).
+
+
+def test_retransmit_of_committed_mid_drain_gets_stored_reply(monkeypatch):
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "1")
+    c = Cluster(3, seed=5)
+    cl = _register(c, 100)
+    _setup_accounts(c, cl)
+    cl2 = _register(c, 200)
+    cl.request(
+        types.Operation.create_transfers,
+        pack([transfer(77, debit_account_id=1, credit_account_id=2,
+                       amount=1)]),
+    )
+    retrans_h = cl._inflight[0].copy()
+    retrans_b = cl._inflight[1]
+    c.run_until(lambda: not cl.busy())
+    assert cl.reply == b""
+    c.settle()
+    primary = c.replicas[0]
+    assert primary.is_primary
+
+    sent: list = []
+    orig = primary.bus.send_client
+
+    def send_client(client, header, body):
+        sent.append((client, header.copy(), bytes(body)))
+        orig(client, header, body)
+
+    primary.bus.send_client = send_client
+    # Admission bound 0: every FRESH request in the drain sheds.
+    primary.admit_queue = 0
+
+    def fresh(n):
+        h = wire.make_header(
+            command=Command.request,
+            operation=types.Operation.create_transfers,
+            cluster=c.cluster_id, client=cl2.id,
+            request=cl2.request_number + n,
+        )
+        body = pack([transfer(800 + n, debit_account_id=1,
+                              credit_account_id=2, amount=1)])
+        wire.finalize_header(h, body)
+        return h, body
+
+    f1, b1 = fresh(1)
+    f2, b2 = fresh(2)
+    primary.on_requests_batch(
+        [f1, retrans_h, f2], [b1, retrans_b, b2]
+    )
+    primary.flush_group_commit()
+    to_cl = [
+        (int(h["command"]), int(h["request"]))
+        for client, h, _ in sent if client == cl.id
+    ]
+    assert (int(Command.reply), int(retrans_h["request"])) in to_cl
+    assert int(Command.client_busy) not in [cmd for cmd, _ in to_cl]
+    # The fresh traffic around it really was under pressure: shed with
+    # typed busies, not silently dropped.
+    to_cl2 = [int(h["command"]) for client, h, _ in sent if client == cl2.id]
+    assert to_cl2.count(int(Command.client_busy)) == 2
+
+
+# ----------------------------------------------------------------------
+# Stale-.so forensics extended to the batch symbols (r20's contract):
+# explicit TB_NATIVE_DRAIN=1 against a stale library fails fast with
+# the rebuild hint; the defaulted knob degrades to the per-item arm.
+
+
+def test_stale_library_fails_fast_on_explicit_drain_opt_in(monkeypatch):
+    monkeypatch.setattr(fastpath, "_load", lambda: _StaleLib())
+    monkeypatch.setattr(fastpath, "_pipeline_warned", False)
+    monkeypatch.delenv("TB_NATIVE_PIPELINE", raising=False)
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "1")
+    assert not fastpath.drain_available()
+    assert "make -C native" in fastpath.drain_error()
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        with pytest.raises(RuntimeError, match="make -C native"):
+            Cluster(3, seed=1)
+
+
+def test_stale_library_degrades_when_drain_knob_defaulted(monkeypatch):
+    monkeypatch.setattr(fastpath, "_load", lambda: _StaleLib())
+    monkeypatch.setattr(fastpath, "_pipeline_warned", False)
+    monkeypatch.delenv("TB_NATIVE_PIPELINE", raising=False)
+    monkeypatch.delenv("TB_NATIVE_DRAIN", raising=False)
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        c = Cluster(3, seed=1)
+    for r in c.replicas:
+        assert not r._drain_native and r._np is None

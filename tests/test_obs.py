@@ -113,6 +113,43 @@ def test_histogram_empty_and_single():
     assert h.percentile(0.5) == obs.Histogram.quantize(42)
 
 
+@pytest.mark.parametrize("seed", [5, 6])
+def test_histogram_unit_scale_resolves_sub_unit_floor(seed):
+    """unit_scale=16 (r22: vsr.prepare_us / prepare_ok_us): sub-µs
+    samples land in 1/16-µs buckets instead of collapsing into bucket
+    0, percentiles descale back to raw units and still match the
+    sorted oracle quantized at the scaled resolution, and count/sum/
+    max stay in raw units."""
+    rng = random.Random(seed)
+    reg = obs.Registry(enabled=True)
+    h = reg.histogram("fine_us", unit_scale=16)
+    coarse = reg.histogram("coarse_us")
+    samples = [rng.random() * rng.choice([0.2, 1, 4, 50]) for _ in range(3000)]
+    for v in samples:
+        h.observe(v)
+        coarse.observe(v)
+    ss = sorted(samples)
+    for q in (0.25, 0.5, 0.9, 0.99):
+        rank = min(len(ss), max(1, math.ceil(q * len(ss))))
+        oracle = obs.Histogram.quantize(ss[rank - 1] * 16) / 16
+        assert h.percentile(q) == oracle, q
+    # The widened floor actually resolves the sub-µs mass the unscaled
+    # histogram collapses: its p50 sits below 1 µs (impossible for
+    # unit_scale=1, whose smallest nonzero representative is 1).
+    assert h.percentile(0.5) < 1.0 <= coarse.percentile(0.5)
+    assert h.count == len(samples)
+    assert h.max == max(samples)
+    assert abs(h.total - sum(samples)) < 1e-6 * max(1.0, sum(samples))
+
+
+def test_histogram_unit_scale_must_agree_across_registrations():
+    reg = obs.Registry(enabled=True)
+    reg.histogram("h_us", unit_scale=16)
+    reg.histogram("h_us", unit_scale=16)  # idempotent re-registration
+    with pytest.raises(AssertionError, match="unit_scale"):
+        reg.histogram("h_us")
+
+
 # ----------------------------------------------------------------------
 # Registry: composition, compat properties, version-driven dedup.
 

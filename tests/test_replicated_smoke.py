@@ -91,6 +91,54 @@ def test_two_replica_native_pipeline_smoke(tmp_path, monkeypatch):
     assert replies_native == replies_python
 
 
+def test_two_replica_native_drain_smoke(tmp_path, monkeypatch):
+    """C-resident drain arm (round 22): the same cluster smoke with a
+    whole poll's prepare->ack->commit-decision batched below Python
+    (TB_NATIVE_DRAIN=1) vs the per-item loop over the same batch seams
+    (=0) — reply bodies identical (bit-level frame identity is pinned
+    by the batched-delivery differential in tests/test_native_drain.py),
+    and the scrape proves which arm ran: batch C crossings only on the
+    ON arm, and far fewer crossings than prepares+acks processed."""
+    from tigerbeetle_tpu.runtime import fastpath
+
+    if not fastpath.drain_available():
+        pytest.skip("libtb_fastpath r22 drain symbols not built")
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "1")
+    drain_scrapes.clear()
+    replies_native = _run_cluster_once(tmp_path / "nd_on", "1", monkeypatch)
+    on_snaps = list(drain_scrapes)
+    monkeypatch.setenv("TB_NATIVE_DRAIN", "0")
+    drain_scrapes.clear()
+    replies_python = _run_cluster_once(tmp_path / "nd_off", "1", monkeypatch)
+    off_snaps = list(drain_scrapes)
+    assert replies_native == replies_python
+    # The ON arm crossed into C per batch seam — on BOTH roles (the
+    # primary's plan+ack drains, the backup's accept drains) — and the
+    # OFF arm never did.  Crossings are per RUN, so they stay bounded
+    # by the per-item work they replaced (native_calls <= items; the
+    # bench harvests the amortization ratio under real concurrency).
+    for s in on_snaps:
+        assert s["vsr.drain.native_calls"] > 0
+    primary_on, backup_on = on_snaps[0], on_snaps[1]
+    assert (
+        primary_on["vsr.drain.native_calls"]
+        <= primary_on["vsr.prepare_us.count"]
+        + primary_on["vsr.prepares_written"] * 2
+    )
+    assert (
+        backup_on["vsr.drain.native_calls"]
+        <= backup_on["vsr.prepare_ok_us.count"]
+    )
+    for s in off_snaps:
+        assert s["vsr.drain.native_calls"] == 0
+
+
+# Scrape snapshots stashed by _run_cluster_once for arm-level
+# assertions that need both runs (the drain smoke above).
+drain_scrapes: list = []
+
+
 def _run_cluster_once(tmp_path, fastpath_flag, monkeypatch):
     from tigerbeetle_tpu.client import Client
     from tigerbeetle_tpu.runtime.server import format_data_file
@@ -203,7 +251,12 @@ def _run_cluster_once(tmp_path, fastpath_flag, monkeypatch):
 
         for i, server in enumerate(servers):
             snap = scrape_stats(addresses[i], CLUSTER, timeout_ms=20_000)
+            drain_scrapes.append(snap)
             assert snap["replica"] == i
+            # r22 drain forensics are always scrape-visible, whichever
+            # arm ran (the smoke above asserts the arm-specific values).
+            assert "vsr.drain.native_calls" in snap
+            assert "vsr.drain.py_fallbacks" in snap
             r = server.server.replica
             # Quiescent counters must agree bit-for-bit with the
             # in-process registry (drain histograms keep moving with

@@ -137,6 +137,22 @@ def native_pipeline() -> int:
     return env_int("TB_NATIVE_PIPELINE", 1, minimum=0, maximum=1)
 
 
+def native_drain() -> int:
+    """TB_NATIVE_DRAIN: 1 (default) runs a whole poll drain's
+    prepare→ack→commit-decision work through ONE native call per
+    batch seam (native/tb_pipeline.cpp tb_pl_build_prepares /
+    tb_pl_accept_prepares / tb_pl_on_acks / tb_pl_commit_ready_run,
+    ABI 2) — Python demoted to a per-BATCH orchestrator.  Requires
+    the native pipeline (TB_NATIVE_PIPELINE=1 and a current .so);
+    falls back to the per-item loop otherwise.  0 pins the per-item
+    Python loop over the SAME batch seams for differential runs:
+    consensus and reply frames must be bit-identical either way (the
+    r20 contract extended from per-call to per-drain).  Setting 1
+    EXPLICITLY makes a stale library a hard error naming
+    `make -C native` instead of a silent fallback."""
+    return env_int("TB_NATIVE_DRAIN", 1, minimum=0, maximum=1)
+
+
 def cpu_affinity() -> str:
     """TB_CPU_AFFINITY: replica/router/follower core pinning for the
     multi-process spawn paths (bench subprocess spawns and the

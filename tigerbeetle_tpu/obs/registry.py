@@ -80,15 +80,22 @@ class Histogram:
     SUB_BITS = 4
     SUBS = 1 << SUB_BITS  # 16
 
-    __slots__ = ("name", "_v", "counts", "count", "total", "max")
+    __slots__ = ("name", "_v", "counts", "count", "total", "max",
+                 "unit_scale")
 
-    def __init__(self, name: str, vcell: list) -> None:
+    def __init__(self, name: str, vcell: list, unit_scale: int = 1) -> None:
         self.name = name
         self._v = vcell
         self.counts: dict[int, int] = {}
         self.count = 0
         self.total = 0.0
         self.max = 0.0
+        # Sub-unit floor widening: values are bucketed at
+        # value*unit_scale resolution and percentiles divide back, so
+        # a `_us` histogram with unit_scale=16 resolves 1/16-µs steps
+        # below 1 µs (sub-µs p50s stop collapsing into bucket 0).
+        # count/sum/max stay in raw units.
+        self.unit_scale = unit_scale
 
     # -- bucket arithmetic (static: the oracle test uses these too) ----
 
@@ -120,7 +127,7 @@ class Histogram:
     # -- hot path ------------------------------------------------------
 
     def observe(self, value) -> None:
-        idx = self.bucket_of(value)
+        idx = self.bucket_of(value * self.unit_scale)
         self.counts[idx] = self.counts.get(idx, 0) + 1
         self.count += 1
         self.total += value
@@ -136,8 +143,9 @@ class Histogram:
 
     def percentile(self, q: float) -> float:
         """Nearest-rank percentile, exact at bucket resolution: the
-        upper edge of the bucket holding sample #ceil(q*count)."""
-        return percentile_of_counts(self.counts, q)
+        upper edge of the bucket holding sample #ceil(q*count),
+        descaled back to raw units."""
+        return percentile_of_counts(self.counts, q) / self.unit_scale
 
 
 def percentile_of_counts(counts: dict, q: float) -> float:
@@ -205,6 +213,7 @@ class _NoopHistogram:
     total = 0.0
     max = 0.0
     counts: dict = {}
+    unit_scale = 1
 
     def observe(self, value) -> None:
         pass
@@ -251,12 +260,25 @@ class Registry:
     def gauge(self, name: str) -> Gauge:
         return self._make(name, Gauge)
 
-    def histogram(self, name: str):
+    def histogram(self, name: str, unit_scale: int = 1):
         """Latency histogram — the no-op instance when TB_METRICS=0
-        (its sites then skip the clock reads entirely)."""
+        (its sites then skip the clock reads entirely).  `unit_scale`
+        widens the sub-unit floor (see Histogram.unit_scale); every
+        registration of a name must agree on it."""
         if not self.enabled:
             return _NOOP_HIST
-        return self._make(name, Histogram)
+        item = self._items.get(name)
+        if item is None:
+            item = Histogram(name, self._v, unit_scale)
+            self._items[name] = item
+        assert isinstance(item, Histogram), (
+            f"{name} already registered as {type(item).__name__}"
+        )
+        assert item.unit_scale == unit_scale, (
+            f"{name} registered with unit_scale {item.unit_scale}, "
+            f"re-requested with {unit_scale}"
+        )
+        return item
 
     def gauge_fn(self, name: str, fn) -> None:
         """Pull gauge: `fn()` evaluated at snapshot time — for values
@@ -328,8 +350,8 @@ class Scope:
     def gauge(self, name: str) -> Gauge:
         return self._reg.gauge(self._prefix + name)
 
-    def histogram(self, name: str):
-        return self._reg.histogram(self._prefix + name)
+    def histogram(self, name: str, unit_scale: int = 1):
+        return self._reg.histogram(self._prefix + name, unit_scale)
 
     def gauge_fn(self, name: str, fn) -> None:
         self._reg.gauge_fn(self._prefix + name, fn)

@@ -240,6 +240,38 @@ def _load():
             ]
             lib.tb_pl_votes.restype = ctypes.c_uint32
             lib.tb_pl_votes.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            # C-resident drain loop (round 22, ABI 2).  Grouped with
+            # the r20 symbols on purpose: a stale .so missing ANY of
+            # them disables the whole pipeline (and reports ABI != 2
+            # anyway), never a mixed old/new symbol set.
+            lib.tb_pl_build_prepares.restype = ctypes.c_int64
+            lib.tb_pl_build_prepares.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(_U8P),
+                _U64P, _U64P, _U64P, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_int, _U8P,
+                _U8P, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+                _U8P, ctypes.c_uint64, _U64P, _U64P, _U64P, _U8P, _U64P,
+            ]
+            lib.tb_pl_accept_prepares.restype = ctypes.c_int64
+            lib.tb_pl_accept_prepares.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(_U8P), _U64P,
+                ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_int, _U8P,
+                _U8P, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+                _U8P, ctypes.c_uint64, _U64P, _U64P, _U64P, _U8P, _U64P,
+            ]
+            lib.tb_pl_on_acks.restype = ctypes.c_int64
+            lib.tb_pl_on_acks.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32, _I64P,
+            ]
+            lib.tb_pl_commit_ready_run.restype = ctypes.c_uint64
+            lib.tb_pl_commit_ready_run.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32,
+            ]
         except AttributeError:
             lib.tb_pl_abi_version = None
         _lib = lib
@@ -660,7 +692,10 @@ def verify_and_gather(arena: np.ndarray, moffs: np.ndarray,
 
 # Expected tb_pl_abi_version().  Bump in lockstep with
 # native/tb_pipeline.cpp whenever any tb_pl_* signature changes.
-PIPELINE_ABI = 1
+# ABI 2 = the r22 C-resident drain loop batch family
+# (tb_pl_build_prepares / tb_pl_accept_prepares / tb_pl_on_acks /
+# tb_pl_commit_ready_run).
+PIPELINE_ABI = 2
 
 _PIPELINE_HINT = (
     "libtb_fastpath.so is stale (missing/mismatched tb_pl_* pipeline "
@@ -691,6 +726,28 @@ def pipeline_error() -> str | None:
 def pipeline_available() -> bool:
     lib = _load()
     return lib is not None and pipeline_error() is None
+
+
+def drain_error() -> str | None:
+    """Why the r22 C-resident drain loop is unavailable even though
+    the fastpath library loaded (stale-.so forensics extended to the
+    batch symbols), else None.  A library missing any batch symbol
+    also reports pipeline ABI != 2, so this usually collapses into
+    pipeline_error(); the getattr probe is belt and braces."""
+    err = pipeline_error()
+    if err is not None:
+        return err
+    lib = _load()
+    if lib is None:
+        return None
+    if getattr(lib, "tb_pl_build_prepares", None) is None:
+        return _PIPELINE_HINT
+    return None
+
+
+def drain_available() -> bool:
+    lib = _load()
+    return lib is not None and drain_error() is None
 
 
 def create_pipeline():
@@ -781,6 +838,30 @@ class NativePipeline:
         votes = self._lib.tb_pl_on_ack(self._pl, header.tobytes())
         return None if votes < 0 else int(votes)
 
+    def on_acks(self, headers: np.ndarray, cluster: int,
+                view: int) -> tuple[int, np.ndarray]:
+        """Vote a contiguous run of prepare_ok headers in one call
+        (r22).  Returns (accepted_count, verdicts) where verdicts[i]
+        is the entry's vote count after ack i, or negative for the
+        drops the per-ack path also takes: -4 foreign cluster, -3
+        stale/future view, -1 unknown op, -2 stale-sibling checksum."""
+        k = len(headers)
+        assert headers.dtype.itemsize == 256
+        out = np.empty(k, np.int64)
+        accepted = self._lib.tb_pl_on_acks(
+            self._pl, headers.tobytes(), k,
+            cluster & 0xFFFFFFFFFFFFFFFF, cluster >> 64, view,
+            _p(out, _I64P),
+        )
+        return int(accepted), out
+
+    def commit_ready_run(self, commit_min: int, quorum: int) -> int:
+        """Length of the contiguous commit-ready run above commit_min
+        — tb_pl_commit_ready extended to the whole drain (r22)."""
+        return int(
+            self._lib.tb_pl_commit_ready_run(self._pl, commit_min, quorum)
+        )
+
     def mark_all_synced(self) -> None:
         self._lib.tb_pl_mark_all_synced(self._pl)
 
@@ -823,6 +904,109 @@ def frame_prepare(header: np.void, body: bytes, headers_ring: np.ndarray,
         ctypes.cast(out_prepare.ctypes.data, _U8P),
         ctypes.cast(out_sector.ctypes.data, _U8P),
     ))
+
+
+def _padded_total(body_lens: np.ndarray, sector_size: int) -> int:
+    """Sum of sector-padded prepare sizes — sized exactly like the C
+    side's capacity check so a successful allocation here can never
+    overflow there."""
+    msgs = body_lens + np.uint64(256 + sector_size - 1)
+    return int((msgs // np.uint64(sector_size)).sum()) * sector_size
+
+
+def build_prepares(pl: NativePipeline, req_hdrs: np.ndarray, bodies: list,
+                   timestamps: np.ndarray, contexts: np.ndarray, *,
+                   cluster: int, view: int, op0: int, commit: int,
+                   parent: int, replica: int, release: int, synced: bool,
+                   headers_ring: np.ndarray, slot_count: int,
+                   headers_per_sector: int, sector_size: int):
+    """One C call for a whole drain's prepare builds (r22): K headers
+    chained parent->checksum, registered in the slot table with the
+    self-vote, and framed for the journal.  Returns (prepares, frames)
+    where `prepares` is a (K,) HEADER_DTYPE array and `frames` is the
+    WAL write-descriptor tuple (wal_arena, wal_off, wal_len, slots,
+    sector_arena, sector_index), or None on arena overflow (caller
+    loops the per-prepare path; nothing was mutated)."""
+    lib = _load()
+    k = len(bodies)
+    assert req_hdrs.dtype.itemsize == 256 and req_hdrs.flags["C_CONTIGUOUS"]
+    assert headers_ring.flags["C_CONTIGUOUS"]
+    ptrs = (_U8P * k)(
+        *[ctypes.cast(ctypes.c_char_p(b), _U8P) for b in bodies]
+    )
+    blens = np.array([len(b) for b in bodies], np.uint64)
+    ts = np.ascontiguousarray(timestamps, np.uint64)
+    ctx = np.ascontiguousarray(contexts, np.uint64)
+    from tigerbeetle_tpu.vsr.wire import HEADER_DTYPE
+
+    prepares = np.empty(k, HEADER_DTYPE)
+    wal_arena = np.zeros(_padded_total(blens, sector_size), np.uint8)
+    sector_arena = np.zeros(k * sector_size, np.uint8)
+    wal_off = np.empty(k, np.uint64)
+    wal_len = np.empty(k, np.uint64)
+    slots = np.empty(k, np.uint64)
+    sector_index = np.empty(k, np.uint64)
+    rc = lib.tb_pl_build_prepares(
+        pl._pl, req_hdrs.tobytes(), ptrs, _p(blens, _U64P),
+        _p(ts, _U64P), _p(ctx, _U64P), k,
+        cluster & 0xFFFFFFFFFFFFFFFF, cluster >> 64, view, op0, commit,
+        parent & 0xFFFFFFFFFFFFFFFF, parent >> 64, replica, release,
+        1 if synced else 0,
+        ctypes.cast(prepares.ctypes.data, _U8P),
+        ctypes.cast(headers_ring.ctypes.data, _U8P), slot_count,
+        headers_per_sector, sector_size,
+        ctypes.cast(wal_arena.ctypes.data, _U8P), len(wal_arena),
+        _p(wal_off, _U64P), _p(wal_len, _U64P), _p(slots, _U64P),
+        ctypes.cast(sector_arena.ctypes.data, _U8P),
+        _p(sector_index, _U64P),
+    )
+    if rc < 0:
+        return None
+    return prepares, (wal_arena, wal_off, wal_len, slots, sector_arena,
+                      sector_index)
+
+
+def accept_prepares(hdrs: np.ndarray, bodies: list, *, view: int,
+                    replica: int, build_oks: bool,
+                    headers_ring: np.ndarray, slot_count: int,
+                    headers_per_sector: int, sector_size: int):
+    """One C call for a backup drain's accepted-prepare run (r22):
+    frame K prepares for the journal and build their prepare_ok
+    headers.  Returns (oks, frames) — `oks` a (K,) HEADER_DTYPE array
+    (contents undefined when build_oks=False) and `frames` as in
+    build_prepares — or None on arena overflow (nothing mutated)."""
+    lib = _load()
+    k = len(bodies)
+    assert hdrs.dtype.itemsize == 256 and hdrs.flags["C_CONTIGUOUS"]
+    assert headers_ring.flags["C_CONTIGUOUS"]
+    ptrs = (_U8P * k)(
+        *[ctypes.cast(ctypes.c_char_p(b), _U8P) for b in bodies]
+    )
+    blens = np.array([len(b) for b in bodies], np.uint64)
+    from tigerbeetle_tpu.vsr.wire import HEADER_DTYPE
+
+    oks = np.empty(k, HEADER_DTYPE)
+    wal_arena = np.zeros(_padded_total(blens, sector_size), np.uint8)
+    sector_arena = np.zeros(k * sector_size, np.uint8)
+    wal_off = np.empty(k, np.uint64)
+    wal_len = np.empty(k, np.uint64)
+    slots = np.empty(k, np.uint64)
+    sector_index = np.empty(k, np.uint64)
+    rc = lib.tb_pl_accept_prepares(
+        hdrs.tobytes(), ptrs, _p(blens, _U64P), k, view, replica,
+        1 if build_oks else 0,
+        ctypes.cast(oks.ctypes.data, _U8P),
+        ctypes.cast(headers_ring.ctypes.data, _U8P), slot_count,
+        headers_per_sector, sector_size,
+        ctypes.cast(wal_arena.ctypes.data, _U8P), len(wal_arena),
+        _p(wal_off, _U64P), _p(wal_len, _U64P), _p(slots, _U64P),
+        ctypes.cast(sector_arena.ctypes.data, _U8P),
+        _p(sector_index, _U64P),
+    )
+    if rc < 0:
+        return None
+    return oks, (wal_arena, wal_off, wal_len, slots, sector_arena,
+                 sector_index)
 
 
 def finalize_headers(headers: np.ndarray, bodies: list) -> bool:

@@ -182,6 +182,15 @@ def _load():
         except AttributeError:
             lib.tb_bus_send2 = None
         try:
+            lib.tb_bus_sendv.restype = ctypes.c_int
+            lib.tb_bus_sendv.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32,
+            ]
+        except AttributeError:
+            lib.tb_bus_sendv = None
+        try:
             lib.tb_bus_poll_drain.restype = ctypes.c_int
             lib.tb_bus_poll_drain.argtypes = [
                 ctypes.c_void_p, ctypes.c_int,
@@ -286,6 +295,23 @@ class NativeBus:
         self._lib.tb_bus_send2(
             self._bus, conn, head, len(head), body, len(body)
         )
+
+    def sendv(self, conn: int, frames: list[bytes]) -> None:
+        """Queue a run of complete frames for one connection in a
+        single crossing (r22 drain loop: the backup's per-drain
+        prepare_ok burst).  Falls back to per-frame sends when the
+        loaded library predates the symbol."""
+        if getattr(self._lib, "tb_bus_sendv", None) is None:
+            for f in frames:
+                self.send(conn, f)
+            return
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        k = len(frames)
+        bufs = (u8p * k)(
+            *[ctypes.cast(ctypes.c_char_p(f), u8p) for f in frames]
+        )
+        lens = (ctypes.c_uint32 * k)(*[len(f) for f in frames])
+        self._lib.tb_bus_sendv(self._bus, conn, bufs, lens, k)
 
     def close_conn(self, conn: int) -> None:
         self._lib.tb_bus_close(self._bus, conn)

@@ -79,6 +79,18 @@ class TcpBus:
             return
         self.native.send2(conn, header.tobytes(), body)
 
+    def send_frames(self, dst_replica: int,
+                    frames: list[tuple[np.ndarray, bytes]]) -> None:
+        """Vectored send of a whole run of frames to one replica (r22:
+        a drain's deferred prepare_oks release in ONE native call —
+        same frames, same order as the per-frame loop)."""
+        conn = self.replica_conns.get(self._to_process(dst_replica))
+        if conn is None:
+            return  # not connected yet; protocol retransmits
+        self.native.sendv(
+            conn, [h.tobytes() + body for h, body in frames]
+        )
+
     # -- connection management --
 
     def connect_peers(self, cluster: int, view: int) -> None:
@@ -515,10 +527,34 @@ class ReplicaServer:
         pos = 0
         req_hdrs: list = []
         req_bodies: list = []
+        # Contiguous same-command runs of prepare / prepare_ok frames
+        # collect here and hand off as ONE batch call (vsr/multi.py
+        # on_prepares_batch / on_prepare_oks_batch) — the r22
+        # C-resident drain seam.  Any other event flushes the pending
+        # run first, so relative order against non-run messages is
+        # exactly the per-message walk's; requests still defer to the
+        # end of the round (r14 behavior), AFTER the final flush.
+        run_kind = 0
+        run_hdrs: list = []
+        run_bodies: list = []
+
+        def flush_run() -> None:
+            nonlocal run_kind, run_hdrs, run_bodies
+            if not run_hdrs:
+                return
+            if run_kind == int(Command.prepare):
+                self.replica.on_prepares_batch(run_hdrs, run_bodies)
+            else:
+                self.replica.on_prepare_oks_batch(run_hdrs)
+            run_kind = 0
+            run_hdrs = []
+            run_bodies = []
+
         for j in range(n):
             et = int(ev_types[j])
             conn = int(conns[j])
             if et == EV_CLOSED:
+                flush_run()
                 self.bus.drop_conn(conn)
                 continue
             if et != EV_MESSAGE or not lens[j]:
@@ -531,13 +567,18 @@ class ReplicaServer:
             header = hdrs[i]
             off = int(offsets[j])
             end = off + int(lens[j])
-            if int(header["command"]) == int(Command.request):
+            cmd = int(header["command"])
+            if cmd == int(Command.request):
                 if int(header["operation"]) == int(wire.VsrOperation.stats):
+                    # Scrapes answer from live state: flush so they
+                    # observe everything that arrived before them.
+                    flush_run()
                     self._send_stats_reply(conn, header)
                     continue
                 if int(header["operation"]) == int(
                     wire.VsrOperation.state_root
                 ):
+                    flush_run()
                     self._send_state_root_reply(
                         conn, header, mv[off + HEADER_SIZE : end]
                     )
@@ -546,11 +587,26 @@ class ReplicaServer:
                 self.bus.register_client(conn, wire.u128(header, "client"))
                 req_hdrs.append(header)
                 req_bodies.append(mv[off + HEADER_SIZE : end])
+            elif cmd in (int(Command.prepare), int(Command.prepare_ok)):
+                # Learn peer identity at collection time, exactly as
+                # _dispatch_message would per message (ack routing in
+                # the batch path needs the conn registered).
+                if int(header["replica"]) != self.replica.replica:
+                    if self.bus._conn_peer.get(conn) is None:
+                        self.bus.register_peer(conn, int(header["replica"]))
+                if run_kind != cmd:
+                    flush_run()
+                    run_kind = cmd
+                run_hdrs.append(header)
+                if cmd == int(Command.prepare):
+                    run_bodies.append(mv[off + HEADER_SIZE : end])
             else:
+                flush_run()
                 self._dispatch_message(
                     conn, header, bytes(mv[off + HEADER_SIZE : end]),
                     verified=True,
                 )
+        flush_run()
         if req_hdrs:
             self.replica.on_requests_batch(req_hdrs, req_bodies)
         return msgs

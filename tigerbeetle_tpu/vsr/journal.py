@@ -165,6 +165,30 @@ class Journal:
                 self.unsynced_writes += 1
         self.anatomy.stage_h(header, "journal_write")
 
+    def write_prepare_framed(self, header: np.ndarray, body_len: int,
+                             wal_view, slot: int, sector_view,
+                             sector_index: int) -> None:
+        """Append one ALREADY-FRAMED prepare (r22 drain loop): the
+        sector-padded prepare buffer and redundant-header sector were
+        built by the batch C call (which also wrote headers[slot] in
+        place) — this issues the same two storage writes, counters,
+        and spans as write_prepare(sync=False), per prepare, so the
+        storage-visible sequence is identical to the per-item path."""
+        assert int(header["command"]) == Command.prepare
+        assert int(header["size"]) == HEADER_SIZE + body_len
+        op = int(header["op"])
+        self._c_writes.inc()
+        with self.tracer.span(
+            "journal_write", op=op, bytes=body_len
+        ), self._h_write.time():
+            self.storage.write(self.layout.prepare_slot_offset(slot), wal_view)
+            self.storage.write(
+                self.layout.wal_headers_offset + sector_index * SECTOR_SIZE,
+                sector_view,
+            )
+            self.unsynced_writes += 1
+        self.anatomy.stage_h(header, "journal_write")
+
     def sync_batch(self) -> bool:
         """One covering fdatasync for every deferred WAL write since
         the last batch — the group-commit seam: a whole poll-drain's

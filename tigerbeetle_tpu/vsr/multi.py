@@ -358,8 +358,41 @@ class VsrReplica(Replica):
         # build + pipeline bookkeeping; prepare_ok_us times the
         # backup's ack build (body-independent, so the native-vs-
         # Python delta stays visible under group-commit coalescing).
-        self._h_prepare_us = self.metrics.histogram("prepare_us")
-        self._h_prepare_ok_us = self.metrics.histogram("prepare_ok_us")
+        # unit_scale=16 widens the sub-µs floor (1/16-µs buckets below
+        # 1 µs) so the native drain's amortized per-prepare cost stays
+        # resolvable instead of collapsing into bucket 0.
+        self._h_prepare_us = self.metrics.histogram("prepare_us",
+                                                    unit_scale=16)
+        self._h_prepare_ok_us = self.metrics.histogram("prepare_ok_us",
+                                                       unit_scale=16)
+
+        # C-resident drain loop (round 22): whole prepare/ack runs
+        # cross into native/tb_pipeline.cpp as ONE call per batch seam
+        # (tb_pl_build_prepares / tb_pl_accept_prepares / tb_pl_on_acks
+        # / tb_pl_commit_ready_run) — Python keeps the per-BATCH
+        # orchestration plus every slow path (dedupe misses, QoS
+        # shedding, view change, checkpoint, recovery, commit
+        # execution).  TB_NATIVE_DRAIN=0 pins the per-item loop over
+        # the SAME batch seams, so the 0/1 frames are structurally
+        # bit-identical.  native_calls counts batch C crossings;
+        # py_fallbacks counts items that took a per-item arm while the
+        # drain loop was on (ineligible run, non-gc mode, arena
+        # overflow) — the "one call per drain" scrape assertion.
+        self._c_drain_native = self.metrics.counter("drain.native_calls")
+        self._c_drain_fallback = self.metrics.counter("drain.py_fallbacks")
+        self._drain_native = False
+        if envcheck.native_drain() == 1:
+            err = _fastpath.drain_error()
+            if err is not None and envcheck.env_is_set("TB_NATIVE_DRAIN"):
+                # Explicit TB_NATIVE_DRAIN=1 against a loaded-but-
+                # stale library: fail fast with the rebuild hint (the
+                # r20 forensics extended to the batch symbols).
+                raise RuntimeError(err)
+            self._drain_native = (
+                err is None
+                and self._np is not None
+                and _fastpath.drain_available()
+            )
 
     # Compatibility properties over the registry handles (obs).
     stat_blocks_repaired = obs_stat_property("stat_blocks_repaired")
@@ -508,7 +541,7 @@ class VsrReplica(Replica):
             if r != self.replica and r not in entry.ok_replicas:
                 self.bus.send(r, entry.header, entry.body)
 
-    def _prepare_headroom(self) -> bool:
+    def _prepare_headroom(self, pending: int = 0) -> bool:
         """True while the NEXT prepare's ring slot would not overwrite
         an op above the checkpoint.  Replay and repair need every op in
         (checkpoint_op, op]; without this bound a commit stall plus
@@ -516,9 +549,10 @@ class VsrReplica(Replica):
         primary accept another pipeline's worth of requests) pushed op
         67 past the stuck commit point and the ring wrap destroyed the
         only copies of two uncommitted ops cluster-wide (VOPR seed
-        202019721)."""
+        202019721).  `pending` counts plan-deferred prepares that have
+        not advanced self.op yet (the r22 drain plan)."""
         return (
-            self.op + 1
+            self.op + pending + 1
             <= self.checkpoint_op + self.config.journal_slot_count
         )
 
@@ -716,6 +750,19 @@ class VsrReplica(Replica):
             return
         inflight = self._inflight_requests()
         undecidable = inflight is UNDECIDABLE
+        # Per-drain dedupe pre-pass (r22): classify the common case —
+        # fresh, registered, in-order, not in flight — in one
+        # vectorized pass so only exceptions (retransmits, registers,
+        # catch-up) walk _request_dedupe's branch ladder.  A True
+        # entry is PROVEN to be exactly what _request_dedupe returns
+        # None for, with zero side effects skipped; everything else
+        # (including a retransmit-of-committed, which must get its
+        # stored reply mid-drain, never a busy) drops to the per-
+        # request slow path unchanged.
+        fast = (
+            None if undecidable or not headers
+            else self._admit_prepass(headers, inflight)
+        )
         for i, h in enumerate(headers):
             operation = int(h["operation"])
             if operation in (
@@ -730,7 +777,10 @@ class VsrReplica(Replica):
                     continue
                 if not self.sm.input_valid(op_enum, body):
                     continue
-            verdict = self._request_dedupe(h, inflight=inflight)
+            if fast is not None and fast[i]:
+                verdict = None
+            else:
+                verdict = self._request_dedupe(h, inflight=inflight)
             if verdict == "drop":
                 continue
             if (
@@ -760,6 +810,201 @@ class VsrReplica(Replica):
                 if key[0] and key in self._queued_keys:
                     inflight.add(key)
         self._drain_request_queue()
+
+    def _admit_prepass(self, headers, inflight) -> list[bool]:
+        """Vectorized fast/slow classification for one drain's request
+        batch (r22 satellite).  out[i] is True only when request i is
+        PROVABLY what _request_dedupe returns None for with no side
+        effects: a non-reserved client op from a registered session,
+        request number strictly advancing, no catch-up in progress,
+        not in flight, and not a duplicate of any earlier request in
+        this same batch.  Everything else — registers, retransmits
+        (committed → stored reply), stale numbers, eviction candidates
+        — stays on the per-request slow path."""
+        arr = np.array(headers)
+        ok = (
+            ((arr["client_lo"] != 0) | (arr["client_hi"] != 0))
+            & (arr["operation"] >= constants.VSR_OPERATIONS_RESERVED)
+        )
+        if self.commit_min != self.commit_max:
+            # Catching up: session entries may predate the
+            # re-committing suffix — everything goes slow.
+            ok[:] = False
+        out: list[bool] = []
+        seen: set[tuple[int, int]] = set()
+        sessions = self.sessions
+        lo, hi, req = arr["client_lo"], arr["client_hi"], arr["request"]
+        for i in range(len(headers)):
+            client = int(lo[i]) | (int(hi[i]) << 64)
+            key = (client, int(req[i]))
+            fast = bool(ok[i])
+            if fast:
+                entry = sessions.get(client)
+                fast = (
+                    entry is not None
+                    and key[1] > entry.request
+                    and key not in inflight
+                    and key not in seen
+                )
+            # EVERY key joins `seen`: a later copy of any earlier
+            # batch item must take the slow path, where the
+            # incrementally-updated inflight set (or the shed state)
+            # decides — exactly as the per-item arm does.
+            if key[0]:
+                seen.add(key)
+            out.append(fast)
+        return out
+
+    def on_prepare_oks_batch(self, headers: list[np.ndarray]) -> None:
+        """A contiguous drain run of prepare_ok frames (runtime/
+        server.py): vote the whole run through the slot table in ONE C
+        call, then run the commit gate once.  Decision-equivalent to
+        per-message _on_prepare_ok: acks emit no frames, and
+        _maybe_commit_pipeline commits the ready run in op order with
+        the same commit->drain interleaving whether entered after each
+        vote or after all of them.  TB_NATIVE_DRAIN=0 pins the
+        per-message loop over the same seam (bit-identical frames)."""
+        if not self.is_primary:
+            return  # per-message arm drops each ack identically
+        if not (self._drain_native and self._np is not None):
+            for h in headers:
+                # on_message's cluster gate, then the per-ack handler.
+                if wire.u128(h, "cluster") == self.cluster:
+                    self._on_prepare_ok(h, b"")
+            return
+        arr = np.array(headers)
+        _accepted, verdicts = self._np.on_acks(arr, self.cluster, self.view)
+        self._c_drain_native.inc()
+        voted = False
+        for i, h in enumerate(headers):
+            if int(verdicts[i]) < 0:
+                # -4 cluster / -3 view / -1 unknown op / -2 stale
+                # sibling: exactly the per-ack drops (on_message's
+                # cluster gate + _on_prepare_ok's early returns).
+                continue
+            entry = self.pipeline.get(int(h["op"]))
+            if entry is None:
+                continue  # C table ahead of a just-dropped entry
+            entry.ok_replicas.add(int(h["replica"]))
+            self.anatomy.stage_h(h, "prepare_ok")
+            voted = True
+        if voted:
+            self._maybe_commit_pipeline()
+
+    def on_prepares_batch(self, headers: list[np.ndarray],
+                          bodies: list) -> None:
+        """A contiguous drain run of prepare frames (runtime/
+        server.py): when the WHOLE run is the steady-state shape — our
+        view, normal status, sequential ops extending our head with an
+        intact parent chain, no stash/anchor interference — frame
+        every WAL write and build every prepare_ok in ONE C call, then
+        replay the per-item side effects (journal descriptors,
+        replicate, ack routing, commit advance) in legacy order.  The
+        run splits at the FIRST deviating frame: the eligible prefix
+        still takes the one C call, only the suffix (typically a
+        stale duplicate from primary retransmission under load) walks
+        per-message _on_prepare — a retransmitted copy must not
+        demote the fresh frames ahead of it.  TB_NATIVE_DRAIN=0 pins
+        the per-message loop over the same seam (bit-identical
+        frames)."""
+        split = 0
+        if (
+            self._drain_native
+            and self._np is not None
+            and self._gc_enabled  # framed writes are unsynced-only
+            and self.journal._native_frame
+            and self.status == "normal"
+            and not self.is_primary
+            and not self._anchor_pending
+            # A stashed successor could double-accept the run's next
+            # op via _drain_stash; per-message handles that ordering.
+            and not self._stash
+        ):
+            op0 = self.op + 1
+            parent = self.parent_checksum
+            split = len(headers)
+            for i, h in enumerate(headers):
+                if (
+                    wire.u128(h, "cluster") != self.cluster
+                    or int(h["view"]) != self.view
+                    or int(h["op"]) != op0 + i
+                    or wire.u128(h, "parent") != parent
+                ):
+                    split = i
+                    break
+                parent = wire.u128(h, "checksum")
+        rest_h, rest_b = headers[split:], bodies[split:]
+        if rest_h and self._drain_native:
+            self._c_drain_fallback.inc(len(rest_h))
+        if split == 0:
+            for i, h in enumerate(rest_h):
+                # on_message's cluster gate, then the per-msg handler.
+                if wire.u128(h, "cluster") == self.cluster:
+                    self._on_prepare(h, bytes(rest_b[i]))
+            return
+        headers, bodies = headers[:split], bodies[:split]
+
+        from tigerbeetle_tpu.constants import SECTOR_SIZE
+        from tigerbeetle_tpu.runtime import fastpath as _fastpath
+        from tigerbeetle_tpu.vsr.journal import HEADERS_PER_SECTOR
+
+        self._last_primary_seen = self._ticks
+        k = len(headers)
+        bodies = [bytes(b) for b in bodies]
+        arr = np.array(headers)
+        build_oks = not self.standby
+        t0 = time.perf_counter_ns()
+        accepted = _fastpath.accept_prepares(
+            arr, bodies, view=self.view, replica=self.replica,
+            build_oks=build_oks,
+            headers_ring=self.journal.headers,
+            slot_count=self.journal.slot_count,
+            headers_per_sector=HEADERS_PER_SECTOR,
+            sector_size=SECTOR_SIZE,
+        )
+        batch_ns = time.perf_counter_ns() - t0
+        if accepted is None:
+            raise RuntimeError(
+                "native drain: accept arena refused exact-sized run"
+            )
+        self._c_drain_native.inc()
+        oks, frames = accepted
+        wal_arena, wal_off, wal_len, slots, sector_arena, sector_index = (
+            frames
+        )
+        per_item_us = batch_ns / k / 1000.0
+        wal_mv = memoryview(wal_arena)
+        sector_mv = memoryview(sector_arena)
+        for i, h in enumerate(headers):
+            op = int(h["op"])
+            off = int(wal_off[i])
+            length = int(wal_len[i])
+            self._journal_write_framed(
+                h, len(bodies[i]), wal_mv[off:off + length],
+                int(slots[i]),
+                sector_mv[i * SECTOR_SIZE:(i + 1) * SECTOR_SIZE],
+                int(sector_index[i]),
+            )
+            self.op = op
+            self.parent_checksum = wire.u128(h, "checksum")
+            self._vouched[op] = self.parent_checksum
+            if op - 1 > self.commit_min:
+                self._vouched.setdefault(op - 1, wire.u128(h, "parent"))
+            self._repair_wanted.pop(op, None)
+            self._replicate(h, bodies[i])
+            if build_oks:
+                self.tracer.instant("prepare_ok", op=op)
+                self._gc_send(self.primary_index(), oks[i], b"")
+            self._h_prepare_ok_us.observe(per_item_us)
+            # Legacy order: each message's commit field advances the
+            # backup commit point before the next message is handled.
+            self._advance_commit(int(h["commit"]))
+        # The deviating suffix (if any) runs per-message AFTER the
+        # prefix — exactly the order the per-item arm would process
+        # the run in.
+        for i, h in enumerate(rest_h):
+            if wire.u128(h, "cluster") == self.cluster:
+                self._on_prepare(h, bytes(rest_b[i]))
 
     def _enqueue_request(self, header: np.ndarray, body: bytes,
                          readmit: bool = False) -> None:
@@ -1127,6 +1372,120 @@ class VsrReplica(Replica):
         self._replicate(prepare, body)
         self._maybe_commit_pipeline()
 
+    def _primary_prepare_plan(
+        self,
+        plan: list[tuple[np.ndarray, bytes, list | None]],
+    ) -> None:
+        """Materialize a drain plan: the whole run of collected
+        (request, body, subs) triples becomes prepares in ONE native
+        call (build + checksum + self-vote + WAL framing below Python),
+        or — on the TB_NATIVE_DRAIN=0 arm / non-sector-aligned
+        journal — the per-item _primary_prepare loop over the same
+        plan.  Only reachable with group commit on (see
+        _drain_request_queue), so no plan entry can commit before the
+        run is fully materialized: both arms emit bit-identical frames
+        in identical order."""
+        if not plan:
+            return
+        use_native = (
+            self._drain_native
+            and self._np is not None
+            and self.journal._native_frame
+        )
+        if not use_native:
+            if self._drain_native:
+                self._c_drain_fallback.inc(len(plan))
+            for head, pbody, subs in plan:
+                if subs is not None:
+                    self._primary_prepare(head, pbody, subs=subs)
+                else:
+                    self._primary_prepare(head, pbody)
+            return
+
+        from tigerbeetle_tpu.constants import SECTOR_SIZE
+        from tigerbeetle_tpu.runtime import fastpath as _fastpath
+        from tigerbeetle_tpu.vsr.journal import HEADERS_PER_SECTOR
+
+        k = len(plan)
+        req_hdrs = np.empty(k, dtype=wire.HEADER_DTYPE)
+        timestamps = np.empty(k, dtype=np.uint64)
+        contexts = np.empty(k, dtype=np.uint64)
+        bodies: list[bytes] = []
+        # Pre-work (state-machine prepare + timestamp advance) runs in
+        # plan order, exactly as the per-item arm interleaves it with
+        # header builds — sm.prepare side effects are order-sensitive.
+        for i, (head, pbody, subs) in enumerate(plan):
+            operation = int(head["operation"])
+            self._advance_prepare_timestamp()
+            if operation >= constants.VSR_OPERATIONS_RESERVED:
+                events = (
+                    demuxer.strip_trailer(pbody, subs) if subs else pbody
+                )
+                self.sm.prepare(types.Operation(operation), events)
+            req_hdrs[i] = head
+            timestamps[i] = self.sm.prepare_timestamp
+            contexts[i] = len(subs) if subs else 0
+            bodies.append(pbody)
+
+        op0 = self.op + 1
+        t0 = time.perf_counter_ns()
+        built = _fastpath.build_prepares(
+            self._np, req_hdrs, bodies, timestamps, contexts,
+            cluster=self.cluster, view=self.view, op0=op0,
+            commit=self.commit_min, parent=self.parent_checksum,
+            replica=self.replica, release=self.release, synced=False,
+            headers_ring=self.journal.headers,
+            slot_count=self.journal.slot_count,
+            headers_per_sector=HEADERS_PER_SECTOR,
+            sector_size=SECTOR_SIZE,
+        )
+        build_ns = time.perf_counter_ns() - t0
+        if built is None:
+            # Arena capacity refused (cannot happen with the exact
+            # allocation above — belt and braces): nothing was mutated,
+            # the per-item arm redoes the run.  sm.prepare already ran,
+            # and _primary_prepare re-runs it — sm.prepare is
+            # idempotent per (op, events) only at execute time, so
+            # instead re-enter via the loop WITHOUT re-prepare by
+            # failing hard: this is a programming error.
+            raise RuntimeError(
+                "native drain: prepare arena refused exact-sized run"
+            )
+        self._c_drain_native.inc()
+        prepares, frames = built
+        wal_arena, wal_off, wal_len, slots, sector_arena, sector_index = (
+            frames
+        )
+        per_prepare_us = build_ns / k / 1000.0
+        wal_mv = memoryview(wal_arena)
+        sector_mv = memoryview(sector_arena)
+        for i in range(k):
+            prepare = prepares[i]
+            op = op0 + i
+            self.anatomy.stage_h(prepare, "prepare")
+            off = int(wal_off[i])
+            length = int(wal_len[i])
+            self._journal_write_framed(
+                prepare, len(bodies[i]),
+                wal_mv[off:off + length], int(slots[i]),
+                sector_mv[i * SECTOR_SIZE:(i + 1) * SECTOR_SIZE],
+                int(sector_index[i]),
+            )
+            self.op = op
+            self.parent_checksum = wire.u128(prepare, "checksum")
+            self._vouched[op] = self.parent_checksum
+            self._repair_wanted.pop(op, None)
+            # C already registered the slot entry + our self-vote
+            # (tb_pl_build_prepares calls note_prepare per item): only
+            # the Python-side mirror is created here.
+            self.pipeline[op] = PipelineEntry(
+                prepare, bodies[i], {self.replica}, plan[i][2],
+                synced=False,
+            )
+            self._h_prepare_us.observe(per_prepare_us)
+            self._replicate(prepare, bodies[i])
+        self._maybe_commit_pipeline()
+
     def _replicate(self, prepare: np.ndarray, body: bytes) -> None:
         """Ring forwarding: send to successor only (reference:
         src/vsr/replica.zig:1532-1556).  The primary additionally
@@ -1195,6 +1554,15 @@ class VsrReplica(Replica):
         self._maybe_commit_pipeline()
 
     def _maybe_commit_pipeline(self) -> None:
+        # Native-drain ready-run cache: ONE C walk answers how many
+        # contiguous ops past commit_min are commit-ready, then each
+        # loop iteration decrements instead of re-walking.  The cache
+        # is keyed to the commit_min it was computed at — any foreign
+        # commit_min movement (recursive drains on non-gc clusters,
+        # _advance_commit) forces a re-walk, so staleness cannot
+        # commit an unready op.
+        ready_run = 0
+        ready_from = -1
         while self.pipeline:
             op = min(self.pipeline)
             if op <= self.commit_min:  # committed via _advance_commit
@@ -1208,7 +1576,15 @@ class VsrReplica(Replica):
                 # votes AND sync-covered AND contiguous (commit_min+1)
                 # answered by one C call over the slot table — the
                 # same three gates the Python arm below walks.
-                if not self._np.commit_ready(
+                if self._drain_native:
+                    if ready_from != self.commit_min:
+                        ready_run = self._np.commit_ready_run(
+                            self.commit_min, self.quorum_replication
+                        )
+                        ready_from = self.commit_min
+                    if ready_run <= 0:
+                        return
+                elif not self._np.commit_ready(
                     self.commit_min, self.quorum_replication
                 ):
                     return
@@ -1264,6 +1640,11 @@ class VsrReplica(Replica):
             del self.pipeline[op]
             if self._np is not None:
                 self._np.drop(op)
+            if ready_from >= 0:
+                # Our own commit advanced commit_min to `op`: keep the
+                # cached run valid without a re-walk.
+                ready_run -= 1
+                ready_from = op
             if self._checkpoint_due():
                 # Deterministic checkpoint point: commit_min crosses the
                 # interval boundary at the same op on every replica, so
@@ -1296,12 +1677,34 @@ class VsrReplica(Replica):
             if self.request_queue
             else None
         )
+        # Drain plan (r22): with group commit on, a new prepare CANNOT
+        # commit mid-drain (entries start unsynced until the covering
+        # flush), so the drain first COLLECTS the whole run and then
+        # materializes it in _primary_prepare_plan — one native call
+        # for the run, or the per-prepare loop on the TB_NATIVE_DRAIN=0
+        # arm (same seam, bit-identical frames).  Without group commit
+        # (sim clusters), prepares may commit inline per item, so the
+        # legacy immediate path stays untouched.
+        plan: list | None = [] if self._gc_enabled else None
+        pending = 0
         while self.request_queue and (
-            len(self.pipeline) < self.config.pipeline_prepare_queue_max
-            and self._prepare_headroom()
+            len(self.pipeline) + pending
+            < self.config.pipeline_prepare_queue_max
+            and self._prepare_headroom(pending)
         ):
             h, b = self._pop_request()
             cur_tenant = self._last_pop_tenant
+            if plan:
+                client = wire.u128(h, "client")
+                if client and client not in self.sessions:
+                    # The dedupe ladder scans the PIPELINE for this
+                    # client's pending register — flush so planned
+                    # prepares are visible to it exactly where the
+                    # per-item arm would already have them (rare:
+                    # only unregistered clients flush).
+                    self._primary_prepare_plan(plan)
+                    plan = []
+                    pending = 0
             # Queued requests re-run the at-most-once gate: their
             # duplicate may have committed (or become decidable) while
             # they waited.
@@ -1345,23 +1748,32 @@ class VsrReplica(Replica):
                     total += len(b2) + sub_size
             prepared = [(h, b)] + batch
             if batch:
-                self._primary_prepare_batch(prepared)
+                head, pbody, subs = self._build_batch_request(prepared)
             else:
-                self._primary_prepare(h, b)
+                head, pbody, subs = h, b, None
+            if plan is not None:
+                plan.append((head, pbody, subs))
+                pending += 1
+            elif subs is not None:
+                self._primary_prepare(head, pbody, subs=subs)
+            else:
+                self._primary_prepare(head, pbody)
             if inflight is not UNDECIDABLE and inflight is not None:
                 for ph, _pb in prepared:
                     c = wire.u128(ph, "client")
                     if c:
                         inflight.add((c, int(ph["request"])))
+        if plan:
+            self._primary_prepare_plan(plan)
         for rh, rb in requeue:
             self._enqueue_request(rh, rb, readmit=True)
 
-    def _primary_prepare_batch(
+    def _build_batch_request(
         self, requests: list[tuple[np.ndarray, bytes]]
-    ) -> None:
-        """One prepare multiplexing several client requests: the body
-        is events || trailer, the header's `context` carries the
-        sub-request count so every replica demuxes identically."""
+    ) -> tuple[np.ndarray, bytes, list]:
+        """Multiplex several client requests into one request frame:
+        the body is events || trailer, the header's `context` carries
+        the sub-request count so every replica demuxes identically."""
         subs = [
             (wire.u128(h, "client"), int(h["request"]),
              len(b) // demuxer.EVENT_SIZE)
@@ -1382,6 +1794,15 @@ class VsrReplica(Replica):
                 wire.copy_trace(head, rh)
                 break
         wire.finalize_header(head, body)
+        return head, body, subs
+
+    def _primary_prepare_batch(
+        self, requests: list[tuple[np.ndarray, bytes]]
+    ) -> None:
+        """One prepare multiplexing several client requests (the
+        immediate form; the drain plan uses _build_batch_request +
+        _primary_prepare_plan instead)."""
+        head, body, subs = self._build_batch_request(requests)
         self._primary_prepare(head, body, subs=subs)
 
     def _send_register_reply(self, client: int, entry: Session,
@@ -1466,6 +1887,31 @@ class VsrReplica(Replica):
                 self.storage.sync_wal
             )
 
+    def _journal_write_framed(
+        self, header: np.ndarray, body_len: int, wal_view, slot: int,
+        sector_view, sector_index: int,
+    ) -> None:
+        """_journal_write for a drain-plan prepare whose WAL frame the
+        native batch call already laid out (padded slot image + header
+        sector image): write the pre-framed views, skip Python-side
+        framing entirely.  Only reachable with group commit on, so
+        writes are always unsynced + covered like _journal_write's gc
+        branch — including the leading-edge sync kick on the first
+        write of the drain."""
+        self._stats["stat_prepares_written"].inc()
+        self.tracer.instant("prepare", op=int(header["op"]))
+        tid = wire.trace_sampled(header)
+        if tid:
+            self._gc_trace_ids.append(tid)
+        self.journal.write_prepare_framed(
+            header, body_len, wal_view, slot, sector_view, sector_index
+        )
+        if self._wal_sync_worker is not None and self._gc_sync_job is None:
+            self._gc_sync_cover = self.journal.unsynced_writes
+            self._gc_sync_job = self._wal_sync_worker.submit(
+                self.storage.sync_wal
+            )
+
     def _gc_defer(self) -> bool:
         """True while an ack sent NOW could precede its covering sync."""
         return self._gc_enabled and (
@@ -1524,11 +1970,31 @@ class VsrReplica(Replica):
             self.stat_gc_flushes += 1
         if self._gc_pending:
             pending, self._gc_pending = self._gc_pending, []
-            for kind, dst, header, body in pending:
-                if kind == "client":
-                    self.bus.send_client(dst, header, body)
-                else:
-                    self.bus.send(dst, header, body)
+            # Scatter-gather release (r22): a backup drain typically
+            # defers a whole run of prepare_oks to ONE destination (the
+            # primary) — batch those into a single vectored bus call
+            # when the transport supports it.  Mixed destinations or
+            # client replies keep the in-order per-frame loop.
+            send_frames = getattr(self.bus, "send_frames", None)
+            if (
+                self._drain_native
+                and send_frames is not None
+                and len(pending) > 1
+                and all(
+                    kind == "replica" and dst == pending[0][1]
+                    for kind, dst, _h, _b in pending
+                )
+            ):
+                send_frames(
+                    pending[0][1],
+                    [(header, body) for _k, _d, header, body in pending],
+                )
+            else:
+                for kind, dst, header, body in pending:
+                    if kind == "client":
+                        self.bus.send_client(dst, header, body)
+                    else:
+                        self.bus.send(dst, header, body)
         # The covering sync makes our self-votes count: commit any
         # pipeline entries that were waiting on it (their replies go
         # out directly — nothing is deferred any more).
