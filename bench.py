@@ -821,13 +821,32 @@ def run_replicated(n_events: int) -> dict:
             "before": [r.get("events_per_sec") for r in befores],
             "after": [r.get("events_per_sec") for r in afters],
         }
+    # Round 23 hash-once arm: the headline AFTER run already IS the
+    # reuse-on configuration (TB_HASH_REUSE defaults on) and carries
+    # the per-replica hash.* counters; one extra run pins reuse OFF so
+    # the rehash-at-build cost is a graded same-session delta rather
+    # than a cross-round comparison.
+    reuse_off = _run_replicated_once(
+        n_events, fastpath=True, native_pipeline=True,
+        native_drain=True, hash_reuse=False,
+    )
+    after["hash_reuse_off"] = {
+        k: reuse_off.get(k)
+        for k in (
+            "events_per_sec", "request_p50_ms", "request_p99_ms",
+            "request_p100_ms", "hash_reuse", "hash_engine",
+            "hash_threads", "per_replica_stats", "error",
+        )
+        if k in reuse_off
+    }
     return after
 
 
 def _run_replicated_once(n_events: int, group_commit: bool = True,
                          fastpath: bool = True,
                          native_pipeline: bool = True,
-                         native_drain: bool = True) -> dict:
+                         native_drain: bool = True,
+                         hash_reuse: bool = True) -> dict:
     """3-replica TCP cluster, real ReplicaServer processes, driven by
     CONCURRENT client sessions (VERDICT r4 #1b): each VSR session keeps
     one request in flight (request numbers are strictly increasing,
@@ -911,6 +930,9 @@ def _run_replicated_once(n_events: int, group_commit: bool = True,
         # per-item Python loop over the same batch seams, so the
         # differential isolates the one-call-per-drain batching.
         server_env["TB_NATIVE_DRAIN"] = "1" if native_drain else "0"
+        # Hash-once arm selector (round 23): 0 pins the rehash-at-
+        # build path so the reuse delta is a graded number.
+        server_env["TB_HASH_REUSE"] = "1" if hash_reuse else "0"
         # Core pinning rides the environment into each replica's
         # runner (applied below via affinity.apply in-process); the
         # per-subprocess plan is recorded so regrades self-describe.
@@ -1067,6 +1089,7 @@ def _run_replicated_once(n_events: int, group_commit: bool = True,
             "fastpath_decode": fastpath,
             "native_pipeline": native_pipeline,
             "native_drain": native_drain,
+            "hash_reuse": hash_reuse,
             "pinned_cores": pinned_cores,
             "per_replica_stats": per_replica_stats,
             **scrape_extra,
@@ -1131,6 +1154,21 @@ def _harvest_replica_stats(
                 "gc_flushes": int(snap.get("vsr.gc_flushes", 0)),
                 "commit_min": int(snap.get("vsr.commit_min", 0)),
                 "ckpt_async": int(snap.get("vsr.ckpt.async", 0)),
+                # Round 23 hash forensics, per role: the reuse ratio
+                # (bytes_hashed vs committed + dup) the TCP smoke
+                # asserts, rendered here per bench row.
+                "hash_bytes_hashed": int(
+                    snap.get("vsr.hash.bytes_hashed", 0)
+                ),
+                "hash_reuse_hits": int(
+                    snap.get("vsr.hash.reuse_hits", 0)
+                ),
+                "hash_committed_body_bytes": int(
+                    snap.get("vsr.hash.committed_body_bytes", 0)
+                ),
+                "hash_dup_body_bytes": int(
+                    snap.get("vsr.hash.dup_body_bytes", 0)
+                ),
             }
             sources[name] = "scrape"
             # Cross-check vs the log tail (same registry, two
@@ -1190,6 +1228,19 @@ def _harvest_replica_stats(
                 )
                 extra["fastpath_native_unavailable"] = int(
                     snap.get("fastpath.native_unavailable", 0)
+                )
+                # Round 23: which SHA-256 engine served this row (a
+                # scalar-fallback number must never grade as SHA-NI)
+                # and the lane configuration that produced it.
+                extra["hash_engine"] = {
+                    1: "evp", 2: "sha256-legacy", 3: "scalar",
+                }.get(int(snap.get("hash.engine_code", 0)), "hashlib")
+                extra["hash_scalar_fallback"] = int(
+                    snap.get("hash.scalar_fallback", 0)
+                )
+                extra["hash_threads"] = int(snap.get("hash.threads", 0))
+                extra["hash_lanes_busy"] = int(
+                    snap.get("hash.lanes_busy", 0)
                 )
                 # Per-prepare Python wall time on the VSR hot path
                 # (round 20): the spans the native pipeline replaces —
@@ -1256,6 +1307,95 @@ def _parse_tb_stats(log_path: str) -> dict | None:
         except ValueError:
             pass
     return out
+
+
+def run_hash_only() -> dict:
+    """SHA-256 engine x body-size x lane-count microbench grid (round
+    23): GB/s through the REAL counted ingress path
+    (tb_fp_verify_frames2 — the batch verify the server drain runs,
+    which also opens a digest-table crossing per call), not a bare
+    digest loop.  Every row records the engine that ACTUALLY served it
+    (hash_engine_name() after configure): forcing "evp" on a box
+    without libcrypto silently lands on "scalar", and a mislabeled
+    engine would turn an 8x regression into a fake win.  The grid is
+    the sizing evidence for TB_HASH_THREADS — lanes only pay above
+    the per-job handoff cost, so small bodies should show lanes <=
+    inline and 1MB bodies should show the fan-out."""
+    from tigerbeetle_tpu.runtime import fastpath
+    from tigerbeetle_tpu.vsr import wire
+
+    if not fastpath.available():
+        return {"error": "libtb_fastpath not built"}
+    if fastpath.verify_frames2(
+        np.zeros(256, np.uint8), np.zeros(1, np.uint64),
+        np.zeros(1, np.uint32), 0,
+    ) is None:
+        return {"error": "libtb_fastpath lacks r23 hash symbols"}
+    rng = np.random.default_rng(23)
+    sizes = (128, 4096, 65536, 1 << 20)
+    lanes_grid = (0, 2, 4)
+    engines = ((1, "evp"), (2, "sha256-legacy"), (3, "scalar"))
+    target = 24 << 20  # bytes hashed per timed rep
+    rows = []
+    try:
+        for size in sizes:
+            # One shared frame batch per size: k frames of `size`-byte
+            # bodies, enough to amortize per-call setup and give the
+            # lanes real fan-out (k >= 24 even at 1MB).
+            k = max(24, min(512, target // max(size, 1)))
+            frames = []
+            for j in range(k):
+                body = rng.bytes(size)
+                h = wire.make_header(
+                    command=wire.Command.prepare, cluster=23, op=j + 1,
+                )
+                wire.finalize_header(h, body)
+                frames.append(h.tobytes() + body)
+            blob = b"".join(frames)
+            arena = np.frombuffer(blob, np.uint8)
+            lens = np.array([len(f) for f in frames], np.uint32)
+            offsets = np.zeros(k, np.uint64)
+            np.cumsum(lens[:-1], out=offsets[1:])
+            body_bytes = int(lens.sum()) - 256 * k
+            for force, requested in engines:
+                for lanes in lanes_grid:
+                    assert fastpath.configure_hash(lanes, force)
+                    actual = fastpath.hash_engine_name()
+                    # Warm once (page-in + pool spin-up), then time
+                    # enough reps for >= ~0.05 s of work.
+                    ok, hashed = fastpath.verify_frames2(
+                        arena, offsets, lens, k
+                    )
+                    assert int(np.asarray(ok).sum()) == k
+                    assert hashed == body_bytes, (hashed, body_bytes)
+                    reps = max(1, (8 << 20) // max(body_bytes, 1))
+                    t0 = time.perf_counter()
+                    for _ in range(reps):
+                        fastpath.verify_frames2(arena, offsets, lens, k)
+                    dt = time.perf_counter() - t0
+                    rows.append({
+                        "engine_requested": requested,
+                        "engine": actual,
+                        "body_bytes": size,
+                        "lanes": lanes,
+                        "frames": k,
+                        "reps": reps,
+                        "gb_per_sec": round(
+                            body_bytes * reps / dt / 1e9, 3
+                        ),
+                    })
+    finally:
+        # Back to the validated env config + auto engine — the grid
+        # must not leak a forced scalar into later configs.
+        fastpath.configure_hash(None, 0)
+    stats = fastpath.hash_stats()
+    return {
+        "rows": rows,
+        "engine_auto": fastpath.hash_engine_name(),
+        "scalar_fallback": fastpath.hash_scalar_fallback(),
+        "lane_jobs_total": stats["lane_jobs"],
+        "host_cores": os.cpu_count(),
+    }
 
 
 def run_open_loop() -> dict:
@@ -4198,8 +4338,8 @@ def main() -> None:
     budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 5400))
     # memory configs + waves compare + device-waves compare + durable
     # + replicated + open-loop + sharded-cluster + qos-suite
-    # + read-scale + tiering
-    n_configs_left = [len(CONFIGS) + 8]
+    # + read-scale + tiering + hash microbench
+    n_configs_left = [len(CONFIGS) + 9]
 
     def next_timeout(cap_s: float) -> int | None:
         remaining = budget_s - (time.time() - t_run0)
@@ -4307,7 +4447,8 @@ def main() -> None:
                         ("sharded_cluster", "--sharded-cluster-only"),
                         ("qos_suite", "--qos-suite"),
                         ("read_scale", "--read-scale"),
-                        ("tiering", "--tiering-only")):
+                        ("tiering", "--tiering-only"),
+                        ("hash_only", "--hash-only")):
         t = next_timeout(per_config_cap)
         configs_out[cname] = (
             dict(_SKIP_ROW) if t is None
@@ -4601,6 +4742,10 @@ if __name__ == "__main__":
         # set vs all-resident oracle, hit rate + step-latency ratio
         # + bit-identical parity (round 20).
         print(json.dumps(_mark_device_fallback(run_tiering_compare())))
+    elif "--hash-only" in sys.argv:
+        # SHA-256 engine x size x lane GB/s grid through the counted
+        # ingress verify (round 23 hash-once commit path).
+        print(json.dumps(_mark_device_fallback(run_hash_only())))
     elif memory_only:
         print(json.dumps(_mark_device_fallback(run_memory_only(memory_only[0]))))
     else:

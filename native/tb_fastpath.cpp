@@ -30,6 +30,7 @@
 #include <unordered_set>
 #include <vector>
 
+#include "hash_pool.h"
 #include "sha256.h"
 
 typedef unsigned __int128 u128;
@@ -471,40 +472,74 @@ static constexpr uint32_t WIRE_OFF_SIZE = 144;
 static constexpr uint32_t WIRE_OFF_VERSION = 155;
 static constexpr uint8_t WIRE_VERSION = 1;
 
+// Verify one frame — exactly wire.verify_header(header, body).
+// Returns the count of BODY bytes hashed (0 when the frame fails a
+// structural check before the body pass) and, on a fully-verified
+// frame, records the body digest in the drain-scoped digest table so
+// the build seams can reuse it without rehashing.
+static uint64_t fp_verify_one(const uint8_t* frame, uint32_t len,
+                              uint8_t* ok) {
+    *ok = 0;
+    if (len < WIRE_HEADER_SIZE) return 0;
+    uint32_t size;
+    memcpy(&size, frame + WIRE_OFF_SIZE, 4);
+    if (size != len || size < WIRE_HEADER_SIZE) return 0;
+    if (frame[WIRE_OFF_VERSION] != WIRE_VERSION) return 0;
+    uint64_t cs[2];
+    tb::checksum128(frame + 16, WIRE_HEADER_SIZE - 16, cs);
+    if (memcmp(frame + WIRE_OFF_CHECKSUM, cs, 16) != 0) return 0;
+    uint64_t body_len = size - WIRE_HEADER_SIZE;
+    tb::checksum128(frame + WIRE_HEADER_SIZE, body_len, cs);
+    if (memcmp(frame + WIRE_OFF_CHECKSUM_BODY, cs, 16) != 0)
+        return body_len;
+    tb::digest_table().put(frame + WIRE_HEADER_SIZE, body_len, cs[0],
+                           cs[1]);
+    *ok = 1;
+    return body_len;
+}
+
 // One pass over a drain's frames packed in `arena`: per frame, verify
 // the header checksum (bytes [16, 256)), the version byte, the size
-// field against the framed length, and the body checksum — exactly
-// wire.verify_header(header, body).  ok[i] = 1 when frame i is valid.
+// field against the framed length, and the body checksum.  ok[i] = 1
+// when frame i is valid.  (r20 entry point, kept for old bindings;
+// the r23 drain path calls tb_fp_verify_frames2 below.)
 void tb_fp_verify_frames(const uint8_t* arena, const uint64_t* offsets,
                          const uint32_t* lens, uint32_t n, uint8_t* ok) {
-    for (uint32_t i = 0; i < n; i++) {
-        ok[i] = 0;
-        const uint8_t* frame = arena + offsets[i];
-        uint32_t len = lens[i];
-        if (len < WIRE_HEADER_SIZE) continue;
-        uint32_t size;
-        memcpy(&size, frame + WIRE_OFF_SIZE, 4);
-        if (size != len || size < WIRE_HEADER_SIZE) continue;
-        if (frame[WIRE_OFF_VERSION] != WIRE_VERSION) continue;
-        uint64_t cs[2];
-        tb::checksum128(frame + 16, WIRE_HEADER_SIZE - 16, cs);
-        if (memcmp(frame + WIRE_OFF_CHECKSUM, cs, 16) != 0) continue;
-        tb::checksum128(frame + WIRE_HEADER_SIZE, size - WIRE_HEADER_SIZE,
-                        cs);
-        if (memcmp(frame + WIRE_OFF_CHECKSUM_BODY, cs, 16) != 0) continue;
-        ok[i] = 1;
-    }
+    for (uint32_t i = 0; i < n; i++)
+        fp_verify_one(arena + offsets[i], lens[i], &ok[i]);
+}
+
+// r23 verify: same contract plus (a) a new digest-table crossing —
+// the previous drain's cached digests die here, this drain's verified
+// body digests are recorded for the build seams to reuse; (b) frames
+// fan out across the hash pool lanes (each lane verifies whole frames
+// — header hash, body hash, memcmps all off the drain thread); and
+// (c) the return value is the total BODY bytes this crossing hashed,
+// feeding the hash.bytes_hashed counter.
+uint64_t tb_fp_verify_frames2(const uint8_t* arena, const uint64_t* offsets,
+                              const uint32_t* lens, uint32_t n,
+                              uint8_t* ok) {
+    tb::digest_table().invalidate();
+    std::atomic<uint64_t> bytes{0};
+    tb::hash_parallel_for(n, [&](uint32_t i) {
+        uint64_t b = fp_verify_one(arena + offsets[i], lens[i], &ok[i]);
+        if (b) bytes.fetch_add(b, std::memory_order_relaxed);
+    });
+    return bytes.load(std::memory_order_relaxed);
 }
 
 // Batch reply finalize: `headers` is n contiguous 256-byte records
 // with every field but the checksums already set; bodies[i]/body_lens
 // [i] is reply i's body.  Sets size, checksum_body, checksum — one C
 // call replaces 2n hashlib calls + per-reply numpy churn (the "one
-// encode pass + scatter" half of the columnar ingest path).
+// encode pass + scatter" half of the columnar ingest path).  Replies
+// are independent of each other, so the per-reply finalize (body hash
+// + header hash) fans out across the hash pool — no signature change,
+// the r20 binding gets the lanes for free.
 void tb_fp_finalize_headers(uint8_t* headers, uint32_t n,
                             const uint8_t* const* bodies,
                             const uint32_t* body_lens) {
-    for (uint32_t i = 0; i < n; i++) {
+    tb::hash_parallel_for(n, [&](uint32_t i) {
         uint8_t* h = headers + uint64_t(i) * WIRE_HEADER_SIZE;
         uint32_t blen = body_lens[i];
         uint32_t size = WIRE_HEADER_SIZE + blen;
@@ -515,7 +550,36 @@ void tb_fp_finalize_headers(uint8_t* headers, uint32_t n,
         uint64_t cs[2];
         tb::checksum128(h + 16, WIRE_HEADER_SIZE - 16, cs);
         memcpy(h + WIRE_OFF_CHECKSUM, cs, 16);
-    }
+    });
+}
+
+// ---- r23: hash pool + engine control (envcheck-validated knobs are
+// read in Python and pushed down here; C never reads the env) ----
+
+// threads: worker lanes beside the calling thread (0 = inline, the
+// 1-core default); clamped to [0, HASH_THREADS_MAX].  force_engine:
+// 0 = auto-resolve, else a Sha256Engine value for the --hash-only
+// bench grid (forcing an unresolved tier degrades down, same as auto).
+void tb_hash_configure(int32_t threads, int32_t force_engine) {
+    if (threads < 0) threads = 0;
+    if (threads > tb::HASH_THREADS_MAX) threads = tb::HASH_THREADS_MAX;
+    tb::hash_threads_cfg().store(threads, std::memory_order_relaxed);
+    tb::sha256_force() = (int)force_engine;
+}
+
+// Which SHA-256 tier actually resolved (Sha256Engine: 1 = EVP one-shot
+// / SHA-NI dispatch, 2 = legacy SHA256(), 3 = the 225 MB/s scalar
+// core).  The Python side names these in bench rows and raises the
+// one-time scalar-fallback warning.
+int32_t tb_hash_engine(void) { return (int32_t)tb::sha256_engine(); }
+
+// out[0] = jobs executed on pool lanes (lanes_busy numerator);
+// out[1] = digest-table hits; out[2] = configured lane count.
+void tb_hash_stats(uint64_t out[3]) {
+    out[0] = tb::hash_lane_jobs().load(std::memory_order_relaxed);
+    out[1] = tb::hash_table_hits().load(std::memory_order_relaxed);
+    out[2] =
+        (uint64_t)tb::hash_threads_cfg().load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

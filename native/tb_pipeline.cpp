@@ -28,10 +28,12 @@
 // stale-.so tripwire: the Python loader refuses a library whose
 // version disagrees instead of AttributeError-ing mid-drain.
 
+#include <array>
 #include <cstdint>
 #include <cstring>
 #include <vector>
 
+#include "hash_pool.h"
 #include "sha256.h"
 
 namespace {
@@ -70,15 +72,36 @@ inline uint64_t pl_rd64(const uint8_t* p) {
     return v;
 }
 
-// Stamp size + checksum_body + checksum — wire.finalize_header.
-void pl_finalize(uint8_t* h, const uint8_t* body, uint64_t body_len) {
+// Stamp size + a KNOWN checksum_body + checksum: the header hash can
+// never be skipped (it covers fresh fields), but the body hash can
+// when an earlier stage already proved the digest — that split is the
+// whole r23 reuse seam.
+void pl_stamp(uint8_t* h, uint64_t body_len, const uint64_t cb[2]) {
     wr32(h + OFF_HDRSIZE, PL_HEADER_SIZE + (uint32_t)body_len);
-    uint64_t cb[2];
-    tb::checksum128(body, body_len, cb);
     memcpy(h + OFF_CHECKSUM_BODY, cb, 16);
     uint64_t cs[2];
     tb::checksum128(h + 16, PL_HEADER_SIZE - 16, cs);
     memcpy(h + OFF_CHECKSUM, cs, 16);
+}
+
+// Stamp size + checksum_body + checksum — wire.finalize_header.
+void pl_finalize(uint8_t* h, const uint8_t* body, uint64_t body_len) {
+    uint64_t cb[2];
+    tb::checksum128(body, body_len, cb);
+    pl_stamp(h, body_len, cb);
+}
+
+// Resolve a prepare body's digest without hashing, under the reuse
+// invariant: every body reaching a build seam arrived under a header
+// whose checksum_body was verified (ingress frames) or freshly
+// computed over these exact bytes (_build_batch_request's coalesce
+// finalize) — so req_hdr[OFF_CHECKSUM_BODY] IS SHA-256(body)[:16].
+// The drain-scoped digest table is consulted first (zero-copy bodies
+// still in the arena); the header carry covers everything else.
+void pl_reuse_digest(const uint8_t* req_hdr, const uint8_t* body,
+                     uint64_t body_len, uint64_t cb[2]) {
+    if (!tb::digest_table().get(body, body_len, cb))
+        memcpy(cb, req_hdr + OFF_CHECKSUM_BODY, 16);
 }
 
 // Journal append framing body, shared by the per-prepare entry point
@@ -132,7 +155,7 @@ extern "C" {
 // Bumped whenever any tb_pl_* signature or semantic changes; the
 // Python binding refuses to use a library reporting a different
 // version (stale prebuilt .so whose rebuild failed).
-uint32_t tb_pl_abi_version(void) { return 2; }
+uint32_t tb_pl_abi_version(void) { return 3; }
 
 Pipeline* tb_pl_create(void) { return new Pipeline(); }
 
@@ -150,12 +173,14 @@ uint32_t tb_pl_size(Pipeline* pl) {
 // operation / trace context are read from the triggering request's
 // header; everything else arrives as scalars.  `context` is the
 // logical-batch sub-request count (u128 low limb; high limb zero).
-void tb_pl_build_prepare(
-    const uint8_t* req_hdr, const uint8_t* body, uint64_t body_len,
-    uint64_t cluster_lo, uint64_t cluster_hi, uint32_t view, uint64_t op,
-    uint64_t commit, uint64_t timestamp, uint64_t parent_lo,
-    uint64_t parent_hi, uint32_t replica, uint64_t context,
-    uint32_t release, uint8_t* out) {
+// flags bit 0 (r23, TB_HASH_REUSE): take checksum_body from the
+// digest table / the request header instead of rehashing the body —
+// bit-identical by the reuse invariant (see pl_reuse_digest).
+static void pl_prepare_fields(
+    const uint8_t* req_hdr, uint64_t cluster_lo, uint64_t cluster_hi,
+    uint32_t view, uint64_t op, uint64_t commit, uint64_t timestamp,
+    uint64_t parent_lo, uint64_t parent_hi, uint32_t replica,
+    uint64_t context, uint32_t release, uint8_t* out) {
     memset(out, 0, PL_HEADER_SIZE);
     memcpy(out + OFF_CLIENT, req_hdr + OFF_CLIENT, 16);
     memcpy(out + OFF_REQUEST, req_hdr + OFF_REQUEST, 4);
@@ -174,7 +199,24 @@ void tb_pl_build_prepare(
     out[OFF_REPLICA] = (uint8_t)replica;
     out[OFF_COMMAND] = CMD_PREPARE;
     out[OFF_HDRVERSION] = PL_WIRE_VERSION;
-    pl_finalize(out, body, body_len);
+}
+
+void tb_pl_build_prepare(
+    const uint8_t* req_hdr, const uint8_t* body, uint64_t body_len,
+    uint64_t cluster_lo, uint64_t cluster_hi, uint32_t view, uint64_t op,
+    uint64_t commit, uint64_t timestamp, uint64_t parent_lo,
+    uint64_t parent_hi, uint32_t replica, uint64_t context,
+    uint32_t release, uint32_t flags, uint8_t* out) {
+    pl_prepare_fields(req_hdr, cluster_lo, cluster_hi, view, op, commit,
+                      timestamp, parent_lo, parent_hi, replica, context,
+                      release, out);
+    if (flags & 1u) {
+        uint64_t cb[2];
+        pl_reuse_digest(req_hdr, body, body_len, cb);
+        pl_stamp(out, body_len, cb);
+    } else {
+        pl_finalize(out, body, body_len);
+    }
 }
 
 // Build + finalize a prepare_ok header into out[256] — bit-identical
@@ -296,13 +338,18 @@ uint32_t tb_pl_votes(Pipeline* pl, uint64_t op) {
 //     sector out_sector_index[i].
 // Capacity is checked up front: on overflow returns -1 with NOTHING
 // mutated (the caller falls back to the per-item path).  Returns k.
+// flags bit 0 (r23): digest reuse — see tb_pl_build_prepare.  With
+// reuse OFF the body digests (the only order-independent hash work;
+// the header pass is strictly sequential through the parent chain)
+// are computed up front across the hash pool lanes.
 int64_t tb_pl_build_prepares(
     Pipeline* pl, const uint8_t* req_hdrs, const uint8_t* const* bodies,
     const uint64_t* body_lens, const uint64_t* timestamps,
     const uint64_t* contexts, uint64_t k, uint64_t cluster_lo,
     uint64_t cluster_hi, uint32_t view, uint64_t op0, uint64_t commit,
     uint64_t parent_lo, uint64_t parent_hi, uint32_t replica,
-    uint32_t release, int synced, uint8_t* out_hdrs, uint8_t* headers_ring,
+    uint32_t release, int synced, uint32_t flags, uint8_t* out_hdrs,
+    uint8_t* headers_ring,
     uint64_t slot_count, uint32_t headers_per_sector, uint32_t sector_size,
     uint8_t* wal_arena, uint64_t wal_cap, uint64_t* out_wal_off,
     uint64_t* out_wal_len, uint64_t* out_slot, uint8_t* sector_arena,
@@ -313,15 +360,25 @@ int64_t tb_pl_build_prepares(
         need += (msg + sector_size - 1) / sector_size * sector_size;
     }
     if (need > wal_cap) return -1;
+    std::vector<std::array<uint64_t, 2>> cbs(k);
+    if (flags & 1u) {
+        for (uint64_t i = 0; i < k; i++)
+            pl_reuse_digest(req_hdrs + i * PL_HEADER_SIZE, bodies[i],
+                            body_lens[i], cbs[i].data());
+    } else {
+        tb::hash_parallel_for((uint32_t)k, [&](uint32_t i) {
+            tb::checksum128(bodies[i], body_lens[i], cbs[i].data());
+        });
+    }
     uint64_t wal_at = 0;
     uint64_t plo = parent_lo;
     uint64_t phi = parent_hi;
     for (uint64_t i = 0; i < k; i++) {
         uint8_t* out = out_hdrs + i * PL_HEADER_SIZE;
-        tb_pl_build_prepare(req_hdrs + i * PL_HEADER_SIZE, bodies[i],
-                            body_lens[i], cluster_lo, cluster_hi, view,
-                            op0 + i, commit, timestamps[i], plo, phi,
-                            replica, contexts[i], release, out);
+        pl_prepare_fields(req_hdrs + i * PL_HEADER_SIZE, cluster_lo,
+                          cluster_hi, view, op0 + i, commit, timestamps[i],
+                          plo, phi, replica, contexts[i], release, out);
+        pl_stamp(out, body_lens[i], cbs[i].data());
         plo = pl_rd64(out + OFF_CHECKSUM);
         phi = pl_rd64(out + OFF_CHECKSUM + 8);
         tb_pl_note_prepare(pl, out, synced, replica);

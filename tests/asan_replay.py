@@ -498,6 +498,102 @@ def check_oversize_frames() -> None:
     print("asan-replay: oversize-frame fuzz ok")
 
 
+def check_hash_pool(seed: int = 2323, rounds: int = 4) -> None:
+    """Round-23 multi-lane hash pool + drain-scoped digest table under
+    the sanitizer: counted batch verifies (tb_fp_verify_frames2)
+    fanned across worker lanes over the fixture stream laced with
+    corrupt mutations, a torn-body frame, and a message_size_max (1MB)
+    body; lane counts resized mid-stream (0 -> 2 -> 5 -> 1 -> 0, the
+    respawn/join path); then reuse-flagged batch builds racing three
+    threads of concurrent verify crossings that each invalidate and
+    repopulate the SHARED digest table — results must stay
+    bit-identical to the inline no-reuse arm while asan watches the
+    pool threads and table slots."""
+    import threading
+
+    from tigerbeetle_tpu.vsr.journal import HEADERS_PER_SECTOR
+
+    assert fastpath.drain_available(), (
+        f"sanitized fastpath lacks drain symbols: {fastpath.drain_error()}"
+    )
+    rng = np.random.default_rng(seed)
+    frames = mutations(fixture_frames())
+    big_body = rng.bytes(1 << 20)
+    h = wire.make_header(command=wire.Command.prepare, cluster=1, op=1)
+    wire.finalize_header(h, big_body)
+    frames.append(h.tobytes() + big_body)
+    body_frame = next(f for f in frames if len(f) > HEADER_SIZE)
+    frames.append(body_frame[:-7])  # torn body: structural fail, 0 hashed
+    arena, offsets, lens = arena_of(frames)
+    try:
+        expect = None
+        for lanes in (0, 2, 5, 1, 0):
+            assert fastpath.configure_hash(lanes)
+            got = fastpath.verify_frames2(arena, offsets, lens, len(frames))
+            assert got is not None, "sanitized fastpath lacks verify2"
+            ok, bytes_hashed = got
+            this = ([int(v) for v in ok], bytes_hashed)
+            if expect is None:
+                expect = this
+            assert this == expect, f"lane differential at {lanes} lanes"
+        # Epoch races: concurrent crossings invalidate + repopulate the
+        # shared table while reuse-flagged builds consume digests.
+        assert fastpath.configure_hash(3)
+        stop = threading.Event()
+
+        def hammer():
+            a, o, ln = arena_of(frames)
+            while not stop.is_set():
+                fastpath.verify_frames2(a, o, ln, len(frames))
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for _round in range(rounds):
+                k = 5
+                reqs = np.zeros(k, wire.HEADER_DTYPE)
+                bodies = []
+                for j in range(k):
+                    body = (
+                        big_body if j == 0
+                        else rng.bytes(int(rng.integers(0, 8192)))
+                    )
+                    req = wire.make_header(
+                        command=wire.Command.request, operation=3,
+                        cluster=9, client=j + 1, request=j,
+                    )
+                    wire.finalize_header(req, body)
+                    reqs[j] = req
+                    bodies.append(body)
+                timestamps = np.arange(1, k + 1, dtype=np.uint64)
+                contexts = np.zeros(k, np.uint64)
+                outs = []
+                for reuse in (False, True):
+                    ring = np.zeros(32, wire.HEADER_DTYPE)
+                    built = fastpath.build_prepares(
+                        fastpath.create_pipeline(), reqs, bodies,
+                        timestamps, contexts, cluster=9, view=1, op0=1,
+                        commit=0, parent=1, replica=0, release=1,
+                        synced=True, headers_ring=ring, slot_count=32,
+                        headers_per_sector=HEADERS_PER_SECTOR,
+                        sector_size=4096, reuse=reuse,
+                    )
+                    assert built is not None
+                    prepares, (wal, *_rest) = built
+                    outs.append((prepares.tobytes(), wal.tobytes()))
+                assert outs[0] == outs[1], "reuse differential under races"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+    finally:
+        assert fastpath.configure_hash(0)
+    assert fastpath.hash_stats()["lane_jobs"] > 0, "pool lanes never ran"
+    print(f"asan-replay: hash pool + digest table fuzz ok "
+          f"({rounds} racing rounds)")
+
+
 def main() -> int:
     assert native_available(), "sanitized native runtime failed to load"
     assert fastpath.available(), "sanitized fastpath failed to load"
@@ -506,6 +602,7 @@ def main() -> int:
     check_torn_frames()
     check_pipeline_fuzz()
     check_drain_fuzz()
+    check_hash_pool()
     check_sendv_torn()
     check_oversize_frames()
     print("ASAN-REPLAY-OK")

@@ -734,6 +734,43 @@ def test_tb_native_drain_validated(monkeypatch):
     assert envcheck.native_drain() == 1  # default on
 
 
+def test_tb_hash_reuse_validated(monkeypatch):
+    monkeypatch.setenv("TB_HASH_REUSE", "yes")
+    with pytest.raises(envcheck.EnvVarError, match="TB_HASH_REUSE"):
+        envcheck.hash_reuse()
+    monkeypatch.setenv("TB_HASH_REUSE", "2")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 1"):
+        envcheck.hash_reuse()
+    monkeypatch.setenv("TB_HASH_REUSE", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.hash_reuse()
+    monkeypatch.setenv("TB_HASH_REUSE", "0")
+    assert envcheck.hash_reuse() == 0
+    monkeypatch.delenv("TB_HASH_REUSE")
+    assert envcheck.hash_reuse() == 1  # default on
+
+
+def test_tb_hash_threads_validated(monkeypatch):
+    monkeypatch.setenv("TB_HASH_THREADS", "many")
+    with pytest.raises(envcheck.EnvVarError, match="TB_HASH_THREADS"):
+        envcheck.hash_threads()
+    monkeypatch.setenv("TB_HASH_THREADS", "-1")
+    with pytest.raises(envcheck.EnvVarError, match="must be >= 0"):
+        envcheck.hash_threads()
+    # The named constraint: lanes are capped at 16 — more than any
+    # target box's cores only adds submit-path contention.
+    monkeypatch.setenv("TB_HASH_THREADS", "17")
+    with pytest.raises(envcheck.EnvVarError, match="must be <= 16"):
+        envcheck.hash_threads()
+    monkeypatch.setenv("TB_HASH_THREADS", "16")
+    assert envcheck.hash_threads() == 16  # boundary accepted
+    # Explicit 0 = inline hashing (no lanes), same as the default.
+    monkeypatch.setenv("TB_HASH_THREADS", "0")
+    assert envcheck.hash_threads() == 0
+    monkeypatch.delenv("TB_HASH_THREADS")
+    assert envcheck.hash_threads() == 0
+
+
 def test_tb_native_drain_explicit_on_fails_fast_on_stale_so(monkeypatch):
     """TB_NATIVE_DRAIN=1 set EXPLICITLY against a loaded-but-stale
     library is a hard RuntimeError naming the rebuild (`make -C

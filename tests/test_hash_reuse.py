@@ -1,0 +1,404 @@
+"""Hash-once commit path (round 23): digest reuse + multi-lane SHA.
+
+The contract under test: with TB_HASH_REUSE=1 (default) every prepare
+body byte is SHA-256'd at most ONCE per replica role, and with
+TB_HASH_THREADS=N the remaining passes fan across native lanes — and
+neither knob may move a single wire bit.  Evidence tiers mirror
+tests/test_native_drain.py:
+
+- Unit differential: tb_pl_build_prepare / tb_pl_build_prepares with
+  the reuse flag on vs off produce bit-identical headers, WAL arenas,
+  redundant sectors, and slot tables (the reused digest comes from the
+  verified request header's checksum_body — the header-carry
+  invariant — or the drain-scoped C digest table).
+- Wire differential: finalize_header's cached-digest seam (the
+  TB_NATIVE_PIPELINE=0 arm) is bit-identical to the hashing path, and
+  a WRONG cached digest fails closed (every verifier rejects).
+- Cluster differential: the SAME deterministic BatchCluster script
+  (including coalesced prepares and a retransmitted duplicate mid
+  drain) runs across {reuse on/off} x {lanes 0/2} x {native/Python
+  pipeline} and every consensus + reply frame must be bit-identical.
+- Counters: reuse-on consumes cached digests (reuse_hits > 0) and the
+  off arm provably rehashes more (bytes_hashed strictly higher on the
+  primary); the multi-lane arm reports lane jobs via tb_hash_stats.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import constants as cfg
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import SECTOR_SIZE
+from tigerbeetle_tpu.runtime import fastpath
+from tigerbeetle_tpu.vsr import storage as storage_mod
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.journal import HEADERS_PER_SECTOR
+from tigerbeetle_tpu.vsr.wire import Command, HEADER_DTYPE
+from tigerbeetle_tpu.testing.harness import pack, transfer
+
+from test_multi import _register, _setup_accounts  # noqa: F401
+from test_native_pipeline import (  # noqa: F401
+    _capture_frames,
+    _fuzz_request,
+    _r128,
+)
+from test_native_drain import BatchCluster  # noqa: F401
+
+
+# ----------------------------------------------------------------------
+# Wire differential: the Python-fallback reuse seam.
+
+
+def test_finalize_header_cached_digest_bit_identical_fuzz():
+    rng = np.random.default_rng(23_01)
+    for _ in range(100):
+        body = rng.bytes(int(rng.integers(0, 2048)))
+        a, _ = _fuzz_request(rng)
+        b = a.copy()
+        wire.finalize_header(a, body)
+        wire.finalize_header(b, body, checksum_body=wire.checksum_pair(body))
+        assert a.tobytes() == b.tobytes()
+
+
+def test_finalize_header_wrong_cached_digest_fails_closed():
+    """A bogus cached pair must yield a frame every verifier REJECTS —
+    reuse can produce garbage frames only if the caller breaks the
+    header-carry invariant, and even then nothing silently commits."""
+    h, body = _fuzz_request(np.random.default_rng(23_02))
+    body = body or b"x"
+    wire.finalize_header(h, body, checksum_body=(123, 456))
+    assert not wire.verify_header(h, body)
+    # The header itself is self-consistent (checksum covers the bogus
+    # checksum_body), so the failure is pinned to the BODY check.
+    assert wire.verify_header(h)
+
+
+# ----------------------------------------------------------------------
+# Unit differential: the native build seams, reuse flag on vs off.
+
+needs_pipeline = pytest.mark.skipif(
+    not fastpath.pipeline_available(),
+    reason="libtb_fastpath pipeline symbols not built",
+)
+
+needs_drain = pytest.mark.skipif(
+    not fastpath.drain_available(),
+    reason="libtb_fastpath r22 drain symbols not built",
+)
+
+
+@needs_pipeline
+def test_build_prepare_reuse_bit_identical_fuzz():
+    rng = np.random.default_rng(23_03)
+    pl = fastpath.create_pipeline()
+    for _ in range(150):
+        req, body = _fuzz_request(rng)
+        kw = dict(
+            cluster=_r128(rng) >> 1,
+            view=int(rng.integers(0, 1 << 31)),
+            op=int(rng.integers(1, 1 << 32)),
+            commit=int(rng.integers(0, 1 << 32)),
+            timestamp=int(rng.integers(1, 1 << 62)),
+            parent=_r128(rng) >> 1,
+            replica=int(rng.integers(0, 6)),
+            context=int(rng.integers(0, 64)),
+            release=int(rng.integers(0, 1 << 31)),
+        )
+        hashed = pl.build_prepare(req, body, **kw)
+        reused = pl.build_prepare(req, body, reuse=True, **kw)
+        assert hashed.tobytes() == reused.tobytes()
+
+
+@needs_drain
+def test_build_prepares_reuse_bit_identical_fuzz():
+    """The batch seam: reuse on vs off over whole fuzzed runs — every
+    output surface compared (headers, WAL arena, redundant sectors,
+    headers ring)."""
+    from test_native_drain import _fuzz_requests
+
+    rng = np.random.default_rng(23_04)
+    slot_count = 64
+    for _ in range(30):
+        k = int(rng.integers(1, 9))
+        req_hdrs, bodies = _fuzz_requests(rng, k)
+        timestamps = rng.integers(1, 1 << 62, k, dtype=np.uint64)
+        contexts = rng.integers(0, 64, k, dtype=np.uint64)
+        kw = dict(
+            cluster=_r128(rng) >> 1,
+            view=int(rng.integers(0, 1 << 30)),
+            op0=int(rng.integers(1, 1 << 32)),
+            commit=int(rng.integers(0, 1 << 32)),
+            parent=_r128(rng) >> 1,
+            replica=int(rng.integers(0, 6)),
+            release=int(rng.integers(0, 1 << 31)),
+        )
+        outs = []
+        for reuse in (False, True):
+            ring = np.zeros(slot_count, HEADER_DTYPE)
+            built = fastpath.build_prepares(
+                fastpath.create_pipeline(), req_hdrs, bodies, timestamps,
+                contexts, synced=False, headers_ring=ring,
+                slot_count=slot_count,
+                headers_per_sector=HEADERS_PER_SECTOR,
+                sector_size=SECTOR_SIZE, reuse=reuse, **kw,
+            )
+            assert built is not None
+            prepares, (wal, wal_off, wal_len, slots, sectors, _si) = built
+            outs.append((
+                prepares.tobytes(), wal.tobytes(), wal_off.tobytes(),
+                wal_len.tobytes(), slots.tobytes(), sectors.tobytes(),
+                ring.tobytes(),
+            ))
+        assert outs[0] == outs[1]
+
+
+@needs_drain
+def test_build_prepares_multilane_bit_identical_fuzz():
+    """Lane-count differential at the same seam: 0 lanes (inline) vs 3
+    lanes, both reuse arms — the pool only changes WHO hashes, never
+    what lands in a frame."""
+    from test_native_drain import _fuzz_requests
+
+    rng = np.random.default_rng(23_05)
+    slot_count = 64
+    try:
+        for _ in range(10):
+            k = int(rng.integers(2, 9))
+            req_hdrs, bodies = _fuzz_requests(rng, k)
+            timestamps = rng.integers(1, 1 << 62, k, dtype=np.uint64)
+            contexts = rng.integers(0, 64, k, dtype=np.uint64)
+            kw = dict(
+                cluster=_r128(rng) >> 1, view=3,
+                op0=int(rng.integers(1, 1 << 32)),
+                commit=int(rng.integers(0, 1 << 32)),
+                parent=_r128(rng) >> 1, replica=0, release=1,
+            )
+            outs = []
+            for lanes in (0, 3):
+                assert fastpath.configure_hash(lanes)
+                ring = np.zeros(slot_count, HEADER_DTYPE)
+                built = fastpath.build_prepares(
+                    fastpath.create_pipeline(), req_hdrs, bodies,
+                    timestamps, contexts, synced=False, headers_ring=ring,
+                    slot_count=slot_count,
+                    headers_per_sector=HEADERS_PER_SECTOR,
+                    sector_size=SECTOR_SIZE, reuse=False, **kw,
+                )
+                assert built is not None
+                prepares, (wal, *_rest) = built
+                outs.append((prepares.tobytes(), wal.tobytes()))
+            assert outs[0] == outs[1]
+        # The 3-lane arm really ran jobs on worker threads.
+        assert fastpath.hash_stats()["lane_jobs"] > 0
+    finally:
+        assert fastpath.configure_hash(0)
+
+
+@needs_drain
+def test_verify_frames2_counts_and_populates_digest_table():
+    """The counted verify: returns exactly the body bytes hashed, and
+    its digest-table entries serve the SAME crossing's build (table
+    hits observable via tb_hash_stats)."""
+    rng = np.random.default_rng(23_06)
+    frames = []
+    total_body = 0
+    for _ in range(8):
+        req, body = _fuzz_request(rng)
+        frames.append(req.tobytes() + body)
+        total_body += len(body)
+    arena = np.frombuffer(b"".join(frames), np.uint8)
+    offsets = np.zeros(len(frames), np.uint64)
+    lens = np.zeros(len(frames), np.uint64)
+    at = 0
+    for i, f in enumerate(frames):
+        offsets[i] = at
+        lens[i] = len(f)
+        at += len(f)
+    got = fastpath.verify_frames2(arena, offsets, lens, len(frames))
+    assert got is not None
+    ok, bytes_hashed = got
+    assert list(ok) == [1] * len(frames)
+    assert bytes_hashed == total_body
+
+
+# ----------------------------------------------------------------------
+# Cluster differential: one deterministic script (unit + coalesced
+# traffic), every knob combination, frames bit-identical.
+
+
+def _hash_run(monkeypatch, *, reuse: str, threads: int, pipeline: str,
+              drain: str, seed: int = 23):
+    monkeypatch.setenv("TB_NATIVE_PIPELINE", pipeline)
+    monkeypatch.setenv("TB_NATIVE_DRAIN", drain)
+    monkeypatch.setenv("TB_HASH_REUSE", reuse)
+    monkeypatch.setattr(time, "perf_counter_ns", lambda: 1_000_000_000)
+    monkeypatch.setattr(
+        storage_mod.MemoryStorage, "supports_deferred_sync", True,
+        raising=False,
+    )
+    assert fastpath.configure_hash(threads)
+    try:
+        c = BatchCluster(3, seed=seed)
+        for r in c.replicas:
+            assert r._hash_reuse == (reuse == "1")
+        frames = _capture_frames(c)
+        cl = _register(c, 100)
+        _setup_accounts(c, cl, ids=(1, 2, 3))
+        # Unit traffic: request -> one prepare, digest reused from the
+        # verified request header.
+        for k in range(8):
+            reply = c.run_request(
+                cl, types.Operation.create_transfers,
+                pack([transfer(500 + k, debit_account_id=1 + (k % 2),
+                               credit_account_id=3, amount=1 + k)]),
+            )
+            assert reply == b""
+        # Coalesced traffic: several clients' requests queued in one
+        # drain multiplex into batched prepares (_build_batch_request
+        # concatenates bodies -> the one legitimate extra hash pass).
+        others = [_register(c, 200 + j) for j in range(3)]
+        for j, o in enumerate(others):
+            o.request(
+                types.Operation.create_transfers,
+                pack([transfer(800 + j, debit_account_id=1,
+                               credit_account_id=2, amount=1)]),
+            )
+        c.run_until(lambda: not any(o.busy() for o in others), 4000)
+        out = c.run_request(
+            cl, types.Operation.lookup_accounts,
+            np.array([1, 0, 2, 0, 3, 0], "<u8").tobytes(),
+        )
+        c.settle(4000)
+        c.check_linearized()
+        c.check_convergence()
+        primary = c.replicas[0]
+        counters = {
+            "reuse_hits": sum(r._c_hash_reuse.value for r in c.replicas),
+            "primary_bytes": primary._c_hash_bytes.value,
+            "primary_committed": primary._c_hash_commit.value,
+        }
+        coalesced = any(
+            f[0] == "peer" and int(
+                np.frombuffer(f[3], HEADER_DTYPE)[0]["context_lo"]
+            ) > 0
+            for f in frames
+            if int(np.frombuffer(f[3], HEADER_DTYPE)[0]["command"])
+            == int(Command.prepare)
+        )
+        return frames, out, counters, coalesced
+    finally:
+        assert fastpath.configure_hash(0)
+
+
+def _assert_same_frames(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x == y
+
+
+@needs_drain
+def test_cluster_frames_bit_identical_reuse_on_off(monkeypatch):
+    on = _hash_run(monkeypatch, reuse="1", threads=0, pipeline="1",
+                   drain="1")
+    off = _hash_run(monkeypatch, reuse="0", threads=0, pipeline="1",
+                    drain="1")
+    _assert_same_frames(on[0], off[0])
+    assert on[1] == off[1]
+    # The script really exercised the coalesce seam in both arms.
+    assert on[3] and off[3]
+    # Reuse-on consumed cached digests; reuse-off rehashed every body
+    # at build — strictly more hashing for the same frames.
+    assert on[2]["reuse_hits"] > 0
+    assert off[2]["reuse_hits"] == 0
+    assert off[2]["primary_bytes"] > on[2]["primary_bytes"]
+
+
+@needs_drain
+def test_cluster_frames_bit_identical_across_lanes(monkeypatch):
+    lanes0 = _hash_run(monkeypatch, reuse="1", threads=0, pipeline="1",
+                       drain="1")
+    lanes2 = _hash_run(monkeypatch, reuse="1", threads=2, pipeline="1",
+                       drain="1")
+    _assert_same_frames(lanes0[0], lanes2[0])
+    assert lanes0[1] == lanes2[1]
+
+
+def test_cluster_frames_bit_identical_python_fallback(monkeypatch):
+    """TB_NATIVE_PIPELINE=0: the pure-Python prepare build arm, where
+    reuse rides wire.finalize_header's cached-digest parameter — the
+    same frames as hashing, and the same frames as the native arm runs
+    (pinned separately above with the same seed/script)."""
+    on = _hash_run(monkeypatch, reuse="1", threads=0, pipeline="0",
+                   drain="0")
+    off = _hash_run(monkeypatch, reuse="0", threads=0, pipeline="0",
+                    drain="0")
+    _assert_same_frames(on[0], off[0])
+    assert on[1] == off[1]
+    assert on[2]["reuse_hits"] > 0
+    assert off[2]["primary_bytes"] > on[2]["primary_bytes"]
+
+
+@needs_drain
+def test_cluster_frames_native_vs_python_with_reuse(monkeypatch):
+    """Cross-arm: native drain + reuse vs pure Python + reuse — the
+    reuse seams live in different layers (C digest table/header-carry
+    vs finalize_header parameter) and must still agree bit for bit."""
+    native = _hash_run(monkeypatch, reuse="1", threads=2, pipeline="1",
+                       drain="1")
+    python = _hash_run(monkeypatch, reuse="1", threads=0, pipeline="0",
+                       drain="0")
+    _assert_same_frames(native[0], python[0])
+    assert native[1] == python[1]
+
+
+@needs_drain
+def test_retransmitted_duplicate_mid_drain_reuse_differential(monkeypatch):
+    """A retransmitted duplicate prepare spliced into a backup's drain
+    run (the test_native_drain prefix-split shape) with reuse on vs
+    off: the duplicate re-walks the per-item arm whose header was
+    already stamped — no rehash decision can corrupt it, and the two
+    arms' frames stay bit-identical."""
+
+    def run(reuse):
+        monkeypatch.setenv("TB_NATIVE_PIPELINE", "1")
+        monkeypatch.setenv("TB_NATIVE_DRAIN", "1")
+        monkeypatch.setenv("TB_HASH_REUSE", reuse)
+        monkeypatch.setattr(
+            time, "perf_counter_ns", lambda: 1_000_000_000
+        )
+        monkeypatch.setattr(
+            storage_mod.MemoryStorage, "supports_deferred_sync", True,
+            raising=False,
+        )
+        c = BatchCluster(3, seed=77)
+        frames = _capture_frames(c)
+        backup = next(r for r in c.replicas if not r.is_primary)
+        orig = backup.on_prepares_batch
+        injected = {"n": 0}
+
+        def wrapped(headers, bodies):
+            if headers and backup.status == "normal":
+                headers = list(headers) + [headers[0].copy()]
+                bodies = [bytes(b) for b in bodies] + [bytes(bodies[0])]
+                injected["n"] += 1
+            orig(headers, bodies)
+
+        backup.on_prepares_batch = wrapped
+        cl = _register(c, 100)
+        _setup_accounts(c, cl, ids=(1, 2))
+        for k in range(6):
+            reply = c.run_request(
+                cl, types.Operation.create_transfers,
+                pack([transfer(700 + k, debit_account_id=1,
+                               credit_account_id=2, amount=1)]),
+            )
+            assert reply == b""
+        c.settle(4000)
+        c.check_linearized()
+        c.check_convergence()
+        assert injected["n"] > 0
+        return frames
+
+    _assert_same_frames(run("1"), run("0"))

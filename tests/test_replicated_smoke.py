@@ -134,6 +134,69 @@ def test_two_replica_native_drain_smoke(tmp_path, monkeypatch):
         assert s["vsr.drain.native_calls"] == 0
 
 
+def test_two_replica_hash_reuse_smoke(tmp_path, monkeypatch):
+    """Hash-once arm (round 23): the same cluster smoke with
+    drain-scoped digest reuse ON vs OFF, pinned to ONE client session
+    so every prepare is a unit request — the coalesce finalize is a
+    legitimate extra pass over freshly concatenated bytes and would
+    muddy the per-byte ratio this test exists to pin.  Reply bodies
+    identical across arms; per role the reuse-on arm SHA-256s each
+    committed body byte at most once (bytes_hashed <=
+    committed_body_bytes), the reuse-off primary strictly more for
+    the same stream (the build rehash comes back), and only the
+    primary's build seams ever consume cached digests."""
+    monkeypatch.setenv("BENCH_REPL_SESSIONS", "1")
+    monkeypatch.setenv("TB_HASH_REUSE", "1")
+    drain_scrapes.clear()
+    replies_on = _run_cluster_once(tmp_path / "hr_on", "1", monkeypatch)
+    on_snaps = list(drain_scrapes)
+    monkeypatch.setenv("TB_HASH_REUSE", "0")
+    drain_scrapes.clear()
+    replies_off = _run_cluster_once(tmp_path / "hr_off", "1", monkeypatch)
+    off_snaps = list(drain_scrapes)
+    assert replies_on == replies_off
+    # The counters and the engine forensics reach the scrape on every
+    # role in both arms (vsr.* graft for the replica counters, bare
+    # names for the server-level engine gauges).
+    for s in on_snaps + off_snaps:
+        assert s["vsr.hash.committed_body_bytes"] > 0
+        assert s["hash.engine_code"] in (1, 2, 3)
+        assert s["hash.threads"] >= 0
+        assert "server.verify_body_bytes" in s
+        assert "hash.scalar_fallback" in s
+    # Tentpole contract, numerically: with reuse ON no role spends
+    # more than ONE SHA-256 pass per committed body byte.  A
+    # retransmitted frame must be verified before it can be
+    # recognized as a duplicate — that pass is unavoidable in any
+    # design and lands in hash.dup_body_bytes, so the bound is exact,
+    # not fuzzed with slack.
+    for s in on_snaps:
+        assert (
+            s["vsr.hash.bytes_hashed"]
+            <= s["vsr.hash.committed_body_bytes"]
+            + s["vsr.hash.dup_body_bytes"]
+        ), s
+    primary_on, primary_off = on_snaps[0], off_snaps[0]
+    assert primary_on["vsr.hash.reuse_hits"] > 0
+    # ... and turning the knob OFF brings the build rehash back: the
+    # primary hashes the same committed stream strictly more than
+    # once per byte (net of duplicate deliveries), and strictly more
+    # than the reuse-on arm did.
+    assert primary_off["vsr.hash.reuse_hits"] == 0
+    off_net = (
+        primary_off["vsr.hash.bytes_hashed"]
+        - primary_off["vsr.hash.dup_body_bytes"]
+    )
+    on_net = (
+        primary_on["vsr.hash.bytes_hashed"]
+        - primary_on["vsr.hash.dup_body_bytes"]
+    )
+    assert off_net > primary_off["vsr.hash.committed_body_bytes"], (
+        primary_off
+    )
+    assert off_net > on_net
+
+
 # Scrape snapshots stashed by _run_cluster_once for arm-level
 # assertions that need both runs (the drain smoke above).
 drain_scrapes: list = []
@@ -242,6 +305,31 @@ def _run_cluster_once(tmp_path, fastpath_flag, monkeypatch):
         # piggybacked commit numbers/heartbeats within a tick or two).
         assert primary.commit_min >= backup.commit_min >= 0
 
+        # Proof-of-state query (state_machine/commitment.py): both
+        # replicas answer the sessionless `state_root` op with the
+        # SAME nonzero 16-byte root once converged — the wire-level
+        # rendering of the hash-log convergence claim.  Run BEFORE the
+        # scrape so the stashed snapshots are quiescent on both roles
+        # (the backup has committed the full tail; the r23 hash-ratio
+        # smoke compares bytes_hashed against committed_body_bytes and
+        # a mid-catch-up backup would under-count the denominator).
+        from tigerbeetle_tpu.obs.scrape import scrape_state_root
+
+        roots = {}
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            roots = {
+                i: scrape_state_root(addresses[i], CLUSTER,
+                                     timeout_ms=20_000)
+                for i in range(len(servers))
+            }
+            if len({cm for _root, cm in roots.values()}) == 1:
+                break
+            time.sleep(0.2)  # backup still applying the tail
+        assert len({root for root, _cm in roots.values()}) == 1, roots
+        assert roots[0][0] != bytes(16)
+        assert roots[0][0] == servers[0].server.replica.sm.state_root()
+
         # Live scrape (obs/scrape.py): the `stats` wire op answers
         # from the same registry the in-process handles feed, and the
         # fsync/prepare counters satisfy the r10 group-commit
@@ -294,26 +382,6 @@ def _run_cluster_once(tmp_path, fastpath_flag, monkeypatch):
                 # its prepare_ok build span.
                 assert snap["vsr.prepare_ok_us.count"] > 0
 
-        # Proof-of-state query (state_machine/commitment.py): both
-        # replicas answer the sessionless `state_root` op with the
-        # SAME nonzero 16-byte root once converged — the wire-level
-        # rendering of the hash-log convergence claim.
-        from tigerbeetle_tpu.obs.scrape import scrape_state_root
-
-        roots = {}
-        deadline = time.monotonic() + 30.0
-        while time.monotonic() < deadline:
-            roots = {
-                i: scrape_state_root(addresses[i], CLUSTER,
-                                     timeout_ms=20_000)
-                for i in range(len(servers))
-            }
-            if len({cm for _root, cm in roots.values()}) == 1:
-                break
-            time.sleep(0.2)  # backup still applying the tail
-        assert len({root for root, _cm in roots.values()}) == 1, roots
-        assert roots[0][0] != bytes(16)
-        assert roots[0][0] == servers[0].server.replica.sm.state_root()
         return reply_bodies
     finally:
         for c in clients:

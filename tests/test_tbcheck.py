@@ -171,6 +171,39 @@ def test_tidy_migration_patterns_still_caught():
         os.remove(path)
 
 
+def test_envcheck_rule_catches_hash_knob_aliases():
+    """r23 knobs (TB_HASH_REUSE / TB_HASH_THREADS) swept through every
+    alias form the envcheck rule resolves — a raw read of either must
+    flag no matter how the import is spelled, so the only blessed
+    readers stay envcheck.hash_reuse()/hash_threads()."""
+    src = (
+        "import os\n"
+        "import os as _o\n"
+        "from os import environ as E\n"
+        "from os import getenv\n"
+        "def a():\n"
+        "    return os.environ['TB_HASH_REUSE']\n"
+        "def b():\n"
+        "    return E.get('TB_HASH_THREADS')\n"
+        "def c():\n"
+        "    return _o.getenv('TB_HASH_REUSE')\n"
+        "def d():\n"
+        "    return getenv('TB_HASH_THREADS', '0')\n"
+    )
+    path = fixture("_tmp_hash_knobs.py")
+    with open(path, "w") as fh:
+        fh.write(src)
+    try:
+        result = run_lint(files=[path], assume_sim=True)
+        env_findings = [f for f in result.findings if f.rule == "envcheck"]
+        flagged = {f.line for f in env_findings}
+        # direct-getenv via `from os import getenv` resolves too
+        assert flagged >= {6, 8, 10}, env_findings
+        assert all("TB_HASH" in f.message for f in env_findings)
+    finally:
+        os.remove(path)
+
+
 def test_suppression_requires_reason_and_use():
     result = lint_fixture("bad_suppression.py")
     sup = [f for f in result.findings if f.rule == "suppression"]

@@ -285,7 +285,7 @@ class OpenLoopSession:
         if len(midx):
             moffs = offsets[midx]
             mlens = lens[midx]
-            ok, hdrs, _native = fastpath.verify_and_gather(
+            ok, hdrs, _native, _bytes = fastpath.verify_and_gather(
                 arena, moffs, mlens
             )
             mv = memoryview(arena)

@@ -142,7 +142,7 @@ def native_drain() -> int:
     prepare→ack→commit-decision work through ONE native call per
     batch seam (native/tb_pipeline.cpp tb_pl_build_prepares /
     tb_pl_accept_prepares / tb_pl_on_acks / tb_pl_commit_ready_run,
-    ABI 2) — Python demoted to a per-BATCH orchestrator.  Requires
+    ABI 3) — Python demoted to a per-BATCH orchestrator.  Requires
     the native pipeline (TB_NATIVE_PIPELINE=1 and a current .so);
     falls back to the per-item loop otherwise.  0 pins the per-item
     Python loop over the SAME batch seams for differential runs:
@@ -151,6 +151,37 @@ def native_drain() -> int:
     EXPLICITLY makes a stale library a hard error naming
     `make -C native` instead of a silent fallback."""
     return env_int("TB_NATIVE_DRAIN", 1, minimum=0, maximum=1)
+
+
+def hash_reuse() -> int:
+    """TB_HASH_REUSE: 1 (default) makes the commit path hash each
+    prepare body at most ONCE per replica role — the ingress verify
+    pass already proved SHA-256(body), so the build seams
+    (tb_pl_build_prepares and the Python mirror in _primary_prepare /
+    finalize_header) consume that digest (the drain-scoped C digest
+    table, falling back to the verified request header's own
+    checksum_body field) instead of rehashing.  0 rehashes everywhere
+    for differential runs: every consensus/reply frame must be
+    bit-identical either way, only hash.bytes_hashed may differ."""
+    return env_int("TB_HASH_REUSE", 1, minimum=0, maximum=1)
+
+
+def hash_threads() -> int:
+    """TB_HASH_THREADS: native hash-pool worker lanes that fan a
+    drain's independent SHA-256 jobs (frame verifies, body digests,
+    reply finalizes) out of the drain thread, inside the existing
+    GIL-released crossings.  0 (default — right for this 1-core
+    container) runs every hash inline on the calling thread; the named
+    constraint is threads <= 16 (lanes beyond the physical cores of
+    any target box only add contention on the submit path)."""
+    value = env_int("TB_HASH_THREADS", 0, minimum=0)
+    if value > 16:
+        _fail(
+            "TB_HASH_THREADS", str(value),
+            "must be <= 16 — hash lanes beyond any target box's "
+            "cores only add submit-path contention",
+        )
+    return value
 
 
 def cpu_affinity() -> str:

@@ -193,6 +193,28 @@ def _load():
         except AttributeError:
             lib.tb_fp_verify_frames = None
             lib.tb_fp_finalize_headers = None
+        # r23 hash family: counted verify + the hash-pool / engine
+        # controls.  Absent from a stale prebuilt .so whose rebuild
+        # failed: callers degrade to the r20 symbols (uncounted) or
+        # the Python fallback — the pipeline ABI check reports the
+        # staleness loudly either way.
+        try:
+            lib.tb_fp_verify_frames2.restype = ctypes.c_uint64
+            lib.tb_fp_verify_frames2.argtypes = [
+                _U8P, ctypes.POINTER(ctypes.c_uint64), _U32P,
+                ctypes.c_uint32, _U8P,
+            ]
+            lib.tb_hash_configure.argtypes = [
+                ctypes.c_int32, ctypes.c_int32,
+            ]
+            lib.tb_hash_engine.restype = ctypes.c_int32
+            lib.tb_hash_engine.argtypes = []
+            lib.tb_hash_stats.argtypes = [_U64P]
+        except AttributeError:
+            lib.tb_fp_verify_frames2 = None
+            lib.tb_hash_configure = None
+            lib.tb_hash_engine = None
+            lib.tb_hash_stats = None
         # Native commit pipeline (round 20).  Absent symbols mean a
         # stale prebuilt .so whose rebuild failed: pipeline_available()
         # reports False with a rebuild hint instead of letting an
@@ -211,7 +233,7 @@ def _load():
                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
-                ctypes.c_uint64, ctypes.c_uint32, _U8P,
+                ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32, _U8P,
             ]
             lib.tb_pl_build_prepare_ok.argtypes = [
                 ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32, _U8P,
@@ -240,10 +262,11 @@ def _load():
             ]
             lib.tb_pl_votes.restype = ctypes.c_uint32
             lib.tb_pl_votes.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-            # C-resident drain loop (round 22, ABI 2).  Grouped with
-            # the r20 symbols on purpose: a stale .so missing ANY of
-            # them disables the whole pipeline (and reports ABI != 2
-            # anyway), never a mixed old/new symbol set.
+            # C-resident drain loop (round 22; flags added r23, ABI
+            # 3).  Grouped with the r20 symbols on purpose: a stale
+            # .so missing ANY of them disables the whole pipeline (and
+            # reports ABI != 3 anyway), never a mixed old/new symbol
+            # set.
             lib.tb_pl_build_prepares.restype = ctypes.c_int64
             lib.tb_pl_build_prepares.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(_U8P),
@@ -251,7 +274,7 @@ def _load():
                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
                 ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
-                ctypes.c_int, _U8P,
+                ctypes.c_int, ctypes.c_uint32, _U8P,
                 _U8P, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
                 _U8P, ctypes.c_uint64, _U64P, _U64P, _U64P, _U8P, _U64P,
             ]
@@ -276,6 +299,11 @@ def _load():
             lib.tb_pl_abi_version = None
         _lib = lib
         _lib_failed = False
+        # Push the envcheck-validated pool sizing down at load: C
+        # never reads the environment itself (the tbcheck envcheck
+        # rule), and every later crossing inherits the lanes.
+        if lib.tb_hash_configure is not None:
+            lib.tb_hash_configure(envcheck.hash_threads(), 0)
         return _lib
 
 
@@ -626,15 +654,39 @@ def verify_frames(arena: np.ndarray, offsets: np.ndarray,
     return ok
 
 
-def verify_frames_py(arena: np.ndarray, offsets: np.ndarray,
-                     lens: np.ndarray, n: int,
-                     hdrs: np.ndarray | None = None) -> np.ndarray:
+def verify_frames2(arena: np.ndarray, offsets: np.ndarray,
+                   lens: np.ndarray, n: int):
+    """Counted r23 verify: same contract as verify_frames, plus the
+    call opens a new digest-table crossing (verified body digests are
+    cached for the build seams, the previous drain's entries die) and
+    returns the body bytes hashed.  -> (ok u8 flags, bytes_hashed), or
+    None when the library lacks the r23 symbols."""
+    lib = _load()
+    if lib is None or getattr(lib, "tb_fp_verify_frames2", None) is None:
+        return None
+    ok = np.empty(n, np.uint8)
+    offsets = np.ascontiguousarray(offsets[:n], np.uint64)
+    lens = np.ascontiguousarray(lens[:n], np.uint32)
+    bytes_hashed = lib.tb_fp_verify_frames2(
+        ctypes.cast(arena.ctypes.data, _U8P),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        _p(lens, _U32P), n, _p(ok, _U8P),
+    )
+    return ok, int(bytes_hashed)
+
+
+def verify_frames_py2(arena: np.ndarray, offsets: np.ndarray,
+                      lens: np.ndarray, n: int,
+                      hdrs: np.ndarray | None = None):
     """Pure-Python vectorized fallback: structural checks (version,
     size) in one numpy pass, checksums per frame via hashlib (C-speed
     SHA-256 — the same hashes the legacy path paid, minus its
     per-message numpy/dispatch churn).  Pass `hdrs` when the caller
     already gathered the header records (verify_and_gather) so the
-    fallback arm doesn't pay the gather twice."""
+    fallback arm doesn't pay the gather twice.  Returns (ok u8 flags,
+    body bytes hashed) — the byte count matches the native pass by
+    construction (a frame failing the header checksum never reaches
+    its body hash)."""
     from tigerbeetle_tpu.vsr import wire
 
     if hdrs is None:
@@ -644,6 +696,7 @@ def verify_frames_py(arena: np.ndarray, offsets: np.ndarray,
         & (hdrs["size"] == lens[:n])
         & (lens[:n] >= np.uint32(256))
     )
+    bytes_hashed = 0
     mv = memoryview(arena)  # zero-copy per-frame slices
     for i in np.nonzero(ok)[0]:
         off = int(offsets[i])
@@ -656,13 +709,21 @@ def verify_frames_py(arena: np.ndarray, offsets: np.ndarray,
         ):
             ok[i] = False
             continue
+        bytes_hashed += size - 256
         cb = wire.checksum(frame[256:])
         if (
             int(hdrs[i]["checksum_body_lo"]) != cb & 0xFFFFFFFFFFFFFFFF
             or int(hdrs[i]["checksum_body_hi"]) != cb >> 64
         ):
             ok[i] = False
-    return ok.astype(np.uint8)
+    return ok.astype(np.uint8), bytes_hashed
+
+
+def verify_frames_py(arena: np.ndarray, offsets: np.ndarray,
+                     lens: np.ndarray, n: int,
+                     hdrs: np.ndarray | None = None) -> np.ndarray:
+    """verify_frames_py2 without the byte count (r20 signature)."""
+    return verify_frames_py2(arena, offsets, lens, n, hdrs=hdrs)[0]
 
 
 def verify_and_gather(arena: np.ndarray, moffs: np.ndarray,
@@ -671,16 +732,22 @@ def verify_and_gather(arena: np.ndarray, moffs: np.ndarray,
     client completions): one batch checksum pass over the message
     frames — native, or the vectorized Python fallback — plus one
     vectorized header gather.  -> (ok u8 flags, (n,) HEADER_DTYPE
-    records, native bool)."""
+    records, native bool, body bytes hashed).  bytes_hashed is None
+    only on the stale-.so corner (old uncounted symbol present, new
+    one absent) — callers skip the counter rather than guess."""
     from tigerbeetle_tpu.vsr import wire
 
     n = len(moffs)
     hdrs = wire.headers_from_arena(arena, moffs, n)
+    res = verify_frames2(arena, moffs, mlens, n)
+    if res is not None:
+        ok, bytes_hashed = res
+        return ok, hdrs, True, bytes_hashed
     ok = verify_frames(arena, moffs, mlens, n)
-    native = ok is not None
-    if not native:
-        ok = verify_frames_py(arena, moffs, mlens, n, hdrs=hdrs)
-    return ok, hdrs, native
+    if ok is not None:
+        return ok, hdrs, True, None
+    ok, bytes_hashed = verify_frames_py2(arena, moffs, mlens, n, hdrs=hdrs)
+    return ok, hdrs, False, bytes_hashed
 
 
 # ----------------------------------------------------------------------
@@ -694,8 +761,12 @@ def verify_and_gather(arena: np.ndarray, moffs: np.ndarray,
 # native/tb_pipeline.cpp whenever any tb_pl_* signature changes.
 # ABI 2 = the r22 C-resident drain loop batch family
 # (tb_pl_build_prepares / tb_pl_accept_prepares / tb_pl_on_acks /
-# tb_pl_commit_ready_run).
-PIPELINE_ABI = 2
+# tb_pl_commit_ready_run).  ABI 3 = the r23 hash-once commit path:
+# tb_pl_build_prepare / tb_pl_build_prepares grew a digest-reuse
+# flags word, and the library carries the hash pool + counted verify
+# (tb_fp_verify_frames2 / tb_hash_configure / tb_hash_engine /
+# tb_hash_stats).
+PIPELINE_ABI = 3
 
 _PIPELINE_HINT = (
     "libtb_fastpath.so is stale (missing/mismatched tb_pl_* pipeline "
@@ -732,7 +803,7 @@ def drain_error() -> str | None:
     """Why the r22 C-resident drain loop is unavailable even though
     the fastpath library loaded (stale-.so forensics extended to the
     batch symbols), else None.  A library missing any batch symbol
-    also reports pipeline ABI != 2, so this usually collapses into
+    also reports pipeline ABI != 3, so this usually collapses into
     pipeline_error(); the getattr probe is belt and braces."""
     err = pipeline_error()
     if err is not None:
@@ -805,13 +876,13 @@ class NativePipeline:
     def build_prepare(self, request: np.void, body: bytes, *, cluster: int,
                       view: int, op: int, commit: int, timestamp: int,
                       parent: int, replica: int, context: int,
-                      release: int) -> np.void:
+                      release: int, reuse: bool = False) -> np.void:
         out = np.empty(1, self._dtype)
         self._lib.tb_pl_build_prepare(
             request.tobytes(), body, len(body),
             cluster & 0xFFFFFFFFFFFFFFFF, cluster >> 64, view, op,
             commit, timestamp, parent & 0xFFFFFFFFFFFFFFFF, parent >> 64,
-            replica, context, release,
+            replica, context, release, 1 if reuse else 0,
             ctypes.cast(out.ctypes.data, _U8P),
         )
         return out[0]
@@ -919,7 +990,8 @@ def build_prepares(pl: NativePipeline, req_hdrs: np.ndarray, bodies: list,
                    cluster: int, view: int, op0: int, commit: int,
                    parent: int, replica: int, release: int, synced: bool,
                    headers_ring: np.ndarray, slot_count: int,
-                   headers_per_sector: int, sector_size: int):
+                   headers_per_sector: int, sector_size: int,
+                   reuse: bool = False):
     """One C call for a whole drain's prepare builds (r22): K headers
     chained parent->checksum, registered in the slot table with the
     self-vote, and framed for the journal.  Returns (prepares, frames)
@@ -951,7 +1023,7 @@ def build_prepares(pl: NativePipeline, req_hdrs: np.ndarray, bodies: list,
         _p(ts, _U64P), _p(ctx, _U64P), k,
         cluster & 0xFFFFFFFFFFFFFFFF, cluster >> 64, view, op0, commit,
         parent & 0xFFFFFFFFFFFFFFFF, parent >> 64, replica, release,
-        1 if synced else 0,
+        1 if synced else 0, 1 if reuse else 0,
         ctypes.cast(prepares.ctypes.data, _U8P),
         ctypes.cast(headers_ring.ctypes.data, _U8P), slot_count,
         headers_per_sector, sector_size,
@@ -1029,3 +1101,80 @@ def finalize_headers(headers: np.ndarray, bodies: list) -> bool:
         ctypes.cast(headers.ctypes.data, _U8P), n, ptrs, _p(blens, _U32P)
     )
     return True
+
+
+# ----------------------------------------------------------------------
+# Hash-once commit path (round 23): pool configuration, engine
+# identity, and the scalar-fallback forensics.
+
+# tb_hash_engine() codes (native/sha256.h Sha256Engine).
+HASH_ENGINE_NAMES = {1: "evp", 2: "sha256-legacy", 3: "scalar"}
+
+_scalar_warned = False
+
+
+def configure_hash(threads: int | None = None, force_engine: int = 0) -> bool:
+    """(Re)apply the hash-pool lane count (default: the validated
+    TB_HASH_THREADS) and optionally force a SHA-256 engine tier for
+    the --hash-only bench grid (0 = auto).  Returns False when the
+    library is absent or lacks the r23 symbols (inline hashlib/scalar
+    hashing everywhere — nothing to configure)."""
+    lib = _load()
+    if lib is None or getattr(lib, "tb_hash_configure", None) is None:
+        return False
+    if threads is None:
+        threads = envcheck.hash_threads()
+    lib.tb_hash_configure(threads, force_engine)
+    return True
+
+
+def hash_engine_name() -> str:
+    """Which SHA-256 implementation the native library dispatches to
+    ("evp" = libcrypto EVP one-shot / SHA-NI, "sha256-legacy" =
+    libcrypto's compat entry, "scalar" = the portable ~225 MB/s core),
+    or "hashlib" when no native library serves the hot path (Python's
+    hashlib — itself OpenSSL-backed).  Recorded in bench rows so a
+    number can never silently come from the wrong engine."""
+    lib = _load()
+    if lib is None or getattr(lib, "tb_hash_engine", None) is None:
+        return "hashlib"
+    return HASH_ENGINE_NAMES.get(int(lib.tb_hash_engine()), "unknown")
+
+
+def hash_scalar_fallback() -> int:
+    """1 when the native library resolved NEITHER libcrypto tier and
+    every native checksum runs on the 225 MB/s scalar core — surfaced
+    as the hash.scalar_fallback gauge plus a one-time RuntimeWarning
+    (a silent 8x hash regression must never pass as a normal run)."""
+    global _scalar_warned
+    if hash_engine_name() != "scalar":
+        return 0
+    if not _scalar_warned:
+        _scalar_warned = True
+        import warnings
+
+        warnings.warn(
+            "native SHA-256 resolved neither libcrypto's EVP one-shot "
+            "nor SHA256(): hashing runs on the ~225 MB/s scalar "
+            "fallback core (expect ~8x slower checksums; install a "
+            "libcrypto.so to restore SHA-NI dispatch)",
+            RuntimeWarning, stacklevel=2,
+        )
+    return 1
+
+
+def hash_stats() -> dict:
+    """Process-global hash-pool counters: jobs executed on worker
+    lanes (hash.lanes_busy), drain-scoped digest-table hits, and the
+    configured lane count.  Zeros when the library lacks the r23
+    symbols."""
+    lib = _load()
+    if lib is None or getattr(lib, "tb_hash_stats", None) is None:
+        return {"lane_jobs": 0, "table_hits": 0, "threads": 0}
+    out = np.zeros(3, np.uint64)
+    lib.tb_hash_stats(_p(out, _U64P))
+    return {
+        "lane_jobs": int(out[0]),
+        "table_hits": int(out[1]),
+        "threads": int(out[2]),
+    }

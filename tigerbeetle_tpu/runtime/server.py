@@ -299,6 +299,12 @@ class ReplicaServer:
         self._c_fp_fallbacks = self.registry.counter(
             "fastpath.batch_decode_fallbacks"
         )
+        # Raw ingress-verify hash total (every frame body, protocol
+        # included) — the engine-load view; the commit-path subset
+        # feeds vsr.hash.bytes_hashed in _dispatch_drain.
+        self._c_verify_bytes = self.registry.counter(
+            "server.verify_body_bytes"
+        )
         # Native availability is pinned at startup (the loader caches);
         # a build failure is VISIBLE here and in the warning
         # runtime/native.py emits — benches must not pass fallback
@@ -317,6 +323,35 @@ class ReplicaServer:
                 + native_mod.build_error(),
                 flush=True,
             )
+        # Hash-once commit path (round 23): which SHA-256 engine serves
+        # the hot path (scalar fallback warned once + gauged so no
+        # bench can mistake a 225 MB/s run for a SHA-NI run), plus the
+        # process-global pool stats.  hash.lanes_busy counts jobs that
+        # actually ran on worker lanes — 0 under TB_HASH_THREADS=0 by
+        # definition.
+        self.registry.gauge_fn(
+            "hash.engine_code",
+            lambda: {"evp": 1, "sha256-legacy": 2, "scalar": 3}.get(
+                fastpath_mod.hash_engine_name(), 0
+            ),
+        )
+        self.registry.gauge_fn(
+            "hash.scalar_fallback", fastpath_mod.hash_scalar_fallback
+        )
+        self.registry.gauge_fn(
+            "hash.lanes_busy",
+            lambda: fastpath_mod.hash_stats()["lane_jobs"],
+        )
+        self.registry.gauge_fn(
+            "hash.table_hits",
+            lambda: fastpath_mod.hash_stats()["table_hits"],
+        )
+        self.registry.gauge_fn(
+            "hash.threads",
+            lambda: fastpath_mod.hash_stats()["threads"],
+        )
+        if fastpath_mod.batch_verify_available():
+            fastpath_mod.hash_scalar_fallback()  # one-time warning
         # Coalesced reply encode (vsr/replica.py _encode_sub_replies)
         # reports into the server's instrument tree.
         self._h_reply_encode = self.registry.histogram(
@@ -508,10 +543,39 @@ class ReplicaServer:
             t0 = time.perf_counter_ns()
             moffs = offsets[midx]
             mlens = lens[midx]
-            ok, hdrs, native = self._fastpath.verify_and_gather(
+            ok, hdrs, native, bytes_hashed = self._fastpath.verify_and_gather(
                 arena, moffs, mlens
             )
             (self._c_fp_hits if native else self._c_fp_fallbacks).inc()
+            # The verify pass is the ingress hash tier.  The replica's
+            # hash.bytes_hashed tracks COMMIT-PATH body bytes only
+            # (request + prepare frames that verified — the bodies
+            # whose digests the reuse seams may consume), so the smoke
+            # ratio against committed_body_bytes is exact; protocol
+            # bodies (ping clock advertisements etc.) are control-plane
+            # noise and land in server.verify_body_bytes, the raw
+            # engine total.  bytes_hashed is None only on the
+            # stale-.so corner — skip, never guess.
+            if bytes_hashed is not None:
+                self._c_verify_bytes.inc(bytes_hashed)
+                cmds = hdrs["command"]
+                ops = hdrs["operation"]
+                # Sessionless admin queries (stats / state_root) are
+                # request frames that never commit — excluded, or a
+                # scrape-polling client would inflate the numerator.
+                rel = np.asarray(ok, bool) & (
+                    (
+                        (cmds == int(Command.request))
+                        & (ops != int(wire.VsrOperation.stats))
+                        & (ops != int(wire.VsrOperation.state_root))
+                    )
+                    | (cmds == int(Command.prepare))
+                )
+                rel_bytes = (
+                    int(mlens[rel].sum()) - HEADER_SIZE * int(rel.sum())
+                )
+                if rel_bytes > 0:
+                    self.replica._c_hash_bytes.inc(rel_bytes)
             # Amortized decode cost per 128-byte event record, sampled
             # only for rounds that actually carry event bodies —
             # protocol-only rounds (heartbeats, prepare_oks) would

@@ -240,6 +240,10 @@ class AOF:
                 os.close(fd)
 
     def write(self, header: np.ndarray, body: bytes) -> None:
+        # Hash-once invariant (round 23): the append reuses the
+        # committed prepare's already-stamped header verbatim — no
+        # body hash here, ever.  Tailers re-verify on read (AofTail),
+        # which is where rehashing belongs.
         os.write(self._fd, header.tobytes() + body)
         if int(header["command"]) == int(wire.Command.prepare):
             self.last_op = max(self.last_op, int(header["op"]))

@@ -112,7 +112,13 @@ class Journal:
     def write_prepare(self, header: np.ndarray, body: bytes, sync: bool = True) -> None:
         """Append one prepare: prepares ring first, then the redundant
         header sector (reference ordering — so a crash between the two
-        writes is the 'valid prepare / missing redundant' case)."""
+        writes is the 'valid prepare / missing redundant' case).
+
+        Hash-once invariant (round 23): this path must NEVER hash the
+        body — the header arrives finalized (checksum_body stamped by
+        the build seam), and the size assertions below are the only
+        integrity checks the write needs.  Disk bytes are re-verified
+        on READ (read_prepare), where rehashing is the point."""
         assert int(header["command"]) == Command.prepare
         assert int(header["size"]) == HEADER_SIZE + len(body)
         op = int(header["op"])

@@ -380,6 +380,10 @@ class VsrReplica(Replica):
         # overflow) — the "one call per drain" scrape assertion.
         self._c_drain_native = self.metrics.counter("drain.native_calls")
         self._c_drain_fallback = self.metrics.counter("drain.py_fallbacks")
+        # Hash-once counters (_c_hash_bytes / _c_hash_reuse /
+        # _c_hash_commit, _hash_reuse) are inherited from the base
+        # Replica __init__ — see vsr/replica.py for the counting
+        # contract.
         self._drain_native = False
         if envcheck.native_drain() == 1:
             err = _fastpath.drain_error()
@@ -705,6 +709,12 @@ class VsrReplica(Replica):
         if verdict is not None:
             if verdict == "queue":
                 self._enqueue_request(header, body)
+            else:
+                # Duplicate delivery (retransmit / stale number): the
+                # ingress verify already hashed this body, and that
+                # pass can never be elided — charge it to the dup
+                # counter so the reuse ratio stays exact.
+                self._c_hash_dup.inc(len(body))
             return
         if (
             len(self.pipeline) >= self.config.pipeline_prepare_queue_max
@@ -782,6 +792,10 @@ class VsrReplica(Replica):
             else:
                 verdict = self._request_dedupe(h, inflight=inflight)
             if verdict == "drop":
+                # Duplicate delivery: its ingress verify pass was
+                # unavoidable — charge hash.dup_body_bytes (the reuse
+                # ratio's retransmission term), not a reuse miss.
+                self._c_hash_dup.inc(len(body))
                 continue
             if (
                 self.admit_queue is not None
@@ -1332,7 +1346,12 @@ class VsrReplica(Replica):
                 op=op, commit=self.commit_min, timestamp=timestamp,
                 parent=self.parent_checksum, replica=self.replica,
                 context=len(subs) if subs else 0, release=self.release,
+                reuse=self._hash_reuse,
             )
+            if self._hash_reuse:
+                self._c_hash_reuse.inc()
+            else:
+                self._c_hash_bytes.inc(len(body))
         else:
             prepare = wire.make_header(
                 command=Command.prepare, operation=operation,
@@ -1348,7 +1367,19 @@ class VsrReplica(Replica):
             # journal_write / prepare_ok against it without any side
             # channel).
             wire.copy_trace(prepare, request)
-            wire.finalize_header(prepare, body)
+            if self._hash_reuse:
+                # Header-carry reuse (round 23): the request header's
+                # checksum_body field IS this body's digest — proven by
+                # the ingress verify pass (unit requests) or stamped by
+                # _build_batch_request's finalize (coalesced bodies).
+                wire.finalize_header(prepare, body, checksum_body=(
+                    int(request["checksum_body_lo"]),
+                    int(request["checksum_body_hi"]),
+                ))
+                self._c_hash_reuse.inc()
+            else:
+                wire.finalize_header(prepare, body)
+                self._c_hash_bytes.inc(len(body))
         build_ns = time.perf_counter_ns() - t0
         self.anatomy.stage_h(prepare, "prepare")
 
@@ -1438,8 +1469,13 @@ class VsrReplica(Replica):
             slot_count=self.journal.slot_count,
             headers_per_sector=HEADERS_PER_SECTOR,
             sector_size=SECTOR_SIZE,
+            reuse=self._hash_reuse,
         )
         build_ns = time.perf_counter_ns() - t0
+        if self._hash_reuse:
+            self._c_hash_reuse.inc(k)
+        else:
+            self._c_hash_bytes.inc(sum(len(b) for b in bodies))
         if built is None:
             # Arena capacity refused (cannot happen with the exact
             # allocation above — belt and braces): nothing was mutated,
@@ -1712,6 +1748,10 @@ class VsrReplica(Replica):
                 h, in_queue=True, inflight=inflight
             )
             if verdict == "drop":
+                # Its twin committed while this copy waited: the
+                # ingress pass that verified this body joins the dup
+                # term of the reuse ratio (see vsr/replica.py).
+                self._c_hash_dup.inc(len(b))
                 continue
             if verdict == "queue":
                 requeue.append((h, b))
@@ -1793,7 +1833,14 @@ class VsrReplica(Replica):
             if wire.trace_sampled(rh):
                 wire.copy_trace(head, rh)
                 break
+        # Coalescing concatenates bodies into NEW bytes, so this is a
+        # legitimate extra hash pass in BOTH reuse arms (the table keys
+        # on (ptr,len) of ingress frames; concatenation has no cached
+        # digest).  It stamps head.checksum_body = digest(body), which
+        # the prepare-build seam then reuses — the pass happens once,
+        # here, not again at build.
         wire.finalize_header(head, body)
+        self._c_hash_bytes.inc(len(body))
         return head, body, subs
 
     def _primary_prepare_batch(
@@ -2046,6 +2093,12 @@ class VsrReplica(Replica):
             return
 
         if op <= self.op:
+            # Retransmitted (or repair-overlap) prepare: the journal
+            # already holds this op, so the ingress verify that proved
+            # this copy was a duplicate-delivery pass — charged to
+            # hash.dup_body_bytes, the retransmission term of the
+            # reuse ratio (see vsr/replica.py).
+            self._c_hash_dup.inc(len(body))
             self._repair_fill(header, body)
             return
         if op > self.op + 1:

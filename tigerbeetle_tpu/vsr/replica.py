@@ -109,6 +109,30 @@ class Replica:
         self._c_commits = self.metrics.counter("commits")
         self._h_commit = self.metrics.histogram("commit_us")
         self._h_request = self.metrics.histogram("request_us")
+        # Hash-once commit path (round 23).  hash.bytes_hashed counts
+        # BODY bytes actually SHA-256'd on this replica (ingress
+        # verify, build rehashes under TB_HASH_REUSE=0, and the
+        # coalesce finalize — header hashes are fixed 240-byte costs
+        # and excluded by definition); hash.reuse_hits counts build
+        # seams that consumed a cached digest instead of rehashing;
+        # hash.committed_body_bytes is the ratio denominator the TCP
+        # smoke asserts against (bytes_hashed / committed_body_bytes
+        # <= 1.0 per role with reuse on).  hash.dup_body_bytes charges
+        # duplicate DELIVERIES — a retransmitted prepare or request
+        # must be verified before it can be recognized as a duplicate,
+        # so its ingress pass is unavoidable in any design and the
+        # smoke's exact bound is bytes_hashed <= committed + dup.
+        # Created here so the single-replica server scrapes the same
+        # vsr.hash.* names the VSR subclass feeds.
+        from tigerbeetle_tpu import envcheck as _envcheck
+
+        self._hash_reuse = _envcheck.hash_reuse() == 1
+        self._c_hash_bytes = self.metrics.counter("hash.bytes_hashed")
+        self._c_hash_reuse = self.metrics.counter("hash.reuse_hits")
+        self._c_hash_commit = self.metrics.counter(
+            "hash.committed_body_bytes"
+        )
+        self._c_hash_dup = self.metrics.counter("hash.dup_body_bytes")
         # Batched-reply encode pass (one vectorized header build + one
         # batch checksum finalize per committed batch).  The owning
         # server re-points this at its own `server.reply_encode_us`
@@ -403,6 +427,10 @@ class Replica:
             parent=self.parent_checksum,
         )
         wire.finalize_header(header, body)
+        # Single-replica role: bodies originate at the caller (no
+        # ingress frame, no prior digest), so this finalize is the one
+        # hash pass the hash-once contract budgets for the role.
+        self._c_hash_bytes.inc(len(body))
 
         # WAL append is THE durability point — but the fdatasync (disk
         # wait, ~8ms on this container) overlaps the commit stage's CPU
@@ -474,6 +502,11 @@ class Replica:
         ), self._h_commit.time():
             reply = self._commit_prepare_impl(header, body, replay)
         self._c_commits.inc()
+        # Ratio denominator for the hash-once contract: every body
+        # byte this replica commits.  The TCP smoke asserts
+        # bytes_hashed / committed_body_bytes <= 1.0 per role with
+        # reuse on (coalescing excluded — see DESIGN.md r23).
+        self._c_hash_commit.inc(len(body))
         if self.root_ring is not None:
             self._record_root(int(header["op"]))
         self.anatomy.stage_h(header, "commit")

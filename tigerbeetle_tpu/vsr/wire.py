@@ -347,10 +347,23 @@ def trace_sampled(h: np.ndarray) -> int:
     return 0
 
 
-def finalize_header(h: np.ndarray, body: bytes = b"") -> np.ndarray:
-    """Set size + checksum_body + checksum.  Returns `h` for chaining."""
+def finalize_header(
+    h: np.ndarray, body: bytes = b"",
+    checksum_body: tuple[int, int] | None = None,
+) -> np.ndarray:
+    """Set size + checksum_body + checksum.  Returns `h` for chaining.
+
+    `checksum_body` is the hash-once reuse seam (round 23): a caller
+    that already holds `body`'s digest — e.g. from a verified request
+    header, whose checksum_body field the ingress verify pass proved
+    equals SHA-256(body)[:16] — passes the (lo, hi) limb pair and the
+    body pass is skipped.  The caller owns the invariant that the pair
+    IS this body's digest; a wrong pair produces a frame that every
+    verifier rejects (fail-closed, not silent corruption)."""
     h["size"] = HEADER_SIZE + len(body)
-    cb_lo, cb_hi = checksum_pair(body)
+    cb_lo, cb_hi = (
+        checksum_pair(body) if checksum_body is None else checksum_body
+    )
     h["checksum_body_lo"] = cb_lo
     h["checksum_body_hi"] = cb_hi
     raw = bytearray(h.tobytes())
